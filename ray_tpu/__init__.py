@@ -1,0 +1,239 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Tasks, actors, and a shared-memory distributed object store with ownership-based
+reference counting (the Ray core model, rebuilt), plus TPU-first AI libraries: SPMD
+training over JAX/pjit/shard_map meshes, collectives over ICI/DCN via XLA, Pallas kernels
+for long-context attention, datasets, serving, tuning, and RL.
+
+Public API parity: reference `python/ray/__init__.py` — init/shutdown, remote, get, put,
+wait, kill, get_actor, cluster_resources, nodes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, Optional
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID  # noqa: F401
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.worker import (
+    CoreWorker,
+    global_worker,
+    global_worker_or_none,
+    set_global_worker,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor, get_actor, kill  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_driver_state: dict[str, Any] = {}
+
+
+def _current_namespace() -> str:
+    return _driver_state.get("namespace", "")
+
+
+def is_initialized() -> bool:
+    return global_worker_or_none() is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    namespace: str = "",
+    object_store_memory: int = 0,
+    ignore_reinit_error: bool = False,
+    worker_env: Optional[dict] = None,
+    _system_config: Optional[dict] = None,
+    _raylet_port: Optional[int] = None,
+):
+    """Start (or connect to) a cluster and attach this process as the driver.
+
+    Parity: reference `ray.init` (python/ray/_private/worker.py:1427). address=None starts
+    a head node locally; address="host:gcs_port" or the RAY_TPU_ADDRESS env var connects to
+    an existing cluster (a raylet must run on this machine; its port is discovered via GCS).
+    """
+    if is_initialized():
+        if ignore_reinit_error:
+            return _driver_state.get("context")
+        raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+
+    from ray_tpu._private import node as node_mod
+
+    address = address or os.environ.get("RAY_TPU_ADDRESS")
+    _driver_state["namespace"] = namespace
+
+    if address in (None, "local"):
+        session_dir = node_mod.make_session_dir()
+        total = dict(resources or {})
+        if "CPU" not in total:
+            total["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+        from ray_tpu.accelerators import detect_accelerator_resources
+
+        for r, amt in detect_accelerator_resources(num_tpus).items():
+            total.setdefault(r, amt)
+        head = node_mod.start_node(
+            head=True,
+            gcs_addr=None,
+            resources=total,
+            labels=labels,
+            session_dir=session_dir,
+            object_store_bytes=object_store_memory,
+            worker_env=worker_env,
+        )
+        _driver_state["head"] = head
+        _driver_state["session_dir"] = session_dir
+        gcs_addr = ("127.0.0.1", head.gcs_port)
+        raylet_addr = ("127.0.0.1", head.raylet_port)
+    else:
+        host, port = address.split(":")
+        gcs_addr = (host, int(port))
+        raylet_port = _raylet_port or os.environ.get("RAY_TPU_RAYLET_PORT")
+        if raylet_port is None:
+            raise RuntimeError(
+                "connecting to an existing cluster requires RAY_TPU_RAYLET_PORT "
+                "(the local raylet's port)"
+            )
+        raylet_addr = ("127.0.0.1", int(raylet_port))
+
+    worker = CoreWorker(mode="driver", raylet_addr=raylet_addr, gcs_addr=gcs_addr)
+    set_global_worker(worker)
+    worker.connect()
+    _driver_state["worker"] = worker
+    atexit.register(_atexit_shutdown)
+    ctx = RuntimeContext(worker)
+    _driver_state["context"] = ctx
+    return ctx
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    worker = global_worker_or_none()
+    if worker is not None:
+        worker.disconnect()
+        set_global_worker(None)
+    head = _driver_state.pop("head", None)
+    if head is not None:
+        head.terminate()
+    _driver_state.pop("worker", None)
+    _driver_state.pop("context", None)
+
+
+def remote(*args, **kwargs):
+    """Decorator: turn a function into a RemoteFunction or a class into an ActorClass."""
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote() accepts only keyword options")
+    return wrap
+
+
+def get(refs, timeout: Optional[float] = None):
+    worker = global_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout)[0]
+    refs = list(refs) if not isinstance(refs, list) else refs
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"ray_tpu.get() expects an ObjectRef or a list of ObjectRefs, got {type(r).__name__}"
+            )
+    return worker.get(refs, timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local=True):
+    return global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def cluster_resources() -> dict:
+    return global_worker().gcs_call("cluster_resources")["total"]
+
+
+def available_resources() -> dict:
+    return global_worker().gcs_call("cluster_resources")["available"]
+
+
+def nodes() -> list:
+    return global_worker().gcs_call("get_nodes")
+
+
+def timeline() -> list:
+    return global_worker().gcs_call("list_task_events", 10000)
+
+
+class RuntimeContext:
+    """Parity: ray.get_runtime_context()."""
+
+    def __init__(self, worker: CoreWorker):
+        self._worker = worker
+
+    def get_node_id(self):
+        return self._worker.node_id
+
+    def get_worker_id(self):
+        return self._worker.worker_id
+
+    def get_job_id(self):
+        return self._worker.job_id
+
+    def get_actor_id(self):
+        return self._worker.actor_id
+
+    def get_task_id(self):
+        return self._worker.current_task_id
+
+    @property
+    def namespace(self) -> str:
+        return _current_namespace()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(global_worker())
+
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "RuntimeContext",
+    "available_resources",
+    "cluster_resources",
+    "exceptions",
+    "exit_actor",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+]

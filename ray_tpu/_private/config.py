@@ -1,0 +1,83 @@
+"""Central flag table, env-var overridable.
+
+Design parity: reference `src/ray/common/ray_config_def.h` (RAY_CONFIG(type, name, default)
+table, 226 entries, each overridable by a `RAY_<name>` env var) compiled into a `RayConfig`
+singleton (`ray_config.h:60`). Here the table is a plain dict of typed defaults; every entry
+is overridable via `RAY_TPU_<NAME>` environment variables, resolved once at first access.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+# name -> (type, default, doc)
+_DEFS: dict[str, tuple[type, Any, str]] = {
+    # --- core runtime ---
+    "max_direct_call_object_size": (int, 100 * 1024, "objects <= this many bytes are returned inline through the owner's memory store instead of the shared-memory store"),
+    "task_retry_delay_ms": (int, 100, "delay before retrying a failed task"),
+    "max_task_retries_default": (int, 3, "default max_retries for remote functions"),
+    "max_actor_restarts_default": (int, 0, "default max_restarts for actors"),
+    "worker_register_timeout_s": (float, 30.0, "how long the raylet waits for a spawned worker to register"),
+    "worker_pool_prestart": (int, 0, "number of workers to prestart per node"),
+    "idle_worker_kill_s": (float, 300.0, "kill idle workers after this many seconds"),
+    "get_poll_interval_s": (float, 0.002, "poll interval for blocking gets"),
+    "rpc_connect_timeout_s": (float, 10.0, "TCP connect timeout for internal RPC"),
+    "heartbeat_interval_s": (float, 1.0, "raylet -> GCS resource/health report interval"),
+    "node_death_timeout_s": (float, 5.0, "GCS marks a node dead after missing heartbeats for this long"),
+    "object_store_memory_fraction": (float, 0.3, "fraction of system memory for the per-node shared-memory object store"),
+    "object_store_min_chunk_bytes": (int, 1024 * 1024, "chunk size for node-to-node object transfer"),
+    "memory_store_max_inline_refs": (int, 10000, "max unresolved inline futures per worker"),
+    "actor_queue_warn_size": (int, 5000, "warn when an actor's pending call queue exceeds this"),
+    # --- scheduling ---
+    "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
+    "lease_timeout_s": (float, 30.0, "worker lease validity"),
+    # --- logging / observability ---
+    "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
+    "event_buffer_size": (int, 10000, "per-worker task event buffer entries"),
+    "metrics_report_interval_s": (float, 5.0, "metrics push interval"),
+    # --- train / libraries ---
+    "train_health_check_interval_s": (float, 1.0, "train controller worker poll interval"),
+    "serve_long_poll_timeout_s": (float, 30.0, "serve long-poll timeout"),
+    "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
+}
+
+
+class _Config:
+    """Singleton flag table with env overrides (RAY_TPU_<NAME>=value)."""
+
+    def __init__(self):
+        self._cache: dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        cache = self.__dict__["_cache"]
+        if name in cache:
+            return cache[name]
+        if name not in _DEFS:
+            raise AttributeError(f"unknown config {name!r}")
+        typ, default, _doc = _DEFS[name]
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is None:
+            value = default
+        elif typ is bool:
+            value = raw.lower() in ("1", "true", "yes", "on")
+        elif typ in (dict, list):
+            value = json.loads(raw)
+        else:
+            value = typ(raw)
+        cache[name] = value
+        return value
+
+    def _reset(self):
+        self.__dict__["_cache"] = {}
+
+    def _all(self) -> dict[str, Any]:
+        return {name: getattr(self, name) for name in _DEFS}
+
+
+CONFIG = _Config()

@@ -1,0 +1,32 @@
+"""Worker process entry point.
+
+Design parity: reference `python/ray/_private/workers/default_worker.py` — connect the
+CoreWorker, then block in the task loop (here the loop is the event-driven io thread).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+
+def main():
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    raylet_port = int(os.environ["RAY_TPU_RAYLET_PORT"])
+    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
+    worker = CoreWorker(
+        mode="worker",
+        raylet_addr=("127.0.0.1", raylet_port),
+        gcs_addr=(gcs_host, int(gcs_port)),
+        worker_id=worker_id,
+    )
+    set_global_worker(worker)
+    worker.connect()
+    threading.Event().wait()  # serve tasks until the raylet connection closes
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Function/actor-class table backed by the GCS KV store.
+
+Design parity: reference `python/ray/_private/function_manager.py` + GCS function table
+(`src/ray/gcs/gcs_function_manager.h`): functions and actor classes are cloudpickled once
+by the exporting driver, stored under a content hash, and lazily fetched + cached by
+executing workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import cloudpickle
+
+_NS = "fn"
+
+
+class FunctionManager:
+    def __init__(self, worker):
+        self._worker = worker
+        self._cache: dict[bytes, object] = {}
+        self._exported: set[bytes] = set()
+        self._lock = threading.Lock()
+
+    def export(self, obj) -> bytes:
+        """Pickle and upload; returns the content-hash key."""
+        blob = cloudpickle.dumps(obj)
+        key = hashlib.sha1(blob).digest()
+        with self._lock:
+            if key in self._exported:
+                return key
+        self._worker.gcs_kv_put(_NS, key, blob, overwrite=False)
+        with self._lock:
+            self._exported.add(key)
+            self._cache[key] = obj
+        return key
+
+    def load(self, key: bytes):
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        blob = self._worker.gcs_kv_get(_NS, key)
+        if blob is None:
+            raise RuntimeError(f"function {key.hex()[:12]} not found in GCS")
+        obj = cloudpickle.loads(blob)
+        with self._lock:
+            self._cache[key] = obj
+        return obj

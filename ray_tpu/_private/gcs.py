@@ -1,0 +1,537 @@
+"""GCS: cluster control plane.
+
+Design parity: reference `src/ray/gcs/` — node membership + health (gcs_node_manager,
+gcs_health_check_manager), actor registry & scheduling (gcs_actor_manager/_scheduler),
+placement groups (gcs_placement_group_manager/_scheduler), internal KV (gcs_kv_manager),
+function table (gcs_function_manager), resource view (gcs_resource_manager), pubsub
+(GcsPublisher). One asyncio service; storage is in-memory (the reference's default
+InMemoryStoreClient; a persistent store client can be slotted in behind `self.kv`).
+
+Actor scheduling follows the reference's two-phase flow (gcs_actor_manager.h:60-92):
+register (owner alive check, name registration) then schedule (lease a worker via a
+raylet, push the creation task, publish ALIVE).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from typing import Any
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu._private.rpc import Connection
+
+ALIVE = "ALIVE"
+DEAD = "DEAD"
+PENDING = "PENDING_CREATION"
+RESTARTING = "RESTARTING"
+
+
+class NodeInfo:
+    def __init__(self, node_id: NodeID, address, resources_total, labels, conn):
+        self.node_id = node_id
+        self.address = address  # (host, port) of the raylet RPC server
+        self.resources_total = dict(resources_total)
+        self.resources_available = dict(resources_total)
+        self.labels = dict(labels or {})
+        self.conn: Connection = conn
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.is_head = False
+
+    def view(self):
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "alive": self.alive,
+            "is_head": self.is_head,
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec
+        self.state = PENDING
+        self.address = None  # {"node_id": NodeID, "worker_id": WorkerID}
+        self.name = spec.get("name")
+        self.namespace = spec.get("namespace", "")
+        self.restarts_left = spec.get("max_restarts", 0)
+        self.num_restarts = 0
+        self.death_cause = None
+
+    def view(self):
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "name": self.name,
+            "namespace": self.namespace,
+            "class_name": self.spec.get("class_name"),
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: PlacementGroupID, bundles, strategy, name=""):
+        self.pg_id = pg_id
+        self.bundles = bundles  # list[dict resource->amount]
+        self.strategy = strategy
+        self.name = name
+        self.state = PENDING
+        self.allocations: list[NodeID | None] = [None] * len(bundles)
+        self.ready_event = asyncio.Event()
+
+
+class GcsService:
+    """The control plane. One instance; serves every connection (raylets + workers)."""
+
+    def __init__(self):
+        self.nodes: dict[NodeID, NodeInfo] = {}
+        self.actors: dict[ActorID, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.placement_groups: dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: dict[str, dict[bytes, bytes]] = {}
+        self.object_dir: dict[ObjectID, dict] = {}
+        self.subscribers: dict[str, set[Connection]] = {}
+        self.job_counter = 0
+        self.task_events: list[dict] = []
+        self._actor_events: dict[ActorID, asyncio.Event] = {}
+        self._death_task = None
+
+    def start_background(self):
+        self._death_task = asyncio.get_running_loop().create_task(self._death_check_loop())
+
+    # ---------------- helpers ----------------
+
+    async def publish(self, channel: str, message: Any):
+        for conn in list(self.subscribers.get(channel, ())):
+            if conn.closed:
+                self.subscribers[channel].discard(conn)
+                continue
+            try:
+                await conn.notify("publish", channel, message)
+            except Exception:
+                self.subscribers[channel].discard(conn)
+
+    def _node_of_conn(self, conn) -> NodeInfo | None:
+        for node in self.nodes.values():
+            if node.conn is conn:
+                return node
+        return None
+
+    # ---------------- node management ----------------
+
+    async def rpc_register_node(self, conn, node_id: NodeID, address, resources, labels, is_head):
+        info = NodeInfo(node_id, tuple(address), resources, labels, conn)
+        info.is_head = bool(is_head)
+        self.nodes[node_id] = info
+        conn.on_close(lambda c: asyncio.get_running_loop().create_task(self._on_node_lost(node_id)))
+        await self.publish("nodes", {"event": "added", "node": info.view()})
+        return {"ok": True}
+
+    async def rpc_heartbeat(self, conn, node_id: NodeID, resources_available):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False}
+        node.last_heartbeat = time.monotonic()
+        node.resources_available = dict(resources_available)
+        return {"ok": True}
+
+    async def rpc_get_nodes(self, conn):
+        return [n.view() for n in self.nodes.values()]
+
+    async def _on_node_lost(self, node_id: NodeID):
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        await self.publish("nodes", {"event": "removed", "node": node.view()})
+        # Fail actors on the dead node (restart where allowed).
+        for actor in list(self.actors.values()):
+            if actor.address and actor.address["node_id"] == node_id and actor.state == ALIVE:
+                await self._handle_actor_failure(actor, f"node {node_id.hex()[:8]} died")
+        # Drop object locations.
+        for entry in self.object_dir.values():
+            entry["locations"].discard(node_id)
+
+    async def _death_check_loop(self):
+        # A hung/partitioned raylet stops heartbeating without its TCP conn erroring;
+        # stale heartbeat alone marks the node dead (conn close is handled eagerly).
+        while True:
+            await asyncio.sleep(CONFIG.heartbeat_interval_s)
+            deadline = time.monotonic() - CONFIG.node_death_timeout_s
+            for node in list(self.nodes.values()):
+                if node.alive and node.last_heartbeat < deadline:
+                    await self._on_node_lost(node.node_id)
+
+    # ---------------- kv / functions / jobs ----------------
+
+    async def rpc_kv_put(self, conn, namespace: str, key: bytes, value: bytes, overwrite=True):
+        ns = self.kv.setdefault(namespace, {})
+        if not overwrite and key in ns:
+            return False
+        ns[key] = value
+        return True
+
+    async def rpc_kv_get(self, conn, namespace: str, key: bytes):
+        return self.kv.get(namespace, {}).get(key)
+
+    async def rpc_kv_del(self, conn, namespace: str, key: bytes):
+        return self.kv.get(namespace, {}).pop(key, None) is not None
+
+    async def rpc_kv_keys(self, conn, namespace: str, prefix: bytes = b""):
+        return [k for k in self.kv.get(namespace, {}) if k.startswith(prefix)]
+
+    async def rpc_next_job_id(self, conn):
+        self.job_counter += 1
+        return JobID.from_int(self.job_counter)
+
+    # ---------------- pubsub ----------------
+
+    async def rpc_subscribe(self, conn, channel: str):
+        self.subscribers.setdefault(channel, set()).add(conn)
+        return True
+
+    async def rpc_unsubscribe(self, conn, channel: str):
+        self.subscribers.get(channel, set()).discard(conn)
+        return True
+
+    # ---------------- object directory ----------------
+
+    async def rpc_report_object(self, conn, object_id: ObjectID, node_id: NodeID, size, owner):
+        entry = self.object_dir.setdefault(
+            object_id, {"size": size, "owner": owner, "locations": set()}
+        )
+        entry["locations"].add(node_id)
+        entry["size"] = size
+        return True
+
+    async def rpc_object_locations(self, conn, object_id: ObjectID):
+        entry = self.object_dir.get(object_id)
+        if entry is None:
+            return None
+        locs = []
+        for nid in entry["locations"]:
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                locs.append({"node_id": nid, "address": node.address})
+        return {"size": entry["size"], "owner": entry["owner"], "locations": locs}
+
+    async def rpc_free_object(self, conn, object_id: ObjectID):
+        entry = self.object_dir.pop(object_id, None)
+        if entry is None:
+            return False
+        for nid in entry["locations"]:
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                try:
+                    await node.conn.notify("evict_object", object_id)
+                except Exception:
+                    pass
+        return True
+
+    # ---------------- actors ----------------
+
+    async def rpc_register_actor(self, conn, actor_id: ActorID, spec: dict):
+        name = spec.get("name")
+        ns = spec.get("namespace", "")
+        if name:
+            existing_id = self.named_actors.get((ns, name))
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != DEAD:
+                    if spec.get("get_if_exists"):
+                        return {"ok": True, "existing": True, "actor_id": existing_id}
+                    raise ValueError(f"actor with name {name!r} already exists in namespace {ns!r}")
+        actor = ActorInfo(actor_id, spec)
+        self.actors[actor_id] = actor
+        if name:
+            self.named_actors[(ns, name)] = actor_id
+        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        return {"ok": True, "existing": False, "actor_id": actor_id}
+
+    def _pick_node_for(self, resources: dict, scheduling=None) -> NodeInfo | None:
+        """Reference: GcsActorScheduler + hybrid policy. Greedy best-fit over alive nodes."""
+        candidates = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            if scheduling and scheduling.get("node_id") is not None:
+                if node.node_id != scheduling["node_id"]:
+                    continue
+            if all(node.resources_available.get(r, 0) >= amt for r, amt in resources.items()):
+                candidates.append(node)
+        if not candidates:
+            return None
+        # Pack onto the most-utilized feasible node (hybrid default behavior).
+        def utilization(n: NodeInfo):
+            tot = sum(n.resources_total.values()) or 1
+            avail = sum(n.resources_available.values())
+            return (tot - avail) / tot
+
+        return max(candidates, key=utilization)
+
+    async def _schedule_actor(self, actor: ActorInfo, retries: int = 60):
+        spec = actor.spec
+        resources = dict(spec.get("resources") or {})
+        for attempt in range(retries):
+            node = self._pick_node_for(resources, spec.get("scheduling_strategy"))
+            if node is None:
+                await asyncio.sleep(0.25)
+                continue
+            try:
+                result = await node.conn.call("create_actor", actor.actor_id, spec)
+            except Exception:
+                await asyncio.sleep(0.1)
+                continue
+            if result.get("ok"):
+                actor.state = ALIVE
+                actor.address = {"node_id": node.node_id, "worker_id": result["worker_id"]}
+                await self.publish("actors", {"actor": actor.view()})
+                ev = self._actor_events.pop(actor.actor_id, None)
+                if ev:
+                    ev.set()
+                return
+            await asyncio.sleep(0.1)
+        actor.state = DEAD
+        actor.death_cause = "unschedulable: no node with resources " + repr(resources)
+        await self.publish("actors", {"actor": actor.view()})
+        ev = self._actor_events.pop(actor.actor_id, None)
+        if ev:
+            ev.set()
+
+    async def rpc_wait_actor_alive(self, conn, actor_id: ActorID, timeout: float = 60.0):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            raise ValueError(f"unknown actor {actor_id}")
+        if actor.state in (ALIVE, DEAD):
+            return actor.view()
+        ev = self._actor_events.setdefault(actor_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        return actor.view()
+
+    async def rpc_get_actor_info(self, conn, actor_id: ActorID = None, name: str = None, namespace: str = ""):
+        if actor_id is None and name is not None:
+            actor_id = self.named_actors.get((namespace, name))
+            if actor_id is None:
+                return None
+        actor = self.actors.get(actor_id)
+        return actor.view() if actor else None
+
+    async def rpc_list_actors(self, conn):
+        return [a.view() for a in self.actors.values()]
+
+    async def rpc_actor_failed(self, conn, actor_id: ActorID, reason: str):
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == DEAD:
+            return False
+        await self._handle_actor_failure(actor, reason)
+        return True
+
+    async def rpc_kill_actor(self, conn, actor_id: ActorID, no_restart: bool = True):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return False
+        if no_restart:
+            actor.restarts_left = 0
+        if actor.address is not None:
+            node = self.nodes.get(actor.address["node_id"])
+            if node is not None and node.alive:
+                try:
+                    await node.conn.call("kill_actor_worker", actor.actor_id)
+                except Exception:
+                    pass
+        if actor.state != DEAD and actor.restarts_left == 0:
+            actor.state = DEAD
+            actor.death_cause = "killed via ray_tpu.kill"
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            await self.publish("actors", {"actor": actor.view()})
+        return True
+
+    async def _handle_actor_failure(self, actor: ActorInfo, reason: str):
+        if actor.restarts_left != 0:
+            if actor.restarts_left > 0:
+                actor.restarts_left -= 1
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            actor.address = None
+            await self.publish("actors", {"actor": actor.view()})
+            await self._schedule_actor(actor)
+        else:
+            actor.state = DEAD
+            actor.death_cause = reason
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            await self.publish("actors", {"actor": actor.view()})
+
+    # ---------------- placement groups ----------------
+
+    async def rpc_create_placement_group(self, conn, pg_id: PlacementGroupID, bundles, strategy, name=""):
+        pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
+        self.placement_groups[pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return True
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo, retries: int = 120):
+        """Reference: gcs_placement_group_scheduler bundle placement (PACK/SPREAD/STRICT_*)."""
+        for attempt in range(retries):
+            plan = self._plan_bundles(pg)
+            if plan is None:
+                await asyncio.sleep(0.25)
+                continue
+            ok = True
+            reserved: list[tuple[NodeInfo, int]] = []
+            for bundle_index, node in plan:
+                try:
+                    res = await node.conn.call(
+                        "reserve_bundle", pg.pg_id, bundle_index, pg.bundles[bundle_index]
+                    )
+                except Exception:
+                    res = False
+                if not res:
+                    ok = False
+                    break
+                reserved.append((node, bundle_index))
+            if ok:
+                for node, bundle_index in reserved:
+                    pg.allocations[bundle_index] = node.node_id
+                pg.state = ALIVE
+                pg.ready_event.set()
+                await self.publish("placement_groups", {"pg_id": pg.pg_id, "state": ALIVE})
+                return
+            for node, bundle_index in reserved:  # roll back partial reservation
+                try:
+                    await node.conn.call("cancel_bundle", pg.pg_id, bundle_index)
+                except Exception:
+                    pass
+            await asyncio.sleep(0.25)
+        pg.state = DEAD
+        pg.ready_event.set()
+
+    def _plan_bundles(self, pg: PlacementGroupInfo):
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+        by_id = {n.node_id: n for n in alive}
+
+        def fits(nid, bundle):
+            return all(avail[nid].get(r, 0) >= amt for r, amt in bundle.items())
+
+        def take(nid, bundle):
+            for r, amt in bundle.items():
+                avail[nid][r] = avail[nid].get(r, 0) - amt
+
+        plan = []
+        if pg.strategy == "STRICT_PACK":
+            # All bundles must fit on one node.
+            for nid in avail:
+                trial = dict(avail[nid])
+                feasible = True
+                for bundle in pg.bundles:
+                    if all(trial.get(r, 0) >= amt for r, amt in bundle.items()):
+                        for r, amt in bundle.items():
+                            trial[r] = trial.get(r, 0) - amt
+                    else:
+                        feasible = False
+                        break
+                if feasible:
+                    return [(i, by_id[nid]) for i in range(len(pg.bundles))]
+            return None
+        if pg.strategy in ("STRICT_SPREAD",):
+            used_nodes = set()
+            for i, bundle in enumerate(pg.bundles):
+                placed = False
+                for nid in avail:
+                    if nid in used_nodes or not fits(nid, bundle):
+                        continue
+                    take(nid, bundle)
+                    used_nodes.add(nid)
+                    plan.append((i, by_id[nid]))
+                    placed = True
+                    break
+                if not placed:
+                    return None
+            return plan
+        # PACK / SPREAD: best effort; PACK prefers fewest nodes, SPREAD round-robins.
+        order = list(avail)
+        rr = 0
+        for i, bundle in enumerate(pg.bundles):
+            placed = False
+            span = order if pg.strategy == "PACK" else order[rr:] + order[:rr]
+            for nid in span:
+                if fits(nid, bundle):
+                    take(nid, bundle)
+                    plan.append((i, by_id[nid]))
+                    placed = True
+                    rr = (order.index(nid) + 1) % len(order)
+                    break
+            if not placed:
+                return None
+        return plan
+
+    async def rpc_pg_wait_ready(self, conn, pg_id: PlacementGroupID, timeout: float = 60.0):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            raise ValueError(f"unknown placement group {pg_id}")
+        try:
+            await asyncio.wait_for(pg.ready_event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        return {"state": pg.state, "allocations": pg.allocations, "bundles": pg.bundles}
+
+    async def rpc_remove_placement_group(self, conn, pg_id: PlacementGroupID):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return False
+        for bundle_index, nid in enumerate(pg.allocations):
+            if nid is None:
+                continue
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                try:
+                    await node.conn.call("cancel_bundle", pg.pg_id, bundle_index)
+                except Exception:
+                    pass
+        return True
+
+    async def rpc_list_placement_groups(self, conn):
+        return [
+            {"pg_id": pg.pg_id, "state": pg.state, "strategy": pg.strategy, "name": pg.name}
+            for pg in self.placement_groups.values()
+        ]
+
+    # ---------------- task events (observability) ----------------
+
+    async def rpc_report_task_events(self, conn, events: list):
+        self.task_events.extend(events)
+        max_events = 100000
+        if len(self.task_events) > max_events:
+            del self.task_events[: len(self.task_events) - max_events]
+        return True
+
+    async def rpc_list_task_events(self, conn, limit: int = 1000):
+        return self.task_events[-limit:]
+
+    async def rpc_cluster_resources(self, conn):
+        total: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            for r, amt in node.resources_total.items():
+                total[r] = total.get(r, 0) + amt
+            for r, amt in node.resources_available.items():
+                avail[r] = avail.get(r, 0) + amt
+        return {"total": total, "available": avail}

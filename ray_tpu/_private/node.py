@@ -1,0 +1,117 @@
+"""Driver-side node/process management: start and stop the cluster daemons.
+
+Design parity: reference `python/ray/_private/node.py` + `services.py` (Node starts
+gcs_server, raylet, dashboard, ... via start_ray_process). Here a node is one
+raylet_main process (head also hosts the GCS inside it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+
+class NodeProcess:
+    def __init__(self, proc: subprocess.Popen, info: dict, ready_file: str):
+        self.proc = proc
+        self.info = info
+        self.ready_file = ready_file
+
+    @property
+    def node_id_hex(self) -> str:
+        return self.info["node_id"]
+
+    @property
+    def raylet_port(self) -> int:
+        return self.info["raylet_port"]
+
+    @property
+    def gcs_port(self) -> int | None:
+        return self.info.get("gcs_port")
+
+    def terminate(self):
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=5)
+        except Exception:
+            try:
+                self.proc.kill()
+            except Exception:
+                pass
+
+
+def _package_pythonpath(existing: str | None) -> str:
+    """Ensure spawned daemons can import ray_tpu even when the driver added it to
+    sys.path manually (the -m child does not inherit sys.path)."""
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    parts = [pkg_root] + ([existing] if existing else [])
+    return os.pathsep.join(parts)
+
+
+def make_session_dir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    session = os.path.join(base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def start_node(
+    *,
+    head: bool,
+    gcs_addr: tuple[str, int] | None,
+    resources: dict,
+    labels: dict | None = None,
+    session_dir: str,
+    object_store_bytes: int = 0,
+    worker_env: dict | None = None,
+    timeout: float = 30.0,
+) -> NodeProcess:
+    ready_file = os.path.join(
+        session_dir, f"node_ready_{uuid.uuid4().hex[:8]}.json"
+    )
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu._private.raylet_main",
+        "--resources",
+        json.dumps(resources),
+        "--labels",
+        json.dumps(labels or {}),
+        "--worker-env",
+        json.dumps(worker_env or {}),
+        "--session-dir",
+        session_dir,
+        "--object-store-bytes",
+        str(object_store_bytes),
+        "--ready-file",
+        ready_file,
+    ]
+    if head:
+        cmd.append("--head")
+    else:
+        cmd += ["--gcs-host", gcs_addr[0], "--gcs-port", str(gcs_addr[1])]
+    log_path = os.path.join(session_dir, "logs", f"raylet-{uuid.uuid4().hex[:8]}.log")
+    out = open(log_path, "wb")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
+    proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ready_file):
+            with open(ready_file) as f:
+                info = json.load(f)
+            return NodeProcess(proc, info, ready_file)
+        if proc.poll() is not None:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-4000:].decode(errors="replace")
+            raise RuntimeError(f"node process exited during startup:\n{tail}")
+        time.sleep(0.05)
+    proc.terminate()
+    raise TimeoutError("node did not become ready in time")

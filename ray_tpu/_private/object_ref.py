@@ -1,0 +1,77 @@
+"""ObjectRef: a handle to a (possibly pending) remote object.
+
+Design parity: reference `python/ray/includes/object_ref.pxi` + ownership model of
+`src/ray/core_worker/reference_counter.h` — every ref carries its owner's address so any
+holder can locate the object; local refcounts are maintained per process and the owner
+frees the object when all known references are gone.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: dict | None = None, _register: bool = True):
+        self.id = object_id
+        self.owner = owner  # {"node_id": NodeID, "worker_id": WorkerID} | None
+        self._worker = None
+        if _register:
+            from ray_tpu._private.worker import global_worker_or_none
+
+            w = global_worker_or_none()
+            if w is not None:
+                self._worker = w
+                w.reference_counter.add_local_ref(self.id)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        async def _get():
+            from ray_tpu._private.worker import global_worker
+
+            return await asyncio.wrap_future(global_worker().as_future(self))
+
+        return _get().__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Crossing a process boundary: the receiver registers a borrowed reference.
+        return (_deserialize_ref, (self.id.binary(), self.owner))
+
+    def __del__(self):
+        w = self._worker
+        if w is not None:
+            try:
+                w.reference_counter.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+
+def _deserialize_ref(binary: bytes, owner):
+    return ObjectRef(ObjectID(binary), owner)

@@ -1,0 +1,226 @@
+"""Per-node shared-memory object store.
+
+Design parity: reference plasma store (`src/ray/object_manager/plasma/` — dlmalloc arena
+over mmap/shm, LRU eviction, create/seal lifecycle, fd-passing to clients). Here each
+sealed object lives in its own POSIX shm segment created by the raylet process; workers on
+the same node map the segment by name for zero-copy reads (the kernel plays the role of
+the reference's dlmalloc arena; a C++ slab allocator can replace per-object segments
+without changing this API). Lifecycle is the same create → write → seal → (map readers)
+→ free, with capacity accounting and LRU eviction of freed-but-cached entries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from multiprocessing import shared_memory
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+_PREFIX = "rtpu_"
+
+
+class _QuietSharedMemory(shared_memory.SharedMemory):
+    """SharedMemory whose close/finalizer tolerates exported buffers.
+
+    Zero-copy readers hand out memoryviews into the mapping (numpy arrays deserialized
+    from the store alias it); closing with exports alive raises BufferError. We swallow
+    it — the fd is reclaimed by the kernel at process exit, which is the plasma behavior
+    (clients keep objects mapped until release)."""
+
+    def close(self):
+        try:
+            super().close()
+        except BufferError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _untrack(shm: shared_memory.SharedMemory):
+    """Detach from the resource tracker: segment lifetime is managed by the store,
+    not by whichever process happened to touch it (3.12 lacks track=False)."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+class _Entry:
+    __slots__ = ("shm", "size", "sealed", "created_at", "freed")
+
+    def __init__(self, shm, size):
+        self.shm = shm
+        self.size = size
+        self.sealed = False
+        self.freed = False
+        self.created_at = time.monotonic()
+
+
+class SharedObjectStore:
+    """Server side (runs in the raylet process)."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._entries: OrderedDict[ObjectID, _Entry] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def create(self, object_id: ObjectID, size: int) -> str:
+        """Allocate a segment; returns the shm name for the writer to map."""
+        with self._lock:
+            if object_id in self._entries:
+                entry = self._entries[object_id]
+                return entry.shm.name
+            self._ensure_capacity(size)
+            # Full hex: the return-index lives in the trailing bytes, so truncation
+            # would collide every put from one task.
+            name = _PREFIX + object_id.hex()
+            try:
+                shm = _QuietSharedMemory(name=name, create=True, size=max(size, 1))
+            except FileExistsError:
+                old = _QuietSharedMemory(name=name)
+                _untrack(old)
+                old.close()
+                old.unlink()
+                shm = _QuietSharedMemory(name=name, create=True, size=max(size, 1))
+            _untrack(shm)
+            self._entries[object_id] = _Entry(shm, size)
+            self.used += size
+            return shm.name
+
+    def put_bytes(self, object_id: ObjectID, data: bytes) -> str:
+        name = self.create(object_id, len(data))
+        entry = self._entries[object_id]
+        entry.shm.buf[: len(data)] = data
+        self.seal(object_id)
+        return name
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                raise KeyError(f"seal of unknown object {object_id}")
+            entry.sealed = True
+            self._entries.move_to_end(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def info(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            self._entries.move_to_end(object_id)
+            return (e.shm.name, e.size)
+
+    def read_bytes(self, object_id: ObjectID, offset: int = 0, length: int | None = None) -> bytes:
+        """Copy out a range (used for node-to-node transfer chunks)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                raise KeyError(f"object {object_id} not sealed/present")
+            end = e.size if length is None else min(offset + length, e.size)
+            return bytes(e.shm.buf[offset:end])
+
+    def free(self, object_id: ObjectID, eager: bool = False):
+        """Mark freed; eager=True unlinks immediately, else the entry stays as LRU cache."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return
+            e.freed = True
+            if eager:
+                self._evict_locked(object_id)
+
+    def _evict_locked(self, object_id: ObjectID):
+        e = self._entries.pop(object_id, None)
+        if e is None:
+            return
+        self.used -= e.size
+        try:
+            e.shm.close()
+            e.shm.unlink()
+        except Exception:
+            pass
+
+    def _ensure_capacity(self, size: int):
+        if self.used + size <= self.capacity:
+            return
+        # LRU-evict freed entries first (reference: eviction_policy.h LRU over releasable).
+        for oid in [o for o, e in self._entries.items() if e.freed and e.sealed]:
+            self._evict_locked(oid)
+            if self.used + size <= self.capacity:
+                return
+        if self.used + size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit: {self.used}/{self.capacity} used"
+            )
+
+    def stats(self):
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "used_bytes": self.used,
+                "capacity_bytes": self.capacity,
+            }
+
+    def destroy(self):
+        with self._lock:
+            for oid in list(self._entries):
+                self._evict_locked(oid)
+
+
+class LocalObjectReader:
+    """Client side: maps sealed segments by name, caches mappings per process."""
+
+    def __init__(self):
+        self._maps: dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def read(self, shm_name: str, size: int) -> memoryview:
+        with self._lock:
+            shm = self._maps.get(shm_name)
+            if shm is None:
+                shm = _QuietSharedMemory(name=shm_name)
+                _untrack(shm)
+                self._maps[shm_name] = shm
+            return shm.buf[:size]
+
+    def write(self, shm_name: str, data: bytes):
+        with self._lock:
+            shm = self._maps.get(shm_name)
+            if shm is None:
+                shm = _QuietSharedMemory(name=shm_name)
+                _untrack(shm)
+                self._maps[shm_name] = shm
+        shm.buf[: len(data)] = data
+
+    def release(self, shm_name: str):
+        with self._lock:
+            shm = self._maps.pop(shm_name, None)
+        if shm is not None:
+            try:
+                shm.close()
+            except Exception:
+                pass
+
+    def close(self):
+        with self._lock:
+            for shm in self._maps.values():
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+            self._maps.clear()

@@ -1,0 +1,811 @@
+"""Raylet: the per-node manager process.
+
+Design parity: reference `src/ray/raylet/` — NodeManager (node_manager.h:124) combining the
+worker-lease protocol (HandleRequestWorkerLease), worker pool with prestart/reuse
+(worker_pool.h:280), local + cluster lease managers with the hybrid scheduling policy
+(scheduling/cluster_lease_manager.h, policy/hybrid_scheduling_policy.cc), placement-group
+bundle resources (placement_group_resource_manager), and the object manager + plasma store
+hosted in the same process (raylet/main.cc:177). Cross-node object transfer follows the
+push/pull manager design (object_manager/push_manager.h, pull_manager.h) with chunked reads.
+
+Topology difference from the reference (documented, intentional): workers hold exactly one
+connection to their local raylet; all cross-process traffic is routed worker -> raylet
+[-> raylet] -> worker rather than direct worker-to-worker gRPC. On TPU pods the data plane
+for tensors is ICI via XLA collectives, not the object plane, so the object/control plane
+optimizes for simplicity and robustness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+import traceback
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, WorkerID
+from ray_tpu._private.object_store import SharedObjectStore
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen | None, kind: str):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.kind = kind  # "worker" | "driver" | "actor"
+        self.conn: rpc.Connection | None = None
+        self.registered = asyncio.Event()
+        self.busy_task: dict | None = None  # currently running normal task spec
+        self.actor_id: ActorID | None = None
+        self.acquired: dict[str, float] = {}
+        self.pg_key: tuple | None = None  # bundle the acquisition came from, if any
+        self.last_idle = time.monotonic()
+
+    @property
+    def alive(self):
+        return self.conn is not None and not self.conn.closed
+
+
+class ResourceManager:
+    """Reference: LocalResourceManager + placement_group_resource_manager."""
+
+    def __init__(self, total: dict[str, float]):
+        self.total = dict(total)
+        self.available = dict(total)
+        # (pg_id, bundle_index) -> {"reserved": {...}, "available": {...}}
+        self.bundles: dict[tuple, dict] = {}
+
+    def feasible(self, demand: dict[str, float], pg_key=None) -> bool:
+        pool = self.bundles[pg_key]["reserved"] if pg_key in self.bundles else self.total
+        return all(pool.get(r, 0) >= amt for r, amt in demand.items())
+
+    def can_acquire(self, demand: dict[str, float], pg_key=None) -> bool:
+        if pg_key is not None:
+            bundle = self.bundles.get(pg_key)
+            if bundle is None:
+                return False
+            return all(bundle["available"].get(r, 0) >= amt for r, amt in demand.items())
+        return all(self.available.get(r, 0) >= amt for r, amt in demand.items())
+
+    def acquire(self, demand: dict[str, float], pg_key=None) -> bool:
+        if not self.can_acquire(demand, pg_key):
+            return False
+        pool = self.bundles[pg_key]["available"] if pg_key is not None else self.available
+        for r, amt in demand.items():
+            pool[r] = pool.get(r, 0) - amt
+        return True
+
+    def release(self, demand: dict[str, float], pg_key=None):
+        if pg_key is not None:
+            bundle = self.bundles.get(pg_key)
+            if bundle is None:
+                return
+            pool = bundle["available"]
+            cap = bundle["reserved"]
+        else:
+            pool = self.available
+            cap = self.total
+        for r, amt in demand.items():
+            pool[r] = min(pool.get(r, 0) + amt, cap.get(r, 0))
+
+    def reserve_bundle(self, pg_key, resources: dict[str, float]) -> bool:
+        if not all(self.available.get(r, 0) >= amt for r, amt in resources.items()):
+            return False
+        for r, amt in resources.items():
+            self.available[r] -= amt
+        self.bundles[pg_key] = {"reserved": dict(resources), "available": dict(resources)}
+        return True
+
+    def cancel_bundle(self, pg_key):
+        bundle = self.bundles.pop(pg_key, None)
+        if bundle is None:
+            return
+        for r, amt in bundle["reserved"].items():
+            self.available[r] = min(self.available.get(r, 0) + amt, self.total.get(r, 0))
+
+
+class Raylet:
+    def __init__(
+        self,
+        node_id: NodeID,
+        gcs_addr: tuple[str, int],
+        resources: dict[str, float],
+        labels: dict | None = None,
+        is_head: bool = False,
+        session_dir: str = "/tmp/ray_tpu",
+        object_store_bytes: int | None = None,
+        worker_env: dict | None = None,
+    ):
+        self.node_id = node_id
+        self.gcs_addr = gcs_addr
+        self.is_head = is_head
+        self.labels = labels or {}
+        self.session_dir = session_dir
+        self.worker_env = worker_env or {}
+        self.resources = ResourceManager(resources)
+        if object_store_bytes is None:
+            try:
+                import psutil
+
+                object_store_bytes = int(
+                    psutil.virtual_memory().total * CONFIG.object_store_memory_fraction
+                )
+            except Exception:
+                object_store_bytes = 2 << 30
+        self.store = SharedObjectStore(object_store_bytes)
+
+        self.server: rpc.RpcServer | None = None
+        self.gcs: rpc.Connection | None = None
+        self.port: int | None = None
+        self.workers: dict[WorkerID, WorkerHandle] = {}
+        self.actors: dict[ActorID, WorkerID] = {}  # actors hosted on this node
+        self.actor_addr_cache: dict[ActorID, dict] = {}
+        self.task_queue: list[dict] = []  # ready tasks waiting for resources/worker
+        self.running: dict[Any, dict] = {}  # task_id -> spec (dispatched)
+        self.peer_conns: dict[NodeID, rpc.Connection] = {}
+        self.node_view: dict[NodeID, dict] = {}  # cluster view from GCS
+        self._sched_wakeup = asyncio.Event()
+        self._pulls_inflight: dict[ObjectID, asyncio.Future] = {}
+        self._shutdown = False
+
+    # ------------------------------------------------------------------ startup
+
+    async def start(self, port: int = 0):
+        self.server = rpc.RpcServer(lambda conn: self)
+        await self.server.start(port=port)
+        self.port = self.server.port
+        self.gcs = await rpc.connect(*self.gcs_addr, handler=self, name="raylet->gcs")
+        await self.gcs.call(
+            "register_node",
+            self.node_id,
+            ("127.0.0.1", self.port),
+            self.resources.total,
+            self.labels,
+            self.is_head,
+        )
+        # Actor state changes invalidate the local address cache (restart support).
+        await self.gcs.call("subscribe", "actors")
+        await self.gcs.call("subscribe", "nodes")
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._heartbeat_loop())
+        loop.create_task(self._scheduler_loop())
+        loop.create_task(self._idle_reaper_loop())
+        return self
+
+    async def _heartbeat_loop(self):
+        while not self._shutdown:
+            try:
+                await self.gcs.call("heartbeat", self.node_id, self.resources.available)
+                nodes = await self.gcs.call("get_nodes")
+                self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
+            except rpc.RpcError:
+                pass
+            await asyncio.sleep(CONFIG.heartbeat_interval_s)
+
+    async def _idle_reaper_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(10)
+            now = time.monotonic()
+            idle = [
+                w
+                for w in self.workers.values()
+                if w.kind == "worker"
+                and w.busy_task is None
+                and w.actor_id is None
+                and w.alive
+                and now - w.last_idle > CONFIG.idle_worker_kill_s
+            ]
+            # Keep a small warm pool.
+            for w in idle[2:]:
+                await self._kill_worker(w)
+
+    # ------------------------------------------------------------------ peers
+
+    async def _peer(self, node_id: NodeID) -> rpc.Connection | None:
+        conn = self.peer_conns.get(node_id)
+        if conn is not None and not conn.closed:
+            return conn
+        info = self.node_view.get(node_id)
+        if info is None:
+            try:
+                nodes = await self.gcs.call("get_nodes")
+                self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
+            except rpc.RpcError:
+                return None
+            info = self.node_view.get(node_id)
+            if info is None:
+                return None
+        host, port = info["address"]
+        try:
+            conn = await rpc.connect(host, port, handler=self, name=f"raylet->{node_id.hex()[:8]}")
+        except OSError:
+            return None
+        self.peer_conns[node_id] = conn
+        return conn
+
+    # ------------------------------------------------------------------ worker pool
+
+    def _spawn_worker(self, kind: str = "worker") -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "wb")
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        from ray_tpu._private.node import _package_pythonpath
+
+        env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_RAYLET_PORT"] = str(self.port)
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+        )
+        handle = WorkerHandle(worker_id, proc, kind)
+        self.workers[worker_id] = handle
+        return handle
+
+    async def _get_idle_worker(self) -> WorkerHandle | None:
+        for w in self.workers.values():
+            if w.kind == "worker" and w.alive and w.busy_task is None and w.actor_id is None:
+                return w
+        # Spawn a fresh one (bounded by resource acquisition done by caller).
+        handle = self._spawn_worker()
+        try:
+            await asyncio.wait_for(
+                handle.registered.wait(), CONFIG.worker_register_timeout_s
+            )
+        except asyncio.TimeoutError:
+            await self._kill_worker(handle)
+            return None
+        return handle
+
+    async def _kill_worker(self, handle: WorkerHandle):
+        self.workers.pop(handle.worker_id, None)
+        if handle.conn is not None:
+            await handle.conn.close()
+        if handle.proc is not None:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+
+    def _on_worker_lost(self, handle: WorkerHandle):
+        """Worker connection dropped: fail or retry its in-flight work."""
+        self.workers.pop(handle.worker_id, None)
+        if handle.acquired:
+            self.resources.release(handle.acquired, handle.pg_key)
+            handle.acquired = {}
+            handle.pg_key = None
+        spec = handle.busy_task
+        loop = asyncio.get_running_loop()
+        if spec is not None:
+            handle.busy_task = None
+            self.running.pop(spec["task_id"], None)
+            if spec.get("retries_left", 0) > 0:
+                spec["retries_left"] -= 1
+                self.task_queue.append(spec)
+                self._sched_wakeup.set()
+            else:
+                loop.create_task(self._fail_task(spec, "worker died during execution"))
+        if handle.actor_id is not None:
+            actor_id = handle.actor_id
+            self.actors.pop(actor_id, None)
+            loop.create_task(self._report_actor_failure(actor_id, "actor worker process died"))
+
+    async def _report_actor_failure(self, actor_id: ActorID, reason: str):
+        try:
+            await self.gcs.call("actor_failed", actor_id, reason)
+        except rpc.RpcError:
+            pass
+
+    async def _fail_task(self, spec: dict, reason: str):
+        from ray_tpu._private import serialization
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        err = serialization.dumps(WorkerCrashedError(f"task {spec.get('name')} failed: {reason}"))
+        results = [
+            {"object_id": oid, "inline": err, "error": True}
+            for oid in spec["return_ids"]
+        ]
+        await self._route_results_to_owner(spec, results)
+
+    # ------------------------------------------------------------------ scheduling
+
+    def _pg_key(self, spec) -> tuple | None:
+        pg = spec.get("placement_group")
+        if pg is None:
+            return None
+        return (pg["pg_id"], pg["bundle_index"])
+
+    async def _scheduler_loop(self):
+        """Reference: ClusterLeaseManager::ScheduleAndGrantLeases."""
+        while not self._shutdown:
+            await self._sched_wakeup.wait()
+            self._sched_wakeup.clear()
+            progress = True
+            while progress and self.task_queue:
+                progress = False
+                remaining = []
+                for spec in self.task_queue:
+                    dispatched = await self._try_dispatch(spec)
+                    if dispatched:
+                        progress = True
+                    else:
+                        remaining.append(spec)
+                self.task_queue = remaining
+            if self.task_queue:
+                # Re-check periodically while tasks wait on resources.
+                await asyncio.sleep(0.02)
+                self._sched_wakeup.set()
+
+    async def _try_dispatch(self, spec: dict) -> bool:
+        demand = spec.get("resources") or {}
+        pg_key = self._pg_key(spec)
+        if pg_key is not None and pg_key not in self.resources.bundles:
+            # Bundle not on this node: route to the right node via GCS pg info.
+            return await self._spill_to_pg_node(spec)
+        if not self.resources.feasible(demand, pg_key):
+            return await self._spill(spec)
+        if not self.resources.can_acquire(demand, pg_key):
+            # Feasible but busy; consider spreading if another node is free.
+            if await self._maybe_spread(spec):
+                return True
+            return False
+        worker = await self._get_idle_worker()
+        if worker is None:
+            return False
+        # Re-check after the await: an actor creation may have taken the resources.
+        if not self.resources.acquire(demand, pg_key):
+            return False
+        worker.acquired = demand
+        worker.pg_key = pg_key
+        worker.busy_task = spec
+        self.running[spec["task_id"]] = spec
+        try:
+            await worker.conn.notify("push_task", spec)
+        except rpc.RpcError:
+            self._on_worker_lost(worker)
+            return False
+        return True
+
+    async def _spill(self, spec: dict) -> bool:
+        """Task infeasible on this node: find a feasible node and forward (spillback)."""
+        demand = spec.get("resources") or {}
+        for node_id, info in self.node_view.items():
+            if node_id == self.node_id:
+                continue
+            if all(info["resources_total"].get(r, 0) >= amt for r, amt in demand.items()):
+                peer = await self._peer(node_id)
+                if peer is None:
+                    continue
+                try:
+                    await peer.notify("submit_task", spec)
+                    return True
+                except rpc.RpcError:
+                    continue
+        return False  # keep queued; cluster may gain a node
+
+    async def _maybe_spread(self, spec: dict) -> bool:
+        demand = spec.get("resources") or {}
+        if not demand:
+            return False
+        for node_id, info in self.node_view.items():
+            if node_id == self.node_id:
+                continue
+            avail = info.get("resources_available", {})
+            if all(avail.get(r, 0) >= amt for r, amt in demand.items()):
+                peer = await self._peer(node_id)
+                if peer is None:
+                    continue
+                try:
+                    await peer.notify("submit_task", spec)
+                    return True
+                except rpc.RpcError:
+                    continue
+        return False
+
+    async def _spill_to_pg_node(self, spec: dict) -> bool:
+        pg = spec["placement_group"]
+        try:
+            info = await self.gcs.call("pg_wait_ready", pg["pg_id"], 30.0)
+        except rpc.RpcError:
+            return False
+        allocations = info.get("allocations") or []
+        idx = pg["bundle_index"]
+        if idx >= len(allocations) or allocations[idx] is None:
+            return False
+        target = allocations[idx]
+        if target == self.node_id:
+            return False  # bundle is local but not reserved yet; retry
+        peer = await self._peer(target)
+        if peer is None:
+            return False
+        await peer.notify("submit_task", spec)
+        return True
+
+    # ------------------------------------------------------------------ RPC: workers
+
+    async def rpc_register_worker(self, conn, worker_id: WorkerID, kind: str, pid: int):
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            handle = WorkerHandle(worker_id, None, kind)
+            self.workers[worker_id] = handle
+        handle.conn = conn
+        handle.kind = kind if handle.kind == "worker" and kind == "driver" else handle.kind
+        handle.registered.set()
+        conn.on_close(lambda c: self._on_worker_lost(handle))
+        return {"node_id": self.node_id, "store_capacity": self.store.capacity}
+
+    async def rpc_submit_task(self, conn, spec: dict):
+        self.task_queue.append(spec)
+        self._sched_wakeup.set()
+        return True
+
+    async def rpc_task_done(self, conn, task_id, results: list, resources_released=True):
+        spec = self.running.pop(task_id, None)
+        handle = None
+        for w in self.workers.values():
+            if w.busy_task is not None and w.busy_task["task_id"] == task_id:
+                handle = w
+                break
+        if handle is not None:
+            self.resources.release(handle.acquired, handle.pg_key)
+            handle.acquired = {}
+            handle.pg_key = None
+            handle.busy_task = None
+            handle.last_idle = time.monotonic()
+            self._sched_wakeup.set()
+        if spec is not None:
+            await self._route_results_to_owner(spec, results)
+        return True
+
+    async def _route_results_to_owner(self, spec: dict, results: list):
+        owner = spec["owner"]
+        payload = {"task_id": spec["task_id"], "results": results}
+        await self._route_to_worker(owner["node_id"], owner["worker_id"], "task_result", payload)
+
+    async def _route_to_worker(self, node_id: NodeID, worker_id: WorkerID, method: str, payload):
+        if node_id == self.node_id:
+            handle = self.workers.get(worker_id)
+            if handle is not None and handle.alive:
+                try:
+                    await handle.conn.notify(method, payload)
+                except rpc.RpcError:
+                    pass
+            return
+        peer = await self._peer(node_id)
+        if peer is not None:
+            try:
+                await peer.notify("route", worker_id, method, payload)
+            except rpc.RpcError:
+                pass
+
+    async def rpc_route(self, conn, worker_id: WorkerID, method: str, payload):
+        handle = self.workers.get(worker_id)
+        if handle is not None and handle.alive:
+            try:
+                await handle.conn.notify(method, payload)
+            except rpc.RpcError:
+                pass
+        return True
+
+    async def rpc_route_call(self, conn, worker_id: WorkerID, method: str, payload):
+        """Routed request that needs an answer (e.g. inline-object fetch from owner)."""
+        handle = self.workers.get(worker_id)
+        if handle is None or not handle.alive:
+            return {"error": "worker_not_found"}
+        try:
+            return await handle.conn.call(method, payload)
+        except rpc.RpcError:
+            return {"error": "worker_lost"}
+
+    # ------------------------------------------------------------------ RPC: object store
+
+    async def rpc_store_create(self, conn, object_id: ObjectID, size: int):
+        return self.store.create(object_id, size)
+
+    async def rpc_store_seal(self, conn, object_id: ObjectID, size: int, owner):
+        self.store.seal(object_id)
+        try:
+            await self.gcs.call("report_object", object_id, self.node_id, size, owner)
+        except rpc.RpcError:
+            pass
+        return True
+
+    async def rpc_store_put_bytes(self, conn, object_id: ObjectID, data: bytes, owner):
+        name = self.store.put_bytes(object_id, data)
+        try:
+            await self.gcs.call("report_object", object_id, self.node_id, len(data), owner)
+        except rpc.RpcError:
+            pass
+        return name
+
+    async def rpc_store_info(self, conn, object_id: ObjectID):
+        return self.store.info(object_id)
+
+    async def rpc_store_free(self, conn, object_id: ObjectID):
+        self.store.free(object_id)
+        try:
+            await self.gcs.notify("free_object", object_id)
+        except rpc.RpcError:
+            pass
+        return True
+
+    async def rpc_evict_object(self, conn, object_id: ObjectID):
+        self.store.free(object_id, eager=True)
+        return True
+
+    async def rpc_read_chunk(self, conn, object_id: ObjectID, offset: int, length: int):
+        return self.store.read_bytes(object_id, offset, length)
+
+    async def rpc_resolve_object(self, conn, object_id: ObjectID, owner=None, timeout: float = 300.0):
+        """Ensure the object is readable on this node.
+
+        Returns {"shm": (name, size)} for store objects or {"inline": bytes} fetched from
+        the owner's in-process memory store. Reference: CoreWorker::Get's plasma-provider
+        path + PullManager for remote objects.
+        """
+        deadline = time.monotonic() + timeout
+        while True:
+            info = self.store.info(object_id)
+            if info is not None:
+                return {"shm": info}
+            inflight = self._pulls_inflight.get(object_id)
+            if inflight is not None:
+                await inflight
+                continue
+            loc = None
+            try:
+                loc = await self.gcs.call("object_locations", object_id)
+            except rpc.RpcError:
+                pass
+            if loc and loc["locations"]:
+                fut = asyncio.get_running_loop().create_future()
+                self._pulls_inflight[object_id] = fut
+                try:
+                    ok = await self._pull_object(object_id, loc)
+                finally:
+                    self._pulls_inflight.pop(object_id, None)
+                    fut.set_result(None)
+                if ok:
+                    continue
+            elif owner is not None:
+                # Small object living in the owner's memory store.
+                reply = await self._fetch_inline_from_owner(object_id, owner)
+                if reply is not None:
+                    return {"inline": reply}
+            if time.monotonic() > deadline:
+                return {"error": "timeout"}
+            await asyncio.sleep(CONFIG.get_poll_interval_s * 10)
+
+    async def _fetch_inline_from_owner(self, object_id: ObjectID, owner) -> bytes | None:
+        node_id, worker_id = owner["node_id"], owner["worker_id"]
+        payload = {"object_id": object_id}
+        if node_id == self.node_id:
+            handle = self.workers.get(worker_id)
+            if handle is None or not handle.alive:
+                return None
+            try:
+                reply = await handle.conn.call("fetch_inline", payload)
+            except rpc.RpcError:
+                return None
+        else:
+            peer = await self._peer(node_id)
+            if peer is None:
+                return None
+            try:
+                reply = await peer.call("route_call", worker_id, "fetch_inline", payload)
+            except rpc.RpcError:
+                return None
+        if isinstance(reply, dict) and reply.get("data") is not None:
+            return reply["data"]
+        return None
+
+    async def _pull_object(self, object_id: ObjectID, loc: dict) -> bool:
+        """Chunked pull from a remote node (reference: PullManager + ObjectBufferPool)."""
+        size = loc["size"]
+        for location in loc["locations"]:
+            if location["node_id"] == self.node_id:
+                continue
+            peer = await self._peer(location["node_id"])
+            if peer is None:
+                continue
+            try:
+                shm_name = self.store.create(object_id, size)
+                from ray_tpu._private.object_store import LocalObjectReader
+
+                chunk = CONFIG.object_store_min_chunk_bytes
+                offset = 0
+                reader = LocalObjectReader()
+                try:
+                    buf = reader.read(shm_name, size)
+                    while offset < size:
+                        data = await peer.call(
+                            "read_chunk", object_id, offset, min(chunk, size - offset)
+                        )
+                        buf[offset : offset + len(data)] = data
+                        offset += len(data)
+                    del buf
+                finally:
+                    reader.close()
+                self.store.seal(object_id)
+                try:
+                    await self.gcs.call(
+                        "report_object", object_id, self.node_id, size, loc.get("owner")
+                    )
+                except rpc.RpcError:
+                    pass
+                return True
+            except Exception:
+                traceback.print_exc()
+                self.store.free(object_id, eager=True)
+        return False
+
+    # ------------------------------------------------------------------ RPC: actors
+
+    async def rpc_create_actor(self, conn, actor_id: ActorID, spec: dict):
+        """From GCS: lease a dedicated worker and instantiate the actor."""
+        demand = dict(spec.get("resources") or {})
+        pg_key = self._pg_key(spec)
+        if not self.resources.acquire(demand, pg_key):
+            return {"ok": False, "reason": "resources"}
+        handle = self._spawn_worker(kind="actor")
+        try:
+            await asyncio.wait_for(handle.registered.wait(), CONFIG.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            self.resources.release(demand, pg_key)
+            await self._kill_worker(handle)
+            return {"ok": False, "reason": "worker_start_timeout"}
+        handle.actor_id = actor_id
+        handle.acquired = demand
+        handle.pg_key = pg_key
+        try:
+            result = await handle.conn.call("init_actor", actor_id, spec, timeout=300)
+        except rpc.RpcError as e:
+            self.resources.release(demand, pg_key)
+            await self._kill_worker(handle)
+            return {"ok": False, "reason": f"init failed: {e}"}
+        if not result.get("ok"):
+            self.resources.release(demand, pg_key)
+            await self._kill_worker(handle)
+            return {"ok": False, "reason": result.get("error", "init failed")}
+        self.actors[actor_id] = handle.worker_id
+        return {"ok": True, "worker_id": handle.worker_id}
+
+    async def rpc_submit_actor_task(self, conn, spec: dict):
+        """Route an actor method call to the actor's host node/worker."""
+        actor_id = spec["actor_id"]
+        worker_id = self.actors.get(actor_id)
+        if worker_id is not None:
+            handle = self.workers.get(worker_id)
+            if handle is not None and handle.alive:
+                await handle.conn.notify("push_task", spec)
+                return True
+            # Actor worker died; report and fall through to error.
+            await self._report_actor_failure(actor_id, "actor worker dead at submit")
+            await self._fail_actor_task(spec, "actor worker died")
+            return False
+        addr = await self._actor_address(actor_id)
+        if addr is None:
+            await self._fail_actor_task(spec, "actor not found or dead")
+            return False
+        if addr["node_id"] == self.node_id:
+            handle = self.workers.get(addr["worker_id"])
+            if handle is not None and handle.alive:
+                await handle.conn.notify("push_task", spec)
+                return True
+            await self._fail_actor_task(spec, "actor worker dead")
+            return False
+        peer = await self._peer(addr["node_id"])
+        if peer is None:
+            await self._fail_actor_task(spec, "actor node unreachable")
+            return False
+        await peer.notify("submit_actor_task", spec)
+        return True
+
+    async def _actor_address(self, actor_id: ActorID):
+        cached = self.actor_addr_cache.get(actor_id)
+        if cached is not None:
+            return cached
+        try:
+            info = await self.gcs.call("wait_actor_alive", actor_id, 60.0)
+        except rpc.RpcError:
+            return None
+        if info is None or info["state"] != "ALIVE":
+            return None
+        self.actor_addr_cache[actor_id] = info["address"]
+        return info["address"]
+
+    async def _fail_actor_task(self, spec: dict, reason: str):
+        from ray_tpu._private import serialization
+        from ray_tpu.exceptions import ActorDiedError
+
+        err = serialization.dumps(ActorDiedError(spec.get("actor_id"), reason))
+        results = [
+            {"object_id": oid, "inline": err, "error": True} for oid in spec["return_ids"]
+        ]
+        await self._route_results_to_owner(spec, results)
+
+    async def rpc_actor_task_done(self, conn, spec_owner, task_id, results):
+        """Actor worker finished a method call; route results to owner."""
+        await self._route_to_worker(
+            spec_owner["node_id"],
+            spec_owner["worker_id"],
+            "task_result",
+            {"task_id": task_id, "results": results},
+        )
+        return True
+
+    async def rpc_kill_actor_worker(self, conn, actor_id: ActorID):
+        worker_id = self.actors.pop(actor_id, None)
+        if worker_id is None:
+            return False
+        handle = self.workers.get(worker_id)
+        if handle is not None:
+            self.resources.release(handle.acquired, handle.pg_key)
+            handle.acquired = {}
+            handle.pg_key = None
+            handle.actor_id = None
+            await self._kill_worker(handle)
+        return True
+
+    async def rpc_invalidate_actor_cache(self, conn, actor_id: ActorID):
+        self.actor_addr_cache.pop(actor_id, None)
+        return True
+
+    # ------------------------------------------------------------------ RPC: bundles
+
+    async def rpc_reserve_bundle(self, conn, pg_id, bundle_index, resources):
+        return self.resources.reserve_bundle((pg_id, bundle_index), resources)
+
+    async def rpc_cancel_bundle(self, conn, pg_id, bundle_index):
+        self.resources.cancel_bundle((pg_id, bundle_index))
+        return True
+
+    # ------------------------------------------------------------------ RPC: misc
+
+    async def rpc_publish(self, conn, channel, message):
+        """Pubsub fan-in from GCS: actor restarts/deaths and node membership."""
+        if channel == "actors":
+            view = message.get("actor", {})
+            actor_id = view.get("actor_id")
+            if actor_id is not None:
+                if view.get("state") == "ALIVE" and view.get("address"):
+                    self.actor_addr_cache[actor_id] = view["address"]
+                else:
+                    self.actor_addr_cache.pop(actor_id, None)
+        elif channel == "nodes" and message.get("event") == "removed":
+            node_id = message["node"]["node_id"]
+            self.node_view.pop(node_id, None)
+            conn_dead = self.peer_conns.pop(node_id, None)
+            if conn_dead is not None:
+                await conn_dead.close()
+        return True
+
+    async def rpc_node_stats(self, conn):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources.total,
+            "resources_available": self.resources.available,
+            "num_workers": len(self.workers),
+            "queued_tasks": len(self.task_queue),
+            "running_tasks": len(self.running),
+            "store": self.store.stats(),
+        }
+
+    async def shutdown(self):
+        self._shutdown = True
+        for handle in list(self.workers.values()):
+            if handle.kind != "driver":
+                await self._kill_worker(handle)
+        if self.server is not None:
+            await self.server.close()
+        self.store.destroy()

@@ -1,0 +1,260 @@
+"""Internal asyncio RPC layer.
+
+Design parity: reference `src/ray/rpc/` (gRPC server/client helpers + ClientCallManager)
+and the asio io-service threading model of the C++ core worker. Here the transport is
+length-prefixed pickled frames over TCP/unix sockets, with a *symmetric* peer protocol:
+either side of a connection can issue requests, which is how the raylet pushes tasks to
+workers over the same connection the worker registered on (reference: separate gRPC
+services in both directions).
+
+Every process runs one IO thread with an asyncio loop (`IoLoop`), mirroring the reference
+core worker's dedicated io_service thread; blocking public APIs bridge in via
+run_coroutine_threadsafe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+import threading
+import traceback
+from typing import Any, Callable
+
+_LEN_FMT = "<Q"
+_LEN_SIZE = 8
+
+_REQUEST = 0
+_RESPONSE = 1
+_ONEWAY = 2
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    def __init__(self, method: str, tb: str):
+        self.method = method
+        self.remote_traceback = tb
+        super().__init__(f"remote call {method!r} failed:\n{tb}")
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN_SIZE)
+    (length,) = struct.unpack(_LEN_FMT, header)
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+def _frame(msg: Any) -> bytes:
+    payload = pickle.dumps(msg, protocol=5)
+    return struct.pack(_LEN_FMT, len(payload)) + payload
+
+
+class Connection:
+    """A symmetric RPC peer. `handler` is an object whose `rpc_<method>` coroutines serve
+    inbound requests; outbound requests go through `call`/`notify`."""
+
+    def __init__(self, reader, writer, handler: Any = None, name: str = "?"):
+        self._reader = reader
+        self._writer = writer
+        self.handler = handler
+        self.name = name
+        self._mid = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._close_callbacks: list[Callable] = []
+        self._writer_lock = asyncio.Lock()
+        self._recv_task: asyncio.Task | None = None
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        return self
+
+    def on_close(self, cb: Callable):
+        self._close_callbacks.append(cb)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def _send(self, msg):
+        async with self._writer_lock:
+            self._writer.write(_frame(msg))
+            await self._writer.drain()
+
+    async def call(self, method: str, *args, timeout: float | None = None, **kwargs):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        mid = next(self._mid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        await self._send((_REQUEST, mid, method, args, kwargs))
+        try:
+            return await (asyncio.wait_for(fut, timeout) if timeout else fut)
+        finally:
+            self._pending.pop(mid, None)
+
+    async def notify(self, method: str, *args, **kwargs):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        await self._send((_ONEWAY, 0, method, args, kwargs))
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                kind = msg[0]
+                if kind == _RESPONSE:
+                    _, mid, ok, value = msg
+                    fut = self._pending.get(mid)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(value)
+                        else:
+                            fut.set_exception(
+                                value
+                                if isinstance(value, Exception)
+                                else RemoteError(str(mid), str(value))
+                            )
+                elif kind == _REQUEST:
+                    asyncio.get_running_loop().create_task(self._dispatch(msg))
+                elif kind == _ONEWAY:
+                    asyncio.get_running_loop().create_task(self._dispatch(msg, oneway=True))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        except asyncio.CancelledError:
+            return
+        finally:
+            await self._shutdown()
+
+    async def _dispatch(self, msg, oneway: bool = False):
+        _, mid, method, args, kwargs = msg
+        try:
+            fn = getattr(self.handler, "rpc_" + method, None)
+            if fn is None:
+                raise RpcError(f"{type(self.handler).__name__} has no method {method!r}")
+            result = fn(self, *args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if not oneway:
+                await self._send((_RESPONSE, mid, True, result))
+        except Exception as e:  # noqa: BLE001 - must report any handler failure to caller
+            if oneway:
+                traceback.print_exc()
+                return
+            try:
+                pickle.dumps(e)
+                payload: Any = e
+            except Exception:
+                payload = RemoteError(method, traceback.format_exc())
+            try:
+                await self._send((_RESPONSE, mid, False, payload))
+            except Exception:
+                pass
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        for cb in self._close_callbacks:
+            try:
+                res = cb(self)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                traceback.print_exc()
+
+    async def close(self):
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        await self._shutdown()
+
+
+class RpcServer:
+    """Accepts connections; each gets a Connection served by `handler_factory(conn)`."""
+
+    def __init__(self, handler_factory: Callable[[Connection], Any]):
+        self._handler_factory = handler_factory
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[Connection] = set()
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, name="server-peer")
+        conn.handler = self._handler_factory(conn)
+        self.connections.add(conn)
+        conn.on_close(lambda c: self.connections.discard(c))
+        conn.start()
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(
+    host: str, port: int, handler: Any = None, name: str = "client", timeout: float = 10.0
+) -> Connection:
+    reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+    return Connection(reader, writer, handler, name=name).start()
+
+
+class IoLoop:
+    """A dedicated asyncio loop thread (parity: core worker io_service thread)."""
+
+    def __init__(self, name: str = "ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float | None = None):
+        """Run a coroutine on the io thread and block for its result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        """Fire-and-forget a coroutine on the io thread."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _stop():
+            tasks = [t for t in asyncio.all_tasks(self.loop) if t is not asyncio.current_task()]
+            for task in tasks:
+                task.cancel()
+
+            async def _drain():
+                await asyncio.gather(*tasks, return_exceptions=True)
+                self.loop.stop()
+
+            self.loop.create_task(_drain())
+
+        self.loop.call_soon_threadsafe(_stop)
+        self._thread.join(timeout=2)

@@ -1,0 +1,149 @@
+"""Object serialization: cloudpickle + pickle5 out-of-band buffers.
+
+Design parity: reference `python/ray/_private/serialization.py` (cloudpickle with protocol-5
+buffer callbacks so large numpy arrays are written out-of-band and can be mapped zero-copy
+from the shared-memory store). TPU-native addition: `jax.Array` values are serialized as
+host numpy plus sharding-free metadata — device placement is a property of the *runtime*
+(mesh + sharding specs), not of the serialized bytes, which is the correct model under XLA
+where arrays are re-sharded on the receiving mesh.
+
+Wire format of a sealed object:
+    [8-byte LE header len][msgpack header][payload bytes...]
+    header = {"pickled": len, "buffers": [len, ...], "meta": {...}}
+Payload = pickled bytes followed by each raw out-of-band buffer, contiguously.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+import msgpack
+
+_HEADER_LEN_FMT = "<Q"
+_HEADER_LEN_SIZE = 8
+
+# Registered custom (reducer, class) pairs: ray.util.serialization parity.
+_custom_serializers: dict[type, tuple] = {}
+
+
+def register_serializer(cls: type, *, serializer, deserializer):
+    """Parity with `ray.util.serialization.register_serializer`."""
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type):
+    _custom_serializers.pop(cls, None)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+
+    def reducer_override(self, obj):
+        custom = _custom_serializers.get(type(obj))
+        if custom is not None:
+            serializer, deserializer = custom
+            return (_apply_deserializer, (deserializer, serializer(obj)))
+        return super().reducer_override(obj)
+
+
+def _apply_deserializer(deserializer, payload):
+    return deserializer(payload)
+
+
+def _jax_device_put_guard(obj):
+    """Convert jax.Arrays to numpy for the wire; see module docstring."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return obj
+    if isinstance(obj, jax.Array):
+        import numpy as np
+
+        return np.asarray(obj)
+    return obj
+
+
+def serialize(value: Any) -> tuple[bytes, list]:
+    """Return (header_and_pickled, buffers). Buffers are pickle.PickleBuffer objects."""
+    import io
+
+    buffers: list[pickle.PickleBuffer] = []
+    value = _jax_device_put_guard(value)
+    bio = io.BytesIO()
+    pickler = _Pickler(bio, buffers.append)
+    pickler.dump(value)
+    pickled = bio.getvalue()
+    return pickled, buffers
+
+
+def dumps(value: Any) -> bytes:
+    """Serialize to a single contiguous byte string (wire format above)."""
+    pickled, buffers = serialize(value)
+    raw_buffers = [b.raw() for b in buffers]
+    header = msgpack.packb(
+        {"pickled": len(pickled), "buffers": [len(b) for b in raw_buffers]}
+    )
+    parts = [struct.pack(_HEADER_LEN_FMT, len(header)), header, pickled]
+    parts.extend(bytes(b) for b in raw_buffers)
+    return b"".join(parts)
+
+
+def dumps_into(value: Any, dest: memoryview) -> int:
+    """Serialize directly into a writable buffer (a shm mapping). Returns bytes written."""
+    blob = dumps(value)  # one copy; fine until the C++ store lands
+    n = len(blob)
+    if n > len(dest):
+        raise ValueError(f"object of {n} bytes exceeds destination of {len(dest)}")
+    dest[:n] = blob
+    return n
+
+
+def serialized_size(value: Any) -> tuple[bytes, list, int]:
+    pickled, buffers = serialize(value)
+    raw = [b.raw() for b in buffers]
+    header = msgpack.packb({"pickled": len(pickled), "buffers": [len(b) for b in raw]})
+    total = _HEADER_LEN_SIZE + len(header) + len(pickled) + sum(len(b) for b in raw)
+    return pickled, raw, total
+
+
+def _header_bytes(pickled: bytes, raw_buffers: list) -> bytes:
+    header = msgpack.packb(
+        {"pickled": len(pickled), "buffers": [len(b) for b in raw_buffers]}
+    )
+    return struct.pack(_HEADER_LEN_FMT, len(header)) + header
+
+
+def write_parts(dest: memoryview, pickled: bytes, raw_buffers: list) -> int:
+    """Write the wire format into a destination buffer without re-pickling."""
+    head = _header_bytes(pickled, raw_buffers)
+    off = 0
+    for part in [head, pickled, *raw_buffers]:
+        n = len(part)
+        dest[off : off + n] = bytes(part) if not isinstance(part, (bytes, bytearray)) else part
+        off += n
+    return off
+
+
+def assemble(pickled: bytes, raw_buffers: list) -> bytes:
+    """Assemble the full wire blob from pre-serialized parts."""
+    return b"".join([_header_bytes(pickled, raw_buffers), pickled, *(bytes(b) for b in raw_buffers)])
+
+
+def loads(data) -> Any:
+    """Deserialize from bytes or a memoryview (zero-copy for buffers)."""
+    view = memoryview(data)
+    (header_len,) = struct.unpack(_HEADER_LEN_FMT, view[:_HEADER_LEN_SIZE])
+    off = _HEADER_LEN_SIZE
+    header = msgpack.unpackb(bytes(view[off : off + header_len]))
+    off += header_len
+    pickled = view[off : off + header["pickled"]]
+    off += header["pickled"]
+    buffers = []
+    for blen in header["buffers"]:
+        buffers.append(view[off : off + blen])
+        off += blen
+    return pickle.loads(pickled, buffers=buffers)

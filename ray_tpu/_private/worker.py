@@ -1,0 +1,762 @@
+"""CoreWorker: the in-process runtime embedded in every driver and worker process.
+
+Design parity: reference `src/ray/core_worker/core_worker.h` (SubmitTask :856, CreateActor
+:881, SubmitActorTask :938, Put :483, Get :659) + `python/ray/_private/worker.py`. Holds
+the in-process memory store (reference: store_provider/memory_store), the reference counter
+(reference_counter.h), the function manager, dependency-gated task submission (reference:
+DependencyResolver in task_submission/), and the task execution loop with per-caller
+ordered actor queues (task_execution/ actor scheduling queues).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Optional
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID, _Counter
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import LocalObjectReader
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    RayTpuTaskError,
+)
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(worker: Optional["CoreWorker"]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = worker
+
+
+class _Record:
+    __slots__ = ("data", "error", "in_plasma", "resolved", "event", "callbacks")
+
+    def __init__(self):
+        self.data: bytes | None = None
+        self.error = False
+        self.in_plasma = False
+        self.resolved = False
+        self.event = threading.Event()
+        self.callbacks: list = []
+
+
+class MemoryStore:
+    """In-process store for inline objects and pending futures (memory_store.h parity)."""
+
+    def __init__(self):
+        self._records: dict[ObjectID, _Record] = {}
+        self._lock = threading.Lock()
+
+    def create_pending(self, object_id: ObjectID) -> _Record:
+        with self._lock:
+            rec = self._records.get(object_id)
+            if rec is None:
+                rec = _Record()
+                self._records[object_id] = rec
+            return rec
+
+    def get(self, object_id: ObjectID) -> _Record | None:
+        with self._lock:
+            return self._records.get(object_id)
+
+    def resolve(self, object_id: ObjectID, data: bytes | None, error: bool, in_plasma: bool):
+        with self._lock:
+            rec = self._records.get(object_id)
+            if rec is None:
+                rec = _Record()
+                self._records[object_id] = rec
+            rec.data = data
+            rec.error = error
+            rec.in_plasma = in_plasma
+            rec.resolved = True
+            callbacks = rec.callbacks
+            rec.callbacks = []
+        rec.event.set()
+        for cb in callbacks:
+            try:
+                cb(object_id, rec)
+            except Exception:
+                traceback.print_exc()
+
+    def add_done_callback(self, object_id: ObjectID, cb) -> bool:
+        """Returns True if registered (pending), False if already resolved."""
+        with self._lock:
+            rec = self._records.get(object_id)
+            if rec is None:
+                rec = _Record()
+                self._records[object_id] = rec
+            if rec.resolved:
+                return False
+            rec.callbacks.append(cb)
+            return True
+
+    def pop(self, object_id: ObjectID):
+        with self._lock:
+            self._records.pop(object_id, None)
+
+
+class ReferenceCounter:
+    """Local reference counts; owners free the object cluster-wide at zero.
+
+    Reference: `src/ray/core_worker/reference_counter.h` (distributed counting with
+    borrowing). Round-1 divergence: borrower counts are not reported back to the owner;
+    owned objects are freed when the *owner's* local count reaches zero, which matches the
+    dominant driver-owns-everything pattern. Documented in docs/divergences.md.
+    """
+
+    def __init__(self, worker: "CoreWorker"):
+        self._counts: dict[ObjectID, int] = {}
+        self._owned: set[ObjectID] = set()
+        self._lock = threading.Lock()
+        self._worker = worker
+
+    def add_owned(self, object_id: ObjectID):
+        with self._lock:
+            self._owned.add(object_id)
+
+    def add_local_ref(self, object_id: ObjectID):
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_local_ref(self, object_id: ObjectID):
+        free = False
+        with self._lock:
+            n = self._counts.get(object_id, 0) - 1
+            if n > 0:
+                self._counts[object_id] = n
+            else:
+                self._counts.pop(object_id, None)
+                if object_id in self._owned:
+                    self._owned.discard(object_id)
+                    free = True
+        if free:
+            self._worker._free_owned_object(object_id)
+
+    def num_refs(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+
+class _ActorRuntime:
+    """Execution state when this worker hosts an actor."""
+
+    def __init__(self, instance, max_concurrency: int, is_async: bool):
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.is_async = is_async
+        self.expected_seq: dict[bytes, int] = {}
+        self.buffered: dict[tuple[bytes, int], dict] = {}
+        self.executor = ThreadPoolExecutor(max_workers=max_concurrency)
+        self.async_loop: asyncio.AbstractEventLoop | None = None
+        self.semaphore: asyncio.Semaphore | None = None
+        if is_async:
+            self.async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._run_loop, daemon=True, name="actor-asyncio")
+            t.start()
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.async_loop)
+        self.semaphore = asyncio.Semaphore(self.max_concurrency)
+        self.async_loop.run_forever()
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        raylet_addr: tuple[str, int],
+        gcs_addr: tuple[str, int],
+        worker_id: WorkerID | None = None,
+        job_id=None,
+    ):
+        self.mode = mode
+        self.session_token = os.urandom(8).hex()  # distinguishes init/shutdown cycles
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id: NodeID | None = None
+        self.job_id = job_id
+        self.io = rpc.IoLoop(name=f"rtpu-io-{mode}")
+        self.raylet: rpc.Connection | None = None
+        self.gcs: rpc.Connection | None = None
+        self.raylet_addr = raylet_addr
+        self.gcs_addr = gcs_addr
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self)
+        self.functions = FunctionManager(self)
+        self.reader = LocalObjectReader()
+        self._default_task_id = TaskID.from_random()  # driver "task" identity
+        self._pending_promoted: dict[TaskID, list[ObjectID]] = {}
+        self._put_counter = _Counter()
+        self._task_counter = _Counter()
+        self._actor_seq: dict[ActorID, _Counter] = {}
+        self._task_executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-exec")
+        self._future_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rtpu-fut")
+        self.actor_runtime: _ActorRuntime | None = None
+        self.actor_id: ActorID | None = None
+        self._connected = False
+        self._task_events: list[dict] = []
+        self._events_lock = threading.Lock()
+        self._tls = threading.local()
+
+    @property
+    def current_task_id(self) -> TaskID:
+        """The task identity of the calling thread (thread-local inside executors:
+        concurrent tasks must stamp their own ObjectIDs for lineage to hold)."""
+        return getattr(self._tls, "task_id", None) or self._default_task_id
+
+    # ------------------------------------------------------------------ connect
+
+    def connect(self):
+        self.raylet = self.io.run(
+            rpc.connect(*self.raylet_addr, handler=self, name=f"{self.mode}->raylet")
+        )
+        self.gcs = self.io.run(rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs"))
+        reply = self.io.run(
+            self.raylet.call("register_worker", self.worker_id, self.mode, os.getpid())
+        )
+        self.node_id = reply["node_id"]
+        if self.mode == "worker":
+            self.raylet.on_close(lambda c: os._exit(0))
+        if self.job_id is None:
+            self.job_id = self.io.run(self.gcs.call("next_job_id"))
+        self._connected = True
+        self.io.spawn(self._event_flush_loop())
+        return self
+
+    def disconnect(self):
+        self._connected = False
+        try:
+            if self.raylet is not None:
+                self.io.run(self.raylet.close())
+            if self.gcs is not None:
+                self.io.run(self.gcs.close())
+        except Exception:
+            pass
+        self.io.stop()
+        self.reader.close()
+
+    # ------------------------------------------------------------------ kv helpers
+
+    def gcs_kv_put(self, ns: str, key: bytes, value: bytes, overwrite=True):
+        return self.io.run(self.gcs.call("kv_put", ns, key, value, overwrite))
+
+    def gcs_kv_get(self, ns: str, key: bytes):
+        return self.io.run(self.gcs.call("kv_get", ns, key))
+
+    def gcs_call(self, method: str, *args, timeout: float | None = None):
+        return self.io.run(self.gcs.call(method, *args), timeout)
+
+    def raylet_call(self, method: str, *args, timeout: float | None = None):
+        return self.io.run(self.raylet.call(method, *args), timeout)
+
+    # ------------------------------------------------------------------ events
+
+    def _record_event(self, **fields):
+        fields["time"] = time.time()
+        with self._events_lock:
+            self._task_events.append(fields)
+            if len(self._task_events) > CONFIG.event_buffer_size:
+                del self._task_events[: len(self._task_events) // 2]
+
+    async def _event_flush_loop(self):
+        while self._connected:
+            await asyncio.sleep(CONFIG.metrics_report_interval_s)
+            with self._events_lock:
+                batch, self._task_events = self._task_events, []
+            if batch:
+                try:
+                    await self.gcs.call("report_task_events", batch)
+                except rpc.RpcError:
+                    pass
+
+    # ------------------------------------------------------------------ put / get / wait
+
+    def _owner_address(self) -> dict:
+        return {"node_id": self.node_id, "worker_id": self.worker_id}
+
+    def put(self, value: Any) -> ObjectRef:
+        object_id = ObjectID.from_task(self.current_task_id, 0x40000000 + self._put_counter.next())
+        self._put_to_plasma(object_id, value, self._owner_address())
+        self.reference_counter.add_owned(object_id)
+        rec = self.memory_store.create_pending(object_id)
+        rec.in_plasma = True
+        rec.resolved = True
+        rec.event.set()
+        return ObjectRef(object_id, self._owner_address())
+
+    def _put_to_plasma(self, object_id: ObjectID, value: Any, owner: dict):
+        pickled, raw_buffers, total = serialization.serialized_size(value)
+        shm_name = self.raylet_call("store_create", object_id, total)
+        buf = self.reader.read(shm_name, total)
+        serialization.write_parts(buf, pickled, raw_buffers)
+        self.raylet_call("store_seal", object_id, total, owner)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            out.append(self._get_one(ref, deadline))
+        return out
+
+    def _get_one(self, ref: ObjectRef, deadline: float | None):
+        rec = self.memory_store.get(ref.id)
+        if rec is not None and not rec.resolved:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not rec.event.wait(remaining):
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+        rec = self.memory_store.get(ref.id)
+        if rec is not None and rec.resolved and not rec.in_plasma:
+            value = serialization.loads(rec.data)
+            if rec.error:
+                raise value.as_instanceof_cause() if isinstance(value, RayTpuTaskError) else value
+            return value
+        # Plasma or borrowed: resolve via the raylet.
+        remaining = 300.0 if deadline is None else max(0.0, deadline - time.monotonic())
+        reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+        if reply.get("error"):
+            if reply["error"] == "timeout":
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            raise ObjectLostError(ref.id, f"failed to resolve {ref}: {reply['error']}")
+        if "inline" in reply:
+            data = reply["inline"]
+            value = serialization.loads(data)
+        else:
+            shm_name, size = reply["shm"]
+            buf = self.reader.read(shm_name, size)
+            value = serialization.loads(buf)
+        if isinstance(value, RayTpuTaskError):
+            raise value.as_instanceof_cause()
+        if isinstance(value, RayTpuError):
+            raise value
+        return value
+
+    def wait(self, refs: list[ObjectRef], num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: list[ObjectRef] = []
+        while True:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(CONFIG.get_poll_interval_s)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        rec = self.memory_store.get(ref.id)
+        if rec is not None and rec.resolved:
+            return True  # inline value present, or plasma object sealed (owner saw completion)
+        # Borrowed ref: check the local/global store.
+        try:
+            info = self.raylet_call("store_info", ref.id)
+        except rpc.RpcError:
+            return False
+        if info is not None:
+            return True
+        try:
+            loc = self.gcs_call("object_locations", ref.id)
+        except rpc.RpcError:
+            return False
+        return bool(loc and loc["locations"])
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        return self._future_pool.submit(lambda: self.get([ref])[0])
+
+    def _free_owned_object(self, object_id: ObjectID):
+        rec = self.memory_store.get(object_id)
+        self.memory_store.pop(object_id)
+        if rec is not None and rec.in_plasma and self._connected:
+            try:
+                self.io.spawn(self.raylet.notify("store_free", object_id))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ task submission
+
+    def _serialize_args(self, args, kwargs):
+        """Each arg: inline bytes, plasma-promoted ref, or passed-through ObjectRef.
+
+        Returns (args, kwargs, promoted_ids); the caller must release the promoted ids'
+        refcounts once the consuming task completes (or pin them for actor lifetime).
+        """
+        promoted: list[ObjectID] = []
+
+        def one(value):
+            if isinstance(value, ObjectRef):
+                return {"ref": (value.id, value.owner)}
+            pickled, raw_buffers, total = serialization.serialized_size(value)
+            if total > CONFIG.max_direct_call_object_size:
+                object_id = ObjectID.from_task(
+                    self.current_task_id, 0x20000000 + self._put_counter.next()
+                )
+                shm_name = self.raylet_call("store_create", object_id, total)
+                buf = self.reader.read(shm_name, total)
+                serialization.write_parts(buf, pickled, raw_buffers)
+                self.raylet_call("store_seal", object_id, total, self._owner_address())
+                self.reference_counter.add_owned(object_id)
+                self.reference_counter.add_local_ref(object_id)
+                promoted.append(object_id)
+                rec = self.memory_store.create_pending(object_id)
+                rec.in_plasma = True
+                rec.resolved = True
+                rec.event.set()
+                return {"ref": (object_id, self._owner_address()), "promoted": True}
+            header_parts = serialization.assemble(pickled, raw_buffers)
+            return {"v": header_parts}
+
+        return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}, promoted
+
+    def submit_task(
+        self,
+        fn_key: bytes,
+        name: str,
+        args,
+        kwargs,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        placement_group: dict | None = None,
+        max_retries: int | None = None,
+        scheduling_strategy=None,
+    ) -> list[ObjectRef]:
+        task_id = TaskID.from_random()
+        ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
+        if promoted:
+            self._pending_promoted[task_id] = promoted
+        return_ids = [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        owner = self._owner_address()
+        spec = {
+            "type": "task",
+            "task_id": task_id,
+            "name": name,
+            "fn_key": fn_key,
+            "args": ser_args,
+            "kwargs": ser_kwargs,
+            "num_returns": num_returns,
+            "return_ids": return_ids,
+            "resources": resources if resources is not None else {"CPU": 1},
+            "placement_group": placement_group,
+            "owner": owner,
+            "retries_left": (
+                max_retries if max_retries is not None else CONFIG.max_task_retries_default
+            ),
+            "scheduling_strategy": scheduling_strategy,
+        }
+        refs = []
+        for oid in return_ids:
+            self.reference_counter.add_owned(oid)
+            self.memory_store.create_pending(oid)
+            refs.append(ObjectRef(oid, owner))
+        self._record_event(task_id=task_id.hex(), name=name, state="SUBMITTED")
+        self._submit_when_ready(spec)
+        return refs
+
+    def _submit_when_ready(self, spec, target="submit_task"):
+        """Dependency gating: hold until owned pending ref-args resolve (DependencyResolver)."""
+        dep_ids = []
+        for loc in list(spec["args"]) + list(spec["kwargs"].values()):
+            if "ref" in loc:
+                oid = loc["ref"][0]
+                rec = self.memory_store.get(oid)
+                if rec is not None and not rec.resolved:
+                    dep_ids.append(oid)
+        if not dep_ids:
+            self.io.spawn(self.raylet.notify(target, spec))
+            return
+        remaining = {"n": len(dep_ids)}
+        lock = threading.Lock()
+
+        def on_done(_oid, _rec):
+            with lock:
+                remaining["n"] -= 1
+                done = remaining["n"] == 0
+            if done:
+                self.io.spawn(self.raylet.notify(target, spec))
+
+        for oid in dep_ids:
+            if not self.memory_store.add_done_callback(oid, on_done):
+                on_done(oid, None)
+
+    # ------------------------------------------------------------------ actors
+
+    def create_actor(
+        self,
+        cls_key: bytes,
+        class_name: str,
+        args,
+        kwargs,
+        *,
+        name=None,
+        namespace="",
+        get_if_exists=False,
+        num_returns: int = 0,
+        resources=None,
+        placement_group=None,
+        max_restarts=0,
+        max_concurrency=1,
+        is_async=False,
+        scheduling_strategy=None,
+        method_names=(),
+    ) -> ActorID:
+        actor_id = ActorID.from_random()
+        # Promoted init args stay pinned for the actor's lifetime: restarts re-run __init__.
+        ser_args, ser_kwargs, _promoted = self._serialize_args(args, kwargs)
+        spec = {
+            "type": "actor_creation",
+            "actor_id": actor_id,
+            "cls_key": cls_key,
+            "class_name": class_name,
+            "args": ser_args,
+            "kwargs": ser_kwargs,
+            "name": name,
+            "namespace": namespace,
+            "get_if_exists": get_if_exists,
+            "resources": dict(resources or {}),
+            "placement_group": placement_group,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "is_async": is_async,
+            "scheduling_strategy": scheduling_strategy,
+            "owner": self._owner_address(),
+            "method_names": list(method_names),
+        }
+        reply = self.gcs_call("register_actor", actor_id, spec)
+        return reply["actor_id"]
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args,
+        kwargs,
+        num_returns: int = 1,
+    ) -> list[ObjectRef]:
+        task_id = TaskID.from_random()
+        ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
+        if promoted:
+            self._pending_promoted[task_id] = promoted
+        return_ids = [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        owner = self._owner_address()
+        counter = self._actor_seq.setdefault(actor_id, _Counter())
+        spec = {
+            "type": "actor_task",
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "name": method_name,
+            "method_name": method_name,
+            "args": ser_args,
+            "kwargs": ser_kwargs,
+            "num_returns": num_returns,
+            "return_ids": return_ids,
+            "owner": owner,
+            "caller_id": self.worker_id.binary(),
+            "seq": counter.next(),
+        }
+        refs = []
+        for oid in return_ids:
+            self.reference_counter.add_owned(oid)
+            self.memory_store.create_pending(oid)
+            refs.append(ObjectRef(oid, owner))
+        self._submit_when_ready(spec, target="submit_actor_task")
+        return refs
+
+    # ------------------------------------------------------------------ RPC handlers (io thread)
+
+    async def rpc_task_result(self, conn, payload):
+        promoted = self._pending_promoted.pop(payload.get("task_id"), None)
+        if promoted:
+            for oid in promoted:
+                self.reference_counter.remove_local_ref(oid)
+        for result in payload["results"]:
+            oid = result["object_id"]
+            if result.get("in_plasma"):
+                self.memory_store.resolve(oid, None, result.get("error", False), True)
+            else:
+                self.memory_store.resolve(
+                    oid, result["inline"], result.get("error", False), False
+                )
+
+    async def rpc_fetch_inline(self, conn, payload):
+        rec = self.memory_store.get(payload["object_id"])
+        if rec is None:
+            return {"error": "unknown"}
+        if not rec.resolved:
+            return {"pending": True}
+        if rec.in_plasma:
+            return {"plasma": True}
+        return {"data": rec.data}
+
+    async def rpc_publish(self, conn, channel, message):
+        return True
+
+    async def rpc_push_task(self, conn, spec):
+        if spec["type"] == "actor_task":
+            self._enqueue_actor_task(spec)
+        else:
+            self._task_executor.submit(self._execute_task_guarded, spec)
+
+    async def rpc_init_actor(self, conn, actor_id: ActorID, spec):
+        fut = self._task_executor.submit(self._init_actor, actor_id, spec)
+        return await asyncio.wrap_future(fut)
+
+    async def rpc_exit(self, conn):
+        os._exit(0)
+
+    # ------------------------------------------------------------------ execution
+
+    def _materialize(self, loc):
+        if "v" in loc:
+            value = serialization.loads(loc["v"])
+            return value
+        oid, owner = loc["ref"]
+        ref = ObjectRef(oid, owner)
+        return self.get([ref])[0]
+
+    def _materialize_args(self, spec):
+        args = [self._materialize(a) for a in spec["args"]]
+        kwargs = {k: self._materialize(v) for k, v in spec["kwargs"].items()}
+        return args, kwargs
+
+    def _init_actor(self, actor_id: ActorID, spec) -> dict:
+        try:
+            cls = self.functions.load(spec["cls_key"])
+            args, kwargs = self._materialize_args(spec)
+            instance = cls.__new__(cls)
+            instance.__init__(*args, **kwargs)
+            self.actor_runtime = _ActorRuntime(
+                instance, spec.get("max_concurrency", 1), spec.get("is_async", False)
+            )
+            self.actor_id = actor_id
+            return {"ok": True}
+        except Exception:
+            return {"ok": False, "error": traceback.format_exc()}
+
+    def _enqueue_actor_task(self, spec):
+        """Per-caller sequence ordering (ActorSchedulingQueue parity). Runs on io thread."""
+        rt = self.actor_runtime
+        if rt is None:
+            return
+        caller = spec["caller_id"]
+        expected = rt.expected_seq.get(caller, 1)
+        rt.buffered[(caller, spec["seq"])] = spec
+        while (caller, expected) in rt.buffered:
+            ready = rt.buffered.pop((caller, expected))
+            expected += 1
+            rt.expected_seq[caller] = expected
+            if rt.is_async:
+                asyncio.run_coroutine_threadsafe(self._execute_async_actor_task(ready), rt.async_loop)
+            else:
+                rt.executor.submit(self._execute_task_guarded, ready)
+
+    async def _execute_async_actor_task(self, spec):
+        rt = self.actor_runtime
+        async with rt.semaphore:
+            method = getattr(rt.instance, spec["method_name"])
+            try:
+                args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self._materialize_args(spec)
+                )
+                result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = await result
+                results = self._package_results(spec, result)
+            except Exception as e:
+                results = self._package_error(spec, e)
+            self.io.spawn(
+                self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
+            )
+
+    def _execute_task_guarded(self, spec):
+        try:
+            self._execute_task(spec)
+        except Exception:
+            traceback.print_exc()
+
+    def _execute_task(self, spec):
+        prev_task = getattr(self._tls, "task_id", None)
+        self._tls.task_id = spec["task_id"]
+        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING")
+        try:
+            if spec["type"] == "actor_task":
+                fn = getattr(self.actor_runtime.instance, spec["method_name"])
+            else:
+                fn = self.functions.load(spec["fn_key"])
+            args, kwargs = self._materialize_args(spec)
+            result = fn(*args, **kwargs)
+            results = self._package_results(spec, result)
+            state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - report any user failure to the owner
+            results = self._package_error(spec, e)
+            state = "FAILED"
+        finally:
+            self._tls.task_id = prev_task
+        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state=state)
+        if spec["type"] == "actor_task":
+            self.io.spawn(
+                self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
+            )
+        else:
+            self.io.spawn(self.raylet.notify("task_done", spec["task_id"], results))
+
+    def _package_results(self, spec, result) -> list:
+        num_returns = spec["num_returns"]
+        if num_returns == 0:
+            values = []
+        elif num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task {spec['name']} declared num_returns={num_returns} "
+                    f"but returned {len(values)} values"
+                )
+        out = []
+        for oid, value in zip(spec["return_ids"], values):
+            pickled, raw_buffers, total = serialization.serialized_size(value)
+            if total > CONFIG.max_direct_call_object_size:
+                shm_name = self.raylet_call("store_create", oid, total)
+                buf = self.reader.read(shm_name, total)
+                serialization.write_parts(buf, pickled, raw_buffers)
+                self.raylet_call("store_seal", oid, total, spec["owner"])
+                out.append({"object_id": oid, "in_plasma": True, "size": total})
+            else:
+                out.append(
+                    {"object_id": oid, "inline": serialization.assemble(pickled, raw_buffers)}
+                )
+        return out
+
+    def _package_error(self, spec, exc: Exception) -> list:
+        err = RayTpuTaskError.from_exception(spec["name"], exc)
+        data = serialization.dumps(err)
+        return [
+            {"object_id": oid, "inline": data, "error": True} for oid in spec["return_ids"]
+        ]

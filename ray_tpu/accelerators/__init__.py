@@ -1,0 +1,10 @@
+"""Accelerator plugin layer.
+
+Design parity: reference `python/ray/_private/accelerators/` — per-vendor
+AcceleratorManager ABC (accelerator.py:18) with auto-detection, visibility env vars, and
+extra pod/slice resources. TPU is the first-class citizen here (reference tpu.py:199).
+"""
+
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager, detect_accelerator_resources
+
+__all__ = ["TPUAcceleratorManager", "detect_accelerator_resources"]

@@ -1,0 +1,116 @@
+"""TPU accelerator manager: chip/topology/slice discovery and slice resources.
+
+Design parity: reference `python/ray/_private/accelerators/tpu.py` (:199 TPUAcceleratorManager)
+— detects chips via env/GCE metadata (TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, TPU_NAME,
+TPU_WORKER_ID), sets TPU_VISIBLE_CHIPS for workers, and publishes three resource kinds:
+  - "TPU": chips on this host,
+  - pod-type resource, e.g. "TPU-v4-16" (tpu.py:326),
+  - per-slice head resource "TPU-<pod>-head" on worker 0 (tpu.py:482-547), which makes
+    slice-atomic gang scheduling expressible as a placement-group bundle.
+"""
+
+from __future__ import annotations
+
+import os
+
+# chips per host for common TPU generations (full-host slices)
+_CHIPS_PER_HOST = 4
+
+
+def _env(name: str) -> str | None:
+    v = os.environ.get(name)
+    return v if v else None
+
+
+class TPUAcceleratorManager:
+    """Discovery + visibility for TPU chips on this host."""
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        explicit = _env("TPU_CHIPS_PER_HOST")
+        if explicit:
+            return int(explicit)
+        accel = _env("TPU_ACCELERATOR_TYPE")  # e.g. "v4-16"
+        if accel is None:
+            # Fall back to live JAX discovery when running on a TPU VM.
+            try:
+                import jax
+
+                return len([d for d in jax.devices() if d.platform == "tpu"])
+            except Exception:
+                return 0
+        return _CHIPS_PER_HOST
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> str | None:
+        accel = _env("TPU_ACCELERATOR_TYPE")
+        if accel is None:
+            return None
+        return "TPU-" + accel.split("-")[0].upper()  # e.g. TPU-V4
+
+    @staticmethod
+    def get_current_pod_type_resource() -> str | None:
+        """e.g. TPU_ACCELERATOR_TYPE=v4-16 -> 'TPU-v4-16'."""
+        accel = _env("TPU_ACCELERATOR_TYPE")
+        if accel is None:
+            return None
+        return f"TPU-{accel}"
+
+    @staticmethod
+    def get_worker_id() -> int:
+        return int(_env("TPU_WORKER_ID") or 0)
+
+    @staticmethod
+    def get_slice_name() -> str | None:
+        return _env("TPU_NAME")
+
+    @staticmethod
+    def is_slice_head() -> bool:
+        return TPUAcceleratorManager.get_worker_id() == 0
+
+    @staticmethod
+    def set_visible_chips(chip_ids: list[int], env: dict) -> None:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chip_ids)
+
+    @staticmethod
+    def node_resources() -> dict[str, float]:
+        """All TPU-related resources this host should advertise."""
+        n = TPUAcceleratorManager.get_current_node_num_accelerators()
+        if n <= 0:
+            return {}
+        resources: dict[str, float] = {"TPU": float(n)}
+        pod_type = TPUAcceleratorManager.get_current_pod_type_resource()
+        if pod_type:
+            resources[pod_type] = 1.0
+            if TPUAcceleratorManager.is_slice_head():
+                resources[f"{pod_type}-head"] = 1.0
+        slice_name = TPUAcceleratorManager.get_slice_name()
+        if slice_name:
+            resources[f"TPU-{slice_name}"] = 1.0
+        return resources
+
+
+def detect_accelerator_resources(num_tpus: int | None = None) -> dict[str, float]:
+    """Resources to advertise for the local node; num_tpus overrides discovery."""
+    if num_tpus is not None:
+        res = {"TPU": float(num_tpus)} if num_tpus else {}
+        pod_type = TPUAcceleratorManager.get_current_pod_type_resource()
+        if num_tpus and pod_type:
+            res[pod_type] = 1.0
+            if TPUAcceleratorManager.is_slice_head():
+                res[f"{pod_type}-head"] = 1.0
+        return res
+    return TPUAcceleratorManager.node_resources()
+
+
+def reserve_tpu_slice(pod_type: str):
+    """Create a placement group that atomically reserves one TPU slice.
+
+    Parity: reference tpu.py:131-197 reserve_tpu_slice/fetch_tpu_slice_name_from_pg —
+    a STRICT_PACK bundle on the slice-head resource gates the whole slice.
+    """
+    from ray_tpu.util.placement_group import placement_group
+
+    return placement_group(
+        bundles=[{f"{pod_type}-head": 1.0}], strategy="STRICT_PACK", name=f"slice-{pod_type}"
+    )

@@ -1,0 +1,167 @@
+"""Actor API: @ray_tpu.remote on classes, ActorClass / ActorHandle / ActorMethod.
+
+Design parity: reference `python/ray/actor.py` (ActorClass._remote :1498, ActorHandle
+:1857, ActorMethod._remote :792) — named actors, namespaces, get_if_exists, max_restarts,
+max_concurrency (threaded) and async actors (async def methods → asyncio event loop with
+a concurrency semaphore), ordered per-caller method delivery.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.worker import global_worker
+from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.remote_function import _build_pg_spec, _build_resources, _resolve_scheduling
+
+_ACTOR_DEFAULTS = {
+    "num_cpus": 0,
+    "num_tpus": 0,
+    "resources": None,
+    "name": None,
+    "namespace": None,
+    "get_if_exists": False,
+    "lifetime": None,
+    "max_restarts": 0,
+    "max_concurrency": None,
+    "placement_group": None,
+    "placement_group_bundle_index": 0,
+    "scheduling_strategy": None,
+    "max_retries": None,
+    "num_returns": 1,
+}
+
+
+def _public_methods(cls) -> list[str]:
+    names = []
+    for name, member in inspect.getmembers(cls, predicate=callable):
+        if not name.startswith("_") or name == "__call__":
+            names.append(name)
+    return names
+
+
+def _has_async_methods(cls) -> bool:
+    return any(
+        inspect.iscoroutinefunction(m)
+        for _n, m in inspect.getmembers(cls, predicate=inspect.isfunction)
+    )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs, self._num_returns
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: list[str], class_name: str = ""):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._class_name = class_name
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name or self._actor_id} has no method {name!r}"
+            )
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names, self._class_name))
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = {**_ACTOR_DEFAULTS, **options}
+        self._cls_key = None
+
+    def options(self, **overrides) -> "ActorClass":
+        clone = ActorClass(self._cls, {**self._options, **overrides})
+        clone._cls_key = self._cls_key
+        return clone
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker()
+        if self._cls_key is None or getattr(self, "_cls_session", None) != worker.session_token:
+            self._cls_key = worker.functions.export(self._cls)
+            self._cls_session = worker.session_token
+        opts = self._options
+        strategy, opts = _resolve_scheduling(opts)
+        is_async = _has_async_methods(self._cls)
+        max_concurrency = opts["max_concurrency"] or (1000 if is_async else 1)
+        namespace = opts["namespace"]
+        if namespace is None:
+            import ray_tpu
+
+            namespace = ray_tpu._current_namespace()
+        method_names = _public_methods(self._cls)
+        actor_id = worker.create_actor(
+            cls_key=self._cls_key,
+            class_name=self._cls.__name__,
+            args=args,
+            kwargs=kwargs,
+            name=opts["name"],
+            namespace=namespace,
+            get_if_exists=opts["get_if_exists"],
+            resources=_build_resources(opts),
+            placement_group=_build_pg_spec(opts),
+            max_restarts=opts["max_restarts"],
+            max_concurrency=max_concurrency,
+            is_async=is_async,
+            scheduling_strategy=strategy,
+            method_names=method_names,
+        )
+        return ActorHandle(actor_id, method_names, self._cls.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()"
+        )
+
+
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    worker = global_worker()
+    if namespace is None:
+        import ray_tpu
+
+        namespace = ray_tpu._current_namespace()
+    info = worker.gcs_call("get_actor_info", None, name, namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"actor {name!r} not found in namespace {namespace!r}")
+    return ActorHandle(info["actor_id"], [], info.get("class_name") or "")
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    worker = global_worker()
+    worker.gcs_call("kill_actor", actor._actor_id, no_restart)
+
+
+def exit_actor():
+    """Terminate the current actor process (parity: ray.actor.exit_actor)."""
+    import os
+
+    worker = global_worker()
+    if worker.actor_id is None:
+        raise RuntimeError("exit_actor called outside an actor")
+    os._exit(0)

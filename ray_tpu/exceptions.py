@@ -1,0 +1,129 @@
+"""Public exception hierarchy.
+
+Design parity: reference `python/ray/exceptions.py` (RayError, RayTaskError, RayActorError,
+GetTimeoutError, ObjectLostError, OutOfMemoryError, ...).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class RayTpuTaskError(RayTpuError):
+    """A task raised an exception on the executing worker.
+
+    Mirrors the reference's RayTaskError: wraps the remote traceback and re-raises at
+    `get()` time on the caller, preserving the original exception as `.cause`.
+    """
+
+    def __init__(self, function_name: str, tb_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = tb_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{tb_str}")
+
+    def __reduce__(self):
+        return (RayTpuTaskError, (self.function_name, self.traceback_str, self.cause))
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: Exception) -> "RayTpuTaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        picklable = exc
+        try:  # exceptions holding unpicklable state fall back to a string repr
+            import cloudpickle
+
+            cloudpickle.dumps(exc)
+        except Exception:
+            picklable = None
+        return cls(function_name, tb, picklable)
+
+    def as_instanceof_cause(self):
+        """Return an exception that is also an instance of the cause's type."""
+        cause = self.cause
+        if cause is None or isinstance(cause, RayTpuTaskError):
+            return self
+
+        class _Wrapped(RayTpuTaskError, type(cause)):
+            def __init__(self, outer):
+                RayTpuTaskError.__init__(
+                    self, outer.function_name, outer.traceback_str, outer.cause
+                )
+
+            def __str__(self):
+                return RayTpuTaskError.__str__(self)
+
+            def __reduce__(self):
+                return (_rebuild_task_error, (self.function_name, self.traceback_str, self.cause))
+
+        try:
+            return _Wrapped(self)
+        except Exception:
+            return self
+
+
+def _rebuild_task_error(function_name, tb_str, cause):
+    return RayTpuTaskError(function_name, tb_str, cause).as_instanceof_cause()
+
+
+class RayTpuActorError(RayTpuError):
+    """The actor died before or during method execution."""
+
+    def __init__(self, actor_id=None, msg: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(msg)
+
+
+class ActorDiedError(RayTpuActorError):
+    pass
+
+
+class ActorUnavailableError(RayTpuActorError):
+    """Actor temporarily unreachable (restarting); call may be retried."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id=None, msg: str | None = None):
+        self.object_id = object_id
+        super().__init__(msg or f"object {object_id} lost and could not be reconstructed")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class TaskUnschedulableError(RayTpuError):
+    pass

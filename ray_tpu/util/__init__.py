@@ -1,0 +1,13 @@
+"""ray_tpu.util: placement groups, scheduling strategies, collectives, actor pools."""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)

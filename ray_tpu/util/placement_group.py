@@ -1,0 +1,61 @@
+"""Placement groups: atomic gang reservation of resource bundles across nodes.
+
+Design parity: reference `python/ray/util/placement_group.py` (:146 placement_group) +
+GCS-side scheduling (`src/ray/gcs/gcs_placement_group_manager.h`). Strategies: PACK,
+SPREAD, STRICT_PACK, STRICT_SPREAD. On TPU clusters a slice is reserved atomically via a
+STRICT_PACK bundle over the slice-head resource (see accelerators/tpu.py).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.worker import global_worker
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict]):
+        self.id = pg_id
+        self.bundles = bundles
+
+    def ready(self, timeout: float = 60.0) -> bool:
+        info = global_worker().gcs_call("pg_wait_ready", self.id, timeout)
+        return info["state"] == "ALIVE"
+
+    def wait(self, timeout_seconds: float = 60.0) -> bool:
+        return self.ready(timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> list[dict]:
+        return self.bundles
+
+    def allocations(self):
+        info = global_worker().gcs_call("pg_wait_ready", self.id, 0.1)
+        return info["allocations"]
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles))
+
+
+def placement_group(
+    bundles: list[dict],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: str | None = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty resource dicts")
+    pg_id = PlacementGroupID.from_random()
+    global_worker().gcs_call("create_placement_group", pg_id, bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    global_worker().gcs_call("remove_placement_group", pg.id)
+
+
+def placement_group_table() -> list:
+    return global_worker().gcs_call("list_placement_groups")

@@ -1,0 +1,27 @@
+"""Scheduling strategies.
+
+Design parity: reference `python/ray/util/scheduling_strategies.py` (:17
+PlacementGroupSchedulingStrategy, :43 NodeAffinitySchedulingStrategy).
+"""
+
+from __future__ import annotations
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index: int = 0,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: dict | None = None, soft: dict | None = None):
+        self.hard = hard or {}
+        self.soft = soft or {}

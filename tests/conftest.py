@@ -1,0 +1,48 @@
+"""Test fixtures.
+
+Parity with the reference test strategy (SURVEY.md §4): ray_start_regular boots a real
+single-node cluster; ray_start_cluster yields a Cluster for multi-node tests with real
+raylet processes. JAX tests run on a virtual 8-device CPU mesh (the reference pattern of
+faking TPU resources on CPU nodes, python/ray/train/v2/tests/test_jax_trainer.py:16-55).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process tree.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Workers inherit via worker_env in fixtures as well.
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+_WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    """A single-node cluster shared by the tests in one module (fast on 1-core CI)."""
+    ray_tpu.init(num_cpus=4, num_tpus=0, worker_env=_WORKER_ENV)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """A fresh single-node cluster per test (for tests that mutate cluster state)."""
+    ray_tpu.init(num_cpus=4, num_tpus=0, worker_env=_WORKER_ENV)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1, "env_vars": _WORKER_ENV})
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,129 @@
+"""Actor semantics tests (reference: python/ray/tests/test_actor.py shapes)."""
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get_value(self):
+        return self.value
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=60) == 6
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(1, 21))
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.get_value.remote(), timeout=60) == 100
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="counter-x").remote(7)
+    ray_tpu.get(c.incr.remote(), timeout=60)
+    c2 = ray_tpu.get_actor("counter-x")
+    assert ray_tpu.get(c2.get_value.remote(), timeout=60) == 8
+    ray_tpu.kill(c)
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="gie", get_if_exists=True).remote(1)
+    b = Counter.options(name="gie", get_if_exists=True).remote(999)
+    ray_tpu.get(a.incr.remote(), timeout=60)
+    assert ray_tpu.get(b.get_value.remote(), timeout=60) == 2
+    ray_tpu.kill(a)
+
+
+def test_actor_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor-err")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor-err"):
+        ray_tpu.get(b.fail.remote(), timeout=60)
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.incr.remote(), timeout=60)
+
+    assert ray_tpu.get(bump.remote(c), timeout=120) == 1
+    assert ray_tpu.get(c.get_value.remote(), timeout=60) == 1
+
+
+def test_async_actor_concurrency(ray_start_regular):
+    @ray_tpu.remote
+    class Async:
+        async def sleepy(self, i):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return i
+
+    a = Async.remote()
+    import time
+
+    ray_tpu.get(a.sleepy.remote(-1), timeout=60)  # warmup: actor worker spawn
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.sleepy.remote(i) for i in range(20)], timeout=60)
+    elapsed = time.monotonic() - t0
+    assert out == list(range(20))
+    assert elapsed < 0.8  # concurrent (~0.05s) rather than 20 * 0.05s serial
+
+
+def test_threaded_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Threaded:
+        def work(self, i):
+            import time
+
+            time.sleep(0.05)
+            return i
+
+    t = Threaded.remote()
+    out = ray_tpu.get([t.work.remote(i) for i in range(8)], timeout=60)
+    assert sorted(out) == list(range(8))
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=60)
+    ray_tpu.kill(c)
+    with pytest.raises(Exception):
+        ray_tpu.get(c.incr.remote(), timeout=10)
+
+
+def test_actor_creation_error(ray_start_regular):
+    @ray_tpu.remote
+    class BadInit:
+        def __init__(self):
+            raise ValueError("init-fail")
+
+        def ping(self):
+            return "pong"
+
+    b = BadInit.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=30)

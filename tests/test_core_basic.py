@@ -1,0 +1,144 @@
+"""Core task/object API tests (reference: python/ray/tests/test_basic.py shapes)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_simple_task(ray_start_regular):
+    assert ray_tpu.get(echo.remote(41), timeout=60) == 41
+
+
+def test_task_chaining(ray_start_regular):
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)
+    r3 = add.remote(r2, r1)
+    assert ray_tpu.get(r3, timeout=60) == 16
+
+
+def test_many_tasks(ray_start_regular):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=120) == [2 * i for i in range(50)]
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    for value in [1, "s", {"a": [1, 2]}, (None, True)]:
+        assert ray_tpu.get(ray_tpu.put(value), timeout=60) == value
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.random.rand(500, 500)
+    out = ray_tpu.get(ray_tpu.put(arr), timeout=60)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_large_arg_promotion(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.int64)
+
+    @ray_tpu.remote
+    def total(a):
+        return int(a.sum())
+
+    assert ray_tpu.get(total.remote(arr), timeout=60) == int(arr.sum())
+
+
+def test_large_return(ray_start_regular):
+    @ray_tpu.remote
+    def big():
+        return np.ones((1000, 1000))
+
+    out = ray_tpu.get(big.remote(), timeout=60)
+    assert out.shape == (1000, 1000)
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom-message")
+
+    with pytest.raises(ValueError, match="boom-message"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_propagation_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("dead")
+
+    r = add.remote(boom.remote(), 1)
+    with pytest.raises(Exception):
+        ray_tpu.get(r, timeout=60)
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        import time
+
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(10)]
+    ready, pending = ray_tpu.wait(refs, num_returns=1, timeout=8)
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray_tpu.get(ready[0], timeout=60) == 0.05
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        import time
+
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.5)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def outer():
+        inner_refs = [echo.remote(i) for i in range(3)]
+        return sum(ray_tpu.get(inner_refs, timeout=60))
+
+    assert ray_tpu.get(outer.remote(), timeout=120) == 3
+
+
+def test_options_override(ray_start_regular):
+    assert ray_tpu.get(echo.options(num_cpus=2).remote("hi"), timeout=60) == "hi"
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4
+
+
+def test_ref_in_collection_stays_ref(ray_start_regular):
+    inner = ray_tpu.put(7)
+
+    @ray_tpu.remote
+    def unwrap(d):
+        (ref,) = d["refs"]
+        return ray_tpu.get(ref, timeout=60) + 1
+
+    assert ray_tpu.get(unwrap.remote({"refs": [inner]}), timeout=60) == 8

@@ -1,0 +1,91 @@
+"""Multi-node behavior: spillback scheduling, cross-node objects, node failure.
+
+Reference pattern: python/ray/tests with ray_start_cluster adding real raylet processes
+(conftest.py:680 + cluster_utils.py).
+"""
+
+import pytest
+
+import ray_tpu
+
+
+def test_spillback_to_resource_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"special": 2})
+    cluster.connect()
+    assert cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"special": 1}, num_cpus=0)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id().hex()
+
+    node_hex = ray_tpu.get(where.remote(), timeout=120)
+    assert node_hex == cluster.worker_nodes[0].node_id_hex
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"remote_node": 1})
+    cluster.connect()
+    assert cluster.wait_for_nodes()
+
+    import numpy as np
+
+    @ray_tpu.remote(resources={"remote_node": 1}, num_cpus=0)
+    def produce():
+        return np.full((500, 500), 3.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    # consume runs on the head node; the array must be pulled across nodes.
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 3.0 * 500 * 500
+
+
+def test_actor_on_remote_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"away": 1})
+    cluster.connect()
+    assert cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"away": 1})
+    class Remote:
+        def pid_node(self):
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+    a = Remote.remote()
+    assert ray_tpu.get(a.pid_node.remote(), timeout=120) == cluster.worker_nodes[0].node_id_hex
+
+
+def test_node_failure_kills_actor(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=1, resources={"doomed": 1})
+    cluster.connect()
+    assert cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"doomed": 1})
+    class Doomed:
+        def ping(self):
+            return "pong"
+
+    a = Doomed.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "pong"
+    cluster.remove_node(node)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.ping.remote(), timeout=20)
+
+
+def test_strict_spread_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    assert cluster.wait_for_nodes()
+
+    from ray_tpu.util import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(60)
+    allocations = pg.allocations()
+    assert len({a.hex() for a in allocations}) == 2
