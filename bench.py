@@ -1,0 +1,100 @@
+"""Benchmark: flagship-model training throughput on the available TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: gpt2-125m causal-LM training tokens/sec on one chip (bf16, flash attention,
+adamw, remat off at this size). vs_baseline is measured model-FLOPs utilization (MFU)
+divided by 0.40 — the MFU a tuned A100 torch/FSDP stack typically reaches on GPT-2-class
+models (the reference framework's GPU training path; BASELINE.md north-star row
+"FSDP->shard_map MFU vs A100 FSDP"). vs_baseline >= 1.0 means we match that bar.
+
+Timing methodology: the train state is threaded through consecutive steps (step N+1
+consumes step N's output), so the measured wall time covers real execution; a final
+device_get syncs the chain. This matters on remote-dispatch backends where
+block_until_ready alone under-measures.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.transformer import Transformer, get_config
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.parallel.spmd import build_train_step, init_state
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    cfg = get_config("gpt2-125m", remat=False, max_seq=seq,
+                     attention="flash" if on_tpu else "reference")
+    model = Transformer(cfg)
+    mesh = mesh_lib.create_mesh({"dp": 1})  # single chip; dp>1 when more are visible
+    optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+    state, _ = init_state(model, cfg, optimizer, mesh, sample_shape=(batch, seq))
+    step_fn, batch_shardings = build_train_step(model, optimizer, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size)
+    data = {
+        "tokens": jax.device_put(tokens, batch_shardings["tokens"]),
+        "targets": jax.device_put(tokens, batch_shardings["targets"]),
+    }
+
+    with mesh:
+        state, metrics = step_fn(state, data)  # compile + warm
+        _ = float(metrics["loss"])
+        iters = 20 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step_fn(state, data)
+        _ = float(metrics["loss"])  # sync the chain
+        dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / dt
+    n_params = cfg.num_params()
+    # Training FLOPs/token ~= 6N (fwd 2N + bwd 4N); attention term added explicitly.
+    attn_flops = 12 * cfg.n_layers * cfg.hidden * seq  # per token, causal-averaged
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    vs_baseline = mfu / 0.40 if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "step_ms": round(dt * 1e3, 2),
+            "batch": batch,
+            "seq": seq,
+            "params_m": round(n_params / 1e6, 1),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -299,9 +299,20 @@ class GcsService:
                 if ev:
                     ev.set()
                 return
+            if result.get("fatal"):
+                # Application error in __init__: surface it, don't retry 60 workers.
+                await self._mark_actor_dead(actor, result.get("reason", "actor __init__ failed"))
+                return
             await asyncio.sleep(0.1)
+        await self._mark_actor_dead(
+            actor, "unschedulable: no node with resources " + repr(resources)
+        )
+
+    async def _mark_actor_dead(self, actor: ActorInfo, reason: str):
         actor.state = DEAD
-        actor.death_cause = "unschedulable: no node with resources " + repr(resources)
+        actor.death_cause = reason
+        if actor.name:
+            self.named_actors.pop((actor.namespace, actor.name), None)
         await self.publish("actors", {"actor": actor.view()})
         ev = self._actor_events.pop(actor.actor_id, None)
         if ev:
@@ -351,12 +362,13 @@ class GcsService:
                     await node.conn.call("kill_actor_worker", actor.actor_id)
                 except Exception:
                     pass
-        if actor.state != DEAD and actor.restarts_left == 0:
-            actor.state = DEAD
-            actor.death_cause = "killed via ray_tpu.kill"
-            if actor.name:
-                self.named_actors.pop((actor.namespace, actor.name), None)
-            await self.publish("actors", {"actor": actor.view()})
+        if actor.state == DEAD:
+            return True
+        if actor.restarts_left != 0:
+            # kill(no_restart=False): restart immediately, per the kill contract.
+            await self._handle_actor_failure(actor, "killed via ray_tpu.kill (restarting)")
+        else:
+            await self._mark_actor_dead(actor, "killed via ray_tpu.kill")
         return True
 
     async def _handle_actor_failure(self, actor: ActorInfo, reason: str):
@@ -369,11 +381,7 @@ class GcsService:
             await self.publish("actors", {"actor": actor.view()})
             await self._schedule_actor(actor)
         else:
-            actor.state = DEAD
-            actor.death_cause = reason
-            if actor.name:
-                self.named_actors.pop((actor.namespace, actor.name), None)
-            await self.publish("actors", {"actor": actor.view()})
+            await self._mark_actor_dead(actor, reason)
 
     # ---------------- placement groups ----------------
 

@@ -39,6 +39,7 @@ class WorkerHandle:
         self.conn: rpc.Connection | None = None
         self.registered = asyncio.Event()
         self.busy_task: dict | None = None  # currently running normal task spec
+        self.inflight_actor_tasks: dict = {}  # task_id -> spec (actor calls in flight)
         self.actor_id: ActorID | None = None
         self.acquired: dict[str, float] = {}
         self.pg_key: tuple | None = None  # bundle the acquisition came from, if any
@@ -299,6 +300,10 @@ class Raylet:
             actor_id = handle.actor_id
             self.actors.pop(actor_id, None)
             loop.create_task(self._report_actor_failure(actor_id, "actor worker process died"))
+        # Fail actor calls that were pushed but never completed (caller would hang).
+        for spec in list(handle.inflight_actor_tasks.values()):
+            loop.create_task(self._fail_actor_task(spec, "actor died during method call"))
+        handle.inflight_actor_tasks.clear()
 
     async def _report_actor_failure(self, actor_id: ActorID, reason: str):
         try:
@@ -348,10 +353,24 @@ class Raylet:
 
     async def _try_dispatch(self, spec: dict) -> bool:
         demand = spec.get("resources") or {}
+        strategy = spec.get("scheduling_strategy")
+        if strategy and strategy.get("node_id") is not None:
+            target = strategy["node_id"]
+            if target != self.node_id:
+                peer = await self._peer(target)
+                if peer is not None:
+                    await peer.notify("submit_task", spec)
+                    return True
+                if not strategy.get("soft"):
+                    await self._fail_task(spec, f"affinity node {target} unavailable")
+                    return True
+                # soft affinity: fall through to normal scheduling
         pg_key = self._pg_key(spec)
         if pg_key is not None and pg_key not in self.resources.bundles:
-            # Bundle not on this node: route to the right node via GCS pg info.
-            return await self._spill_to_pg_node(spec)
+            # Bundle not on this node: hand off asynchronously (pg readiness can take
+            # seconds; never head-of-line block the scheduler loop on it).
+            asyncio.get_running_loop().create_task(self._route_pg_task(spec))
+            return True
         if not self.resources.feasible(demand, pg_key):
             return await self._spill(spec)
         if not self.resources.can_acquire(demand, pg_key):
@@ -412,24 +431,39 @@ class Raylet:
                     continue
         return False
 
-    async def _spill_to_pg_node(self, spec: dict) -> bool:
+    async def _route_pg_task(self, spec: dict):
+        """Off-loop placement-group routing: wait for the PG, then deliver the task to
+        its bundle's node (or fail it if the PG can't be placed)."""
         pg = spec["placement_group"]
-        try:
-            info = await self.gcs.call("pg_wait_ready", pg["pg_id"], 30.0)
-        except rpc.RpcError:
-            return False
-        allocations = info.get("allocations") or []
         idx = pg["bundle_index"]
-        if idx >= len(allocations) or allocations[idx] is None:
-            return False
-        target = allocations[idx]
-        if target == self.node_id:
-            return False  # bundle is local but not reserved yet; retry
-        peer = await self._peer(target)
-        if peer is None:
-            return False
-        await peer.notify("submit_task", spec)
-        return True
+        for _attempt in range(10):
+            try:
+                info = await self.gcs.call("pg_wait_ready", pg["pg_id"], 30.0)
+            except rpc.RpcError:
+                await asyncio.sleep(0.5)
+                continue
+            if info.get("state") == "DEAD":
+                await self._fail_task(spec, "placement group could not be scheduled")
+                return
+            allocations = info.get("allocations") or []
+            if idx >= len(allocations):
+                await self._fail_task(spec, f"placement group has no bundle {idx}")
+                return
+            target = allocations[idx]
+            if target is None:
+                await asyncio.sleep(0.2)
+                continue
+            if target == self.node_id:
+                # Bundle is (now) local: re-enter the normal queue.
+                self.task_queue.append(spec)
+                self._sched_wakeup.set()
+                return
+            peer = await self._peer(target)
+            if peer is not None:
+                await peer.notify("submit_task", spec)
+                return
+            await asyncio.sleep(0.2)
+        await self._fail_task(spec, "placement group routing failed")
 
     # ------------------------------------------------------------------ RPC: workers
 
@@ -657,26 +691,34 @@ class Raylet:
         pg_key = self._pg_key(spec)
         if not self.resources.acquire(demand, pg_key):
             return {"ok": False, "reason": "resources"}
+
+        async def cleanup(handle):
+            # Detach bookkeeping BEFORE killing so _on_worker_lost (conn-close
+            # callback) neither double-releases nor reports a spurious actor death.
+            handle.acquired = {}
+            handle.pg_key = None
+            handle.actor_id = None
+            self.resources.release(demand, pg_key)
+            await self._kill_worker(handle)
+
         handle = self._spawn_worker(kind="actor")
         try:
             await asyncio.wait_for(handle.registered.wait(), CONFIG.worker_register_timeout_s)
         except asyncio.TimeoutError:
-            self.resources.release(demand, pg_key)
-            await self._kill_worker(handle)
+            await cleanup(handle)
             return {"ok": False, "reason": "worker_start_timeout"}
-        handle.actor_id = actor_id
         handle.acquired = demand
         handle.pg_key = pg_key
         try:
             result = await handle.conn.call("init_actor", actor_id, spec, timeout=300)
         except rpc.RpcError as e:
-            self.resources.release(demand, pg_key)
-            await self._kill_worker(handle)
-            return {"ok": False, "reason": f"init failed: {e}"}
+            await cleanup(handle)
+            return {"ok": False, "reason": f"worker died during init: {e}"}
         if not result.get("ok"):
-            self.resources.release(demand, pg_key)
-            await self._kill_worker(handle)
-            return {"ok": False, "reason": result.get("error", "init failed")}
+            await cleanup(handle)
+            # Application error in __init__: retrying cannot help.
+            return {"ok": False, "reason": result.get("error", "init failed"), "fatal": True}
+        handle.actor_id = actor_id
         self.actors[actor_id] = handle.worker_id
         return {"ok": True, "worker_id": handle.worker_id}
 
@@ -687,6 +729,7 @@ class Raylet:
         if worker_id is not None:
             handle = self.workers.get(worker_id)
             if handle is not None and handle.alive:
+                handle.inflight_actor_tasks[spec["task_id"]] = spec
                 await handle.conn.notify("push_task", spec)
                 return True
             # Actor worker died; report and fall through to error.
@@ -700,6 +743,7 @@ class Raylet:
         if addr["node_id"] == self.node_id:
             handle = self.workers.get(addr["worker_id"])
             if handle is not None and handle.alive:
+                handle.inflight_actor_tasks[spec["task_id"]] = spec
                 await handle.conn.notify("push_task", spec)
                 return True
             await self._fail_actor_task(spec, "actor worker dead")
@@ -736,6 +780,10 @@ class Raylet:
 
     async def rpc_actor_task_done(self, conn, spec_owner, task_id, results):
         """Actor worker finished a method call; route results to owner."""
+        for w in self.workers.values():
+            if w.conn is conn:
+                w.inflight_actor_tasks.pop(task_id, None)
+                break
         await self._route_to_worker(
             spec_owner["node_id"],
             spec_owner["worker_id"],

@@ -82,12 +82,14 @@ class MemoryStore:
         with self._lock:
             return self._records.get(object_id)
 
-    def resolve(self, object_id: ObjectID, data: bytes | None, error: bool, in_plasma: bool):
+    def resolve(self, object_id: ObjectID, data: bytes | None, error: bool,
+                in_plasma: bool) -> bool:
+        """Resolve an existing record. Returns False if the record was already freed
+        (all refs dropped before the result arrived) — caller should discard/free."""
         with self._lock:
             rec = self._records.get(object_id)
             if rec is None:
-                rec = _Record()
-                self._records[object_id] = rec
+                return False
             rec.data = data
             rec.error = error
             rec.in_plasma = in_plasma
@@ -100,6 +102,7 @@ class MemoryStore:
                 cb(object_id, rec)
             except Exception:
                 traceback.print_exc()
+        return True
 
     def add_done_callback(self, object_id: ObjectID, cb) -> bool:
         """Returns True if registered (pending), False if already resolved."""
@@ -412,6 +415,13 @@ class CoreWorker:
 
         def one(value):
             if isinstance(value, ObjectRef):
+                # Pin refs we own for the task's lifetime so a caller dropping their
+                # handle right after .remote() can't free the arg out from under the
+                # queued task. (Borrowed refs rely on their owner's pin; divergence
+                # from full distributed refcounting noted in ReferenceCounter.)
+                if value.owner and value.owner.get("worker_id") == self.worker_id:
+                    self.reference_counter.add_local_ref(value.id)
+                    promoted.append(value.id)
                 return {"ref": (value.id, value.owner)}
             pickled, raw_buffers, total = serialization.serialized_size(value)
             if total > CONFIG.max_direct_call_object_size:
@@ -597,12 +607,17 @@ class CoreWorker:
                 self.reference_counter.remove_local_ref(oid)
         for result in payload["results"]:
             oid = result["object_id"]
-            if result.get("in_plasma"):
-                self.memory_store.resolve(oid, None, result.get("error", False), True)
-            else:
-                self.memory_store.resolve(
-                    oid, result["inline"], result.get("error", False), False
-                )
+            in_plasma = bool(result.get("in_plasma"))
+            live = self.memory_store.resolve(
+                oid, None if in_plasma else result["inline"],
+                result.get("error", False), in_plasma,
+            )
+            if not live and in_plasma:
+                # All refs were dropped before the result landed: free the orphan.
+                try:
+                    await self.raylet.notify("store_free", oid)
+                except rpc.RpcError:
+                    pass
 
     async def rpc_fetch_inline(self, conn, payload):
         rec = self.memory_store.get(payload["object_id"])
@@ -665,7 +680,13 @@ class CoreWorker:
         if rt is None:
             return
         caller = spec["caller_id"]
-        expected = rt.expected_seq.get(caller, 1)
+        # First message from a caller sets the baseline: after an actor restart the
+        # caller's sequence counter keeps counting, and the old incarnation's numbers
+        # must not wedge the new one. Per-caller transport is ordered, so the first
+        # arrival is the lowest outstanding seq.
+        expected = rt.expected_seq.get(caller)
+        if expected is None:
+            expected = spec["seq"]
         rt.buffered[(caller, spec["seq"])] = spec
         while (caller, expected) in rt.buffered:
             ready = rt.buffered.pop((caller, expected))

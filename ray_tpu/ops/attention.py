@@ -1,0 +1,229 @@
+"""Attention ops: Pallas flash-attention TPU kernel + reference JAX path.
+
+This is where the reference framework leans on CUDA (vLLM/torch SDPA under Ray's LLM and
+Train libraries); the TPU rebuild owns the kernel. Forward is an online-softmax flash
+kernel tiled for the MXU (q blocked over the grid, k/v streamed per block); backward is a
+custom VJP that recomputes attention blockwise in plain XLA (a Pallas backward kernel is a
+later optimization). On non-TPU backends the reference JAX implementation runs instead, so
+the same model code tests on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def reference_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                        positions_q=None, positions_kv=None):
+    """Plain XLA attention. q:[B,S,H,D] k/v:[B,T,Hkv,D] -> [B,S,H,D]."""
+    out, _ = _attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                 positions_q=positions_q, positions_kv=positions_kv)
+    return out
+
+
+def _attention_with_lse(q, k, v, *, causal, scale, positions_q=None, positions_kv=None):
+    B, S, H, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        pos_q = positions_q if positions_q is not None else jnp.arange(S)
+        pos_k = positions_kv if positions_kv is not None else jnp.arange(T)
+        mask = pos_q[:, None] >= pos_k[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [B,H,S]
+    probs = jnp.exp(logits - lse[..., None]).astype(q.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    return out, lse
+
+
+# ------------------------------------------------------------------ pallas kernel
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                      scale: float, causal: bool):
+    """Grid (BH, nq, nk), nk innermost+sequential: online softmax state lives in VMEM
+    scratch across k-steps (canonical TPU flash structure — no dynamic lane slicing).
+
+    Refs are the raw (1, x, y) blocks; values are squeezed after load (ref-level
+    slicing of lane-padded blocks is rejected by Mosaic). Scratch: acc [BQ,D] f32,
+    m/l [BQ,1] f32.
+    """
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    i_q = pl.program_id(1)
+    j = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = i_q * block_q
+    k_start = j * block_k
+    # Causal: skip blocks entirely above the diagonal (traced predicate).
+    visible = (k_start <= q_start + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        # Keep inputs in their native (bf16) dtype: the MXU takes them directly and
+        # accumulates in f32 via preferred_element_type; f32 casts would halve
+        # throughput. Scale is folded into the f32 logits.
+        q = q_ref[:][0]
+        k_blk = k_ref[:][0]
+        v_blk = v_ref[:][0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK] f32
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[:] = (acc_ref[:] / l)[None].astype(o_ref.dtype)
+        lse_ref[:] = (m_ref[:] + jnp.log(l))[None]
+
+
+def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int,
+                   interpret: bool):
+    """q:[B,S,H,D] k/v:[B,T,H,D] (kv heads already expanded) -> (out, lse [B,H,S])."""
+    from jax.experimental import pallas as pl
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    # Flatten (batch, head) into the leading grid dim; blocks squeeze it away.
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    grid = (B * H, pl.cdiv(S, block_q), pl.cdiv(T, block_k))  # nk innermost
+
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            # lse as [BH, S, 1]: trailing dims (block_q, 1) satisfy TPU tile rules.
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = jnp.transpose(out.reshape(B, H, S, D), (0, 2, 1, 3))
+    return out, lse.reshape(B, H, S)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Flash attention. q:[B,S,H,D], k/v:[B,T,Hkv,D] (GQA: Hkv divides H)."""
+    out, _ = _flash_attention_fwd_impl(q, k, v, causal, scale)
+    return out
+
+
+def _flash_attention_fwd_impl(q, k, v, causal, scale):
+    D = q.shape[-1]
+    eff_scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    H, Hkv = q.shape[2], k.shape[2]
+    k_full, v_full = k, v
+    if Hkv != H:
+        rep = H // Hkv
+        k_full = jnp.repeat(k, rep, axis=2)
+        v_full = jnp.repeat(v, rep, axis=2)
+    if _use_pallas():
+        out, lse = _flash_forward(
+            q, k_full, v_full, causal=causal, scale=eff_scale,
+            block_q=512, block_k=512, interpret=False,
+        )
+    else:
+        out, lse = _attention_with_lse(q, k_full, v_full, causal=causal, scale=eff_scale)
+    return out, lse
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out, lse = _flash_attention_fwd_impl(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, residuals, g):
+    """Recompute-based backward in plain XLA (flash backward kernel: future work)."""
+    q, k, v, out, lse = residuals
+    B, S, H, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    eff_scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    rep = H // Hkv
+    k_full = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+    v_full = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+
+    logits = jnp.einsum("bshd,bthd->bhst", q, k_full).astype(jnp.float32) * eff_scale
+    if causal:
+        mask = jnp.arange(S)[:, None] >= jnp.arange(T)[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    p = jnp.exp(logits - lse[..., None])  # [B,H,S,T]
+
+    g32 = g.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+    dv = jnp.einsum("bhst,bshd->bthd", p, g32)
+    dp = jnp.einsum("bshd,bthd->bhst", g32, v_full.astype(jnp.float32))
+    delta = jnp.sum(g32 * out32, axis=-1)  # [B,S,H]
+    ds = p * (dp - jnp.transpose(delta, (0, 2, 1))[..., None]) * eff_scale
+    dq = jnp.einsum("bhst,bthd->bshd", ds, k_full.astype(jnp.float32))
+    dk = jnp.einsum("bhst,bshd->bthd", ds, q.astype(jnp.float32))
+    if rep > 1:
+        dk = dk.reshape(B, T, Hkv, rep, D).sum(axis=3)
+        dv = dv.reshape(B, T, Hkv, rep, D).sum(axis=3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

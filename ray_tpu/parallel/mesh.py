@@ -1,0 +1,158 @@
+"""Device mesh construction and logical-axis sharding rules.
+
+This is the TPU-native substrate that replaces the reference's NCCL process groups
+(reference: python/ray/util/collective/ + torch.distributed in train/torch/config.py).
+Instead of per-GPU processes wiring NCCL communicators, parallelism is expressed as a
+`jax.sharding.Mesh` over named axes and PartitionSpecs; XLA inserts the ICI/DCN
+collectives. Axis conventions follow the scaling-book recipe:
+
+    dp    data parallel (batch split; gradients all-reduced)
+    fsdp  fully-sharded data parallel (batch AND params split; all-gather on use)
+    tp    tensor parallel (heads/mlp split; activations all-reduced)
+    sp    sequence/context parallel (sequence split; ring attention / all-to-all)
+    pp    pipeline parallel (layers split; ppermute between stages)
+    ep    expert parallel (MoE experts split; all-to-all token routing)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+# Logical tensor-dimension name -> mesh axis (or tuple of axes). The model annotates
+# parameters/activations with logical names; these rules bind them to hardware axes.
+DEFAULT_RULES: dict[str, object] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": "pp",
+    "expert": "ep",
+    "stage": "pp",
+}
+
+
+def create_mesh(
+    axes: Mapping[str, int] | None = None, devices: Sequence | None = None
+) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Missing axes get size 1; a single axis may
+    be -1 to absorb the remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {})
+    for name in axes:
+        if name not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {name!r}; valid: {AXIS_ORDER}")
+    sizes = {name: axes.get(name, 1) for name in AXIS_ORDER}
+    wild = [name for name, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wild:
+        if len(devices) % fixed:
+            raise ValueError(f"{len(devices)} devices not divisible by {fixed}")
+        sizes[wild[0]] = len(devices) // fixed
+    total = math.prod(sizes.values())
+    if total > len(devices):
+        raise ValueError(f"mesh of {total} devices > {len(devices)} available")
+    shape = tuple(sizes[name] for name in AXIS_ORDER)
+    dev_array = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str | None], rules: Mapping[str, object] | None = None
+) -> PartitionSpec:
+    """Map logical dimension names to a PartitionSpec via the rules table."""
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    parts = []
+    used: set[str] = set()
+    for name in logical_axes:
+        axis = rules.get(name) if name is not None else None
+        if axis is None:
+            parts.append(None)
+            continue
+        axes_tuple = (axis,) if isinstance(axis, str) else tuple(axis)
+        free = tuple(a for a in axes_tuple if a not in used)
+        used.update(free)
+        if not free:
+            parts.append(None)
+        elif len(free) == 1:
+            parts.append(free[0])
+        else:
+            parts.append(free)
+    return PartitionSpec(*parts)
+
+
+def named_sharding(
+    mesh: Mesh, logical_axes: Sequence[str | None], rules=None
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """Device-put a parameter pytree according to its logical annotations.
+
+    Works with flax `nn.Partitioned` leaves (from nn.with_logical_partitioning) or any
+    pytree when `rules` maps every leaf path; unannotated leaves are replicated.
+    """
+    import flax.linen as nn
+
+    def spec_of(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return logical_to_spec(leaf.names, rules)
+        return PartitionSpec()
+
+    def place(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            value = leaf.value
+            sharding = NamedSharding(mesh, spec_of(leaf))
+            return leaf.replace(value=jax.device_put(value, sharding))
+        return jax.device_put(leaf, NamedSharding(mesh, PartitionSpec()))
+
+    return jax.tree.map(place, params, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def param_shardings(params, mesh: Mesh, rules=None):
+    """Pytree of NamedShardings matching `params` (for jit in_shardings)."""
+    import flax.linen as nn
+
+    def one(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return NamedSharding(mesh, logical_to_spec(leaf.names, rules))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree.map(one, params, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def unbox(params):
+    """Strip flax Partitioned boxes, leaving raw arrays."""
+    import flax.linen as nn
+
+    return jax.tree.map(
+        lambda x: x.value if isinstance(x, nn.Partitioned) else x,
+        params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+def batch_sharding(mesh: Mesh, rules=None) -> NamedSharding:
+    return named_sharding(mesh, ("batch", "seq"), rules)
+
+
+def host_local_mesh_info(mesh: Mesh) -> dict:
+    """Summary used by the train controller to assign per-host shards."""
+    return {
+        "axis_names": mesh.axis_names,
+        "shape": dict(mesh.shape),
+        "num_devices": mesh.devices.size,
+    }

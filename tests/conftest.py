@@ -8,10 +8,14 @@ faking TPU resources on CPU nodes, python/ray/train/v2/tests/test_jax_trainer.py
 
 import os
 
-# Must be set before jax import anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-# Workers inherit via worker_env in fixtures as well.
+# Tests run on a virtual 8-device CPU mesh, even when a real TPU plugin (axon) was
+# registered by sitecustomize at interpreter start: jax backends initialize lazily, so
+# overriding the platform in-process before first use wins.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -20,6 +24,7 @@ import ray_tpu  # noqa: E402
 _WORKER_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PALLAS_AXON_POOL_IPS": "",
 }
 
 

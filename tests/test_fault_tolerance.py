@@ -1,0 +1,112 @@
+"""Fault-tolerance semantics: actor restarts, init failures, task retries.
+
+Reference shapes: python/ray/tests/test_actor_failures.py, test_failure*.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _wait_for(pred, timeout=30.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_actor_init_failure_is_fatal_and_fast(ray_start_isolated):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot construct")
+
+        def ping(self):
+            return "pong"
+
+    t0 = time.monotonic()
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=30)
+    # Fatal __init__ must not burn the full 60-retry scheduling loop.
+    assert time.monotonic() - t0 < 20
+
+
+def test_actor_restart_after_kill(ray_start_isolated):
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.count = 0
+
+        def incr(self):
+            self.count += 1
+            return self.count
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote(), timeout=60) == 1
+    ray_tpu.kill(p, no_restart=False)
+
+    def alive_again():
+        w = ray_tpu.global_worker()
+        info = w.gcs_call("get_actor_info", p._actor_id, None, "")
+        return info is not None and info["state"] == "ALIVE" and info["num_restarts"] >= 1
+
+    assert _wait_for(alive_again, timeout=60)
+    # State is reset (fresh __init__), calls work again.
+    assert ray_tpu.get(p.incr.remote(), timeout=60) == 1
+
+
+def test_kill_no_restart_overrides_max_restarts(ray_start_isolated):
+    @ray_tpu.remote(max_restarts=5)
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(v, no_restart=True)
+
+    def dead():
+        w = ray_tpu.global_worker()
+        info = w.gcs_call("get_actor_info", v._actor_id, None, "")
+        return info is not None and info["state"] == "DEAD"
+
+    assert _wait_for(dead, timeout=30)
+
+
+def test_dropped_ref_arg_still_usable_by_task(ray_start_isolated):
+    """A put() ref passed to a task and immediately dropped must stay pinned."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def total(arr):
+        return float(arr.sum())
+
+    ref = total.remote(ray_tpu.put(np.ones(300_000)))  # put-ref dropped immediately
+    import gc
+
+    gc.collect()
+    assert ray_tpu.get(ref, timeout=60) == 300_000.0
+
+
+def test_fire_and_forget_does_not_leak_store(ray_start_isolated):
+    """Dropped result refs of plasma-sized returns are freed from the store."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def big():
+        return np.ones(500_000)
+
+    w = ray_tpu.global_worker()
+    for _ in range(5):
+        big.remote()  # ref dropped immediately
+
+    @ray_tpu.remote
+    def ping():
+        return 1
+
+    assert ray_tpu.get(ping.remote(), timeout=60) == 1  # cluster still healthy
