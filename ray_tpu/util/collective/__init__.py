@@ -1,0 +1,25 @@
+"""ray_tpu.util.collective: two-tier collectives (eager HOST / in-graph XLA).
+
+Reference parity: python/ray/util/collective/__init__.py.
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    allgather,
+    allgather_object,
+    allreduce,
+    barrier,
+    broadcast,
+    broadcast_object,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp  # noqa: F401
+from ray_tpu.util.collective import xla  # noqa: F401

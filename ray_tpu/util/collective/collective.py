@@ -1,0 +1,201 @@
+"""ray_tpu.util.collective: eager collective communication among tasks/actors.
+
+Design parity: reference `python/ray/util/collective/collective.py` —
+`init_collective_group` (:180), declarative `create_collective_group` (:217),
+`allreduce` (:325), `barrier` (:365), `reduce`/`broadcast`/`allgather`/`reducescatter`
+(:378-597), p2p `send`/`recv` (:598-721), `GroupManager` (:75).
+
+TPU-native shape: the `*_multigpu` variants of the reference are deliberately absent —
+on TPU one process owns all local chips and collectives over them are in-graph XLA ops
+(see `ray_tpu.util.collective.xla`), not per-device eager calls. The eager verbs here run
+on the HOST backend (rendezvous-actor coordinated, DCN-class traffic).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.util.collective.collective_group.host_group import HostGroup
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    Backend,
+    BarrierOptions,
+    BroadcastOptions,
+    GroupInfo,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+
+_DECL_KV_NS = "collective_groups"
+
+
+class GroupManager:
+    """Process-local registry of collective groups this worker participates in."""
+
+    def __init__(self):
+        self._groups: dict[str, HostGroup] = {}
+        self._lock = threading.Lock()
+
+    def create_group(self, group_name: str, world_size: int, rank: int, backend) -> HostGroup:
+        backend = Backend.of(backend)
+        if backend != Backend.HOST:
+            raise ValueError(
+                "eager collective groups use the HOST backend; in-graph device "
+                "collectives are expressed with ray_tpu.util.collective.xla inside "
+                "jit/shard_map"
+            )
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(f"collective group {group_name!r} already initialized")
+            group = HostGroup(world_size, rank, group_name)
+            self._groups[group_name] = group
+            return group
+
+    def get_group(self, group_name: str) -> HostGroup:
+        with self._lock:
+            group = self._groups.get(group_name)
+        if group is None:
+            group = self._maybe_init_declared(group_name)
+        if group is None:
+            raise RuntimeError(
+                f"collective group {group_name!r} is not initialized in this worker; "
+                "call init_collective_group() or create_collective_group() first"
+            )
+        return group
+
+    def _maybe_init_declared(self, group_name: str):
+        """Lazily join a group declared via create_collective_group: resolve this
+        worker's rank from its actor id recorded in the GCS declaration."""
+        import ray_tpu
+        from ray_tpu._private import serialization
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        raw = worker.gcs_kv_get(_DECL_KV_NS, group_name.encode())
+        if raw is None:
+            return None
+        decl = serialization.loads(raw)
+        me = worker.actor_id
+        if me is None or me.binary() not in decl["ranks"]:
+            return None
+        rank = decl["ranks"][me.binary()]
+        return self.create_group(group_name, decl["world_size"], rank, decl["backend"])
+
+    def is_initialized(self, group_name: str) -> bool:
+        with self._lock:
+            return group_name in self._groups
+
+    def destroy_group(self, group_name: str):
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy_group()
+
+
+_group_mgr = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend="host",
+    group_name: str = "default",
+) -> None:
+    """Imperative init: every member calls this with its own rank (reference :180)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    _group_mgr.create_group(group_name, world_size, rank, backend)
+
+
+def create_collective_group(
+    actors,
+    world_size: int,
+    ranks: list[int],
+    backend="host",
+    group_name: str = "default",
+) -> None:
+    """Declarative init from the driver: assign ranks to actors; each actor joins
+    lazily on its first collective call (reference :217)."""
+    from ray_tpu._private import serialization
+    from ray_tpu._private.worker import global_worker
+
+    if len(actors) != len(ranks) or sorted(ranks) != list(range(world_size)):
+        raise ValueError("ranks must be a permutation of range(world_size) matching actors")
+    decl = {
+        "world_size": world_size,
+        "backend": str(Backend.of(backend).value),
+        "ranks": {a._actor_id.binary(): r for a, r in zip(actors, ranks)},
+    }
+    global_worker().gcs_kv_put(_DECL_KV_NS, group_name.encode(), serialization.dumps(decl))
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _group_mgr.is_initialized(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down this member's group state, kill the coordinator, and delete any
+    declarative registration so the name can be reused."""
+    _group_mgr.destroy_group(group_name)
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        global_worker().gcs_call("kv_del", _DECL_KV_NS, group_name.encode())
+    except Exception:
+        pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).allreduce(tensor, AllReduceOptions(reduceOp=op))
+
+
+def barrier(group_name: str = "default") -> None:
+    _group_mgr.get_group(group_name).barrier(BarrierOptions())
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).reduce(
+        tensor, ReduceOptions(reduceOp=op, root_rank=dst_rank)
+    )
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).broadcast(tensor, BroadcastOptions(root_rank=src_rank))
+
+
+def broadcast_object(obj, src_rank: int = 0, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).broadcast_object(obj, src_rank)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    return _group_mgr.get_group(group_name).allgather(tensor, AllGatherOptions())
+
+
+def allgather_object(obj, group_name: str = "default") -> list:
+    return _group_mgr.get_group(group_name).allgather_object(obj)
+
+
+def reducescatter(tensor_list, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).reducescatter(
+        tensor_list, ReduceScatterOptions(reduceOp=op)
+    )
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    _group_mgr.get_group(group_name).send(tensor, SendOptions(dst_rank=dst_rank))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).recv(opts=RecvOptions(src_rank=src_rank))

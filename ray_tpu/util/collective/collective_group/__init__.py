@@ -1,0 +1,2 @@
+from ray_tpu.util.collective.collective_group.base import BaseGroup  # noqa: F401
+from ray_tpu.util.collective.collective_group.host_group import HostGroup  # noqa: F401

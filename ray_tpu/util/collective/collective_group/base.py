@@ -1,0 +1,80 @@
+"""BaseGroup: the interface every collective backend implements.
+
+Design parity: reference `python/ray/util/collective/collective_group/base_collective_group.py`
+(BaseGroup ABC with rank/world_size/group_name and the verb methods NCCLGroup/GlooGroup
+implement).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+
+
+class BaseGroup(ABC):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self._world_size = world_size
+        self._rank = rank
+        self._group_name = group_name
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def group_name(self) -> str:
+        return self._group_name
+
+    def destroy_group(self):
+        pass
+
+    @classmethod
+    @abstractmethod
+    def backend(cls):
+        ...
+
+    @abstractmethod
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        ...
+
+    @abstractmethod
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        ...
+
+    @abstractmethod
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        ...
+
+    @abstractmethod
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        ...
+
+    @abstractmethod
+    def allgather(self, tensor, opts: AllGatherOptions = AllGatherOptions()):
+        ...
+
+    @abstractmethod
+    def reducescatter(self, tensor, opts: ReduceScatterOptions = ReduceScatterOptions()):
+        ...
+
+    @abstractmethod
+    def send(self, tensor, opts: SendOptions):
+        ...
+
+    @abstractmethod
+    def recv(self, shape, dtype, opts: RecvOptions):
+        ...

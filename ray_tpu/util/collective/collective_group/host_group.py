@@ -1,0 +1,302 @@
+"""Host-tier collective group: eager collectives over the object store + a rendezvous actor.
+
+Design parity: reference `python/ray/util/collective/collective_group/nccl_collective_group.py`
+(NCCLGroup :121) — but where NCCL rendezvouses a unique id through a named `Rendezvous`
+actor (:29) and then moves tensors over GPU rings, the TPU-native host tier keeps both
+the rendezvous AND the data on the control plane: a named async coordinator actor gathers
+each member's contribution and hands back the reduced/gathered result. This is the right
+tier for DCN-class, small/medium host tensors (model metadata, eval metrics, rank-0
+broadcasts). Bulk device traffic belongs to the XLA tier (in-graph ICI collectives,
+`ray_tpu/util/collective/xla.py`), which the compiler schedules — a split the NCCL design
+doesn't have (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    Backend,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+from ray_tpu.util.collective.collective_group.base import BaseGroup
+
+_COORD_PREFIX = "ray_tpu_collective::"
+
+
+def _reduce(values: list, op: ReduceOp):
+    arrs = [np.asarray(v) for v in values]
+    if op == ReduceOp.SUM:
+        out = arrs[0].copy()
+        for a in arrs[1:]:
+            out = out + a
+    elif op == ReduceOp.PRODUCT:
+        out = arrs[0].copy()
+        for a in arrs[1:]:
+            out = out * a
+    elif op == ReduceOp.MIN:
+        out = np.minimum.reduce(arrs)
+    elif op == ReduceOp.MAX:
+        out = np.maximum.reduce(arrs)
+    elif op == ReduceOp.MEAN:
+        out = np.mean(np.stack(arrs), axis=0).astype(arrs[0].dtype)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    return out
+
+
+class _Coordinator:
+    """Named async actor that synchronizes one collective group.
+
+    Each verb call is keyed by (verb, seq); contributions buffer until world_size have
+    arrived, then every waiter is released with its rank's slice of the result.
+    """
+
+    def __init__(self, world_size: int):
+        import asyncio
+        import collections
+        import time
+
+        self._world_size = world_size
+        self._ops = collections.defaultdict(
+            lambda: {
+                "contrib": {},
+                "event": asyncio.Event(),
+                "out": None,
+                "visits": 0,
+                "failed": False,
+                "born": time.time(),
+            }
+        )
+        self._p2p = {}
+        self._p2p_events = collections.defaultdict(asyncio.Event)
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def _leave(self, key, slot):
+        """A rank is done with this op (result fetched, timed out, or aborted);
+        free the slot once every rank has passed through."""
+        slot["visits"] += 1
+        if slot["visits"] >= self._world_size:
+            self._ops.pop(key, None)
+
+    def _gc_stale(self, ttl_s: float = 600.0):
+        """Drop failed slots whose stragglers never showed up (bounded leak)."""
+        import time
+
+        now = time.time()
+        for key in [
+            k for k, s in self._ops.items() if s["failed"] and now - s["born"] > ttl_s
+        ]:
+            del self._ops[key]
+
+    async def collect(self, verb: str, seq: int, rank: int, value, op, timeout_s: float):
+        """Generic gather-compute-scatter: returns this rank's result for the op.
+
+        Timeout consistency: the first waiter to time out marks the op failed and
+        releases everyone — all ranks (including stragglers arriving later) raise, so
+        no subset ever believes the collective succeeded.
+        """
+        import asyncio
+
+        self._gc_stale()
+        key = (verb, seq)
+        slot = self._ops[key]
+        if slot["failed"]:
+            self._leave(key, slot)
+            raise TimeoutError(
+                f"collective {verb}#{seq} was aborted after a peer timed out"
+            )
+        slot["contrib"][rank] = value
+        if len(slot["contrib"]) == self._world_size:
+            ranked = [slot["contrib"][r] for r in range(self._world_size)]
+            slot["out"] = self._compute(verb, ranked, op)
+            slot["event"].set()
+        else:
+            try:
+                await asyncio.wait_for(slot["event"].wait(), timeout_s)
+            except asyncio.TimeoutError:
+                missing = [r for r in range(self._world_size) if r not in slot["contrib"]]
+                slot["failed"] = True
+                slot["event"].set()
+                self._leave(key, slot)
+                raise TimeoutError(
+                    f"collective {verb}#{seq} timed out after {timeout_s}s; "
+                    f"missing ranks {missing}"
+                ) from None
+        if slot["failed"]:
+            self._leave(key, slot)
+            raise TimeoutError(
+                f"collective {verb}#{seq} was aborted after a peer timed out"
+            )
+        out = slot["out"]
+        self._leave(key, slot)
+        if verb in ("reducescatter",):
+            return out[rank]
+        if verb == "reduce":
+            root = op[1]
+            return out if rank == root else None
+        return out
+
+    def _compute(self, verb: str, ranked: list, op):
+        if verb == "barrier":
+            return True
+        if verb == "allreduce":
+            return _reduce(ranked, op)
+        if verb == "reduce":
+            return _reduce(ranked, op[0])
+        if verb == "broadcast":
+            return ranked[op]  # op = root rank
+        if verb == "allgather":
+            return [np.asarray(v) for v in ranked]
+        if verb == "reducescatter":
+            # Each rank contributes a list of world_size chunks; rank r gets the
+            # reduction of everyone's chunk r.
+            return [
+                _reduce([ranked[src][r] for src in range(self._world_size)], op)
+                for r in range(self._world_size)
+            ]
+        raise ValueError(f"unknown verb {verb}")
+
+    async def p2p_send(self, src: int, dst: int, seq: int, value):
+        key = (src, dst, seq)
+        self._p2p[key] = value
+        self._p2p_events[key].set()
+        return True
+
+    async def p2p_recv(self, src: int, dst: int, seq: int, timeout_s: float):
+        import asyncio
+
+        key = (src, dst, seq)
+        try:
+            await asyncio.wait_for(self._p2p_events[key].wait(), timeout_s)
+        except asyncio.TimeoutError:
+            raise TimeoutError(f"recv from rank {src} (op {seq}) timed out") from None
+        value = self._p2p.pop(key)
+        del self._p2p_events[key]
+        return value
+
+
+def _get_coordinator(group_name: str, world_size: int):
+    import ray_tpu
+
+    actor_cls = ray_tpu.remote(_Coordinator)
+    return actor_cls.options(
+        name=_COORD_PREFIX + group_name,
+        get_if_exists=True,
+        num_cpus=0,
+        max_concurrency=max(world_size * 4, 16),
+    ).remote(world_size)
+
+
+class HostGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        import ray_tpu
+
+        super().__init__(world_size, rank, group_name)
+        self._coordinator = _get_coordinator(group_name, world_size)
+        # A stale coordinator from a destroyed-but-leaked or re-sized group would
+        # silently desync every op; fail loudly instead.
+        actual = ray_tpu.get(self._coordinator.world_size.remote())
+        if actual != world_size:
+            raise RuntimeError(
+                f"collective group {group_name!r} already exists with "
+                f"world_size={actual} (asked for {world_size}); destroy it first "
+                "with destroy_collective_group()"
+            )
+        self._seq = 0
+        self._p2p_seq: dict = {}
+
+    @classmethod
+    def backend(cls):
+        return Backend.HOST
+
+    def _next(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _call(self, verb, value, op, timeout_ms):
+        import ray_tpu
+
+        ref = self._coordinator.collect.remote(
+            verb, self._next(), self._rank, value, op, timeout_ms / 1000.0
+        )
+        return ray_tpu.get(ref, timeout=timeout_ms / 1000.0 + 30)
+
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        return self._call("allreduce", np.asarray(tensor), opts.reduceOp, opts.timeout_ms)
+
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        self._call("barrier", None, None, opts.timeout_ms)
+
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        return self._call(
+            "reduce", np.asarray(tensor), (opts.reduceOp, opts.root_rank), opts.timeout_ms
+        )
+
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        value = np.asarray(tensor) if tensor is not None else None
+        return self._call("broadcast", value, opts.root_rank, opts.timeout_ms)
+
+    def broadcast_object(self, obj, root_rank: int = 0, timeout_ms: int = 30000):
+        """Broadcast an arbitrary picklable object (reference gloo's bcast-object path)."""
+        return self._call("broadcast", obj, root_rank, timeout_ms)
+
+    def allgather(self, tensor, opts: AllGatherOptions = AllGatherOptions()):
+        return self._call("allgather", np.asarray(tensor), None, opts.timeout_ms)
+
+    def allgather_object(self, obj, timeout_ms: int = 30000):
+        return self._call("allgather", obj, None, timeout_ms)
+
+    def reducescatter(self, tensor_list, opts: ReduceScatterOptions = ReduceScatterOptions()):
+        chunks = [np.asarray(t) for t in tensor_list]
+        if len(chunks) != self._world_size:
+            raise ValueError(
+                f"reducescatter needs {self._world_size} chunks, got {len(chunks)}"
+            )
+        return self._call("reducescatter", chunks, opts.reduceOp, opts.timeout_ms)
+
+    def send(self, tensor, opts: SendOptions):
+        import ray_tpu
+
+        key = (self._rank, opts.dst_rank)
+        seq = self._p2p_seq.get(key, 0) + 1
+        self._p2p_seq[key] = seq
+        ray_tpu.get(
+            self._coordinator.p2p_send.remote(self._rank, opts.dst_rank, seq, np.asarray(tensor))
+        )
+
+    def recv(self, shape=None, dtype=None, opts: RecvOptions = RecvOptions()):
+        import ray_tpu
+
+        key = (opts.src_rank, self._rank)
+        seq = self._p2p_seq.get(key, 0) + 1
+        self._p2p_seq[key] = seq
+        value = ray_tpu.get(
+            self._coordinator.p2p_recv.remote(
+                opts.src_rank, self._rank, seq, opts.timeout_ms / 1000.0
+            ),
+            timeout=opts.timeout_ms / 1000.0 + 30,
+        )
+        return value
+
+    def destroy_group(self):
+        """Kill the named coordinator so the group name can be re-created (possibly
+        with a different world_size). Idempotent across members."""
+        import ray_tpu
+
+        coordinator, self._coordinator = self._coordinator, None
+        if coordinator is not None:
+            try:
+                ray_tpu.kill(coordinator)
+            except Exception:
+                pass  # another member already killed it

@@ -1,0 +1,96 @@
+"""Collective types/enums.
+
+Design parity: reference `python/ray/util/collective/types.py` (Backend, ReduceOp, and
+the option dataclasses passed to each verb). TPU-native split: the reference has one
+backend tier (NCCL/gloo eager ops); here there are two — HOST (eager, DCN-class, via the
+object store + a rendezvous actor; the gloo analog) and XLA (in-graph ICI collectives
+emitted by the compiler inside jit/shard_map; see ray_tpu/util/collective/xla.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class Backend(str, Enum):
+    """Which transport executes the collective."""
+
+    HOST = "host"  # eager, CPU/host memory, rendezvous-actor coordinated (gloo analog)
+    XLA = "xla"  # in-graph ICI/DCN collectives inside jit (NCCL analog, compiler-inserted)
+
+    @classmethod
+    def of(cls, value: "Backend | str") -> "Backend":
+        if isinstance(value, Backend):
+            return value
+        v = str(value).lower()
+        # Accept the reference's backend names so ported user code runs unchanged.
+        if v in ("gloo", "torch_gloo", "host", "cpu"):
+            return cls.HOST
+        if v in ("nccl", "xla", "ici", "tpu"):
+            return cls.XLA
+        raise ValueError(f"unknown collective backend {value!r}")
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"  # not in NCCL; natural on TPU (psum / axis_size), so first-class here
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    rank: int
+    backend: Backend = Backend.HOST
+    extra: dict = field(default_factory=dict)
