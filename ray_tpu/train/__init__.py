@@ -1,0 +1,46 @@
+"""ray_tpu.train: distributed training orchestration (Ray Train parity, TPU-first).
+
+Reference surface (python/ray/train/__init__.py + v2 api): report, get_context,
+get_checkpoint, get_dataset_shard, Checkpoint, ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig, Result, DataParallelTrainer, JaxTrainer (the flagship), backend SPI.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train._internal.controller import TrainingFailedError
+from ray_tpu.train.jax import JaxConfig, JaxTrainer
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainingFailedError",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

@@ -1,0 +1,86 @@
+"""CheckpointManager: tracks reported checkpoints, retention, and the best/latest.
+
+Design parity: reference `python/ray/train/v2/_internal/execution/checkpoint/
+checkpoint_manager.py` — dedupes per report (all ranks persist into the same directory),
+enforces CheckpointConfig.num_to_keep scored by checkpoint_score_attribute.
+"""
+
+from __future__ import annotations
+
+import shutil
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+class _Tracked:
+    def __init__(self, checkpoint: Checkpoint, metrics: dict, index: int):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+
+
+class CheckpointManager:
+    def __init__(self, config: CheckpointConfig):
+        self._config = config
+        self._tracked: dict[int, _Tracked] = {}  # report_index -> entry
+
+    def register(self, report_index: int, checkpoint: Checkpoint, metrics: dict,
+                 rank: int = 0):
+        existing = self._tracked.get(report_index)
+        if existing is not None:
+            # Another rank reporting the same round (same directory). Scoring must be
+            # deterministic: rank 0's metrics win regardless of arrival order.
+            if rank == 0:
+                existing.metrics = metrics
+                self._enforce_retention()
+            return
+        self._tracked[report_index] = _Tracked(checkpoint, metrics, report_index)
+        self._enforce_retention()
+
+    def _score(self, t: _Tracked):
+        attr = self._config.checkpoint_score_attribute
+        if attr is None:
+            return t.index
+        value = t.metrics.get(attr)
+        if value is None:
+            # Metric missing from this report: rank it worst rather than mixing the
+            # raw index into the metric's scale (which would pin it as "best").
+            return float("-inf")
+        return value if self._config.checkpoint_score_order == "max" else -value
+
+    def _enforce_retention(self):
+        keep = self._config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        entries = sorted(self._tracked.values(), key=self._score, reverse=True)
+        latest = self.latest  # never delete the resume point
+        for victim in entries[keep:]:
+            if latest is not None and victim.checkpoint.path == latest.path:
+                continue
+            self._tracked.pop(victim.index, None)
+            shutil.rmtree(victim.checkpoint.path, ignore_errors=True)
+
+    @property
+    def max_index(self) -> int:
+        """Highest report index seen — restart attempts resume numbering above it."""
+        return max(self._tracked, default=0)
+
+    @property
+    def latest(self) -> Checkpoint | None:
+        if not self._tracked:
+            return None
+        return self._tracked[max(self._tracked)].checkpoint
+
+    @property
+    def best(self) -> Checkpoint | None:
+        if not self._tracked:
+            return None
+        return max(self._tracked.values(), key=self._score).checkpoint
+
+    @property
+    def best_checkpoints(self) -> list[tuple[Checkpoint, dict]]:
+        return [
+            (t.checkpoint, t.metrics)
+            for t in sorted(self._tracked.values(), key=self._score, reverse=True)
+        ]

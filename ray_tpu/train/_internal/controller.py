@@ -1,0 +1,188 @@
+"""TrainController: the run loop that owns worker groups across restarts.
+
+Design parity: reference `python/ray/train/v2/_internal/execution/controller/
+controller.py:99` — run() :487 creates a worker group per attempt (ScalingPolicy),
+polls worker health (:266), routes reported results to the CheckpointManager, and on
+failure consults the FailurePolicy to restart from the latest checkpoint or raise.
+Runs in the driver process (the reference detaches it as an actor so the job survives
+driver death; divergence documented in docs/divergences.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train._internal.failure_policy import (
+    DefaultFailurePolicy,
+    FailureDecision,
+    ScalingPolicy,
+)
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RayTpuError):
+    """Parity: ray.train.base_trainer.TrainingFailedError."""
+
+
+class TrainController:
+    def __init__(
+        self,
+        *,
+        train_fn,
+        train_fn_config: dict | None,
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        backend=None,
+        backend_config=None,
+        datasets: dict | None = None,
+        poll_interval_s: float = 0.2,
+        trial_info: dict | None = None,
+    ):
+        self._train_fn = train_fn
+        self._train_fn_config = train_fn_config
+        self._scaling = scaling_config
+        self._run_config = run_config
+        self._backend = backend
+        self._backend_config = backend_config
+        self._datasets = datasets or {}
+        self._poll_interval_s = poll_interval_s
+        self._trial_info = trial_info
+        self._failure_policy = DefaultFailurePolicy(
+            run_config.failure_config.max_failures
+        )
+        self._scaling_policy = ScalingPolicy(scaling_config)
+        self._checkpoints = CheckpointManager(run_config.checkpoint_config)
+        self._latest_metrics: dict | None = None
+        self._experiment_name = run_config.name or f"train_{int(time.time())}"
+        self._storage_path = os.path.expanduser(run_config.storage_path)
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> Result:
+        failure_count = 0
+        attempt = 0
+        while True:
+            group = None
+            try:
+                group = self._start_worker_group(attempt)
+                error = self._monitor(group)
+            except Exception as e:
+                # Worker/actor death, placement timeouts, and startup-hook failures all
+                # route through the failure policy like in-loop training failures.
+                import traceback
+
+                error = "".join(traceback.format_exception(e))
+            finally:
+                if group is not None:
+                    if self._backend is not None:
+                        try:
+                            self._backend.on_shutdown(group, self._backend_config)
+                        except Exception:
+                            pass
+                    group.shutdown()
+            if error is None:
+                return self._build_result(error=None)
+            failure_count += 1
+            attempt += 1
+            decision = self._failure_policy.make_decision(failure_count, error)
+            if decision is FailureDecision.RAISE:
+                return self._build_result(
+                    error=TrainingFailedError(
+                        f"training failed after {failure_count} failure(s); last error:\n{error}"
+                    )
+                )
+            # else RESTART: loop re-creates the group from the latest checkpoint
+
+    def _start_worker_group(self, attempt: int) -> WorkerGroup:
+        import dataclasses
+
+        # Copy: never mutate the caller's ScalingConfig (elastic attempts resize it).
+        scaling = dataclasses.replace(
+            self._scaling, num_workers=self._scaling_policy.world_size_for_attempt(attempt)
+        )
+        if attempt > 0:
+            self._remove_orphan_checkpoints()
+        group = WorkerGroup(scaling)
+        try:
+            group.start()
+            if self._backend is not None:
+                self._backend.on_start(group, self._backend_config)
+            group.init_sessions(
+                experiment_name=self._experiment_name,
+                storage_path=self._storage_path,
+                latest_checkpoint=self._checkpoints.latest,
+                dataset_shards_per_worker=self._split_datasets(len(group)),
+                trial_info=self._trial_info,
+                report_index_offset=self._checkpoints.max_index,
+            )
+            if self._backend is not None:
+                self._backend.on_training_start(group, self._backend_config)
+            group.start_training(self._train_fn, self._train_fn_config)
+        except BaseException:
+            group.shutdown()
+            raise
+        return group
+
+    def _remove_orphan_checkpoints(self):
+        """Delete checkpoint_<n> dirs persisted by a dead attempt but never registered
+        (worker wrote files, group died before the controller polled the report) — the
+        new attempt reuses those indices and must not merge into stale contents."""
+        import re
+        import shutil
+
+        exp_dir = os.path.join(self._storage_path, self._experiment_name)
+        if not os.path.isdir(exp_dir):
+            return
+        for entry in os.listdir(exp_dir):
+            m = re.fullmatch(r"checkpoint_(\d+)", entry)
+            if m and int(m.group(1)) > self._checkpoints.max_index:
+                shutil.rmtree(os.path.join(exp_dir, entry), ignore_errors=True)
+
+    def _split_datasets(self, world_size: int) -> list[dict] | None:
+        if not self._datasets:
+            return None
+        shards: list[dict] = [dict() for _ in range(world_size)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(world_size)
+            else:
+                parts = [ds] * world_size
+            for rank in range(world_size):
+                shards[rank][name] = parts[rank]
+        return shards
+
+    def _monitor(self, group: WorkerGroup) -> str | None:
+        """Poll until every worker finishes or one errors. Returns error text or None."""
+        while True:
+            statuses = group.poll()
+            for status in statuses:
+                for result in status.results:
+                    self._ingest_result(result)
+            errors = [s for s in statuses if s.state == "ERRORED"]
+            if errors:
+                return errors[0].error or "worker error"
+            if all(s.state == "FINISHED" for s in statuses):
+                return None
+            time.sleep(self._poll_interval_s)
+
+    def _ingest_result(self, result: dict):
+        if result["rank"] == 0:
+            self._latest_metrics = result["metrics"]
+        if result.get("checkpoint") is not None:
+            self._checkpoints.register(
+                result["report_index"], result["checkpoint"], result["metrics"],
+                rank=result["rank"],
+            )
+
+    def _build_result(self, error) -> Result:
+        return Result(
+            metrics=self._latest_metrics,
+            checkpoint=self._checkpoints.latest,
+            path=os.path.join(self._storage_path, self._experiment_name),
+            error=error,
+            best_checkpoints=self._checkpoints.best_checkpoints,
+        )

@@ -1,0 +1,43 @@
+"""Failure and scaling policies for the train controller.
+
+Design parity: reference `python/ray/train/v2/_internal/execution/failure_handling/
+failure_policy.py:14` (FailurePolicy ABC, decisions RETRY/RAISE) with the default
+max-failure counting policy (`default.py:24`), and `.../scaling_policy/` (fixed world
+size now; the interface leaves room for elastic sizes).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class FailureDecision(enum.Enum):
+    RESTART = "RESTART"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    def make_decision(self, failure_count: int, error: str) -> FailureDecision:
+        raise NotImplementedError
+
+
+@dataclass
+class DefaultFailurePolicy(FailurePolicy):
+    max_failures: int = 0
+
+    def make_decision(self, failure_count: int, error: str) -> FailureDecision:
+        if self.max_failures < 0 or failure_count <= self.max_failures:
+            return FailureDecision.RESTART
+        return FailureDecision.RAISE
+
+
+class ScalingPolicy:
+    """Decides the world size for (re)starts. Fixed for now; elastic policies return a
+    different size after failures (reference scaling_policy/)."""
+
+    def __init__(self, scaling_config):
+        self.scaling_config = scaling_config
+
+    def world_size_for_attempt(self, attempt: int) -> int:
+        return self.scaling_config.num_workers

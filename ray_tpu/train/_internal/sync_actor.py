@@ -1,0 +1,63 @@
+"""SynchronizationActor: barrier + rank-0 broadcast for a training worker gang.
+
+Design parity: reference `python/ray/train/v2/_internal/execution/checkpoint/sync_actor.py`
+(SynchronizationActor) backing `ray.train.collective.barrier`/`broadcast_from_rank_zero`
+(reference train/collective/collectives.py:14,56). Async actor: calls park on asyncio
+events rather than blocking threads. Rounds are garbage-collected once the last waiter
+leaves, so memory stays flat over arbitrarily long runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class SynchronizationActor:
+    def __init__(self):
+        self._rounds: dict[str, dict] = {}
+        self._lock = asyncio.Lock()
+
+    def _round(self, key: str) -> dict:
+        if key not in self._rounds:
+            self._rounds[key] = {"count": 0, "event": asyncio.Event(), "data": None}
+        return self._rounds[key]
+
+    async def _arrive(self, key: str, world_size: int) -> dict:
+        async with self._lock:
+            r = self._round(key)
+            r["count"] += 1
+            if r["count"] >= world_size:
+                r["event"].set()
+        return r
+
+    async def _leave(self, key: str, world_size: int):
+        async with self._lock:
+            r = self._rounds.get(key)
+            if r is not None:
+                r["left"] = r.get("left", 0) + 1
+                if r["left"] >= world_size:
+                    del self._rounds[key]
+
+    async def barrier(self, world_size: int, key: str) -> bool:
+        r = await self._arrive(key, world_size)
+        await r["event"].wait()
+        await self._leave(key, world_size)
+        return True
+
+    async def broadcast(self, world_size: int, key: str, rank: int, value=None):
+        """All workers call; the rank-0 value is returned to everyone."""
+        async with self._lock:
+            r = self._round(key)
+            if rank == 0:
+                r["data"] = value
+            r["count"] += 1
+            if r["count"] >= world_size:
+                r["event"].set()
+        await r["event"].wait()
+        data = r["data"]
+        await self._leave(key, world_size)
+        return data
+
+    async def reset(self):
+        self._rounds.clear()
+        return True

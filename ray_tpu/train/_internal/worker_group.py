@@ -1,0 +1,272 @@
+"""WorkerGroup: the gang of training worker actors behind a trainer.
+
+Design parity: reference `python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:104` — creates a placement group from the ScalingConfig, spawns one
+`RayTrainWorker` actor per bundle, assigns world/local/node ranks (sorted by node so
+local ranks are contiguous), runs backend hooks, and launches the user train loop in a
+background thread per worker (reference thread_runner.py) so health polling stays live.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.train import context as train_ctx
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+
+class RayTrainWorker:
+    """Actor hosting one training worker. The user loop runs in a daemon thread so the
+    actor stays responsive to poll()/execute() (max_concurrency stays 1: methods are
+    serialized, but none of them block on the training thread)."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._finished = False
+
+    def get_metadata(self) -> dict:
+        import os
+
+        worker = ray_tpu._private.worker.global_worker()
+        return {"node_id": worker.node_id.hex(), "pid": os.getpid()}
+
+    def init_session(self, **kwargs):
+        train_ctx.init_session(**kwargs)
+        return True
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker process (backend hooks etc.)."""
+        return fn(*args, **kwargs)
+
+    def start_train_fn(self, train_fn: Callable, config: dict | None):
+        def run():
+            try:
+                import inspect
+
+                sig = inspect.signature(train_fn)
+                if len(sig.parameters) == 0:
+                    train_fn()
+                else:
+                    train_fn(config or {})
+            except SystemExit:
+                pass
+            except BaseException:
+                self._error = traceback.format_exc()
+            finally:
+                self._finished = True
+
+        self._finished = False
+        self._error = None
+        self._thread = threading.Thread(target=run, daemon=True, name="train-fn")
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        session = train_ctx.get_session()
+        results = []
+        if session is not None:
+            while not session.result_queue.empty():
+                results.append(session.result_queue.get_nowait())
+        state = "RUNNING"
+        if self._finished:
+            state = "ERRORED" if self._error else "FINISHED"
+        return {"state": state, "results": results, "error": self._error}
+
+    def request_stop(self):
+        session = train_ctx.get_session()
+        if session is not None:
+            session.stop_event.set()
+        return True
+
+    def shutdown(self):
+        train_ctx.shutdown_session()
+        return True
+
+
+@dataclass
+class WorkerStatus:
+    rank: int
+    state: str
+    results: list
+    error: Optional[str]
+
+
+class WorkerGroup:
+    def __init__(self, scaling_config):
+        self._scaling = scaling_config
+        self._pg = None
+        self._workers: list = []
+        self._sync_actor = None
+        self._metadata: list[dict] = []
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self, pg_timeout: float = 120.0):
+        from ray_tpu.train._internal.sync_actor import SynchronizationActor
+
+        bundles = self._scaling.bundles()
+        self._pg = placement_group(bundles, strategy=self._scaling.pg_strategy)
+        try:
+            if not self._pg.ready(timeout=pg_timeout):
+                raise TimeoutError(
+                    f"placement group for {len(bundles)} training workers "
+                    f"({bundles[0]}) not ready within {pg_timeout}s"
+                )
+            self._sync_actor = (
+                ray_tpu.remote(SynchronizationActor).options(num_cpus=0).remote()
+            )
+            worker_cls = ray_tpu.remote(RayTrainWorker)
+            self._workers = []
+            for i, bundle in enumerate(bundles):
+                opts = {k: v for k, v in bundle.items() if k not in ("CPU", "TPU")}
+                self._workers.append(
+                    worker_cls.options(
+                        num_cpus=bundle.get("CPU", 0),
+                        num_tpus=bundle.get("TPU", 0),
+                        resources=opts or None,
+                        placement_group=self._pg,
+                        placement_group_bundle_index=i,
+                    ).remote()
+                )
+            self._metadata = ray_tpu.get(
+                [w.get_metadata.remote() for w in self._workers], timeout=60.0
+            )
+            self._assign_ranks()
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def _assign_ranks(self):
+        """Sort workers by node so world ranks are contiguous per host, with bundle 0's
+        node (the slice head when a topology bundle pinned it) ordered first — so world
+        rank 0 is on the head node and rank = f(node_rank, local_rank) stays consistent."""
+        head_node = self._metadata[0]["node_id"]
+        order = sorted(
+            range(len(self._workers)),
+            key=lambda i: (self._metadata[i]["node_id"] != head_node,
+                           self._metadata[i]["node_id"], i),
+        )
+        self._rank_of = {idx: rank for rank, idx in enumerate(order)}
+        node_ids = []
+        for i in order:
+            nid = self._metadata[i]["node_id"]
+            if nid not in node_ids:
+                node_ids.append(nid)
+        self._node_rank_of = {
+            i: node_ids.index(self._metadata[i]["node_id"]) for i in range(len(self._workers))
+        }
+        local_counter: dict[str, int] = {}
+        self._local_rank_of = {}
+        for i in order:
+            nid = self._metadata[i]["node_id"]
+            self._local_rank_of[i] = local_counter.get(nid, 0)
+            local_counter[nid] = self._local_rank_of[i] + 1
+        self._local_world = {
+            nid: local_counter[nid] for nid in local_counter
+        }
+
+    def init_sessions(
+        self,
+        *,
+        experiment_name: str,
+        storage_path: str,
+        latest_checkpoint=None,
+        dataset_shards_per_worker: list[dict] | None = None,
+        trial_info: dict | None = None,
+        report_index_offset: int = 0,
+    ):
+        calls = []
+        for i, w in enumerate(self._workers):
+            rank = self._rank_of[i]
+            shards = (
+                dataset_shards_per_worker[rank]
+                if dataset_shards_per_worker is not None
+                else None
+            )
+            calls.append(
+                w.init_session.remote(
+                    world_size=len(self._workers),
+                    world_rank=rank,
+                    local_rank=self._local_rank_of[i],
+                    local_world_size=self._local_world[self._metadata[i]["node_id"]],
+                    node_rank=self._node_rank_of[i],
+                    experiment_name=experiment_name,
+                    storage_path=storage_path,
+                    sync_actor=self._sync_actor,
+                    latest_checkpoint=latest_checkpoint,
+                    dataset_shards=shards,
+                    trial_info=trial_info,
+                    report_index_offset=report_index_offset,
+                )
+            )
+        ray_tpu.get(calls, timeout=60.0)
+
+    # ------------------------------------------------------------------ ops
+
+    def __len__(self):
+        return len(self._workers)
+
+    @property
+    def sorted_workers(self) -> list:
+        """Workers in world-rank order."""
+        by_rank = sorted(range(len(self._workers)), key=lambda i: self._rank_of[i])
+        return [self._workers[i] for i in by_rank]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> list:
+        """Run fn on every worker (world-rank order), blocking."""
+        return ray_tpu.get(
+            [w.execute.remote(fn, *args, **kwargs) for w in self.sorted_workers],
+            timeout=300.0,
+        )
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(
+            self.sorted_workers[rank].execute.remote(fn, *args, **kwargs), timeout=300.0
+        )
+
+    def start_training(self, train_fn: Callable, config: dict | None):
+        ray_tpu.get(
+            [w.start_train_fn.remote(train_fn, config) for w in self.sorted_workers],
+            timeout=60.0,
+        )
+
+    def poll(self) -> list[WorkerStatus]:
+        out = []
+        replies = ray_tpu.get(
+            [w.poll.remote() for w in self.sorted_workers], timeout=60.0
+        )
+        for rank, r in enumerate(replies):
+            out.append(WorkerStatus(rank, r["state"], r["results"], r["error"]))
+        return out
+
+    def shutdown(self):
+        try:
+            for w in self._workers:
+                try:
+                    w.shutdown.remote()
+                except Exception:
+                    pass
+            for w in self._workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            if self._sync_actor is not None:
+                try:
+                    ray_tpu.kill(self._sync_actor)
+                except Exception:
+                    pass
+        finally:
+            self._workers = []
+            self._sync_actor = None
+            if self._pg is not None:
+                try:
+                    remove_placement_group(self._pg)
+                except Exception:
+                    pass
+                self._pg = None

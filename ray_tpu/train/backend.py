@@ -1,0 +1,23 @@
+"""Backend SPI: per-framework setup hooks around the worker group.
+
+Design parity: reference `python/ray/train/backend.py` (Backend :16 / BackendConfig :32)
+— on_start (process-group rendezvous), on_training_start, on_shutdown.
+"""
+
+from __future__ import annotations
+
+
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        """Called after workers exist, before sessions start (rendezvous setup)."""
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):
+        """Called after sessions are initialized, before the user loop launches."""
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        """Called before the worker group is torn down."""

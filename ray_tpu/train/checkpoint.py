@@ -1,0 +1,52 @@
+"""Checkpoint: a directory-of-files abstraction.
+
+Design parity: reference `python/ray/train/_checkpoint.py` — Checkpoint.from_directory /
+to_directory / as_directory over a filesystem path. Orbax/msgpack-friendly: the directory
+contents are opaque to the framework; JAX users typically put an orbax or
+`flax.serialization` blob inside.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+import uuid
+
+
+class Checkpoint:
+    """A reference to a directory tree persisted under the run storage path."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(os.path.expanduser(path))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: str | None = None) -> str:
+        """Copy checkpoint contents into `path` (or a fresh temp dir) and return it."""
+        target = path or os.path.join(
+            tempfile.gettempdir(), f"rtpu_ckpt_{uuid.uuid4().hex[:8]}"
+        )
+        if os.path.abspath(target) != self.path:
+            shutil.copytree(self.path, target, dirs_exist_ok=True)
+        return target
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        """Context manager yielding a local directory with the checkpoint contents.
+
+        Local-filesystem storage means no copy is needed; yield the path directly.
+        """
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

@@ -1,0 +1,37 @@
+"""Control-plane collectives among training workers.
+
+Design parity: reference `python/ray/train/collective/collectives.py`
+(broadcast_from_rank_zero :14, barrier :56) implemented over the gang's
+SynchronizationActor (reference sync_actor.py), not the data-plane mesh — these are for
+small control values (rendezvous info, booleans), never tensors.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu.train.context import get_session
+
+
+def barrier(timeout_s: float = 600.0):
+    s = get_session()
+    if s is None:
+        raise RuntimeError("barrier() called outside a training worker")
+    key = f"user-barrier-{_next_key(s, 'barrier')}"
+    ray_tpu.get(s.sync_actor.barrier.remote(s.world_size, key), timeout=timeout_s)
+
+
+def broadcast_from_rank_zero(value=None, timeout_s: float = 600.0):
+    s = get_session()
+    if s is None:
+        raise RuntimeError("broadcast_from_rank_zero() called outside a training worker")
+    key = f"user-bcast-{_next_key(s, 'bcast')}"
+    return ray_tpu.get(
+        s.sync_actor.broadcast.remote(s.world_size, key, s.world_rank, value),
+        timeout=timeout_s,
+    )
+
+
+def _next_key(session, kind: str) -> int:
+    counters = session.collective_counters
+    counters[kind] = counters.get(kind, 0) + 1
+    return counters[kind]

@@ -1,0 +1,126 @@
+"""Shared Train/Tune configuration dataclasses.
+
+Design parity: reference `python/ray/air/config.py` (ScalingConfig/RunConfig/
+FailureConfig/CheckpointConfig) and `python/ray/train/v2/api/config.py`. TPU-first
+divergence: `ScalingConfig` speaks TPU — `use_tpu` + `topology` (e.g. "v4-16") reserve a
+whole slice via the slice-head resource (reference tpu.py:131-197 precedent), one SPMD
+worker per host.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers, and what each one needs.
+
+    On TPU: one worker per *host* (each host owns all its chips — the SPMD model),
+    so ``num_workers`` counts hosts, and ``topology`` ("v4-16", "v5e-64", ...) can be
+    given instead to derive the host count and gang-reserve the slice atomically.
+    """
+
+    num_workers: Optional[int] = None
+    use_tpu: bool = False
+    topology: Optional[str] = None  # e.g. "v4-16": reserve one whole slice
+    resources_per_worker: Optional[dict] = None
+    placement_strategy: str = "PACK"
+    chips_per_host: int = 4
+
+    def __post_init__(self):
+        if self.num_workers is None and self.topology is None:
+            self.num_workers = 1
+        if self.topology is not None:
+            # "v4-16" -> 16 cores -> hosts = cores / (2 cores-per-chip * chips-per-host)
+            # Keep the simple public convention: N in vX-N counts chips for v5e/v6e and
+            # cores (2/chip) for v4/v5p. Hosts = chips / chips_per_host.
+            gen, _, n = self.topology.partition("-")
+            n = int(n)
+            chips = n if gen in ("v5e", "v5litepod", "v6e") else n // 2
+            hosts = max(1, chips // self.chips_per_host)
+            if self.num_workers is None:
+                self.num_workers = hosts
+            self.use_tpu = True
+
+    @property
+    def _resources_per_worker_not_none(self) -> dict:
+        if self.resources_per_worker is not None:
+            resources = dict(self.resources_per_worker)
+        elif self.use_tpu:
+            resources = {"CPU": 1, "TPU": float(self.chips_per_host)}
+        else:
+            resources = {"CPU": 1}
+        return {k: float(v) for k, v in resources.items() if v}
+
+    def bundles(self) -> list[dict]:
+        """Placement-group bundles for the worker gang. With a topology, bundle 0 also
+        claims the slice-head resource so the whole slice is reserved atomically."""
+        per = self._resources_per_worker_not_none
+        bundles = [dict(per) for _ in range(self.num_workers)]
+        if self.topology:
+            bundles[0][f"TPU-{self.topology}-head"] = 1.0
+        return bundles
+
+    @property
+    def pg_strategy(self) -> str:
+        if self.use_tpu:
+            return "SPREAD"  # one SPMD worker per host
+        return self.placement_strategy
+
+
+@dataclass
+class FailureConfig:
+    """Parity: reference air/config.py FailureConfig (max_failures) — how many worker
+    group failures to tolerate by restarting from the latest checkpoint.
+    -1 means retry forever."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Parity: reference air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Parity: reference air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser(
+                os.environ.get("RAY_TPU_STORAGE_PATH", "~/ray_tpu_results")
+            )
+
+
+@dataclass
+class Result:
+    """Parity: reference python/ray/air/result.py Result."""
+
+    metrics: Optional[dict] = None
+    checkpoint: Optional[Any] = None
+    path: Optional[str] = None
+    error: Optional[BaseException] = None
+    metrics_dataframe: Optional[Any] = None
+    best_checkpoints: list = field(default_factory=list)
+
+    @property
+    def config(self) -> Optional[dict]:
+        return (self.metrics or {}).get("config")

@@ -1,0 +1,195 @@
+"""Worker-side training session and context.
+
+Design parity: reference `python/ray/train/v2/api/context.py` (TrainContext) +
+`train_fn_utils.py` (ray.train.report / get_context / get_checkpoint) and the v1
+`session.py`. The session lives in the worker actor; `report()` is a synchronization
+point across all workers (every worker must call it the same number of times), matching
+the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+class _TrainSession:
+    """Per-worker training state: identity, report queue, sync actor handle."""
+
+    def __init__(
+        self,
+        *,
+        world_size: int,
+        world_rank: int,
+        local_rank: int,
+        local_world_size: int,
+        node_rank: int,
+        experiment_name: str,
+        storage_path: str,
+        sync_actor=None,
+        latest_checkpoint: Checkpoint | None = None,
+        dataset_shards: dict | None = None,
+        trial_info: dict | None = None,
+        report_index_offset: int = 0,
+    ):
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.sync_actor = sync_actor
+        self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.trial_info = trial_info or {}
+        self.result_queue: "queue.Queue[dict]" = queue.Queue()
+        # Restart attempts continue numbering where the previous attempt stopped so
+        # checkpoint_<n> dirs never collide across attempts.
+        self.report_count = report_index_offset
+        self.stop_event = threading.Event()
+        self.collective_counters: dict[str, int] = {}  # user barrier/broadcast rounds
+
+    # ------------------------------------------------------------------ report
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None,
+               checkpoint_dir_name: str | None = None):
+        self.report_count += 1
+        persisted = None
+        if checkpoint is not None:
+            persisted = self._persist_checkpoint(checkpoint, checkpoint_dir_name)
+        if self.sync_actor is not None:
+            # Lockstep across the gang: report is a barrier (reference semantics).
+            import ray_tpu
+
+            ray_tpu.get(
+                self.sync_actor.barrier.remote(self.world_size, f"report-{self.report_count}"),
+                timeout=600.0,
+            )
+        self.result_queue.put(
+            {
+                "metrics": dict(metrics),
+                "checkpoint": persisted,
+                "report_index": self.report_count,
+                "rank": self.world_rank,
+            }
+        )
+        if self.stop_event.is_set():
+            raise SystemExit(0)
+
+    def _persist_checkpoint(self, checkpoint: Checkpoint, dir_name: str | None) -> Checkpoint:
+        """Move the worker's local checkpoint dir under the experiment storage path.
+
+        Every reporting worker writes into the same checkpoint_<n> dir under distinct
+        file names by convention (rank-prefixed files); on a shared filesystem this is
+        the reference's StorageContext layout (train/v2 storage.py).
+        """
+        name = dir_name or f"checkpoint_{self.report_count:06d}"
+        target = os.path.join(self.storage_path, self.experiment_name, name)
+        os.makedirs(target, exist_ok=True)
+        if os.path.abspath(checkpoint.path) != os.path.abspath(target):
+            shutil.copytree(checkpoint.path, target, dirs_exist_ok=True)
+        return Checkpoint(target)
+
+
+def init_session(**kwargs) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(**kwargs)
+    return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+class TrainContext:
+    """Parity: reference ray.train.get_context() (v2/api/context.py)."""
+
+    def __init__(self, session: _TrainSession):
+        self._s = session
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.world_rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._s.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._s.experiment_name
+
+    def get_storage(self):
+        return self._s.storage_path
+
+    def get_trial_name(self):
+        return self._s.trial_info.get("name")
+
+    def get_trial_id(self):
+        return self._s.trial_info.get("id")
+
+    def get_trial_resources(self):
+        return self._s.trial_info.get("resources")
+
+
+def get_context() -> TrainContext:
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a training worker"
+        )
+    return TrainContext(s)
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None, *,
+           checkpoint_dir_name: str | None = None):
+    """Parity: ray.train.report — report metrics (+ optional checkpoint); acts as a
+    barrier across the worker gang."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a training worker")
+    s.report(metrics, checkpoint, checkpoint_dir_name)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Parity: ray.train.get_checkpoint — the latest checkpoint to resume from."""
+    s = get_session()
+    if s is None:
+        return None
+    return s.latest_checkpoint
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    """Parity: ray.train.get_dataset_shard — this worker's split of a Dataset."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() called outside a training worker")
+    shard = s.dataset_shards.get(dataset_name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {dataset_name!r} was passed to the trainer "
+            f"(available: {list(s.dataset_shards)})"
+        )
+    return shard

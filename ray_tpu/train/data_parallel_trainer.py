@@ -1,0 +1,50 @@
+"""DataParallelTrainer: run one train loop per worker over a gang of actors.
+
+Design parity: reference `python/ray/train/v2/api/data_parallel_trainer.py:64`
+(`fit()` :152) — wraps `train_loop_per_worker`, builds the controller, blocks until the
+run finishes, and surfaces a Result. The backend hook point matches
+`python/ray/train/backend.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.train._internal.controller import TrainController, TrainingFailedError
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend_config: Optional[BackendConfig] = None,
+        datasets: Optional[dict] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._backend_config = backend_config or BackendConfig()
+        self._datasets = datasets or {}
+
+    def fit(self) -> Result:
+        backend = self._backend_config.backend_cls()()
+        controller = TrainController(
+            train_fn=self._train_loop,
+            train_fn_config=self._train_loop_config,
+            scaling_config=self.scaling_config,
+            run_config=self.run_config,
+            backend=backend,
+            backend_config=self._backend_config,
+            datasets=self._datasets,
+        )
+        result = controller.run()
+        if result.error is not None:
+            raise result.error
+        return result

@@ -1,0 +1,194 @@
+"""Ray Train parity tests: controller/worker-group/report/checkpoint/failure-restart.
+
+Modeled on reference python/ray/train/v2/tests/ (controller + trainer tests) and the
+fake-TPU-resources-on-CPU-nodes pattern of test_jax_trainer.py:16-55.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def storage(tmp_path):
+    return str(tmp_path / "results")
+
+
+def test_basic_fit_reports_metrics(ray_start_regular, storage):
+    def loop(config):
+        ctx = train.get_context()
+        assert ctx.get_world_size() == 2
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(), "loss": 1.0 / (step + 1)})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="basic", storage_path=storage),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["rank"] == 0  # rank-0 metrics win
+
+
+def test_ranks_unique_and_broadcast(ray_start_regular, storage, tmp_path):
+    rank_dir = tmp_path / "ranks"
+    rank_dir.mkdir()
+
+    def loop(config):
+        import json
+
+        ctx = train.get_context()
+        from ray_tpu.train.collective import broadcast_from_rank_zero
+
+        value = broadcast_from_rank_zero(
+            {"from_rank0": ctx.get_world_rank()} if ctx.get_world_rank() == 0 else None
+        )
+        info = {
+            "world_rank": ctx.get_world_rank(),
+            "local_rank": ctx.get_local_rank(),
+            "node_rank": ctx.get_node_rank(),
+            "world_size": ctx.get_world_size(),
+        }
+        with open(config["rank_dir"] + f"/r{ctx.get_world_rank()}.json", "w") as f:
+            json.dump(info, f)
+        train.report({"got": value["from_rank0"], "rank": ctx.get_world_rank()})
+
+    result = DataParallelTrainer(
+        loop,
+        train_loop_config={"rank_dir": str(rank_dir)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="bcast", storage_path=storage),
+    ).fit()
+    assert result.metrics["got"] == 0
+    import json
+
+    infos = [json.load(open(rank_dir / f)) for f in sorted(os.listdir(rank_dir))]
+    assert sorted(i["world_rank"] for i in infos) == [0, 1]
+    assert all(i["world_size"] == 2 for i in infos)
+    # Single node: local ranks mirror world ranks and are unique.
+    assert sorted(i["local_rank"] for i in infos) == [0, 1]
+    assert all(i["node_rank"] == 0 for i in infos)
+
+
+def test_checkpoint_roundtrip_and_retention(ray_start_regular, storage, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(4):
+            local = tmp_path / f"w{ctx.get_world_rank()}_s{step}"
+            local.mkdir(exist_ok=True)
+            (local / f"model_rank{ctx.get_world_rank()}.txt").write_text(f"step={step}")
+            train.report({"step": step, "score": float(step)},
+                         checkpoint=Checkpoint.from_directory(str(local)))
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="ckpt",
+            storage_path=storage,
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            ),
+        ),
+    ).fit()
+    assert result.checkpoint is not None
+    with result.checkpoint.as_directory() as d:
+        # Both ranks' files merged into the shared checkpoint dir.
+        assert open(os.path.join(d, "model_rank0.txt")).read() == "step=3"
+        assert open(os.path.join(d, "model_rank1.txt")).read() == "step=3"
+    exp_dir = os.path.join(storage, "ckpt")
+    kept = [d for d in os.listdir(exp_dir) if d.startswith("checkpoint_")]
+    assert len(kept) == 2  # num_to_keep enforced
+
+
+def test_failure_restart_resumes_from_checkpoint(ray_start_regular, storage, tmp_path):
+    marker = tmp_path / "fail_once"
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = int(open(os.path.join(d, "progress.txt")).read()) + 1
+        for step in range(start, 4):
+            local = tmp_path / f"r{ctx.get_world_rank()}_{step}"
+            local.mkdir(exist_ok=True)
+            (local / "progress.txt").write_text(str(step))
+            train.report({"step": step, "resumed_from": start},
+                         checkpoint=Checkpoint.from_directory(str(local)))
+            if step == 1 and ctx.get_world_rank() == 0 and not marker.exists():
+                marker.write_text("x")
+                raise RuntimeError("injected failure")
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="restart",
+            storage_path=storage,
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed_from"] == 2  # restarted from the step-1 checkpoint
+
+
+def test_failure_exhausts_budget_raises(ray_start_regular, storage):
+    def loop(config):
+        raise ValueError("always fails")
+
+    with pytest.raises(train.TrainingFailedError):
+        DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="fail", storage_path=storage,
+                                 failure_config=FailureConfig(max_failures=1)),
+        ).fit()
+
+
+def test_jax_trainer_single_worker_grad(ray_start_regular, storage):
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+
+        def f(w):
+            return jnp.sum(w**2)
+
+        g = jax.grad(f)(jnp.array([1.0, 2.0]))
+        train.report({"g0": float(g[0]), "n_dev": len(jax.devices())})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=False,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="jax1", storage_path=storage),
+    ).fit()
+    assert result.metrics["g0"] == 2.0
+    assert result.metrics["n_dev"] >= 1
+
+
+def test_scaling_config_tpu_topology_bundles():
+    sc = ScalingConfig(topology="v4-16")  # 16 cores = 8 chips = 2 hosts
+    assert sc.num_workers == 2
+    assert sc.use_tpu
+    bundles = sc.bundles()
+    assert len(bundles) == 2
+    assert bundles[0]["TPU-v4-16-head"] == 1.0
+    assert bundles[0]["TPU"] == 4.0
+    assert "TPU-v4-16-head" not in bundles[1]
+    assert sc.pg_strategy == "SPREAD"
