@@ -1,0 +1,127 @@
+"""ray_tpu.data: lazy, streaming, distributed datasets for TPU training ingest.
+
+Parity: reference `python/ray/data/__init__.py` — read_* constructors, from_* in-memory
+constructors, Dataset, ActorPoolStrategy, aggregate fns, DataContext.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.data._executor import ActorPoolStrategy
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, ReadStage, from_blocks
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    BlocksDatasource,
+    CSVDatasource,
+    Datasource,
+    FileBasedDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+)
+from ray_tpu.data.iterator import DataIterator
+
+
+def _read(source: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset([ReadStage(f"Read{source.get_name()}", source, parallelism)])
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return _read(RangeDatasource(n), parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return _read(ItemsDatasource(items), parallelism)
+
+
+def read_datasource(source: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _read(source, parallelism)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None, parallelism: int = -1, **kw):
+    return _read(ParquetDatasource(paths, columns=columns, **kw), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(CSVDatasource(paths, **kw), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(JSONDatasource(paths, **kw), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(TextDatasource(paths, **kw), parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(BinaryDatasource(paths), parallelism)
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks([pa.Table.from_pandas(df, preserve_index=False) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return from_blocks(tables)
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    from ray_tpu.data.block import batch_to_block
+
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    return from_blocks([batch_to_block({column: a}) for a in arrays])
+
+
+__all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
+    "Block",
+    "BlockAccessor",
+    "BlocksDatasource",
+    "Count",
+    "CSVDatasource",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "FileBasedDatasource",
+    "GroupedData",
+    "ItemsDatasource",
+    "JSONDatasource",
+    "Max",
+    "Mean",
+    "Min",
+    "ParquetDatasource",
+    "RangeDatasource",
+    "ReadTask",
+    "Std",
+    "Sum",
+    "TextDatasource",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

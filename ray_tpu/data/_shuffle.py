@@ -1,0 +1,285 @@
+"""Bulk all-to-all implementations: shuffle, repartition, sort, hash aggregate.
+
+Parity: reference `python/ray/data/_internal/planner/exchange/` — two-phase map/reduce
+over remote tasks. Map tasks partition each input bundle into N outputs; reduce tasks
+concatenate partition i across all maps. All data stays in the object store; the driver
+only moves refs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._executor import RefBundle
+from ray_tpu.data.aggregate import AggregateFn
+from ray_tpu.data.block import Block, BlockAccessor, batch_to_block, rows_to_block
+
+
+def _bundle_of(blocks: List[Block]) -> RefBundle:
+    rows = sum(b.num_rows for b in blocks)
+    nbytes = sum(b.nbytes for b in blocks)
+    return RefBundle(ray_tpu.put(blocks), rows, nbytes)
+
+
+# -- map/reduce task bodies -------------------------------------------------
+
+
+def _partition_task(part_fn, n: int, blocks: List[Block]) -> List[List[Block]]:
+    """Split each block into n partitions via part_fn(block) -> list of n blocks."""
+    parts: List[List[Block]] = [[] for _ in range(n)]
+    for block in blocks:
+        for i, piece in enumerate(part_fn(block)):
+            if piece.num_rows:
+                parts[i].append(piece)
+    return parts
+
+
+def _reduce_concat(postprocess, *part_lists) -> tuple:
+    blocks: List[Block] = []
+    for parts in part_lists:
+        blocks.extend(parts)
+    merged = BlockAccessor.concat(blocks) if blocks else rows_to_block([])
+    if postprocess is not None:
+        merged = postprocess(merged)
+    return [merged], (merged.num_rows, merged.nbytes)
+
+
+_partition_remote = ray_tpu.remote(_partition_task)
+_reduce_remote = ray_tpu.remote(_reduce_concat)
+
+
+def _two_phase(
+    bundles: List[RefBundle],
+    n_out: int,
+    part_fn: Callable[[Block], List[Block]],
+    postprocess: Optional[Callable[[Block], Block]] = None,
+) -> List[RefBundle]:
+    """Generic shuffle: partition each bundle into n_out pieces, then reduce by index."""
+    if not bundles:
+        return []
+    # Phase 1: map. Each task returns a list of n_out partition-lists (one object).
+    map_refs = [
+        _partition_remote.remote(part_fn, n_out, b.block_ref) for b in bundles
+    ]
+    # Phase 2: reduce partition i across all maps. _part_select picks out index i
+    # remotely so the full map outputs never land on the driver.
+    out: List[RefBundle] = []
+    select_refs = [
+        [_select_remote.remote(i, m) for m in map_refs] for i in range(n_out)
+    ]
+    reduce_out = [
+        _reduce_remote.options(num_returns=2).remote(postprocess, *select_refs[i])
+        for i in range(n_out)
+    ]
+    for blocks_ref, meta_ref in reduce_out:
+        rows, nbytes = ray_tpu.get(meta_ref)
+        out.append(RefBundle(blocks_ref, rows, nbytes))
+    return out
+
+
+def _select_part(i: int, parts: List[List[Block]]) -> List[Block]:
+    return parts[i]
+
+
+_select_remote = ray_tpu.remote(_select_part)
+
+
+# -- public bulk ops --------------------------------------------------------
+
+
+def random_shuffle(bundles: List[RefBundle], seed: Optional[int], n_out: Optional[int] = None):
+    if not bundles:
+        return []
+    n_out = n_out or max(1, len(bundles))
+
+    def make_part_fn(task_idx: int):
+        # Each map task gets an independent stream (seeded shuffles must not apply
+        # the same permutation in every task; rng seeds sequence over (seed, idx)).
+        def part_fn(block: Block, _state=[0]) -> List[Block]:
+            acc = BlockAccessor.for_block(block)
+            rng = np.random.default_rng(
+                None if seed is None else (seed, task_idx, _state[0])
+            )
+            _state[0] += 1
+            idx = rng.permutation(block.num_rows)
+            assignment = np.arange(block.num_rows) % n_out
+            return [acc.take_rows(idx[assignment == i]) for i in range(n_out)]
+
+        return part_fn
+
+    map_refs = [
+        _partition_remote.remote(make_part_fn(j), n_out, b.block_ref)
+        for j, b in enumerate(bundles)
+    ]
+    out: List[RefBundle] = []
+    reduce_out = []
+    for i in range(n_out):
+        def postprocess(block: Block, part_idx=i) -> Block:
+            if block.num_rows == 0:
+                return block
+            acc = BlockAccessor.for_block(block)
+            # 2-int entropy tuple: disjoint from the 3-int tuples the map side uses.
+            rng = np.random.default_rng(None if seed is None else (seed, part_idx))
+            return acc.take_rows(rng.permutation(block.num_rows))
+
+        selects = [_select_remote.remote(i, m) for m in map_refs]
+        reduce_out.append(
+            _reduce_remote.options(num_returns=2).remote(postprocess, *selects)
+        )
+    for blocks_ref, meta_ref in reduce_out:
+        rows, nbytes = ray_tpu.get(meta_ref)
+        out.append(RefBundle(blocks_ref, rows, nbytes))
+    return out
+
+
+def repartition(bundles: List[RefBundle], n_out: int):
+    total_rows = sum(b.num_rows for b in bundles)
+    per = -(-total_rows // n_out) if total_rows else 1
+    # Global row offsets per bundle let each map task slice against absolute boundaries.
+    offsets = np.cumsum([0] + [b.num_rows for b in bundles])
+
+    def make_part_fn(offset):
+        state = [offset]
+
+        def part_fn(block: Block) -> List[Block]:
+            start = state[0]
+            state[0] += block.num_rows
+            pieces = []
+            for i in range(n_out):
+                lo, hi = i * per, min((i + 1) * per, total_rows)
+                s = max(lo - start, 0)
+                e = min(hi - start, block.num_rows)
+                pieces.append(block.slice(s, max(s, e) - s) if e > s else block.slice(0, 0))
+            return pieces
+
+        return part_fn
+
+    # Run one partition task per bundle with its own absolute offset.
+    map_refs = [
+        _partition_remote.remote(make_part_fn(int(offsets[j])), n_out, b.block_ref)
+        for j, b in enumerate(bundles)
+    ]
+    out: List[RefBundle] = []
+    for i in range(n_out):
+        selects = [_select_remote.remote(i, m) for m in map_refs]
+        blocks_ref, meta_ref = _reduce_remote.options(num_returns=2).remote(None, *selects)
+        rows, nbytes = ray_tpu.get(meta_ref)
+        out.append(RefBundle(blocks_ref, rows, nbytes))
+    return out
+
+
+def sort(bundles: List[RefBundle], key: str, descending: bool = False):
+    if not bundles:
+        return []
+    n_out = max(1, len(bundles))
+    # Sample boundary candidates from every bundle (cheap: <=100 rows each). Sampling
+    # a prefix only would return data UNSORTED when early bundles are empty (e.g.
+    # after a selective filter).
+    sample_refs = [_sample_remote.remote(key, b.block_ref) for b in bundles]
+    samples = np.concatenate([s for s in ray_tpu.get(sample_refs) if len(s)] or [np.array([])])
+    if len(samples) == 0:
+        total = sum(b.num_rows for b in bundles)
+        if total == 0:
+            return bundles
+        raise RuntimeError(f"sort key {key!r} produced no boundary samples")
+    # Rank-based boundaries (works for strings and any orderable dtype, unlike
+    # np.quantile which needs arithmetic).
+    samples = np.sort(samples, kind="stable")
+    if n_out > 1:
+        idx = (np.arange(1, n_out) * len(samples)) // n_out
+        boundaries = samples[idx]
+    else:
+        boundaries = samples[:0]
+
+    def part_fn(block: Block) -> List[Block]:
+        acc = BlockAccessor.for_block(block)
+        col = acc.to_numpy([key])[key]
+        which = np.searchsorted(boundaries, col, side="right")
+        if descending:
+            which = (n_out - 1) - which
+        return [acc.take_rows(np.nonzero(which == i)[0]) for i in range(n_out)]
+
+    def postprocess(block: Block) -> Block:
+        if block.num_rows == 0:
+            return block
+        acc = BlockAccessor.for_block(block)
+        col = acc.to_numpy([key])[key]
+        order = np.argsort(col, kind="stable")
+        if descending:
+            order = order[::-1]
+        return acc.take_rows(order)
+
+    return _two_phase(bundles, n_out, part_fn, postprocess)
+
+
+def _sample_block(key: str, blocks: List[Block]) -> np.ndarray:
+    vals = []
+    for b in blocks:
+        acc = BlockAccessor.for_block(b)
+        if b.num_rows:
+            sampled = acc.sample_rows(min(100, b.num_rows), seed=0)
+            vals.append(BlockAccessor.for_block(sampled).to_numpy([key])[key])
+    return np.concatenate(vals) if vals else np.array([])
+
+
+_sample_remote = ray_tpu.remote(_sample_block)
+
+
+def _stable_hash(v) -> int:
+    import zlib
+
+    return zlib.crc32(repr(v).encode())
+
+
+def hash_aggregate(
+    bundles: List[RefBundle],
+    key: Optional[str],
+    aggs: List[AggregateFn],
+    n_out: Optional[int] = None,
+):
+    """groupby(key).aggregate(aggs). key=None means one global group."""
+    if not bundles:
+        return []
+    n_out = 1 if key is None else (n_out or min(max(1, len(bundles)), 8))
+
+    def part_fn(block: Block) -> List[Block]:
+        if key is None or n_out == 1:
+            return [block]
+        acc = BlockAccessor.for_block(block)
+        col = acc.to_numpy([key])[key]
+        # Stable across processes (unlike builtin hash(), which is seed-randomized
+        # for str and would split one group over several partitions).
+        hashes = np.array([_stable_hash(v) % n_out for v in col.tolist()])
+        return [acc.take_rows(np.nonzero(hashes == i)[0]) for i in range(n_out)]
+
+    def postprocess(block: Block) -> Block:
+        # Aggregate one hash partition: group rows by key, run each AggregateFn.
+        acc = BlockAccessor.for_block(block)
+        if block.num_rows == 0:
+            return rows_to_block([])
+        if key is None:
+            states = [a.init() for a in aggs]
+            states = [a.accumulate_block(s, block) for a, s in zip(aggs, states)]
+            return rows_to_block(
+                [{a.name: a.finalize(s) for a, s in zip(aggs, states)}]
+            )
+        col = acc.to_numpy([key])[key]
+        order = np.argsort(col, kind="stable")
+        sorted_block = acc.take_rows(order)
+        sorted_col = col[order]
+        # Find group boundaries on the sorted key column.
+        uniq, starts = np.unique(sorted_col, return_index=True)
+        starts = list(starts) + [block.num_rows]
+        rows = []
+        for gi, gval in enumerate(uniq):
+            gblock = sorted_block.slice(starts[gi], starts[gi + 1] - starts[gi])
+            row = {key: gval.item() if hasattr(gval, "item") else gval}
+            for a in aggs:
+                row[a.name] = a.finalize(a.accumulate_block(a.init(), gblock))
+            rows.append(row)
+        return rows_to_block(rows)
+
+    return _two_phase(bundles, n_out, part_fn, postprocess)

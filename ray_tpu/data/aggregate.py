@@ -1,0 +1,140 @@
+"""Aggregation functions for groupby / global aggregates.
+
+Parity: reference `python/ray/data/aggregate.py` (AggregateFn with init/accumulate/
+merge/finalize; built-ins Count/Sum/Min/Max/Mean/Std). Accumulation is vectorized over
+whole blocks (numpy), not row-at-a-time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class AggregateFn:
+    def __init__(
+        self,
+        init: Callable[[], Any],
+        accumulate_block: Callable[[Any, Block], Any],
+        merge: Callable[[Any, Any], Any],
+        finalize: Callable[[Any], Any] = lambda a: a,
+        name: str = "agg",
+    ):
+        self.init = init
+        self.accumulate_block = accumulate_block
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+
+
+def _column(block: Block, on: Optional[str]) -> np.ndarray:
+    acc = BlockAccessor.for_block(block)
+    if on is None:
+        on = acc.schema().names[0]
+    return acc.to_numpy([on])[on]
+
+
+class Count(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: 0,
+            accumulate_block=lambda a, b: a + b.num_rows,
+            merge=lambda a, b: a + b,
+            name="count()",
+        )
+
+
+class Sum(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: 0,
+            accumulate_block=lambda a, b: a + _column(b, on).sum(),
+            merge=lambda a, b: a + b,
+            name=f"sum({on})",
+        )
+
+
+class Min(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: None,
+            accumulate_block=lambda a, b: _nanmin(a, _column(b, on).min()),
+            merge=_nanmin,
+            name=f"min({on})",
+        )
+
+
+class Max(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: None,
+            accumulate_block=lambda a, b: _nanmax(a, _column(b, on).max()),
+            merge=_nanmax,
+            name=f"max({on})",
+        )
+
+
+class Mean(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: (0.0, 0),
+            accumulate_block=lambda a, b: (
+                a[0] + _column(b, on).sum(),
+                a[1] + b.num_rows,
+            ),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            finalize=lambda a: a[0] / a[1] if a[1] else None,
+            name=f"mean({on})",
+        )
+
+
+class Std(AggregateFn):
+    """Numerically-stable parallel variance (Chan et al.), ddof=1 like the reference."""
+
+    def __init__(self, on: Optional[str] = None, ddof: int = 1):
+        def accumulate(a, block):
+            x = _column(block, on).astype(np.float64)
+            n2, mean2 = len(x), (x.mean() if len(x) else 0.0)
+            m2_2 = ((x - mean2) ** 2).sum()
+            return _merge_moments(a, (n2, mean2, m2_2))
+
+        super().__init__(
+            init=lambda: (0, 0.0, 0.0),
+            accumulate_block=accumulate,
+            merge=_merge_moments,
+            finalize=lambda a: float(np.sqrt(a[2] / (a[0] - ddof))) if a[0] > ddof else None,
+            name=f"std({on})",
+        )
+
+
+def _merge_moments(a, b):
+    n1, mean1, m1 = a
+    n2, mean2, m2 = b
+    if n1 == 0:
+        return b
+    if n2 == 0:
+        return a
+    n = n1 + n2
+    delta = mean2 - mean1
+    mean = mean1 + delta * n2 / n
+    m = m1 + m2 + delta * delta * n1 * n2 / n
+    return (n, mean, m)
+
+
+def _nanmin(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
+
+def _nanmax(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)

@@ -1,0 +1,239 @@
+"""Block layer: the unit of data that flows between operators.
+
+Design parity: reference `python/ray/data/block.py` + `_internal/arrow_block.py` — a block
+is an Arrow table (columnar, zero-copy through the shared-memory object store thanks to
+pickle-5 out-of-band buffers), `BlockAccessor` wraps one block with format conversions,
+slicing, and builders. TPU-first notes: columnar numpy batches are the canonical training
+interchange (they device_put cleanly onto a mesh), so `to_batch_format("numpy")` is the
+hot path rather than pandas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+# A Block is a pyarrow Table. Rows are dicts.
+Block = pa.Table
+Row = Dict[str, Any]
+Batch = Union[pa.Table, Dict[str, np.ndarray], "pandas.DataFrame"]  # noqa: F821
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar stats the executor keeps per block without fetching it.
+
+    Parity: reference `python/ray/data/block.py` BlockMetadata.
+    """
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: List[str] = field(default_factory=list)
+
+
+def _standardize_column(values: Any) -> Any:
+    """Make a python sequence / ndarray acceptable to pyarrow."""
+    if isinstance(values, np.ndarray) and values.ndim > 1:
+        # Tensor column: store as fixed-size-list of flattened rows.
+        return pa.FixedSizeListArray.from_arrays(
+            pa.array(values.reshape(values.shape[0], -1).ravel()),
+            int(np.prod(values.shape[1:])),
+        )
+    return values
+
+
+_TENSOR_SHAPE_META = b"ray_tpu.tensor_shape"
+
+
+def batch_to_block(batch: Batch) -> Block:
+    """Convert any supported batch format into an Arrow table block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        meta = {}
+        for name, values in batch.items():
+            if isinstance(values, np.ndarray) and values.ndim > 1:
+                meta[_TENSOR_SHAPE_META + b"." + name.encode()] = repr(
+                    list(values.shape[1:])
+                ).encode()
+            cols[name] = _standardize_column(values)
+        table = pa.table(cols)
+        if meta:
+            table = table.replace_schema_metadata({**(table.schema.metadata or {}), **meta})
+        return table
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert batch of type {type(batch).__name__} to a block")
+
+
+def rows_to_block(rows: List[Row]) -> Block:
+    if not rows:
+        return pa.table({})
+    if not isinstance(rows[0], dict):
+        rows = [{"item": r} for r in rows]
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return batch_to_block({k: _infer_array(v) for k, v in cols.items()})
+
+
+def _infer_array(values: list) -> Any:
+    try:
+        arr = np.asarray(values)
+        if arr.dtype != object:
+            return arr
+    except Exception:
+        pass
+    return values
+
+
+class BlockAccessor:
+    """Format conversions + slicing over one Arrow block."""
+
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        if not isinstance(block, pa.Table):
+            block = batch_to_block(block)
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def get_metadata(self, input_files: Optional[List[str]] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(),
+            size_bytes=self.size_bytes(),
+            schema=self.schema(),
+            input_files=input_files or [],
+        )
+
+    # -- format conversion ------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def _tensor_shapes(self) -> Dict[str, tuple]:
+        shapes = {}
+        meta = self._table.schema.metadata or {}
+        prefix = _TENSOR_SHAPE_META + b"."
+        for key, val in meta.items():
+            if key.startswith(prefix):
+                shapes[key[len(prefix) :].decode()] = tuple(eval(val.decode()))  # noqa: S307
+        return shapes
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        shapes = self._tensor_shapes()
+        out = {}
+        for name in columns or self._table.column_names:
+            col = self._table.column(name)
+            if isinstance(col.type, pa.FixedSizeListType):
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                shape = shapes.get(name, (col.type.list_size,))
+                out[name] = flat.reshape((self._table.num_rows,) + shape)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self._table.to_pydict()
+
+    def to_batch_format(self, batch_format: Optional[str]) -> Batch:
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy()
+        if batch_format == "pyarrow":
+            return self._table
+        if batch_format == "pandas":
+            return self.to_pandas()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- row / slice access ----------------------------------------------
+    def iter_rows(self) -> Iterator[Row]:
+        cols = self._table.column_names
+        for chunk in self._table.to_batches():
+            pydict = chunk.to_pydict()
+            for i in range(chunk.num_rows):
+                yield {c: pydict[c][i] for c in cols}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_rows(self, indices: np.ndarray) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def sample_rows(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self.num_rows())
+        idx = rng.choice(self.num_rows(), size=n, replace=False)
+        return self.take_rows(idx)
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        if not blocks:
+            return pa.table({})
+        if len(blocks) == 1:
+            return blocks[0]
+        # Preserve tensor-shape metadata from the first block carrying it.
+        meta = {}
+        for b in blocks:
+            for k, v in (b.schema.metadata or {}).items():
+                meta.setdefault(k, v)
+        out = pa.concat_tables(
+            [b.replace_schema_metadata(None) for b in blocks], promote_options="default"
+        )
+        return out.replace_schema_metadata(meta or None)
+
+
+class BlockBuilder:
+    """Accumulate rows/batches into bounded-size blocks."""
+
+    def __init__(self, target_rows: Optional[int] = None):
+        self._rows: List[Row] = []
+        self._blocks: List[Block] = []
+        self._target = target_rows
+
+    def add_row(self, row: Row):
+        self._rows.append(row)
+
+    def add_block(self, block: Block):
+        self._flush_rows()
+        self._blocks.append(block)
+
+    def add_batch(self, batch: Batch):
+        self.add_block(batch_to_block(batch))
+
+    def _flush_rows(self):
+        if self._rows:
+            self._blocks.append(rows_to_block(self._rows))
+            self._rows = []
+
+    def num_rows(self) -> int:
+        return sum(b.num_rows for b in self._blocks) + len(self._rows)
+
+    def build(self) -> Block:
+        self._flush_rows()
+        if not self._blocks:
+            return pa.table({})
+        return BlockAccessor.concat(self._blocks)

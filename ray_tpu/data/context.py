@@ -1,0 +1,34 @@
+"""DataContext: per-driver execution knobs.
+
+Parity: reference `python/ray/data/context.py` (DataContext.get_current thread-local
+singleton with target block sizes and executor limits).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Rows per block produced by reads when the source can't estimate sizes.
+    default_batch_size: int = 1024
+    # Executor limits (backpressure).
+    max_tasks_in_flight: int = 16
+    max_queued_bundles: int = 32
+    output_queue_size: int = 8
+    # Default parallelism for reads when not specified (-1 = auto).
+    read_parallelism: int = -1
+    # Verbose per-op stats collection.
+    enable_stats: bool = True
+    extra: dict = field(default_factory=dict)
+
+    _current = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._current is None:
+            DataContext._current = DataContext()
+        return DataContext._current

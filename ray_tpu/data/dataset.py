@@ -1,0 +1,700 @@
+"""Dataset: the lazy, streaming, distributed data API.
+
+Design parity: reference `python/ray/data/dataset.py` — a Dataset is a logical plan;
+transformations append stages; consumption builds physical operators and runs them on
+the StreamingExecutor. TPU-first: `iter_jax_batches`/`to_jax` produce device-resident
+batches with host-side prefetch, and `shard()` gives each SPMD host its slice of the
+input files so multi-host training never reads redundant bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import _shuffle
+from ray_tpu.data._executor import (
+    ActorMapOperator,
+    ActorPoolStrategy,
+    AllToAllOperator,
+    InputOperator,
+    LimitOperator,
+    PhysicalOperator,
+    RefBundle,
+    StreamingExecutor,
+    TaskMapOperator,
+)
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import Block, BlockAccessor, batch_to_block, rows_to_block
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import Datasource, ReadTask, write_block
+
+
+# -- logical plan ----------------------------------------------------------
+
+
+@dataclass
+class Stage:
+    name: str
+
+
+@dataclass
+class ReadStage(Stage):
+    datasource: Datasource
+    parallelism: int = -1
+
+
+@dataclass
+class InputStage(Stage):
+    bundles: List[RefBundle] = field(default_factory=list)
+
+
+@dataclass
+class MapStage(Stage):
+    transform: Callable[[Iterator[Block]], Iterator[Block]]
+    compute: Optional[ActorPoolStrategy] = None
+    ray_remote_args: Optional[dict] = None
+
+
+@dataclass
+class AllToAllStage(Stage):
+    bulk_fn: Callable[[List[RefBundle]], List[RefBundle]] = None
+
+
+@dataclass
+class LimitStage(Stage):
+    limit: int = 0
+
+
+@dataclass
+class UnionStage(Stage):
+    others: List["Dataset"] = field(default_factory=list)
+
+
+class Dataset:
+    def __init__(self, stages: List[Stage], ctx: Optional[DataContext] = None):
+        self._stages = stages
+        self._ctx = ctx or DataContext.get_current()
+        self._cached_bundles: Optional[List[RefBundle]] = None
+
+    # -- plan helpers ------------------------------------------------------
+    def _with(self, stage: Stage) -> "Dataset":
+        return Dataset(self._stages + [stage], self._ctx)
+
+    def _build_ops(self) -> List[PhysicalOperator]:
+        ops: List[PhysicalOperator] = []
+        pending_transforms: List[Callable] = []
+        pending_names: List[str] = []
+        source_items = None
+        source_name = None
+
+        def flush_maps():
+            nonlocal pending_transforms, pending_names, source_items, source_name
+            if pending_transforms or source_items is not None:
+                name = "+".join(([source_name] if source_name else []) + pending_names)
+                ops.append(
+                    TaskMapOperator(
+                        name or "Map",
+                        pending_transforms,
+                        source_items=source_items,
+                    )
+                )
+                pending_transforms, pending_names = [], []
+                source_items, source_name = None, None
+
+        for stage in self._stages:
+            if isinstance(stage, ReadStage):
+                parallelism = stage.parallelism
+                if parallelism in (-1, None):
+                    parallelism = self._ctx.max_tasks_in_flight
+                source_items = stage.datasource.get_read_tasks(parallelism)
+                source_name = f"Read{stage.datasource.get_name()}"
+            elif isinstance(stage, InputStage):
+                flush_maps()
+                ops.append(InputOperator(stage.bundles))
+            elif isinstance(stage, MapStage):
+                if stage.compute is not None:
+                    flush_maps()
+                    ops.append(ActorMapOperator(stage.name, [stage.transform], stage.compute))
+                elif stage.ray_remote_args:
+                    flush_maps()
+                    ops.append(
+                        TaskMapOperator(stage.name, [stage.transform], stage.ray_remote_args)
+                    )
+                else:
+                    # Fuse with the preceding read/map chain.
+                    pending_transforms.append(stage.transform)
+                    pending_names.append(stage.name)
+            elif isinstance(stage, AllToAllStage):
+                flush_maps()
+                ops.append(AllToAllOperator(stage.name, stage.bulk_fn))
+            elif isinstance(stage, LimitStage):
+                flush_maps()
+                ops.append(LimitOperator(stage.limit))
+            else:
+                raise TypeError(f"unknown stage {stage}")
+        flush_maps()
+        if not ops:
+            ops.append(InputOperator([]))
+        return ops
+
+    def _execute(self) -> Iterator[RefBundle]:
+        if self._cached_bundles is not None:
+            return iter(self._cached_bundles)
+        return StreamingExecutor(self._build_ops(), self._ctx).execute()
+
+    # -- transformations ---------------------------------------------------
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_args: Tuple = (),
+        fn_kwargs: Optional[Dict] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        **_ignored,
+    ) -> "Dataset":
+        """Apply fn to batches. fn: Batch -> Batch (dict of numpy / pandas / arrow).
+
+        Parity: reference Dataset.map_batches (dataset.py). When `compute` is an
+        ActorPoolStrategy and fn is a class, the class is instantiated once per actor
+        (warm model state) and called per batch.
+        """
+        fn_kwargs = fn_kwargs or {}
+        is_callable_class = isinstance(fn, type)
+
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            # Memoized on the closure: inside an ActorMapOperator the same transform
+            # object lives across bundles, so a callable class (a warm model) is
+            # constructed once per actor, not once per bundle.
+            callable_fn = getattr(transform, "_cached_fn", None)
+            if callable_fn is None:
+                callable_fn = fn(*fn_args, **fn_kwargs) if is_callable_class else fn
+                transform._cached_fn = callable_fn
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                n = acc.num_rows()
+                bs = batch_size or max(1, n)
+                for start in range(0, max(n, 1), bs):
+                    if n == 0:
+                        break
+                    piece = BlockAccessor(acc.slice(start, min(start + bs, n)))
+                    batch = piece.to_batch_format(batch_format)
+                    if is_callable_class:
+                        out = callable_fn(batch)
+                    else:
+                        out = callable_fn(batch, *fn_args, **fn_kwargs)
+                    yield batch_to_block(out)
+
+        remote_args = {}
+        if num_cpus is not None:
+            remote_args["num_cpus"] = num_cpus
+        if num_tpus:
+            remote_args["num_tpus"] = num_tpus
+        name = getattr(fn, "__name__", type(fn).__name__)
+        return self._with(
+            MapStage(
+                f"MapBatches({name})",
+                transform,
+                compute=compute,
+                ray_remote_args=remote_args or None,
+            )
+        )
+
+    def map(self, fn: Callable[[Dict], Dict], **kwargs) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                yield rows_to_block([fn(row) for row in acc.iter_rows()])
+
+        return self._with(MapStage(f"Map({getattr(fn, '__name__', 'fn')})", transform))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]], **kwargs) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                out: List[Dict] = []
+                for row in acc.iter_rows():
+                    out.extend(fn(row))
+                yield rows_to_block(out)
+
+        return self._with(MapStage("FlatMap", transform))
+
+    def filter(self, fn: Callable[[Dict], bool], **kwargs) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                keep = np.array([bool(fn(row)) for row in acc.iter_rows()], dtype=bool)
+                yield acc.take_rows(np.nonzero(keep)[0])
+
+        return self._with(MapStage("Filter", transform))
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], np.ndarray]) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add, batch_format="numpy")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                yield block.drop_columns([c for c in cols if c in block.column_names])
+
+        return self._with(MapStage("DropColumns", transform))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                yield block.select(cols)
+
+        return self._with(MapStage("SelectColumns", transform))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                yield block.rename_columns(
+                    [mapping.get(c, c) for c in block.column_names]
+                )
+
+        return self._with(MapStage("RenameColumns", transform))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(LimitStage("Limit", limit=n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(
+            AllToAllStage("Repartition", lambda bs: _shuffle.repartition(bs, num_blocks))
+        )
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(
+            AllToAllStage("RandomShuffle", lambda bs: _shuffle.random_shuffle(bs, seed))
+        )
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        def bulk(bundles):
+            rng = np.random.default_rng(seed)
+            order = rng.permutation(len(bundles))
+            return [bundles[i] for i in order]
+
+        return self._with(AllToAllStage("RandomizeBlockOrder", bulk))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(
+            AllToAllStage("Sort", lambda bs: _shuffle.sort(bs, key, descending))
+        )
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            rng = np.random.default_rng(seed)
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                mask = rng.random(block.num_rows) < fraction
+                yield acc.take_rows(np.nonzero(mask)[0])
+
+        return self._with(MapStage("RandomSample", transform))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        # Materialize each branch's bundles and feed them through one Input op.
+        def bulk(bundles, others=others):
+            out = list(bundles)
+            for o in others:
+                out.extend(o._execute())
+            return out
+
+        return self._with(AllToAllStage("Union", bulk))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        def bulk(bundles, other=other):
+            left = _collect_blocks(bundles)
+            right = _collect_blocks(list(other._execute()))
+            lt = BlockAccessor.concat(left) if left else rows_to_block([])
+            rt = BlockAccessor.concat(right) if right else rows_to_block([])
+            if lt.num_rows != rt.num_rows:
+                raise ValueError(
+                    f"zip requires equal row counts, got {lt.num_rows} vs {rt.num_rows}"
+                )
+            for name in rt.column_names:
+                col = rt.column(name)
+                out_name = name if name not in lt.column_names else name + "_1"
+                lt = lt.append_column(out_name, col)
+            return [RefBundle(ray_tpu.put([lt]), lt.num_rows, lt.nbytes)]
+
+        return self._with(AllToAllStage("Zip", bulk))
+
+    # -- consumption -------------------------------------------------------
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds refs and re-iterates without recompute."""
+        bundles = list(self._execute())
+        ds = Dataset([InputStage("Materialized", bundles)], self._ctx)
+        ds._cached_bundles = bundles
+        return ds
+
+    def take(self, n: int = 20) -> List[Dict]:
+        out: List[Dict] = []
+        for bundle in self.limit(n)._execute():
+            for block in bundle.get_blocks():
+                out.extend(BlockAccessor.for_block(block).iter_rows())
+                if len(out) >= n:
+                    return out[:n]
+        return out[:n]
+
+    def take_all(self) -> List[Dict]:
+        out: List[Dict] = []
+        for bundle in self._execute():
+            for block in bundle.get_blocks():
+                out.extend(BlockAccessor.for_block(block).iter_rows())
+        return out
+
+    def take_batch(self, batch_size: int = 20, *, batch_format: str = "numpy"):
+        limited = self.limit(batch_size)
+        for batch in limited.iter_batches(
+            batch_size=batch_size, batch_format=batch_format, drop_last=False
+        ):
+            return batch
+        return {}
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._execute())
+
+    def schema(self) -> Optional[pa.Schema]:
+        for bundle in self.limit(1)._execute():
+            for block in bundle.get_blocks():
+                return block.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def num_blocks(self) -> int:
+        return sum(len(b.get_blocks()) for b in self._execute())
+
+    def size_bytes(self) -> int:
+        return sum(b.size_bytes for b in self._execute())
+
+    def input_files(self) -> List[str]:
+        files: List[str] = []
+        for stage in self._stages:
+            if isinstance(stage, ReadStage):
+                for task in stage.datasource.get_read_tasks(1):
+                    files.extend(task.metadata.input_files)
+        return files
+
+    def unique(self, column: str) -> List[Any]:
+        seen: set = set()
+        for bundle in self._execute():
+            for block in bundle.get_blocks():
+                vals = BlockAccessor.for_block(block).to_numpy([column])[column]
+                seen.update(vals.tolist())
+        return sorted(seen)
+
+    # aggregates over the whole dataset
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        bundles = list(self._execute())
+        out = _shuffle.hash_aggregate(bundles, None, list(aggs))
+        rows = _bundle_rows(out)
+        return rows[0] if rows else {}
+
+    def sum(self, on: Optional[str] = None):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: Optional[str] = None):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: Optional[str] = None):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: Optional[str] = None):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: Optional[str] = None):
+        return self.aggregate(Std(on)).get(f"std({on})")
+
+    # -- iteration ---------------------------------------------------------
+    def iter_rows(self) -> Iterator[Dict]:
+        for bundle in self._execute():
+            for block in bundle.get_blocks():
+                yield from BlockAccessor.for_block(block).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        prefetch_batches: int = 1,
+    ) -> Iterator[Any]:
+        from ray_tpu.data.iterator import iter_batches_impl
+
+        return iter_batches_impl(
+            self._execute(),
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+            shuffle_buffer_size=local_shuffle_buffer_size,
+            shuffle_seed=local_shuffle_seed,
+        )
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device=None,
+        sharding=None,
+        drop_last: bool = True,
+        local_shuffle_buffer_size: Optional[int] = None,
+        prefetch: int = 2,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as device-resident jax.Arrays with host-side prefetch.
+
+        TPU-first analog of the reference's `iter_torch_batches` (data/iterator.py):
+        numpy batches are staged onto the accelerator (optionally with an explicit
+        `sharding` for SPMD input pipelines) while the current batch is being consumed.
+        """
+        from ray_tpu.data.iterator import iter_jax_batches_impl
+
+        return iter_jax_batches_impl(
+            self._execute(),
+            batch_size=batch_size,
+            dtypes=dtypes,
+            device=device,
+            sharding=sharding,
+            drop_last=drop_last,
+            shuffle_buffer_size=local_shuffle_buffer_size,
+            prefetch=prefetch,
+        )
+
+    def to_jax(self, **kwargs):
+        return self.iter_jax_batches(**kwargs)
+
+    # -- splits ------------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        bundles = list(self._execute())
+        blocks = _collect_blocks(bundles)
+        total = sum(b.num_rows for b in blocks)
+        per = total // n if equal else -(-total // n)
+        table = BlockAccessor.concat(blocks) if blocks else rows_to_block([])
+        out = []
+        for i in range(n):
+            lo = i * per
+            hi = min((i + 1) * per, total) if not equal else (i + 1) * per
+            piece = table.slice(lo, max(0, hi - lo))
+            out.append(from_blocks([piece], self._ctx))
+        return out
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        blocks = _collect_blocks(list(self._execute()))
+        table = BlockAccessor.concat(blocks) if blocks else rows_to_block([])
+        bounds = [0] + list(indices) + [table.num_rows]
+        return [
+            from_blocks([table.slice(lo, hi - lo)], self._ctx)
+            for lo, hi in zip(bounds, bounds[1:])
+        ]
+
+    def split_proportionately(self, proportions: List[float]) -> List["Dataset"]:
+        total = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(total * acc))
+        return self.split_at_indices(indices)
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        train, test = ds.split_proportionately([1 - test_size])
+        return train, test
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
+        from ray_tpu.data.iterator import DataIterator
+
+        return [DataIterator(self, shard_index=i, num_shards=n) for i in range(n)]
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Static SPMD sharding: this host keeps every num_shards-th read task.
+
+        TPU-first: in multi-host SPMD each host process feeds its own chips. Sharding
+        happens at PLAN level — the leading ReadStage's read tasks (or InputStage's
+        bundles) are strided BEFORE execution, so a host only reads its slice of the
+        files; downstream map stages then run only on that slice.
+        """
+        if not self._stages:
+            return self
+        head, rest = self._stages[0], self._stages[1:]
+        if isinstance(head, ReadStage):
+            head = ReadStage(
+                f"{head.name}[shard {index}/{num_shards}]",
+                _ShardedDatasource(head.datasource, num_shards, index),
+                head.parallelism,
+            )
+        elif isinstance(head, InputStage):
+            head = InputStage(
+                f"{head.name}[shard {index}/{num_shards}]",
+                head.bundles[index::num_shards],
+            )
+        else:
+            raise TypeError(f"cannot shard a plan starting with {type(head).__name__}")
+        return Dataset([head] + rest, self._ctx)
+
+    # -- writes ------------------------------------------------------------
+    def _write(self, path: str, file_format: str, **kwargs) -> List[str]:
+        paths = []
+        for i, bundle in enumerate(self._execute()):
+            blocks = bundle.get_blocks()
+            merged = BlockAccessor.concat(blocks) if blocks else rows_to_block([])
+            if merged.num_rows == 0:
+                continue
+            paths.append(write_block(merged, path, file_format, i, **kwargs))
+        return paths
+
+    def write_parquet(self, path: str, **kwargs) -> List[str]:
+        return self._write(path, "parquet", **kwargs)
+
+    def write_csv(self, path: str, **kwargs) -> List[str]:
+        return self._write(path, "csv", **kwargs)
+
+    def write_json(self, path: str, **kwargs) -> List[str]:
+        return self._write(path, "json", **kwargs)
+
+    def to_pandas(self, limit: Optional[int] = None):
+        ds = self.limit(limit) if limit else self
+        blocks = _collect_blocks(list(ds._execute()))
+        table = BlockAccessor.concat(blocks) if blocks else rows_to_block([])
+        return table.to_pandas()
+
+    def to_arrow_refs(self) -> List["ray_tpu.ObjectRef"]:
+        return [b.block_ref for b in self._execute()]
+
+    def stats(self) -> str:
+        ops = self._build_ops()
+        return " -> ".join(op.name for op in ops)
+
+    def __repr__(self):
+        names = [s.name for s in self._stages]
+        return f"Dataset({' -> '.join(names)})"
+
+
+class GroupedData:
+    """Parity: reference `python/ray/data/grouped_data.py`."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        key = self._key
+        return self._ds._with(
+            AllToAllStage(
+                f"Aggregate({key})",
+                lambda bs: _shuffle.hash_aggregate(bs, key, list(aggs)),
+            )
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn(batch_dict) per group; groups are formed via a sort shuffle."""
+        key = self._key
+
+        def bulk(bundles):
+            bundles = _shuffle.sort(bundles, key)
+            return bundles
+
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                if block.num_rows == 0:
+                    continue
+                col = acc.to_numpy([key])[key]
+                uniq, starts = np.unique(col, return_index=True)
+                order = np.argsort(starts)
+                starts_sorted = list(starts[order]) + [block.num_rows]
+                for gi in range(len(uniq)):
+                    piece = block.slice(
+                        starts_sorted[gi], starts_sorted[gi + 1] - starts_sorted[gi]
+                    )
+                    out = fn(BlockAccessor.for_block(piece).to_numpy())
+                    yield batch_to_block(out)
+
+        return self._ds._with(AllToAllStage("SortForGroups", bulk))._with(
+            MapStage("MapGroups", transform)
+        )
+
+
+class _ShardedDatasource(Datasource):
+    """Every num_shards-th read task of an inner datasource (SPMD input sharding)."""
+
+    def __init__(self, inner: Datasource, num_shards: int, index: int):
+        self._inner = inner
+        self._num_shards = num_shards
+        self._index = index
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        # Ask for enough tasks that every shard gets at least one when possible.
+        tasks = self._inner.get_read_tasks(max(parallelism, self._num_shards))
+        return tasks[self._index :: self._num_shards]
+
+    def estimate_inmemory_data_size(self):
+        est = self._inner.estimate_inmemory_data_size()
+        return None if est is None else est // self._num_shards
+
+    def get_name(self) -> str:
+        return self._inner.get_name()
+
+
+def _collect_blocks(bundles: List[RefBundle]) -> List[Block]:
+    blocks: List[Block] = []
+    for b in bundles:
+        blocks.extend(b.get_blocks())
+    return blocks
+
+
+def _bundle_rows(bundles: List[RefBundle]) -> List[Dict]:
+    rows: List[Dict] = []
+    for b in bundles:
+        for block in b.get_blocks():
+            rows.extend(BlockAccessor.for_block(block).iter_rows())
+    return rows
+
+
+def from_blocks(blocks: List[Block], ctx: Optional[DataContext] = None) -> Dataset:
+    bundles = [
+        RefBundle(ray_tpu.put([b]), b.num_rows, b.nbytes) for b in blocks
+    ]
+    ds = Dataset([InputStage("FromBlocks", bundles)], ctx)
+    ds._cached_bundles = bundles
+    return ds

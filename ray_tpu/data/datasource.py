@@ -1,0 +1,248 @@
+"""Datasources: pluggable readers producing ReadTasks, and file writers.
+
+Design parity: reference `python/ray/data/datasource/` (Datasource.get_read_tasks →
+ReadTask closures executed as remote tasks; per-format datasources for parquet/csv/json)
+plus `read_api.py`'s in-memory sources (range/from_items). Each ReadTask is a zero-arg
+closure returning an iterator of blocks, so reads stream and parallelize trivially.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockMetadata, batch_to_block, rows_to_block
+
+
+@dataclass
+class ReadTask:
+    """A serializable unit of reading: executed remotely, yields blocks."""
+
+    read_fn: Callable[[], Iterator[Block]]
+    metadata: BlockMetadata
+
+    def __call__(self) -> Iterator[Block]:
+        return self.read_fn()
+
+
+class Datasource:
+    """SPI: estimate size and produce parallel read tasks."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, block_format: str = "int"):
+        self._n = n
+        self._block_format = block_format
+
+    def estimate_inmemory_data_size(self):
+        return self._n * 8
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        per = -(-self._n // parallelism)
+        for start in range(0, self._n, per):
+            end = min(start + per, self._n)
+
+            def read_fn(start=start, end=end) -> Iterator[Block]:
+                yield batch_to_block({"id": np.arange(start, end, dtype=np.int64)})
+
+            meta = BlockMetadata(num_rows=end - start, size_bytes=(end - start) * 8)
+            tasks.append(ReadTask(read_fn, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def estimate_inmemory_data_size(self):
+        return len(self._items) * 64
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        per = -(-n // parallelism) if n else 1
+        tasks = []
+        for start in range(0, n, per):
+            chunk = self._items[start : start + per]
+
+            def read_fn(chunk=chunk) -> Iterator[Block]:
+                yield rows_to_block([r if isinstance(r, dict) else {"item": r} for r in chunk])
+
+            tasks.append(ReadTask(read_fn, BlockMetadata(len(chunk), len(chunk) * 64)))
+        return tasks or [ReadTask(lambda: iter([pa.table({})]), BlockMetadata(0, 0))]
+
+
+class BlocksDatasource(Datasource):
+    """Wrap already-materialized blocks (from_pandas/from_numpy/from_arrow)."""
+
+    def __init__(self, blocks: List[Block]):
+        self._blocks = [batch_to_block(b) if not isinstance(b, pa.Table) else b for b in blocks]
+
+    def estimate_inmemory_data_size(self):
+        return sum(b.nbytes for b in self._blocks)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self._blocks:
+
+            def read_fn(b=b) -> Iterator[Block]:
+                yield b
+
+            tasks.append(ReadTask(read_fn, BlockMetadata(b.num_rows, b.nbytes, b.schema)))
+        return tasks
+
+
+def _expand_paths(paths, extensions: Optional[List[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if not extensions or any(f.endswith(e) for e in extensions):
+                        out.append(os.path.join(root, f))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files found for {paths}")
+    return out
+
+
+@dataclass
+class FileBasedDatasource(Datasource):
+    """One-or-more files → one ReadTask per file group."""
+
+    paths: Any
+    extensions: List[str] = field(default_factory=list)
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        files = _expand_paths(self.paths, self.extensions)
+        # Group files into at most `parallelism` tasks.
+        parallelism = max(1, min(parallelism, len(files)))
+        groups: List[List[str]] = [[] for _ in range(parallelism)]
+        for i, f in enumerate(files):
+            groups[i % parallelism].append(f)
+        tasks = []
+        for group in groups:
+            if not group:
+                continue
+
+            def read_fn(group=group, self=self) -> Iterator[Block]:
+                for path in group:
+                    yield from self._read_file(path)
+
+            size = sum(os.path.getsize(f) for f in group if os.path.exists(f))
+            tasks.append(ReadTask(read_fn, BlockMetadata(-1, size, input_files=group)))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def __init__(self, paths, columns: Optional[List[str]] = None, **kwargs):
+        super().__init__(paths, extensions=[".parquet"])
+        self._columns = columns
+        self._kwargs = kwargs
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        for batch in pf.iter_batches(columns=self._columns, **self._kwargs):
+            yield pa.Table.from_batches([batch])
+
+
+class CSVDatasource(FileBasedDatasource):
+    def __init__(self, paths, **kwargs):
+        super().__init__(paths, extensions=[".csv"])
+        self._kwargs = kwargs
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path, **self._kwargs)
+
+
+class JSONDatasource(FileBasedDatasource):
+    """Newline-delimited JSON."""
+
+    def __init__(self, paths, **kwargs):
+        super().__init__(paths, extensions=[".json", ".jsonl"])
+        self._kwargs = kwargs
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(path, **self._kwargs)
+
+
+class BinaryDatasource(FileBasedDatasource):
+    """Whole files as {path, bytes} rows."""
+
+    def __init__(self, paths):
+        super().__init__(paths)
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            data = f.read()
+        yield rows_to_block([{"path": path, "bytes": data}])
+
+
+class TextDatasource(FileBasedDatasource):
+    def __init__(self, paths, drop_empty_lines: bool = True):
+        super().__init__(paths)
+        self._drop_empty = drop_empty_lines
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "r") as f:
+            lines = f.read().splitlines()
+        if self._drop_empty:
+            lines = [ln for ln in lines if ln.strip()]
+        yield rows_to_block([{"text": ln} for ln in lines])
+
+
+# -- writers ---------------------------------------------------------------
+
+
+def write_block(block: Block, path: str, file_format: str, index: int, **kwargs) -> str:
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, f"part-{index:06d}.{file_format}")
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, fname, **kwargs)
+    elif file_format == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(block, fname, **kwargs)
+    elif file_format == "json":
+        import json
+
+        from ray_tpu.data.block import BlockAccessor
+
+        with open(fname, "w") as f:
+            for row in BlockAccessor.for_block(block).iter_rows():
+                f.write(json.dumps(row, default=str) + "\n")
+    else:
+        raise ValueError(f"unknown write format {file_format!r}")
+    return fname

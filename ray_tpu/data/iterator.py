@@ -1,0 +1,168 @@
+"""Batch iteration: local rebatching, shuffle buffers, and JAX device staging.
+
+Parity: reference `python/ray/data/iterator.py` (iter_batches, iter_torch_batches,
+local shuffle buffer) — with the torch path replaced by a JAX path that overlaps host
+batch assembly with device compute via a small prefetch queue, and supports an explicit
+`jax.sharding.Sharding` so a multi-chip mesh gets its inputs laid out without a gather.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def _blocks_from(bundles) -> Iterator[Block]:
+    for bundle in bundles:
+        for block in bundle.get_blocks():
+            if block.num_rows > 0:
+                yield block
+
+
+def iter_batches_impl(
+    bundles,
+    *,
+    batch_size: Optional[int],
+    batch_format: str,
+    drop_last: bool,
+    shuffle_buffer_size: Optional[int],
+    shuffle_seed: Optional[int],
+) -> Iterator[Any]:
+    blocks = _blocks_from(bundles)
+    if shuffle_buffer_size:
+        blocks = _shuffled_blocks(blocks, shuffle_buffer_size, shuffle_seed)
+    carry: List[Block] = []
+    carry_rows = 0
+    for block in blocks:
+        if batch_size is None:
+            yield BlockAccessor.for_block(block).to_batch_format(batch_format)
+            continue
+        carry.append(block)
+        carry_rows += block.num_rows
+        while carry_rows >= batch_size:
+            merged = BlockAccessor.concat(carry)
+            batch_block = merged.slice(0, batch_size)
+            rest = merged.slice(batch_size, merged.num_rows - batch_size)
+            carry = [rest] if rest.num_rows else []
+            carry_rows = rest.num_rows
+            yield BlockAccessor.for_block(batch_block).to_batch_format(batch_format)
+    if batch_size is not None and carry_rows and not drop_last:
+        merged = BlockAccessor.concat(carry)
+        yield BlockAccessor.for_block(merged).to_batch_format(batch_format)
+
+
+def _shuffled_blocks(
+    blocks: Iterator[Block], buffer_size: int, seed: Optional[int]
+) -> Iterator[Block]:
+    """Maintain a row buffer >= buffer_size; emit random permutations of it."""
+    rng = np.random.default_rng(seed)
+    buf: List[Block] = []
+    rows = 0
+    for block in blocks:
+        buf.append(block)
+        rows += block.num_rows
+        if rows >= buffer_size * 2:
+            merged = BlockAccessor.for_block(BlockAccessor.concat(buf))
+            perm = rng.permutation(merged.num_rows())
+            emit = merged.take_rows(perm[: rows - buffer_size])
+            keep = merged.take_rows(perm[rows - buffer_size :])
+            buf, rows = [keep], keep.num_rows
+            yield emit
+    if buf:
+        merged = BlockAccessor.for_block(BlockAccessor.concat(buf))
+        yield merged.take_rows(rng.permutation(merged.num_rows()))
+
+
+def iter_jax_batches_impl(
+    bundles,
+    *,
+    batch_size: int,
+    dtypes: Optional[Dict[str, Any]],
+    device,
+    sharding,
+    drop_last: bool,
+    shuffle_buffer_size: Optional[int],
+    prefetch: int,
+) -> Iterator[Dict[str, Any]]:
+    import jax
+    import jax.numpy as jnp
+
+    def stage(np_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        out = {}
+        for name, arr in np_batch.items():
+            if dtypes and name in dtypes:
+                arr = arr.astype(dtypes[name])
+            if sharding is not None:
+                out[name] = jax.device_put(arr, sharding)
+            elif device is not None:
+                out[name] = jax.device_put(arr, device)
+            else:
+                out[name] = jnp.asarray(arr)
+        return out
+
+    host_iter = iter_batches_impl(
+        bundles,
+        batch_size=batch_size,
+        batch_format="numpy",
+        drop_last=drop_last,
+        shuffle_buffer_size=shuffle_buffer_size,
+        shuffle_seed=None,
+    )
+    if prefetch <= 0:
+        for np_batch in host_iter:
+            yield stage(np_batch)
+        return
+
+    # Overlap: a host thread assembles + device_puts the next batches while the
+    # consumer computes on the current one.
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    _done = object()
+    err: List[BaseException] = []
+
+    def producer():
+        try:
+            for np_batch in host_iter:
+                q.put(stage(np_batch))
+        except BaseException as e:
+            err.append(e)
+        finally:
+            q.put(_done)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _done:
+            break
+        yield item
+    if err:
+        raise err[0]
+
+
+class DataIterator:
+    """One consumer's view of a streaming_split. Parity: ray.data.DataIterator."""
+
+    def __init__(self, ds, shard_index: int, num_shards: int):
+        self._ds = ds
+        self._shard_index = shard_index
+        self._num_shards = num_shards
+
+    def _sharded(self):
+        return self._ds.shard(self._num_shards, self._shard_index)
+
+    def iter_batches(self, **kwargs):
+        return self._sharded().iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs):
+        return self._sharded().iter_jax_batches(**kwargs)
+
+    def iter_rows(self):
+        return self._sharded().iter_rows()
+
+    def materialize(self):
+        return self._sharded().materialize()
