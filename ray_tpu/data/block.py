@@ -81,7 +81,14 @@ def rows_to_block(rows: List[Row]) -> Block:
         return pa.table({})
     if not isinstance(rows[0], dict):
         rows = [{"item": r} for r in rows]
-    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    # Union of keys across ALL rows (ordered by first occurrence); rows missing a
+    # key contribute None. Keying off rows[0] would silently drop late-appearing
+    # fields from heterogeneous map/flat_map outputs.
+    cols: Dict[str, list] = {}
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols[k] = []
     for r in rows:
         for k in cols:
             cols[k].append(r.get(k))

@@ -70,11 +70,6 @@ class LimitStage(Stage):
     limit: int = 0
 
 
-@dataclass
-class UnionStage(Stage):
-    others: List["Dataset"] = field(default_factory=list)
-
-
 class Dataset:
     def __init__(self, stages: List[Stage], ctx: Optional[DataContext] = None):
         self._stages = stages
@@ -294,9 +289,23 @@ class Dataset:
 
     def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
         def transform(blocks: Iterator[Block]) -> Iterator[Block]:
-            rng = np.random.default_rng(seed)
+            import zlib
+
             for block in blocks:
                 acc = BlockAccessor.for_block(block)
+                if seed is None:
+                    rng = np.random.default_rng()
+                else:
+                    # Derive per-block entropy from content: a fixed seed must not
+                    # replay the same mask in every parallel task (that correlates
+                    # the sample across partitions), and tasks don't know their
+                    # global position — block bytes do.
+                    crc = 0
+                    for name in block.column_names[:1]:
+                        for buf in block.column(name).combine_chunks().buffers():
+                            if buf is not None:
+                                crc = zlib.crc32(buf, crc)
+                    rng = np.random.default_rng((seed, crc, block.num_rows))
                 mask = rng.random(block.num_rows) < fraction
                 yield acc.take_rows(np.nonzero(mask)[0])
 
@@ -442,9 +451,9 @@ class Dataset:
         local_shuffle_seed: Optional[int] = None,
         prefetch_batches: int = 1,
     ) -> Iterator[Any]:
-        from ray_tpu.data.iterator import iter_batches_impl
+        from ray_tpu.data.iterator import iter_batches_impl, prefetched
 
-        return iter_batches_impl(
+        it = iter_batches_impl(
             self._execute(),
             batch_size=batch_size,
             batch_format=batch_format,
@@ -452,6 +461,9 @@ class Dataset:
             shuffle_buffer_size=local_shuffle_buffer_size,
             shuffle_seed=local_shuffle_seed,
         )
+        if prefetch_batches and prefetch_batches > 0:
+            return prefetched(it, prefetch_batches)
+        return it
 
     def iter_jax_batches(
         self,
@@ -511,12 +523,16 @@ class Dataset:
         ]
 
     def split_proportionately(self, proportions: List[float]) -> List["Dataset"]:
-        total = self.count()
+        # Materialize once: count() and the slicing must see the SAME execution
+        # (a re-run would double the work and can misalign under nondeterministic
+        # stages like unseeded random_sample).
+        mat = self.materialize()
+        total = mat.count()
         indices, acc = [], 0.0
         for p in proportions:
             acc += p
             indices.append(int(total * acc))
-        return self.split_at_indices(indices)
+        return mat.split_at_indices(indices)
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
         ds = self.random_shuffle(seed=seed) if shuffle else self

@@ -120,28 +120,63 @@ def iter_jax_batches_impl(
 
     # Overlap: a host thread assembles + device_puts the next batches while the
     # consumer computes on the current one.
-    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    def staged():
+        try:
+            for np_batch in host_iter:
+                yield stage(np_batch)
+        finally:
+            host_iter.close()
+
+    yield from prefetched(staged(), prefetch)
+
+
+def prefetched(source, depth: int):
+    """Drain `source` on a background thread through a bounded queue.
+
+    Abandonment-safe: if the consumer drops the iterator (break mid-epoch), the
+    generator's finally sets a stop flag; the producer's bounded put polls it and
+    exits, closing `source` so upstream executors shut down instead of leaking.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     _done = object()
     err: List[BaseException] = []
+    stopped = threading.Event()
 
     def producer():
         try:
-            for np_batch in host_iter:
-                q.put(stage(np_batch))
+            for item in source:
+                while not stopped.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stopped.is_set():
+                    return
         except BaseException as e:
             err.append(e)
         finally:
-            q.put(_done)
+            if hasattr(source, "close"):
+                source.close()
+            while not stopped.is_set():
+                try:
+                    q.put(_done, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _done:
-            break
-        yield item
-    if err:
-        raise err[0]
+    try:
+        while True:
+            item = q.get()
+            if item is _done:
+                break
+            yield item
+        if err:
+            raise err[0]
+    finally:
+        stopped.set()
 
 
 class DataIterator:

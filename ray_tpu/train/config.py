@@ -102,6 +102,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # Tune: stop condition — {"metric": threshold} (stop when reached) or
+    # callable(trial_id, result) -> bool. Parity: air RunConfig.stop.
+    stop: Optional[Any] = None
 
     def __post_init__(self):
         if self.storage_path is None:

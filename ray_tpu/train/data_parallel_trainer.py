@@ -33,6 +33,24 @@ class DataParallelTrainer:
         self._backend_config = backend_config or BackendConfig()
         self._datasets = datasets or {}
 
+    @property
+    def train_loop_config(self) -> Optional[dict]:
+        return self._train_loop_config
+
+    def with_overrides(self, *, train_loop_config: Optional[dict] = None):
+        """A copy of this trainer with a different per-worker config (Tune HPO hook)."""
+        return type(self)(
+            self._train_loop,
+            train_loop_config=(
+                train_loop_config if train_loop_config is not None
+                else self._train_loop_config
+            ),
+            scaling_config=self.scaling_config,
+            run_config=self.run_config,
+            backend_config=self._backend_config,
+            datasets=self._datasets,
+        )
+
     def fit(self) -> Result:
         backend = self._backend_config.backend_cls()()
         controller = TrainController(

@@ -1,0 +1,219 @@
+"""ray_tpu.tune: hyperparameter tuning over the distributed runtime.
+
+Parity: reference `python/ray/tune/__init__.py` — Tuner/TuneConfig, tune.report,
+search-space primitives (uniform/loguniform/choice/randint/grid_search/sample_from),
+schedulers (ASHA, PBT, median stopping), with_parameters/with_resources, ResultGrid.
+A Trainer instance can be passed as the trainable (HPO over Train runs), matching the
+reference's Tuner(trainer) flow.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.tune import _session
+from ray_tpu.tune._trial_runner import ERROR, TERMINATED, Trial, TuneController
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Domain,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+def report(metrics: dict, *, checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (and optionally a checkpoint) from inside a trial.
+
+    Parity: `ray.tune.report` / `train.report` inside tune functions.
+    """
+    session = _session.get()
+    if session is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    session.report_fn(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    session = _session.get()
+    if session is None:
+        raise RuntimeError("tune.get_checkpoint() called outside a Tune trial")
+    return session.checkpoint
+
+
+def get_trial_id() -> Optional[str]:
+    session = _session.get()
+    return session.trial_id if session else None
+
+
+def get_trial_dir() -> Optional[str]:
+    session = _session.get()
+    return session.trial_dir if session else None
+
+
+def with_parameters(fn: Callable, **params) -> Callable:
+    """Bind large constant objects to a trainable. Parity: tune.with_parameters —
+    the reference puts params in the object store; here the closure rides the
+    function export through the store the same way."""
+
+    @functools.wraps(fn)
+    def inner(config):
+        return fn(config, **params)
+
+    return inner
+
+
+def with_resources(fn: Callable, resources: Dict[str, float]) -> Callable:
+    fn._tune_resources = resources
+    return fn
+
+
+@dataclass
+class TuneConfig:
+    """Parity: reference `python/ray/tune/tune_config.py`."""
+
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    seed: Optional[int] = None
+    resources_per_trial: Optional[dict] = None
+
+    def __post_init__(self):
+        if self.mode is not None and self.mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+
+
+class Tuner:
+    """Parity: reference `python/ray/tune/tuner.py` Tuner(trainable, param_space=...,
+    tune_config=..., run_config=...).fit() -> ResultGrid."""
+
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: Optional[dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = self._normalize_trainable(trainable)
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        if self._tune_config.resources_per_trial is None:
+            res = getattr(trainable, "_tune_resources", None)
+            if res:
+                self._tune_config.resources_per_trial = {
+                    "num_cpus": res.get("CPU", res.get("num_cpus", 1)),
+                    "num_tpus": res.get("TPU", res.get("num_tpus", 0)),
+                }
+
+    @staticmethod
+    def _normalize_trainable(trainable):
+        # A Trainer instance (has .fit and ._train_loop) → per-trial function that
+        # rebuilds the trainer with the sampled train_loop_config and runs fit()
+        # inside the trial actor, reporting its final metrics.
+        from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+        if isinstance(trainable, DataParallelTrainer):
+
+            def trainer_fn(config, _trainer=trainable):
+                import ray_tpu.tune as tune
+
+                # Sampled hyperparams reach the train loop: either the reference's
+                # nested {"train_loop_config": {...}} form, or a flat config which is
+                # merged over the trainer's existing train_loop_config.
+                if "train_loop_config" in config:
+                    loop_cfg = config["train_loop_config"]
+                else:
+                    loop_cfg = {**(_trainer.train_loop_config or {}), **config}
+                trainer = _trainer.with_overrides(train_loop_config=loop_cfg)
+                result = trainer.fit()
+                metrics = dict(result.metrics or {})
+                tune.report(metrics, checkpoint=result.checkpoint)
+
+            return trainer_fn
+        if callable(trainable):
+            return trainable
+        raise TypeError(f"unsupported trainable: {type(trainable).__name__}")
+
+    def fit(self) -> ResultGrid:
+        name = self._run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
+        experiment_dir = os.path.join(self._run_config.storage_path, name)
+        os.makedirs(experiment_dir, exist_ok=True)
+        controller = TuneController(
+            self._trainable,
+            param_space=self._param_space,
+            tune_config=self._tune_config,
+            run_config=self._run_config,
+            experiment_dir=experiment_dir,
+        )
+        controller.run()
+        results = []
+        for trial in controller.trials:
+            metrics = dict(trial.last_result)
+            metrics["config"] = trial.config
+            results.append(
+                Result(
+                    metrics=metrics,
+                    checkpoint=trial.latest_checkpoint,
+                    path=trial.local_dir,
+                    error=RuntimeError(trial.error) if trial.error else None,
+                )
+            )
+        return ResultGrid(
+            results,
+            default_metric=self._tune_config.metric,
+            default_mode=self._tune_config.mode,
+        )
+
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "Checkpoint",
+    "Domain",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_dir",
+    "get_trial_id",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "with_resources",
+]

@@ -1,0 +1,31 @@
+"""Per-trial session: carries report()/get_checkpoint() inside a trial thread.
+
+Parity: reference `python/ray/tune/trainable/session` semantics (the function-trainable
+session). Thread-local because each trial actor runs its function on a worker thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+@dataclass
+class TuneSession:
+    report_fn: Callable
+    checkpoint: Optional[Checkpoint]
+    trial_id: str
+    trial_dir: str
+
+
+def set(session: Optional[TuneSession]):  # noqa: A001
+    _local.session = session
+
+
+def get() -> Optional[TuneSession]:
+    return getattr(_local, "session", None)

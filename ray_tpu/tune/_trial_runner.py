@@ -1,0 +1,267 @@
+"""Trial execution: per-trial actors + the TuneController event loop.
+
+Design parity: reference `python/ray/tune/execution/tune_controller.py` (:68 — the
+stepping loop that starts trials, processes results, applies scheduler decisions) and
+`python/ray/tune/trainable/function_trainable.py` (function trainables report through a
+session; results are buffered and drained by the controller). Trials run as ray_tpu
+actors: the user function executes on a worker thread inside the actor and
+`tune.report()` appends to a buffer the controller polls.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched_mod
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, experiment_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.results: List[dict] = []
+        self.last_result: dict = {}
+        self.error: Optional[str] = None
+        self.actor = None
+        self.local_dir = os.path.join(experiment_dir, trial_id)
+        self.latest_checkpoint: Optional[Checkpoint] = None
+        # scheduler state
+        self.rungs_passed: set = set()
+        self.last_perturbation_t: int = 0
+        self.restore_checkpoint: Optional[Checkpoint] = None
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status}, {self.config})"
+
+
+class _TrialActor:
+    """Runs one trial's user function on a thread; buffers reported results."""
+
+    def __init__(self, fn_blob: bytes, config: dict, trial_id: str, trial_dir: str,
+                 restore_from: Optional[str]):
+        import cloudpickle
+
+        self._fn = cloudpickle.loads(fn_blob)
+        self._config = config
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+        os.makedirs(trial_dir, exist_ok=True)
+        self._results: List[dict] = []
+        self._lock = threading.Lock()
+        self._status = RUNNING
+        self._error: Optional[str] = None
+        self._iteration = 0
+        self._restore_from = restore_from
+        self._start_time = time.time()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        from ray_tpu.tune import _session
+
+        _session.set(
+            _session.TuneSession(
+                report_fn=self._on_report,
+                checkpoint=(
+                    Checkpoint(self._restore_from) if self._restore_from else None
+                ),
+                trial_id=self._trial_id,
+                trial_dir=self._trial_dir,
+            )
+        )
+        try:
+            self._fn(self._config)
+            with self._lock:
+                self._status = TERMINATED
+        except BaseException:
+            with self._lock:
+                self._error = traceback.format_exc()
+                self._status = ERROR
+        finally:
+            _session.set(None)
+
+    def _on_report(self, metrics: dict, checkpoint: Optional[Checkpoint]):
+        self._iteration += 1
+        row = dict(metrics)
+        row.setdefault("training_iteration", self._iteration)
+        row["trial_id"] = self._trial_id
+        row["time_total_s"] = time.time() - self._start_time
+        if checkpoint is not None:
+            # Persist into the trial dir so the checkpoint outlives the actor (PBT
+            # exploit and Tuner.restore both read it later).
+            target = os.path.join(
+                self._trial_dir, f"checkpoint_{self._iteration:06d}"
+            )
+            checkpoint.to_directory(target)
+            row["__checkpoint_path"] = target
+        with self._lock:
+            self._results.append(row)
+
+    def poll(self) -> dict:
+        with self._lock:
+            out = {
+                "results": self._results[:],
+                "status": self._status,
+                "error": self._error,
+            }
+            self._results = []
+        return out
+
+    def ready(self) -> bool:
+        return True
+
+
+class TuneController:
+    """The driver-side loop: start trials, drain results, apply scheduler decisions."""
+
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: dict,
+        tune_config,
+        run_config,
+        experiment_dir: str,
+    ):
+        import cloudpickle
+
+        from ray_tpu.tune.search import BasicVariantGenerator
+
+        self._fn_blob = cloudpickle.dumps(trainable)
+        self._tune_config = tune_config
+        self._run_config = run_config
+        self._experiment_dir = experiment_dir
+        self._searcher = tune_config.search_alg or BasicVariantGenerator(
+            param_space, num_samples=tune_config.num_samples, seed=tune_config.seed
+        )
+        self.trials: List[Trial] = []
+        n = (
+            self._searcher.total_variants
+            if isinstance(self._searcher, BasicVariantGenerator)
+            else tune_config.num_samples
+        )
+        for i in range(n):
+            cfg = self._searcher.suggest(f"trial_{i:05d}")
+            if cfg is None:
+                break
+            self.trials.append(Trial(f"trial_{i:05d}", cfg, experiment_dir))
+        self._scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
+        if getattr(self._scheduler, "metric", None) is None:
+            self._scheduler.metric = tune_config.metric
+        if getattr(self._scheduler, "mode", None) is None:
+            self._scheduler.mode = tune_config.mode or "max"
+        self._max_concurrent = tune_config.max_concurrent_trials or len(self.trials)
+        self._resources = tune_config.resources_per_trial or {"num_cpus": 1}
+        self._exploits: List[tuple] = []
+
+    # -- PBT hook ---------------------------------------------------------
+    def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
+        if any(t is trial for t, _, _ in self._exploits):
+            return
+        self._exploits.append((trial, donor, new_config))
+
+    def _has_pending_exploit(self, trial: Trial) -> bool:
+        return any(t is trial for t, _, _ in self._exploits)
+
+    def _start_trial(self, trial: Trial):
+        actor_cls = ray_tpu.remote(**self._resources)(_TrialActor)
+        restore = trial.restore_checkpoint.path if trial.restore_checkpoint else None
+        trial.actor = actor_cls.remote(
+            self._fn_blob, trial.config, trial.trial_id, trial.local_dir, restore
+        )
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _apply_exploits(self):
+        for trial, donor, new_config in self._exploits:
+            if trial.status not in (RUNNING, PENDING):
+                continue
+            self._stop_trial(trial, PENDING)
+            trial.config = new_config
+            trial.restore_checkpoint = donor.latest_checkpoint
+            trial.rungs_passed = set()
+        self._exploits = []
+
+    def _check_stop_condition(self, result: dict) -> bool:
+        stop = getattr(self._run_config, "stop", None)
+        if stop is None:
+            return False
+        if callable(stop):
+            return bool(stop(result.get("trial_id", ""), result))
+        return any(result.get(k, float("-inf")) >= v for k, v in stop.items())
+
+    def step(self) -> bool:
+        """One scheduling round; returns True while any trial is live."""
+        running = [t for t in self.trials if t.status == RUNNING]
+        pending = [t for t in self.trials if t.status == PENDING]
+        for trial in pending[: max(0, self._max_concurrent - len(running))]:
+            self._start_trial(trial)
+
+        for trial in [t for t in self.trials if t.status == RUNNING]:
+            try:
+                poll = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
+            except Exception as e:
+                trial.error = f"poll failed: {e}"
+                self._stop_trial(trial, ERROR)
+                continue
+            for result in poll["results"]:
+                ckpt_path = result.pop("__checkpoint_path", None)
+                if ckpt_path:
+                    trial.latest_checkpoint = Checkpoint(ckpt_path)
+                trial.results.append(result)
+                trial.last_result = result
+                decision = self._scheduler.on_trial_result(self, trial, result)
+                if decision == sched_mod.STOP or self._check_stop_condition(result):
+                    self._stop_trial(trial, TERMINATED)
+                    break
+                if self._has_pending_exploit(trial):
+                    # Abandon the rest of this buffered batch: the trial is about to
+                    # be restarted from the donor's checkpoint, so results from the
+                    # old lineage past the exploit point are moot. Skipping the
+                    # terminal-status transition below also means a fast trial whose
+                    # actor already finished still gets restarted (results often
+                    # arrive as one batch when a trial outpaces the poll loop).
+                    break
+            if (
+                trial.status == RUNNING
+                and not self._has_pending_exploit(trial)
+                and poll["status"] in (TERMINATED, ERROR)
+            ):
+                trial.error = poll["error"]
+                self._stop_trial(trial, poll["status"])
+                self._scheduler.on_trial_complete(self, trial, trial.last_result)
+                self._searcher.on_trial_complete(
+                    trial.trial_id, trial.last_result, error=poll["status"] == ERROR
+                )
+        self._apply_exploits()
+        return any(t.status in (PENDING, RUNNING) for t in self.trials)
+
+    def run(self):
+        while self.step():
+            time.sleep(0.05)
+        failed = [t for t in self.trials if t.status == ERROR]
+        if failed and len(failed) == len(self.trials):
+            raise RuntimeError(
+                f"all {len(failed)} trials errored; first error:\n{failed[0].error}"
+            )

@@ -1,0 +1,61 @@
+"""ResultGrid: the outcome of a Tuner.fit().
+
+Parity: reference `python/ray/tune/result_grid.py` — indexable results with
+get_best_result, get_dataframe, and per-trial metrics/config/checkpoint access.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.train.config import Result
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], *, default_metric=None, default_mode=None):
+        self._results = results
+        self._metric = default_metric
+        self._mode = default_mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode or "max"
+        if metric is None:
+            raise ValueError("get_best_result requires a metric")
+        candidates = [
+            r for r in self._results if r.metrics and metric in r.metrics
+        ]
+        if not candidates:
+            raise RuntimeError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(candidates, key=key) if mode == "max" else min(candidates, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            for k, v in (r.config or {}).items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)

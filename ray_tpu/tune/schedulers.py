@@ -1,0 +1,195 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), median stopping, PBT.
+
+Design parity: reference `python/ray/tune/schedulers/` — `TrialScheduler` SPI with
+on_trial_result decisions (`trial_scheduler.py`), `AsyncHyperBandScheduler`
+(`async_hyperband.py` — rung milestones at grace_period * rf^k, cutoff at the top-1/rf
+quantile), `MedianStoppingRule` (`median_stopping_rule.py`), and
+`PopulationBasedTraining` (`pbt.py` — exploit top quantile's checkpoint + explore by
+perturbing hyperparams at each perturbation interval).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search import Domain
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, controller, trial, result: Optional[dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: stop a trial at a rung milestone if it is below the top-1/rf cutoff."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+        brackets: int = 1,
+    ):
+        self._time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        # rung milestones: grace * rf^k up to max_t
+        self._milestones: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self._milestones.append(t)
+            t *= reduction_factor
+        # recorded metric values per rung
+        self._rungs: Dict[float, List[float]] = {m: [] for m in self._milestones}
+
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        t = result.get(self._time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self._max_t:
+            return STOP
+        score = metric if self.mode == "max" else -metric
+        for m in self._milestones:
+            if t >= m and m not in trial.rungs_passed:
+                trial.rungs_passed.add(m)
+                rung = self._rungs[m]
+                rung.append(score)
+                if len(rung) >= self._rf:
+                    cutoff = np.quantile(rung, 1 - 1 / self._rf)
+                    if score < cutoff:
+                        return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of running means."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self._time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._means: Dict[str, float] = {}
+
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        t = result.get(self._time_attr, 0)
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        sign = 1 if self.mode == "max" else -1
+        scores = [sign * r[self.metric] for r in trial.results if self.metric in r]
+        self._means[trial.trial_id] = float(np.mean(scores))
+        if t < self._grace or len(self._means) < self._min_samples:
+            return CONTINUE
+        median = float(np.median(list(self._means.values())))
+        if max(scores) < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: at each perturbation interval, bottom-quantile trials exploit a top-quantile
+    trial's checkpoint+config and explore by perturbing hyperparameters."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, object]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self._time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+
+    def _score(self, trial) -> Optional[float]:
+        if not trial.last_result or self.metric not in trial.last_result:
+            return None
+        v = trial.last_result[self.metric]
+        return v if self.mode == "max" else -v
+
+    def explore(self, config: dict) -> dict:
+        out = dict(config)
+        for key, mutation in self._mutations.items():
+            if self._rng.random() < self._resample_prob or key not in out:
+                if isinstance(mutation, Domain):
+                    out[key] = mutation.sample(self._rng)
+                elif isinstance(mutation, list):
+                    out[key] = self._rng.choice(mutation)
+                elif callable(mutation):
+                    out[key] = mutation()
+            else:
+                cur = out[key]
+                if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                    factor = self._rng.choice([0.8, 1.2])
+                    out[key] = type(cur)(cur * factor) if isinstance(cur, float) else max(
+                        1, int(cur * factor)
+                    )
+                elif isinstance(mutation, list):
+                    out[key] = self._rng.choice(mutation)
+        return out
+
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        t = result.get(self._time_attr, 0)
+        if t - trial.last_perturbation_t < self._interval:
+            return CONTINUE
+        trial.last_perturbation_t = t
+        # Rank current population.
+        scored = [
+            (self._score(other), other)
+            for other in controller.trials
+            if self._score(other) is not None
+        ]
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda x: x[0])
+        n = len(scored)
+        k = max(1, int(n * self._quantile))
+        bottom = [tr for _, tr in scored[:k]]
+        top = [tr for _, tr in scored[-k:]]
+        if trial in bottom:
+            donor = self._rng.choice([tr for tr in top if tr is not trial] or [None])
+            if donor is not None and donor.latest_checkpoint is not None:
+                new_config = self.explore(donor.config)
+                controller.request_exploit(trial, donor, new_config)
+        return CONTINUE

@@ -1,0 +1,171 @@
+"""Search spaces and search algorithms.
+
+Design parity: reference `python/ray/tune/search/` — sample-space primitives
+(uniform/loguniform/choice/randint/grid_search), the `Searcher` SPI, and
+`BasicVariantGenerator` (grid cross-product x num_samples random sampling,
+`search/basic_variant.py`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    """A samplable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    options: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+@dataclass
+class SampleFrom(Domain):
+    fn: Callable[[dict], Any]
+
+    def sample(self, rng):
+        return self.fn
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(options: List[Any]) -> Choice:
+    return Choice(list(options))
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+class Searcher:
+    """SPI parity: reference `python/ray/tune/search/searcher.py`."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict], error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples; distributions sampled per variant."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1, seed: Optional[int] = None):
+        self._space = param_space
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._variants = self._expand()
+        self._idx = 0
+
+    def _expand(self) -> List[dict]:
+        grid_keys: List[str] = []
+        grid_vals: List[List[Any]] = []
+
+        def find_grids(space: dict, prefix=()):
+            for k, v in space.items():
+                if _is_grid(v):
+                    grid_keys.append((*prefix, k))
+                    grid_vals.append(v["grid_search"])
+                elif isinstance(v, dict) and not _is_grid(v):
+                    find_grids(v, (*prefix, k))
+
+        find_grids(self._space)
+        combos = list(itertools.product(*grid_vals)) if grid_vals else [()]
+        variants = []
+        for _ in range(self._num_samples):
+            for combo in combos:
+                cfg = self._materialize(self._space)
+                for key_path, value in zip(grid_keys, combo):
+                    node = cfg
+                    for k in key_path[:-1]:
+                        node = node[k]
+                    node[key_path[-1]] = value
+                variants.append(cfg)
+        return variants
+
+    def _materialize(self, space: dict) -> dict:
+        out = {}
+        deferred = []
+        for k, v in space.items():
+            if _is_grid(v):
+                out[k] = None  # filled by grid combo
+            elif isinstance(v, SampleFrom):
+                deferred.append((k, v))
+            elif isinstance(v, Domain):
+                out[k] = v.sample(self._rng)
+            elif isinstance(v, dict):
+                out[k] = self._materialize(v)
+            else:
+                out[k] = v
+        # conditional params see the rest of the config
+        for k, v in deferred:
+            out[k] = v.fn(out)
+        return out
+
+    @property
+    def total_variants(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg

@@ -286,3 +286,37 @@ def test_shard_slices_read_tasks_not_output():
     assert len(tasks) == 2  # 10 read tasks strided by 5
     total = sum(s.count() for s in (ds.shard(5, i) for i in range(5)))
     assert total == 100
+
+
+def test_rows_to_block_unions_keys():
+    ds = rd.from_items([{"id": i} for i in range(4)]).map(
+        lambda r: {"id": r["id"]} if r["id"] % 2 == 0 else {"id": r["id"], "label": 1}
+    )
+    rows = ds.take_all()
+    assert any("label" in r and r["label"] == 1 for r in rows)
+
+
+def test_seeded_random_sample_uncorrelated_across_blocks():
+    ds = rd.range(4000, parallelism=8).random_sample(0.5, seed=42)
+    ids = np.array(sorted(r["id"] for r in ds.take_all()))
+    # Correlated per-block masks would repeat every 500 ids; check block-relative
+    # positions differ between two blocks.
+    picks0 = set(ids[(ids >= 0) & (ids < 500)] % 500)
+    picks1 = set(ids[(ids >= 500) & (ids < 1000)] % 500)
+    assert picks0 != picks1
+
+
+def test_abandoned_jax_iterator_stops_threads():
+    import threading
+    import time
+
+    before = threading.active_count()
+    for _ in range(4):
+        it = rd.range(50_000, parallelism=8).iter_jax_batches(batch_size=16)
+        next(it)
+        del it
+    import gc
+
+    gc.collect()
+    time.sleep(1.0)
+    assert threading.active_count() <= before + 3

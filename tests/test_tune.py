@@ -1,0 +1,265 @@
+"""ray_tpu.tune tests.
+
+Shape parity with the reference suite (python/ray/tune/tests/): variant generation,
+Tuner.fit over function trainables, schedulers (ASHA early stopping, PBT
+exploit/explore), checkpointing, stop conditions, and Tuner(trainer) integration.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+
+
+def test_variant_generation_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0, 1),
+        "n": tune.choice([1, 2, 3]),
+        "nested": {"depth": tune.grid_search([2, 4])},
+    }
+    gen = BasicVariantGenerator(space, num_samples=3, seed=0)
+    assert gen.total_variants == 2 * 2 * 3
+    cfgs = [gen.suggest(f"t{i}") for i in range(gen.total_variants)]
+    assert all(c["lr"] in (0.1, 0.01) for c in cfgs)
+    assert all(c["nested"]["depth"] in (2, 4) for c in cfgs)
+    assert all(0 <= c["wd"] <= 1 for c in cfgs)
+    assert gen.suggest("extra") is None
+
+
+def test_tuner_basic(tmp_path):
+    def trainable(config):
+        tune.report({"score": config["x"] * 2})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 5, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.metrics["score"] == 10
+    assert best.config["x"] == 5
+
+
+def test_tuner_multi_iteration_and_stop_dict(tmp_path):
+    def trainable(config):
+        for i in range(100):
+            tune.report({"loss": 1.0 / (i + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(storage_path=str(tmp_path), stop={"training_iteration": 7}),
+    )
+    results = tuner.fit()
+    assert results[0].metrics["training_iteration"] >= 7
+    assert results[0].metrics["training_iteration"] < 100
+
+
+def test_tuner_errors_surface(tmp_path):
+    def bad(config):
+        if config["x"] == 1:
+            raise ValueError("sad trial")
+        tune.report({"score": 1})
+
+    results = tune.Tuner(
+        bad,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 1
+    assert "sad trial" in str(results.errors[0])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.train import Checkpoint
+
+    def trainable(config):
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "it.txt")) as f:
+                start = int(f.read())
+        for i in range(start, 3):
+            d = os.path.join(tune.get_trial_dir(), f"tmp_{i}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "it.txt"), "w") as f:
+                f.write(str(i + 1))
+            tune.report({"it": i + 1}, checkpoint=Checkpoint.from_directory(d))
+
+    results = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="it", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    best = results.get_best_result()
+    assert best.checkpoint is not None
+    with open(os.path.join(best.checkpoint.path, "it.txt")) as f:
+        assert f.read() == "3"
+
+
+def test_asha_stops_bad_trials(tmp_path):
+    def trainable(config):
+        for i in range(20):
+            tune.report({"acc": config["q"] + i * 0.001})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.0, 0.1, 0.2, 0.9])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=20),
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    iters = {r.config["q"]: r.metrics.get("training_iteration", 0) for r in results}
+    assert iters[0.9] >= max(iters.values()) - 1  # best trial ran longest (or tied)
+    assert results.get_best_result().config["q"] == 0.9
+
+
+def test_pbt_exploits_and_perturbs(tmp_path):
+    from ray_tpu.train import Checkpoint
+
+    def trainable(config):
+        import time
+
+        # score grows at rate lr; checkpoint carries accumulated score. The sleep
+        # paces the trial so controller polls interleave with results (PBT acts on
+        # a live population, not on an already-finished one).
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "s.txt")) as f:
+                score = float(f.read())
+        for i in range(30):
+            time.sleep(0.05)
+            score += config["lr"]
+            d = os.path.join(tune.get_trial_dir(), f"c{i}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(score))
+            tune.report({"score": score}, checkpoint=Checkpoint.from_directory(d))
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=5,
+                hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)},
+                quantile_fraction=0.5,
+                seed=0,
+            ),
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), stop={"training_iteration": 25}),
+    ).fit()
+    # The weak trial must have been exploited: its final score reflects the strong
+    # trial's checkpoint (score >> 30 * 0.001).
+    scores = sorted(r.metrics["score"] for r in results)
+    assert scores[0] > 1.0
+
+
+def test_tuner_over_trainer(tmp_path):
+    import ray_tpu.train as train
+
+    def loop(config):
+        train.report({"final": config["k"] * 10})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="inner"),
+    )
+    results = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"k": tune.grid_search([1, 4])}},
+        tune_config=tune.TuneConfig(metric="final", mode="max", max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert results.get_best_result().metrics["final"] == 40
+
+
+def test_median_stopping(tmp_path):
+    def trainable(config):
+        for i in range(15):
+            tune.report({"m": config["v"]})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"v": tune.grid_search([1.0, 1.0, 0.0])},
+        tune_config=tune.TuneConfig(
+            metric="m",
+            mode="max",
+            scheduler=tune.MedianStoppingRule(grace_period=3, min_samples_required=2),
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+
+
+def test_custom_searcher_is_used(tmp_path):
+    class FixedSearcher(tune.Searcher):
+        def __init__(self):
+            self.completed = []
+
+        def suggest(self, trial_id):
+            return {"x": 7}
+
+        def on_trial_complete(self, trial_id, result, error=False):
+            self.completed.append(trial_id)
+
+    searcher = FixedSearcher()
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 1)},  # must be ignored: searcher wins
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=3, search_alg=searcher
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+    assert all(r.metrics["score"] == 7 for r in results)
+    assert len(searcher.completed) == 3
+
+
+def test_tuner_over_trainer_flat_param_space(tmp_path):
+    import ray_tpu.train as train
+
+    def loop(config):
+        train.report({"final": config["k"] * 10 + config.get("base", 0)})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        train_loop_config={"base": 1},
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="inner2"),
+    )
+    results = tune.Tuner(
+        trainer,
+        param_space={"k": tune.grid_search([2, 5])},  # flat: merged over base config
+        tune_config=tune.TuneConfig(metric="final", mode="max", max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert results.get_best_result().metrics["final"] == 51
