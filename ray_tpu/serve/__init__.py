@@ -1,0 +1,293 @@
+"""ray_tpu.serve: scalable model serving over the distributed runtime.
+
+Parity: reference `python/ray/serve/__init__.py` / `api.py` — @serve.deployment,
+Deployment.bind composition, serve.run/delete/shutdown/status, DeploymentHandle,
+@serve.batch, HTTP ingress via a proxy actor. TPU-first: replicas are long-lived
+actors that hold compiled jitted models warm; @serve.batch keeps the MXU fed.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve._common import (
+    CONTROLLER_NAME,
+    DEFAULT_APP_NAME,
+    SERVE_NAMESPACE,
+    AutoscalingConfig,
+    DeploymentConfig,
+    Request,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+
+@dataclass
+class Deployment:
+    """A deployment definition: user class/function + config. Parity: serve.Deployment."""
+
+    target: Any
+    name: str
+    config: DeploymentConfig = field(default_factory=DeploymentConfig)
+
+    def options(self, *, name: Optional[str] = None, num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+                ray_actor_options: Optional[dict] = None,
+                user_config: Optional[dict] = None) -> "Deployment":
+        cfg = replace(self.config)
+        if num_replicas is not None:
+            if num_replicas == "auto":
+                cfg.autoscaling_config = cfg.autoscaling_config or AutoscalingConfig()
+            else:
+                cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = (
+                AutoscalingConfig(**autoscaling_config)
+                if isinstance(autoscaling_config, dict)
+                else autoscaling_config
+            )
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        if user_config is not None:
+            cfg.user_config = user_config
+        return Deployment(self.target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+@dataclass
+class Application:
+    """A bound deployment graph node. Parity: serve.Application (built by .bind())."""
+
+    deployment: Deployment
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+
+def deployment(
+    _target: Any = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[Union[int, str]] = None,
+    max_ongoing_requests: int = 100,
+    autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+    ray_actor_options: Optional[dict] = None,
+    user_config: Optional[dict] = None,
+):
+    """@serve.deployment decorator. Parity: reference serve/api.py deployment()."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(max_ongoing_requests=max_ongoing_requests)
+        d = Deployment(target, name or target.__name__, cfg)
+        return d.options(
+            num_replicas=num_replicas,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+            user_config=user_config,
+        )
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def ingress(_app=None):
+    """Kept for API parity; the bound top-level deployment is already the ingress."""
+
+    def wrap(cls):
+        return cls
+
+    return wrap
+
+
+# -- controller / proxy lifecycle -----------------------------------------
+
+
+def _get_or_create_controller():
+    from ray_tpu.serve._controller import ServeController
+
+    controller_cls = ray_tpu.remote(num_cpus=0)(ServeController)
+    controller = controller_cls.options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, get_if_exists=True,
+        max_concurrency=1000,
+    ).remote()
+    controller.run_control_loop.remote()  # idempotent fire-and-forget
+    return controller
+
+
+_proxy_state: dict = {}
+
+
+def start(http_options: Optional[dict] = None, **_kwargs):
+    """Start serve system actors (controller + HTTP proxy). Parity: serve.start."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = _get_or_create_controller()
+    if _proxy_state.get("proxy") is None:
+        from ray_tpu.serve._proxy import HTTPProxy
+
+        opts = http_options or {}
+        proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
+        proxy = proxy_cls.options(
+            name="SERVE_PROXY", namespace=SERVE_NAMESPACE, get_if_exists=True,
+            max_concurrency=1000,
+        ).remote(opts.get("host", "127.0.0.1"), opts.get("port", 8000))
+        port = ray_tpu.get(proxy.start.remote())
+        _proxy_state["proxy"] = proxy
+        _proxy_state["port"] = port
+    return controller
+
+
+def _collect_deployments(app: Application, app_name: str, acc: Dict[str, dict]) -> Any:
+    """DFS over the bound graph: nested Applications become DeploymentHandles."""
+    import cloudpickle
+
+    d = app.deployment
+
+    def convert(v):
+        if isinstance(v, Application):
+            return _collect_deployments(v, app_name, acc)
+        return v
+
+    args = tuple(convert(a) for a in app.init_args)
+    kwargs = {k: convert(v) for k, v in app.init_kwargs.items()}
+    spec = {
+        "target_blob": cloudpickle.dumps(d.target),
+        "init_blob": cloudpickle.dumps((args, kwargs)),
+        "config": d.config,
+    }
+    if d.name in acc:
+        existing = acc[d.name]
+        if (
+            existing["target_blob"] != spec["target_blob"]
+            or existing["init_blob"] != spec["init_blob"]
+            or existing["config"] != spec["config"]
+        ):
+            raise ValueError(
+                f"deployment name {d.name!r} bound twice with different args or "
+                f"config; use .options(name=...) to disambiguate"
+            )
+    acc[d.name] = spec
+    return DeploymentHandle(app_name, d.name)
+
+
+def run(
+    app: Application,
+    *,
+    name: str = DEFAULT_APP_NAME,
+    route_prefix: Optional[str] = "/",
+    blocking: bool = False,
+    _timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress. Parity: serve.run.
+
+    route_prefix=None deploys without HTTP exposure (handle-only access).
+    """
+    controller = start()
+    acc: Dict[str, dict] = {}
+    _collect_deployments(app, name, acc)
+    ingress_name = app.deployment.name
+    ray_tpu.get(
+        controller.deploy_app.remote(name, acc, route_prefix, ingress_name)
+    )
+    deadline = time.monotonic() + _timeout_s
+    while time.monotonic() < deadline:
+        if ray_tpu.get(controller.ready.remote(name)):
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError(f"application {name!r} did not become ready")
+    handle = DeploymentHandle(name, ingress_name)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def _existing_controller():
+    """The live controller, or None — read paths must not spawn one as a side effect."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except Exception:
+        return None
+
+
+def delete(name: str):
+    controller = _existing_controller()
+    if controller is not None:
+        ray_tpu.get(controller.delete_app.remote(name))
+
+
+def status() -> dict:
+    controller = _existing_controller()
+    if controller is None:
+        return {}
+    return ray_tpu.get(controller.list_apps.remote())
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        ray_tpu.get(controller.shutdown_serve.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    proxy = _proxy_state.pop("proxy", None)
+    if proxy is not None:
+        try:
+            ray_tpu.kill(proxy)
+        except Exception:
+            pass
+    _proxy_state.clear()
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    controller = _existing_controller()
+    meta = (
+        ray_tpu.get(controller.get_app_meta.remote(name))
+        if controller is not None
+        else None
+    )
+    if meta is None or not meta.get("ingress"):
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(name, meta["ingress"])
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = DEFAULT_APP_NAME):
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def get_proxy_port() -> Optional[int]:
+    return _proxy_state.get("port")
+
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_proxy_port",
+    "ingress",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

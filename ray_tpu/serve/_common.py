@@ -1,0 +1,68 @@
+"""Shared serve types: deployment config, request object, helpers.
+
+Parity: reference `python/ray/serve/config.py` (DeploymentConfig/AutoscalingConfig,
+pydantic there, dataclasses here) and `python/ray/serve/_private/common.py`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+DEFAULT_APP_NAME = "default"
+
+
+@dataclass
+class AutoscalingConfig:
+    """Parity: reference serve/config.py AutoscalingConfig."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    health_check_period_s: float = 1.0
+    graceful_shutdown_timeout_s: float = 5.0
+    user_config: Optional[dict] = None
+
+
+@dataclass
+class Request:
+    """Minimal HTTP request surface handed to ingress deployments.
+
+    Parity role: the starlette.requests.Request the reference passes
+    (`serve/_private/proxy.py`); plain data here so it pickles through the object
+    store to the replica.
+    """
+
+    method: str = "GET"
+    path: str = "/"
+    query_params: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return self.body.decode()
+
+
+async def async_get(ref, timeout: Optional[float] = None):
+    """Await an ObjectRef from inside an async actor without blocking its loop."""
+    import ray_tpu
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout))

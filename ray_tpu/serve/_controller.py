@@ -1,0 +1,251 @@
+"""ServeController: the serve control plane actor.
+
+Design parity: reference `python/ray/serve/_private/controller.py` (:103) +
+`application_state.py` + `deployment_state.py` — hold the desired state (apps →
+deployments → configs), reconcile replica actors toward it (create missing, kill
+excess, replace dead), serve routing tables to handles, and run the autoscaling
+policy over replica stats (`autoscaling_policy.py`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+import traceback
+from typing import Dict, List, Optional
+
+
+class ServeController:
+    """Async actor. One per cluster, named SERVE_CONTROLLER in the serve namespace."""
+
+    def __init__(self):
+        # app -> deployment -> spec dict (blobs + DeploymentConfig)
+        self._apps: Dict[str, Dict[str, dict]] = {}
+        # app -> deployment -> list of replica ActorHandles
+        self._replicas: Dict[str, Dict[str, list]] = {}
+        self._versions: Dict[str, int] = {}
+        self._loop_started = False
+        self._shutting_down = False
+        # autoscale bookkeeping: (app, dep) -> last scale decision time
+        self._last_scale: Dict[tuple, float] = {}
+
+    # -- deploy / teardown -------------------------------------------------
+    async def deploy_app(self, app: str, deployments: Dict[str, dict],
+                         route_prefix: Optional[str], ingress: str) -> bool:
+        if route_prefix is not None:
+            for other, deps in self._apps.items():
+                if other != app and deps.get("__meta__", {}).get("route_prefix") == route_prefix:
+                    raise ValueError(
+                        f"route_prefix {route_prefix!r} is already used by app "
+                        f"{other!r}; pass a distinct route_prefix (or None for "
+                        f"handle-only access)"
+                    )
+        old = self._apps.get(app, {})
+        live = self._replicas.setdefault(app, {})
+        # Redeploy: replicas built from changed code/args/config are stale — kill
+        # them so reconcile rebuilds from the new blobs (a count-only reconcile
+        # would happily keep serving the old code).
+        for name, spec in deployments.items():
+            if name == "__meta__":
+                continue
+            prev = old.get(name)
+            if prev is not None and (
+                prev["target_blob"] != spec["target_blob"]
+                or prev["init_blob"] != spec["init_blob"]
+                or prev["config"] != spec["config"]
+            ):
+                for r in live.pop(name, []):
+                    self._kill(r)
+                self._bump(app, name)
+        # Deployments dropped from the app entirely.
+        for name in list(old):
+            if name != "__meta__" and name not in deployments:
+                for r in live.pop(name, []):
+                    self._kill(r)
+        self._apps[app] = deployments
+        meta = self._apps[app].setdefault("__meta__", {})
+        meta["route_prefix"] = route_prefix
+        meta["ingress"] = ingress
+        await self._reconcile_app(app)
+        return True
+
+    async def delete_app(self, app: str) -> bool:
+        self._apps.pop(app, None)
+        for replicas in self._replicas.pop(app, {}).values():
+            for r in replicas:
+                self._kill(r)
+        return True
+
+    async def shutdown_serve(self) -> bool:
+        self._shutting_down = True
+        for app in list(self._apps):
+            await self.delete_app(app)
+        return True
+
+    def _kill(self, actor):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    # -- routing tables ----------------------------------------------------
+    async def get_replicas(self, app: str, deployment: str) -> dict:
+        key = f"{app}#{deployment}"
+        return {
+            "version": self._versions.get(key, 0),
+            "replicas": list(self._replicas.get(app, {}).get(deployment, [])),
+        }
+
+    async def get_app_meta(self, app: str) -> Optional[dict]:
+        if app not in self._apps:
+            return None
+        meta = self._apps[app].get("__meta__", {})
+        return {"route_prefix": meta.get("route_prefix"),
+                "ingress": meta.get("ingress")}
+
+    async def list_apps(self) -> dict:
+        out = {}
+        for app, deps in self._apps.items():
+            meta = deps.get("__meta__", {})
+            out[app] = {
+                "route_prefix": meta.get("route_prefix"),
+                "ingress": meta.get("ingress"),
+                "deployments": {
+                    name: {
+                        "num_replicas": len(self._replicas.get(app, {}).get(name, [])),
+                        "target": spec["config"].num_replicas,
+                    }
+                    for name, spec in deps.items()
+                    if name != "__meta__"
+                },
+            }
+        return out
+
+    async def ready(self, app: str) -> bool:
+        """All deployments of the app have their target replica count, and each
+        replica answers ready()."""
+        import ray_tpu
+        from ray_tpu.serve._common import async_get
+
+        deps = self._apps.get(app)
+        if deps is None:
+            return False
+        for name, spec in deps.items():
+            if name == "__meta__":
+                continue
+            want = self._target_replicas(app, name)
+            have = self._replicas.get(app, {}).get(name, [])
+            if len(have) < want:
+                return False
+            try:
+                await async_get([r.ready.remote() for r in have], timeout=30)
+            except Exception:
+                return False
+        return True
+
+    # -- reconciliation ----------------------------------------------------
+    def _target_replicas(self, app: str, name: str) -> int:
+        spec = self._apps[app][name]
+        cfg = spec["config"]
+        if cfg.autoscaling_config is not None:
+            return spec.setdefault("_autoscale_target", cfg.autoscaling_config.min_replicas)
+        return cfg.num_replicas
+
+    async def _reconcile_app(self, app: str):
+        import ray_tpu
+        from ray_tpu.serve._replica import Replica
+
+        deps = self._apps.get(app, {})
+        live = self._replicas.setdefault(app, {})
+        for name, spec in list(deps.items()):
+            if name == "__meta__":
+                continue
+            cfg = spec["config"]
+            replicas = live.setdefault(name, [])
+            # Drop dead replicas (ping failed in the control loop marks them).
+            dead = spec.pop("_dead", [])
+            if dead:
+                keep = []
+                for r in replicas:
+                    if any(r._actor_id == d for d in dead):
+                        self._kill(r)
+                    else:
+                        keep.append(r)
+                live[name] = replicas = keep
+            want = self._target_replicas(app, name)
+            actor_opts = dict(cfg.ray_actor_options or {})
+            actor_opts.setdefault("num_cpus", 0)
+            actor_cls = ray_tpu.remote(**actor_opts)(Replica)
+            while len(replicas) < want:
+                replicas.append(
+                    actor_cls.options(max_concurrency=cfg.max_ongoing_requests).remote(
+                        spec["target_blob"], spec["init_blob"], name, app,
+                        cfg.user_config,
+                    )
+                )
+                self._bump(app, name)
+            while len(replicas) > want:
+                victim = replicas.pop()
+                self._kill(victim)
+                self._bump(app, name)
+
+    def _bump(self, app: str, name: str):
+        key = f"{app}#{name}"
+        self._versions[key] = self._versions.get(key, 0) + 1
+
+    # -- control loop ------------------------------------------------------
+    async def run_control_loop(self):
+        if self._loop_started:
+            return
+        self._loop_started = True
+        while not self._shutting_down:
+            try:
+                await self._step()
+            except Exception:
+                traceback.print_exc()
+            await asyncio.sleep(0.25)
+
+    async def _step(self):
+        from ray_tpu.serve._common import async_get
+
+        for app in list(self._apps):
+            deps = self._apps.get(app, {})
+            for name, spec in list(deps.items()):
+                if name == "__meta__":
+                    continue
+                replicas = self._replicas.get(app, {}).get(name, [])
+                # Health check + stats in one pass.
+                stats = []
+                dead = []
+                for r in replicas:
+                    try:
+                        stats.append(await async_get(r.get_stats.remote(), timeout=5))
+                    except Exception:
+                        dead.append(r._actor_id)
+                if dead:
+                    spec["_dead"] = dead
+                cfg = spec["config"]
+                if cfg.autoscaling_config is not None and stats:
+                    self._autoscale(app, name, spec, stats)
+            await self._reconcile_app(app)
+
+    def _autoscale(self, app: str, name: str, spec: dict, stats: List[dict]):
+        cfg = spec["config"].autoscaling_config
+        total_ongoing = sum(s["ongoing"] for s in stats)
+        current = spec.get("_autoscale_target", cfg.min_replicas)
+        desired = max(
+            cfg.min_replicas,
+            min(cfg.max_replicas, math.ceil(total_ongoing / cfg.target_ongoing_requests)),
+        )
+        now = time.monotonic()
+        key = (app, name)
+        last = self._last_scale.get(key, 0.0)
+        if desired > current and now - last >= cfg.upscale_delay_s:
+            spec["_autoscale_target"] = desired
+            self._last_scale[key] = now
+        elif desired < current and now - last >= cfg.downscale_delay_s:
+            spec["_autoscale_target"] = current - 1  # scale down gently
+            self._last_scale[key] = now

@@ -1,0 +1,137 @@
+"""HTTP proxy: routes HTTP requests to application ingress deployments.
+
+Design parity: reference `python/ray/serve/_private/proxy.py` (HTTPProxy :706 behind
+uvicorn) — here a dependency-free asyncio HTTP/1.1 server inside an async actor. Routing
+matches the longest route_prefix; the body is handed to the ingress deployment as a
+`Request`; str/bytes/dict returns map to text/JSON responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import traceback
+from typing import Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE, Request
+
+
+class HTTPProxy:
+    """Async actor: one per serve instance (head node)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes: Dict[str, str] = {}  # route_prefix -> app name
+        self._handles: Dict[str, object] = {}
+
+    async def start(self) -> int:
+        if self._server is not None:
+            # Idempotent: a second driver's serve.start() reaches the existing
+            # proxy actor via get_if_exists; re-binding would EADDRINUSE.
+            return self._port
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        asyncio.get_running_loop().create_task(self._route_refresh_loop())
+        return self._port
+
+    async def _route_refresh_loop(self):
+        import ray_tpu
+        from ray_tpu.serve._common import async_get
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        while True:
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+                apps = await async_get(controller.list_apps.remote())
+                routes = {}
+                for app, meta in apps.items():
+                    if meta.get("ingress") and meta.get("route_prefix") is not None:
+                        routes[meta["route_prefix"]] = app
+                        if app not in self._handles:
+                            self._handles[app] = DeploymentHandle(app, meta["ingress"])
+                self._routes = routes
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            request = await self._read_request(reader)
+            if request is None:
+                writer.close()
+                return
+            status, body, ctype = await self._dispatch(request)
+        except Exception:
+            status, body, ctype = 500, traceback.format_exc().encode(), "text/plain"
+        try:
+            writer.write(
+                b"HTTP/1.1 %d %s\r\n" % (status, {200: b"OK", 404: b"Not Found",
+                                                  500: b"Internal Server Error"}.get(
+                                                      status, b"OK"))
+                + b"Content-Type: %s\r\n" % ctype.encode()
+                + b"Content-Length: %d\r\n" % len(body)
+                + b"Connection: close\r\n\r\n"
+                + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Request]:
+        line = await reader.readline()
+        if not line:
+            return None
+        method, target, _version = line.decode().split(" ", 2)
+        headers: Dict[str, str] = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = hline.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        length = int(headers.get("content-length", "0") or 0)
+        if length:
+            body = await reader.readexactly(length)
+        split = urlsplit(target)
+        return Request(
+            method=method.upper(),
+            path=split.path,
+            query_params=dict(parse_qsl(split.query)),
+            headers=headers,
+            body=body,
+        )
+
+    async def _dispatch(self, request: Request):
+        # Longest matching route prefix wins.
+        match = None
+        for prefix in sorted(self._routes, key=len, reverse=True):
+            if request.path == prefix or request.path.startswith(
+                prefix.rstrip("/") + "/"
+            ) or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            return 404, b"no application mounted", "text/plain"
+        app = self._routes[match]
+        handle = self._handles[app]
+        response = handle.remote(request)
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(None, lambda: response.result(timeout_s=60))
+        if isinstance(result, bytes):
+            return 200, result, "application/octet-stream"
+        if isinstance(result, str):
+            return 200, result.encode(), "text/plain"
+        return 200, json.dumps(result, default=str).encode(), "application/json"
+
+    async def get_port(self) -> int:
+        return self._port
+
+    async def ready(self) -> bool:
+        return self._server is not None

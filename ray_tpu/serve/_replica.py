@@ -1,0 +1,113 @@
+"""Replica: the actor that runs user deployment code.
+
+Design parity: reference `python/ray/serve/_private/replica.py` (`Replica` :1041,
+`UserCallableWrapper` :1333) — wraps the user class/function, counts ongoing requests
+for the router's load metric and the autoscaler, supports sync and async callables and
+method dispatch, reconstructs nested deployment handles for composition.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import traceback
+from typing import Any
+
+
+async def _await_it(awaitable):
+    return await awaitable
+
+
+class Replica:
+    """Async actor: one replica of one deployment."""
+
+    def __init__(self, cls_or_fn_blob: bytes, init_args_blob: bytes, deployment: str,
+                 app: str, user_config=None):
+        import cloudpickle
+
+        target = cloudpickle.loads(cls_or_fn_blob)
+        init_args, init_kwargs = cloudpickle.loads(init_args_blob)
+        self._deployment = deployment
+        self._app = app
+        self._ongoing = 0
+        self._total = 0
+        if inspect.isclass(target):
+            self._instance = target(*init_args, **init_kwargs)
+        else:
+            # Function deployment: calls dispatch to the function itself.
+            self._instance = target
+        if user_config is not None and hasattr(self._instance, "reconfigure"):
+            out = self._instance.reconfigure(user_config)
+            if inspect.isawaitable(out):
+                # __init__ runs off the actor's event loop, so a private loop here
+                # is safe — and required, or an async reconfigure would silently
+                # never run and the initial user_config would be dropped.
+                asyncio.run(_await_it(out))
+
+    async def reconfigure(self, user_config):
+        out = self._instance.reconfigure(user_config)
+        if inspect.isawaitable(out):
+            await out
+        return True
+
+    async def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        import ray_tpu
+
+        self._ongoing += 1
+        self._total += 1
+        try:
+            # Chained DeploymentResponses arrive as ObjectRefs nested inside the
+            # args tuple (not top-level task args), so resolve them here — off the
+            # event loop, since get() blocks.
+            if any(isinstance(a, ray_tpu.ObjectRef) for a in args) or any(
+                isinstance(v, ray_tpu.ObjectRef) for v in kwargs.values()
+            ):
+                loop = asyncio.get_running_loop()
+                args, kwargs = await loop.run_in_executor(
+                    None,
+                    lambda: (
+                        tuple(
+                            ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a
+                            for a in args
+                        ),
+                        {
+                            k: ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef) else v
+                            for k, v in kwargs.items()
+                        },
+                    ),
+                )
+            if callable(self._instance) and method_name == "__call__":
+                fn = self._instance
+            else:
+                fn = getattr(self._instance, method_name)
+            if inspect.iscoroutinefunction(fn) or (
+                not inspect.isfunction(fn) and not inspect.ismethod(fn)
+                and inspect.iscoroutinefunction(getattr(fn, "__call__", None))
+            ):
+                out = await fn(*args, **kwargs)
+            else:
+                # Sync callables run off-loop: a blocking handler must not freeze
+                # the replica's event loop (that would serialize all requests and
+                # zero out the concurrency the router/autoscaler observe).
+                loop = asyncio.get_running_loop()
+                out = await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isgenerator(out):
+                # Non-streaming v1: generators are materialized. (Reference streams
+                # them over the handle; see serve/_private/replica.py generator path.)
+                out = list(out)
+            elif inspect.isasyncgen(out):
+                items = []
+                async for item in out:
+                    items.append(item)
+                out = items
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def get_stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    async def ready(self) -> bool:
+        return True

@@ -1,0 +1,110 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Design parity: reference `python/ray/serve/batching.py` — an async decorator that
+queues individual calls and invokes the wrapped function with a list once
+`max_batch_size` items are buffered or `batch_timeout_s` elapses; each caller gets its
+own element of the returned list. TPU relevance: batched model calls are how replicas
+keep the MXU fed — single-request inference wastes the systolic array.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, batch_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_timeout_s
+        self._queue: List = []  # (item, future)
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+
+    def _ensure_loop_state(self):
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._batch_loop())
+
+    async def submit(self, self_arg, item) -> Any:
+        self._ensure_loop_state()
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append((self_arg, item, fut))
+        self._wake.set()
+        return await fut
+
+    async def _batch_loop(self):
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            if not self._queue:
+                continue
+            # Wait out the batching window unless the batch is already full.
+            if len(self._queue) < self._max:
+                try:
+                    await asyncio.wait_for(self._full(), timeout=self._timeout)
+                except asyncio.TimeoutError:
+                    pass
+            batch, self._queue = self._queue[: self._max], self._queue[self._max :]
+            if not batch:
+                continue
+            self_arg = batch[0][0]
+            items = [b[1] for b in batch]
+            futs = [b[2] for b in batch]
+            try:
+                if self_arg is not None:
+                    results = await self._fn(self_arg, items)
+                else:
+                    results = await self._fn(items)
+                if not isinstance(results, list) or len(results) != len(items):
+                    raise TypeError(
+                        f"@serve.batch function must return a list of {len(items)} "
+                        f"results, got {type(results).__name__}"
+                    )
+                for fut, res in zip(futs, results):
+                    if not fut.done():
+                        fut.set_result(res)
+            except BaseException as e:
+                for fut in futs:
+                    if not fut.done():
+                        fut.set_exception(e)
+            if self._queue:
+                self._wake.set()
+
+    async def _full(self):
+        while len(self._queue) < self._max:
+            await asyncio.sleep(self._timeout / 10 or 0.001)
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 10,
+    batch_timeout_s: float = 0.01,
+):
+    """Decorator: async fn(self, items: list) -> list, called per item."""
+
+    def wrap(fn: Callable):
+        queue_holder: dict = {}
+
+        @functools.wraps(fn)
+        async def inner(*args):
+            # Supports both bound methods (self, item) and free functions (item).
+            if len(args) == 2:
+                self_arg, item = args
+            else:
+                (item,) = args
+                self_arg = None
+            q = queue_holder.get("q")
+            if q is None:
+                q = queue_holder["q"] = _BatchQueue(fn, max_batch_size, batch_timeout_s)
+            return await q.submit(self_arg, item)
+
+        return inner
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,160 @@
+"""DeploymentHandle: the client-side router for calling deployments.
+
+Design parity: reference `python/ray/serve/handle.py` (DeploymentHandle.remote :692 →
+DeploymentResponse) and `_private/router.py` (:470 AsyncioRouter) with the default
+power-of-two-choices replica scheduler (`_private/request_router/pow_2_router.py`):
+pick two random replicas, send to the one with fewer locally-tracked in-flight
+requests. Handles are picklable (app+deployment names) so deployments can call each
+other — model composition.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE
+
+
+class DeploymentResponse:
+    """A future for one deployment request. Parity: serve.handle.DeploymentResponse."""
+
+    def __init__(self, ref: "ray_tpu.ObjectRef"):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, lambda: ray_tpu.get(self._ref))
+        return fut.__await__()
+
+    @property
+    def object_ref(self) -> "ray_tpu.ObjectRef":
+        return self._ref
+
+
+class _Router:
+    """Replica set cache + power-of-two-choices pick. One per handle per process."""
+
+    _CACHE_TTL_S = 2.0
+
+    def __init__(self, app: str, deployment: str):
+        self._app = app
+        self._deployment = deployment
+        self._replicas: List = []
+        self._version = -1
+        self._fetched_at = 0.0
+        self._inflight: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and self._replicas and now - self._fetched_at < self._CACHE_TTL_S:
+            return
+        info = ray_tpu.get(
+            self._controller().get_replicas.remote(self._app, self._deployment)
+        )
+        with self._lock:
+            self._version = info["version"]
+            self._replicas = info["replicas"]
+            self._fetched_at = now
+            self._inflight = {
+                a._actor_id: self._inflight.get(a._actor_id, 0) for a in self._replicas
+            }
+
+    def pick(self):
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self._app}#{self._deployment}"
+                )
+            time.sleep(0.05)
+            self._refresh(force=True)
+        with self._lock:
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            pick = a if self._inflight.get(a._actor_id, 0) <= self._inflight.get(
+                b._actor_id, 0
+            ) else b
+            self._inflight[pick._actor_id] = self._inflight.get(pick._actor_id, 0) + 1
+            return pick
+
+    def done(self, replica):
+        with self._lock:
+            if replica._actor_id in self._inflight:
+                self._inflight[replica._actor_id] = max(
+                    0, self._inflight[replica._actor_id] - 1
+                )
+
+    def evict(self):
+        with self._lock:
+            self._replicas = []
+            self._fetched_at = 0.0
+
+
+class DeploymentHandle:
+    def __init__(self, app: str, deployment: str, method_name: str = "__call__"):
+        self._app = app
+        self._deployment = deployment
+        self._method_name = method_name
+        self._router: Optional[_Router] = None
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app, self._deployment, self._method_name))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._app, self._deployment, name)
+
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._app, self._deployment, method_name or self._method_name
+        )
+
+    def _get_router(self) -> _Router:
+        if self._router is None:
+            self._router = _Router(self._app, self._deployment)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # Deployment responses compose: pass the underlying refs so the runtime
+        # resolves them as task dependencies (no blocking round-trip here).
+        args = tuple(
+            a.object_ref if isinstance(a, DeploymentResponse) else a for a in args
+        )
+        kwargs = {
+            k: (v.object_ref if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        router = self._get_router()
+        last_err: Optional[Exception] = None
+        for _attempt in range(3):
+            replica = router.pick()
+            try:
+                ref = replica.handle_request.remote(self._method_name, args, kwargs)
+                # In-flight bookkeeping: decremented when the result resolves.
+                ray_tpu.global_worker().memory_store.add_done_callback(
+                    ref.id, lambda *_a, _r=replica: router.done(_r)
+                ) or router.done(replica)
+                return DeploymentResponse(ref)
+            except ray_tpu.exceptions.ActorDiedError as e:  # replica gone: refresh
+                last_err = e
+                router.evict()
+        raise last_err
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._app}#{self._deployment}.{self._method_name})"
