@@ -1,0 +1,35 @@
+"""ray_tpu.rllib: reinforcement learning on the distributed runtime.
+
+Parity: reference `rllib/` new API stack — AlgorithmConfig builders, Algorithm.train(),
+EnvRunnerGroup of CPU sampling actors, flax RLModule, jitted Learner/LearnerGroup
+(pjit data-parallel on a TPU mesh), PPO.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    Columns,
+    DefaultActorCriticModule,
+    RLModule,
+    build_default_module,
+)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "Columns",
+    "DefaultActorCriticModule",
+    "EnvRunnerGroup",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "SingleAgentEnvRunner",
+    "build_default_module",
+    "compute_gae",
+]

@@ -1,0 +1,172 @@
+"""Algorithm: the top-level trainable driving sample → learn → sync.
+
+Design parity: reference `rllib/algorithms/algorithm.py` (`step()` :1007,
+`training_step()` :2072, save/restore via the Checkpointable mixin) — an Algorithm
+owns an EnvRunnerGroup and a LearnerGroup, and `train()` runs one iteration returning
+a metrics dict. Also a Tune trainable: tune.Tuner(PPO, param_space={...}) works via
+the function-trainable adapter in `compat_tune()`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import build_default_module
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+
+class Algorithm:
+    def __init__(self, config):
+        import cloudpickle
+
+        self.config = config
+        self.iteration = 0
+        self._total_timesteps = 0
+        env_fn = config.env_creator()
+        probe = env_fn()
+        self._module = build_default_module(
+            probe.observation_space, probe.action_space,
+            hiddens=tuple(config.model.get("hiddens", (64, 64))),
+        )
+        probe.close()
+        module_blob = cloudpickle.dumps(self._module)
+        self.env_runner_group = EnvRunnerGroup(
+            cloudpickle.dumps(env_fn), module_blob,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            seed=config.seed,
+        )
+        self.learner_group = LearnerGroup(
+            module_blob, cloudpickle.dumps(self.loss_fn()),
+            num_learners=config.num_learners, lr=config.lr,
+            grad_clip=config.grad_clip, seed=config.seed or 0,
+            learner_resources=config.learner_resources,
+            use_mesh=config.use_mesh,
+        )
+        self._ret_history: list = []
+
+    # -- SPI ---------------------------------------------------------------
+    def loss_fn(self):
+        """Return a pure fn(module, params, batch) -> (loss, metrics-dict)."""
+        raise NotImplementedError
+
+    def postprocess(self, batch_fragments: list) -> Dict[str, np.ndarray]:
+        """Turn raw runner fragments into one training batch (e.g. GAE)."""
+        raise NotImplementedError
+
+    # -- train loop --------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        self.iteration += 1
+        self.env_runner_group.sync_weights(self.learner_group.get_params())
+        per_runner = max(
+            1, self.config.train_batch_size // max(1, len(self.env_runner_group))
+        )
+        runner_batches = self.env_runner_group.sample(per_runner)
+        returns = np.concatenate(
+            [b.get("episode_returns", np.zeros(0)) for b in runner_batches]
+        ) if runner_batches else np.zeros(0)
+        lens = np.concatenate(
+            [b.get("episode_lens", np.zeros(0)) for b in runner_batches]
+        ) if runner_batches else np.zeros(0)
+        fragments = [f for b in runner_batches for f in b["fragments"]]
+        if not fragments:
+            # Every runner failed this round (they've been replaced); skip the
+            # update rather than crash — weights re-sync next iteration.
+            return {
+                "training_iteration": self.iteration,
+                "num_env_steps_sampled_lifetime": self._total_timesteps,
+                "episode_return_mean": (
+                    float(np.mean(self._ret_history)) if self._ret_history
+                    else float("nan")
+                ),
+                "episode_len_mean": float("nan"),
+                "episodes_this_iter": 0,
+                "time_this_iter_s": time.time() - t0,
+            }
+        batch = self.postprocess(fragments)
+        n = len(batch["obs"])
+        self._total_timesteps += n
+        # Minibatch epochs.
+        rng = np.random.default_rng(self.iteration)
+        learner_metrics: Dict[str, float] = {}
+        mb = min(self.config.minibatch_size, n)
+        for _epoch in range(self.config.num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start : start + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                learner_metrics = self.learner_group.update(minibatch)
+        if len(returns):
+            self._ret_history.extend(returns.tolist())
+            self._ret_history = self._ret_history[-100:]
+        metrics = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_timesteps,
+            "episode_return_mean": (
+                float(np.mean(self._ret_history)) if self._ret_history else float("nan")
+            ),
+            "episode_len_mean": float(np.mean(lens)) if len(lens) else float("nan"),
+            "episodes_this_iter": int(len(returns)),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+        return metrics
+
+    # -- checkpointing (Checkpointable parity) ------------------------------
+    def save_to_path(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "params": self.learner_group.get_params(),
+            "iteration": self.iteration,
+            "total_timesteps": self._total_timesteps,
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_params(state["params"])
+        self.iteration = state["iteration"]
+        self._total_timesteps = state["total_timesteps"]
+
+    def get_weights(self):
+        return self.learner_group.get_params()
+
+    def set_weights(self, params):
+        self.learner_group.set_params(params)
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    # -- tune integration --------------------------------------------------
+    @classmethod
+    def as_trainable(cls, base_config):
+        """A Tune function-trainable: per-trial config keys override the base
+        config's attributes (reference: Algorithm IS a Tune trainable)."""
+
+        def trainable(trial_config: dict):
+            import ray_tpu.tune as tune
+
+            cfg = base_config.copy()
+            for k, v in trial_config.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cls(cfg)
+            try:
+                stop_iters = trial_config.get("_stop_iters", 10)
+                for _ in range(stop_iters):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -1,0 +1,125 @@
+"""AlgorithmConfig: the fluent builder configuring an Algorithm.
+
+Design parity: reference `rllib/algorithms/algorithm_config.py` — chained
+.environment()/.env_runners()/.training()/.learners()/.debugging() sections,
+`.build_algo()` constructing the Algorithm.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Sequence, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type] = None):
+        self._algo_class = algo_class
+        # environment
+        self.env: Any = None
+        self.env_config: Dict = {}
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        # training
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 400
+        self.minibatch_size: int = 128
+        self.num_epochs: int = 4
+        self.grad_clip: Optional[float] = None
+        self.model: Dict = {"hiddens": (64, 64)}
+        # learners
+        self.num_learners: int = 0
+        self.use_mesh: bool = False
+        self.learner_resources: Optional[dict] = None
+        # debugging
+        self.seed: Optional[int] = None
+        # algo-specific extras live as attributes set by subclasses
+        self.extra: Dict[str, Any] = {}
+
+    # -- sections ----------------------------------------------------------
+    def environment(self, env=None, *, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 minibatch_size: Optional[int] = None,
+                 num_epochs: Optional[int] = None,
+                 grad_clip: Optional[float] = None,
+                 model: Optional[dict] = None, **algo_specific):
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if minibatch_size is not None:
+            self.minibatch_size = minibatch_size
+        if num_epochs is not None:
+            self.num_epochs = num_epochs
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        if model is not None:
+            self.model = model
+        for k, v in algo_specific.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r} for {type(self).__name__}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 use_mesh: Optional[bool] = None,
+                 learner_resources: Optional[dict] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if use_mesh is not None:
+            self.use_mesh = use_mesh
+        if learner_resources is not None:
+            self.learner_resources = learner_resources
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    # -- build -------------------------------------------------------------
+    def build_algo(self):
+        if self._algo_class is None:
+            raise ValueError("config has no algorithm class; use PPOConfig() etc.")
+        return self._algo_class(self.copy())
+
+    build = build_algo  # legacy alias, parity with the reference
+
+    def env_creator(self) -> Callable:
+        env, env_config = self.env, dict(self.env_config)
+        if callable(env):
+            return lambda: env(env_config)
+        if isinstance(env, str):
+
+            def make():
+                import gymnasium as gym
+
+                return gym.make(env, **env_config)
+
+            return make
+        raise ValueError(f"env must be a gym id or callable, got {type(env)}")

@@ -1,0 +1,108 @@
+"""PPO: proximal policy optimization on the new-stack SPI.
+
+Design parity: reference `rllib/algorithms/ppo/ppo.py` (`training_step` :389; config
+defaults `ppo.py` PPOConfig) + `ppo/torch/ppo_torch_learner.py` loss — clipped
+surrogate + clipped value loss + entropy bonus, GAE(lambda) advantages computed over
+episode fragments with bootstrap values. The loss is a pure jax fn jitted inside the
+Learner (TPU path), while sampling runs on CPU env-runner actors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_clip_param: float = 10.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.kl_coeff: float = 0.0  # simplified: no adaptive-KL loop
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 30
+
+
+def compute_gae(rewards: np.ndarray, vf_preds: np.ndarray, bootstrap: float,
+                gamma: float, lam: float) -> tuple:
+    """GAE(lambda) over one episode fragment. Parity: rllib postprocessing
+    (`rllib/evaluation/postprocessing.py` compute_advantages)."""
+    n = len(rewards)
+    values = np.append(vf_preds, bootstrap)
+    adv = np.zeros(n, np.float32)
+    last = 0.0
+    for t in range(n - 1, -1, -1):
+        delta = rewards[t] + gamma * values[t + 1] - values[t]
+        last = delta + gamma * lam * last
+        adv[t] = last
+    return adv, adv + vf_preds
+
+
+def _ppo_loss_factory(clip_param, vf_clip_param, vf_loss_coeff, entropy_coeff):
+    def ppo_loss(module, params, batch):
+        import jax.numpy as jnp
+
+        out = module.forward_train(params, batch)
+        dist_in = out[Columns.ACTION_DIST_INPUTS]
+        logp = module.dist_logp(dist_in, batch[Columns.ACTIONS])
+        ratio = jnp.exp(logp - batch[Columns.ACTION_LOGP])
+        adv = batch[Columns.ADVANTAGES]
+        surrogate = jnp.minimum(
+            adv * ratio,
+            adv * jnp.clip(ratio, 1 - clip_param, 1 + clip_param),
+        )
+        policy_loss = -jnp.mean(surrogate)
+        vf = out[Columns.VF_PREDS]
+        vf_err = jnp.square(vf - batch[Columns.VALUE_TARGETS])
+        vf_loss = jnp.mean(jnp.clip(vf_err, 0, vf_clip_param))
+        entropy = jnp.mean(module.dist_entropy(dist_in))
+        total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": jnp.mean(batch[Columns.ACTION_LOGP] - logp),
+        }
+
+    return ppo_loss
+
+
+class PPO(Algorithm):
+    def loss_fn(self):
+        c = self.config
+        return _ppo_loss_factory(
+            c.clip_param, c.vf_clip_param, c.vf_loss_coeff, c.entropy_coeff
+        )
+
+    def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
+        c = self.config
+        cols: Dict[str, list] = {
+            Columns.OBS: [], Columns.ACTIONS: [], Columns.ACTION_LOGP: [],
+            Columns.ADVANTAGES: [], Columns.VALUE_TARGETS: [],
+        }
+        for frag in fragments:
+            adv, targets = compute_gae(
+                frag[Columns.REWARDS], frag[Columns.VF_PREDS],
+                float(frag["bootstrap_value"]), c.gamma, c.lambda_,
+            )
+            cols[Columns.OBS].append(frag[Columns.OBS])
+            cols[Columns.ACTIONS].append(frag[Columns.ACTIONS])
+            cols[Columns.ACTION_LOGP].append(frag[Columns.ACTION_LOGP])
+            cols[Columns.ADVANTAGES].append(adv)
+            cols[Columns.VALUE_TARGETS].append(targets)
+        batch = {k: np.concatenate(v).astype(np.float32) if k != Columns.ACTIONS
+                 else np.concatenate(v) for k, v in cols.items()}
+        # Advantage standardization (reference default).
+        adv = batch[Columns.ADVANTAGES]
+        batch[Columns.ADVANTAGES] = (adv - adv.mean()) / max(1e-6, adv.std())
+        return batch

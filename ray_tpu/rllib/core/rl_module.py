@@ -1,0 +1,145 @@
+"""RLModule: the model abstraction, implemented in flax.
+
+Design parity: reference `rllib/core/rl_module/rl_module.py:256` (RLModule with
+forward_inference / forward_exploration / forward_train over batch dicts) — rebuilt on
+flax.linen. TPU-first: all forwards are pure functions of (params, batch) so they jit
+cleanly, shard over a mesh via pjit in the Learner, and run as cheap host numpy calls
+in CPU env runners from the same parameter pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+Columns = type("Columns", (), {
+    "OBS": "obs",
+    "ACTIONS": "actions",
+    "REWARDS": "rewards",
+    "TERMINATEDS": "terminateds",
+    "TRUNCATEDS": "truncateds",
+    "ACTION_LOGP": "action_logp",
+    "ACTION_DIST_INPUTS": "action_dist_inputs",
+    "VF_PREDS": "vf_preds",
+    "ADVANTAGES": "advantages",
+    "VALUE_TARGETS": "value_targets",
+})
+
+
+class RLModule:
+    """SPI: build params, and three pure forwards over batch dicts."""
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_inference(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Greedy/inference outputs: at minimum ACTION_DIST_INPUTS."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return self.forward_inference(params, batch)
+
+    def forward_train(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return self.forward_inference(params, batch)
+
+
+class DefaultActorCriticModule(RLModule):
+    """MLP actor-critic for discrete or continuous (diag-gaussian) action spaces.
+
+    Parity role: the default MLP RLModule the reference builds from catalog defaults
+    (`rllib/core/rl_module/default_model_config.py`).
+    """
+
+    def __init__(
+        self,
+        obs_dim: int,
+        action_dim: int,
+        *,
+        discrete: bool = True,
+        hiddens: Sequence[int] = (64, 64),
+    ):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.discrete = discrete
+        out_dim = action_dim if discrete else 2 * action_dim
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = obs.astype(jnp.float32)
+                v = x
+                for h in hiddens:
+                    x = nn.tanh(nn.Dense(h)(x))
+                logits = nn.Dense(out_dim, kernel_init=nn.initializers.orthogonal(0.01))(x)
+                for h in hiddens:
+                    v = nn.tanh(nn.Dense(h)(v))
+                value = nn.Dense(1)(v)
+                return logits, value[..., 0]
+
+        self._net = _Net()
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, self.obs_dim), jnp.float32)
+        return self._net.init(rng, dummy)
+
+    def forward_inference(self, params, batch):
+        logits, value = self._net.apply(params, batch[Columns.OBS])
+        return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: value}
+
+    # -- distribution helpers (jax-traceable) ------------------------------
+    def dist_sample(self, dist_inputs, rng):
+        import jax
+
+        if self.discrete:
+            return jax.random.categorical(rng, dist_inputs)
+        mean, log_std = self._split(dist_inputs)
+        return mean + jax.numpy.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    def dist_logp(self, dist_inputs, actions):
+        import jax
+        import jax.numpy as jnp
+
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(dist_inputs)
+            return jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+        mean, log_std = self._split(dist_inputs)
+        var = jnp.exp(2 * log_std)
+        return (
+            -0.5 * jnp.sum((actions - mean) ** 2 / var, axis=-1)
+            - jnp.sum(log_std, axis=-1)
+            - 0.5 * mean.shape[-1] * jnp.log(2 * jnp.pi)
+        )
+
+    def dist_entropy(self, dist_inputs):
+        import jax
+        import jax.numpy as jnp
+
+        if self.discrete:
+            logp = jax.nn.log_softmax(dist_inputs)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        _mean, log_std = self._split(dist_inputs)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def _split(dist_inputs):
+        d = dist_inputs.shape[-1] // 2
+        return dist_inputs[..., :d], dist_inputs[..., d:]
+
+
+def build_default_module(observation_space, action_space, hiddens=(64, 64)):
+    import gymnasium as gym
+
+    obs_dim = int(np.prod(observation_space.shape))
+    if isinstance(action_space, gym.spaces.Discrete):
+        return DefaultActorCriticModule(obs_dim, int(action_space.n), discrete=True,
+                                        hiddens=hiddens)
+    action_dim = int(np.prod(action_space.shape))
+    return DefaultActorCriticModule(obs_dim, action_dim, discrete=False, hiddens=hiddens)

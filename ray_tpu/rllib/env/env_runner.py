@@ -1,0 +1,199 @@
+"""EnvRunner: actor that samples episodes with the current policy.
+
+Design parity: reference `rllib/env/single_agent_env_runner.py:68` — gymnasium vector
+env + RLModule inference + episode bookkeeping; `sample(num_timesteps)` returns
+completed+truncated episode fragments as column batches. Policy weights arrive via
+`set_weights` broadcast from the Algorithm (object-store ref, the reference's path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+class _DuckEnvAdapter:
+    """Wrap a duck-typed env (reset/step/spaces but no gym.Env base) so gymnasium's
+    vector wrappers accept it."""
+
+    def __new__(cls, inner):
+        import gymnasium as gym
+
+        class _Adapted(gym.Env):
+            metadata = {"render_modes": []}
+
+            def __init__(self):
+                self._inner = inner
+                self.observation_space = inner.observation_space
+                self.action_space = inner.action_space
+
+            def reset(self, *, seed=None, options=None):
+                super().reset(seed=seed)
+                return self._inner.reset(seed=seed, options=options)
+
+            def step(self, action):
+                return self._inner.step(action)
+
+            def close(self):
+                return self._inner.close()
+
+        return _Adapted()
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_spec, module_blob: bytes, num_envs: int = 1,
+                 seed: Optional[int] = None, worker_index: int = 0):
+        import os
+
+        # Env runners are CPU samplers by design (the learner owns the TPU — same
+        # division as the reference's CPU rollout workers vs GPU learners). Forcing
+        # the CPU backend here keeps N runner processes from fighting over chips and
+        # avoids per-step device-dispatch latency. Must happen before jax's backend
+        # initializes in this fresh worker process.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        env_fn = cloudpickle.loads(env_spec)
+
+        def make_env():
+            e = env_fn()
+            if not isinstance(e, gym.Env):
+                e = _DuckEnvAdapter(e)
+            return e
+
+        self._envs = gym.vector.SyncVectorEnv(
+            [make_env for _ in range(num_envs)]
+        )
+        self._num_envs = num_envs
+        self._module = cloudpickle.loads(module_blob)
+        self._params = None
+        self._rng = jax.random.PRNGKey(
+            (seed if seed is not None else 0) * 10007 + worker_index
+        )
+        self._obs, _ = self._envs.reset(
+            seed=None if seed is None else seed + worker_index
+        )
+        # gymnasium >=1.0 next-step autoreset: the step after a termination ignores
+        # the action and returns (reset_obs, 0, False, False) — that transition is
+        # bookkeeping, not experience, and must not be recorded.
+        self._pending_reset = np.zeros(num_envs, dtype=bool)
+        # per-env running episode buffers
+        self._episodes: List[Dict[str, list]] = [self._new_ep() for _ in range(num_envs)]
+        self._ep_returns: List[float] = []
+        self._ep_lens: List[int] = []
+        self._jit_step = None
+
+    @staticmethod
+    def _new_ep() -> Dict[str, list]:
+        return {Columns.OBS: [], Columns.ACTIONS: [], Columns.REWARDS: [],
+                Columns.ACTION_LOGP: [], Columns.VF_PREDS: []}
+
+    def set_weights(self, params):
+        self._params = params
+
+    def get_weights(self):
+        return self._params
+
+    def _policy_step(self, params, obs, rng):
+        import jax
+
+        if self._jit_step is None:
+            module = self._module
+
+            def step(params, obs, rng):
+                out = module.forward_exploration(params, {Columns.OBS: obs})
+                dist_in = out[Columns.ACTION_DIST_INPUTS]
+                action = module.dist_sample(dist_in, rng)
+                logp = module.dist_logp(dist_in, action)
+                return action, logp, out[Columns.VF_PREDS]
+
+            self._jit_step = jax.jit(step)
+        return self._jit_step(params, obs, rng)
+
+    def sample(self, num_timesteps: int) -> Dict[str, Any]:
+        """Roll the vector env for ~num_timesteps; return concatenated episode
+        fragments with bootstrap values, ready for GAE."""
+        import jax
+
+        assert self._params is not None, "set_weights() before sample()"
+        frags: List[Dict[str, np.ndarray]] = []
+        steps = 0
+        while steps < num_timesteps:
+            self._rng, sub = jax.random.split(self._rng)
+            action, logp, vf = self._policy_step(self._params, self._obs, sub)
+            action = np.asarray(action)
+            logp = np.asarray(logp)
+            vf = np.asarray(vf)
+            next_obs, rewards, terms, truncs, _infos = self._envs.step(action)
+            for i in range(self._num_envs):
+                if self._pending_reset[i]:
+                    # Autoreset step: next_obs[i] is the fresh episode's first obs.
+                    self._pending_reset[i] = False
+                    continue
+                ep = self._episodes[i]
+                ep[Columns.OBS].append(self._obs[i])
+                ep[Columns.ACTIONS].append(action[i])
+                ep[Columns.REWARDS].append(float(rewards[i]))
+                ep[Columns.ACTION_LOGP].append(float(logp[i]))
+                ep[Columns.VF_PREDS].append(float(vf[i]))
+                if terms[i] or truncs[i]:
+                    frags.append(self._finish_ep(i, terminated=bool(terms[i]),
+                                                 next_obs=next_obs[i], env_done=True))
+                    self._pending_reset[i] = True
+            self._obs = next_obs
+            steps += self._num_envs
+        # Flush in-progress episodes as truncated fragments (bootstrap with vf).
+        for i in range(self._num_envs):
+            if self._episodes[i][Columns.OBS]:
+                frags.append(self._finish_ep(i, terminated=False, next_obs=self._obs[i],
+                                             env_done=False))
+        batch = self._concat(frags)
+        batch["episode_returns"] = np.array(self._ep_returns, np.float32)
+        batch["episode_lens"] = np.array(self._ep_lens, np.float32)
+        self._ep_returns, self._ep_lens = [], []
+        return batch
+
+    def _finish_ep(self, i: int, terminated: bool, next_obs,
+                   env_done: bool = True) -> Dict[str, np.ndarray]:
+        import jax
+
+        ep = self._episodes[i]
+        n = len(ep[Columns.OBS])
+        if terminated:
+            bootstrap = 0.0
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            _a, _lp, vf = self._policy_step(
+                self._params, np.asarray(next_obs)[None, :], sub
+            )
+            bootstrap = float(np.asarray(vf)[0])
+        out = {
+            Columns.OBS: np.asarray(ep[Columns.OBS], np.float32),
+            Columns.ACTIONS: np.asarray(ep[Columns.ACTIONS]),
+            Columns.REWARDS: np.asarray(ep[Columns.REWARDS], np.float32),
+            Columns.ACTION_LOGP: np.asarray(ep[Columns.ACTION_LOGP], np.float32),
+            Columns.VF_PREDS: np.asarray(ep[Columns.VF_PREDS], np.float32),
+            "bootstrap_value": np.float32(bootstrap),
+            "terminated": terminated,
+        }
+        if env_done:
+            # Episode metrics count episodes the ENV ended (terminated OR truncated,
+            # e.g. TimeLimit); mid-sample flushes feed the learner but not the stats.
+            self._ep_returns.append(float(out[Columns.REWARDS].sum()))
+            self._ep_lens.append(float(n))
+        self._episodes[i] = self._new_ep()
+        return out
+
+    @staticmethod
+    def _concat(frags: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
+        return {"fragments": frags}
+
+    def ping(self) -> bool:
+        return True

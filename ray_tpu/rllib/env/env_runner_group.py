@@ -1,0 +1,61 @@
+"""EnvRunnerGroup: fan-out sampling across env-runner actors.
+
+Design parity: reference `rllib/env/env_runner_group.py:69` — owns N runner actors,
+broadcasts weights (one object-store put, N refs), gathers sample batches, restarts
+failed runners (the FaultAwareApply role of `rllib/utils/actor_manager.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class EnvRunnerGroup:
+    def __init__(self, env_spec: bytes, module_blob: bytes, *, num_env_runners: int,
+                 num_envs_per_runner: int = 1, seed: Optional[int] = None,
+                 runner_cpus: float = 1):
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        self._env_spec = env_spec
+        self._module_blob = module_blob
+        self._num_envs_per_runner = num_envs_per_runner
+        self._seed = seed
+        self._cls = ray_tpu.remote(num_cpus=runner_cpus)(SingleAgentEnvRunner)
+        self._runners = [
+            self._make_runner(i) for i in range(max(1, num_env_runners))
+        ]
+
+    def _make_runner(self, index: int):
+        return self._cls.remote(
+            self._env_spec, self._module_blob, self._num_envs_per_runner,
+            self._seed, index,
+        )
+
+    def __len__(self):
+        return len(self._runners)
+
+    def sync_weights(self, params):
+        ref = ray_tpu.put(params)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self._runners])
+
+    def sample(self, timesteps_per_runner: int) -> List[Dict[str, Any]]:
+        """Returns one batch dict per runner; dead runners are replaced and skipped
+        this round (fault tolerance parity: restartable env runners)."""
+        refs = [r.sample.remote(timesteps_per_runner) for r in self._runners]
+        out: List[Dict[str, Any]] = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=300))
+            except Exception:
+                self._runners[i] = self._make_runner(i)
+                # Re-arm the fresh runner with no weights; caller re-syncs next iter.
+        return out
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -121,9 +121,13 @@ class HTTPProxy:
             return 404, b"no application mounted", "text/plain"
         app = self._routes[match]
         handle = self._handles[app]
-        response = handle.remote(request)
         loop = asyncio.get_running_loop()
-        result = await loop.run_in_executor(None, lambda: response.result(timeout_s=60))
+        # The whole submit+resolve runs off-loop: routing does blocking controller
+        # RPCs (and can wait for replicas after a redeploy), which must not stall
+        # other in-flight HTTP connections.
+        result = await loop.run_in_executor(
+            None, lambda: handle.remote(request).result(timeout_s=60)
+        )
         if isinstance(result, bytes):
             return 200, result, "application/octet-stream"
         if isinstance(result, str):

@@ -88,7 +88,10 @@ def batch(
     """Decorator: async fn(self, items: list) -> list, called per item."""
 
     def wrap(fn: Callable):
-        queue_holder: dict = {}
+        # One queue PER INSTANCE (keyed by id), not per decorated function: two
+        # instances sharing a class must never have their items batched together
+        # (the batch executes against a single self).
+        queues: dict = {}
 
         @functools.wraps(fn)
         async def inner(*args):
@@ -98,9 +101,10 @@ def batch(
             else:
                 (item,) = args
                 self_arg = None
-            q = queue_holder.get("q")
+            key = id(self_arg)
+            q = queues.get(key)
             if q is None:
-                q = queue_holder["q"] = _BatchQueue(fn, max_batch_size, batch_timeout_s)
+                q = queues[key] = _BatchQueue(fn, max_batch_size, batch_timeout_s)
             return await q.submit(self_arg, item)
 
         return inner

@@ -20,19 +20,35 @@ from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE
 
 
 class DeploymentResponse:
-    """A future for one deployment request. Parity: serve.handle.DeploymentResponse."""
+    """A future for one deployment request. Parity: serve.handle.DeploymentResponse.
 
-    def __init__(self, ref: "ray_tpu.ObjectRef"):
+    Replica death surfaces at result-resolution time (actor errors are delivered as
+    task results in this runtime, never at submit), so failover lives here: on
+    ActorDiedError the request is resubmitted through the router to a live replica.
+    """
+
+    _MAX_RETRIES = 3
+
+    def __init__(self, ref: "ray_tpu.ObjectRef", resubmit=None):
         self._ref = ref
+        self._resubmit = resubmit
+        self._retries = 0
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        while True:
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout_s)
+            except ray_tpu.exceptions.ActorDiedError:
+                if self._resubmit is None or self._retries >= self._MAX_RETRIES:
+                    raise
+                self._retries += 1
+                self._ref = self._resubmit()
 
     def __await__(self):
         import asyncio
 
         loop = asyncio.get_event_loop()
-        fut = loop.run_in_executor(None, lambda: ray_tpu.get(self._ref))
+        fut = loop.run_in_executor(None, lambda: self.result())
         return fut.__await__()
 
     @property
@@ -105,6 +121,22 @@ class _Router:
             self._fetched_at = 0.0
 
 
+# Routers are shared per (app, deployment) within a process so every handle —
+# including the throwaway children __getattr__ builds for handle.method.remote() —
+# reuses one replica cache and one in-flight load map.
+_ROUTERS: Dict[tuple, _Router] = {}
+_ROUTERS_LOCK = threading.Lock()
+
+
+def _shared_router(app: str, deployment: str) -> _Router:
+    key = (app, deployment)
+    with _ROUTERS_LOCK:
+        router = _ROUTERS.get(key)
+        if router is None:
+            router = _ROUTERS[key] = _Router(app, deployment)
+        return router
+
+
 class DeploymentHandle:
     def __init__(self, app: str, deployment: str, method_name: str = "__call__"):
         self._app = app
@@ -127,7 +159,7 @@ class DeploymentHandle:
 
     def _get_router(self) -> _Router:
         if self._router is None:
-            self._router = _Router(self._app, self._deployment)
+            self._router = _shared_router(self._app, self._deployment)
         return self._router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -141,20 +173,22 @@ class DeploymentHandle:
             for k, v in kwargs.items()
         }
         router = self._get_router()
-        last_err: Optional[Exception] = None
-        for _attempt in range(3):
+        method = self._method_name
+
+        def submit():
             replica = router.pick()
-            try:
-                ref = replica.handle_request.remote(self._method_name, args, kwargs)
-                # In-flight bookkeeping: decremented when the result resolves.
-                ray_tpu.global_worker().memory_store.add_done_callback(
-                    ref.id, lambda *_a, _r=replica: router.done(_r)
-                ) or router.done(replica)
-                return DeploymentResponse(ref)
-            except ray_tpu.exceptions.ActorDiedError as e:  # replica gone: refresh
-                last_err = e
-                router.evict()
-        raise last_err
+            ref = replica.handle_request.remote(method, args, kwargs)
+            # In-flight bookkeeping: decremented when the result resolves.
+            ray_tpu.global_worker().memory_store.add_done_callback(
+                ref.id, lambda *_a, _r=replica: router.done(_r)
+            ) or router.done(replica)
+            return ref
+
+        def resubmit():
+            router.evict()  # stale table: the picked replica was dead
+            return submit()
+
+        return DeploymentResponse(submit(), resubmit)
 
     def __repr__(self):
         return f"DeploymentHandle({self._app}#{self._deployment}.{self._method_name})"

@@ -1,0 +1,207 @@
+"""ray_tpu.rllib tests.
+
+Shape parity with the reference suite (rllib/algorithms/ppo/tests/ +
+rllib/core/tests/): GAE math, module distribution math, a learning smoke test on a
+trivially learnable env, CartPole end-to-end sampling/training, checkpoint
+save/restore, and learner-actor placement.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig, compute_gae
+from ray_tpu.rllib.core.rl_module import Columns, DefaultActorCriticModule
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+
+
+def test_gae_matches_reference_math():
+    rewards = np.array([1.0, 1.0, 1.0], np.float32)
+    vf = np.array([0.5, 0.4, 0.3], np.float32)
+    gamma, lam = 0.9, 0.8
+    adv, targets = compute_gae(rewards, vf, bootstrap=0.2, gamma=gamma, lam=lam)
+    # hand-rolled backward recursion
+    deltas = [1.0 + gamma * 0.4 - 0.5, 1.0 + gamma * 0.3 - 0.4, 1.0 + gamma * 0.2 - 0.3]
+    a2 = deltas[2]
+    a1 = deltas[1] + gamma * lam * a2
+    a0 = deltas[0] + gamma * lam * a1
+    np.testing.assert_allclose(adv, [a0, a1, a2], rtol=1e-5)
+    np.testing.assert_allclose(targets, adv + vf, rtol=1e-5)
+
+
+def test_module_distribution_math():
+    import jax
+    import jax.numpy as jnp
+
+    m = DefaultActorCriticModule(obs_dim=3, action_dim=4, discrete=True)
+    params = m.init_params(jax.random.PRNGKey(0))
+    batch = {Columns.OBS: jnp.ones((5, 3))}
+    out = m.forward_inference(params, batch)
+    logits = out[Columns.ACTION_DIST_INPUTS]
+    assert logits.shape == (5, 4)
+    assert out[Columns.VF_PREDS].shape == (5,)
+    actions = m.dist_sample(logits, jax.random.PRNGKey(1))
+    logp = m.dist_logp(logits, actions)
+    assert logp.shape == (5,)
+    assert float(jnp.exp(logp).max()) <= 1.0 + 1e-5
+    ent = m.dist_entropy(logits)
+    # near-uniform init → entropy close to log(4)
+    assert float(ent.mean()) == pytest.approx(np.log(4), abs=0.1)
+
+
+class _BanditEnv:
+    """One-step env: reward +1 iff action matches the sign feature. Learnable in a
+    handful of PPO iterations — the learning-progress smoke test. Deliberately NOT a
+    gym.Env subclass: exercises the runner's duck-typed env adapter."""
+
+    def __init__(self, *_a, **_k):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._rng = np.random.default_rng(0)
+        self._obs = None
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        sign = self._rng.choice([-1.0, 1.0])
+        self._obs = np.array([sign, 1.0], np.float32)
+        return self._obs, {}
+
+    def step(self, action):
+        correct = (self._obs[0] > 0) == (int(action) == 1)
+        obs, _ = self.reset()
+        return obs, (1.0 if correct else 0.0), True, False, {}
+
+    def close(self):
+        pass
+
+
+def test_ppo_learns_bandit():
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=6, lr=0.02,
+                  entropy_coeff=0.0)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        first = algo.train()
+        assert "episode_return_mean" in first
+        last = first
+        for _ in range(6):
+            last = algo.train()
+        assert last["episode_return_mean"] > max(0.75, first["episode_return_mean"])
+    finally:
+        algo.stop()
+
+
+def test_ppo_cartpole_smoke():
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=1)
+        .training(train_batch_size=400, minibatch_size=128, num_epochs=2, lr=3e-4)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        # recorded transitions: ~train_batch_size minus the per-episode autoreset
+        # bookkeeping steps that are (correctly) not recorded as experience
+        assert result["num_env_steps_sampled_lifetime"] >= 300
+        assert result["episodes_this_iter"] >= 1
+        assert np.isfinite(result["learner/total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_checkpoint_save_restore(tmp_path):
+    import jax
+
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        algo.train()
+        path = algo.save_to_path(str(tmp_path / "ckpt"))
+        w1 = algo.get_weights()
+        algo2 = config.copy().build_algo()
+        try:
+            algo2.restore_from_path(path)
+            assert algo2.iteration == algo.iteration
+            w2 = algo2.get_weights()
+            for a, b in zip(jax.tree_util.tree_leaves(w1), jax.tree_util.tree_leaves(w2)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_learner_actor_placement():
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+        .learners(num_learners=1, learner_resources={"num_cpus": 1})
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        assert np.isfinite(result["learner/total_loss"])
+    finally:
+        algo.stop()
+
+
+class _TruncOnlyEnv:
+    """Ends every episode via truncation after 5 steps — exercises the stats path
+    for TimeLimit-style envs and the gymnasium next-step autoreset handling."""
+
+    def __init__(self, *_a, **_k):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return np.zeros(2, np.float32), {}
+
+    def step(self, action):
+        self._t += 1
+        return np.zeros(2, np.float32), 1.0, False, self._t >= 5, {}
+
+    def close(self):
+        pass
+
+
+def test_truncated_episodes_counted_in_stats():
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _TruncOnlyEnv())
+        .training(train_batch_size=64, minibatch_size=32, num_epochs=1)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        # every episode is exactly 5 steps of reward 1.0
+        assert result["episodes_this_iter"] >= 5
+        assert result["episode_return_mean"] == pytest.approx(5.0)
+        assert result["episode_len_mean"] == pytest.approx(5.0)
+    finally:
+        algo.stop()
