@@ -697,10 +697,21 @@ class CoreWorker:
             else:
                 rt.executor.submit(self._execute_task_guarded, ready)
 
+    def _resolve_actor_method(self, instance, method_name: str):
+        """Method lookup plus the __rtpu_apply__ escape hatch: run an arbitrary
+        function against the actor instance (parity: the reference's __ray_call__,
+        used by compiled DAGs to install their pinned exec loops)."""
+        if method_name == "__rtpu_apply__":
+            def apply(fn, *args, **kwargs):
+                return fn(instance, *args, **kwargs)
+
+            return apply
+        return getattr(instance, method_name)
+
     async def _execute_async_actor_task(self, spec):
         rt = self.actor_runtime
         async with rt.semaphore:
-            method = getattr(rt.instance, spec["method_name"])
+            method = self._resolve_actor_method(rt.instance, spec["method_name"])
             try:
                 args, kwargs = await asyncio.get_running_loop().run_in_executor(
                     None, lambda: self._materialize_args(spec)
@@ -727,7 +738,9 @@ class CoreWorker:
         self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING")
         try:
             if spec["type"] == "actor_task":
-                fn = getattr(self.actor_runtime.instance, spec["method_name"])
+                fn = self._resolve_actor_method(
+                    self.actor_runtime.instance, spec["method_name"]
+                )
             else:
                 fn = self.functions.load(spec["fn_key"])
             args, kwargs = self._materialize_args(spec)

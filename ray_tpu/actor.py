@@ -66,6 +66,13 @@ class ActorMethod:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a compiled-graph node for this method call (reference:
+        actor_method.bind() -> ClassMethodNode, python/ray/dag/class_node.py)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: list[str], class_name: str = ""):

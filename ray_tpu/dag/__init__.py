@@ -1,0 +1,26 @@
+"""ray_tpu.dag: compiled graphs (aDAG) — pinned actor pipelines over channels.
+
+Parity: reference `python/ray/dag/__init__.py` — InputNode, MultiOutputNode,
+actor_method.bind(), DAGNode.experimental_compile(). The pipeline-parallel substrate:
+steady-state execution does no task submission and no allocation, just channel
+writes/reads between pinned per-actor loops.
+"""
+
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+]

@@ -1,0 +1,290 @@
+"""CompiledDAG: pin actor loops + preallocated channels; drive with execute().
+
+Design parity: reference `python/ray/dag/compiled_dag_node.py` (CompiledDAG :805,
+ExecutableTask :478, `do_exec_tasks` actor loops :186, driver `execute()` :2546) — at
+compile time every edge gets ONE mutable shared-memory channel and every actor gets a
+long-running loop task that reads its inputs, runs its methods in topological order,
+and writes outputs. Steady-state execution does zero task submissions and zero object
+allocations — the TPU-relevant property for pipeline-parallel stage feeding.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+
+class _ExecSpec:
+    """One actor-local step: read input channels / constants, call method, write."""
+
+    def __init__(self, method_name: str, arg_sources: list, kwarg_sources: dict,
+                 out_channel: Optional[Channel]):
+        self.method_name = method_name
+        self.arg_sources = arg_sources      # list of ("chan", Channel)|("const", v)
+        self.kwarg_sources = kwarg_sources  # name -> same
+        self.out_channel = out_channel
+
+
+def _read_source(kind, src):
+    if kind == "chan":
+        return src.read()
+    if kind == "pick":
+        reader, key = src
+        value = reader.read()
+        if isinstance(key, str) and hasattr(value, key):
+            return getattr(value, key)
+        return value[key]
+    return src
+
+
+def _exec_loop(instance, specs: List[_ExecSpec]):
+    """Runs inside the actor (as one pinned long-running method call)."""
+    while True:
+        try:
+            for spec in specs:
+                args = [_read_source(kind, src) for kind, src in spec.arg_sources]
+                kwargs = {
+                    k: _read_source(kind, src)
+                    for k, (kind, src) in spec.kwarg_sources.items()
+                }
+                # Errors flow THROUGH the graph (as wrapped values) so one bad
+                # input poisons only its execution, not the pinned loops.
+                err = next(
+                    (v for v in list(args) + list(kwargs.values())
+                     if isinstance(v, _WrappedError)),
+                    None,
+                )
+                if err is None:
+                    try:
+                        out = getattr(instance, spec.method_name)(*args, **kwargs)
+                    except Exception as e:  # surfaced at CompiledDAGRef.get
+                        out = _WrappedError(e)
+                else:
+                    out = err
+                if spec.out_channel is not None:
+                    spec.out_channel.write(out)
+        except ChannelClosed:
+            return "closed"
+
+
+class CompiledDAGRef:
+    """The driver-side result future of one execute() call."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._value: Any = None
+        self._ready = False
+
+    def get(self, timeout: Optional[float] = 60):
+        if not self._ready:
+            self._dag._resolve_until(self._idx, timeout)
+            self._value = self._dag._pending.pop(self._idx)
+            self._ready = True
+        if isinstance(self._value, _WrappedError):
+            raise self._value.error
+        return self._value
+
+
+class _WrappedError:
+    def __init__(self, error):
+        self.error = error
+
+
+class CompiledDAG:
+    def __init__(self, leaf: DAGNode, *, buffer_size_bytes: int = 8 << 20,
+                 _timeout_s: float = 60.0):
+        self._buffer = buffer_size_bytes
+        self._timeout = _timeout_s
+        self._torn_down = False
+        self._exec_count = 0
+        self._pending: Dict[int, Any] = {}
+        self._build(leaf)
+        # Per-output-reader progress: how many rounds each has consumed. Kept per
+        # reader so a timeout on one output can't shift another reader's stream.
+        self._reader_round = [0] * self._num_outputs
+
+    # -- compilation -------------------------------------------------------
+    def _build(self, leaf: DAGNode):
+        nodes = leaf._all_nodes()
+        input_nodes = [n for n in nodes if isinstance(n, InputNode)]
+        if len(input_nodes) != 1:
+            raise ValueError(f"a compiled DAG needs exactly one InputNode, "
+                             f"found {len(input_nodes)}")
+        self._input_node = input_nodes[0]
+        if isinstance(leaf, MultiOutputNode):
+            outputs = leaf.outputs
+        else:
+            outputs = [leaf]
+        self._num_outputs = len(outputs)
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor method nodes")
+
+        # Consumer counts per node, counted per ARG OCCURRENCE (a node passed twice
+        # to one bind() needs two reader slots — source_for allocates one per
+        # occurrence, and every slot must have its own ack word).
+        consumers: Dict[int, int] = {}
+        for n in nodes:
+            if isinstance(n, ClassMethodNode):
+                for u in n.upstream:
+                    consumers[id(u)] = consumers.get(id(u), 0) + 1
+        # Input channel read by every arg occurrence that consumes the input
+        # (directly or through attribute nodes).
+        input_consumers = consumers.get(id(self._input_node), 0) + sum(
+            consumers.get(id(n), 0)
+            for n in nodes
+            if isinstance(n, InputAttributeNode)
+        )
+        self._input_channel = Channel(self._buffer, max(1, input_consumers))
+        for out in outputs:
+            consumers[id(out)] = consumers.get(id(out), 0) + 1  # driver reads leaves
+
+        # Create one output channel per ClassMethodNode that anyone consumes.
+        chan_of: Dict[int, Channel] = {}
+        for n in nodes:
+            if isinstance(n, ClassMethodNode) and consumers.get(id(n), 0) > 0:
+                chan_of[id(n)] = Channel(self._buffer, consumers[id(n)])
+
+        # Assign reader slots.
+        next_slot: Dict[int, int] = {}
+        input_next_slot = [0]
+
+        def source_for(value) -> tuple:
+            if isinstance(value, InputNode):
+                slot = input_next_slot[0]
+                input_next_slot[0] += 1
+                return ("chan", self._input_channel.reader(slot))
+            if isinstance(value, InputAttributeNode):
+                slot = input_next_slot[0]
+                input_next_slot[0] += 1
+                return ("pick", (self._input_channel.reader(slot), value.key))
+            if isinstance(value, ClassMethodNode):
+                ch = chan_of[id(value)]
+                slot = next_slot.get(id(value), 0)
+                next_slot[id(value)] = slot + 1
+                return ("chan", ch.reader(slot))
+            return ("const", value)
+
+        # Group method nodes per actor, topological order (nodes already topo-sorted
+        # by _all_nodes' postorder).
+        per_actor: Dict[Any, List[_ExecSpec]] = {}
+        actor_of: Dict[Any, Any] = {}
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                continue
+            specs = per_actor.setdefault(n.actor._actor_id, [])
+            actor_of[n.actor._actor_id] = n.actor
+            arg_sources = [source_for(a) for a in n.args]
+            kwarg_sources = {k: source_for(v) for k, v in n.kwargs.items()}
+            specs.append(
+                _ExecSpec(n.method_name, arg_sources, kwarg_sources,
+                          chan_of.get(id(n)))
+            )
+        # Driver-side output readers (last reader slot of each output's channel).
+        self._output_readers: List[Channel] = []
+        for out in outputs:
+            ch = chan_of[id(out)]
+            slot = next_slot.get(id(out), 0)
+            next_slot[id(out)] = slot + 1
+            self._output_readers.append(ch.reader(slot))
+
+        self._channels = [self._input_channel] + list(chan_of.values())
+        self._loop_refs = []
+        self._actors = list(actor_of.values())
+        from ray_tpu.actor import ActorMethod
+
+        for actor_id, specs in per_actor.items():
+            actor = actor_of[actor_id]
+            # Pin the loop: one long-running call per actor via the generic
+            # apply hook (the reference's __ray_call__ + do_exec_tasks pattern).
+            self._loop_refs.append(
+                ActorMethod(actor, "__rtpu_apply__").remote(_exec_loop, specs)
+            )
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, input_value: Any = None) -> List[CompiledDAGRef] | CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("this compiled DAG was torn down")
+        idx = self._exec_count
+        self._exec_count += 1
+        self._input_channel.write(input_value, timeout=self._timeout)
+        refs = [CompiledDAGRef(self, idx * self._num_outputs + k)
+                for k in range(self._num_outputs)]
+        return refs if self._num_outputs > 1 else refs[0]
+
+    def _resolve_until(self, target_idx: int, timeout: Optional[float]):
+        round_needed, j = divmod(target_idx, self._num_outputs)
+        reader = self._output_readers[j]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._reader_round[j] <= round_needed:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            value = reader.read(remaining)
+            self._pending[self._reader_round[j] * self._num_outputs + j] = value
+            self._reader_round[j] += 1
+
+    def __getattr__(self, name):
+        raise AttributeError(name)
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        # Close EVERY channel: a loop can be blocked in a downstream write (full
+        # ring), not just an upstream read — both sides observe the closed flag.
+        for ch in self._channels:
+            ch.close()
+        try:
+            ray_tpu.get(self._loop_refs, timeout=10)
+        except Exception:
+            pass
+        for ch in self._channels:
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def interpret(leaf: DAGNode, *args) -> Any:
+    """Uncompiled execution with plain actor calls (DAGNode.execute parity)."""
+    input_value = args[0] if args else None
+    cache: Dict[int, Any] = {}
+
+    def run(n: DAGNode):
+        if id(n) in cache:
+            return cache[id(n)]
+        if isinstance(n, InputNode):
+            out = input_value
+        elif isinstance(n, InputAttributeNode):
+            parent = run(n.upstream[0])
+            out = parent[n.key] if not isinstance(n.key, str) or not hasattr(
+                parent, n.key
+            ) else getattr(parent, n.key)
+        elif isinstance(n, ClassMethodNode):
+            call_args = [run(a) if isinstance(a, DAGNode) else a for a in n.args]
+            call_kwargs = {
+                k: run(v) if isinstance(v, DAGNode) else v for k, v in n.kwargs.items()
+            }
+            method = getattr(n.actor, n.method_name)
+            out = ray_tpu.get(method.remote(*call_args, **call_kwargs))
+        elif isinstance(n, MultiOutputNode):
+            out = [run(o) for o in n.outputs]
+        else:
+            raise TypeError(f"unknown node {type(n).__name__}")
+        cache[id(n)] = out
+        return out
+
+    return run(leaf)

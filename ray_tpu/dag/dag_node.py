@@ -1,0 +1,90 @@
+"""DAG node types for compiled graphs.
+
+Design parity: reference `python/ray/dag/` — InputNode (`input_node.py`),
+ClassMethodNode (`class_node.py` — created by actor_method.bind()),
+MultiOutputNode (`output_node.py`), and `experimental_compile`
+(`dag_node.py:278`). A DAG is built with .bind() calls, then compiled into
+pinned per-actor execution loops over shared-memory channels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class DAGNode:
+    def __init__(self, upstream: List["DAGNode"]):
+        self.upstream = upstream
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+    def execute(self, *args):
+        """Uncompiled (interpreted) execution — parity with DAGNode.execute:
+        walks the graph with plain actor calls. Useful for debugging."""
+        from ray_tpu.dag.compiled_dag import interpret
+
+        return interpret(self, *args)
+
+    def _all_nodes(self) -> List["DAGNode"]:
+        seen: list = []
+
+        def visit(n):
+            if any(n is s for s in seen):
+                return
+            for u in n.upstream:
+                visit(u)
+            seen.append(n)
+
+        visit(self)
+        return seen
+
+
+class InputNode(DAGNode):
+    """The driver-provided input. Supports `with InputNode() as inp:` and
+    `inp[i]` / `inp.key` access (InputAttributeNode)."""
+
+    def __init__(self):
+        super().__init__([])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name in ("upstream",):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name)
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key):
+        super().__init__([parent])
+        self.key = key
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call in the graph."""
+
+    def __init__(self, actor, method_name: str, args: tuple, kwargs: dict):
+        upstream = [a for a in args if isinstance(a, DAGNode)] + [
+            v for v in kwargs.values() if isinstance(v, DAGNode)
+        ]
+        super().__init__(upstream)
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(list(outputs))
+        self.outputs = list(outputs)

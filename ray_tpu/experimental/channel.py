@@ -1,0 +1,187 @@
+"""Mutable shared-memory channels: the compiled-graph transport.
+
+Design parity: reference `python/ray/experimental/channel/shared_memory_channel.py`
+(:151 Channel over mutable plasma objects, write :435 / read :473, BufferedSharedMemory
+variant :586) and the C++ mutable object manager
+(`src/ray/core_worker/experimental_mutable_object_manager.h:44`) — repeated in-place
+writes with writer/reader version synchronization, so a compiled DAG reuses a fixed
+ring of buffers per edge instead of allocating an object per call.
+
+Segment layout (S slots, R readers):
+    [u64 write_version][u64 closed][u64 ack_version x R][u64 len x S][S x payload]
+Ring protocol: writer waits until write_version - min(acks) < S (a free slot exists),
+writes slot write_version % S, publishes write_version+1. Reader waits until
+write_version > my_ack, reads slot my_ack % S, publishes my_ack+1. close() sets the
+closed word: BOTH sides observe it from their wait loops (a writer blocked on a full
+ring must be stoppable too) and raise ChannelClosed; readers drain buffered values
+first. Synchronization is version-polling over shm words (cross-process, nothing to
+leak); waits back off to 50us sleeps.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+import uuid
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+import cloudpickle
+
+_U64 = struct.Struct("<Q")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+# Segment names created by THIS process: attach-views of these must not unregister
+# them from resource_tracker (that would strip the creator's own registration and
+# the eventual unlink would traceback in the tracker daemon).
+_created_here: set = set()
+
+
+class Channel:
+    """One writer, `num_readers` readers, `num_slots` in-flight values.
+    Picklable by segment name; `reader(slot)` binds a reader view."""
+
+    def __init__(self, capacity: int = 4 << 20, num_readers: int = 1,
+                 num_slots: int = 4, _name: Optional[str] = None,
+                 _reader_slot: Optional[int] = None):
+        self._capacity = capacity
+        self._num_readers = num_readers
+        self._num_slots = num_slots
+        self._reader_slot = _reader_slot
+        self._ctrl = 16 + 8 * num_readers + 8 * num_slots
+        total = self._ctrl + num_slots * capacity
+        if _name is None:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=total, name=f"rtpuch_{uuid.uuid4().hex[:12]}"
+            )
+            self._owner = True
+            self._shm.buf[: self._ctrl] = bytes(self._ctrl)
+            _created_here.add(self._shm.name)
+        else:
+            self._shm = shared_memory.SharedMemory(name=_name)
+            self._owner = False
+            # Only the creator owns the segment's lifetime; detach this attachment
+            # from resource_tracker or it double-unlinks at exit (CPython gh-82300).
+            # Views inside the creator process keep the registration.
+            if self._shm.name not in _created_here:
+                try:
+                    from multiprocessing import resource_tracker
+
+                    resource_tracker.unregister(self._shm._name, "shared_memory")
+                except Exception:
+                    pass
+
+    # -- pickling ----------------------------------------------------------
+    def __reduce__(self):
+        return (
+            Channel,
+            (self._capacity, self._num_readers, self._num_slots, self._shm.name,
+             self._reader_slot),
+        )
+
+    def reader(self, slot: int) -> "Channel":
+        """A view of this channel bound to reader slot `slot`."""
+        return Channel(self._capacity, self._num_readers, self._num_slots,
+                       self._shm.name, slot)
+
+    # -- control words -----------------------------------------------------
+    def _get_u64(self, off: int) -> int:
+        return _U64.unpack_from(self._shm.buf, off)[0]
+
+    def _set_u64(self, off: int, value: int):
+        _U64.pack_into(self._shm.buf, off, value)
+
+    @property
+    def _write_version(self) -> int:
+        return self._get_u64(0)
+
+    @property
+    def _closed(self) -> bool:
+        return self._get_u64(8) != 0
+
+    def _ack_off(self, reader: int) -> int:
+        return 16 + 8 * reader
+
+    def _len_off(self, slot: int) -> int:
+        return 16 + 8 * self._num_readers + 8 * slot
+
+    def _data_off(self, slot: int) -> int:
+        return self._ctrl + slot * self._capacity
+
+    def _min_ack(self) -> int:
+        return min(
+            self._get_u64(self._ack_off(r)) for r in range(self._num_readers)
+        )
+
+    # -- writer ------------------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None):
+        data = cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        self.write_bytes(data, timeout)
+
+    def write_bytes(self, data: bytes, timeout: Optional[float] = None):
+        if len(data) > self._capacity:
+            raise ValueError(
+                f"value of {len(data)} bytes exceeds channel slot capacity "
+                f"{self._capacity}; construct the Channel with a larger capacity"
+            )
+        if self._closed:
+            raise ChannelClosed()
+        wv = self._write_version
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Wait for a free slot: slowest reader must be < num_slots behind.
+        while wv - self._min_ack() >= self._num_slots:
+            if self._closed:
+                raise ChannelClosed()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out waiting for readers")
+            time.sleep(5e-5)
+        slot = wv % self._num_slots
+        off = self._data_off(slot)
+        self._shm.buf[off : off + len(data)] = data
+        self._set_u64(self._len_off(slot), len(data))
+        self._set_u64(0, wv + 1)
+
+    # -- reader ------------------------------------------------------------
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return cloudpickle.loads(self.read_bytes(timeout))
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        reader = self._reader_slot or 0
+        my_ack = self._get_u64(self._ack_off(reader))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._write_version <= my_ack:
+            if self._closed:
+                # Buffered values are drained above; nothing more is coming.
+                raise ChannelClosed()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(5e-5)
+        slot = my_ack % self._num_slots
+        n = self._get_u64(self._len_off(slot))
+        off = self._data_off(slot)
+        data = bytes(self._shm.buf[off : off + n])
+        self._set_u64(self._ack_off(reader), my_ack + 1)
+        return data
+
+    def close(self):
+        """Mark closed: wakes blocked readers AND writers (buffered reads drain)."""
+        self._set_u64(8, 1)
+
+    def destroy(self):
+        try:
+            self._shm.close()
+            if self._owner:
+                self._shm.unlink()
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self._shm.close()
+        except Exception:
+            pass

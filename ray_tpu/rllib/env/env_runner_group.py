@@ -49,6 +49,12 @@ class EnvRunnerGroup:
             try:
                 out.append(ray_tpu.get(ref, timeout=300))
             except Exception:
+                # Kill before replacing: a merely-slow runner would otherwise leak
+                # its process and CPU reservation forever.
+                try:
+                    ray_tpu.kill(self._runners[i])
+                except Exception:
+                    pass
                 self._runners[i] = self._make_runner(i)
                 # Re-arm the fresh runner with no weights; caller re-syncs next iter.
         return out

@@ -88,23 +88,30 @@ def batch(
     """Decorator: async fn(self, items: list) -> list, called per item."""
 
     def wrap(fn: Callable):
-        # One queue PER INSTANCE (keyed by id), not per decorated function: two
-        # instances sharing a class must never have their items batched together
-        # (the batch executes against a single self).
-        queues: dict = {}
+        # One queue PER INSTANCE, stored ON the instance: two instances sharing a
+        # class must never have their items batched together (a batch executes
+        # against a single self), and an instance's queue must die with it —
+        # id()-keyed maps leak and can rebind a recycled id to a dead queue.
+        attr = f"__rtpu_batch_queue_{fn.__name__}"
+        free_fn_queue: list = []
 
         @functools.wraps(fn)
         async def inner(*args):
             # Supports both bound methods (self, item) and free functions (item).
             if len(args) == 2:
                 self_arg, item = args
+                q = getattr(self_arg, attr, None)
+                if q is None:
+                    q = _BatchQueue(fn, max_batch_size, batch_timeout_s)
+                    setattr(self_arg, attr, q)
             else:
                 (item,) = args
                 self_arg = None
-            key = id(self_arg)
-            q = queues.get(key)
-            if q is None:
-                q = queues[key] = _BatchQueue(fn, max_batch_size, batch_timeout_s)
+                if not free_fn_queue:
+                    free_fn_queue.append(
+                        _BatchQueue(fn, max_batch_size, batch_timeout_s)
+                    )
+                q = free_fn_queue[0]
             return await q.submit(self_arg, item)
 
         return inner
