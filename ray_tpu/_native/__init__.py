@@ -1,0 +1,35 @@
+"""Native (C++) components, built on demand with the system toolchain."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_build_lock = threading.Lock()
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_HERE, f"lib{name}.so")
+
+
+def ensure_built(name: str) -> str | None:
+    """Compile lib<name>.so from <name>.cpp if missing or stale; returns the path
+    or None if the toolchain is unavailable/fails (callers fall back to Python)."""
+    src = os.path.join(_HERE, f"{name}.cpp")
+    out = lib_path(name)
+    with _build_lock:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        try:
+            tmp = out + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp,
+                 "-lpthread", "-lrt"],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, out)
+            return out
+        except Exception:
+            return None

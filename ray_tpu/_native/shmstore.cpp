@@ -1,0 +1,468 @@
+// Native shared-memory object store: one mmap arena per node, many processes.
+//
+// Design parity: reference plasma store (src/ray/object_manager/plasma/ — a dlmalloc
+// arena over mmap/shm with an object index, create/seal lifecycle and LRU eviction of
+// releasable objects: plasma_allocator.h:42, eviction_policy.h:159, object_store.h:76).
+// Rebuilt small: boundary-tag first-fit allocator with coalescing, open-addressing
+// object index, LRU list threaded through the index entries, and a robust
+// process-shared mutex so any client of the node can allocate/lookup directly in
+// shared memory — no RPC on the hot get/put path.
+//
+// Built with: g++ -O2 -shared -fPIC shmstore.cpp -o libshmstore.so -lpthread -lrt
+// Exposed to Python via ctypes (see shmstore.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254505553544f31ULL;  // "RTPUSTO1"
+constexpr uint32_t kMaxObjects = 1 << 16;
+constexpr uint32_t kNumBuckets = 1 << 17;  // 2x entries, open addressing
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kBlockMeta = 16;  // 8B header + 8B footer
+constexpr uint32_t kEmpty = 0xffffffffu;
+constexpr uint32_t kTombstone = 0xfffffffeu;
+
+// Entry states.
+enum : uint32_t { KSTATE_FREE = 0, KSTATE_ALLOCATED = 1, KSTATE_SEALED = 2 };
+// Entry flags.
+enum : uint32_t { KFLAG_FREED = 1 };
+
+struct Entry {
+  uint8_t id[16];
+  uint64_t offset;   // payload offset within the data area
+  uint64_t size;     // user size
+  uint32_t state;
+  uint32_t flags;
+  uint32_t lru_prev;  // entry index or kEmpty
+  uint32_t lru_next;
+  uint32_t pins;      // client pin count: pinned entries are never evicted
+  uint32_t _pad;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;   // data area bytes
+  uint64_t used;       // user bytes in live entries
+  uint64_t data_off;   // offset of data area from arena base
+  pthread_mutex_t mutex;
+  uint64_t free_head;      // data-offset of first free block, or 0 (none)
+  uint32_t num_entries;
+  uint32_t lru_head;       // least recently used entry index
+  uint32_t lru_tail;
+  uint32_t next_free_entry;      // freelist of Entry slots via lru_next
+  uint32_t entry_freelist_head;  // kEmpty-terminated
+  uint64_t num_evictions;
+  uint32_t buckets[kNumBuckets];  // entry index, kEmpty, or kTombstone
+  Entry entries[kMaxObjects];
+};
+
+// Free data blocks: [u64 size|1bit free][u64 next_free][u64 prev_free]...[u64 size]
+// Used data blocks: [u64 size|0][payload][u64 size]
+// size field counts the WHOLE block (meta included); low bit = free flag.
+
+struct Arena {
+  uint8_t* base;
+  Header* hdr;
+  uint8_t* data;
+  uint64_t map_len;
+};
+
+inline uint64_t block_size(uint64_t word) { return word & ~1ULL; }
+inline bool block_free(uint64_t word) { return word & 1ULL; }
+
+inline uint64_t rd64(uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+inline void wr64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+
+// free-block links stored at payload start (data offsets; 0 = none)
+inline uint64_t fb_next(uint8_t* data, uint64_t off) { return rd64(data + off + 8); }
+inline uint64_t fb_prev(uint8_t* data, uint64_t off) { return rd64(data + off + 16); }
+inline void set_fb_next(uint8_t* data, uint64_t off, uint64_t v) { wr64(data + off + 8, v); }
+inline void set_fb_prev(uint8_t* data, uint64_t off, uint64_t v) { wr64(data + off + 16, v); }
+
+void freelist_remove(Header* h, uint8_t* data, uint64_t off) {
+  uint64_t prev = fb_prev(data, off), next = fb_next(data, off);
+  if (prev) set_fb_next(data, prev, next);
+  else h->free_head = next;
+  if (next) set_fb_prev(data, next, prev);
+}
+
+void freelist_push(Header* h, uint8_t* data, uint64_t off) {
+  set_fb_prev(data, off, 0);
+  set_fb_next(data, off, h->free_head);
+  if (h->free_head) set_fb_prev(data, h->free_head, off);
+  h->free_head = off;
+}
+
+void write_block(uint8_t* data, uint64_t off, uint64_t size, bool is_free) {
+  uint64_t word = size | (is_free ? 1ULL : 0ULL);
+  wr64(data + off, word);
+  wr64(data + off + size - 8, word);
+}
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 16; i++) { h ^= id[i]; h *= 1099511628211ULL; }
+  return h;
+}
+
+uint32_t find_entry(Header* h, const uint8_t* id) {
+  uint64_t b = hash_id(id) & (kNumBuckets - 1);
+  for (uint32_t probe = 0; probe < kNumBuckets; probe++) {
+    uint32_t v = h->buckets[(b + probe) & (kNumBuckets - 1)];
+    if (v == kEmpty) return kEmpty;
+    if (v != kTombstone && memcmp(h->entries[v].id, id, 16) == 0) return v;
+  }
+  return kEmpty;
+}
+
+bool insert_bucket(Header* h, const uint8_t* id, uint32_t entry_idx) {
+  uint64_t b = hash_id(id) & (kNumBuckets - 1);
+  for (uint32_t probe = 0; probe < kNumBuckets; probe++) {
+    uint32_t slot = (b + probe) & (kNumBuckets - 1);
+    uint32_t v = h->buckets[slot];
+    if (v == kEmpty || v == kTombstone) { h->buckets[slot] = entry_idx; return true; }
+  }
+  return false;
+}
+
+void remove_bucket(Header* h, const uint8_t* id) {
+  uint64_t b = hash_id(id) & (kNumBuckets - 1);
+  for (uint32_t probe = 0; probe < kNumBuckets; probe++) {
+    uint32_t slot = (b + probe) & (kNumBuckets - 1);
+    uint32_t v = h->buckets[slot];
+    if (v == kEmpty) return;
+    if (v != kTombstone && memcmp(h->entries[v].id, id, 16) == 0) {
+      h->buckets[slot] = kTombstone;
+      return;
+    }
+  }
+}
+
+// -- LRU (most recent at tail) ---------------------------------------------
+void lru_unlink(Header* h, uint32_t idx) {
+  Entry& e = h->entries[idx];
+  if (e.lru_prev != kEmpty) h->entries[e.lru_prev].lru_next = e.lru_next;
+  else if (h->lru_head == idx) h->lru_head = e.lru_next;
+  if (e.lru_next != kEmpty) h->entries[e.lru_next].lru_prev = e.lru_prev;
+  else if (h->lru_tail == idx) h->lru_tail = e.lru_prev;
+  e.lru_prev = e.lru_next = kEmpty;
+}
+
+void lru_push_tail(Header* h, uint32_t idx) {
+  Entry& e = h->entries[idx];
+  e.lru_prev = h->lru_tail;
+  e.lru_next = kEmpty;
+  if (h->lru_tail != kEmpty) h->entries[h->lru_tail].lru_next = idx;
+  h->lru_tail = idx;
+  if (h->lru_head == kEmpty) h->lru_head = idx;
+}
+
+uint32_t entry_alloc(Header* h) {
+  if (h->entry_freelist_head != kEmpty) {
+    uint32_t idx = h->entry_freelist_head;
+    h->entry_freelist_head = h->entries[idx].lru_next;
+    return idx;
+  }
+  if (h->next_free_entry < kMaxObjects) return h->next_free_entry++;
+  return kEmpty;
+}
+
+void entry_release(Header* h, uint32_t idx) {
+  h->entries[idx].state = KSTATE_FREE;
+  h->entries[idx].lru_next = h->entry_freelist_head;
+  h->entry_freelist_head = idx;
+}
+
+// -- allocator -------------------------------------------------------------
+uint64_t round_block(uint64_t user_size) {
+  uint64_t need = user_size + kBlockMeta;
+  if (need < 32) need = 32;  // room for free links
+  return (need + kAlign - 1) & ~(kAlign - 1);
+}
+
+uint64_t data_alloc(Header* h, uint8_t* data, uint64_t user_size) {
+  uint64_t want = round_block(user_size);
+  uint64_t off = h->free_head;
+  while (off) {
+    uint64_t word = rd64(data + off);
+    uint64_t bsize = block_size(word);
+    if (bsize >= want) {
+      freelist_remove(h, data, off);
+      if (bsize - want >= 64) {
+        // split: remainder stays free
+        uint64_t rem_off = off + want;
+        write_block(data, rem_off, bsize - want, true);
+        freelist_push(h, data, rem_off);
+        write_block(data, off, want, false);
+      } else {
+        write_block(data, off, bsize, false);
+      }
+      return off + 8;  // payload offset
+    }
+    off = fb_next(data, off);
+  }
+  return UINT64_MAX;
+}
+
+void data_free(Header* h, uint8_t* data, uint64_t payload_off) {
+  uint64_t off = payload_off - 8;
+  uint64_t word = rd64(data + off);
+  uint64_t bsize = block_size(word);
+  // coalesce with next
+  uint64_t next_off = off + bsize;
+  if (next_off + 8 <= h->capacity) {
+    uint64_t nword = rd64(data + next_off);
+    if (block_free(nword)) {
+      freelist_remove(h, data, next_off);
+      bsize += block_size(nword);
+    }
+  }
+  // coalesce with prev
+  if (off >= 8) {
+    uint64_t pword = rd64(data + off - 8);
+    if (block_free(pword)) {
+      uint64_t poff = off - block_size(pword);
+      freelist_remove(h, data, poff);
+      off = poff;
+      bsize += block_size(pword);
+    }
+  }
+  write_block(data, off, bsize, true);
+  freelist_push(h, data, off);
+}
+
+void evict_entry(Header* h, uint8_t* data, uint32_t idx) {
+  Entry& e = h->entries[idx];
+  remove_bucket(h, e.id);
+  lru_unlink(h, idx);
+  data_free(h, data, e.offset);
+  h->used -= e.size;
+  h->num_evictions++;
+  entry_release(h, idx);
+}
+
+// Try to make room: evict freed+sealed entries from LRU head.
+bool evict_until(Header* h, uint8_t* data, uint64_t user_size) {
+  uint64_t want = round_block(user_size);
+  for (int rounds = 0; rounds < (int)kMaxObjects; rounds++) {
+    // quick check: is there a block big enough?
+    for (uint64_t off = h->free_head; off; off = fb_next(data, off)) {
+      if (block_size(rd64(data + off)) >= want) return true;
+    }
+    // evict next evictable from LRU head
+    uint32_t idx = h->lru_head;
+    while (idx != kEmpty) {
+      Entry& e = h->entries[idx];
+      uint32_t next = e.lru_next;
+      if ((e.flags & KFLAG_FREED) && e.pins == 0) {  // freed AND unpinned evict
+        evict_entry(h, data, idx);
+        break;
+      }
+      idx = next;
+    }
+    if (idx == kEmpty) return false;  // nothing evictable
+  }
+  return false;
+}
+
+void lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mutex);
+}
+
+void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
+
+}  // namespace
+
+extern "C" {
+
+// Create a new arena shm segment; returns mapped Arena* or null.
+void* shmstore_create(const char* name, uint64_t capacity) {
+  uint64_t total = sizeof(Header) + capacity;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) { close(fd); shm_unlink(name); return nullptr; }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) { shm_unlink(name); return nullptr; }
+  Header* h = (Header*)base;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  h->data_off = sizeof(Header);
+  h->lru_head = h->lru_tail = kEmpty;
+  h->entry_freelist_head = kEmpty;
+  for (uint32_t i = 0; i < kNumBuckets; i++) h->buckets[i] = kEmpty;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  uint8_t* data = (uint8_t*)base + h->data_off;
+  // Offset 0 holds a permanent used sentinel block so free_head==0 can mean
+  // "no free blocks" and prev-coalescing never walks off the front.
+  write_block(data, 0, kAlign, false);
+  write_block(data, kAlign, capacity - kAlign, true);
+  set_fb_next(data, kAlign, 0);
+  set_fb_prev(data, kAlign, 0);
+  h->free_head = kAlign;
+  h->magic = kMagic;
+  Arena* a = new Arena{(uint8_t*)base, h, data, total};
+  return a;
+}
+
+// Attach an existing arena.
+void* shmstore_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Header* h = (Header*)base;
+  if (h->magic != kMagic) { munmap(base, (size_t)st.st_size); return nullptr; }
+  Arena* a = new Arena{(uint8_t*)base, h, (uint8_t*)base + h->data_off,
+                       (uint64_t)st.st_size};
+  return a;
+}
+
+// Allocate an unsealed object; returns payload offset from arena base, or
+// UINT64_MAX if it can't fit (after eviction), UINT64_MAX-1 if id exists.
+uint64_t shmstore_alloc(void* arena, const uint8_t* id, uint64_t size) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  if (find_entry(h, id) != kEmpty) { unlock(h); return UINT64_MAX - 1; }
+  uint64_t payload = data_alloc(h, a->data, size);
+  if (payload == UINT64_MAX) {
+    if (evict_until(h, a->data, size)) payload = data_alloc(h, a->data, size);
+  }
+  if (payload == UINT64_MAX) { unlock(h); return UINT64_MAX; }
+  uint32_t idx = entry_alloc(h);
+  if (idx == kEmpty) { data_free(h, a->data, payload); unlock(h); return UINT64_MAX; }
+  Entry& e = h->entries[idx];
+  memcpy(e.id, id, 16);
+  e.offset = payload;
+  e.size = size;
+  e.state = KSTATE_ALLOCATED;
+  e.flags = 0;
+  e.pins = 0;
+  e.lru_prev = e.lru_next = kEmpty;
+  insert_bucket(h, id, idx);
+  lru_push_tail(h, idx);
+  h->used += size;
+  unlock(h);
+  return h->data_off + payload;
+}
+
+int shmstore_seal(void* arena, const uint8_t* id) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t idx = find_entry(h, id);
+  if (idx == kEmpty) { unlock(h); return -1; }
+  h->entries[idx].state = KSTATE_SEALED;
+  lru_unlink(h, idx);
+  lru_push_tail(h, idx);
+  unlock(h);
+  return 0;
+}
+
+// Lookup a sealed object: fills offset (from arena base) and size; touches LRU.
+int shmstore_lookup(void* arena, const uint8_t* id, uint64_t* offset, uint64_t* size) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t idx = find_entry(h, id);
+  if (idx == kEmpty || h->entries[idx].state != KSTATE_SEALED) { unlock(h); return -1; }
+  Entry& e = h->entries[idx];
+  *offset = h->data_off + e.offset;
+  *size = e.size;
+  lru_unlink(h, idx);
+  lru_push_tail(h, idx);
+  unlock(h);
+  return 0;
+}
+
+// Mark freed. eager=1 evicts now (unless pinned); else the entry stays as
+// evictable LRU cache.
+int shmstore_free_obj(void* arena, const uint8_t* id, int eager) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t idx = find_entry(h, id);
+  if (idx == kEmpty) { unlock(h); return -1; }
+  h->entries[idx].flags |= KFLAG_FREED;
+  if (eager && h->entries[idx].pins == 0) evict_entry(h, a->data, idx);
+  unlock(h);
+  return 0;
+}
+
+// Pin: the entry's memory will not be recycled until released. Callers pin while
+// zero-copy views alias the payload (plasma's client refcount role). A client that
+// dies pinned leaks the entry until the arena is recreated.
+int shmstore_pin(void* arena, const uint8_t* id) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t idx = find_entry(h, id);
+  if (idx == kEmpty) { unlock(h); return -1; }
+  h->entries[idx].pins++;
+  unlock(h);
+  return 0;
+}
+
+int shmstore_release(void* arena, const uint8_t* id) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t idx = find_entry(h, id);
+  if (idx == kEmpty) { unlock(h); return -1; }
+  Entry& e = h->entries[idx];
+  if (e.pins > 0) e.pins--;
+  // A release of a freed, now-unpinned entry evicts it promptly.
+  if (e.pins == 0 && (e.flags & KFLAG_FREED)) evict_entry(h, a->data, idx);
+  unlock(h);
+  return 0;
+}
+
+uint64_t shmstore_used(void* arena) { return ((Arena*)arena)->hdr->used; }
+uint64_t shmstore_capacity(void* arena) { return ((Arena*)arena)->hdr->capacity; }
+uint64_t shmstore_num_evictions(void* arena) { return ((Arena*)arena)->hdr->num_evictions; }
+
+uint64_t shmstore_count(void* arena) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint64_t n = 0;
+  for (uint32_t i = h->lru_head; i != kEmpty; i = h->entries[i].lru_next) n++;
+  unlock(h);
+  return n;
+}
+
+// Base pointer for ctypes to build zero-copy memoryviews.
+void* shmstore_base(void* arena) { return ((Arena*)arena)->base; }
+uint64_t shmstore_map_len(void* arena) { return ((Arena*)arena)->map_len; }
+
+void shmstore_close(void* arena) {
+  Arena* a = (Arena*)arena;
+  munmap(a->base, a->map_len);
+  delete a;
+}
+
+void shmstore_destroy(void* arena, const char* name) {
+  shmstore_close(arena);
+  shm_unlink(name);
+}
+
+}  // extern "C"

@@ -1,0 +1,198 @@
+"""ctypes binding for the native shared-memory object store (shmstore.cpp).
+
+Server side (raylet) creates the arena; clients (workers) attach by name and read
+payloads zero-copy via a memoryview over the mapping.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+from ray_tpu._native import ensure_built
+
+_lib = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = ensure_built("shmstore")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.shmstore_create.restype = ctypes.c_void_p
+    lib.shmstore_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmstore_open.restype = ctypes.c_void_p
+    lib.shmstore_open.argtypes = [ctypes.c_char_p]
+    lib.shmstore_alloc.restype = ctypes.c_uint64
+    lib.shmstore_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmstore_seal.restype = ctypes.c_int
+    lib.shmstore_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shmstore_lookup.restype = ctypes.c_int
+    lib.shmstore_lookup.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.shmstore_free_obj.restype = ctypes.c_int
+    lib.shmstore_free_obj.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.shmstore_pin.restype = ctypes.c_int
+    lib.shmstore_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shmstore_release.restype = ctypes.c_int
+    lib.shmstore_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    for fn in ("shmstore_used", "shmstore_capacity", "shmstore_count",
+               "shmstore_num_evictions", "shmstore_map_len"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.shmstore_base.restype = ctypes.c_void_p
+    lib.shmstore_base.argtypes = [ctypes.c_void_p]
+    lib.shmstore_close.argtypes = [ctypes.c_void_p]
+    lib.shmstore_destroy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+_ALLOC_FULL = (1 << 64) - 1
+_ALLOC_EXISTS = (1 << 64) - 2
+
+
+class _ArenaView:
+    """Zero-copy view over the whole arena mapping."""
+
+    def __init__(self, lib, handle):
+        base = lib.shmstore_base(handle)
+        length = lib.shmstore_map_len(handle)
+        self._buf = (ctypes.c_char * length).from_address(base)
+        self.view = memoryview(self._buf).cast("B")
+
+
+class _ArenaHandle:
+    """Shared lookup/read/write/pin plumbing for server and client views."""
+
+    def __init__(self, name: str, handle):
+        self._lib = load()
+        self.name = name
+        self._h = handle
+        self._view = _ArenaView(self._lib, self._h)
+
+    def lookup(self, object_id: bytes) -> Optional[Tuple[int, int]]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if self._lib.shmstore_lookup(self._h, object_id, ctypes.byref(off),
+                                     ctypes.byref(size)) != 0:
+            return None
+        return off.value, size.value
+
+    def read(self, offset: int, size: int) -> memoryview:
+        return self._view.view[offset : offset + size]
+
+    def write(self, offset: int, data: bytes):
+        self._view.view[offset : offset + len(data)] = data
+
+    def pin(self, object_id: bytes) -> bool:
+        return self._lib.shmstore_pin(self._h, object_id) == 0
+
+    def release(self, object_id: bytes) -> bool:
+        if self._h is None:
+            return False
+        return self._lib.shmstore_release(self._h, object_id) == 0
+
+    def read_pinned(self, object_id: bytes, offset: int, size: int) -> memoryview:
+        """A zero-copy view that PINS the object: the arena will not recycle the
+        payload while this view (or any memoryview/ndarray sliced from it) is
+        alive. The pin releases when the region object is garbage collected."""
+        self.pin(object_id)
+        region = _PinnedRegion(self, object_id, self._view.view[offset : offset + size])
+        return memoryview(region)
+
+
+class _PinnedRegion:
+    """Buffer-protocol wrapper tying an arena pin to Python object lifetime.
+
+    memoryview(region) re-exports the underlying view but keeps `region` as the
+    owner (PEP 688 __buffer__), so every slice/ndarray built over it holds the pin
+    until the last alias dies — the plasma client-refcount role."""
+
+    def __init__(self, handle: _ArenaHandle, object_id: bytes, view: memoryview):
+        self._handle = handle
+        self._object_id = object_id
+        self._mv = view
+
+    def __buffer__(self, flags):
+        return self._mv.__buffer__(flags)
+
+    def __del__(self):
+        try:
+            self._handle.release(self._object_id)
+        except Exception:
+            pass
+
+
+class NativeStoreServer(_ArenaHandle):
+    """Owns the arena segment (raylet side)."""
+
+    def __init__(self, name: str, capacity: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native shmstore unavailable")
+        h = lib.shmstore_create(name.encode(), capacity)
+        if not h:
+            raise RuntimeError(f"failed to create arena {name!r}")
+        super().__init__(name, h)
+
+    def alloc(self, object_id: bytes, size: int) -> Optional[int]:
+        """Returns payload offset, None if full, or raises on duplicate."""
+        off = self._lib.shmstore_alloc(self._h, object_id, size)
+        if off == _ALLOC_FULL:
+            return None
+        if off == _ALLOC_EXISTS:
+            raise FileExistsError(object_id.hex())
+        return off
+
+    def seal(self, object_id: bytes) -> bool:
+        return self._lib.shmstore_seal(self._h, object_id) == 0
+
+    def free(self, object_id: bytes, eager: bool = False) -> bool:
+        return self._lib.shmstore_free_obj(self._h, object_id, 1 if eager else 0) == 0
+
+    @property
+    def used(self) -> int:
+        return self._lib.shmstore_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.shmstore_capacity(self._h)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.shmstore_count(self._h)
+
+    @property
+    def num_evictions(self) -> int:
+        return self._lib.shmstore_num_evictions(self._h)
+
+    def destroy(self):
+        if self._h:
+            del self._view
+            self._lib.shmstore_destroy(self._h, self.name.encode())
+            self._h = None
+
+
+class NativeStoreClient(_ArenaHandle):
+    """Attaches to an existing arena (worker side)."""
+
+    def __init__(self, name: str):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native shmstore unavailable")
+        h = lib.shmstore_open(name.encode())
+        if not h:
+            raise RuntimeError(f"failed to open arena {name!r}")
+        super().__init__(name, h)
+
+    def close(self):
+        # Deliberately does NOT munmap: zero-copy readers (numpy arrays
+        # deserialized from the store) may alias the mapping for the rest of the
+        # process lifetime — plasma semantics; the kernel reclaims at exit.
+        self._h = None

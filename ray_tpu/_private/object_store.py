@@ -1,16 +1,25 @@
 """Per-node shared-memory object store.
 
 Design parity: reference plasma store (`src/ray/object_manager/plasma/` — dlmalloc arena
-over mmap/shm, LRU eviction, create/seal lifecycle, fd-passing to clients). Here each
-sealed object lives in its own POSIX shm segment created by the raylet process; workers on
-the same node map the segment by name for zero-copy reads (the kernel plays the role of
-the reference's dlmalloc arena; a C++ slab allocator can replace per-object segments
-without changing this API). Lifecycle is the same create → write → seal → (map readers)
-→ free, with capacity accounting and LRU eviction of freed-but-cached entries.
+over mmap/shm, LRU eviction, create/seal lifecycle, fd-passing to clients).
+
+Two backends behind one API:
+- **Native (default)**: one C++ mmap arena per node (`_native/shmstore.cpp` —
+  boundary-tag allocator with coalescing, open-addressing index, LRU eviction, robust
+  process-shared mutex); workers attach the arena once and read payloads zero-copy at
+  offsets. This is the plasma-shaped path: one mapping, allocator-managed placement.
+- **Pure-Python fallback** (`RAY_TPU_NATIVE_STORE=0` or no toolchain): one POSIX shm
+  segment per object, kernel-managed.
+
+Both speak the same name protocol: `info()/create()` return an opaque "location name"
+the reader side resolves (`@arena:offset:size` for native, a segment name otherwise),
+so the raylet/worker wire format is backend-agnostic.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -20,6 +29,16 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
 
 _PREFIX = "rtpu_"
+
+
+def _native_key(object_id: ObjectID) -> bytes:
+    """ObjectIDs are longer than the native index's 16-byte keys; a keyed blake2b
+    digest keeps collisions negligible."""
+    return hashlib.blake2b(object_id.binary(), digest_size=16).digest()
+
+
+def _native_enabled() -> bool:
+    return os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0"
 
 
 class _QuietSharedMemory(shared_memory.SharedMemory):
@@ -65,8 +84,127 @@ class _Entry:
         self.created_at = time.monotonic()
 
 
-class SharedObjectStore:
-    """Server side (runs in the raylet process)."""
+def SharedObjectStore(capacity_bytes: int):
+    """Backend-selecting factory (the raylet's store construction point)."""
+    if _native_enabled():
+        try:
+            return NativeSharedObjectStore(capacity_bytes)
+        except Exception:
+            pass
+    return PySharedObjectStore(capacity_bytes)
+
+
+class NativeSharedObjectStore:
+    """C++ arena backend. Location names: '@<arena>:<offset>:<size>'."""
+
+    def __init__(self, capacity_bytes: int):
+        from ray_tpu._native.shmstore import NativeStoreServer
+
+        self.capacity = capacity_bytes
+        self._arena_name = f"rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
+        self._srv = NativeStoreServer(self._arena_name, capacity_bytes)
+        # Unsealed objects: the native index only serves sealed lookups, but
+        # create()/seal()/put_bytes() need the placement before sealing.
+        self._unsealed: dict[ObjectID, tuple[int, int]] = {}
+        self._lock = threading.Lock()
+
+    def _name_of(self, offset: int, size: int, key: bytes) -> str:
+        # The key rides in the name so readers can pin the object against
+        # eviction-recycling while zero-copy views alias the arena.
+        return f"@{self._arena_name}:{offset}:{size}:{key.hex()}"
+
+    def create(self, object_id: ObjectID, size: int) -> str:
+        key = _native_key(object_id)
+        with self._lock:
+            if object_id in self._unsealed:
+                off, sz = self._unsealed[object_id]
+                return self._name_of(off, sz, key)
+            found = self._srv.lookup(key)
+            if found is not None:
+                return self._name_of(*found, key)
+            try:
+                off = self._srv.alloc(key, size)
+            except FileExistsError:
+                found = self._srv.lookup(key)
+                if found is not None:
+                    return self._name_of(*found, key)
+                raise
+            if off is None:
+                raise ObjectStoreFullError(
+                    f"object of {size} bytes does not fit: "
+                    f"{self._srv.used}/{self.capacity} used"
+                )
+            self._unsealed[object_id] = (off, size)
+            return self._name_of(off, size, key)
+
+    def put_bytes(self, object_id: ObjectID, data: bytes) -> str:
+        name = self.create(object_id, len(data))
+        with self._lock:
+            off, _sz = self._unsealed.get(object_id, (None, None))
+        if off is not None:
+            self._srv.write(off, data)
+            self.seal(object_id)
+        return name
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            if object_id not in self._unsealed:
+                # already sealed (idempotent) or unknown
+                if self._srv.lookup(_native_key(object_id)) is not None:
+                    return
+                raise KeyError(f"seal of unknown object {object_id}")
+            self._unsealed.pop(object_id)
+        self._srv.seal(_native_key(object_id))
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._srv.lookup(_native_key(object_id)) is not None
+
+    def info(self, object_id: ObjectID):
+        key = _native_key(object_id)
+        found = self._srv.lookup(key)
+        if found is None:
+            return None
+        return (self._name_of(*found, key), found[1])
+
+    def read_bytes(self, object_id: ObjectID, offset: int = 0, length: int | None = None) -> bytes:
+        key = _native_key(object_id)
+        found = self._srv.lookup(key)
+        if found is None:
+            raise KeyError(f"object {object_id} not sealed/present")
+        off, size = found
+        end = size if length is None else min(offset + length, size)
+        # Pin across the copy: another process's alloc must not recycle the block
+        # mid-memcpy.
+        self._srv.pin(key)
+        try:
+            return bytes(self._srv.read(off + offset, end - offset))
+        finally:
+            self._srv.release(key)
+
+    def free(self, object_id: ObjectID, eager: bool = False):
+        with self._lock:
+            self._unsealed.pop(object_id, None)
+        self._srv.free(_native_key(object_id), eager=eager)
+
+    @property
+    def used(self) -> int:
+        return self._srv.used
+
+    def stats(self):
+        return {
+            "num_objects": self._srv.num_objects,
+            "used_bytes": self._srv.used,
+            "capacity_bytes": self.capacity,
+            "num_evictions": self._srv.num_evictions,
+            "backend": "native",
+        }
+
+    def destroy(self):
+        self._srv.destroy()
+
+
+class PySharedObjectStore:
+    """Pure-Python fallback: one shm segment per object (server side)."""
 
     def __init__(self, capacity_bytes: int):
         self.capacity = capacity_bytes
@@ -183,14 +321,37 @@ class SharedObjectStore:
 
 
 class LocalObjectReader:
-    """Client side: maps sealed segments by name, caches mappings per process."""
+    """Client side: resolves location names from either backend.
+
+    Native names ('@arena:offset:size') attach the node's arena ONCE and slice the
+    mapping; per-object names map their own segment. Both cached per process."""
 
     def __init__(self):
         self._maps: dict[str, shared_memory.SharedMemory] = {}
+        self._arenas: dict[str, object] = {}
         self._lock = threading.Lock()
+
+    def _arena(self, name: str):
+        client = self._arenas.get(name)
+        if client is None:
+            from ray_tpu._native.shmstore import NativeStoreClient
+
+            client = NativeStoreClient(name)
+            self._arenas[name] = client
+        return client
+
+    @staticmethod
+    def _parse(shm_name: str):
+        arena, off, size, key = shm_name[1:].rsplit(":", 3)
+        return arena, int(off), int(size), bytes.fromhex(key)
 
     def read(self, shm_name: str, size: int) -> memoryview:
         with self._lock:
+            if shm_name.startswith("@"):
+                arena, off, sz, key = self._parse(shm_name)
+                # Pinned view: the arena can't recycle this payload while any
+                # deserialized alias of the returned buffer is alive.
+                return self._arena(arena).read_pinned(key, off, min(size, sz))
             shm = self._maps.get(shm_name)
             if shm is None:
                 shm = _QuietSharedMemory(name=shm_name)
@@ -200,6 +361,15 @@ class LocalObjectReader:
 
     def write(self, shm_name: str, data: bytes):
         with self._lock:
+            if shm_name.startswith("@"):
+                arena, off, sz, _key = self._parse(shm_name)
+                if len(data) > sz:
+                    raise ValueError(
+                        f"write of {len(data)} bytes exceeds the {sz}-byte "
+                        f"allocation at {shm_name}"
+                    )
+                self._arena(arena).write(off, data)
+                return
             shm = self._maps.get(shm_name)
             if shm is None:
                 shm = _QuietSharedMemory(name=shm_name)
@@ -208,6 +378,8 @@ class LocalObjectReader:
         shm.buf[: len(data)] = data
 
     def release(self, shm_name: str):
+        if shm_name.startswith("@"):
+            return  # arena mapping is shared; nothing per-object to unmap
         with self._lock:
             shm = self._maps.pop(shm_name, None)
         if shm is not None:
@@ -224,3 +396,9 @@ class LocalObjectReader:
                 except Exception:
                     pass
             self._maps.clear()
+            for client in self._arenas.values():
+                try:
+                    client.close()
+                except Exception:
+                    pass
+            self._arenas.clear()

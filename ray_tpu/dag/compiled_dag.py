@@ -41,9 +41,14 @@ def _read_source(kind, src):
     if kind == "pick":
         reader, key = src
         value = reader.read()
-        if isinstance(key, str) and hasattr(value, key):
-            return getattr(value, key)
-        return value[key]
+        try:
+            # The channel read already happened (acks stay consistent); only the
+            # projection can fail, and that failure flows through the graph.
+            if isinstance(key, str) and hasattr(value, key):
+                return getattr(value, key)
+            return value[key]
+        except Exception as e:
+            return _WrappedError(e)
     return src
 
 
@@ -72,7 +77,15 @@ def _exec_loop(instance, specs: List[_ExecSpec]):
                 else:
                     out = err
                 if spec.out_channel is not None:
-                    spec.out_channel.write(out)
+                    try:
+                        spec.out_channel.write(out)
+                    except ChannelClosed:
+                        raise
+                    except Exception as e:
+                        # e.g. result larger than the channel slot: report the
+                        # error IN PLACE of the oversized value so the loop (and
+                        # downstream consumers) stay alive and in sync.
+                        spec.out_channel.write(_WrappedError(e))
         except ChannelClosed:
             return "closed"
 

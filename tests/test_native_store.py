@@ -1,0 +1,172 @@
+"""Native C++ object store tests: direct API plus end-to-end through the runtime.
+
+Parity role: the reference plasma store's C++ unit tests
+(src/ray/object_manager/plasma/ + store tests) — create/seal/get lifecycle, LRU
+eviction of freed objects, allocator coalescing under churn, and cross-process reads.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._native.shmstore import NativeStoreClient, NativeStoreServer, load
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    LocalObjectReader,
+    NativeSharedObjectStore,
+    SharedObjectStore,
+)
+
+pytestmark = pytest.mark.skipif(load() is None, reason="native toolchain unavailable")
+
+
+def test_native_lifecycle_and_eviction():
+    srv = NativeStoreServer(f"rtpu_t1_{os.getpid()}", 4 << 20)
+    try:
+        a = bytes([7] * 16)
+        off = srv.alloc(a, 2 << 20)
+        srv.write(off, b"a" * (2 << 20))
+        assert srv.lookup(a) is None  # unsealed: invisible
+        srv.seal(a)
+        assert srv.lookup(a) == (off, 2 << 20)
+        # second big object requires evicting the freed first
+        assert srv.alloc(bytes([8] * 16), 3 << 20) is None
+        srv.free(a)
+        off2 = srv.alloc(bytes([8] * 16), 3 << 20)
+        assert off2 is not None and srv.num_evictions == 1
+    finally:
+        srv.destroy()
+
+
+def test_native_allocator_churn_preserves_data():
+    srv = NativeStoreServer(f"rtpu_t2_{os.getpid()}", 8 << 20)
+    try:
+        rng = np.random.default_rng(0)
+        live = {}
+        for round_ in range(300):
+            oid = int(round_).to_bytes(16, "big")
+            size = int(rng.integers(100, 50_000))
+            off = srv.alloc(oid, size)
+            if off is None:
+                break
+            payload = bytes([round_ % 256]) * size
+            srv.write(off, payload)
+            srv.seal(oid)
+            live[oid] = (off, size, round_ % 256)
+            if rng.random() < 0.4 and live:
+                victim = list(live)[int(rng.integers(len(live)))]
+                srv.free(victim, eager=True)
+                del live[victim]
+        # all remaining objects intact
+        for oid, (off, size, byte) in live.items():
+            got = srv.lookup(oid)
+            assert got == (off, size)
+            view = srv.read(off, size)
+            assert view[0] == byte and view[size - 1] == byte
+    finally:
+        srv.destroy()
+
+
+def test_store_api_native_backend():
+    store = SharedObjectStore(4 << 20)
+    assert isinstance(store, NativeSharedObjectStore), "native backend expected"
+    try:
+        oid = ObjectID.rand() if hasattr(ObjectID, "rand") else ObjectID(os.urandom(ObjectID.SIZE))
+        name = store.put_bytes(oid, b"hello world")
+        assert name.startswith("@")
+        assert store.contains(oid)
+        got_name, size = store.info(oid)
+        assert size == 11
+        assert store.read_bytes(oid) == b"hello world"
+        assert store.read_bytes(oid, offset=6, length=5) == b"world"
+        reader = LocalObjectReader()
+        assert bytes(reader.read(got_name, size)) == b"hello world"
+        store.free(oid, eager=True)
+        assert not store.contains(oid)
+        st = store.stats()
+        assert st["backend"] == "native"
+    finally:
+        store.destroy()
+
+
+def test_runtime_end_to_end_on_native_store(ray_start_isolated):
+    # the module fixture cluster in other files may predate this test; isolated
+    # cluster guarantees the native store is what backs put/get here.
+    arr = np.arange(200_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    np.testing.assert_array_equal(ray_tpu.get(double.remote(ref)), arr * 2)
+
+
+def test_pinned_read_survives_eviction():
+    srv = NativeStoreServer(f"rtpu_t3_{os.getpid()}", 4 << 20)
+    try:
+        a = bytes([9] * 16)
+        off = srv.alloc(a, 1 << 20)
+        srv.write(off, b"\xab" * (1 << 20))
+        srv.seal(a)
+        cli = NativeStoreClient(srv.name)
+        view = cli.read_pinned(a, off, 1 << 20)
+        arr = np.frombuffer(view, dtype=np.uint8)
+        # free + pressure: allocator must NOT recycle the pinned block
+        srv.free(a)
+        filler = bytes([10] * 16)
+        got = srv.alloc(filler, 2500 << 10)  # fits without touching pinned block
+        assert got is not None
+        srv.write(got, b"\x00" * (2500 << 10))
+        # a second alloc that WOULD need the pinned block must fail
+        assert srv.alloc(bytes([11] * 16), 1 << 20) is None
+        assert arr[0] == 0xAB and arr[-1] == 0xAB  # data intact under pressure
+        # drop the alias: pin releases, eviction proceeds
+        del arr, view
+        import gc
+
+        gc.collect()
+        assert srv.alloc(bytes([11] * 16), 1 << 20) is not None
+    finally:
+        srv.destroy()
+
+
+def test_reader_write_bounds_checked():
+    store = SharedObjectStore(1 << 20)
+    try:
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        name = store.create(oid, 100)
+        reader = LocalObjectReader()
+        with pytest.raises(ValueError, match="exceeds"):
+            reader.write(name, b"z" * 4096)
+        reader.write(name, b"ok")
+        store.seal(oid)
+        assert store.read_bytes(oid, length=2) == b"ok"
+    finally:
+        store.destroy()
+
+
+def test_dag_oversized_output_surfaces_error(ray_start_isolated):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Big:
+        def make(self, n):
+            return np.zeros(n, np.uint8)
+
+    b = Big.remote()
+    with InputNode() as inp:
+        dag = b.make.bind(inp)
+    compiled = dag.experimental_compile(buffer_size_bytes=1 << 16)
+    try:
+        with pytest.raises(Exception, match="exceeds"):
+            compiled.execute(1 << 20).get()
+        # loop survives: a small value goes through fine afterwards
+        out = compiled.execute(100).get()
+        assert len(out) == 100
+    finally:
+        compiled.teardown()
