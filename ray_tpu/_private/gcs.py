@@ -514,6 +514,18 @@ class GcsService:
                     pass
         return True
 
+    async def rpc_list_objects(self, conn, limit: int = 1000):
+        out = []
+        for oid, entry in self.object_dir.items():
+            out.append({
+                "object_id": oid.hex(),
+                "size": entry["size"],
+                "num_locations": len(entry["locations"]),
+            })
+            if len(out) >= limit:
+                break
+        return out
+
     async def rpc_list_placement_groups(self, conn):
         return [
             {"pg_id": pg.pg_id, "state": pg.state, "strategy": pg.strategy, "name": pg.name}

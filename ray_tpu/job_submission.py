@@ -1,0 +1,198 @@
+"""Job submission: run driver scripts on the cluster, supervised and observable.
+
+Design parity: reference `python/ray/dashboard/modules/job/` — `JobSubmissionClient`
+(sdk.py:36) + the job manager/supervisor pattern (`job_manager.py`,
+`job_supervisor.py`: the entrypoint runs as a subprocess under a supervisor actor;
+status and logs are recorded centrally). Here status lives in the GCS KV store and
+logs in a per-job file the supervisor tails back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+_NS = "job"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class _JobSupervisor:
+    """Async actor: runs one job's entrypoint as a subprocess and records state."""
+
+    def __init__(self, job_id: str, entrypoint: str, env: dict, cwd: Optional[str]):
+        self._job_id = job_id
+        self._entrypoint = entrypoint
+        self._env = env
+        self._cwd = cwd
+        self._proc = None
+        self._log_path = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"rtpu_job_{job_id}.log"
+        )
+
+    def _put_status(self, status: str, message: str = ""):
+        import ray_tpu
+
+        worker = ray_tpu.global_worker()
+        payload = {
+            "job_id": self._job_id,
+            "status": status,
+            "entrypoint": self._entrypoint,
+            "message": message,
+            "log_path": self._log_path,
+            "updated_at": time.time(),
+        }
+        worker.gcs_call("kv_put", _NS, self._job_id.encode(),
+                        json.dumps(payload).encode(), True)
+
+    async def run(self) -> str:
+        import asyncio
+        import subprocess
+
+        import ray_tpu
+
+        worker = ray_tpu.global_worker()
+        env = dict(os.environ)
+        env.update(self._env)
+        # The entrypoint attaches to THIS cluster as a driver.
+        gcs_host, gcs_port = worker.gcs_addr
+        env["RAY_TPU_ADDRESS"] = f"{gcs_host}:{gcs_port}"
+        env["RAY_TPU_RAYLET_PORT"] = str(worker.raylet_addr[1])
+        self._put_status(JobStatus.RUNNING)
+        loop = asyncio.get_running_loop()
+
+        def run_proc():
+            with open(self._log_path, "wb") as log:
+                self._proc = subprocess.Popen(
+                    self._entrypoint, shell=True, stdout=log, stderr=log,
+                    env=env, cwd=self._cwd,
+                )
+                return self._proc.wait()
+
+        code = await loop.run_in_executor(None, run_proc)
+        if code == 0:
+            self._put_status(JobStatus.SUCCEEDED)
+            return JobStatus.SUCCEEDED
+        status = JobStatus.STOPPED if code in (-15, -9) else JobStatus.FAILED
+        self._put_status(status, f"exit code {code}")
+        return status
+
+    async def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            return True
+        return False
+
+    async def logs(self, tail_bytes: int = 65536) -> str:
+        try:
+            with open(self._log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+
+class JobSubmissionClient:
+    """Parity: reference JobSubmissionClient(address).submit_job(entrypoint=...)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, ignore_reinit_error=True)
+        self._worker = ray_tpu.global_worker()
+
+    @classmethod
+    def _attached(cls) -> "JobSubmissionClient":
+        return cls()
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        job_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+        entrypoint_num_cpus: float = 0,
+    ) -> str:
+        job_id = job_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        env = dict((runtime_env or {}).get("env_vars", {}))
+        cwd = (runtime_env or {}).get("working_dir")
+        supervisor_cls = ray_tpu.remote(num_cpus=entrypoint_num_cpus)(_JobSupervisor)
+        supervisor = supervisor_cls.options(
+            name=f"_rtpu_job_supervisor_{job_id}", namespace="job",
+        ).remote(job_id, entrypoint, env, cwd)
+        self._worker.gcs_call(
+            "kv_put", _NS, job_id.encode(),
+            json.dumps({
+                "job_id": job_id, "status": JobStatus.PENDING,
+                "entrypoint": entrypoint, "message": "", "updated_at": time.time(),
+            }).encode(), True,
+        )
+        supervisor.run.remote()  # fire and forget; status lands in KV
+        return job_id
+
+    def _info(self, job_id: str) -> Optional[dict]:
+        raw = self._worker.gcs_call("kv_get", _NS, job_id.encode())
+        return json.loads(raw) if raw else None
+
+    def get_job_status(self, job_id: str) -> Optional[str]:
+        info = self._info(job_id)
+        return info["status"] if info else None
+
+    def get_job_info(self, job_id: str) -> Optional[dict]:
+        return self._info(job_id)
+
+    def list_jobs(self) -> List[dict]:
+        keys = self._worker.gcs_call("kv_keys", _NS, b"")
+        out = []
+        for key in keys:
+            raw = self._worker.gcs_call("kv_get", _NS, key)
+            if raw:
+                out.append(json.loads(raw))
+        return out
+
+    def get_job_logs(self, job_id: str) -> str:
+        try:
+            supervisor = ray_tpu.get_actor(
+                f"_rtpu_job_supervisor_{job_id}", namespace="job"
+            )
+            return ray_tpu.get(supervisor.logs.remote())
+        except Exception:
+            info = self._info(job_id)
+            if info and info.get("log_path") and os.path.exists(info["log_path"]):
+                with open(info["log_path"], errors="replace") as f:
+                    return f.read()
+            return ""
+
+    def stop_job(self, job_id: str) -> bool:
+        try:
+            supervisor = ray_tpu.get_actor(
+                f"_rtpu_job_supervisor_{job_id}", namespace="job"
+            )
+            return ray_tpu.get(supervisor.stop.remote())
+        except Exception:
+            return False
+
+    def wait_until_status(self, job_id: str, statuses=JobStatus.TERMINAL,
+                          timeout: float = 120) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in statuses:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} not in {statuses} after {timeout}s")

@@ -1,0 +1,257 @@
+"""Operator CLI: `python -m ray_tpu.scripts.scripts <command>`.
+
+Parity: reference `python/ray/scripts/scripts.py` — start/stop/status/list/summary,
+job submit/status/logs, microbenchmark. The head address is written to a well-known
+file so follow-on commands (and `ray_tpu.init(address="auto")` semantics) find it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_ADDR_FILE = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "head_address.json"
+)
+
+
+def _write_addr(gcs_port: int, raylet_port: int):
+    os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
+    with open(_ADDR_FILE, "w") as f:
+        json.dump({"gcs_port": gcs_port, "raylet_port": raylet_port,
+                   "pid": os.getpid()}, f)
+
+
+def read_addr():
+    try:
+        with open(_ADDR_FILE) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _connect_from_file():
+    import ray_tpu
+
+    addr = read_addr()
+    if addr is None:
+        print("no running head found (start one with: ... start --head)", file=sys.stderr)
+        sys.exit(1)
+    os.environ["RAY_TPU_RAYLET_PORT"] = str(addr["raylet_port"])
+    ray_tpu.init(address=f"127.0.0.1:{addr['gcs_port']}")
+
+
+def cmd_start(args):
+    from ray_tpu._private import node as node_mod
+
+    if not args.head and not args.address:
+        print("worker nodes need --address=host:gcs_port", file=sys.stderr)
+        sys.exit(1)
+    session_dir = node_mod.make_session_dir()
+    resources = {"CPU": float(args.num_cpus or (os.cpu_count() or 1))}
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    if args.head:
+        handle = node_mod.start_node(
+            head=True, gcs_addr=None, resources=resources, labels=None,
+            session_dir=session_dir,
+            object_store_bytes=args.object_store_memory or 0,
+            worker_env=None,
+        )
+        _write_addr(handle.gcs_port, handle.raylet_port)
+        print(f"head started: gcs=127.0.0.1:{handle.gcs_port} "
+              f"raylet_port={handle.raylet_port}")
+    else:
+        host, port = args.address.split(":")
+        handle = node_mod.start_node(
+            head=False, gcs_addr=(host, int(port)), resources=resources,
+            labels=None, session_dir=session_dir,
+            object_store_bytes=args.object_store_memory or 0, worker_env=None,
+        )
+        print(f"node started, joined {args.address}; raylet_port={handle.raylet_port}")
+    if args.block or args.head:
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.5)
+        finally:
+            handle.terminate()
+            if args.head:
+                try:
+                    os.remove(_ADDR_FILE)
+                except OSError:
+                    pass
+
+
+def cmd_stop(_args):
+    addr = read_addr()
+    if addr is None:
+        print("no running head found")
+        return
+    try:
+        os.kill(addr["pid"], signal.SIGTERM)
+        print(f"sent SIGTERM to head pid {addr['pid']}")
+    except ProcessLookupError:
+        print("head process already gone")
+    try:
+        os.remove(_ADDR_FILE)
+    except OSError:
+        pass
+
+
+def cmd_status(_args):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _connect_from_file()
+    summary = state.cluster_summary()
+    print(json.dumps(summary, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_list(args):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _connect_from_file()
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[args.entity]
+    for row in fn():
+        print(json.dumps(row, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_job_submit(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    _connect_from_file()
+    client = JobSubmissionClient()
+    # Drop only the LEADING argparse separator; later '--' tokens belong to the
+    # user's command line.
+    entrypoint = args.entrypoint
+    if entrypoint and entrypoint[0] == "--":
+        entrypoint = entrypoint[1:]
+    job_id = client.submit_job(entrypoint=" ".join(entrypoint))
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        ray_tpu.shutdown()
+        return
+    status = client.wait_until_status(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    ray_tpu.shutdown()
+    sys.exit(0 if status == JobStatus.SUCCEEDED else 1)
+
+
+def cmd_job_logs(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    _connect_from_file()
+    print(JobSubmissionClient().get_job_logs(args.job_id), end="")
+    ray_tpu.shutdown()
+
+
+def cmd_microbenchmark(_args):
+    """Parity: `ray microbenchmark` (python/ray/_private/ray_perf.py) — core op rates."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    def rate(n, fn):
+        t0 = time.monotonic()
+        fn(n)
+        return n / (time.monotonic() - t0)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote())
+    print(f"single_client_tasks_sync: "
+          f"{rate(300, lambda n: [ray_tpu.get(noop.remote()) for _ in range(n)]):.1f}/s")
+    print(f"single_client_tasks_async: "
+          f"{rate(1000, lambda n: ray_tpu.get([noop.remote() for _ in range(n)])):.1f}/s")
+
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.f.remote())
+    print(f"1_1_actor_calls_sync: "
+          f"{rate(300, lambda n: [ray_tpu.get(a.f.remote()) for _ in range(n)]):.1f}/s")
+    print(f"1_1_actor_calls_async: "
+          f"{rate(1000, lambda n: ray_tpu.get([a.f.remote() for _ in range(n)])):.1f}/s")
+
+    arr = np.zeros(1024 * 1024, dtype=np.uint8)
+    ray_tpu.get(ray_tpu.put(arr))
+    print(f"single_client_put_1MiB: "
+          f"{rate(100, lambda n: [ray_tpu.put(arr) for _ in range(n)]):.1f}/s")
+    big = np.zeros(256 << 20, dtype=np.uint8)
+    t0 = time.monotonic()
+    for _ in range(4):
+        ray_tpu.get(ray_tpu.put(big))
+    gib = 4 * big.nbytes / (time.monotonic() - t0) / 2**30
+    print(f"put+get bandwidth: {gib:.2f} GiB/s")
+    ray_tpu.shutdown()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="gcs address host:port to join")
+    p.add_argument("--num-cpus", type=int)
+    p.add_argument("--resources", help='JSON, e.g. \'{"TPU": 4}\'')
+    p.add_argument("--object-store-memory", type=int)
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop the local head").set_defaults(fn=cmd_stop)
+    sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity", choices=["nodes", "actors", "tasks", "objects",
+                                      "placement-groups", "jobs"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("job", help="job commands")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("--no-wait", action="store_true")
+    ps.add_argument("--timeout", type=float, default=600)
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    ps.set_defaults(fn=cmd_job_submit)
+    pl = jsub.add_parser("logs")
+    pl.add_argument("job_id")
+    pl.set_defaults(fn=cmd_job_logs)
+
+    sub.add_parser("microbenchmark", help="core op throughput").set_defaults(
+        fn=cmd_microbenchmark
+    )
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""ActorPool: load-balance work over a fixed set of actors.
+
+Parity: reference `python/ray/util/actor_pool.py` — submit/get_next/
+get_next_unordered/map/map_unordered/has_next/push/pop_idle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order. On timeout the future stays pending
+        (retry later); on task error the actor is still returned to the pool."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        self._return_actor(self._future_to_actor.pop(ref))
+        return ray_tpu.get(ref)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in COMPLETION order; same actor-return guarantees."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        # keep ordered bookkeeping consistent
+        for idx, fut in list(self._index_to_future.items()):
+            if fut is ref or fut == ref:
+                del self._index_to_future[idx]
+                break
+        self._return_actor(self._future_to_actor.pop(ref))
+        return ray_tpu.get(ref)
+
+    def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor):
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,167 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Parity: reference `python/ray/util/metrics.py` — metrics recorded from any worker,
+aggregated cluster-wide (the per-node agent → Prometheus pipeline role is played by
+the GCS KV store here; `collect_all()` returns the merged series and
+`prometheus_text()` renders the exposition format).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_FLUSH_INTERVAL_S = 2.0
+_NAMESPACE = "metrics"
+
+
+def _worker():
+    import ray_tpu
+
+    return ray_tpu.global_worker()
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        self._last_flush = 0.0
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+    def _maybe_flush(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_flush < _FLUSH_INTERVAL_S:
+            return
+        self._last_flush = now
+        try:
+            worker = _worker()
+            with self._lock:
+                payload = {
+                    "name": self._name,
+                    "type": type(self).__name__.lower(),
+                    "description": self._description,
+                    "series": [
+                        {"tags": dict(k), "value": v} for k, v in self._values.items()
+                    ],
+                    "ts": time.time(),
+                }
+            key = f"{self._name}:{worker.worker_id.hex()}".encode()
+            worker.gcs_call(
+                "kv_put", _NAMESPACE, key, json.dumps(payload).encode(), True
+            )
+        except Exception:
+            pass  # metrics must never break the workload
+
+    def flush(self):
+        self._maybe_flush(force=True)
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        self._maybe_flush()
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = value
+        self._maybe_flush()
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        base = dict(self._key(tags))
+        with self._lock:
+            for b in self._boundaries:
+                if value <= b:
+                    key = tuple(sorted({**base, "le": str(b)}.items()))
+                    self._values[key] = self._values.get(key, 0.0) + 1
+            inf_key = tuple(sorted({**base, "le": "+Inf"}.items()))
+            self._values[inf_key] = self._values.get(inf_key, 0.0) + 1
+            sum_key = tuple(sorted({**base, "stat": "sum"}.items()))
+            self._values[sum_key] = self._values.get(sum_key, 0.0) + value
+        self._maybe_flush()
+
+
+def collect_all() -> List[dict]:
+    """All flushed metric payloads across the cluster (driver-side)."""
+    worker = _worker()
+    keys = worker.gcs_call("kv_keys", _NAMESPACE, b"")
+    out = []
+    for key in keys:
+        raw = worker.gcs_call("kv_get", _NAMESPACE, key)
+        if raw:
+            out.append(json.loads(raw))
+    return out
+
+
+def prometheus_text() -> str:
+    """Render all metrics in Prometheus exposition format."""
+    lines = []
+    merged: Dict[Tuple[str, str], Dict[Tuple, float]] = {}
+    descs: Dict[str, Tuple[str, str]] = {}
+    for payload in collect_all():
+        name, mtype = payload["name"], payload["type"]
+        descs[name] = (payload.get("description", ""), mtype)
+        series = merged.setdefault((name, mtype), {})
+        for s in payload["series"]:
+            key = tuple(sorted(s["tags"].items()))
+            if mtype == "gauge":
+                series[key] = s["value"]
+            else:
+                series[key] = series.get(key, 0.0) + s["value"]
+    for (name, mtype), series in merged.items():
+        desc, _ = descs[name]
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for key, value in series.items():
+            tags_d = dict(key)
+            if mtype == "histogram":
+                # Proper exposition: name_bucket{le=...}, name_sum, name_count.
+                if tags_d.pop("stat", None) == "sum":
+                    base = ",".join(f'{k}="{v}"' for k, v in sorted(tags_d.items()))
+                    lines.append(
+                        f"{name}_sum{{{base}}} {value}" if base else f"{name}_sum {value}"
+                    )
+                    continue
+                le = tags_d.pop("le", None)
+                base_items = sorted(tags_d.items())
+                if le is not None:
+                    tags = ",".join(
+                        f'{k}="{v}"' for k, v in base_items + [("le", le)]
+                    )
+                    lines.append(f"{name}_bucket{{{tags}}} {value}")
+                    if le == "+Inf":
+                        base = ",".join(f'{k}="{v}"' for k, v in base_items)
+                        lines.append(
+                            f"{name}_count{{{base}}} {value}"
+                            if base else f"{name}_count {value}"
+                        )
+                    continue
+            tags = ",".join(f'{k}="{v}"' for k, v in key)
+            lines.append(f"{name}{{{tags}}} {value}" if tags else f"{name} {value}")
+    return "\n".join(lines) + "\n"

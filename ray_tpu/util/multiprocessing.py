@@ -1,0 +1,207 @@
+"""multiprocessing.Pool-compatible API over the distributed runtime.
+
+Parity: reference `python/ray/util/multiprocessing/pool.py` — Pool with
+map/starmap/imap/imap_unordered/apply/apply_async over remote tasks, so existing
+`multiprocessing` code scales past one machine by changing an import.
+`processes` is honored as a true concurrency cap (at most that many chunks in
+flight), and the initializer runs once per worker process before any work — the
+standard multiprocessing contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+# Worker-process-side: initializers that already ran here (keyed by identity).
+_initialized: set = set()
+
+
+def _run_chunk(fn, arg_tuples: List[tuple], initializer=None, initargs=()) -> List[Any]:
+    if initializer is not None:
+        key = (getattr(initializer, "__module__", ""),
+               getattr(initializer, "__qualname__", repr(initializer)))
+        if key not in _initialized:
+            initializer(*initargs)
+            _initialized.add(key)
+    return [fn(*args) for args in arg_tuples]
+
+
+class AsyncResult:
+    """Windowed executor: keeps at most `window` chunk tasks in flight."""
+
+    def __init__(self, task, chunk_args: List[tuple], window: int,
+                 single: bool = False, flatten: bool = True):
+        self._refs: List = []
+        self._single = single
+        self._flatten = flatten
+        self._total = len(chunk_args)
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+        def run():
+            inflight: List = []
+            try:
+                for args in chunk_args:
+                    while len(inflight) >= window:
+                        _ready, rest = ray_tpu.wait(inflight, num_returns=1,
+                                                    timeout=None)
+                        inflight = list(rest)
+                    ref = task.remote(*args)
+                    self._refs.append(ref)
+                    inflight.append(ref)
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _ref_at(self, i: int, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while i >= len(self._refs):
+            if self._done.is_set() and i >= len(self._refs):
+                if self._error is not None:
+                    raise self._error
+                raise IndexError(i)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("result not ready")
+            time.sleep(0.005)
+        return self._refs[i]
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if not self._done.wait(timeout):
+            raise TimeoutError("pool tasks still submitting")
+        if self._error is not None:
+            raise self._error
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        chunks = ray_tpu.get(self._refs, remaining)
+        if self._single:
+            return chunks[0][0]
+        if self._flatten:
+            return list(itertools.chain.from_iterable(chunks))
+        return chunks
+
+    def wait(self, timeout: Optional[float] = None):
+        if self._done.wait(timeout):
+            ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        if not self._done.is_set():
+            return False
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+    def iter_ordered(self):
+        i = 0
+        while True:
+            try:
+                ref = self._ref_at(i)
+            except IndexError:
+                return
+            yield from ray_tpu.get(ref)
+            i += 1
+
+    def iter_unordered(self):
+        seen: set = set()
+        while True:
+            self._done.wait(0.005)
+            pending = [r for r in self._refs if r.id not in seen]
+            if not pending:
+                if self._done.is_set():
+                    if self._error is not None:
+                        raise self._error
+                    return
+                continue
+            ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=1)
+            for ref in ready:
+                seen.add(ref.id)
+                yield from ray_tpu.get(ref)
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=(), **_kwargs):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cpus = ray_tpu.cluster_resources().get("CPU", 1)
+        self._size = processes or max(1, int(cpus))
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+        self._closed = False
+        self._chunk_task = ray_tpu.remote(num_cpus=1)(_run_chunk)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunks(self, items: List[tuple], chunksize: Optional[int]) -> List[tuple]:
+        chunksize = chunksize or max(1, len(items) // (self._size * 4) or 1)
+        return [
+            (items[c : c + chunksize],)
+            for c in range(0, len(items), chunksize)
+        ]
+
+    def _submit(self, fn, arg_tuples: List[tuple], chunksize, single=False,
+                flatten=True) -> AsyncResult:
+        self._check_open()
+        chunk_args = [
+            (fn, chunk[0], self._initializer, self._initargs)
+            for chunk in self._chunks(arg_tuples, chunksize)
+        ]
+        return AsyncResult(self._chunk_task, chunk_args, self._size,
+                           single=single, flatten=flatten)
+
+    def apply(self, fn: Callable, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args=(), kwds=None) -> AsyncResult:
+        kwds = dict(kwds or {})
+        call = (lambda *a: fn(*a, **kwds)) if kwds else fn
+        return self._submit(call, [tuple(args)], chunksize=1, single=True)
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize: Optional[int] = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return self._submit(fn, [(i,) for i in iterable], chunksize)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple], chunksize=None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return self._submit(fn, [tuple(t) for t in iterable], chunksize)
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        return self._submit(fn, [(i,) for i in iterable], chunksize).iter_ordered()
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        return self._submit(fn, [(i,) for i in iterable], chunksize).iter_unordered()
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -1,0 +1,135 @@
+"""Distributed FIFO queue backed by an async actor.
+
+Parity: reference `python/ray/util/queue.py` — Queue with put/get (blocking with
+timeout), qsize/empty/full, put_nowait/get_nowait, batch variants.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                return (True, await self._q.get())
+            return (True, await asyncio.wait_for(self._q.get(), timeout))
+        except asyncio.TimeoutError:
+            return (False, None)
+
+    async def put_nowait(self, item):
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return (True, self._q.get_nowait())
+        except asyncio.QueueEmpty:
+            return (False, None)
+
+    async def put_nowait_batch(self, items: List[Any]):
+        # Atomic: reject the whole batch if it cannot fit (no partial inserts).
+        if self._q.maxsize and self._q.qsize() + len(items) > self._q.maxsize:
+            return False
+        for item in items:
+            self._q.put_nowait(item)
+        return True
+
+    async def get_nowait_batch(self, num_items: int):
+        # Atomic: reject if fewer than num_items present (no partial pops).
+        if self._q.qsize() < num_items:
+            return (False, None)
+        return (True, [self._q.get_nowait() for _ in range(num_items)])
+
+    async def qsize(self):
+        return self._q.qsize()
+
+    async def empty(self):
+        return self._q.empty()
+
+    async def full(self):
+        return self._q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = {"num_cpus": 0, **(actor_options or {})}
+        self._actor = ray_tpu.remote(**opts)(_QueueActor).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            return self.put_nowait(item)
+        ok = ray_tpu.get(self._actor.put.remote(item, timeout))
+        if not ok:
+            raise Full("queue put timed out")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            return self.get_nowait()
+        ok, item = ray_tpu.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue get timed out")
+        return item
+
+    def put_nowait(self, item: Any):
+        if not ray_tpu.get(self._actor.put_nowait.remote(item)):
+            raise Full("queue is full")
+
+    def get_nowait(self) -> Any:
+        ok, item = ray_tpu.get(self._actor.get_nowait.remote())
+        if not ok:
+            raise Empty("queue is empty")
+        return item
+
+    def put_nowait_batch(self, items: List[Any]):
+        if not ray_tpu.get(self._actor.put_nowait_batch.remote(list(items))):
+            raise Full(f"batch of {len(items)} does not fit")
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        ok, items = ray_tpu.get(self._actor.get_nowait_batch.remote(num_items))
+        if not ok:
+            raise Empty(f"fewer than {num_items} items in queue")
+        return items
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self._actor.full.remote())
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass

@@ -1,0 +1,93 @@
+"""State API: observability over cluster entities.
+
+Parity: reference `python/ray/util/state/api.py` (`ray list tasks|actors|nodes|...`,
+`ray summary tasks`) backed by GCS tables + task events (the GcsTaskManager role,
+`src/ray/gcs/gcs_task_manager.h`).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+def _gcs(*args):
+    return ray_tpu.global_worker().gcs_call(*args)
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _gcs("get_nodes")
+
+
+def list_actors(*, filters=None) -> List[Dict[str, Any]]:
+    actors = _gcs("list_actors")
+    if filters:
+        for key, op, value in filters:
+            assert op == "=", "only '=' filters are supported"
+            actors = [a for a in actors if str(a.get(key)) == str(value)]
+    return actors
+
+
+def list_tasks(*, limit: int = 1000, filters=None) -> List[Dict[str, Any]]:
+    events = _gcs("list_task_events", limit)
+    if filters:
+        for key, op, value in filters:
+            assert op == "=", "only '=' filters are supported"
+            events = [e for e in events if str(e.get(key)) == str(value)]
+    return events
+
+
+def list_objects(*, limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs("list_objects", limit)
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _gcs("list_placement_groups")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    return JobSubmissionClient._attached().list_jobs()
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Task counts by state (parity: `ray summary tasks`)."""
+    by_state: Counter = Counter()
+    for e in list_tasks(limit=100_000):
+        by_state[e.get("state", "UNKNOWN")] += 1
+    return dict(by_state)
+
+
+def summarize_actors() -> Dict[str, int]:
+    by_state: Counter = Counter()
+    for a in list_actors():
+        by_state[a.get("state", "UNKNOWN")] += 1
+    return dict(by_state)
+
+
+def cluster_summary() -> Dict[str, Any]:
+    nodes = list_nodes()
+    return {
+        "nodes": len(nodes),
+        "alive_nodes": sum(1 for n in nodes if n.get("alive", True)),
+        "resources_total": ray_tpu.cluster_resources(),
+        "resources_available": ray_tpu.available_resources(),
+        "tasks": summarize_tasks(),
+        "actors": summarize_actors(),
+    }
+
+
+__all__ = [
+    "cluster_summary",
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "summarize_actors",
+    "summarize_tasks",
+]

@@ -1,0 +1,225 @@
+"""Platform services tests: state API, ActorPool, Queue, multiprocessing Pool,
+metrics, job submission.
+
+Shape parity: reference python/ray/tests/test_state_api*.py, test_actor_pool.py,
+test_queue.py, test_multiprocessing.py, test_metrics*.py, dashboard job tests.
+"""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, collect_all, prometheus_text
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+
+
+def test_state_lists():
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.remote()
+    assert ray_tpu.get(p.ping.remote()) == "pong"
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1
+    actors = state.list_actors()
+    assert any(a.get("class_name") == "Pinger" for a in actors)
+    # task events reach the GCS on a flush interval: poll briefly
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        tasks = state.list_tasks()
+        if any("ping" in str(t.get("name", "")) for t in tasks):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"no ping task event in {tasks[:5]}")
+    summary = state.cluster_summary()
+    assert summary["alive_nodes"] >= 1
+    assert "CPU" in summary["resources_total"]
+
+
+def test_actor_pool_ordered_and_unordered():
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote(), Sq.remote()])
+    assert list(pool.map(lambda a, v: a.sq.remote(v), range(8))) == [i * i for i in range(8)]
+    out = sorted(pool.map_unordered(lambda a, v: a.sq.remote(v), range(8)))
+    assert out == sorted(i * i for i in range(8))
+
+
+def test_queue_blocking_and_nowait():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Exception):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.qsize() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_multiprocessing_pool():
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq_for_pool, range(10)) == [i * i for i in range(10)]
+        assert pool.starmap(_add_for_pool, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(_sq_for_pool, (6,))
+        assert r.get(timeout=60) == 36
+        assert sorted(pool.imap_unordered(_sq_for_pool, range(6), chunksize=2)) == [
+            i * i for i in range(6)
+        ]
+
+
+def _sq_for_pool(x):
+    return x * x
+
+
+def _add_for_pool(a, b):
+    return a + b
+
+
+def test_metrics_roundtrip():
+    c = Counter("test_requests_total", "test counter", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("test_inflight", "gauge")
+    g.set(7)
+    h = Histogram("test_latency", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    for m in (c, g, h):
+        m.flush()
+    all_metrics = collect_all()
+    names = {m["name"] for m in all_metrics}
+    assert {"test_requests_total", "test_inflight", "test_latency"} <= names
+    text = prometheus_text()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_inflight 7" in text
+
+
+def test_job_submission_end_to_end(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "job.py"
+    script.write_text("print('hello from job'); import sys; sys.exit(0)\n")
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_status(job_id, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_reported(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)\n")
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert client.wait_until_status(job_id, timeout=120) == JobStatus.FAILED
+
+
+def test_job_attaches_to_cluster(tmp_path):
+    """The entrypoint can init against the running cluster and use actors."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "attach.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # picks up RAY_TPU_ADDRESS + RAY_TPU_RAYLET_PORT
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "assert ray_tpu.get(f.remote(41)) == 42\n"
+        "print('attached ok')\n"
+    )
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_status(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "attached ok" in logs
+
+
+def test_actor_pool_survives_task_errors():
+    @ray_tpu.remote
+    class Flaky:
+        def f(self, x):
+            if x == 2:
+                raise ValueError("flaky")
+            return x
+
+    pool = ActorPool([Flaky.remote()])
+    for v in range(4):
+        pool.submit(lambda a, v: a.f.remote(v), v)
+    results = []
+    errors = 0
+    while pool.has_next():
+        try:
+            results.append(pool.get_next(timeout=60))
+        except ValueError:
+            errors += 1
+    assert errors == 1 and results == [0, 1, 3]  # actor returned after the error
+
+
+def test_queue_batches_atomic():
+    q = Queue(maxsize=3)
+    q.put_nowait_batch([1, 2])
+    with pytest.raises(Exception):
+        q.put_nowait_batch([3, 4])  # would exceed maxsize: nothing inserted
+    assert q.qsize() == 2
+    with pytest.raises(Empty):
+        q.get_nowait_batch(3)  # only 2 present: nothing popped
+    assert q.get_nowait_batch(2) == [1, 2]
+    q.shutdown()
+
+
+def test_pool_initializer_runs_for_map():
+    with Pool(processes=2, initializer=_set_flag_for_pool, initargs=(5,)) as pool:
+        assert pool.map(_read_flag_for_pool, range(4)) == [5] * 4
+
+
+def _set_flag_for_pool(v):
+    import builtins
+
+    builtins._rtpu_pool_flag = v
+
+
+def _read_flag_for_pool(_x):
+    import builtins
+
+    return getattr(builtins, "_rtpu_pool_flag", None)
+
+
+def test_prometheus_histogram_exposition():
+    h = Histogram("expo_latency", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+    h.flush()
+    text = prometheus_text()
+    assert 'expo_latency_bucket{le="1"} 1.0' in text
+    assert 'expo_latency_bucket{le="10"} 2.0' in text
+    assert 'expo_latency_bucket{le="+Inf"} 3.0' in text
+    assert "expo_latency_count 3.0" in text
+    assert "expo_latency_sum 55.5" in text
