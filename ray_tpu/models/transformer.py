@@ -42,6 +42,12 @@ class ModelConfig:
     # measured slower than separate projections on v5e at gpt2 scale — off by default
     attention: str = "flash"  # flash | reference | ring | ulysses
     sp_axis: str = "sp"
+    # MoE: >0 replaces the dense MLP with that many experts (expert-parallel over
+    # the "ep" mesh axis; reference has no native EP — SURVEY.md §2.3).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -211,12 +217,26 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None):
-        attn_out, new_cache = Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions, kv_cache
+        cfg = self.cfg
+        attn_out, new_cache = Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, kv_cache
         )
         x = x + attn_out
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x))
-        return x, new_cache
+        normed = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        if cfg.moe_experts > 0:
+            from ray_tpu.ops.moe import MoEMLP
+
+            mlp_out, aux = MoEMLP(
+                d_model=cfg.hidden, d_ff=cfg.mlp_dim,
+                num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                name="moe",
+            )(normed)
+        else:
+            mlp_out = MLP(cfg, name="mlp")(normed)
+            aux = jnp.zeros((), jnp.float32)
+        x = x + mlp_out
+        return x, (new_cache, aux)
 
 
 class Transformer(nn.Module):
@@ -251,15 +271,22 @@ class Transformer(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
                 in_axes=(nn.broadcast,),
             )
-            x, _ = ScannedBlocks(cfg, name="layers")(x, positions)
+            x, (_, aux_stack) = ScannedBlocks(cfg, name="layers")(x, positions)
+            moe_aux = jnp.sum(aux_stack)
         else:
+            moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layers):
                 block_cls = Block
                 if cfg.remat and kv_caches is None:
                     block_cls = nn.remat(Block, prevent_cse=False)
                 cache = kv_caches[i] if kv_caches is not None else None
-                x, new_cache = block_cls(cfg, name=f"layer_{i}")(x, positions, cache)
+                x, (new_cache, aux) = block_cls(cfg, name=f"layer_{i}")(x, positions, cache)
                 new_caches.append(new_cache)
+                moe_aux = moe_aux + aux
+        if cfg.moe_experts > 0:
+            # Reaches the training loss without changing the return signature:
+            # apply(..., mutable=["losses"]) surfaces it; plain apply ignores it.
+            self.sow("losses", "moe_aux", cfg.moe_aux_coeff * moe_aux)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         # Head matmul on the MXU bf16 path with f32 accumulation (an f32 matmul here

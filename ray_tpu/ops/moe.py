@@ -1,0 +1,103 @@
+"""Mixture-of-Experts layer with expert parallelism over the "ep" mesh axis.
+
+The reference framework has no native expert parallelism (SURVEY.md §2.3: vLLM
+kwargs pass-through only); here it is a library op. Design is the standard TPU
+MoE recipe: top-k router → capacity-bounded dispatch (dense einsum with a
+one-hot dispatch mask keeps everything static-shaped for XLA) → experts as a
+batched matmul sharded over "ep" → combine weighted by router probs. With the
+experts dimension sharded on "ep", pjit turns the dispatch/combine einsums into
+all-to-alls over ICI — no hand-written collectives needed.
+
+Shapes (E experts, C capacity per expert, k top-k):
+    tokens  [B, S, M]  →  dispatch [B, S, E, C]  →  expert in [E, B*C', M] ...
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(router_logits, k: int, capacity: int):
+    """Compute dispatch/combine tensors from router logits.
+
+    router_logits: [T, E] (T = flattened tokens). Returns:
+      dispatch [T, E, C] bool-ish float: token t occupies slot c of expert e
+      combine  [T, E, C] float: dispatch weighted by router prob
+      aux_loss: load-balancing loss (Switch-style mean(prob)*mean(assignment)*E)
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    _topv, topi = jax.lax.top_k(probs, k)  # [T, k]
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # [T, k, E]
+    assignment = onehot.sum(1)  # [T, E] in {0,1} per expert
+    # position of each token within its expert's queue (capacity slots)
+    position_in_expert = (jnp.cumsum(assignment, axis=0) - assignment)  # [T, E]
+    keep = assignment * (position_in_expert < capacity)
+    slot = jax.nn.one_hot(position_in_expert, capacity, dtype=jnp.float32)  # [T,E,C]
+    dispatch = keep[..., None] * slot  # [T, E, C]
+    gates = probs * keep  # zero out dropped
+    denom = gates.sum(-1, keepdims=True) + 1e-9
+    combine = (gates / denom)[..., None] * dispatch
+    # Switch load-balance loss
+    density = assignment.mean(0)          # fraction routed per expert
+    density_proxy = probs.mean(0)
+    aux_loss = (density * density_proxy).sum() * E
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MoE replacement for a dense MLP block.
+
+    Partitioning: expert weights carry a leading E dim annotated with the
+    "expert" logical axis → sharded over the mesh's ep axis by the rules table.
+    """
+
+    d_model: int
+    d_ff: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        B, S, M = x.shape
+        E, K = self.num_experts, self.top_k
+        T = B * S
+        capacity = max(1, int(self.capacity_factor * T * K / E))
+        flat = x.reshape(T, M)
+
+        router = self.param(
+            "router",
+            nn.with_logical_partitioning(nn.initializers.lecun_normal(), ("embed", None)),
+            (M, E), jnp.float32,
+        )
+        logits = flat.astype(jnp.float32) @ router
+        dispatch, combine, aux_loss = top_k_routing(logits, K, capacity)
+
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (E, M, self.d_ff), self.dtype,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
+            ),
+            (E, self.d_ff, M), self.dtype,
+        )
+        # dispatch: [T,E,C] x [T,M] -> expert inputs [E,C,M] (XLA inserts the
+        # token->expert all-to-all when E is sharded on ep)
+        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), flat)
+        h = jax.nn.silu(jnp.einsum("ecm,emf->ecf", expert_in, w_in))
+        expert_out = jnp.einsum("ecf,efm->ecm", h, w_out)
+        # combine back: [T,E,C] x [E,C,M] -> [T,M]
+        out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        return out.reshape(B, S, M), aux_loss.astype(jnp.float32)

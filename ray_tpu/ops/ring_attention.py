@@ -109,6 +109,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", *, causal: bool = True,
     Requires num heads divisible by the axis size.
     """
     n = jax.lax.psum(1, axis_name)
+    if k.shape[2] != q.shape[2] and k.shape[2] % n != 0:
+        # GQA with fewer kv-head groups than the sp axis: materialize full kv heads
+        # before the exchange (costs bandwidth; correctness over elegance).
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # [B, Sc, H, D] -> gather sequence, scatter heads -> [B, S, H/n, D]
     q_g = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k_g = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
