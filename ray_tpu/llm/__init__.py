@@ -1,0 +1,225 @@
+"""ray_tpu.llm: LLM serving on TPU replicas.
+
+Parity: reference `python/ray/llm/` + `python/ray/serve/llm/__init__.py` — LLMConfig,
+build_llm_deployment, build_openai_app (OpenAI-compatible /v1/completions +
+/v1/chat/completions router). The engine is TPU-native continuous batching
+(`_engine.py`) instead of a wrapped CUDA vLLM; replicas hold compiled prefill/decode
+programs warm, so scaling replicas scales both throughput and compiled-state reuse.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import pickle
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu import serve
+from ray_tpu.llm._engine import DecodeEngine, SamplingParams
+
+
+class ByteTokenizer:
+    """Default zero-dependency tokenizer: UTF-8 bytes as token ids (vocab >= 256).
+
+    Real deployments plug a sentencepiece/BPE tokenizer via LLMConfig.tokenizer;
+    the byte fallback keeps the stack runnable with zero downloads."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """Parity: reference `ray.serve.llm.LLMConfig` (server_models.py)."""
+
+    model_id: str = "test-tiny"
+    model_config: Optional[Any] = None  # ModelConfig; defaults to get_config(model_id)
+    checkpoint_path: Optional[str] = None  # dir with params.pkl (else random init)
+    num_replicas: int = 1
+    num_slots: int = 4            # continuous-batching slots per replica
+    max_seq: Optional[int] = None
+    tokenizer: Optional[Any] = None
+    seed: int = 0
+    accelerator_resources: Optional[dict] = None  # e.g. {"TPU": 4}
+
+
+class LLMServer:
+    """One TPU replica: engine + tokenizer. Parity: llm_server.py LLMServer."""
+
+    def __init__(self, config: LLMConfig):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import CONFIGS, Transformer, get_config
+
+        cfg = config.model_config or get_config(
+            config.model_id if config.model_id in CONFIGS else "test-tiny"
+        )
+        cfg = dataclasses.replace(cfg, scan_layers=False, remat=False)
+        self._cfg = cfg
+        self._config = config
+        self._tokenizer = config.tokenizer or ByteTokenizer()
+        model = Transformer(cfg)
+        if config.checkpoint_path:
+            with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
+                params = pickle.load(f)
+        else:
+            params = model.init(
+                jax.random.PRNGKey(config.seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        self._engine = DecodeEngine(
+            cfg, params, num_slots=config.num_slots,
+            max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
+        )
+
+    async def generate(self, prompt: Union[str, List[int]], *,
+                       max_tokens: int = 64, temperature: float = 0.0,
+                       top_k: int = 0, stop_token_id: Optional[int] = None) -> dict:
+        t0 = time.monotonic()
+        token_ids = (
+            self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        loop = asyncio.get_running_loop()
+        done: asyncio.Future = loop.create_future()
+        out: List[int] = []
+        ttft = [None]
+
+        def cb(token: int, finished: bool):
+            if ttft[0] is None:
+                ttft[0] = time.monotonic() - t0
+            out.append(token)
+            if finished:
+                loop.call_soon_threadsafe(
+                    lambda: done.set_result(None) if not done.done() else None
+                )
+
+        self._engine.submit(
+            token_ids,
+            SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                           top_k=top_k, stop_token_id=stop_token_id),
+            cb,
+        )
+        await done
+        gen = list(out)
+        if stop_token_id is not None and gen and gen[-1] == stop_token_id:
+            gen = gen[:-1]
+        return {
+            "text": self._tokenizer.decode(gen),
+            "token_ids": gen,
+            "usage": {
+                "prompt_tokens": len(token_ids),
+                "completion_tokens": len(gen),
+                "total_tokens": len(token_ids) + len(gen),
+            },
+            "ttft_s": ttft[0],
+            "latency_s": time.monotonic() - t0,
+        }
+
+    async def model_id(self) -> str:
+        return self._config.model_id
+
+    def __del__(self):
+        try:
+            self._engine.shutdown()
+        except Exception:
+            pass
+
+
+class OpenAIRouter:
+    """OpenAI-compatible HTTP front: /v1/completions, /v1/chat/completions,
+    /v1/models. Parity: reference serve/deployments/routers/router.py."""
+
+    def __init__(self, servers: Dict[str, Any]):
+        self._servers = servers  # model_id -> DeploymentHandle
+
+    async def __call__(self, request) -> dict:
+        path = request.path
+        if path.endswith("/v1/models"):
+            return {
+                "object": "list",
+                "data": [{"id": mid, "object": "model"} for mid in self._servers],
+            }
+        body = request.json()
+        model = body.get("model") or next(iter(self._servers))
+        handle = self._servers.get(model)
+        if handle is None:
+            return {"error": {"message": f"unknown model {model!r}",
+                              "type": "invalid_request_error"}}
+        is_chat = path.endswith("/v1/chat/completions")
+        if is_chat:
+            prompt = "\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in body.get("messages", [])
+            ) + "\nassistant:"
+        else:
+            prompt = body.get("prompt", "")
+        response = handle.generate.remote(
+            prompt,
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+        )
+        result = await response
+        created = int(time.time())
+        if is_chat:
+            return {
+                "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": result["text"]},
+                    "finish_reason": "length",
+                }],
+                "usage": result["usage"],
+            }
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:16]}",
+            "object": "text_completion",
+            "created": created,
+            "model": model,
+            "choices": [{"index": 0, "text": result["text"],
+                         "finish_reason": "length"}],
+            "usage": result["usage"],
+        }
+
+
+def build_llm_deployment(config: LLMConfig) -> "serve.Application":
+    """One LLM server deployment. Parity: serve.llm.build_llm_deployment."""
+    resources = config.accelerator_resources or {}
+    deployment = serve.deployment(
+        name=f"LLMServer-{config.model_id}",
+        num_replicas=config.num_replicas,
+        ray_actor_options={"num_cpus": 0, **resources},
+        max_ongoing_requests=config.num_slots * 4,
+    )(LLMServer)
+    return deployment.bind(config)
+
+
+def build_openai_app(llm_configs: List[LLMConfig]) -> "serve.Application":
+    """OpenAI-compatible app over one or more models. Parity:
+    serve.llm.build_openai_app."""
+    servers = {cfg.model_id: build_llm_deployment(cfg) for cfg in llm_configs}
+    router = serve.deployment(name="OpenAIRouter")(OpenAIRouter)
+    return router.bind(servers)
+
+
+__all__ = [
+    "ByteTokenizer",
+    "DecodeEngine",
+    "LLMConfig",
+    "LLMServer",
+    "OpenAIRouter",
+    "SamplingParams",
+    "build_llm_deployment",
+    "build_openai_app",
+]

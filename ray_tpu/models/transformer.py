@@ -56,7 +56,13 @@ class ModelConfig:
     def num_params(self) -> int:
         e = self.vocab_size * self.hidden
         attn = self.hidden * self.head_dim * (self.n_heads * 2 + self.n_kv_heads * 2)
-        mlp = 3 * self.hidden * self.mlp_dim
+        if self.moe_experts > 0:
+            # router + per-expert in/out projections (2 matmuls each)
+            mlp = self.hidden * self.moe_experts + (
+                self.moe_experts * 2 * self.hidden * self.mlp_dim
+            )
+        else:
+            mlp = 3 * self.hidden * self.mlp_dim
         norms = 2 * self.hidden
         per_layer = attn + mlp + norms
         head = 0 if self.tie_embeddings else e
@@ -230,7 +236,7 @@ class Block(nn.Module):
                 d_model=cfg.hidden, d_ff=cfg.mlp_dim,
                 num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
-                name="moe",
+                param_dtype=cfg.param_dtype, name="moe",
             )(normed)
         else:
             mlp_out = MLP(cfg, name="mlp")(normed)

@@ -61,7 +61,9 @@ class MoEMLP(nn.Module):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
-    dtype: jnp.dtype = jnp.bfloat16
+    dtype: jnp.dtype = jnp.bfloat16        # compute dtype
+    param_dtype: jnp.dtype = jnp.float32   # storage dtype (f32: adamw updates
+    # at lr*grad scale underflow bf16 mantissas and experts stop learning)
 
     @nn.compact
     def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
@@ -84,15 +86,15 @@ class MoEMLP(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
             ),
-            (E, M, self.d_ff), self.dtype,
-        )
+            (E, M, self.d_ff), self.param_dtype,
+        ).astype(self.dtype)
         w_out = self.param(
             "w_out",
             nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
             ),
-            (E, self.d_ff, M), self.dtype,
-        )
+            (E, self.d_ff, M), self.param_dtype,
+        ).astype(self.dtype)
         # dispatch: [T,E,C] x [T,M] -> expert inputs [E,C,M] (XLA inserts the
         # token->expert all-to-all when E is sharded on ep)
         expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), flat)

@@ -108,21 +108,15 @@ def build_train_step(model, optimizer, mesh: Mesh, rules=None,
     rules_list = _rules_list(rules)
     loss_fn = loss_fn or cross_entropy_loss
 
-    moe = getattr(getattr(model, "cfg", None), "moe_experts", 0) > 0
-
     def step(state: TrainState, batch: dict):
         def compute_loss(params):
             with nn.logical_axis_rules(rules_list):
-                if moe:
-                    logits, extra = model.apply(
-                        {"params": params}, batch["tokens"], mutable=["losses"]
-                    )
-                    aux = sum(
-                        jnp.sum(v) for v in jax.tree_util.tree_leaves(extra)
-                    )
-                else:
-                    logits = model.apply({"params": params}, batch["tokens"])
-                    aux = 0.0
+                # "losses" collects sown auxiliary losses (MoE load balance, or
+                # any custom model's); empty collection sums to 0 for dense models.
+                logits, extra = model.apply(
+                    {"params": params}, batch["tokens"], mutable=["losses"]
+                )
+            aux = sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(extra))
             return loss_fn(logits, batch["targets"], batch.get("mask")) + aux
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)

@@ -154,6 +154,12 @@ def _collect_deployments(app: Application, app_name: str, acc: Dict[str, dict]) 
     def convert(v):
         if isinstance(v, Application):
             return _collect_deployments(v, app_name, acc)
+        # Applications may ride inside containers (e.g. a {model_id: app} dict).
+        if isinstance(v, dict):
+            return {k: convert(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            out = [convert(x) for x in v]
+            return tuple(out) if isinstance(v, tuple) else out
         return v
 
     args = tuple(convert(a) for a in app.init_args)

@@ -29,6 +29,10 @@ class ServeController:
         self._shutting_down = False
         # autoscale bookkeeping: (app, dep) -> last scale decision time
         self._last_scale: Dict[tuple, float] = {}
+        # health bookkeeping OUTSIDE the spec dicts: redeploys must not reset a
+        # live replica's "has been healthy" status or its startup clock.
+        # (app, dep) -> {"healthy": set[actor_id], "created": {actor_id: t}}
+        self._health: Dict[tuple, dict] = {}
 
     # -- deploy / teardown -------------------------------------------------
     async def deploy_app(self, app: str, deployments: Dict[str, dict],
@@ -217,13 +221,46 @@ class ServeController:
                 if name == "__meta__":
                     continue
                 replicas = self._replicas.get(app, {}).get(name, [])
-                # Health check + stats in one pass.
+                # Health check + stats, probed CONCURRENTLY (a serial 5s timeout
+                # per starting replica would stall the whole control loop).
+                # A replica that has never responded is STARTING (model
+                # load/compile can take minutes) and gets a grace period; a
+                # replica whose ACTOR DIED is dead immediately; a
+                # previously-healthy one that stops answering is dead too.
+                health = self._health.setdefault((app, name), {
+                    "healthy": set(), "created": {},
+                })
+                live_ids = {r._actor_id for r in replicas}
+                health["healthy"] &= live_ids
+                health["created"] = {
+                    k: v for k, v in health["created"].items() if k in live_ids
+                }
+                now = time.monotonic()
+                grace_s = 600.0
+                for r in replicas:
+                    health["created"].setdefault(r._actor_id, now)
+
+                async def probe(r):
+                    try:
+                        return await async_get(r.get_stats.remote(), timeout=5)
+                    except Exception as e:
+                        return e
+
+                results = await asyncio.gather(*(probe(r) for r in replicas))
                 stats = []
                 dead = []
-                for r in replicas:
-                    try:
-                        stats.append(await async_get(r.get_stats.remote(), timeout=5))
-                    except Exception:
+                for r, res in zip(replicas, results):
+                    if not isinstance(res, Exception):
+                        stats.append(res)
+                        health["healthy"].add(r._actor_id)
+                        continue
+                    died = type(res).__name__ == "ActorDiedError"
+                    started = health["created"].get(r._actor_id, now)
+                    if (
+                        died
+                        or r._actor_id in health["healthy"]
+                        or now - started > grace_s
+                    ):
                         dead.append(r._actor_id)
                 if dead:
                     spec["_dead"] = dead

@@ -1,0 +1,123 @@
+"""ray_tpu.llm tests: decode engine correctness + OpenAI-compatible serving.
+
+Shape parity: reference python/ray/llm tests — engine generation, server
+deployment, router request shapes, multi-request batching.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_apps():
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+def test_engine_matches_full_forward():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def greedy_full(prompt, n):
+        toks = list(prompt)
+        for _ in range(n):
+            logits = model.apply({"params": params}, jnp.asarray([toks]))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        return toks[len(prompt):]
+
+    engine = DecodeEngine(cfg, params, num_slots=2, max_seq=128)
+    try:
+        results = {}
+        done = threading.Event()
+
+        def cb_for(key):
+            acc = []
+
+            def cb(tok, fin):
+                acc.append(tok)
+                if fin:
+                    results[key] = acc
+                    if len(results) == 2:
+                        done.set()
+
+            return cb
+
+        p1, p2 = [5, 9, 17, 3], [8, 2, 44, 7, 19, 21, 6]
+        engine.submit(p1, SamplingParams(max_tokens=6), cb_for("a"))
+        engine.submit(p2, SamplingParams(max_tokens=6), cb_for("b"))
+        assert done.wait(180), results
+        assert results["a"] == greedy_full(p1, 6)
+        assert results["b"] == greedy_full(p2, 6)
+    finally:
+        engine.shutdown()
+
+
+def test_llm_server_deployment_generate():
+    from ray_tpu.llm import LLMConfig, build_llm_deployment
+
+    app = build_llm_deployment(LLMConfig(model_id="test-tiny", num_slots=2))
+    handle = serve.run(app, name="llm", route_prefix=None, _timeout_s=240)
+    out = handle.generate.remote("hi", max_tokens=8).result(timeout_s=240)
+    assert len(out["token_ids"]) == 8
+    assert out["usage"]["prompt_tokens"] == 2
+    assert isinstance(out["text"], str)
+    # deterministic: same prompt, greedy -> same tokens
+    out2 = handle.generate.remote("hi", max_tokens=8).result(timeout_s=120)
+    assert out2["token_ids"] == out["token_ids"]
+    # concurrent requests share the batch
+    rs = [handle.generate.remote(f"p{i}", max_tokens=4) for i in range(6)]
+    outs = [r.result(timeout_s=240) for r in rs]
+    assert all(len(o["token_ids"]) == 4 for o in outs)
+
+
+def test_openai_app_http():
+    from ray_tpu.llm import LLMConfig, build_openai_app
+
+    app = build_openai_app([LLMConfig(model_id="test-tiny", num_slots=2)])
+    serve.run(app, name="openai", route_prefix="/", _timeout_s=240)
+    port = serve.get_proxy_port()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            return json.loads(resp.read())
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/v1/models", timeout=120) as r:
+        models = json.loads(r.read())
+    assert models["data"][0]["id"] == "test-tiny"
+
+    out = post("/v1/completions",
+               {"model": "test-tiny", "prompt": "ab", "max_tokens": 5})
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] == 5
+
+    chat = post("/v1/chat/completions",
+                {"model": "test-tiny",
+                 "messages": [{"role": "user", "content": "hello"}],
+                 "max_tokens": 5})
+    assert chat["object"] == "chat.completion"
+    assert chat["choices"][0]["message"]["role"] == "assistant"
