@@ -1,0 +1,164 @@
+"""Runtime environments: per-task/per-actor env vars, working_dir, py_modules.
+
+Design parity: reference `python/ray/_private/runtime_env/` — the per-lease
+environment prepared before a worker runs user code. Here the common plugins are
+applied in-process: `env_vars`, `working_dir` (chdir + sys.path), `py_modules`
+(sys.path additions). Paths must be visible on the executing node (shared
+filesystem or same machine); package-installing plugins (pip/uv/conda) are a later
+round — they need the reference's per-env virtualenv cache keyed into the worker
+pool.
+
+Isolation model: actors own their worker process, so their env applies permanently.
+Plain tasks share a threaded worker, so process-global mutations (os.environ, cwd,
+sys.path) are guarded by a reader/writer lock — a task WITH a runtime_env runs
+exclusively on its worker; tasks without one run concurrently as before. Modules
+imported from a task's py_modules/working_dir are evicted from sys.modules on
+restore so later tasks can't silently pick up stale code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not runtime_env:
+        return None
+    unknown = set(runtime_env) - _SUPPORTED
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(_SUPPORTED)}"
+        )
+    env_vars = runtime_env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
+        raise ValueError("runtime_env env_vars must be str -> str")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not isinstance(wd, (str, os.PathLike)):
+        raise ValueError(f"runtime_env working_dir must be a path, got {type(wd).__name__}")
+    mods = runtime_env.get("py_modules")
+    if mods is not None:
+        if isinstance(mods, (str, os.PathLike)) or not all(
+            isinstance(m, (str, os.PathLike)) for m in mods
+        ):
+            raise ValueError("runtime_env py_modules must be a LIST of paths")
+    return dict(runtime_env)
+
+
+class _RWLock:
+    """Many concurrent env-free tasks OR one env-carrying task per process."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    @contextlib.contextmanager
+    def shared(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
+_lock = _RWLock()
+
+
+def _env_paths(runtime_env: Dict[str, Any]) -> list:
+    paths = []
+    wd = runtime_env.get("working_dir")
+    if wd:
+        paths.append(os.path.abspath(os.path.expanduser(str(wd))))
+    for m in runtime_env.get("py_modules") or []:
+        paths.append(os.path.abspath(os.path.expanduser(str(m))))
+    return paths
+
+
+def _apply(runtime_env: Dict[str, Any], saved_env: Optional[Dict[str, Optional[str]]]):
+    """Apply the env; when saved_env is a dict, record prior values for restore."""
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        if saved_env is not None:
+            saved_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    wd = runtime_env.get("working_dir")
+    if wd:
+        wd = os.path.abspath(os.path.expanduser(str(wd)))
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+    for mod_path in runtime_env.get("py_modules") or []:
+        mod_path = os.path.abspath(os.path.expanduser(str(mod_path)))
+        if mod_path not in sys.path:
+            sys.path.insert(0, mod_path)
+
+
+def apply_permanent(runtime_env: Optional[Dict[str, Any]]):
+    """Actor path: the actor owns its worker process, so mutate it directly."""
+    if not runtime_env:
+        return
+    _apply(runtime_env, saved_env=None)
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[Dict[str, Any]]):
+    """Task path: apply exclusively around one execution, then restore.
+
+    The rw-lock keeps concurrent env-free tasks from observing (or clobbering)
+    another task's env; env-free tasks take the shared side and stay concurrent.
+    """
+    if not runtime_env:
+        with _lock.shared():
+            yield
+        return
+    with _lock.exclusive():
+        saved_env: Dict[str, Optional[str]] = {}
+        saved_cwd = os.getcwd()
+        saved_path = list(sys.path)
+        saved_modules = set(sys.modules)
+        env_paths = _env_paths(runtime_env)
+        try:
+            _apply(runtime_env, saved_env)
+            yield
+        finally:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+            sys.path[:] = saved_path
+            # Evict modules this task imported from ITS paths: a later task with a
+            # different py_modules version must not silently get this one's code.
+            for name in set(sys.modules) - saved_modules:
+                mod_file = getattr(sys.modules.get(name), "__file__", None) or ""
+                if any(mod_file.startswith(p + os.sep) or mod_file == p
+                       for p in env_paths):
+                    sys.modules.pop(name, None)

@@ -456,6 +456,7 @@ class CoreWorker:
         placement_group: dict | None = None,
         max_retries: int | None = None,
         scheduling_strategy=None,
+        runtime_env: dict | None = None,
     ) -> list[ObjectRef]:
         task_id = TaskID.from_random()
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
@@ -479,6 +480,7 @@ class CoreWorker:
                 max_retries if max_retries is not None else CONFIG.max_task_retries_default
             ),
             "scheduling_strategy": scheduling_strategy,
+            "runtime_env": runtime_env,
         }
         refs = []
         for oid in return_ids:
@@ -535,6 +537,7 @@ class CoreWorker:
         is_async=False,
         scheduling_strategy=None,
         method_names=(),
+        runtime_env=None,
     ) -> ActorID:
         actor_id = ActorID.from_random()
         # Promoted init args stay pinned for the actor's lifetime: restarts re-run __init__.
@@ -557,6 +560,7 @@ class CoreWorker:
             "scheduling_strategy": scheduling_strategy,
             "owner": self._owner_address(),
             "method_names": list(method_names),
+            "runtime_env": runtime_env,
         }
         reply = self.gcs_call("register_actor", actor_id, spec)
         return reply["actor_id"]
@@ -662,6 +666,10 @@ class CoreWorker:
 
     def _init_actor(self, actor_id: ActorID, spec) -> dict:
         try:
+            from ray_tpu._private import runtime_env as runtime_env_mod
+
+            # The actor owns this worker process: its runtime env applies for life.
+            runtime_env_mod.apply_permanent(spec.get("runtime_env"))
             cls = self.functions.load(spec["cls_key"])
             args, kwargs = self._materialize_args(spec)
             instance = cls.__new__(cls)
@@ -737,14 +745,19 @@ class CoreWorker:
         self._tls.task_id = spec["task_id"]
         self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING")
         try:
-            if spec["type"] == "actor_task":
-                fn = self._resolve_actor_method(
-                    self.actor_runtime.instance, spec["method_name"]
-                )
-            else:
-                fn = self.functions.load(spec["fn_key"])
-            args, kwargs = self._materialize_args(spec)
-            result = fn(*args, **kwargs)
+            from ray_tpu._private import runtime_env as runtime_env_mod
+
+            # The env applies BEFORE function load / arg deserialization: both may
+            # depend on py_modules/working_dir being importable.
+            with runtime_env_mod.applied(spec.get("runtime_env")):
+                if spec["type"] == "actor_task":
+                    fn = self._resolve_actor_method(
+                        self.actor_runtime.instance, spec["method_name"]
+                    )
+                else:
+                    fn = self.functions.load(spec["fn_key"])
+                args, kwargs = self._materialize_args(spec)
+                result = fn(*args, **kwargs)
             results = self._package_results(spec, result)
             state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - report any user failure to the owner

@@ -30,6 +30,7 @@ _ACTOR_DEFAULTS = {
     "scheduling_strategy": None,
     "max_retries": None,
     "num_returns": 1,
+    "runtime_env": None,
 }
 
 
@@ -121,6 +122,8 @@ class ActorClass:
             import ray_tpu
 
             namespace = ray_tpu._current_namespace()
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
         method_names = _public_methods(self._cls)
         actor_id = worker.create_actor(
             cls_key=self._cls_key,
@@ -137,6 +140,7 @@ class ActorClass:
             is_async=is_async,
             scheduling_strategy=strategy,
             method_names=method_names,
+            runtime_env=runtime_env_mod.validate(opts.get("runtime_env")),
         )
         return ActorHandle(actor_id, method_names, self._cls.__name__)
 

@@ -21,6 +21,7 @@ _DEFAULTS = {
     "placement_group_bundle_index": 0,
     "scheduling_strategy": None,
     "name": None,
+    "runtime_env": None,
 }
 
 
@@ -84,6 +85,8 @@ class RemoteFunction:
             self._fn_session = worker.session_token
         opts = self._options
         strategy, opts = _resolve_scheduling(opts)
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
         refs = worker.submit_task(
             fn_key=self._fn_key,
             name=opts.get("name") or getattr(self._fn, "__name__", "anonymous"),
@@ -94,6 +97,7 @@ class RemoteFunction:
             placement_group=_build_pg_spec(opts),
             max_retries=opts["max_retries"],
             scheduling_strategy=strategy,
+            runtime_env=runtime_env_mod.validate(opts.get("runtime_env")),
         )
         if opts["num_returns"] == 1:
             return refs[0]

@@ -1,0 +1,136 @@
+"""Runtime environment tests: env_vars, working_dir, py_modules for tasks/actors.
+
+Shape parity: reference python/ray/tests/test_runtime_env*.py (the env_vars/
+working_dir plugins; package-installing plugins are a documented later round).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+
+
+def test_task_env_vars_applied_and_restored():
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "abc"}})
+    def with_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    @ray_tpu.remote
+    def without_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(with_env.remote()) == "abc"
+    # the shared worker must NOT leak the env var into other tasks
+    assert ray_tpu.get(without_env.remote()) is None
+
+
+def test_task_working_dir(tmp_path):
+    (tmp_path / "data.txt").write_text("from working dir")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_relative():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_relative.remote()) == "from working dir"
+
+
+def test_py_modules_importable(tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "rtpu_test_mod.py").write_text("VALUE = 1234\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import rtpu_test_mod
+
+        return rtpu_test_mod.VALUE
+
+    assert ray_tpu.get(use_module.remote()) == 1234
+
+
+def test_actor_runtime_env_sticky():
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "sticky"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "sticky"
+    assert ray_tpu.get(a.read.remote()) == "sticky"
+
+
+def test_options_override_runtime_env():
+    @ray_tpu.remote
+    def probe():
+        return os.environ.get("RTPU_OPT_FLAG")
+
+    ref = probe.options(runtime_env={"env_vars": {"RTPU_OPT_FLAG": "via-options"}}).remote()
+    assert ray_tpu.get(ref) == "via-options"
+
+
+def test_invalid_runtime_env_rejected():
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        f.remote()
+
+
+def test_concurrent_tasks_do_not_observe_env(tmp_path):
+    """An env-carrying task runs exclusively: parallel env-free tasks never see
+    its env vars or cwd."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_RACE": "yes"}})
+    def env_task():
+        import time
+
+        time.sleep(0.3)
+        return os.environ.get("RTPU_RACE")
+
+    @ray_tpu.remote
+    def plain_task(_i):
+        import time
+
+        time.sleep(0.05)
+        return os.environ.get("RTPU_RACE")
+
+    refs = [env_task.remote()] + [plain_task.remote(i) for i in range(8)]
+    out = ray_tpu.get(refs)
+    assert out[0] == "yes"
+    assert all(v is None for v in out[1:])
+
+
+def test_stale_py_module_evicted(tmp_path):
+    v1 = tmp_path / "v1"
+    v2 = tmp_path / "v2"
+    v1.mkdir(); v2.mkdir()
+    (v1 / "verlib.py").write_text("VERSION = 1\n")
+    (v2 / "verlib.py").write_text("VERSION = 2\n")
+
+    @ray_tpu.remote(num_cpus=4)  # force same worker by using all CPUs
+    def load(path):
+        import verlib
+
+        return verlib.VERSION
+
+    r1 = load.options(runtime_env={"py_modules": [str(v1)]}).remote(str(v1))
+    assert ray_tpu.get(r1) == 1
+    r2 = load.options(runtime_env={"py_modules": [str(v2)]}).remote(str(v2))
+    assert ray_tpu.get(r2) == 2  # must NOT return the cached v1 module
+
+
+def test_py_modules_string_rejected():
+    @ray_tpu.remote(runtime_env={"py_modules": "/tmp/not-a-list"})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="LIST"):
+        f.remote()
