@@ -40,6 +40,7 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.is_head = False
+        self.pending_demand: dict = {}
 
     def view(self):
         return {
@@ -47,6 +48,7 @@ class NodeInfo:
             "address": self.address,
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
+            "pending_demand": self.pending_demand,
             "labels": self.labels,
             "alive": self.alive,
             "is_head": self.is_head,
@@ -64,6 +66,7 @@ class ActorInfo:
         self.restarts_left = spec.get("max_restarts", 0)
         self.num_restarts = 0
         self.death_cause = None
+        self.placing = False  # a create_actor RPC is in flight to a chosen node
 
     def view(self):
         return {
@@ -136,13 +139,38 @@ class GcsService:
         await self.publish("nodes", {"event": "added", "node": info.view()})
         return {"ok": True}
 
-    async def rpc_heartbeat(self, conn, node_id: NodeID, resources_available):
+    async def rpc_heartbeat(self, conn, node_id: NodeID, resources_available,
+                            pending_demand=None):
         node = self.nodes.get(node_id)
         if node is None:
             return {"ok": False}
         node.last_heartbeat = time.monotonic()
         node.resources_available = dict(resources_available)
+        node.pending_demand = dict(pending_demand or {})
         return {"ok": True}
+
+    async def rpc_cluster_demand(self, conn):
+        """Unplaceable-work summary for the autoscaler: queued task resources per
+        node, actors stuck waiting for capacity (PENDING or RESTARTING, excluding
+        those whose placement is already in flight), and unallocated PG bundles."""
+        pending: dict[str, float] = {}
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            for r, amt in node.pending_demand.items():
+                pending[r] = pending.get(r, 0.0) + amt
+        pending_actors = 0
+        for actor in self.actors.values():
+            if actor.state in (PENDING, RESTARTING) and not actor.placing:
+                pending_actors += 1
+                for r, amt in (actor.spec.get("resources") or {}).items():
+                    pending[r] = pending.get(r, 0.0) + float(amt)
+        for pg in self.placement_groups.values():
+            if pg.state not in (ALIVE, DEAD):
+                for bundle in pg.bundles:
+                    for r, amt in bundle.items():
+                        pending[r] = pending.get(r, 0.0) + float(amt)
+        return {"pending": pending, "pending_actors": pending_actors}
 
     async def rpc_get_nodes(self, conn):
         return [n.view() for n in self.nodes.values()]
@@ -284,11 +312,14 @@ class GcsService:
         for attempt in range(retries):
             node = self._pick_node_for(resources, spec.get("scheduling_strategy"))
             if node is None:
+                actor.placing = False  # truly unplaceable: autoscaler demand
                 await asyncio.sleep(0.25)
                 continue
+            actor.placing = True  # placement in flight: resources already picked
             try:
                 result = await node.conn.call("create_actor", actor.actor_id, spec)
             except Exception:
+                actor.placing = False
                 await asyncio.sleep(0.1)
                 continue
             if result.get("ok"):

@@ -176,10 +176,21 @@ class Raylet:
         loop.create_task(self._idle_reaper_loop())
         return self
 
+    def _pending_demand(self) -> dict:
+        """Aggregate resources of queued-but-unplaceable work (autoscaler signal)."""
+        demand: dict[str, float] = {}
+        for spec in self.task_queue:
+            for r, amt in (spec.get("resources") or {}).items():
+                demand[r] = demand.get(r, 0.0) + float(amt)
+        return demand
+
     async def _heartbeat_loop(self):
         while not self._shutdown:
             try:
-                await self.gcs.call("heartbeat", self.node_id, self.resources.available)
+                await self.gcs.call(
+                    "heartbeat", self.node_id, self.resources.available,
+                    self._pending_demand(),
+                )
                 nodes = await self.gcs.call("get_nodes")
                 self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
             except rpc.RpcError:

@@ -1,0 +1,213 @@
+"""Autoscaler: declarative reconciliation of cluster size to resource demand.
+
+Design parity: reference autoscaler v2 (`python/ray/autoscaler/v2/` — a reconciler
+over an InstanceManager driven by the GCS autoscaler state, `autoscaler.py:47`
+`update_autoscaling_state`) with the NodeProvider SPI of v1
+(`python/ray/autoscaler/_private/node_provider.py`). The GCS exports unplaceable
+demand (queued task resources + PENDING actors, `rpc_cluster_demand`); the
+reconciler adds nodes until demand fits and removes nodes idle past a timeout.
+`LocalNodeProvider` launches worker nodes as local processes — the
+FakeMultiNodeProvider testing pattern (SURVEY.md §4.3) — while cloud providers
+implement the same three methods against their APIs.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_REQUEST_KEY = b"autoscaler_resource_request"
+_NS = "autoscaler"
+
+
+# -- provider SPI ----------------------------------------------------------
+
+
+class NodeProvider:
+    """Three methods against your infrastructure; everything else is the reconciler."""
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Worker nodes as local raylet processes on this machine (test/laptop cloud)."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster  # ray_tpu.cluster_utils.Cluster
+        self._nodes: Dict[str, Any] = {}
+        self._counter = 0
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        handle = self._cluster.add_node(
+            num_cpus=int(resources.get("CPU", 1)),
+            resources={k: v for k, v in resources.items() if k != "CPU"},
+        )
+        self._counter += 1
+        name = f"local-{self._counter}"
+        self._nodes[name] = handle
+        return name
+
+    def terminate_node(self, node_id: str) -> None:
+        handle = self._nodes.pop(node_id, None)
+        if handle is not None:
+            self._cluster.remove_node(handle)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+
+# -- config + sdk ----------------------------------------------------------
+
+
+@dataclass
+class AutoscalingConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    worker_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1})
+    idle_timeout_s: float = 30.0
+    poll_interval_s: float = 1.0
+    upscaling_speed: int = 2  # max nodes added per reconcile round
+
+
+def request_resources(*, num_cpus: Optional[float] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None):
+    """Explicit demand hint (parity: ray.autoscaler.sdk.request_resources)."""
+    import json
+
+    demand: Dict[str, float] = {}
+    if num_cpus:
+        demand["CPU"] = float(num_cpus)
+    for b in bundles or []:
+        for r, amt in b.items():
+            demand[r] = demand.get(r, 0.0) + float(amt)
+    ray_tpu.global_worker().gcs_call(
+        "kv_put", _NS, _REQUEST_KEY, json.dumps(demand).encode(), True
+    )
+
+
+# -- reconciler ------------------------------------------------------------
+
+
+class Autoscaler:
+    def __init__(self, provider: NodeProvider, config: Optional[AutoscalingConfig] = None):
+        self._provider = provider
+        self._config = config or AutoscalingConfig()
+        self._idle_since: Dict[str, float] = {}  # provider node id -> first idle t
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_scale_ups = 0
+        self.num_scale_downs = 0
+
+    # -- demand/state reads ------------------------------------------------
+    def _demand(self) -> Dict[str, float]:
+        import json
+
+        worker = ray_tpu.global_worker()
+        out = dict(worker.gcs_call("cluster_demand")["pending"])
+        raw = worker.gcs_call("kv_get", _NS, _REQUEST_KEY)
+        if raw:
+            requested = json.loads(raw)
+            avail = worker.gcs_call("cluster_resources")["total"]
+            # request_resources is a floor on TOTAL cluster resources
+            for r, amt in requested.items():
+                shortfall = amt - avail.get(r, 0.0)
+                if shortfall > 0:
+                    out[r] = out.get(r, 0.0) + shortfall
+        return out
+
+    def reconcile_once(self) -> Dict[str, int]:
+        cfg = self._config
+        demand = self._demand()
+        nodes = self._provider.non_terminated_nodes()
+        actions = {"added": 0, "removed": 0}
+        # Upscale: enough worker nodes to absorb the unplaceable demand.
+        if demand:
+            per_node = cfg.worker_resources
+            need = 0
+            for r, amt in demand.items():
+                cap = per_node.get(r, 0.0)
+                if cap > 0:
+                    need = max(need, math.ceil(amt / cap))
+                elif amt > 0:
+                    need = max(need, 0)  # this provider can't supply r
+            room = cfg.max_workers - len(nodes)
+            to_add = max(0, min(need, room, cfg.upscaling_speed))
+            for _ in range(to_add):
+                self._provider.create_node(dict(per_node))
+                self.num_scale_ups += 1
+                actions["added"] += 1
+        # Downscale: provider nodes fully idle (available == total) past timeout.
+        gcs_nodes = ray_tpu.global_worker().gcs_call("get_nodes")
+        idle_cluster_nodes = {
+            tuple(n["address"]) for n in gcs_nodes
+            if n["alive"] and not n["is_head"]
+            and n["resources_available"] == n["resources_total"]
+            # a node with QUEUED work is not idle even though nothing is running
+            # yet — terminating it would strand the queue
+            and not any(n.get("pending_demand", {}).values())
+        }
+        now = time.monotonic()
+        nodes = self._provider.non_terminated_nodes()
+        removable = len(nodes) - max(cfg.min_workers, 0)
+        for node_id in nodes:
+            if removable <= 0:
+                break
+            if self._node_is_idle(node_id, idle_cluster_nodes):
+                first = self._idle_since.setdefault(node_id, now)
+                if now - first >= cfg.idle_timeout_s:
+                    self._provider.terminate_node(node_id)
+                    self._idle_since.pop(node_id, None)
+                    self.num_scale_downs += 1
+                    actions["removed"] += 1
+                    removable -= 1
+            else:
+                self._idle_since.pop(node_id, None)
+        return actions
+
+    def _node_is_idle(self, provider_node_id: str, idle_cluster_nodes) -> bool:
+        handle = getattr(self._provider, "_nodes", {}).get(provider_node_id)
+        addr = getattr(handle, "raylet_port", None)
+        if addr is None:
+            return False
+        return any(a[1] == addr for a in idle_cluster_nodes)
+
+    # -- loop --------------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:
+                pass
+            self._stop.wait(self._config.poll_interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalingConfig",
+    "LocalNodeProvider",
+    "NodeProvider",
+    "request_resources",
+]

@@ -1,0 +1,118 @@
+"""Autoscaler tests: demand-driven upscale, idle downscale, request_resources.
+
+Shape parity: reference python/ray/tests/test_autoscaler_e2e.py +
+autoscaler/v2/tests (reconciler logic against a local provider).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    LocalNodeProvider,
+    request_resources,
+)
+from ray_tpu.cluster_utils import Cluster
+
+_WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1, "env_vars": _WORKER_ENV})
+    c.connect()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_upscale_on_pending_tasks(cluster):
+    autoscaler = Autoscaler(
+        LocalNodeProvider(cluster),
+        AutoscalingConfig(max_workers=2, worker_resources={"CPU": 2},
+                          idle_timeout_s=300),
+    )
+
+    @ray_tpu.remote(num_cpus=2)  # can never fit on the 1-CPU head
+    def big(x):
+        return x * 2
+
+    refs = [big.remote(i) for i in range(4)]
+    # demand reaches the GCS via heartbeats; reconcile until nodes appear
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        autoscaler.reconcile_once()
+        if autoscaler.num_scale_ups >= 1:
+            break
+        time.sleep(0.5)
+    assert autoscaler.num_scale_ups >= 1
+    assert ray_tpu.get(refs, timeout=120) == [0, 2, 4, 6]
+
+
+def test_downscale_idle_nodes(cluster):
+    provider = LocalNodeProvider(cluster)
+    autoscaler = Autoscaler(
+        provider,
+        AutoscalingConfig(min_workers=0, max_workers=2,
+                          worker_resources={"CPU": 1}, idle_timeout_s=1.0),
+    )
+    provider.create_node({"CPU": 1})
+    deadline = time.time() + 20
+    while time.time() < deadline and len(ray_tpu.nodes()) < 2:
+        time.sleep(0.2)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        autoscaler.reconcile_once()
+        if autoscaler.num_scale_downs >= 1:
+            break
+        time.sleep(0.5)
+    assert autoscaler.num_scale_downs >= 1
+    assert provider.non_terminated_nodes() == []
+
+
+def test_request_resources_floor(cluster):
+    autoscaler = Autoscaler(
+        LocalNodeProvider(cluster),
+        AutoscalingConfig(max_workers=3, worker_resources={"CPU": 2},
+                          idle_timeout_s=300),
+    )
+    request_resources(num_cpus=4)  # head has 1; needs 2 worker nodes of 2
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        autoscaler.reconcile_once()
+        total = ray_tpu.cluster_resources().get("CPU", 0)
+        if total >= 4:
+            break
+        time.sleep(0.5)
+    assert ray_tpu.cluster_resources().get("CPU", 0) >= 4
+
+
+def test_upscale_on_pending_actor(cluster):
+    autoscaler = Autoscaler(
+        LocalNodeProvider(cluster),
+        AutoscalingConfig(max_workers=1, worker_resources={"CPU": 2},
+                          idle_timeout_s=300),
+    )
+
+    @ray_tpu.remote(num_cpus=2)
+    class Heavy:
+        def ping(self):
+            return "up"
+
+    a = Heavy.remote()  # unplaceable on the 1-CPU head
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        autoscaler.reconcile_once()
+        if autoscaler.num_scale_ups >= 1:
+            break
+        time.sleep(0.5)
+    assert autoscaler.num_scale_ups >= 1
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "up"
