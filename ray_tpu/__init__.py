@@ -180,8 +180,37 @@ def nodes() -> list:
     return global_worker().gcs_call("get_nodes")
 
 
-def timeline() -> list:
-    return global_worker().gcs_call("list_task_events", 10000)
+def timeline(filename: Optional[str] = None) -> list:
+    """Task events; with `filename`, also write a Chrome trace (chrome://tracing /
+    perfetto) — parity: `ray timeline` (python/ray/_private/internal_api.py)."""
+    events = global_worker().gcs_call("list_task_events", 100000)
+    if filename:
+        import json
+
+        # Pair RUNNING/FINISHED-or-FAILED into complete ("X") slices per task.
+        starts: dict = {}
+        trace = []
+        for e in events:
+            tid = e.get("task_id")
+            state = e.get("state")
+            if state == "RUNNING":
+                starts[tid] = e
+            elif state in ("FINISHED", "FAILED") and tid in starts:
+                s = starts.pop(tid)
+                trace.append({
+                    "name": e.get("name", "task"),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": s["time"] * 1e6,
+                    "dur": max(0.0, (e["time"] - s["time"]) * 1e6),
+                    "pid": e.get("worker_id", "worker")[:8] if isinstance(
+                        e.get("worker_id"), str) else "worker",
+                    "tid": tid[:8],
+                    "args": {"state": state},
+                })
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return events
 
 
 class RuntimeContext:

@@ -170,7 +170,21 @@ class GcsService:
                 for bundle in pg.bundles:
                     for r, amt in bundle.items():
                         pending[r] = pending.get(r, 0.0) + float(amt)
-        return {"pending": pending, "pending_actors": pending_actors}
+        # Nodes that are NOT safe to downscale even when resource-idle: they host
+        # live actors (zero-resource actors reserve nothing) or hold the only
+        # copies of objects a consumer may still fetch.
+        occupied: set = set()
+        for actor in self.actors.values():
+            if actor.state == ALIVE and actor.address:
+                occupied.add(actor.address["node_id"])
+        for entry in self.object_dir.values():
+            for nid in entry["locations"]:
+                occupied.add(nid)
+        return {
+            "pending": pending,
+            "pending_actors": pending_actors,
+            "occupied_nodes": [n.hex() for n in occupied],
+        }
 
     async def rpc_get_nodes(self, conn):
         return [n.view() for n in self.nodes.values()]

@@ -1,0 +1,111 @@
+"""Chaos/fault-injection test utilities.
+
+Design parity: reference `python/ray/_private/test_utils.py` — the ResourceKiller
+hierarchy (`RayletKiller` :1479, `WorkerKillerActor` :1591,
+`get_and_run_resource_killer` :1665) used by chaos and long-running release tests to
+randomly kill nodes/workers while a workload runs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+import ray_tpu
+
+
+class ResourceKiller:
+    """Periodically kill one target until stopped. Subclasses choose targets."""
+
+    def __init__(self, interval_s: float = 1.0, max_to_kill: int = 3,
+                 seed: Optional[int] = None):
+        self._interval = interval_s
+        self._max = max_to_kill
+        self._rng = random.Random(seed)
+        self.killed: List = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _targets(self) -> list:
+        raise NotImplementedError
+
+    def _kill(self, target):
+        raise NotImplementedError
+
+    def run(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set() and len(self.killed) < self._max:
+            self._stop.wait(self._interval)
+            if self._stop.is_set():
+                return
+            targets = [t for t in self._targets() if t not in self.killed]
+            if not targets:
+                continue
+            target = self._rng.choice(targets)
+            try:
+                self._kill(target)
+                self.killed.append(target)
+            except Exception:
+                pass
+
+    def stop(self) -> list:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        return list(self.killed)
+
+
+class NodeKiller(ResourceKiller):
+    """Kills random non-head worker NODES of a cluster_utils.Cluster
+    (RayletKiller/EC2InstanceTerminator role)."""
+
+    def __init__(self, cluster, **kwargs):
+        super().__init__(**kwargs)
+        self._cluster = cluster
+
+    def _targets(self) -> list:
+        return list(self._cluster.worker_nodes)
+
+    def _kill(self, node):
+        self._cluster.remove_node(node)
+
+
+class ActorKiller(ResourceKiller):
+    """Kills random live actors matching a class-name filter (WorkerKillerActor role)."""
+
+    def __init__(self, class_name: Optional[str] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._class_name = class_name
+
+    def _targets(self) -> list:
+        from ray_tpu.util import state
+
+        out = []
+        for a in state.list_actors():
+            if a.get("state") != "ALIVE":
+                continue
+            if self._class_name and a.get("class_name") != self._class_name:
+                continue
+            out.append(a["actor_id"])
+        return out
+
+    def _kill(self, actor_id):
+        from ray_tpu.actor import ActorHandle
+
+        # Chaos simulates a CRASH: no_restart=False lets max_restarts kick in
+        # (no_restart=True is a permanent administrative kill).
+        ray_tpu.kill(ActorHandle(actor_id, [], ""), no_restart=False)
+
+
+def get_and_run_resource_killer(killer_cls, interval_s: float = 1.0, **kwargs):
+    """Parity: test_utils.get_and_run_resource_killer — construct + start."""
+    killer = killer_cls(interval_s=interval_s, **kwargs)
+    killer.run()
+    return killer

@@ -278,6 +278,7 @@ class CoreWorker:
 
     def _record_event(self, **fields):
         fields["time"] = time.time()
+        fields["worker_id"] = self.worker_id.hex()  # per-worker timeline lanes
         with self._events_lock:
             self._task_events.append(fields)
             if len(self._task_events) > CONFIG.event_buffer_size:

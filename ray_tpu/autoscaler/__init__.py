@@ -29,7 +29,10 @@ _NS = "autoscaler"
 
 
 class NodeProvider:
-    """Three methods against your infrastructure; everything else is the reconciler."""
+    """Provider SPI. create/terminate/list drive scaling; cluster_address maps a
+    provider node to its raylet (host, port) so the reconciler can tell which
+    CLUSTER node a provider node is — providers that return None opt out of
+    downscale (nodes are only ever added)."""
 
     def create_node(self, resources: Dict[str, float]) -> str:
         raise NotImplementedError
@@ -39,6 +42,9 @@ class NodeProvider:
 
     def non_terminated_nodes(self) -> List[str]:
         raise NotImplementedError
+
+    def cluster_address(self, node_id: str) -> Optional[tuple]:
+        return None
 
 
 class LocalNodeProvider(NodeProvider):
@@ -66,6 +72,12 @@ class LocalNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         return list(self._nodes)
+
+    def cluster_address(self, node_id: str) -> Optional[tuple]:
+        handle = self._nodes.get(node_id)
+        if handle is None:
+            return None
+        return ("127.0.0.1", handle.raylet_port)
 
 
 # -- config + sdk ----------------------------------------------------------
@@ -111,11 +123,13 @@ class Autoscaler:
         self.num_scale_downs = 0
 
     # -- demand/state reads ------------------------------------------------
-    def _demand(self) -> Dict[str, float]:
+    def _demand(self, demand_info: Optional[dict] = None) -> Dict[str, float]:
         import json
 
         worker = ray_tpu.global_worker()
-        out = dict(worker.gcs_call("cluster_demand")["pending"])
+        if demand_info is None:
+            demand_info = worker.gcs_call("cluster_demand")
+        out = dict(demand_info["pending"])
         raw = worker.gcs_call("kv_get", _NS, _REQUEST_KEY)
         if raw:
             requested = json.loads(raw)
@@ -129,10 +143,15 @@ class Autoscaler:
 
     def reconcile_once(self) -> Dict[str, int]:
         cfg = self._config
-        demand = self._demand()
-        nodes = self._provider.non_terminated_nodes()
+        worker = ray_tpu.global_worker()
+        demand_info = worker.gcs_call("cluster_demand")
+        demand = self._demand(demand_info)
+        gcs_nodes = worker.gcs_call("get_nodes")
+        provider_nodes = self._provider.non_terminated_nodes()
         actions = {"added": 0, "removed": 0}
-        # Upscale: enough worker nodes to absorb the unplaceable demand.
+        # Upscale: enough worker nodes to absorb the unplaceable demand — minus
+        # nodes already LAUNCHED but not yet registered with the GCS (counting
+        # them again would over-provision to max_workers while they boot).
         if demand:
             per_node = cfg.worker_resources
             need = 0
@@ -140,31 +159,40 @@ class Autoscaler:
                 cap = per_node.get(r, 0.0)
                 if cap > 0:
                     need = max(need, math.ceil(amt / cap))
-                elif amt > 0:
-                    need = max(need, 0)  # this provider can't supply r
-            room = cfg.max_workers - len(nodes)
+            registered = {
+                tuple(n["address"]) for n in gcs_nodes if n["alive"] and not n["is_head"]
+            }
+            in_flight = sum(
+                1 for pid in provider_nodes
+                if self._provider.cluster_address(pid) not in registered
+            )
+            need = max(0, need - in_flight)
+            room = cfg.max_workers - len(provider_nodes)
             to_add = max(0, min(need, room, cfg.upscaling_speed))
             for _ in range(to_add):
                 self._provider.create_node(dict(per_node))
                 self.num_scale_ups += 1
                 actions["added"] += 1
-        # Downscale: provider nodes fully idle (available == total) past timeout.
-        gcs_nodes = ray_tpu.global_worker().gcs_call("get_nodes")
+        # Downscale: provider nodes idle past the timeout. Idle = no running work
+        # (available == total), nothing queued, AND not occupied by live actors or
+        # resident objects (zero-resource actors reserve nothing; a node holding
+        # the only copy of an object must survive until it's fetched/freed).
+        occupied = set(demand_info.get("occupied_nodes", []))
         idle_cluster_nodes = {
             tuple(n["address"]) for n in gcs_nodes
             if n["alive"] and not n["is_head"]
             and n["resources_available"] == n["resources_total"]
-            # a node with QUEUED work is not idle even though nothing is running
-            # yet — terminating it would strand the queue
             and not any(n.get("pending_demand", {}).values())
+            and n["node_id"].hex() not in occupied
         }
         now = time.monotonic()
-        nodes = self._provider.non_terminated_nodes()
-        removable = len(nodes) - max(cfg.min_workers, 0)
-        for node_id in nodes:
+        provider_nodes = self._provider.non_terminated_nodes()
+        removable = len(provider_nodes) - max(cfg.min_workers, 0)
+        for node_id in provider_nodes:
             if removable <= 0:
                 break
-            if self._node_is_idle(node_id, idle_cluster_nodes):
+            addr = self._provider.cluster_address(node_id)
+            if addr is not None and tuple(addr) in idle_cluster_nodes:
                 first = self._idle_since.setdefault(node_id, now)
                 if now - first >= cfg.idle_timeout_s:
                     self._provider.terminate_node(node_id)
@@ -176,13 +204,6 @@ class Autoscaler:
                 self._idle_since.pop(node_id, None)
         return actions
 
-    def _node_is_idle(self, provider_node_id: str, idle_cluster_nodes) -> bool:
-        handle = getattr(self._provider, "_nodes", {}).get(provider_node_id)
-        addr = getattr(handle, "raylet_port", None)
-        if addr is None:
-            return False
-        return any(a[1] == addr for a in idle_cluster_nodes)
-
     # -- loop --------------------------------------------------------------
     def start(self):
         if self._thread is None:
@@ -190,11 +211,19 @@ class Autoscaler:
             self._thread.start()
 
     def _run(self):
+        import traceback
+
+        consecutive_failures = 0
         while not self._stop.is_set():
             try:
                 self.reconcile_once()
+                consecutive_failures = 0
             except Exception:
-                pass
+                consecutive_failures += 1
+                if consecutive_failures in (1, 10, 100):
+                    # A silently-broken autoscaler looks like "tasks hang forever";
+                    # log early, then rate-limit.
+                    traceback.print_exc()
             self._stop.wait(self._config.poll_interval_s)
 
     def stop(self):

@@ -498,6 +498,49 @@ class Dataset:
     def to_jax(self, **kwargs):
         return self.iter_jax_batches(**kwargs)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: str = "cpu",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        prefetch_batches: int = 1,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (parity: reference iter_torch_batches —
+        data/iterator.py). The JAX path is the first-class one here; this keeps
+        torch-based loops portable."""
+        import torch
+
+        def to_torch(np_batch):
+            out = {}
+            for name, arr in np_batch.items():
+                t = torch.as_tensor(arr)
+                want_dtype = dtypes.get(name) if dtypes else None
+                if want_dtype is not None or device != "cpu":
+                    # single .to(): no intermediate tensor per column per batch
+                    t = t.to(device=device if device != "cpu" else None,
+                             dtype=want_dtype)
+                out[name] = t
+            return out
+
+        it = map(
+            to_torch,
+            self.iter_batches(
+                batch_size=batch_size,
+                batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                prefetch_batches=0,
+            ),
+        )
+        if prefetch_batches and prefetch_batches > 0:
+            from ray_tpu.data.iterator import prefetched
+
+            return prefetched(it, prefetch_batches)
+        return it
+
     # -- splits ------------------------------------------------------------
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         bundles = list(self._execute())

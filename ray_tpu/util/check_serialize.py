@@ -1,0 +1,77 @@
+"""Serializability debugging: find WHICH member of an object fails to pickle.
+
+Parity: reference `python/ray/util/check_serialize.py`
+(inspect_serializability) — walks closures/attributes of a failing object and
+reports the leaf culprits instead of one opaque pickling error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Set, Tuple
+
+import cloudpickle
+
+
+def _try(obj) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def inspect_serializability(obj: Any, name: str = None, _depth: int = 3,
+                            _seen: Set[int] = None, _prefix: str = "") -> Tuple[bool, list]:
+    """Returns (serializable, [failure descriptions])."""
+    name = name or getattr(obj, "__name__", type(obj).__name__)
+    _seen = _seen if _seen is not None else set()
+    if id(obj) in _seen:
+        return True, []
+    _seen.add(id(obj))
+    if _try(obj):
+        return True, []
+    failures = []
+    label = f"{_prefix}{name}"
+    found_inner = False
+    if _depth > 0:
+        # closure cells of functions
+        closure = getattr(obj, "__closure__", None)
+        if closure:
+            names = obj.__code__.co_freevars
+            for var, cell in zip(names, closure):
+                try:
+                    inner = cell.cell_contents
+                except ValueError:
+                    continue
+                ok, inner_fail = inspect_serializability(
+                    inner, var, _depth - 1, _seen, label + ".")
+                if not ok:
+                    found_inner = True
+                    failures.extend(inner_fail)
+        # instance attributes
+        attrs = getattr(obj, "__dict__", None)
+        if isinstance(attrs, dict):
+            for attr, value in attrs.items():
+                ok, inner_fail = inspect_serializability(
+                    value, attr, _depth - 1, _seen, label + ".")
+                if not ok:
+                    found_inner = True
+                    failures.extend(inner_fail)
+        # container elements
+        if isinstance(obj, (list, tuple, set)):
+            for i, v in enumerate(obj):
+                ok, inner_fail = inspect_serializability(
+                    v, f"[{i}]", _depth - 1, _seen, label)
+                if not ok:
+                    found_inner = True
+                    failures.extend(inner_fail)
+        elif isinstance(obj, dict):
+            for k, v in obj.items():
+                ok, inner_fail = inspect_serializability(
+                    v, f"[{k!r}]", _depth - 1, _seen, label)
+                if not ok:
+                    found_inner = True
+                    failures.extend(inner_fail)
+    if not found_inner:
+        failures.append(f"{label} (type {type(obj).__name__}) is not serializable")
+    return False, failures

@@ -223,3 +223,86 @@ def test_prometheus_histogram_exposition():
     assert 'expo_latency_bucket{le="+Inf"} 3.0' in text
     assert "expo_latency_count 3.0" in text
     assert "expo_latency_sum 55.5" in text
+
+
+def test_chaos_actor_killer_and_recovery():
+    from ray_tpu._private.test_utils import ActorKiller
+
+    @ray_tpu.remote(max_restarts=3)
+    class Victim:
+        def ping(self):
+            return "ok"
+
+    actors = [Victim.remote() for _ in range(2)]
+    assert all(ray_tpu.get(a.ping.remote()) == "ok" for a in actors)
+    killer = ActorKiller(class_name="Victim", interval_s=0.2, max_to_kill=1, seed=0)
+    killer.run()
+    deadline = time.time() + 30
+    while time.time() < deadline and not killer.killed:
+        time.sleep(0.2)
+    killed = killer.stop()
+    assert len(killed) == 1
+    # max_restarts>0: the killed actor comes back
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            assert all(
+                ray_tpu.get(a.ping.remote(), timeout=30) == "ok" for a in actors
+            )
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("killed actor did not recover")
+
+
+def test_timeline_chrome_trace(tmp_path):
+    import json
+
+    @ray_tpu.remote
+    def traced_task():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced_task.remote() for _ in range(3)])
+    trace_file = tmp_path / "trace.json"
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        ray_tpu.timeline(str(trace_file))
+        trace = json.loads(trace_file.read_text())
+        if any(e["name"] == "traced_task" for e in trace):
+            break
+        time.sleep(0.5)
+    trace = json.loads(trace_file.read_text())
+    slices = [e for e in trace if e["name"] == "traced_task"]
+    assert slices and all(e["ph"] == "X" and e["dur"] >= 0 for e in slices)
+
+
+def test_iter_torch_batches():
+    import torch
+
+    from ray_tpu import data as rd
+
+    ds = rd.range(64)
+    batches = list(ds.iter_torch_batches(batch_size=16, dtypes={"id": torch.float32}))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert all(b["id"].dtype == torch.float32 for b in batches)
+    assert float(sum(b["id"].sum() for b in batches)) == sum(range(64))
+
+
+def test_inspect_serializability():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    lock = threading.Lock()
+
+    def bad_fn():
+        return lock  # unpicklable closure
+
+    ok, failures = inspect_serializability(bad_fn)
+    assert not ok
+    assert any("lock" in f for f in failures)
+    ok2, failures2 = inspect_serializability(lambda: 42)
+    assert ok2 and not failures2
