@@ -1,0 +1,64 @@
+"""Minimal dependency-free HTTP/1.1 helpers shared by the serve proxy and the
+dashboard (one parser, one response writer — not two hand-rolled copies)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    500: "Internal Server Error",
+}
+
+
+@dataclass
+class HttpRequest:
+    method: str = "GET"
+    path: str = "/"
+    query: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+
+async def read_http_request(reader: asyncio.StreamReader) -> Optional[HttpRequest]:
+    line = await reader.readline()
+    if not line:
+        return None
+    method, target, _version = line.decode().split(" ", 2)
+    headers: Dict[str, str] = {}
+    while True:
+        hline = await reader.readline()
+        if hline in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = hline.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+    body = b""
+    length = int(headers.get("content-length", "0") or 0)
+    if length:
+        body = await reader.readexactly(length)
+    split = urlsplit(target)
+    return HttpRequest(
+        method=method.upper(),
+        path=split.path,
+        query=dict(parse_qsl(split.query)),
+        headers=headers,
+        body=body,
+    )
+
+
+async def write_http_response(writer: asyncio.StreamWriter, status: int,
+                              body: bytes, content_type: str):
+    reason = _REASONS.get(status, "OK")
+    writer.write(
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n".encode()
+        + body
+    )
+    await writer.drain()

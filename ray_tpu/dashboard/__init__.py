@@ -1,0 +1,171 @@
+"""Dashboard: HTTP observability over the cluster.
+
+Design parity: reference `python/ray/dashboard/` (head.py + modules serving the
+state/jobs/nodes APIs the React UI consumes). Rebuilt small: one async actor runs a
+dependency-free HTTP server exposing the JSON API (`/api/...`) and a self-contained
+HTML page that polls it — no build step, no JS dependencies. The heavy lifting is the
+same state sources the `ray_tpu.util.state` API reads (GCS tables + task events).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+import ray_tpu
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.5rem; background: #fafafa; }
+ h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.2rem; }
+ table { border-collapse: collapse; width: 100%; background: #fff; }
+ th, td { border: 1px solid #ddd; padding: 4px 8px; font-size: 0.85rem; text-align: left; }
+ th { background: #f0f0f0; }
+ .pill { padding: 1px 8px; border-radius: 10px; font-size: 0.8rem; }
+ .ALIVE, .SUCCEEDED, .FINISHED { background: #d4efd4; }
+ .DEAD, .FAILED { background: #f3d0d0; }
+ .PENDING_CREATION, .RUNNING, .PENDING { background: #fdeec7; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, c => (
+    {"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+}
+function row(cells, tag) {
+  return "<tr>" + cells.map(c => `<${tag||"td"}>${c}</${tag||"td"}>`).join("") + "</tr>";
+}
+function pill(s) { return `<span class="pill ${esc(s)}">${esc(s)}</span>`; }
+async function refresh() {
+  const s = await (await fetch("/api/cluster")).json();
+  document.getElementById("summary").innerHTML =
+    `<b>${s.alive_nodes}</b> nodes · CPU ${JSON.stringify(s.resources_available.CPU||0)}` +
+    ` / ${JSON.stringify(s.resources_total.CPU||0)} available` +
+    ` · actors ${JSON.stringify(s.actors)} · tasks ${JSON.stringify(s.tasks)}`;
+  const nodes = await (await fetch("/api/nodes")).json();
+  document.getElementById("nodes").innerHTML = row(["node", "address", "total", "available", "state"], "th") +
+    nodes.map(n => row([esc(n.node_id), esc(n.address), esc(JSON.stringify(n.resources_total)),
+                        esc(JSON.stringify(n.resources_available)),
+                        pill(n.alive ? "ALIVE" : "DEAD")])).join("");
+  const actors = await (await fetch("/api/actors")).json();
+  document.getElementById("actors").innerHTML = row(["actor", "class", "name", "state", "restarts"], "th") +
+    actors.map(a => row([esc(a.actor_id), esc(a.class_name), esc(a.name || ""),
+                         pill(a.state), esc(a.num_restarts)])).join("");
+  const jobs = await (await fetch("/api/jobs")).json();
+  document.getElementById("jobs").innerHTML = row(["job", "status", "entrypoint"], "th") +
+    jobs.map(j => row([esc(j.job_id), pill(j.status), esc(j.entrypoint)])).join("");
+  const tasks = await (await fetch("/api/tasks?limit=50")).json();
+  document.getElementById("tasks").innerHTML = row(["task", "name", "state"], "th") +
+    tasks.slice(-50).reverse().map(t => row([esc(t.task_id), esc(t.name), pill(t.state)])).join("");
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class DashboardActor:
+    """Async actor serving the dashboard HTTP endpoint."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._server = None
+
+    async def start(self) -> int:
+        if self._server is not None:
+            return self._port
+        self._server = await asyncio.start_server(self._handle, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def _state(self, path: str, query: dict):
+        from ray_tpu.util import state
+
+        loop = asyncio.get_running_loop()
+        if path == "/api/cluster":
+            return await loop.run_in_executor(None, state.cluster_summary)
+        if path == "/api/nodes":
+            return await loop.run_in_executor(None, state.list_nodes)
+        if path == "/api/actors":
+            return await loop.run_in_executor(None, state.list_actors)
+        if path == "/api/tasks":
+            limit = int(query.get("limit", "200"))
+            return await loop.run_in_executor(None, lambda: state.list_tasks(limit=limit))
+        if path == "/api/objects":
+            return await loop.run_in_executor(None, state.list_objects)
+        if path == "/api/jobs":
+            return await loop.run_in_executor(None, state.list_jobs)
+        return None
+
+    async def _handle(self, reader, writer):
+        from ray_tpu._private.http import read_http_request, write_http_response
+
+        try:
+            request = await read_http_request(reader)
+            if request is None:
+                writer.close()
+                return
+            if request.path in ("/", "/index.html"):
+                body, ctype, status = _PAGE.encode(), "text/html", 200
+            else:
+                data = await self._state(request.path, request.query)
+                if data is None:
+                    body, ctype, status = b"not found", "text/plain", 404
+                else:
+                    body = json.dumps(data, default=str).encode()
+                    ctype, status = "application/json", 200
+        except Exception as e:
+            body, ctype, status = str(e).encode(), "text/plain", 500
+        try:
+            await write_http_response(writer, status, body, ctype)
+        finally:
+            writer.close()
+
+    async def get_port(self) -> int:
+        return self._port
+
+
+_state: dict = {}
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
+    """Start (or return) the cluster dashboard; returns the bound port."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if _state.get("actor") is None:
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        cls = ray_tpu.remote(num_cpus=0)(DashboardActor)
+        actor = cls.options(
+            name="RTPU_DASHBOARD", namespace="dashboard", get_if_exists=True,
+            max_concurrency=100,
+            # Pin to the CALLER's node: the server binds loopback, so the returned
+            # port must be reachable from where start_dashboard was invoked.
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=ray_tpu.global_worker().node_id, soft=False
+            ),
+        ).remote(host, port)
+        _state["actor"] = actor
+        _state["port"] = ray_tpu.get(actor.start.remote())
+    return _state["port"]
+
+
+def stop_dashboard():
+    actor = _state.pop("actor", None)
+    _state.pop("port", None)
+    if actor is not None:
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+
+__all__ = ["DashboardActor", "start_dashboard", "stop_dashboard"]

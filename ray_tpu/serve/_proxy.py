@@ -12,8 +12,6 @@ import asyncio
 import json
 import traceback
 from typing import Dict, Optional
-from urllib.parse import parse_qsl, urlsplit
-
 from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE, Request
 
 
@@ -61,52 +59,24 @@ class HTTPProxy:
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
+        from ray_tpu._private.http import read_http_request, write_http_response
+
         try:
-            request = await self._read_request(reader)
-            if request is None:
+            raw = await read_http_request(reader)
+            if raw is None:
                 writer.close()
                 return
+            request = Request(
+                method=raw.method, path=raw.path, query_params=raw.query,
+                headers=raw.headers, body=raw.body,
+            )
             status, body, ctype = await self._dispatch(request)
         except Exception:
             status, body, ctype = 500, traceback.format_exc().encode(), "text/plain"
         try:
-            writer.write(
-                b"HTTP/1.1 %d %s\r\n" % (status, {200: b"OK", 404: b"Not Found",
-                                                  500: b"Internal Server Error"}.get(
-                                                      status, b"OK"))
-                + b"Content-Type: %s\r\n" % ctype.encode()
-                + b"Content-Length: %d\r\n" % len(body)
-                + b"Connection: close\r\n\r\n"
-                + body
-            )
-            await writer.drain()
+            await write_http_response(writer, status, body, ctype)
         finally:
             writer.close()
-
-    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Request]:
-        line = await reader.readline()
-        if not line:
-            return None
-        method, target, _version = line.decode().split(" ", 2)
-        headers: Dict[str, str] = {}
-        while True:
-            hline = await reader.readline()
-            if hline in (b"\r\n", b"\n", b""):
-                break
-            k, _, v = hline.decode().partition(":")
-            headers[k.strip().lower()] = v.strip()
-        body = b""
-        length = int(headers.get("content-length", "0") or 0)
-        if length:
-            body = await reader.readexactly(length)
-        split = urlsplit(target)
-        return Request(
-            method=method.upper(),
-            path=split.path,
-            query_params=dict(parse_qsl(split.query)),
-            headers=headers,
-            body=body,
-        )
 
     async def _dispatch(self, request: Request):
         # Longest matching route prefix wins.

@@ -54,11 +54,19 @@ def list_jobs() -> List[Dict[str, Any]]:
 
 
 def summarize_tasks() -> Dict[str, int]:
-    """Task counts by state (parity: `ray summary tasks`)."""
-    by_state: Counter = Counter()
+    """Task counts by LATEST state per task (parity: `ray summary tasks`).
+
+    Events are a log flushed per-worker on independent timers, so both list order
+    and arrival order interleave; the per-event `time` field decides latest."""
+    latest: Dict[str, tuple] = {}
     for e in list_tasks(limit=100_000):
-        by_state[e.get("state", "UNKNOWN")] += 1
-    return dict(by_state)
+        tid = e.get("task_id")
+        if tid is None:
+            continue
+        t = e.get("time", 0.0)
+        if tid not in latest or t >= latest[tid][0]:
+            latest[tid] = (t, e.get("state", "UNKNOWN"))
+    return dict(Counter(state for _t, state in latest.values()))
 
 
 def summarize_actors() -> Dict[str, int]:

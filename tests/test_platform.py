@@ -306,3 +306,33 @@ def test_inspect_serializability():
     assert any("lock" in f for f in failures)
     ok2, failures2 = inspect_serializability(lambda: 42)
     assert ok2 and not failures2
+
+
+def test_dashboard_endpoints():
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Dash:
+        def ping(self):
+            return 1
+
+    d = Dash.remote()
+    ray_tpu.get(d.ping.remote())
+    port = start_dashboard(port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/", timeout=30) as r:
+            assert b"ray_tpu dashboard" in r.read()
+        with urllib.request.urlopen(base + "/api/cluster", timeout=30) as r:
+            summary = _json.loads(r.read())
+        assert summary["alive_nodes"] >= 1
+        with urllib.request.urlopen(base + "/api/actors", timeout=30) as r:
+            actors = _json.loads(r.read())
+        assert any(a["class_name"] == "Dash" for a in actors)
+        with urllib.request.urlopen(base + "/api/nodes", timeout=30) as r:
+            assert _json.loads(r.read())
+    finally:
+        stop_dashboard()
