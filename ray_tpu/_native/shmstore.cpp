@@ -450,6 +450,26 @@ uint64_t shmstore_count(void* arena) {
   return n;
 }
 
+// List up to max_out SEALED, unpinned entry ids in LRU order (spill candidates:
+// the store can copy them out and evict to make room). Writes 16-byte ids
+// consecutively into out; returns the count.
+uint32_t shmstore_list_spillable(void* arena, uint8_t* out, uint32_t max_out) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->hdr;
+  lock(h);
+  uint32_t n = 0;
+  for (uint32_t idx = h->lru_head; idx != kEmpty && n < max_out;
+       idx = h->entries[idx].lru_next) {
+    Entry& e = h->entries[idx];
+    if (e.state == KSTATE_SEALED && e.pins == 0) {
+      memcpy(out + 16 * n, e.id, 16);
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
 // Base pointer for ctypes to build zero-copy memoryviews.
 void* shmstore_base(void* arena) { return ((Arena*)arena)->base; }
 uint64_t shmstore_map_len(void* arena) { return ((Arena*)arena)->map_len; }

@@ -37,6 +37,10 @@ def load() -> Optional[ctypes.CDLL]:
     ]
     lib.shmstore_free_obj.restype = ctypes.c_int
     lib.shmstore_free_obj.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.shmstore_list_spillable.restype = ctypes.c_uint32
+    lib.shmstore_list_spillable.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+    ]
     lib.shmstore_pin.restype = ctypes.c_int
     lib.shmstore_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shmstore_release.restype = ctypes.c_int
@@ -101,8 +105,11 @@ class _ArenaHandle:
     def read_pinned(self, object_id: bytes, offset: int, size: int) -> memoryview:
         """A zero-copy view that PINS the object: the arena will not recycle the
         payload while this view (or any memoryview/ndarray sliced from it) is
-        alive. The pin releases when the region object is garbage collected."""
-        self.pin(object_id)
+        alive. The pin releases when the region object is garbage collected.
+        Raises KeyError if the object vanished (evicted/spilled) since the caller
+        resolved its location — callers re-resolve."""
+        if not self.pin(object_id):
+            raise KeyError(object_id.hex())
         region = _PinnedRegion(self, object_id, self._view.view[offset : offset + size])
         return memoryview(region)
 
@@ -155,6 +162,12 @@ class NativeStoreServer(_ArenaHandle):
 
     def free(self, object_id: bytes, eager: bool = False) -> bool:
         return self._lib.shmstore_free_obj(self._h, object_id, 1 if eager else 0) == 0
+
+    def list_spillable(self, max_out: int = 256) -> list:
+        """Sealed, unpinned object keys in LRU order (spill candidates)."""
+        buf = ctypes.create_string_buffer(16 * max_out)
+        n = self._lib.shmstore_list_spillable(self._h, buf, max_out)
+        return [buf.raw[16 * i : 16 * (i + 1)] for i in range(n)]
 
     @property
     def used(self) -> int:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import shutil
 import threading
 import time
 from collections import OrderedDict
@@ -95,18 +96,82 @@ def SharedObjectStore(capacity_bytes: int):
 
 
 class NativeSharedObjectStore:
-    """C++ arena backend. Location names: '@<arena>:<offset>:<size>'."""
+    """C++ arena backend. Location names: '@<arena>:<offset>:<size>:<key>' for
+    in-arena objects, '#spill:<path>:<size>' for objects spilled to disk.
 
-    def __init__(self, capacity_bytes: int):
+    Spilling (plasma parity: local_object_manager spill orchestration): when an
+    allocation cannot fit even after evicting freed objects, sealed unpinned
+    objects are copied out to files in LRU order and evicted, and reads serve
+    them from the file via mmap."""
+
+    def __init__(self, capacity_bytes: int, spill_dir: str | None = None):
         from ray_tpu._native.shmstore import NativeStoreServer
 
         self.capacity = capacity_bytes
         self._arena_name = f"rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
         self._srv = NativeStoreServer(self._arena_name, capacity_bytes)
+        spill_root = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "spill"
+        )
+        self._spill_dir = spill_dir or os.path.join(spill_root, self._arena_name)
+        self._sweep_stale_spill_dirs(spill_root)
+        self._spilled: dict[bytes, tuple[str, int]] = {}  # key -> (path, size)
+        self.num_spilled = 0
+        self.spilled_bytes = 0
         # Unsealed objects: the native index only serves sealed lookups, but
         # create()/seal()/put_bytes() need the placement before sealing.
         self._unsealed: dict[ObjectID, tuple[int, int]] = {}
         self._lock = threading.Lock()
+
+    # -- spilling ----------------------------------------------------------
+    def _spill_one(self) -> bool:
+        """Copy the LRU sealed, unpinned object to disk and evict it. Disk IO
+        happens without self._lock; only the bookkeeping mutation takes it."""
+        for key in self._srv.list_spillable(64):
+            with self._lock:
+                if key in self._spilled:
+                    continue
+            found = self._srv.lookup(key)
+            if found is None:
+                continue
+            off, size = found
+            if not self._srv.pin(key):
+                continue
+            try:
+                os.makedirs(self._spill_dir, exist_ok=True)
+                path = os.path.join(self._spill_dir, key.hex())
+                with open(path, "wb") as f:
+                    f.write(self._srv.read(off, size))
+            finally:
+                self._srv.release(key)
+            with self._lock:
+                self._spilled[key] = (path, size)
+                self.num_spilled += 1
+                self.spilled_bytes += size
+            self._srv.free(key, eager=True)
+            return True
+        return False
+
+    @staticmethod
+    def _sweep_stale_spill_dirs(spill_root: str):
+        """Best-effort cleanup of spill dirs left by crashed stores (their embedded
+        pid is gone). Prevents /tmp filling up across repeated crashes."""
+        try:
+            for name in os.listdir(spill_root):
+                parts = name.split("_")  # rtpu_arena_<pid>_<rand>
+                if len(parts) < 4 or not parts[2].isdigit():
+                    continue
+                pid = int(parts[2])
+                try:
+                    os.kill(pid, 0)
+                    continue  # owner alive
+                except ProcessLookupError:
+                    pass
+                except PermissionError:
+                    continue
+                shutil.rmtree(os.path.join(spill_root, name), ignore_errors=True)
+        except OSError:
+            pass
 
     def _name_of(self, offset: int, size: int, key: bytes) -> str:
         # The key rides in the name so readers can pin the object against
@@ -119,9 +184,13 @@ class NativeSharedObjectStore:
             if object_id in self._unsealed:
                 off, sz = self._unsealed[object_id]
                 return self._name_of(off, sz, key)
-            found = self._srv.lookup(key)
-            if found is not None:
-                return self._name_of(*found, key)
+        found = self._srv.lookup(key)
+        if found is not None:
+            return self._name_of(*found, key)
+        # Allocation + spilling run OUTSIDE self._lock: the C++ arena has its own
+        # process-shared mutex, and a multi-second disk spill must not block every
+        # other store call on this node.
+        while True:
             try:
                 off = self._srv.alloc(key, size)
             except FileExistsError:
@@ -129,13 +198,19 @@ class NativeSharedObjectStore:
                 if found is not None:
                     return self._name_of(*found, key)
                 raise
-            if off is None:
+            if off is not None:
+                break
+            # Full even after evicting freed entries: spill sealed LRU
+            # objects to disk until the allocation fits.
+            if not self._spill_one():
                 raise ObjectStoreFullError(
                     f"object of {size} bytes does not fit: "
-                    f"{self._srv.used}/{self.capacity} used"
+                    f"{self._srv.used}/{self.capacity} used, "
+                    f"{self.num_spilled} objects already spilled"
                 )
+        with self._lock:
             self._unsealed[object_id] = (off, size)
-            return self._name_of(off, size, key)
+        return self._name_of(off, size, key)
 
     def put_bytes(self, object_id: ObjectID, data: bytes) -> str:
         name = self.create(object_id, len(data))
@@ -157,19 +232,31 @@ class NativeSharedObjectStore:
         self._srv.seal(_native_key(object_id))
 
     def contains(self, object_id: ObjectID) -> bool:
-        return self._srv.lookup(_native_key(object_id)) is not None
+        key = _native_key(object_id)
+        return self._srv.lookup(key) is not None or key in self._spilled
 
     def info(self, object_id: ObjectID):
         key = _native_key(object_id)
         found = self._srv.lookup(key)
-        if found is None:
-            return None
-        return (self._name_of(*found, key), found[1])
+        if found is not None:
+            return (self._name_of(*found, key), found[1])
+        spilled = self._spilled.get(key)
+        if spilled is not None:
+            path, size = spilled
+            return (f"#spill:{path}:{size}", size)
+        return None
 
     def read_bytes(self, object_id: ObjectID, offset: int = 0, length: int | None = None) -> bytes:
         key = _native_key(object_id)
         found = self._srv.lookup(key)
         if found is None:
+            spilled = self._spilled.get(key)
+            if spilled is not None:
+                path, size = spilled
+                end = size if length is None else min(offset + length, size)
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(end - offset)
             raise KeyError(f"object {object_id} not sealed/present")
         off, size = found
         end = size if length is None else min(offset + length, size)
@@ -182,9 +269,17 @@ class NativeSharedObjectStore:
             self._srv.release(key)
 
     def free(self, object_id: ObjectID, eager: bool = False):
+        key = _native_key(object_id)
         with self._lock:
             self._unsealed.pop(object_id, None)
-        self._srv.free(_native_key(object_id), eager=eager)
+            spilled = self._spilled.pop(key, None)
+        if spilled is not None:
+            try:
+                os.remove(spilled[0])
+            except OSError:
+                pass
+            self.spilled_bytes -= spilled[1]
+        self._srv.free(key, eager=eager)
 
     @property
     def used(self) -> int:
@@ -196,11 +291,15 @@ class NativeSharedObjectStore:
             "used_bytes": self._srv.used,
             "capacity_bytes": self.capacity,
             "num_evictions": self._srv.num_evictions,
+            "num_spilled": self.num_spilled,
+            "spilled_bytes": max(0, self.spilled_bytes),
             "backend": "native",
         }
 
     def destroy(self):
         self._srv.destroy()
+        self._spilled.clear()
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
 
 class PySharedObjectStore:
@@ -347,10 +446,21 @@ class LocalObjectReader:
 
     def read(self, shm_name: str, size: int) -> memoryview:
         with self._lock:
+            if shm_name.startswith("#spill:"):
+                import mmap
+
+                rest = shm_name[len("#spill:"):]
+                path, _, sz = rest.rpartition(":")
+                with open(path, "rb") as f:
+                    mapped = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                # The mmap stays alive via the returned memoryview; page cache
+                # makes repeated spilled reads cheap.
+                return memoryview(mapped)[: min(size, int(sz))]
             if shm_name.startswith("@"):
                 arena, off, sz, key = self._parse(shm_name)
                 # Pinned view: the arena can't recycle this payload while any
-                # deserialized alias of the returned buffer is alive.
+                # deserialized alias of the returned buffer is alive. KeyError =
+                # evicted/spilled since resolve; caller re-resolves.
                 return self._arena(arena).read_pinned(key, off, min(size, sz))
             shm = self._maps.get(shm_name)
             if shm is None:

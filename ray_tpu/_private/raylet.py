@@ -555,7 +555,10 @@ class Raylet:
     # ------------------------------------------------------------------ RPC: object store
 
     async def rpc_store_create(self, conn, object_id: ObjectID, size: int):
-        return self.store.create(object_id, size)
+        # Off-loop: under memory pressure create() spills LRU objects to disk,
+        # which must not stall scheduling/heartbeats/resolves on the event loop.
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.store.create, object_id, size)
 
     async def rpc_store_seal(self, conn, object_id: ObjectID, size: int, owner):
         self.store.seal(object_id)
@@ -566,7 +569,8 @@ class Raylet:
         return True
 
     async def rpc_store_put_bytes(self, conn, object_id: ObjectID, data: bytes, owner):
-        name = self.store.put_bytes(object_id, data)
+        loop = asyncio.get_running_loop()
+        name = await loop.run_in_executor(None, self.store.put_bytes, object_id, data)
         try:
             await self.gcs.call("report_object", object_id, self.node_id, len(data), owner)
         except rpc.RpcError:

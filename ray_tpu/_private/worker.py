@@ -348,7 +348,22 @@ class CoreWorker:
             value = serialization.loads(data)
         else:
             shm_name, size = reply["shm"]
-            buf = self.reader.read(shm_name, size)
+            try:
+                buf = self.reader.read(shm_name, size)
+            except (KeyError, FileNotFoundError, OSError):
+                # Location went stale between resolve and read (the store spilled,
+                # evicted, or freed+unlinked the object); one re-resolve gets the
+                # new location. A second stale read means the object is gone.
+                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+                if reply.get("error") or "shm" not in reply:
+                    raise ObjectLostError(ref.id, f"failed to re-resolve {ref}")
+                shm_name, size = reply["shm"]
+                try:
+                    buf = self.reader.read(shm_name, size)
+                except (KeyError, FileNotFoundError, OSError) as e:
+                    raise ObjectLostError(
+                        ref.id, f"object location stale twice for {ref}: {e}"
+                    )
             value = serialization.loads(buf)
         if isinstance(value, RayTpuTaskError):
             raise value.as_instanceof_cause()

@@ -140,6 +140,12 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
     """Start (or return) the cluster dashboard; returns the bound port."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
+    # The cache is per cluster SESSION: after shutdown()+init() the old actor is
+    # gone and a cached port would point at nothing.
+    session = ray_tpu.global_worker().session_token
+    if _state.get("session") != session:
+        _state.clear()
+        _state["session"] = session
     if _state.get("actor") is None:
         from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
