@@ -149,6 +149,7 @@ class Raylet:
         self.peer_conns: dict[NodeID, rpc.Connection] = {}
         self.node_view: dict[NodeID, dict] = {}  # cluster view from GCS
         self._sched_wakeup = asyncio.Event()
+        self._spawning = 0  # worker spawns awaiting registration
         self._pulls_inflight: dict[ObjectID, asyncio.Future] = {}
         self._shutdown = False
 
@@ -264,20 +265,47 @@ class Raylet:
         self.workers[worker_id] = handle
         return handle
 
-    async def _get_idle_worker(self) -> WorkerHandle | None:
+    def _find_idle_worker(self) -> WorkerHandle | None:
         for w in self.workers.values():
-            if w.kind == "worker" and w.alive and w.busy_task is None and w.actor_id is None:
+            if (
+                w.kind == "worker" and w.alive and w.registered.is_set()
+                and w.busy_task is None and w.actor_id is None
+            ):
                 return w
-        # Spawn a fresh one (bounded by resource acquisition done by caller).
+        return None
+
+    def _maybe_spawn_worker(self):
+        """Background worker prestart. Bounded to the node's CPU slots plus slack
+        under normal load, but when EVERY task worker is busy (e.g. nested
+        zero-resource tasks whose parents block in get()), the pool may grow past
+        the cap one spawn at a time — otherwise a parent waiting on a child that
+        can never get a worker deadlocks the node."""
+        cap = max(4, int(self.resources.total.get("CPU", 1))) + 2
+        task_workers = [
+            w for w in self.workers.values()
+            if w.kind == "worker" and w.alive and w.actor_id is None
+        ]
+        all_busy = all(w.busy_task is not None for w in task_workers)
+        over_cap = len(task_workers) + self._spawning >= cap
+        if over_cap and not (all_busy and self._spawning == 0):
+            return
+        if self._spawning >= 4:
+            return
+        self._spawning += 1
         handle = self._spawn_worker()
-        try:
-            await asyncio.wait_for(
-                handle.registered.wait(), CONFIG.worker_register_timeout_s
-            )
-        except asyncio.TimeoutError:
-            await self._kill_worker(handle)
-            return None
-        return handle
+
+        async def wait_registered():
+            try:
+                await asyncio.wait_for(
+                    handle.registered.wait(), CONFIG.worker_register_timeout_s
+                )
+                self._sched_wakeup.set()
+            except asyncio.TimeoutError:
+                await self._kill_worker(handle)
+            finally:
+                self._spawning -= 1
+
+        asyncio.get_running_loop().create_task(wait_registered())
 
     async def _kill_worker(self, handle: WorkerHandle):
         self.workers.pop(handle.worker_id, None)
@@ -342,25 +370,49 @@ class Raylet:
         return (pg["pg_id"], pg["bundle_index"])
 
     async def _scheduler_loop(self):
-        """Reference: ClusterLeaseManager::ScheduleAndGrantLeases."""
+        """Reference: ClusterLeaseManager::ScheduleAndGrantLeases.
+
+        Each wakeup makes ONE full pass, but a resource shape that failed to
+        dispatch is memoized for the pass and later tasks with the same shape are
+        skipped without the (await-laden) dispatch attempt — a deep homogeneous
+        queue (10k queued 1-CPU tasks) costs one real attempt plus cheap dict
+        checks instead of the O(n^2)-awaits rescans that capped bulk-async
+        throughput, while heterogeneous queues still get every distinct shape
+        tried (no head-of-line starvation).
+        """
         while not self._shutdown:
-            await self._sched_wakeup.wait()
+            # Event-driven with a poll fallback: completions/registrations set the
+            # wakeup and dispatch IMMEDIATELY; an unconditional sleep here would
+            # gate throughput to (idle workers)/(sleep) per second.
+            try:
+                await asyncio.wait_for(
+                    self._sched_wakeup.wait(), timeout=0.02 if self.task_queue else None
+                )
+            except asyncio.TimeoutError:
+                pass
             self._sched_wakeup.clear()
-            progress = True
-            while progress and self.task_queue:
-                progress = False
-                remaining = []
-                for spec in self.task_queue:
-                    dispatched = await self._try_dispatch(spec)
-                    if dispatched:
-                        progress = True
-                    else:
-                        remaining.append(spec)
-                self.task_queue = remaining
-            if self.task_queue:
-                # Re-check periodically while tasks wait on resources.
-                await asyncio.sleep(0.02)
-                self._sched_wakeup.set()
+            remaining = []
+            queue, self.task_queue = self.task_queue, []
+            failed_shapes: set = set()
+            for spec in queue:
+                shape = self._dispatch_shape(spec)
+                if shape in failed_shapes:
+                    remaining.append(spec)
+                    continue
+                if not await self._try_dispatch(spec):
+                    remaining.append(spec)
+                    failed_shapes.add(shape)
+            # Work submitted while this pass ran landed in the fresh task_queue.
+            self.task_queue = remaining + self.task_queue
+
+    def _dispatch_shape(self, spec: dict) -> tuple:
+        """Pass-local memo key: specs with equal shape dispatch-or-fail together."""
+        strategy = spec.get("scheduling_strategy") or {}
+        return (
+            tuple(sorted((spec.get("resources") or {}).items())),
+            self._pg_key(spec),
+            strategy.get("node_id"),
+        )
 
     async def _try_dispatch(self, spec: dict) -> bool:
         demand = spec.get("resources") or {}
@@ -389,10 +441,15 @@ class Raylet:
             if await self._maybe_spread(spec):
                 return True
             return False
-        worker = await self._get_idle_worker()
+        worker = self._find_idle_worker()
         if worker is None:
+            # Spawn happens in the BACKGROUND: awaiting a worker's registration
+            # inside the dispatch loop would serialize the whole scheduler behind
+            # process startup. The task stays queued; registration wakes us.
+            self._maybe_spawn_worker()
             return False
-        # Re-check after the await: an actor creation may have taken the resources.
+        # No await separates can_acquire from here (single-threaded loop), so this
+        # acquire cannot fail; it performs the actual bookkeeping.
         if not self.resources.acquire(demand, pg_key):
             return False
         worker.acquired = demand

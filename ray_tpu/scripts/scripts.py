@@ -182,7 +182,8 @@ def cmd_microbenchmark(_args):
     def noop():
         return None
 
-    ray_tpu.get(noop.remote())
+    # Prewarm the worker pool: spawn time must not pollute steady-state rates.
+    ray_tpu.get([noop.remote() for _ in range(100)])
     print(f"single_client_tasks_sync: "
           f"{rate(300, lambda n: [ray_tpu.get(noop.remote()) for _ in range(n)]):.1f}/s")
     print(f"single_client_tasks_async: "

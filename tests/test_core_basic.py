@@ -142,3 +142,37 @@ def test_ref_in_collection_stays_ref(ray_start_regular):
         return ray_tpu.get(ref, timeout=60) + 1
 
     assert ray_tpu.get(unwrap.remote({"refs": [inner]}), timeout=60) == 8
+
+
+def test_no_head_of_line_starvation(ray_start_regular):
+    """Unplaceable tasks at the queue head must not block later feasible ones."""
+    import ray_tpu
+
+    @ray_tpu.remote(resources={"NONEXISTENT": 1}, max_retries=0)
+    def impossible(i):
+        return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def possible(i):
+        return i * 10
+
+    blocked = [impossible.remote(i) for i in range(20)]  # head of the queue
+    feasible = [possible.remote(i) for i in range(20)]
+    assert ray_tpu.get(feasible, timeout=60) == [i * 10 for i in range(20)]
+    del blocked
+
+
+def test_nested_zero_cpu_tasks_progress(ray_start_regular):
+    """Parents blocked in get() must not deadlock children out of worker slots."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote(num_cpus=0)
+    def parent(x):
+        return ray_tpu.get(child.remote(x))
+
+    out = ray_tpu.get([parent.remote(i) for i in range(8)], timeout=120)
+    assert out == [i + 1 for i in range(8)]
