@@ -281,9 +281,13 @@ class Raylet:
         the cap one spawn at a time — otherwise a parent waiting on a child that
         can never get a worker deadlocks the node."""
         cap = max(4, int(self.resources.total.get("CPU", 1))) + 2
+        # Registered only: handles for in-flight spawns are already in
+        # self.workers and would otherwise double-count against the cap
+        # alongside self._spawning.
         task_workers = [
             w for w in self.workers.values()
             if w.kind == "worker" and w.alive and w.actor_id is None
+            and w.registered.is_set()
         ]
         all_busy = all(w.busy_task is not None for w in task_workers)
         over_cap = len(task_workers) + self._spawning >= cap
@@ -399,7 +403,15 @@ class Raylet:
                 if shape in failed_shapes:
                     remaining.append(spec)
                     continue
-                if not await self._try_dispatch(spec):
+                try:
+                    dispatched = await self._try_dispatch(spec)
+                except Exception:
+                    # e.g. a peer connection dying mid-notify: the spec stays
+                    # queued and the loop survives (an escaping exception after
+                    # the queue swap would silently lose every queued task).
+                    traceback.print_exc()
+                    dispatched = False
+                if not dispatched:
                     remaining.append(spec)
                     failed_shapes.add(shape)
             # Work submitted while this pass ran landed in the fresh task_queue.

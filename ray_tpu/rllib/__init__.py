@@ -7,6 +7,7 @@ EnvRunnerGroup of CPU sampling actors, flax RLModule, jitted Learner/LearnerGrou
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
@@ -22,6 +23,8 @@ __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "Columns",
+    "DQN",
+    "DQNConfig",
     "DefaultActorCriticModule",
     "EnvRunnerGroup",
     "Learner",

@@ -61,9 +61,9 @@ class Algorithm:
         raise NotImplementedError
 
     # -- train loop --------------------------------------------------------
-    def train(self) -> Dict[str, Any]:
-        t0 = time.time()
-        self.iteration += 1
+    def _sample_fragments(self):
+        """Shared sampling scaffold: sync weights, fan out sampling, gather
+        fragments + episode stats. Subclass train() loops build on this."""
         self.env_runner_group.sync_weights(self.learner_group.get_params())
         per_runner = max(
             1, self.config.train_batch_size // max(1, len(self.env_runner_group))
@@ -76,16 +76,27 @@ class Algorithm:
             [b.get("episode_lens", np.zeros(0)) for b in runner_batches]
         ) if runner_batches else np.zeros(0)
         fragments = [f for b in runner_batches for f in b["fragments"]]
+        return fragments, returns, lens
+
+    def _record_returns(self, returns) -> None:
+        if len(returns):
+            self._ret_history.extend(returns.tolist())
+            self._ret_history = self._ret_history[-100:]
+
+    def _return_mean(self) -> float:
+        return float(np.mean(self._ret_history)) if self._ret_history else float("nan")
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        self.iteration += 1
+        fragments, returns, lens = self._sample_fragments()
         if not fragments:
             # Every runner failed this round (they've been replaced); skip the
             # update rather than crash — weights re-sync next iteration.
             return {
                 "training_iteration": self.iteration,
                 "num_env_steps_sampled_lifetime": self._total_timesteps,
-                "episode_return_mean": (
-                    float(np.mean(self._ret_history)) if self._ret_history
-                    else float("nan")
-                ),
+                "episode_return_mean": self._return_mean(),
                 "episode_len_mean": float("nan"),
                 "episodes_this_iter": 0,
                 "time_this_iter_s": time.time() - t0,
@@ -103,15 +114,11 @@ class Algorithm:
                 idx = perm[start : start + mb]
                 minibatch = {k: v[idx] for k, v in batch.items()}
                 learner_metrics = self.learner_group.update(minibatch)
-        if len(returns):
-            self._ret_history.extend(returns.tolist())
-            self._ret_history = self._ret_history[-100:]
+        self._record_returns(returns)
         metrics = {
             "training_iteration": self.iteration,
             "num_env_steps_sampled_lifetime": self._total_timesteps,
-            "episode_return_mean": (
-                float(np.mean(self._ret_history)) if self._ret_history else float("nan")
-            ),
+            "episode_return_mean": self._return_mean(),
             "episode_len_mean": float(np.mean(lens)) if len(lens) else float("nan"),
             "episodes_this_iter": int(len(returns)),
             "time_this_iter_s": time.time() - t0,

@@ -181,6 +181,9 @@ class SingleAgentEnvRunner:
             Columns.ACTION_LOGP: np.asarray(ep[Columns.ACTION_LOGP], np.float32),
             Columns.VF_PREDS: np.asarray(ep[Columns.VF_PREDS], np.float32),
             "bootstrap_value": np.float32(bootstrap),
+            # Off-policy consumers (DQN) need the true successor of the last
+            # transition; without it they'd self-bootstrap at fragment edges.
+            "final_next_obs": np.asarray(next_obs, np.float32),
             "terminated": terminated,
         }
         if env_done:

@@ -205,3 +205,85 @@ def test_truncated_episodes_counted_in_stats():
         assert result["episode_len_mean"] == pytest.approx(5.0)
     finally:
         algo.stop()
+
+
+def test_replay_buffer_fifo_and_sampling():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10)
+    batch = {
+        "obs": np.arange(6, dtype=np.float32).reshape(6, 1),
+        "actions": np.arange(6),
+        "rewards": np.ones(6, np.float32),
+        "next_obs": np.arange(1, 7, dtype=np.float32).reshape(6, 1),
+        "dones": np.zeros(6, np.float32),
+    }
+    buf.add_batch(batch)
+    assert len(buf) == 6
+    buf.add_batch(batch)  # 12 > capacity: oldest overwritten
+    assert len(buf) == 10
+    sample = buf.sample(32, np.random.default_rng(0))
+    assert sample["obs"].shape == (32, 1)
+    assert set(sample["actions"].tolist()) <= set(range(6))
+
+
+def test_dqn_learns_bandit():
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .training(
+            train_batch_size=256, minibatch_size=64, lr=5e-3,
+            learning_starts=100, n_updates_per_iter=20,
+            target_network_update_freq=256,
+        )
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        first = algo.train()
+        # one-step episodes: every other env step is autoreset bookkeeping,
+        # so ~train_batch_size/2 transitions land in the buffer per iteration
+        assert first["replay_size"] >= 100
+        last = first
+        for _ in range(8):
+            last = algo.train()
+        assert np.isfinite(last["learner/total_loss"])
+        # Boltzmann sampling over converged Q-values (1 vs 0) caps the return at
+        # e/(e+1) ~= 0.73; clearly above the 0.5 chance level proves learning.
+        assert last["episode_return_mean"] > max(0.65, first["episode_return_mean"])
+        assert last["learner/td_error_mean"] < 0.5
+    finally:
+        algo.stop()
+
+
+def test_dqn_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .training(train_batch_size=128, minibatch_size=32, learning_starts=64,
+                  n_updates_per_iter=2)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        algo.train()
+        path = algo.save_to_path(str(tmp_path / "dqn"))
+        algo2 = config.copy().build_algo()
+        try:
+            algo2.restore_from_path(path)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(algo.get_weights()),
+                jax.tree_util.tree_leaves(algo2.get_weights()),
+            ):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
