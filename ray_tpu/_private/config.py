@@ -20,6 +20,8 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "max_direct_call_object_size": (int, 100 * 1024, "objects <= this many bytes are returned inline through the owner's memory store instead of the shared-memory store"),
     "task_retry_delay_ms": (int, 100, "delay before retrying a failed task"),
     "max_task_retries_default": (int, 3, "default max_retries for remote functions"),
+    "max_object_reconstructions": (int, 3, "how many times a lost plasma object may be rebuilt by re-running its producing task (0 disables lineage reconstruction)"),
+    "max_lineage_entries": (int, 10000, "max owned objects whose producing task spec is retained for reconstruction; oldest entries are evicted first"),
     "max_actor_restarts_default": (int, 0, "default max_restarts for actors"),
     "worker_register_timeout_s": (float, 30.0, "how long the raylet waits for a spawned worker to register"),
     "worker_pool_prestart": (int, 0, "number of workers to prestart per node"),

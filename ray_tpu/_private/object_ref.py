@@ -24,7 +24,10 @@ class ObjectRef:
             w = global_worker_or_none()
             if w is not None:
                 self._worker = w
-                w.reference_counter.add_local_ref(self.id)
+                # Passing the owner lets the counter detect borrowed refs (owner is
+                # another worker) and report the borrow so the owner keeps the
+                # object alive until every borrower's last ref dies.
+                w.reference_counter.add_local_ref(self.id, owner)
 
     def binary(self) -> bytes:
         return self.id.binary()

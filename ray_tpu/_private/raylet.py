@@ -151,6 +151,13 @@ class Raylet:
         self._sched_wakeup = asyncio.Event()
         self._spawning = 0  # worker spawns awaiting registration
         self._pulls_inflight: dict[ObjectID, asyncio.Future] = {}
+        # Tasks this raylet forwarded to a peer and is responsible for until the
+        # results reach the owner (reference: the owner-side NormalTaskSubmitter
+        # retries when a leased node dies). task_id -> {"spec", "target",
+        # "missing_since"}. Re-queued (tasks) or failed (actor calls) when the
+        # target node dies, so work cannot vanish with a node between the moment
+        # it was handed off and the moment its results reached the owner.
+        self.delegated: dict[Any, dict] = {}
         self._shutdown = False
 
     # ------------------------------------------------------------------ startup
@@ -194,9 +201,26 @@ class Raylet:
                 )
                 nodes = await self.gcs.call("get_nodes")
                 self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
+                await self._check_delegations()
             except rpc.RpcError:
                 pass
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
+
+    async def _check_delegations(self):
+        """Backstop for a missed node-removal pubsub event: a delegation whose
+        target has been absent from the cluster view for two heartbeats is
+        recovered as if the node-death notification had arrived."""
+        now = time.monotonic()
+        dead_targets = set()
+        for entry in self.delegated.values():
+            if entry["target"] in self.node_view:
+                entry["missing_since"] = None
+            elif entry["missing_since"] is None:
+                entry["missing_since"] = now
+            elif now - entry["missing_since"] > 2 * CONFIG.heartbeat_interval_s:
+                dead_targets.add(entry["target"])
+        for target in dead_targets:
+            await self._recover_delegated(target)
 
     async def _idle_reaper_loop(self):
         while not self._shutdown:
@@ -364,6 +388,68 @@ class Raylet:
             for oid in spec["return_ids"]
         ]
         await self._route_results_to_owner(spec, results)
+        await self._settle_delegation(spec)
+
+    # ------------------------------------------------------------------ delegation
+
+    async def _forward_to_peer(self, spec: dict, target: NodeID, method: str = "submit_task") -> bool:
+        """Track-then-notify a spec to a peer; untrack if the send fails so a
+        never-delivered task is not 'recovered' into a duplicate later."""
+        peer = await self._peer(target)
+        if peer is None:
+            return False
+        self._track_delegation(spec, target)
+        try:
+            await peer.notify(method, spec)
+        except rpc.RpcError:
+            self.delegated.pop(spec["task_id"], None)
+            return False
+        return True
+
+    def _track_delegation(self, spec: dict, target: NodeID):
+        """Remember a spec forwarded to `target` until its results reach the owner."""
+        if spec.get("type") not in ("task", "actor_task"):
+            return
+        via = spec.setdefault("via", [])
+        if self.node_id not in via:
+            via.append(self.node_id)
+        self.delegated[spec["task_id"]] = {
+            "spec": spec, "target": target, "missing_since": None,
+        }
+
+    async def _settle_delegation(self, spec: dict):
+        """Results reached the routing stage: release every forwarder on the path."""
+        for nid in spec.get("via", ()):
+            if nid == self.node_id:
+                self.delegated.pop(spec["task_id"], None)
+                continue
+            peer = await self._peer(nid)
+            if peer is not None:
+                try:
+                    await peer.notify("task_settled", spec["task_id"])
+                except rpc.RpcError:
+                    pass
+
+    async def rpc_task_settled(self, conn, task_id):
+        self.delegated.pop(task_id, None)
+        return True
+
+    async def _recover_delegated(self, dead: NodeID):
+        """The node a task was handed to died: re-queue it here (normal tasks,
+        within the retry budget) or fail it to the owner (actor calls)."""
+        for task_id, entry in list(self.delegated.items()):
+            if entry["target"] != dead:
+                continue
+            self.delegated.pop(task_id, None)
+            spec = entry["spec"]
+            if spec["type"] == "actor_task":
+                await self._fail_actor_task(spec, "actor's node died with call in flight")
+            elif spec.get("retries_left", 0) > 0:
+                spec["retries_left"] -= 1
+                self.task_queue.append(spec)
+                self._sched_wakeup.set()
+            else:
+                await self._fail_task(spec, f"node {dead.hex()[:8]} died (retries exhausted)")
 
     # ------------------------------------------------------------------ scheduling
 
@@ -432,9 +518,7 @@ class Raylet:
         if strategy and strategy.get("node_id") is not None:
             target = strategy["node_id"]
             if target != self.node_id:
-                peer = await self._peer(target)
-                if peer is not None:
-                    await peer.notify("submit_task", spec)
+                if await self._forward_to_peer(spec, target):
                     return True
                 if not strategy.get("soft"):
                     await self._fail_task(spec, f"affinity node {target} unavailable")
@@ -482,14 +566,8 @@ class Raylet:
             if node_id == self.node_id:
                 continue
             if all(info["resources_total"].get(r, 0) >= amt for r, amt in demand.items()):
-                peer = await self._peer(node_id)
-                if peer is None:
-                    continue
-                try:
-                    await peer.notify("submit_task", spec)
+                if await self._forward_to_peer(spec, node_id):
                     return True
-                except rpc.RpcError:
-                    continue
         return False  # keep queued; cluster may gain a node
 
     async def _maybe_spread(self, spec: dict) -> bool:
@@ -501,14 +579,8 @@ class Raylet:
                 continue
             avail = info.get("resources_available", {})
             if all(avail.get(r, 0) >= amt for r, amt in demand.items()):
-                peer = await self._peer(node_id)
-                if peer is None:
-                    continue
-                try:
-                    await peer.notify("submit_task", spec)
+                if await self._forward_to_peer(spec, node_id):
                     return True
-                except rpc.RpcError:
-                    continue
         return False
 
     async def _route_pg_task(self, spec: dict):
@@ -538,9 +610,7 @@ class Raylet:
                 self.task_queue.append(spec)
                 self._sched_wakeup.set()
                 return
-            peer = await self._peer(target)
-            if peer is not None:
-                await peer.notify("submit_task", spec)
+            if await self._forward_to_peer(spec, target):
                 return
             await asyncio.sleep(0.2)
         await self._fail_task(spec, "placement group routing failed")
@@ -579,6 +649,7 @@ class Raylet:
             self._sched_wakeup.set()
         if spec is not None:
             await self._route_results_to_owner(spec, results)
+            await self._settle_delegation(spec)
         return True
 
     async def _route_results_to_owner(self, spec: dict, results: list):
@@ -620,6 +691,28 @@ class Raylet:
             return await handle.conn.call(method, payload)
         except rpc.RpcError:
             return {"error": "worker_lost"}
+
+    async def rpc_call_worker(self, conn, target: dict, method: str, payload):
+        """Worker-to-worker request routed by address (e.g. borrower asking the
+        owner to reconstruct a lost object)."""
+        node_id, worker_id = target["node_id"], target["worker_id"]
+        if node_id == self.node_id:
+            return await self.rpc_route_call(conn, worker_id, method, payload)
+        peer = await self._peer(node_id)
+        if peer is None:
+            return {"error": "node_unreachable"}
+        try:
+            return await peer.call("route_call", worker_id, method, payload)
+        except rpc.RpcError:
+            return {"error": "node_unreachable"}
+
+    async def rpc_report_borrow(self, conn, object_id: ObjectID, owner: dict, delta: int):
+        """Forward a borrower's ref registration/release to the owning worker."""
+        await self._route_to_worker(
+            owner["node_id"], owner["worker_id"], "borrow_update",
+            {"object_id": object_id, "delta": delta},
+        )
+        return True
 
     # ------------------------------------------------------------------ RPC: object store
 
@@ -672,6 +765,7 @@ class Raylet:
         path + PullManager for remote objects.
         """
         deadline = time.monotonic() + timeout
+        lost_polls = 0
         while True:
             info = self.store.info(object_id)
             if info is not None:
@@ -685,6 +779,16 @@ class Raylet:
                 loc = await self.gcs.call("object_locations", object_id)
             except rpc.RpcError:
                 pass
+            if loc is not None and not loc["locations"]:
+                # The directory knows this object but every node holding a copy is
+                # gone: report it lost quickly so the owner can reconstruct from
+                # lineage instead of burning the full resolve timeout. Two polls of
+                # grace cover a copy in transit between seal and report.
+                lost_polls += 1
+                if lost_polls >= 2:
+                    return {"error": "lost"}
+            else:
+                lost_polls = 0
             if loc and loc["locations"]:
                 fut = asyncio.get_running_loop().create_future()
                 self._pulls_inflight[object_id] = fut
@@ -832,11 +936,9 @@ class Raylet:
                 return True
             await self._fail_actor_task(spec, "actor worker dead")
             return False
-        peer = await self._peer(addr["node_id"])
-        if peer is None:
+        if not await self._forward_to_peer(spec, addr["node_id"], "submit_actor_task"):
             await self._fail_actor_task(spec, "actor node unreachable")
             return False
-        await peer.notify("submit_actor_task", spec)
         return True
 
     async def _actor_address(self, actor_id: ActorID):
@@ -861,12 +963,14 @@ class Raylet:
             {"object_id": oid, "inline": err, "error": True} for oid in spec["return_ids"]
         ]
         await self._route_results_to_owner(spec, results)
+        await self._settle_delegation(spec)
 
     async def rpc_actor_task_done(self, conn, spec_owner, task_id, results):
         """Actor worker finished a method call; route results to owner."""
+        spec = None
         for w in self.workers.values():
             if w.conn is conn:
-                w.inflight_actor_tasks.pop(task_id, None)
+                spec = w.inflight_actor_tasks.pop(task_id, None)
                 break
         await self._route_to_worker(
             spec_owner["node_id"],
@@ -874,6 +978,8 @@ class Raylet:
             "task_result",
             {"task_id": task_id, "results": results},
         )
+        if spec is not None:
+            await self._settle_delegation(spec)
         return True
 
     async def rpc_kill_actor_worker(self, conn, actor_id: ActorID):
@@ -920,6 +1026,7 @@ class Raylet:
             conn_dead = self.peer_conns.pop(node_id, None)
             if conn_dead is not None:
                 await conn_dead.close()
+            await self._recover_delegated(node_id)
         return True
 
     async def rpc_node_stats(self, conn):

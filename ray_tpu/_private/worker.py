@@ -122,17 +122,24 @@ class MemoryStore:
 
 
 class ReferenceCounter:
-    """Local reference counts; owners free the object cluster-wide at zero.
+    """Distributed reference counts: local refs + borrower reports back to the owner.
 
-    Reference: `src/ray/core_worker/reference_counter.h` (distributed counting with
-    borrowing). Round-1 divergence: borrower counts are not reported back to the owner;
-    owned objects are freed when the *owner's* local count reaches zero, which matches the
-    dominant driver-owns-everything pattern. Documented in docs/divergences.md.
+    Reference: `src/ray/core_worker/reference_counter.h`. The owner frees an object
+    cluster-wide only when (a) its own local count is zero AND (b) every borrower that
+    reported a borrow has reported releasing it. Borrowers register on ObjectRef
+    deserialization (first local ref to a foreign-owned id) and report the release when
+    their last local ref dies. A borrower that crashes without reporting leaks its count;
+    lineage reconstruction makes premature frees recoverable, crashes are bounded by the
+    borrowing process's raylet failing its in-flight work (divergence noted in
+    docs/divergences.md).
     """
 
     def __init__(self, worker: "CoreWorker"):
         self._counts: dict[ObjectID, int] = {}
         self._owned: set[ObjectID] = set()
+        self._borrows: dict[ObjectID, int] = {}  # owned id -> outstanding borrower refs
+        self._borrowed_owner: dict[ObjectID, dict] = {}  # borrowed id -> owner address
+        self._pending_free: set[ObjectID] = set()  # local zero, waiting on borrowers
         self._lock = threading.Lock()
         self._worker = worker
 
@@ -140,19 +147,61 @@ class ReferenceCounter:
         with self._lock:
             self._owned.add(object_id)
 
-    def add_local_ref(self, object_id: ObjectID):
+    def add_local_ref(self, object_id: ObjectID, owner: dict | None = None):
+        report_to = None
         with self._lock:
-            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+            n = self._counts.get(object_id, 0)
+            self._counts[object_id] = n + 1
+            self._pending_free.discard(object_id)  # re-acquired before borrowers drained
+            if (
+                n == 0
+                and owner is not None
+                and object_id not in self._owned
+                and object_id not in self._borrowed_owner
+                and owner.get("worker_id") is not None
+                and owner["worker_id"] != self._worker.worker_id
+            ):
+                self._borrowed_owner[object_id] = owner
+                report_to = owner
+        if report_to is not None:
+            self._worker._report_borrow(object_id, report_to, +1)
 
     def remove_local_ref(self, object_id: ObjectID):
         free = False
+        report_to = None
         with self._lock:
             n = self._counts.get(object_id, 0) - 1
             if n > 0:
                 self._counts[object_id] = n
             else:
                 self._counts.pop(object_id, None)
-                if object_id in self._owned:
+                report_to = self._borrowed_owner.pop(object_id, None)
+                if report_to is None and object_id in self._owned:
+                    if self._borrows.get(object_id, 0) > 0:
+                        self._pending_free.add(object_id)
+                    else:
+                        self._owned.discard(object_id)
+                        free = True
+        if report_to is not None:
+            self._worker._report_borrow(object_id, report_to, -1)
+        if free:
+            self._worker._free_owned_object(object_id)
+
+    def update_borrow(self, object_id: ObjectID, delta: int):
+        """Owner side: a borrower registered (+1) or released (-1) the object."""
+        free = False
+        with self._lock:
+            n = self._borrows.get(object_id, 0) + delta
+            if n > 0:
+                self._borrows[object_id] = n
+            else:
+                self._borrows.pop(object_id, None)
+                if (
+                    object_id in self._pending_free
+                    and self._counts.get(object_id, 0) <= 0
+                    and object_id in self._owned
+                ):
+                    self._pending_free.discard(object_id)
                     self._owned.discard(object_id)
                     free = True
         if free:
@@ -161,6 +210,10 @@ class ReferenceCounter:
     def num_refs(self, object_id: ObjectID) -> int:
         with self._lock:
             return self._counts.get(object_id, 0)
+
+    def num_borrows(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._borrows.get(object_id, 0)
 
 
 class _ActorRuntime:
@@ -213,7 +266,14 @@ class CoreWorker:
         self._pending_promoted: dict[TaskID, list[ObjectID]] = {}
         self._put_counter = _Counter()
         self._task_counter = _Counter()
+        # Lineage for reconstruction: owned return-object id -> shared entry
+        # {"spec", "live": set of ids, "promoted": pinned arg ids} (task_manager.h:177).
+        self._lineage: dict[ObjectID, dict] = {}
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: set[ObjectID] = set()
+        self._recon_attempts: dict[ObjectID, int] = {}
         self._actor_seq: dict[ActorID, _Counter] = {}
+        self._actor_arg_pins: dict[ActorID, list[ObjectID]] = {}
         self._task_executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-exec")
         self._future_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rtpu-fut")
         self.actor_runtime: _ActorRuntime | None = None
@@ -324,6 +384,14 @@ class CoreWorker:
             out.append(self._get_one(ref, deadline))
         return out
 
+    @staticmethod
+    def _decode_inline(rec: _Record):
+        """Deserialize a resolved inline record, raising task errors in caller context."""
+        value = serialization.loads(rec.data)
+        if rec.error:
+            raise value.as_instanceof_cause() if isinstance(value, RayTpuTaskError) else value
+        return value
+
     def _get_one(self, ref: ObjectRef, deadline: float | None):
         rec = self.memory_store.get(ref.id)
         if rec is not None and not rec.resolved:
@@ -332,13 +400,34 @@ class CoreWorker:
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
         rec = self.memory_store.get(ref.id)
         if rec is not None and rec.resolved and not rec.in_plasma:
-            value = serialization.loads(rec.data)
-            if rec.error:
-                raise value.as_instanceof_cause() if isinstance(value, RayTpuTaskError) else value
-            return value
-        # Plasma or borrowed: resolve via the raylet.
-        remaining = 300.0 if deadline is None else max(0.0, deadline - time.monotonic())
-        reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+            return self._decode_inline(rec)
+        # Plasma or borrowed: resolve via the raylet. "lost" (known object, zero live
+        # copies) triggers lineage reconstruction: the owner re-runs the producing
+        # task and the loop waits for the fresh copy to be sealed.
+        hard_deadline = time.monotonic() + 300.0 if deadline is None else deadline
+        recon_next = 0.0  # owner requests dedupe internally; borrowers back off
+        while True:
+            remaining = max(0.0, hard_deadline - time.monotonic())
+            reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+            if reply.get("error") == "lost":
+                # A rebuild may already have routed an (inline) error result back.
+                rec = self.memory_store.get(ref.id)
+                if rec is not None and rec.resolved and not rec.in_plasma:
+                    return self._decode_inline(rec)
+                now = time.monotonic()
+                if now >= hard_deadline:
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                if now >= recon_next:
+                    if not self._try_reconstruct(ref):
+                        raise ObjectLostError(
+                            ref.id,
+                            f"{ref} was lost (all copies died) and could not be "
+                            "reconstructed from lineage",
+                        )
+                    recon_next = now + 2.0
+                time.sleep(0.1)
+                continue
+            break
         if reply.get("error"):
             if reply["error"] == "timeout":
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
@@ -413,11 +502,108 @@ class CoreWorker:
     def _free_owned_object(self, object_id: ObjectID):
         rec = self.memory_store.get(object_id)
         self.memory_store.pop(object_id)
+        self._drop_lineage(object_id)
         if rec is not None and rec.in_plasma and self._connected:
             try:
                 self.io.spawn(self.raylet.notify("store_free", object_id))
             except Exception:
                 pass
+
+    def _report_borrow(self, object_id: ObjectID, owner: dict, delta: int):
+        if not self._connected or self.raylet is None:
+            return
+        try:
+            self.io.spawn(self.raylet.notify("report_borrow", object_id, owner, delta))
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ lineage
+
+    def _record_lineage(self, spec, promoted: list[ObjectID]):
+        """Retain the producing task spec (+ pins on its promoted plasma args) until
+        every return object is out of scope, so a lost object can be rebuilt by
+        re-running the task (reference: TaskManager lineage, task_manager.h:177)."""
+        if CONFIG.max_object_reconstructions <= 0 or not spec["return_ids"]:
+            return False
+        entry = {"spec": spec, "live": set(spec["return_ids"]), "promoted": promoted}
+        with self._lineage_lock:
+            for oid in spec["return_ids"]:
+                self._lineage[oid] = entry
+            overflow = len(self._lineage) - CONFIG.max_lineage_entries
+            evicted = []
+            if overflow > 0:
+                for oid in list(self._lineage):
+                    if overflow <= 0:
+                        break
+                    ev = self._lineage.pop(oid)
+                    ev["live"].discard(oid)
+                    if not ev["live"]:
+                        evicted.append(ev)
+                    overflow -= 1
+        for ev in evicted:
+            for pid in ev.get("promoted", ()):
+                self.reference_counter.remove_local_ref(pid)
+        return True
+
+    def _drop_lineage(self, object_id: ObjectID):
+        release = None
+        with self._lineage_lock:
+            self._recon_attempts.pop(object_id, None)
+            self._reconstructing.discard(object_id)
+            entry = self._lineage.pop(object_id, None)
+            if entry is None:
+                return
+            entry["live"].discard(object_id)
+            if not entry["live"]:
+                release = entry.get("promoted", ())
+        if release:
+            for pid in release:
+                self.reference_counter.remove_local_ref(pid)
+
+    def _try_reconstruct_owned(self, object_id: ObjectID) -> bool:
+        """Re-submit the producing task of a lost owned object. Returns True if a
+        rebuild was started or is already in flight (reference:
+        object_recovery_manager.h:41)."""
+        with self._lineage_lock:
+            entry = self._lineage.get(object_id)
+            if entry is None:
+                return False
+            if object_id in self._reconstructing:
+                return True
+            attempts = self._recon_attempts.get(object_id, 0)
+            if attempts >= CONFIG.max_object_reconstructions:
+                return False
+            spec = dict(entry["spec"])
+            for oid in entry["live"]:
+                self._recon_attempts[oid] = attempts + 1
+                self._reconstructing.add(oid)
+        spec["retries_left"] = max(1, spec.get("retries_left", 1))
+        self._record_event(
+            task_id=spec["task_id"].hex(), name=spec["name"], state="RECONSTRUCTING"
+        )
+
+        def unwedge():
+            # The resubmission never reached the raylet: clear the in-flight marker
+            # so a later get() attempts reconstruction again instead of spinning.
+            with self._lineage_lock:
+                for oid in spec["return_ids"]:
+                    self._reconstructing.discard(oid)
+
+        self._submit_when_ready(spec, on_send_failure=unwedge)
+        return True
+
+    def _try_reconstruct(self, ref: ObjectRef) -> bool:
+        """Owner: rebuild locally. Borrower: ask the owner to rebuild."""
+        if ref.owner and ref.owner.get("worker_id") != self.worker_id:
+            try:
+                reply = self.raylet_call(
+                    "call_worker", ref.owner, "reconstruct_object",
+                    {"object_id": ref.id},
+                )
+            except rpc.RpcError:
+                return False
+            return bool(isinstance(reply, dict) and reply.get("ok"))
+        return self._try_reconstruct_owned(ref.id)
 
     # ------------------------------------------------------------------ task submission
 
@@ -431,13 +617,12 @@ class CoreWorker:
 
         def one(value):
             if isinstance(value, ObjectRef):
-                # Pin refs we own for the task's lifetime so a caller dropping their
+                # Pin every ref arg for the task's lifetime so a caller dropping its
                 # handle right after .remote() can't free the arg out from under the
-                # queued task. (Borrowed refs rely on their owner's pin; divergence
-                # from full distributed refcounting noted in ReferenceCounter.)
-                if value.owner and value.owner.get("worker_id") == self.worker_id:
-                    self.reference_counter.add_local_ref(value.id)
-                    promoted.append(value.id)
+                # queued task. For borrowed refs the pin keeps this process's borrow
+                # registered with the owner until the task completes.
+                self.reference_counter.add_local_ref(value.id, value.owner)
+                promoted.append(value.id)
                 return {"ref": (value.id, value.owner)}
             pickled, raw_buffers, total = serialization.serialized_size(value)
             if total > CONFIG.max_direct_call_object_size:
@@ -476,8 +661,6 @@ class CoreWorker:
     ) -> list[ObjectRef]:
         task_id = TaskID.from_random()
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
-        if promoted:
-            self._pending_promoted[task_id] = promoted
         return_ids = [ObjectID.from_task(task_id, i) for i in range(num_returns)]
         owner = self._owner_address()
         spec = {
@@ -503,12 +686,29 @@ class CoreWorker:
             self.reference_counter.add_owned(oid)
             self.memory_store.create_pending(oid)
             refs.append(ObjectRef(oid, owner))
+        # Two independent pins on promoted args: the flight pin (released when the
+        # task's result arrives, guaranteeing args outlive the queued/running task)
+        # and, when lineage is retained, a lineage pin (released when the last
+        # return object dies, so a rebuild can re-materialize args).
+        if self._record_lineage(spec, promoted):
+            for pid in promoted:
+                self.reference_counter.add_local_ref(pid)
+        if promoted:
+            self._pending_promoted[task_id] = promoted
         self._record_event(task_id=task_id.hex(), name=name, state="SUBMITTED")
         self._submit_when_ready(spec)
         return refs
 
-    def _submit_when_ready(self, spec, target="submit_task"):
+    def _submit_when_ready(self, spec, target="submit_task", on_send_failure=None):
         """Dependency gating: hold until owned pending ref-args resolve (DependencyResolver)."""
+
+        async def send():
+            try:
+                await self.raylet.notify(target, spec)
+            except Exception:
+                if on_send_failure is not None:
+                    on_send_failure()
+
         dep_ids = []
         for loc in list(spec["args"]) + list(spec["kwargs"].values()):
             if "ref" in loc:
@@ -517,7 +717,7 @@ class CoreWorker:
                 if rec is not None and not rec.resolved:
                     dep_ids.append(oid)
         if not dep_ids:
-            self.io.spawn(self.raylet.notify(target, spec))
+            self.io.spawn(send())
             return
         remaining = {"n": len(dep_ids)}
         lock = threading.Lock()
@@ -527,7 +727,7 @@ class CoreWorker:
                 remaining["n"] -= 1
                 done = remaining["n"] == 0
             if done:
-                self.io.spawn(self.raylet.notify(target, spec))
+                self.io.spawn(send())
 
         for oid in dep_ids:
             if not self.memory_store.add_done_callback(oid, on_done):
@@ -556,8 +756,9 @@ class CoreWorker:
         runtime_env=None,
     ) -> ActorID:
         actor_id = ActorID.from_random()
-        # Promoted init args stay pinned for the actor's lifetime: restarts re-run __init__.
-        ser_args, ser_kwargs, _promoted = self._serialize_args(args, kwargs)
+        # Promoted/borrowed init args stay pinned while the actor can restart
+        # (restarts re-run __init__); released when the creator's handle dies.
+        ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
         spec = {
             "type": "actor_creation",
             "actor_id": actor_id,
@@ -579,7 +780,23 @@ class CoreWorker:
             "runtime_env": runtime_env,
         }
         reply = self.gcs_call("register_actor", actor_id, spec)
-        return reply["actor_id"]
+        actual_id = reply["actor_id"]
+        if promoted:
+            if reply.get("existing"):
+                # get_if_exists hit an existing actor: our spec (and its arg pins)
+                # will never be used for a restart.
+                for pid in promoted:
+                    self.reference_counter.remove_local_ref(pid)
+            else:
+                self._actor_arg_pins[actual_id] = promoted
+        return actual_id
+
+    def release_actor_arg_pins(self, actor_id: ActorID):
+        """The creator's handle died: the actor can still run, but this process no
+        longer guards its init args (a restart after this frees-then-fails like the
+        reference when the owner of the args is gone)."""
+        for pid in self._actor_arg_pins.pop(actor_id, ()):  # noqa: B020
+            self.reference_counter.remove_local_ref(pid)
 
     def submit_actor_task(
         self,
@@ -625,6 +842,9 @@ class CoreWorker:
         if promoted:
             for oid in promoted:
                 self.reference_counter.remove_local_ref(oid)
+        with self._lineage_lock:
+            for result in payload["results"]:
+                self._reconstructing.discard(result["object_id"])
         for result in payload["results"]:
             oid = result["object_id"]
             in_plasma = bool(result.get("in_plasma"))
@@ -638,6 +858,14 @@ class CoreWorker:
                     await self.raylet.notify("store_free", oid)
                 except rpc.RpcError:
                     pass
+
+    async def rpc_borrow_update(self, conn, payload):
+        self.reference_counter.update_borrow(payload["object_id"], payload["delta"])
+        return True
+
+    async def rpc_reconstruct_object(self, conn, payload):
+        """A borrower lost an object we own: rebuild it from lineage."""
+        return {"ok": self._try_reconstruct_owned(payload["object_id"])}
 
     async def rpc_fetch_inline(self, conn, payload):
         rec = self.memory_store.get(payload["object_id"])

@@ -76,10 +76,31 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID, method_names: list[str], class_name: str = ""):
+    def __init__(
+        self,
+        actor_id: ActorID,
+        method_names: list[str],
+        class_name: str = "",
+        _owns_arg_pins: bool = False,
+    ):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._class_name = class_name
+        # Only the handle returned to the CREATOR guards the actor's pinned init
+        # args; deserialized copies (__reduce__) do not, so a borrower dropping
+        # its copy cannot release pins it never took.
+        self._owns_arg_pins = _owns_arg_pins
+
+    def __del__(self):
+        if getattr(self, "_owns_arg_pins", False):
+            try:
+                from ray_tpu._private.worker import global_worker_or_none
+
+                w = global_worker_or_none()
+                if w is not None:
+                    w.release_actor_arg_pins(self._actor_id)
+            except Exception:
+                pass  # interpreter shutdown
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -142,7 +163,7 @@ class ActorClass:
             method_names=method_names,
             runtime_env=runtime_env_mod.validate(opts.get("runtime_env")),
         )
-        return ActorHandle(actor_id, method_names, self._cls.__name__)
+        return ActorHandle(actor_id, method_names, self._cls.__name__, _owns_arg_pins=True)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
