@@ -67,6 +67,7 @@ class ActorInfo:
         self.num_restarts = 0
         self.death_cause = None
         self.placing = False  # a create_actor RPC is in flight to a chosen node
+        self.awaiting_report = False  # restored after GCS restart; host not yet re-reported
 
     def view(self):
         return {
@@ -90,12 +91,23 @@ class PlacementGroupInfo:
         self.state = PENDING
         self.allocations: list[NodeID | None] = [None] * len(bundles)
         self.ready_event = asyncio.Event()
+        self.awaiting_report = False  # restored after GCS restart
 
 
 class GcsService:
-    """The control plane. One instance; serves every connection (raylets + workers)."""
+    """The control plane. One instance; serves every connection (raylets + workers).
 
-    def __init__(self):
+    With a persistent store (`gcs_store.FileStoreClient`) the GCS can restart and
+    re-learn cluster state: durable tables (kv, jobs, actor specs, PG specs) load
+    from storage, and live state (actor addresses, object locations, reserved
+    bundles) is re-reported by raylets when they re-register
+    (reference: gcs_init_data.cc + redis_store_client.h:126).
+    """
+
+    def __init__(self, store=None):
+        from ray_tpu._private.gcs_store import InMemoryStoreClient
+
+        self.store = store if store is not None else InMemoryStoreClient()
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
@@ -107,9 +119,64 @@ class GcsService:
         self.task_events: list[dict] = []
         self._actor_events: dict[ActorID, asyncio.Event] = {}
         self._death_task = None
+        self._restored_from_store = False
+        self._restore()
+
+    def _restore(self):
+        """Load durable tables; live state arrives via raylet re-registration."""
+        self.store.load()
+        for (ns, key), value in self.store.items("kv"):
+            self.kv.setdefault(ns, {})[key] = value
+        self.job_counter = self.store.get("meta", "job_counter", 0)
+        for actor_id, rec in self.store.items("actors"):
+            spec = rec["spec"]
+            actor = ActorInfo(actor_id, spec)
+            actor.restarts_left = rec.get("restarts_left", actor.restarts_left)
+            actor.num_restarts = rec.get("num_restarts", 0)
+            # Await the hosting raylet's re-report; a sweep reschedules/buries
+            # actors whose node never comes back (_restored_actor_sweep).
+            actor.state = RESTARTING
+            actor.placing = True
+            actor.awaiting_report = True
+            self.actors[actor_id] = actor
+            if actor.name:
+                self.named_actors[(actor.namespace, actor.name)] = actor_id
+            self._restored_from_store = True
+        for pg_id, rec in self.store.items("pgs"):
+            pg = PlacementGroupInfo(pg_id, rec["bundles"], rec["strategy"], rec.get("name", ""))
+            pg.awaiting_report = True
+            self.placement_groups[pg_id] = pg
+            self._restored_from_store = True
 
     def start_background(self):
-        self._death_task = asyncio.get_running_loop().create_task(self._death_check_loop())
+        loop = asyncio.get_running_loop()
+        self._death_task = loop.create_task(self._death_check_loop())
+        if self._restored_from_store:
+            loop.create_task(self._restored_state_sweep())
+
+    async def _restored_state_sweep(self, grace: float = 10.0):
+        """After a GCS restart, anything not re-reported within the grace window is
+        treated as having died during the outage."""
+        await asyncio.sleep(grace)
+        for actor in list(self.actors.values()):
+            if getattr(actor, "awaiting_report", False) and actor.state == RESTARTING:
+                actor.awaiting_report = False
+                actor.placing = False
+                await self._handle_actor_failure(actor, "node lost while GCS was down")
+        for pg in list(self.placement_groups.values()):
+            if getattr(pg, "awaiting_report", False) and pg.state == PENDING:
+                pg.awaiting_report = False
+                # Cancel whatever partial reservations were re-reported, then
+                # schedule from scratch.
+                for idx, nid in enumerate(pg.allocations):
+                    node = self.nodes.get(nid) if nid else None
+                    if node is not None and node.alive:
+                        try:
+                            await node.conn.call("cancel_bundle", pg.pg_id, idx)
+                        except Exception:
+                            pass
+                    pg.allocations[idx] = None
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
 
     # ---------------- helpers ----------------
 
@@ -138,6 +205,38 @@ class GcsService:
         conn.on_close(lambda c: asyncio.get_running_loop().create_task(self._on_node_lost(node_id)))
         await self.publish("nodes", {"event": "added", "node": info.view()})
         return {"ok": True}
+
+    async def rpc_sync_node_state(self, conn, node_id: NodeID, hosted_actors: dict,
+                                  sealed_objects: list, reserved_bundles: list):
+        """A raylet re-registered (typically after a GCS restart): re-learn the live
+        state it hosts — actor addresses, object locations, PG bundle reservations."""
+        for actor_id, worker_id in hosted_actors.items():
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state == ALIVE:
+                continue
+            actor.state = ALIVE
+            actor.address = {"node_id": node_id, "worker_id": worker_id}
+            actor.placing = False
+            actor.awaiting_report = False
+            await self.publish("actors", {"actor": actor.view()})
+            ev = self._actor_events.pop(actor_id, None)
+            if ev:
+                ev.set()
+        for oid, size, owner in sealed_objects:
+            entry = self.object_dir.setdefault(
+                oid, {"size": size, "owner": owner, "locations": set()}
+            )
+            entry["locations"].add(node_id)
+        for pg_id, bundle_index in reserved_bundles:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or bundle_index >= len(pg.bundles):
+                continue
+            pg.allocations[bundle_index] = node_id
+            if all(a is not None for a in pg.allocations):
+                pg.state = ALIVE
+                pg.awaiting_report = False
+                pg.ready_event.set()
+        return True
 
     async def rpc_heartbeat(self, conn, node_id: NodeID, resources_available,
                             pending_demand=None):
@@ -218,21 +317,28 @@ class GcsService:
     async def rpc_kv_put(self, conn, namespace: str, key: bytes, value: bytes, overwrite=True):
         ns = self.kv.setdefault(namespace, {})
         if not overwrite and key in ns:
-            return False
+            # Idempotent retry detection: report success if the stored value is
+            # already exactly what this put carried.
+            return ns[key] == value
         ns[key] = value
+        self.store.put("kv", (namespace, key), value)
         return True
 
     async def rpc_kv_get(self, conn, namespace: str, key: bytes):
         return self.kv.get(namespace, {}).get(key)
 
     async def rpc_kv_del(self, conn, namespace: str, key: bytes):
-        return self.kv.get(namespace, {}).pop(key, None) is not None
+        existed = self.kv.get(namespace, {}).pop(key, None) is not None
+        if existed:
+            self.store.delete("kv", (namespace, key))
+        return existed
 
     async def rpc_kv_keys(self, conn, namespace: str, prefix: bytes = b""):
         return [k for k in self.kv.get(namespace, {}) if k.startswith(prefix)]
 
     async def rpc_next_job_id(self, conn):
         self.job_counter += 1
+        self.store.put("meta", "job_counter", self.job_counter)
         return JobID.from_int(self.job_counter)
 
     # ---------------- pubsub ----------------
@@ -282,6 +388,11 @@ class GcsService:
     # ---------------- actors ----------------
 
     async def rpc_register_actor(self, conn, actor_id: ActorID, spec: dict):
+        # Idempotent on the client-generated actor_id: a retry after a GCS crash
+        # (applied but unacknowledged) must not re-register a fresh PENDING record
+        # over a live/restoring actor.
+        if actor_id in self.actors:
+            return {"ok": True, "existing": False, "actor_id": actor_id}
         name = spec.get("name")
         ns = spec.get("namespace", "")
         if name:
@@ -296,8 +407,16 @@ class GcsService:
         self.actors[actor_id] = actor
         if name:
             self.named_actors[(ns, name)] = actor_id
+        self._persist_actor(actor)
         asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         return {"ok": True, "existing": False, "actor_id": actor_id}
+
+    def _persist_actor(self, actor: ActorInfo):
+        self.store.put("actors", actor.actor_id, {
+            "spec": actor.spec,
+            "restarts_left": actor.restarts_left,
+            "num_restarts": actor.num_restarts,
+        })
 
     def _pick_node_for(self, resources: dict, scheduling=None) -> NodeInfo | None:
         """Reference: GcsActorScheduler + hybrid policy. Greedy best-fit over alive nodes."""
@@ -356,6 +475,7 @@ class GcsService:
     async def _mark_actor_dead(self, actor: ActorInfo, reason: str):
         actor.state = DEAD
         actor.death_cause = reason
+        self.store.delete("actors", actor.actor_id)
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
         await self.publish("actors", {"actor": actor.view()})
@@ -421,6 +541,7 @@ class GcsService:
             if actor.restarts_left > 0:
                 actor.restarts_left -= 1
             actor.num_restarts += 1
+            self._persist_actor(actor)
             actor.state = RESTARTING
             actor.address = None
             await self.publish("actors", {"actor": actor.view()})
@@ -433,6 +554,7 @@ class GcsService:
     async def rpc_create_placement_group(self, conn, pg_id: PlacementGroupID, bundles, strategy, name=""):
         pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = pg
+        self.store.put("pgs", pg_id, {"bundles": bundles, "strategy": strategy, "name": name})
         asyncio.get_running_loop().create_task(self._schedule_pg(pg))
         return True
 
@@ -546,6 +668,7 @@ class GcsService:
 
     async def rpc_remove_placement_group(self, conn, pg_id: PlacementGroupID):
         pg = self.placement_groups.pop(pg_id, None)
+        self.store.delete("pgs", pg_id)
         if pg is None:
             return False
         for bundle_index, nid in enumerate(pg.allocations):

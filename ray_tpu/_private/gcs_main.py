@@ -1,0 +1,55 @@
+"""GCS server process entry point.
+
+Design parity: reference `src/ray/gcs/gcs_server_main.cc:51` — the cluster control
+plane runs as its own process so it can crash and restart independently of any raylet;
+with a persistent store (--store-dir) a restarted GCS re-learns cluster state from
+storage plus raylet re-registration (reference `gcs_init_data.cc`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs import GcsService
+from ray_tpu._private.gcs_store import FileStoreClient, InMemoryStoreClient
+
+
+async def amain(args):
+    store = FileStoreClient(args.store_dir) if args.store_dir else InMemoryStoreClient()
+    gcs = GcsService(store=store)
+    server = rpc.RpcServer(lambda conn: gcs)
+    await server.start(port=args.port)
+    gcs.start_background()
+
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"gcs_port": server.port, "pid": os.getpid()}, f)
+        os.replace(tmp, args.ready_file)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for s in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(s, stop.set)
+    await stop.wait()
+    await server.close()
+    store.close()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--store-dir", default="")
+    p.add_argument("--ready-file", default="")
+    args = p.parse_args()
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

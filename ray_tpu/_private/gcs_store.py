@@ -1,0 +1,133 @@
+"""Persistent storage behind the GCS tables.
+
+Design parity: reference `src/ray/gcs/store_client/` — the GCS keeps all cluster tables
+behind a `StoreClient` so the control plane can restart and re-learn its state
+(`redis_store_client.h:126` vs `in_memory_store_client.h:32`; restart recovery loads
+tables via `gcs_init_data.cc`). Here the durable backend is an append-only pickle log
+per store directory (this framework has no Redis dependency): every mutation appends an
+(op, table, key, value) record; load() replays the log; compaction rewrites it as one
+snapshot record per live key once the log grows past a threshold.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Iterator
+
+
+class InMemoryStoreClient:
+    """Table storage with no durability (reference in_memory_store_client.h:32)."""
+
+    def __init__(self):
+        self._tables: dict[str, dict[Any, Any]] = {}
+
+    @property
+    def persistent(self) -> bool:
+        return False
+
+    def put(self, table: str, key, value):
+        self._tables.setdefault(table, {})[key] = value
+
+    def get(self, table: str, key, default=None):
+        return self._tables.get(table, {}).get(key, default)
+
+    def delete(self, table: str, key):
+        self._tables.get(table, {}).pop(key, None)
+
+    def keys(self, table: str) -> list:
+        return list(self._tables.get(table, {}))
+
+    def items(self, table: str) -> Iterator[tuple[Any, Any]]:
+        return iter(list(self._tables.get(table, {}).items()))
+
+    def load(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class FileStoreClient(InMemoryStoreClient):
+    """Append-only-log storage; survives GCS process restarts.
+
+    Records are pickle-framed (op, table, key, value) tuples. Writes flush to the OS
+    on every append (crash of the GCS process loses nothing; host crash can lose the
+    tail, same class of guarantee as default Redis AOF everysec).
+    """
+
+    _COMPACT_THRESHOLD = 50_000
+
+    def __init__(self, store_dir: str):
+        super().__init__()
+        self._dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self._path = os.path.join(store_dir, "gcs_tables.log")
+        self._lock = threading.Lock()
+        self._log = None
+        self._appends_since_compact = 0
+
+    @property
+    def persistent(self) -> bool:
+        return True
+
+    def load(self):
+        """Replay the log into memory, then open it for appending. A torn tail
+        record (crash mid-append) is truncated away so later appends are not
+        stranded behind unreadable bytes on the next load."""
+        good_offset = 0
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as f:
+                while True:
+                    try:
+                        op, table, key, value = pickle.load(f)
+                        good_offset = f.tell()
+                    except EOFError:
+                        break
+                    except Exception:
+                        break  # torn tail record from a crash mid-append
+                    if op == "put":
+                        super().put(table, key, value)
+                    elif op == "del":
+                        super().delete(table, key)
+            if good_offset < os.path.getsize(self._path):
+                with open(self._path, "r+b") as f:
+                    f.truncate(good_offset)
+        self._log = open(self._path, "ab")
+
+    def _append(self, record):
+        if self._log is None:
+            return
+        with self._lock:
+            pickle.dump(record, self._log, protocol=5)
+            self._log.flush()
+            self._appends_since_compact += 1
+            if self._appends_since_compact >= self._COMPACT_THRESHOLD:
+                self._compact_locked()
+
+    def _compact_locked(self):
+        tmp = self._path + ".compact"
+        with open(tmp, "wb") as f:
+            for table, kv in self._tables.items():
+                for key, value in kv.items():
+                    pickle.dump(("put", table, key, value), f, protocol=5)
+            f.flush()
+            os.fsync(f.fileno())
+        self._log.close()
+        os.replace(tmp, self._path)
+        self._log = open(self._path, "ab")
+        self._appends_since_compact = 0
+
+    def put(self, table: str, key, value):
+        super().put(table, key, value)
+        self._append(("put", table, key, value))
+
+    def delete(self, table: str, key):
+        super().delete(table, key)
+        self._append(("del", table, key, None))
+
+    def close(self):
+        if self._log is not None:
+            self._log.close()
+            self._log = None

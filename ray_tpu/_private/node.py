@@ -17,10 +17,16 @@ import uuid
 
 
 class NodeProcess:
-    def __init__(self, proc: subprocess.Popen, info: dict, ready_file: str):
+    def __init__(self, proc: subprocess.Popen, info: dict, ready_file: str,
+                 gcs_proc: subprocess.Popen | None = None,
+                 gcs_store_dir: str | None = None,
+                 session_dir: str | None = None):
         self.proc = proc
         self.info = info
         self.ready_file = ready_file
+        self.gcs_proc = gcs_proc  # head only: the separate GCS server process
+        self.gcs_store_dir = gcs_store_dir
+        self.session_dir = session_dir
 
     @property
     def node_id_hex(self) -> str:
@@ -43,6 +49,33 @@ class NodeProcess:
                 self.proc.kill()
             except Exception:
                 pass
+        if self.gcs_proc is not None:
+            try:
+                self.gcs_proc.terminate()
+                self.gcs_proc.wait(timeout=5)
+            except Exception:
+                try:
+                    self.gcs_proc.kill()
+                except Exception:
+                    pass
+
+    def kill_gcs(self):
+        """Crash the GCS process (head nodes only); raylets keep running."""
+        if self.gcs_proc is None:
+            raise RuntimeError("this node does not host the GCS")
+        self.gcs_proc.kill()
+        self.gcs_proc.wait(timeout=5)
+
+    def restart_gcs(self, timeout: float = 30.0):
+        """Start a fresh GCS on the same port over the same persistent store
+        (reference: gcs_server restart with a Redis backend)."""
+        if self.gcs_port is None:
+            raise RuntimeError("this node does not host the GCS")
+        if self.gcs_proc is not None and self.gcs_proc.poll() is None:
+            self.kill_gcs()
+        self.gcs_proc = _start_gcs_process(
+            self.session_dir, self.gcs_store_dir, port=self.gcs_port, timeout=timeout
+        )
 
 
 def _package_pythonpath(existing: str | None) -> str:
@@ -62,6 +95,47 @@ def make_session_dir() -> str:
     return session
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_gcs_process(session_dir: str, store_dir: str, port: int,
+                       timeout: float = 30.0) -> subprocess.Popen:
+    """Spawn the standalone GCS server (reference: gcs_server binary) and wait for
+    it to bind. The fixed port lets raylets and drivers reconnect to a restarted
+    GCS at the same address."""
+    ready_file = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:8]}.json")
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.gcs_main",
+        "--port", str(port),
+        "--store-dir", store_dir,
+        "--ready-file", ready_file,
+    ]
+    log_path = os.path.join(session_dir, "logs", f"gcs-{uuid.uuid4().hex[:8]}.log")
+    out = open(log_path, "wb")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
+    proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ready_file):
+            os.remove(ready_file)
+            return proc
+        if proc.poll() is not None:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-4000:].decode(errors="replace")
+            raise RuntimeError(f"gcs process exited during startup:\n{tail}")
+        time.sleep(0.05)
+    proc.terminate()
+    raise TimeoutError("gcs did not become ready in time")
+
+
 def start_node(
     *,
     head: bool,
@@ -76,6 +150,14 @@ def start_node(
     ready_file = os.path.join(
         session_dir, f"node_ready_{uuid.uuid4().hex[:8]}.json"
     )
+    gcs_proc = None
+    gcs_store_dir = None
+    if head:
+        # The GCS runs as its own process (reference: gcs_server binary) so it can
+        # crash and restart independently of the raylet; a pre-picked port lets the
+        # raylet spawn concurrently and retry-connect while the GCS boots.
+        gcs_store_dir = os.path.join(session_dir, "gcs_store")
+        gcs_addr = ("127.0.0.1", _free_port())
     cmd = [
         sys.executable,
         "-m",
@@ -92,26 +174,41 @@ def start_node(
         str(object_store_bytes),
         "--ready-file",
         ready_file,
+        "--gcs-host",
+        gcs_addr[0],
+        "--gcs-port",
+        str(gcs_addr[1]),
     ]
     if head:
         cmd.append("--head")
-    else:
-        cmd += ["--gcs-host", gcs_addr[0], "--gcs-port", str(gcs_addr[1])]
     log_path = os.path.join(session_dir, "logs", f"raylet-{uuid.uuid4().hex[:8]}.log")
     out = open(log_path, "wb")
     env = dict(os.environ)
     env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
     proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
+    if head:
+        try:
+            gcs_proc = _start_gcs_process(
+                session_dir, gcs_store_dir, port=gcs_addr[1], timeout=timeout
+            )
+        except Exception:
+            proc.terminate()
+            raise
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(ready_file):
             with open(ready_file) as f:
                 info = json.load(f)
-            return NodeProcess(proc, info, ready_file)
+            return NodeProcess(proc, info, ready_file, gcs_proc=gcs_proc,
+                               gcs_store_dir=gcs_store_dir, session_dir=session_dir)
         if proc.poll() is not None:
             with open(log_path, "rb") as f:
                 tail = f.read()[-4000:].decode(errors="replace")
+            if gcs_proc is not None:
+                gcs_proc.terminate()
             raise RuntimeError(f"node process exited during startup:\n{tail}")
         time.sleep(0.05)
     proc.terminate()
+    if gcs_proc is not None:
+        gcs_proc.terminate()
     raise TimeoutError("node did not become ready in time")

@@ -158,6 +158,10 @@ class Raylet:
         # target node dies, so work cannot vanish with a node between the moment
         # it was handed off and the moment its results reached the owner.
         self.delegated: dict[Any, dict] = {}
+        # Sealed objects this node holds: id -> (size, owner). Re-reported to the
+        # GCS after a GCS restart so the (non-persisted, owner-based) object
+        # directory can be rebuilt from the nodes that actually hold the data.
+        self._sealed_objects: dict[ObjectID, tuple[int, Any]] = {}
         self._shutdown = False
 
     # ------------------------------------------------------------------ startup
@@ -166,7 +170,30 @@ class Raylet:
         self.server = rpc.RpcServer(lambda conn: self)
         await self.server.start(port=port)
         self.port = self.server.port
-        self.gcs = await rpc.connect(*self.gcs_addr, handler=self, name="raylet->gcs")
+        await self._connect_gcs()
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._heartbeat_loop())
+        loop.create_task(self._scheduler_loop())
+        loop.create_task(self._idle_reaper_loop())
+        return self
+
+    async def _connect_gcs(self, deadline_s: float = 60.0):
+        """Connect (or reconnect) to the GCS, register, and sync hosted state.
+
+        Retries while the GCS is down: the control plane can restart independently
+        of raylets (reference: GCS clients buffer+retry during GCS downtime)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                self.gcs = await rpc.connect(
+                    *self.gcs_addr, handler=self, name="raylet->gcs"
+                )
+                break
+            except OSError:
+                if self._shutdown or time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.5)
+        self.gcs.on_close(self._on_gcs_lost)
         await self.gcs.call(
             "register_node",
             self.node_id,
@@ -178,11 +205,28 @@ class Raylet:
         # Actor state changes invalidate the local address cache (restart support).
         await self.gcs.call("subscribe", "actors")
         await self.gcs.call("subscribe", "nodes")
-        loop = asyncio.get_running_loop()
-        loop.create_task(self._heartbeat_loop())
-        loop.create_task(self._scheduler_loop())
-        loop.create_task(self._idle_reaper_loop())
-        return self
+        await self.gcs.call(
+            "sync_node_state",
+            self.node_id,
+            dict(self.actors),
+            [(oid, sz, owner) for oid, (sz, owner) in self._sealed_objects.items()],
+            list(self.resources.bundles.keys()),
+        )
+
+    def _on_gcs_lost(self, conn):
+        if self._shutdown:
+            return
+        asyncio.get_running_loop().create_task(self._reconnect_gcs())
+
+    async def _reconnect_gcs(self):
+        # Retry indefinitely: a raylet must rejoin whenever the GCS comes back,
+        # however long the outage (a bounded attempt would leave a zombie node).
+        while not self._shutdown:
+            try:
+                await self._connect_gcs(deadline_s=60.0)
+                return
+            except Exception:
+                await asyncio.sleep(1.0)
 
     def _pending_demand(self) -> dict:
         """Aggregate resources of queued-but-unplaceable work (autoscaler signal)."""
@@ -724,6 +768,7 @@ class Raylet:
 
     async def rpc_store_seal(self, conn, object_id: ObjectID, size: int, owner):
         self.store.seal(object_id)
+        self._sealed_objects[object_id] = (size, owner)
         try:
             await self.gcs.call("report_object", object_id, self.node_id, size, owner)
         except rpc.RpcError:
@@ -733,6 +778,7 @@ class Raylet:
     async def rpc_store_put_bytes(self, conn, object_id: ObjectID, data: bytes, owner):
         loop = asyncio.get_running_loop()
         name = await loop.run_in_executor(None, self.store.put_bytes, object_id, data)
+        self._sealed_objects[object_id] = (len(data), owner)
         try:
             await self.gcs.call("report_object", object_id, self.node_id, len(data), owner)
         except rpc.RpcError:
@@ -744,6 +790,7 @@ class Raylet:
 
     async def rpc_store_free(self, conn, object_id: ObjectID):
         self.store.free(object_id)
+        self._sealed_objects.pop(object_id, None)
         try:
             await self.gcs.notify("free_object", object_id)
         except rpc.RpcError:
@@ -752,6 +799,7 @@ class Raylet:
 
     async def rpc_evict_object(self, conn, object_id: ObjectID):
         self.store.free(object_id, eager=True)
+        self._sealed_objects.pop(object_id, None)
         return True
 
     async def rpc_read_chunk(self, conn, object_id: ObjectID, offset: int, length: int):
@@ -859,6 +907,7 @@ class Raylet:
                 finally:
                     reader.close()
                 self.store.seal(object_id)
+                self._sealed_objects[object_id] = (size, loc.get("owner"))
                 try:
                     await self.gcs.call(
                         "report_object", object_id, self.node_id, size, loc.get("owner")
@@ -945,9 +994,16 @@ class Raylet:
         cached = self.actor_addr_cache.get(actor_id)
         if cached is not None:
             return cached
-        try:
-            info = await self.gcs.call("wait_actor_alive", actor_id, 60.0)
-        except rpc.RpcError:
+        info = None
+        for _attempt in range(20):  # survive a GCS restart mid-lookup
+            try:
+                info = await self.gcs.call("wait_actor_alive", actor_id, 60.0)
+                break
+            except rpc.ConnectionLost:
+                await asyncio.sleep(0.5)
+            except rpc.RpcError:
+                return None
+        if info is None:
             return None
         if info is None or info["state"] != "ALIVE":
             return None

@@ -21,15 +21,15 @@ from ray_tpu._private.raylet import Raylet
 
 
 async def amain(args):
-    gcs_port = None
-    if args.head:
+    gcs_port = args.gcs_port
+    if args.head and not gcs_port:
+        # Fallback for direct invocation: host the GCS in-process. The normal path
+        # (node.py) runs the GCS as its own restartable process via gcs_main.
         gcs = GcsService()
         gcs_server = rpc.RpcServer(lambda conn: gcs)
-        await gcs_server.start(port=args.gcs_port)
+        await gcs_server.start(port=0)
         gcs.start_background()
         gcs_port = gcs_server.port
-    else:
-        gcs_port = args.gcs_port
 
     node_id = NodeID.from_hex(args.node_id) if args.node_id else NodeID.from_random()
     raylet = Raylet(

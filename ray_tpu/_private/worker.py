@@ -90,6 +90,11 @@ class MemoryStore:
             rec = self._records.get(object_id)
             if rec is None:
                 return False
+            if rec.resolved and not rec.error and error:
+                # First success wins: a late failure report (e.g. delegated-task
+                # recovery racing a completion that already landed) must not
+                # clobber a delivered result.
+                return True
             rec.data = data
             rec.error = error
             rec.in_plasma = in_plasma
@@ -323,13 +328,27 @@ class CoreWorker:
     # ------------------------------------------------------------------ kv helpers
 
     def gcs_kv_put(self, ns: str, key: bytes, value: bytes, overwrite=True):
-        return self.io.run(self.gcs.call("kv_put", ns, key, value, overwrite))
+        return self.gcs_call("kv_put", ns, key, value, overwrite)
 
     def gcs_kv_get(self, ns: str, key: bytes):
-        return self.io.run(self.gcs.call("kv_get", ns, key))
+        return self.gcs_call("kv_get", ns, key)
 
     def gcs_call(self, method: str, *args, timeout: float | None = None):
-        return self.io.run(self.gcs.call(method, *args), timeout)
+        """GCS request with transparent reconnect: the control plane may restart
+        under us (reference: GCS clients buffer and retry during GCS downtime)."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                return self.io.run(self.gcs.call(method, *args), timeout)
+            except rpc.ConnectionLost:
+                if not self._connected or time.monotonic() > deadline:
+                    raise
+                try:
+                    self.gcs = self.io.run(
+                        rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs")
+                    )
+                except OSError:
+                    time.sleep(0.5)
 
     def raylet_call(self, method: str, *args, timeout: float | None = None):
         return self.io.run(self.raylet.call(method, *args), timeout)
@@ -781,15 +800,19 @@ class CoreWorker:
         }
         reply = self.gcs_call("register_actor", actor_id, spec)
         actual_id = reply["actor_id"]
+        existing = bool(reply.get("existing"))
         if promoted:
-            if reply.get("existing"):
+            if existing:
                 # get_if_exists hit an existing actor: our spec (and its arg pins)
                 # will never be used for a restart.
                 for pid in promoted:
                     self.reference_counter.remove_local_ref(pid)
             else:
                 self._actor_arg_pins[actual_id] = promoted
-        return actual_id
+        # The caller's handle owns the arg pins only when this call actually
+        # created the actor; a get_if_exists hit must return a non-owning handle
+        # (its __del__ must not release the first creator's pins).
+        return actual_id, not existing
 
     def release_actor_arg_pins(self, actor_id: ActorID):
         """The creator's handle died: the actor can still run, but this process no

@@ -146,7 +146,7 @@ class ActorClass:
         from ray_tpu._private import runtime_env as runtime_env_mod
 
         method_names = _public_methods(self._cls)
-        actor_id = worker.create_actor(
+        actor_id, owns_pins = worker.create_actor(
             cls_key=self._cls_key,
             class_name=self._cls.__name__,
             args=args,
@@ -163,7 +163,9 @@ class ActorClass:
             method_names=method_names,
             runtime_env=runtime_env_mod.validate(opts.get("runtime_env")),
         )
-        return ActorHandle(actor_id, method_names, self._cls.__name__, _owns_arg_pins=True)
+        return ActorHandle(
+            actor_id, method_names, self._cls.__name__, _owns_arg_pins=owns_pins
+        )
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

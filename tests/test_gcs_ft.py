@@ -1,0 +1,147 @@
+"""GCS fault tolerance: persistent store, GCS crash + restart recovery.
+
+Reference shapes: the GCS runs as its own process (gcs_server_main.cc) over a
+persistent store client (redis_store_client.h); on restart it re-learns durable
+tables from storage and live state from raylet re-registration (gcs_init_data.cc).
+Tests mirror python/ray/tests with external-Redis GCS restart coverage.
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu._private.gcs_store import FileStoreClient
+
+
+def _wait_for(pred, timeout=30.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_file_store_roundtrip_and_compaction(tmp_path):
+    store = FileStoreClient(str(tmp_path / "s"))
+    store.load()
+    for i in range(100):
+        store.put("t", f"k{i}", {"v": i})
+    store.delete("t", "k0")
+    store.put("kv", ("ns", b"key"), b"value")
+    store._compact_locked = store._compact_locked  # exercised implicitly below
+    store.close()
+
+    store2 = FileStoreClient(str(tmp_path / "s"))
+    store2.load()
+    assert store2.get("t", "k1") == {"v": 1}
+    assert store2.get("t", "k0") is None
+    assert store2.get("kv", ("ns", b"key")) == b"value"
+    assert len(store2.keys("t")) == 99
+    store2.close()
+
+
+def test_file_store_survives_torn_tail(tmp_path):
+    store = FileStoreClient(str(tmp_path / "s"))
+    store.load()
+    store.put("t", "a", 1)
+    store.close()
+    with open(str(tmp_path / "s" / "gcs_tables.log"), "ab") as f:
+        f.write(b"\x80\x05garbage-torn-record")
+    store2 = FileStoreClient(str(tmp_path / "s"))
+    store2.load()
+    assert store2.get("t", "a") == 1
+    store2.close()
+
+
+def test_gcs_restart_cluster_keeps_working():
+    """Kill the GCS mid-session; after restart the cluster resumes: named actors
+    stay reachable, pre-crash KV and plasma objects survive, new tasks run."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from tests.conftest import _WORKER_ENV
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 2, "env_vars": _WORKER_ENV}
+    )
+    try:
+        cluster.connect()
+        w = ray_tpu.global_worker()
+
+        @ray_tpu.remote(name="counter")
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+        w.gcs_kv_put("app", b"config", b"v1")
+        big = ray_tpu.put(np.ones(300_000))
+
+        cluster.head.kill_gcs()
+        time.sleep(1.0)
+        cluster.head.restart_gcs()
+
+        # Raylets re-register and re-report hosted actors + sealed objects.
+        assert _wait_for(
+            lambda: len([n for n in ray_tpu.nodes() if n["alive"]]) >= 1, timeout=30
+        )
+        # Durable KV survived via the file store.
+        assert _wait_for(lambda: w.gcs_kv_get("app", b"config") == b"v1", timeout=30)
+        # The actor's in-memory state survived (its process never died).
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+        # Named-actor registry restored from storage + re-report.
+        h = ray_tpu.get_actor("counter")
+        assert ray_tpu.get(h.incr.remote(), timeout=60) == 3
+        # Object directory rebuilt from the raylet's sealed-object re-report.
+        assert float(ray_tpu.get(big, timeout=60).sum()) == 300_000.0
+        # New work schedules normally.
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+    finally:
+        cluster.shutdown()
+
+
+def test_calls_retry_through_gcs_downtime():
+    """A driver KV call issued while the GCS is down blocks and succeeds once the
+    GCS is back (client-side buffer+retry, reference GCS client behavior)."""
+    from ray_tpu.cluster_utils import Cluster
+    from tests.conftest import _WORKER_ENV
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 2, "env_vars": _WORKER_ENV}
+    )
+    try:
+        cluster.connect()
+        w = ray_tpu.global_worker()
+        w.gcs_kv_put("app", b"k", b"v0")
+        cluster.head.kill_gcs()
+
+        import threading
+
+        result = {}
+
+        def blocked_put():
+            try:
+                w.gcs_kv_put("app", b"k", b"v1")
+                result["ok"] = True
+            except Exception as e:  # pragma: no cover - failure path
+                result["err"] = e
+
+        t = threading.Thread(target=blocked_put)
+        t.start()
+        time.sleep(1.5)
+        cluster.head.restart_gcs()
+        t.join(timeout=30)
+        assert result.get("ok"), result
+        assert _wait_for(lambda: w.gcs_kv_get("app", b"k") == b"v1", timeout=30)
+    finally:
+        cluster.shutdown()
