@@ -20,6 +20,7 @@ from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, Worke
 from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
 from ray_tpu._private.worker import (
     CoreWorker,
+    ObjectRefGenerator,
     global_worker,
     global_worker_or_none,
     set_global_worker,

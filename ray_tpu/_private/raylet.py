@@ -401,7 +401,10 @@ class Raylet:
         if spec is not None:
             handle.busy_task = None
             self.running.pop(spec["task_id"], None)
-            if spec.get("retries_left", 0) > 0:
+            # Streaming tasks are not retried: a replay would re-emit items the
+            # consumer already took (and rewrite sealed item buffers); fail the
+            # stream cleanly instead.
+            if spec.get("retries_left", 0) > 0 and spec.get("num_returns") != "streaming":
                 spec["retries_left"] -= 1
                 self.task_queue.append(spec)
                 self._sched_wakeup.set()
@@ -432,6 +435,12 @@ class Raylet:
             for oid in spec["return_ids"]
         ]
         await self._route_results_to_owner(spec, results)
+        if spec.get("num_returns") == "streaming":
+            owner = spec["owner"]
+            await self._route_to_worker(
+                owner["node_id"], owner["worker_id"], "stream_abort",
+                {"task_id": spec["task_id"], "reason": reason},
+            )
         await self._settle_delegation(spec)
 
     # ------------------------------------------------------------------ delegation
@@ -488,7 +497,10 @@ class Raylet:
             spec = entry["spec"]
             if spec["type"] == "actor_task":
                 await self._fail_actor_task(spec, "actor's node died with call in flight")
-            elif spec.get("retries_left", 0) > 0:
+            elif (
+                spec.get("retries_left", 0) > 0
+                and spec.get("num_returns") != "streaming"
+            ):
                 spec["retries_left"] -= 1
                 self.task_queue.append(spec)
                 self._sched_wakeup.set()
@@ -749,6 +761,21 @@ class Raylet:
             return await peer.call("route_call", worker_id, method, payload)
         except rpc.RpcError:
             return {"error": "node_unreachable"}
+
+    async def rpc_stream_item(self, conn, owner: dict, task_id, index: int, result: dict):
+        """Route one streaming-task item to the owning worker."""
+        await self._route_to_worker(
+            owner["node_id"], owner["worker_id"], "stream_item",
+            {"task_id": task_id, "index": index, "result": result},
+        )
+        return True
+
+    async def rpc_stream_end(self, conn, owner: dict, task_id, count: int):
+        await self._route_to_worker(
+            owner["node_id"], owner["worker_id"], "stream_end",
+            {"task_id": task_id, "count": count},
+        )
+        return True
 
     async def rpc_report_borrow(self, conn, object_id: ObjectID, owner: dict, delta: int):
         """Forward a borrower's ref registration/release to the owning worker."""
@@ -1019,6 +1046,12 @@ class Raylet:
             {"object_id": oid, "inline": err, "error": True} for oid in spec["return_ids"]
         ]
         await self._route_results_to_owner(spec, results)
+        if spec.get("num_returns") == "streaming":
+            owner = spec["owner"]
+            await self._route_to_worker(
+                owner["node_id"], owner["worker_id"], "stream_abort",
+                {"task_id": spec["task_id"], "reason": reason},
+            )
         await self._settle_delegation(spec)
 
     async def rpc_actor_task_done(self, conn, spec_owner, task_id, results):

@@ -221,6 +221,89 @@ class ReferenceCounter:
             return self._borrows.get(object_id, 0)
 
 
+class _StreamState:
+    """Owner-side state of one streaming-generator task (ObjectRefStream parity,
+    reference task_manager.h). Items can arrive out of order (RPC dispatch is
+    concurrent per message), so they buffer by index and emit in order."""
+
+    def __init__(self):
+        self.items: dict[int, "ObjectRef"] = {}
+        self.total: int | None = None  # set at end-of-stream
+        self.abort_error: Exception | None = None  # producer died, retries exhausted
+        self.cond = threading.Condition()
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs yielded by a streaming task.
+
+    Reference: `ObjectRefGenerator` / streaming generators
+    (`num_returns="streaming"`). Each __next__ returns the next item's ObjectRef
+    as soon as the executor has produced it — consumption overlaps production.
+    A mid-stream exception in the generator body becomes a final error ref whose
+    get() raises, followed by StopIteration.
+    """
+
+    def __init__(self, task_id: TaskID, worker: "CoreWorker"):
+        self._task_id = task_id
+        self._worker = worker
+        self._consumed = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._next(timeout=None)
+
+    def _next(self, timeout: float | None):
+        st = self._worker._streams.get(self._task_id)
+        if st is None:
+            raise StopIteration
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with st.cond:
+            while True:
+                if self._consumed in st.items:
+                    ref = st.items.pop(self._consumed)
+                    self._consumed += 1
+                    return ref
+                if st.total is not None and self._consumed >= st.total:
+                    self._worker._streams.pop(self._task_id, None)
+                    raise StopIteration
+                if st.abort_error is not None:
+                    self._worker._streams.pop(self._task_id, None)
+                    raise st.abort_error
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"no stream item within timeout for task {self._task_id.hex()}"
+                    )
+                st.cond.wait(0.2 if remaining is None else min(0.2, remaining))
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        # StopIteration cannot cross an executor Future (Python converts it to
+        # RuntimeError); end-of-stream travels as a sentinel instead.
+        done = object()
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return done
+
+        item = await asyncio.get_running_loop().run_in_executor(None, step)
+        if item is done:
+            raise StopAsyncIteration
+        return item
+
+    def __del__(self):
+        try:
+            self._worker._streams.pop(self._task_id, None)
+        except Exception:
+            pass
+
+
 class _ActorRuntime:
     """Execution state when this worker hosts an actor."""
 
@@ -279,6 +362,7 @@ class CoreWorker:
         self._recon_attempts: dict[ObjectID, int] = {}
         self._actor_seq: dict[ActorID, _Counter] = {}
         self._actor_arg_pins: dict[ActorID, list[ObjectID]] = {}
+        self._streams: dict[TaskID, _StreamState] = {}  # owner side of streaming tasks
         self._task_executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-exec")
         self._future_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rtpu-fut")
         self.actor_runtime: _ActorRuntime | None = None
@@ -680,7 +764,10 @@ class CoreWorker:
     ) -> list[ObjectRef]:
         task_id = TaskID.from_random()
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
-        return_ids = [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        return_ids = (
+            [] if streaming else [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        )
         owner = self._owner_address()
         spec = {
             "type": "task",
@@ -709,13 +796,19 @@ class CoreWorker:
         # task's result arrives, guaranteeing args outlive the queued/running task)
         # and, when lineage is retained, a lineage pin (released when the last
         # return object dies, so a rebuild can re-materialize args).
-        if self._record_lineage(spec, promoted):
+        # Streamed items are not lineage-reconstructable (the stream is consumed
+        # incrementally), so streaming tasks keep only the flight pin.
+        if not streaming and self._record_lineage(spec, promoted):
             for pid in promoted:
                 self.reference_counter.add_local_ref(pid)
         if promoted:
             self._pending_promoted[task_id] = promoted
         self._record_event(task_id=task_id.hex(), name=name, state="SUBMITTED")
+        if streaming:
+            self._streams[task_id] = _StreamState()
         self._submit_when_ready(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return refs
 
     def _submit_when_ready(self, spec, target="submit_task", on_send_failure=None):
@@ -833,7 +926,10 @@ class CoreWorker:
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
         if promoted:
             self._pending_promoted[task_id] = promoted
-        return_ids = [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        return_ids = (
+            [] if streaming else [ObjectID.from_task(task_id, i) for i in range(num_returns)]
+        )
         owner = self._owner_address()
         counter = self._actor_seq.setdefault(actor_id, _Counter())
         spec = {
@@ -855,7 +951,11 @@ class CoreWorker:
             self.reference_counter.add_owned(oid)
             self.memory_store.create_pending(oid)
             refs.append(ObjectRef(oid, owner))
+        if streaming:
+            self._streams[task_id] = _StreamState()
         self._submit_when_ready(spec, target="submit_actor_task")
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return refs
 
     # ------------------------------------------------------------------ RPC handlers (io thread)
@@ -881,6 +981,51 @@ class CoreWorker:
                     await self.raylet.notify("store_free", oid)
                 except rpc.RpcError:
                     pass
+
+    async def rpc_stream_item(self, conn, payload):
+        """Owner side: one item of a streaming task arrived."""
+        task_id, index, result = payload["task_id"], payload["index"], payload["result"]
+        oid = result["object_id"]
+        in_plasma = bool(result.get("in_plasma"))
+        st = self._streams.get(task_id)
+        if st is None:
+            # Generator was dropped before this item landed: free an orphan.
+            if in_plasma:
+                try:
+                    await self.raylet.notify("store_free", oid)
+                except rpc.RpcError:
+                    pass
+            return True
+        self.reference_counter.add_owned(oid)
+        self.memory_store.create_pending(oid)
+        self.memory_store.resolve(
+            oid, None if in_plasma else result["inline"],
+            result.get("error", False), in_plasma,
+        )
+        ref = ObjectRef(oid, self._owner_address())
+        with st.cond:
+            st.items[index] = ref
+            st.cond.notify_all()
+        return True
+
+    async def rpc_stream_end(self, conn, payload):
+        st = self._streams.get(payload["task_id"])
+        if st is not None:
+            with st.cond:
+                st.total = payload["count"]
+                st.cond.notify_all()
+        return True
+
+    async def rpc_stream_abort(self, conn, payload):
+        """The producing worker died with retries exhausted: unblock the consumer."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        st = self._streams.get(payload["task_id"])
+        if st is not None:
+            with st.cond:
+                st.abort_error = WorkerCrashedError(payload.get("reason", "stream lost"))
+                st.cond.notify_all()
+        return True
 
     async def rpc_borrow_update(self, conn, payload):
         self.reference_counter.update_borrow(payload["object_id"], payload["delta"])
@@ -994,9 +1139,19 @@ class CoreWorker:
                 result = method(*args, **kwargs)
                 if asyncio.iscoroutine(result):
                     result = await result
-                results = self._package_results(spec, result)
+                if spec.get("num_returns") == "streaming":
+                    await self._run_streaming_async(spec, result)
+                    results = []
+                else:
+                    results = self._package_results(spec, result)
             except Exception as e:
-                results = self._package_error(spec, e)
+                if spec.get("num_returns") == "streaming":
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._stream_failure, spec, e
+                    )
+                    results = []
+                else:
+                    results = self._package_error(spec, e)
             self.io.spawn(
                 self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
             )
@@ -1025,10 +1180,20 @@ class CoreWorker:
                     fn = self.functions.load(spec["fn_key"])
                 args, kwargs = self._materialize_args(spec)
                 result = fn(*args, **kwargs)
-            results = self._package_results(spec, result)
+            if spec.get("num_returns") == "streaming":
+                self._run_streaming(spec, result)
+                results = []
+            else:
+                results = self._package_results(spec, result)
             state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - report any user failure to the owner
-            results = self._package_error(spec, e)
+            if spec.get("num_returns") == "streaming":
+                # Pre-iteration failure (fn load / arg materialization): the
+                # stream must still terminate with an error ref, not hang.
+                self._stream_failure(spec, e)
+                results = []
+            else:
+                results = self._package_error(spec, e)
             state = "FAILED"
         finally:
             self._tls.task_id = prev_task
@@ -1053,20 +1218,91 @@ class CoreWorker:
                     f"task {spec['name']} declared num_returns={num_returns} "
                     f"but returned {len(values)} values"
                 )
-        out = []
-        for oid, value in zip(spec["return_ids"], values):
-            pickled, raw_buffers, total = serialization.serialized_size(value)
-            if total > CONFIG.max_direct_call_object_size:
-                shm_name = self.raylet_call("store_create", oid, total)
-                buf = self.reader.read(shm_name, total)
-                serialization.write_parts(buf, pickled, raw_buffers)
-                self.raylet_call("store_seal", oid, total, spec["owner"])
-                out.append({"object_id": oid, "in_plasma": True, "size": total})
+        return [
+            self._package_one(oid, value, spec["owner"])
+            for oid, value in zip(spec["return_ids"], values)
+        ]
+
+    def _package_one(self, oid: ObjectID, value, owner: dict) -> dict:
+        pickled, raw_buffers, total = serialization.serialized_size(value)
+        if total > CONFIG.max_direct_call_object_size:
+            shm_name = self.raylet_call("store_create", oid, total)
+            buf = self.reader.read(shm_name, total)
+            serialization.write_parts(buf, pickled, raw_buffers)
+            self.raylet_call("store_seal", oid, total, owner)
+            return {"object_id": oid, "in_plasma": True, "size": total}
+        return {"object_id": oid, "inline": serialization.assemble(pickled, raw_buffers)}
+
+    def _stream_results(self, spec) -> "callable":
+        """Build the per-item sender for a streaming task: each yielded value is
+        packaged and pushed to the owner immediately (ObjectRefStream parity)."""
+        owner = spec["owner"]
+        task_id = spec["task_id"]
+        state = {"index": 0}
+
+        def send(value, error: bool = False):
+            index = state["index"]
+            state["index"] = index + 1
+            oid = ObjectID.from_task(task_id, 0x10000000 + index)
+            if error:
+                out = {"object_id": oid, "inline": serialization.dumps(value), "error": True}
             else:
-                out.append(
-                    {"object_id": oid, "inline": serialization.assemble(pickled, raw_buffers)}
-                )
-        return out
+                out = self._package_one(oid, value, owner)
+            self.io.run(self.raylet.notify("stream_item", owner, task_id, index, out))
+
+        def finish():
+            self.io.run(self.raylet.notify("stream_end", owner, task_id, state["index"]))
+
+        return send, finish
+
+    def _run_streaming(self, spec, result):
+        """Drive a (sync) generator result, pushing each item to the owner.
+
+        Never raises: a broken raylet link means this worker is about to die
+        (worker mode exits when its raylet conn closes) and the raylet-side
+        failure path will abort the owner's stream. Raising into the caller's
+        generic handler would restart the stream at index 0 and silently
+        truncate it at the owner.
+        """
+        try:
+            send, finish = self._stream_results(spec)
+            try:
+                for value in result:
+                    send(value)
+            except rpc.RpcError:
+                return
+            except Exception as e:  # noqa: BLE001 - mid-stream error becomes an error ref
+                send(RayTpuTaskError.from_exception(spec["name"], e), error=True)
+            finish()
+        except Exception:
+            traceback.print_exc()
+
+    def _stream_failure(self, spec, exc: Exception):
+        send, finish = self._stream_results(spec)
+        send(RayTpuTaskError.from_exception(spec["name"], exc), error=True)
+        finish()
+
+    async def _run_streaming_async(self, spec, result):
+        """Drive an async (or sync) generator inside an async actor. Never raises
+        (see _run_streaming)."""
+        loop = asyncio.get_running_loop()
+        try:
+            send, finish = self._stream_results(spec)
+            try:
+                if hasattr(result, "__anext__"):
+                    async for value in result:
+                        await loop.run_in_executor(None, send, value)
+                else:
+                    for value in result:
+                        await loop.run_in_executor(None, send, value)
+            except rpc.RpcError:
+                return
+            except Exception as e:  # noqa: BLE001
+                err = RayTpuTaskError.from_exception(spec["name"], e)
+                await loop.run_in_executor(None, lambda: send(err, error=True))
+            await loop.run_in_executor(None, finish)
+        except Exception:
+            traceback.print_exc()
 
     def _package_error(self, spec, exc: Exception) -> list:
         err = RayTpuTaskError.from_exception(spec["name"], exc)

@@ -44,7 +44,7 @@ def _public_methods(cls) -> list[str]:
 
 def _has_async_methods(cls) -> bool:
     return any(
-        inspect.iscoroutinefunction(m)
+        inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
         for _n, m in inspect.getmembers(cls, predicate=inspect.isfunction)
     )
 

@@ -1,0 +1,153 @@
+"""Streaming generators: num_returns="streaming" -> ObjectRefGenerator.
+
+Reference shapes: python/ray/tests/test_streaming_generator.py (ObjectRefStream,
+task_manager.h:177 owns the stream; items consumable while the task still runs).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_task_streaming_basic(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def countdown(n):
+        for i in range(n):
+            yield i * 10
+
+    gen = countdown.remote(5)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    values = [ray_tpu.get(ref, timeout=60) for ref in gen]
+    assert values == [0, 10, 20, 30, 40]
+
+
+def test_streaming_overlaps_production(ray_start_regular):
+    """The first item must be consumable well before the producer finishes."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_stream():
+        for i in range(4):
+            yield i
+            time.sleep(1.0)
+
+    gen = slow_stream.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(gen), timeout=60)
+    elapsed = time.monotonic() - t0
+    assert first == 0
+    assert elapsed < 3.0  # producer takes ~4s total; item 0 must arrive early
+    rest = [ray_tpu.get(r, timeout=60) for r in gen]
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_mid_stream_error(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def broken():
+        yield 1
+        yield 2
+        raise RuntimeError("stream broke")
+
+    gen = broken.remote()
+    assert ray_tpu.get(next(gen), timeout=60) == 1
+    assert ray_tpu.get(next(gen), timeout=60) == 2
+    with pytest.raises(RuntimeError, match="stream broke"):
+        ray_tpu.get(next(gen), timeout=60)
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_streaming_plasma_sized_items(ray_start_regular):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def arrays():
+        for i in range(3):
+            yield np.full(200_000, float(i))
+
+    total = 0.0
+    for ref in arrays.remote():
+        total += float(ray_tpu.get(ref, timeout=60).sum())
+    assert total == 200_000.0 * (0 + 1 + 2)
+
+
+def test_actor_streaming_method(ray_start_regular):
+    @ray_tpu.remote
+    class Streamer:
+        def stream(self, n):
+            for i in range(n):
+                yield f"item-{i}"
+
+    s = Streamer.remote()
+    gen = s.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r, timeout=60) for r in gen] == ["item-0", "item-1", "item-2"]
+
+
+def test_async_actor_streaming(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncStreamer:
+        async def agen(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 2
+
+    a = AsyncStreamer.remote()
+    gen = a.agen.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r, timeout=60) for r in gen] == [0, 2, 4, 6]
+
+
+def test_streaming_bad_function_error(ray_start_regular):
+    """A failure before the first yield terminates the stream with an error ref."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def bad(x):
+        raise ValueError("no stream for you")
+        yield x  # pragma: no cover
+
+    gen = bad.remote(1)
+    with pytest.raises(ValueError, match="no stream for you"):
+        ray_tpu.get(next(gen), timeout=60)
+
+
+def test_async_for_consumption(ray_start_regular):
+    """async for over the generator must end with StopAsyncIteration, not the
+    RuntimeError Python makes of StopIteration crossing an executor Future."""
+    import asyncio
+
+    @ray_tpu.remote(num_returns="streaming")
+    def nums(n):
+        for i in range(n):
+            yield i
+
+    async def consume():
+        out = []
+        async for ref in nums.remote(3):
+            out.append(ray_tpu.get(ref, timeout=60))
+        return out
+
+    assert asyncio.run(consume()) == [0, 1, 2]
+
+
+def test_actor_death_aborts_stream(ray_start_regular):
+    """Killing the actor mid-stream unblocks the consumer with an error instead
+    of hanging forever."""
+
+    @ray_tpu.remote
+    class Infinite:
+        def stream(self):
+            i = 0
+            while True:
+                yield i
+                i += 1
+                time.sleep(0.1)
+
+    a = Infinite.remote()
+    gen = a.stream.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(gen), timeout=60) == 0
+    ray_tpu.kill(a)
+    with pytest.raises(Exception):  # ActorDiedError / WorkerCrashedError at some index
+        for _ in range(10_000):
+            ray_tpu.get(next(gen), timeout=30)
