@@ -62,3 +62,23 @@ async def write_http_response(writer: asyncio.StreamWriter, status: int,
         + body
     )
     await writer.drain()
+
+
+async def write_http_chunked(writer: asyncio.StreamWriter, status: int,
+                             content_type: str, chunks):
+    """Stream a chunked-transfer response; `chunks` is an async iterator of bytes."""
+    reason = _REASONS.get(status, "OK")
+    writer.write(
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Transfer-Encoding: chunked\r\n"
+        f"Connection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    async for chunk in chunks:
+        if not chunk:
+            continue
+        writer.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+        await writer.drain()
+    writer.write(b"0\r\n\r\n")
+    await writer.drain()

@@ -22,7 +22,12 @@ from ray_tpu.serve._common import (
     Request,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 
 @dataclass
@@ -126,22 +131,18 @@ _proxy_state: dict = {}
 
 
 def start(http_options: Optional[dict] = None, **_kwargs):
-    """Start serve system actors (controller + HTTP proxy). Parity: serve.start."""
+    """Start serve system actors (controller + per-node HTTP proxies).
+
+    Parity: serve.start — the controller owns proxy lifecycle and keeps one
+    proxy per alive node (reference: ProxyActor per node, proxy.py:1138); the
+    head node's proxy binds the configured port."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     controller = _get_or_create_controller()
-    if _proxy_state.get("proxy") is None:
-        from ray_tpu.serve._proxy import HTTPProxy
-
-        opts = http_options or {}
-        proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
-        proxy = proxy_cls.options(
-            name="SERVE_PROXY", namespace=SERVE_NAMESPACE, get_if_exists=True,
-            max_concurrency=1000,
-        ).remote(opts.get("host", "127.0.0.1"), opts.get("port", 8000))
-        port = ray_tpu.get(proxy.start.remote())
-        _proxy_state["proxy"] = proxy
-        _proxy_state["port"] = port
+    if http_options or _proxy_state.get("port") is None:
+        port = ray_tpu.get(controller.ensure_proxies.remote(http_options or {}))
+        if port:  # 0 = no proxy bound yet; don't cache so callers retry
+            _proxy_state["port"] = port
     return controller
 
 
@@ -200,8 +201,18 @@ def run(
     acc: Dict[str, dict] = {}
     _collect_deployments(app, name, acc)
     ingress_name = app.deployment.name
+    import inspect as _inspect
+
+    target = app.deployment.target
+    call = target if not _inspect.isclass(target) else getattr(target, "__call__", None)
+    ingress_streaming = bool(
+        call is not None
+        and (_inspect.isgeneratorfunction(call) or _inspect.isasyncgenfunction(call))
+    )
     ray_tpu.get(
-        controller.deploy_app.remote(name, acc, route_prefix, ingress_name)
+        controller.deploy_app.remote(
+            name, acc, route_prefix, ingress_name, ingress_streaming
+        )
     )
     deadline = time.monotonic() + _timeout_s
     while time.monotonic() < deadline:
@@ -242,18 +253,29 @@ def status() -> dict:
 
 
 def shutdown():
-    try:
-        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
-        ray_tpu.get(controller.shutdown_serve.remote())
-        ray_tpu.kill(controller)
-    except Exception:
-        pass
-    proxy = _proxy_state.pop("proxy", None)
-    if proxy is not None:
+    controller = _existing_controller()
+    if controller is not None:
         try:
-            ray_tpu.kill(proxy)
+            ray_tpu.get(controller.shutdown_serve.remote(), timeout=15)
         except Exception:
             pass
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass
+    # Independent proxy cleanup: a wedged controller must not leak the per-node
+    # proxy actors (and their bound ports).
+    try:
+        for n in ray_tpu.nodes():
+            try:
+                proxy = ray_tpu.get_actor(
+                    f"SERVE_PROXY:{n['node_id'].hex()[:12]}", namespace=SERVE_NAMESPACE
+                )
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
+    except Exception:
+        pass
     _proxy_state.clear()
 
 
@@ -274,7 +296,27 @@ def get_deployment_handle(deployment_name: str, app_name: str = DEFAULT_APP_NAME
 
 
 def get_proxy_port() -> Optional[int]:
-    return _proxy_state.get("port")
+    if _proxy_state.get("port") is not None:
+        return _proxy_state["port"]
+    controller = _existing_controller()
+    if controller is None:
+        return None
+    try:
+        port = ray_tpu.get(controller.ensure_proxies.remote(None))
+        if port:
+            _proxy_state["port"] = port
+            return port
+        return None
+    except Exception:
+        return None
+
+
+def proxy_ports() -> Dict[str, int]:
+    """Per-node proxy ports: node id hex -> bound HTTP port."""
+    controller = _existing_controller()
+    if controller is None:
+        return {}
+    return ray_tpu.get(controller.proxy_ports.remote())
 
 
 __all__ = [
@@ -284,16 +326,20 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "Request",
     "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "get_proxy_port",
     "ingress",
+    "multiplexed",
+    "proxy_ports",
     "run",
     "shutdown",
-    "start",
     "status",
+    "start",
 ]

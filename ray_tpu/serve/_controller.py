@@ -33,10 +33,82 @@ class ServeController:
         # live replica's "has been healthy" status or its startup clock.
         # (app, dep) -> {"healthy": set[actor_id], "created": {actor_id: t}}
         self._health: Dict[tuple, dict] = {}
+        # Per-node HTTP proxies (reference: one ProxyActor per node, proxy.py):
+        # node_id hex -> (actor handle, port). Reconciled against cluster
+        # membership in the control loop once ensure_proxies() arms it.
+        self._http_options: Optional[dict] = None
+        self._proxies: Dict[str, tuple] = {}
+
+    # -- proxies -----------------------------------------------------------
+    async def ensure_proxies(self, http_options: Optional[dict] = None) -> int:
+        """Arm per-node proxy management and return the head node's proxy port.
+
+        Explicit options always take effect: serve.run()/get_proxy_port() arm the
+        defaults with {}, and a later serve.start(http_options={'port': N}) must
+        not be silently ignored — a port change restarts the proxies."""
+        if http_options:
+            prev = self._http_options
+            self._http_options = {**(prev or {}), **http_options}
+            if prev is not None and prev.get("port") != self._http_options.get("port"):
+                for _nid, (handle, _port) in list(self._proxies.items()):
+                    self._kill(handle)
+                self._proxies.clear()
+        elif self._http_options is None:
+            self._http_options = {}
+        await self._reconcile_proxies()
+        import ray_tpu
+
+        head_hex = next(
+            (n["node_id"].hex() for n in ray_tpu.nodes() if n.get("is_head")), None
+        )
+        if head_hex and head_hex in self._proxies:
+            return self._proxies[head_hex][1]
+        return next(iter(self._proxies.values()))[1] if self._proxies else 0
+
+    async def proxy_ports(self) -> Dict[str, int]:
+        return {nid: port for nid, (_h, port) in self._proxies.items()}
+
+    async def _reconcile_proxies(self):
+        if self._http_options is None:
+            return
+        import ray_tpu
+        from ray_tpu.serve._common import SERVE_NAMESPACE, async_get
+        from ray_tpu.serve._proxy import HTTPProxy
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        alive = {n["node_id"].hex(): n for n in ray_tpu.nodes() if n["alive"]}
+        # Drop proxies on dead nodes.
+        for nid in list(self._proxies):
+            if nid not in alive:
+                handle, _port = self._proxies.pop(nid)
+                self._kill(handle)
+        # One proxy per alive node. The head node binds the configured port; the
+        # other nodes bind an ephemeral port (on real multi-host clusters each
+        # node has its own address, so the reference binds one fixed port per
+        # host; single-host test clusters would collide on it).
+        for nid, info in alive.items():
+            if nid in self._proxies:
+                continue
+            port = self._http_options.get("port", 8000) if info.get("is_head") else 0
+            host = self._http_options.get("host", "127.0.0.1")
+            proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
+            try:
+                proxy = proxy_cls.options(
+                    name=f"SERVE_PROXY:{nid[:12]}", namespace=SERVE_NAMESPACE,
+                    get_if_exists=True, max_concurrency=1000,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        info["node_id"], soft=False
+                    ),
+                ).remote(host, port)
+                bound = await async_get(proxy.start.remote(), timeout=30)
+            except Exception:
+                continue  # node may have just died; next pass retries
+            self._proxies[nid] = (proxy, bound)
 
     # -- deploy / teardown -------------------------------------------------
     async def deploy_app(self, app: str, deployments: Dict[str, dict],
-                         route_prefix: Optional[str], ingress: str) -> bool:
+                         route_prefix: Optional[str], ingress: str,
+                         ingress_streaming: bool = False) -> bool:
         if route_prefix is not None:
             for other, deps in self._apps.items():
                 if other != app and deps.get("__meta__", {}).get("route_prefix") == route_prefix:
@@ -71,6 +143,7 @@ class ServeController:
         meta = self._apps[app].setdefault("__meta__", {})
         meta["route_prefix"] = route_prefix
         meta["ingress"] = ingress
+        meta["ingress_streaming"] = ingress_streaming
         await self._reconcile_app(app)
         return True
 
@@ -85,6 +158,10 @@ class ServeController:
         self._shutting_down = True
         for app in list(self._apps):
             await self.delete_app(app)
+        for _nid, (handle, _port) in list(self._proxies.items()):
+            self._kill(handle)
+        self._proxies.clear()
+        self._http_options = None
         return True
 
     def _kill(self, actor):
@@ -108,7 +185,8 @@ class ServeController:
             return None
         meta = self._apps[app].get("__meta__", {})
         return {"route_prefix": meta.get("route_prefix"),
-                "ingress": meta.get("ingress")}
+                "ingress": meta.get("ingress"),
+                "ingress_streaming": meta.get("ingress_streaming", False)}
 
     async def list_apps(self) -> dict:
         out = {}
@@ -117,6 +195,7 @@ class ServeController:
             out[app] = {
                 "route_prefix": meta.get("route_prefix"),
                 "ingress": meta.get("ingress"),
+                "ingress_streaming": meta.get("ingress_streaming", False),
                 "deployments": {
                     name: {
                         "num_replicas": len(self._replicas.get(app, {}).get(name, [])),
@@ -268,6 +347,7 @@ class ServeController:
                 if cfg.autoscaling_config is not None and stats:
                     self._autoscale(app, name, spec, stats)
             await self._reconcile_app(app)
+        await self._reconcile_proxies()
 
     def _autoscale(self, app: str, name: str, spec: dict, stats: List[dict]):
         cfg = spec["config"].autoscaling_config

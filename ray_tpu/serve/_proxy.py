@@ -23,6 +23,7 @@ class HTTPProxy:
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._routes: Dict[str, str] = {}  # route_prefix -> app name
+        self._streaming: Dict[str, bool] = {}  # app -> ingress is a generator
         self._handles: Dict[str, object] = {}
 
     async def start(self) -> int:
@@ -47,12 +48,15 @@ class HTTPProxy:
                 controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
                 apps = await async_get(controller.list_apps.remote())
                 routes = {}
+                streaming = {}
                 for app, meta in apps.items():
                     if meta.get("ingress") and meta.get("route_prefix") is not None:
                         routes[meta["route_prefix"]] = app
+                        streaming[app] = bool(meta.get("ingress_streaming"))
                         if app not in self._handles:
                             self._handles[app] = DeploymentHandle(app, meta["ingress"])
                 self._routes = routes
+                self._streaming = streaming
             except Exception:
                 pass
             await asyncio.sleep(0.5)
@@ -70,6 +74,11 @@ class HTTPProxy:
                 method=raw.method, path=raw.path, query_params=raw.query,
                 headers=raw.headers, body=raw.body,
             )
+            app = self._match_app(request.path)
+            if app is not None and self._streaming.get(app):
+                await self._dispatch_streaming(app, request, writer)
+                writer.close()
+                return
             status, body, ctype = await self._dispatch(request)
         except Exception:
             status, body, ctype = 500, traceback.format_exc().encode(), "text/plain"
@@ -78,18 +87,60 @@ class HTTPProxy:
         finally:
             writer.close()
 
-    async def _dispatch(self, request: Request):
+    def _match_app(self, path: str) -> Optional[str]:
         # Longest matching route prefix wins.
-        match = None
         for prefix in sorted(self._routes, key=len, reverse=True):
-            if request.path == prefix or request.path.startswith(
-                prefix.rstrip("/") + "/"
-            ) or prefix == "/":
-                match = prefix
-                break
-        if match is None:
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                return self._routes[prefix]
+        return None
+
+    async def _dispatch_streaming(self, app: str, request: Request, writer):
+        """Chunked-transfer response: each item the generator endpoint yields is
+        flushed to the client as one chunk (reference: StreamingResponse over the
+        proxy's ASGI path).
+
+        Submission and the first-item fetch run off-loop (router.pick blocks) and
+        BEFORE the 200 header goes out, so an endpoint that fails up front still
+        gets a clean 500; a failure after streaming began can only terminate the
+        connection (the status line is already on the wire).
+        """
+        from ray_tpu._private.http import write_http_chunked
+
+        loop = asyncio.get_running_loop()
+        gen = await loop.run_in_executor(
+            None, lambda: self._handles[app].options(stream=True).remote(request)
+        )
+        try:
+            first = await gen.__anext__()
+            have_first = True
+        except StopAsyncIteration:
+            first, have_first = None, False
+
+        def encode(item) -> bytes:
+            if isinstance(item, bytes):
+                return item
+            if isinstance(item, str):
+                return item.encode()
+            return (json.dumps(item, default=str) + "\n").encode()
+
+        async def chunks():
+            if have_first:
+                yield encode(first)
+                async for item in gen:
+                    yield encode(item)
+
+        try:
+            await write_http_chunked(writer, 200, "text/plain", chunks())
+        except Exception:
+            # Mid-stream failure (endpoint error or client disconnect): headers
+            # are already sent, so drop the connection; never write a second
+            # status line onto a half-streamed body.
+            gen.close()
+
+    async def _dispatch(self, request: Request):
+        app = self._match_app(request.path)
+        if app is None:
             return 404, b"no application mounted", "text/plain"
-        app = self._routes[match]
         handle = self._handles[app]
         loop = asyncio.get_running_loop()
         # The whole submit+resolve runs off-loop: routing does blocking controller

@@ -3,15 +3,18 @@
 Design parity: reference `python/ray/serve/_private/replica.py` (`Replica` :1041,
 `UserCallableWrapper` :1333) — wraps the user class/function, counts ongoing requests
 for the router's load metric and the autoscaler, supports sync and async callables and
-method dispatch, reconstructs nested deployment handles for composition.
+method dispatch, reconstructs nested deployment handles for composition, streams
+generator responses over the handle (handle_request_streaming), and carries the
+multiplexed model id of each request into `serve.get_multiplexed_model_id()`.
 """
 
 from __future__ import annotations
 
 import asyncio
 import inspect
-import traceback
 from typing import Any
+
+MUX_KWARG = "_serve_mux_model_id"
 
 
 async def _await_it(awaitable):
@@ -50,36 +53,46 @@ class Replica:
             await out
         return True
 
-    async def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+    async def _resolve_ref_args(self, args: tuple, kwargs: dict):
+        """Chained DeploymentResponses arrive as ObjectRefs nested inside the args
+        tuple (not top-level task args), so resolve them here — off the event
+        loop, since get() blocks."""
         import ray_tpu
 
+        if any(isinstance(a, ray_tpu.ObjectRef) for a in args) or any(
+            isinstance(v, ray_tpu.ObjectRef) for v in kwargs.values()
+        ):
+            loop = asyncio.get_running_loop()
+            args, kwargs = await loop.run_in_executor(
+                None,
+                lambda: (
+                    tuple(
+                        ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a
+                        for a in args
+                    ),
+                    {
+                        k: ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef) else v
+                        for k, v in kwargs.items()
+                    },
+                ),
+            )
+        return args, kwargs
+
+    def _lookup(self, method_name: str):
+        if callable(self._instance) and method_name == "__call__":
+            return self._instance
+        return getattr(self._instance, method_name)
+
+    async def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
+
+        mux_id = kwargs.pop(MUX_KWARG, "")
         self._ongoing += 1
         self._total += 1
+        token = _set_model_id(mux_id)
         try:
-            # Chained DeploymentResponses arrive as ObjectRefs nested inside the
-            # args tuple (not top-level task args), so resolve them here — off the
-            # event loop, since get() blocks.
-            if any(isinstance(a, ray_tpu.ObjectRef) for a in args) or any(
-                isinstance(v, ray_tpu.ObjectRef) for v in kwargs.values()
-            ):
-                loop = asyncio.get_running_loop()
-                args, kwargs = await loop.run_in_executor(
-                    None,
-                    lambda: (
-                        tuple(
-                            ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a
-                            for a in args
-                        ),
-                        {
-                            k: ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef) else v
-                            for k, v in kwargs.items()
-                        },
-                    ),
-                )
-            if callable(self._instance) and method_name == "__call__":
-                fn = self._instance
-            else:
-                fn = getattr(self._instance, method_name)
+            args, kwargs = await self._resolve_ref_args(args, kwargs)
+            fn = self._lookup(method_name)
             if inspect.iscoroutinefunction(fn) or (
                 not inspect.isfunction(fn) and not inspect.ismethod(fn)
                 and inspect.iscoroutinefunction(getattr(fn, "__call__", None))
@@ -88,14 +101,25 @@ class Replica:
             else:
                 # Sync callables run off-loop: a blocking handler must not freeze
                 # the replica's event loop (that would serialize all requests and
-                # zero out the concurrency the router/autoscaler observe).
+                # zero out the concurrency the router/autoscaler observe). The
+                # model-id contextvar is re-seated inside the pool thread —
+                # run_in_executor does not propagate context.
                 loop = asyncio.get_running_loop()
-                out = await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+                def call_sync():
+                    t = _set_model_id(mux_id)
+                    try:
+                        return fn(*args, **kwargs)
+                    finally:
+                        _reset_model_id(t)
+
+                out = await loop.run_in_executor(None, call_sync)
             if inspect.isawaitable(out):
                 out = await out
             if inspect.isgenerator(out):
-                # Non-streaming v1: generators are materialized. (Reference streams
-                # them over the handle; see serve/_private/replica.py generator path.)
+                # Non-streaming call of a generator endpoint: materialized.
+                # (Streaming consumers use handle.options(stream=True), which
+                # routes through handle_request_streaming instead.)
                 out = list(out)
             elif inspect.isasyncgen(out):
                 items = []
@@ -104,10 +128,79 @@ class Replica:
                 out = items
             return out
         finally:
+            _reset_model_id(token)
+            self._ongoing -= 1
+
+    async def handle_request_streaming(self, method_name: str, args: tuple, kwargs: dict):
+        """Async generator: each item the user endpoint yields streams to the
+        caller as soon as it is produced (reference: replica.py generator path
+        over the handle; rides the runtime's num_returns="streaming")."""
+        from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
+
+        mux_id = kwargs.pop(MUX_KWARG, "")
+        self._ongoing += 1
+        self._total += 1
+        token = _set_model_id(mux_id)
+        try:
+            args, kwargs = await self._resolve_ref_args(args, kwargs)
+            fn = self._lookup(method_name)
+            if (
+                inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn)
+                or inspect.iscoroutinefunction(fn)
+            ):
+                out = fn(*args, **kwargs)
+            else:
+                # Plain sync callable behind a streaming handle: run it off-loop,
+                # same invariant as handle_request (a blocking body must not
+                # freeze the replica's event loop).
+                loop = asyncio.get_running_loop()
+
+                def call_sync():
+                    t = _set_model_id(mux_id)
+                    try:
+                        return fn(*args, **kwargs)
+                    finally:
+                        _reset_model_id(t)
+
+                out = await loop.run_in_executor(None, call_sync)
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isasyncgen(out):
+                async for item in out:
+                    yield item
+            elif inspect.isgenerator(out):
+                loop = asyncio.get_running_loop()
+                done = object()
+
+                def nxt():
+                    t = _set_model_id(mux_id)
+                    try:
+                        return next(out)
+                    except StopIteration:
+                        return done
+                    finally:
+                        _reset_model_id(t)
+
+                while True:
+                    item = await loop.run_in_executor(None, nxt)
+                    if item is done:
+                        break
+                    yield item
+            else:
+                yield out
+        finally:
+            _reset_model_id(token)
             self._ongoing -= 1
 
     async def get_stats(self) -> dict:
-        return {"ongoing": self._ongoing, "total": self._total}
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "multiplexed_ids": loaded_model_ids(self._instance),
+        }
 
     async def ready(self) -> bool:
         return True

@@ -56,6 +56,70 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response: yields the VALUES the
+    endpoint streams, as they are produced (reference:
+    serve.handle.DeploymentResponseGenerator over a streaming replica call)."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._ref_gen = ref_gen  # ObjectRefGenerator
+        self._on_done = on_done
+        self._finished = False
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            if self._on_done is not None:
+                self._on_done()
+
+    def close(self):
+        """Release router bookkeeping for an abandoned stream."""
+        self._finish()
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._ref_gen)
+        except StopIteration:
+            self._finish()
+            raise
+        except Exception:
+            self._finish()
+            raise
+        return ray_tpu.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        done = object()
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return done
+
+        item = await asyncio.get_running_loop().run_in_executor(None, step)
+        if item is done:
+            raise StopAsyncIteration
+        return item
+
+    @property
+    def object_ref_gen(self):
+        return self._ref_gen
+
+
 class _Router:
     """Replica set cache + power-of-two-choices pick. One per handle per process."""
 
@@ -68,6 +132,10 @@ class _Router:
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[Any, int] = {}
+        # Multiplexing cache affinity: model_id -> actor_id that loaded it last
+        # (reference routes on replica-reported loaded ids; here the map is
+        # learned locally per process, which converges for steady callers).
+        self._model_affinity: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def _controller(self):
@@ -88,7 +156,7 @@ class _Router:
                 a._actor_id: self._inflight.get(a._actor_id, 0) for a in self._replicas
             }
 
-    def pick(self):
+    def pick(self, model_id: str = ""):
         self._refresh()
         deadline = time.monotonic() + 30
         while not self._replicas:
@@ -99,13 +167,24 @@ class _Router:
             time.sleep(0.05)
             self._refresh(force=True)
         with self._lock:
+            if model_id:
+                # Cache affinity: send the request where the model already lives.
+                aff = self._model_affinity.get(model_id)
+                if aff is not None:
+                    for r in self._replicas:
+                        if r._actor_id == aff:
+                            self._inflight[aff] = self._inflight.get(aff, 0) + 1
+                            return r
             if len(self._replicas) == 1:
-                return self._replicas[0]
-            a, b = random.sample(self._replicas, 2)
-            pick = a if self._inflight.get(a._actor_id, 0) <= self._inflight.get(
-                b._actor_id, 0
-            ) else b
+                pick = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                pick = a if self._inflight.get(a._actor_id, 0) <= self._inflight.get(
+                    b._actor_id, 0
+                ) else b
             self._inflight[pick._actor_id] = self._inflight.get(pick._actor_id, 0) + 1
+            if model_id:
+                self._model_affinity[model_id] = pick._actor_id
             return pick
 
     def done(self, replica):
@@ -138,23 +217,40 @@ def _shared_router(app: str, deployment: str) -> _Router:
 
 
 class DeploymentHandle:
-    def __init__(self, app: str, deployment: str, method_name: str = "__call__"):
+    def __init__(self, app: str, deployment: str, method_name: str = "__call__",
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self._app = app
         self._deployment = deployment
         self._method_name = method_name
+        self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
         self._router: Optional[_Router] = None
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._app, self._deployment, self._method_name))
+        return (
+            DeploymentHandle,
+            (self._app, self._deployment, self._method_name, self._stream,
+             self._multiplexed_model_id),
+        )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._app, self._deployment, name)
-
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
-            self._app, self._deployment, method_name or self._method_name
+            self._app, self._deployment, name, self._stream, self._multiplexed_model_id
+        )
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._app,
+            self._deployment,
+            method_name or self._method_name,
+            self._stream if stream is None else stream,
+            self._multiplexed_model_id
+            if multiplexed_model_id is None
+            else multiplexed_model_id,
         )
 
     def _get_router(self) -> _Router:
@@ -174,9 +270,23 @@ class DeploymentHandle:
         }
         router = self._get_router()
         method = self._method_name
+        model_id = self._multiplexed_model_id
+        if model_id:
+            from ray_tpu.serve._replica import MUX_KWARG
+
+            kwargs = {**kwargs, MUX_KWARG: model_id}
+
+        if self._stream:
+            replica = router.pick(model_id)
+            ref_gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs)
+            return DeploymentResponseGenerator(
+                ref_gen, on_done=lambda: router.done(replica)
+            )
 
         def submit():
-            replica = router.pick()
+            replica = router.pick(model_id)
             ref = replica.handle_request.remote(method, args, kwargs)
             # In-flight bookkeeping: decremented when the result resolves.
             ray_tpu.global_worker().memory_store.add_done_callback(

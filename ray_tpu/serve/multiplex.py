@@ -1,0 +1,150 @@
+"""Model multiplexing: many models per replica with per-replica LRU caching.
+
+Design parity: reference `python/ray/serve/multiplex.py` (`@serve.multiplexed` wrapping
+an async model loader with an LRU of `max_num_models_per_replica`) and
+`serve.get_multiplexed_model_id()` reading the current request's target model. The
+router prefers replicas that already hold the requested model (cache affinity), falling
+back to power-of-two-choices.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_model_id_ctx: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id the current request was routed with."""
+    return _model_id_ctx.get()
+
+
+def _set_model_id(model_id: str):
+    return _model_id_ctx.set(model_id)
+
+
+def _reset_model_id(token):
+    _model_id_ctx.reset(token)
+
+
+_LOADING = object()  # slot reserved, model load in progress
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models, keyed by model id.
+
+    The capacity bound is enforced under one cache-wide lock (reserve a slot,
+    evicting as needed, BEFORE loading) so concurrent loads of distinct ids can
+    never overshoot max_num_models_per_replica — the bound is the whole point of
+    multiplexing device-resident models.
+    """
+
+    def __init__(self, loader: Callable, owner, max_models: int):
+        self._loader = loader
+        self._owner = owner  # the deployment instance (None for bare functions)
+        self._max = max_models
+        self._models: OrderedDict[str, Any] = OrderedDict()
+        self._locks: dict[str, asyncio.Lock] = {}
+        self._cap_lock = asyncio.Lock()
+
+    @property
+    def model_ids(self) -> list:
+        return [k for k, v in self._models.items() if v is not _LOADING]
+
+    async def _evict_to_fit(self):
+        while len(self._models) >= self._max:
+            victim_id = next(
+                (k for k, v in self._models.items() if v is not _LOADING), None
+            )
+            if victim_id is None:
+                return  # everything is mid-load; momentary overshoot is unavoidable
+            evicted = self._models.pop(victim_id)
+            del_fn = getattr(evicted, "__del__", None)
+            if callable(del_fn):
+                try:
+                    out = del_fn()
+                    if inspect.isawaitable(out):
+                        await out
+                except Exception:
+                    pass
+
+    async def get(self, model_id: str):
+        cached = self._models.get(model_id)
+        if cached is not None and cached is not _LOADING:
+            self._models.move_to_end(model_id)
+            return cached
+        lock = self._locks.setdefault(model_id, asyncio.Lock())
+        async with lock:
+            cached = self._models.get(model_id)
+            if cached is not None and cached is not _LOADING:  # loaded while we waited
+                self._models.move_to_end(model_id)
+                return cached
+            async with self._cap_lock:
+                await self._evict_to_fit()
+                self._models[model_id] = _LOADING
+            try:
+                args = (model_id,) if self._owner is None else (self._owner, model_id)
+                out = self._loader(*args)
+                if inspect.isawaitable(out):
+                    out = await out
+            except Exception:
+                self._models.pop(model_id, None)
+                raise
+            self._models[model_id] = out
+            self._locks.pop(model_id, None)
+            return out
+
+
+def multiplexed(func: Optional[Callable] = None, *, max_num_models_per_replica: int = 3):
+    """Decorate a model-loader method: `async def load(self, model_id) -> model`.
+
+    Calls are LRU-cached per replica; the replica advertises its loaded ids so the
+    router can route with cache affinity.
+    """
+
+    def wrap(loader):
+        cache_attr = f"__serve_mux_cache_{loader.__name__}"
+
+        async def wrapper(self_or_id, model_id=None):
+            if model_id is None:
+                # Bare function loader: called as wrapper(model_id).
+                owner, mid = None, self_or_id
+                holder = wrapper
+            else:
+                owner, mid = self_or_id, model_id
+                holder = owner
+            cache = getattr(holder, cache_attr, None)
+            if cache is None:
+                cache = _ModelCache(loader, owner, max_num_models_per_replica)
+                try:
+                    setattr(holder, cache_attr, cache)
+                    caches = getattr(holder, "__serve_mux_caches__", None)
+                    if caches is None:
+                        caches = []
+                        setattr(holder, "__serve_mux_caches__", caches)
+                    caches.append(cache)
+                except AttributeError:
+                    pass
+            return await cache.get(mid)
+
+        wrapper.__name__ = loader.__name__
+        wrapper.__serve_multiplexed__ = True
+        return wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap
+
+
+def loaded_model_ids(instance) -> list:
+    """All model ids currently cached on a deployment instance."""
+    out = []
+    for cache in getattr(instance, "__serve_mux_caches__", ()):
+        out.extend(cache.model_ids)
+    return out

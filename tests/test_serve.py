@@ -301,3 +301,121 @@ def test_route_prefix_collision_rejected():
     serve.run(a.bind(), name="appa", route_prefix="/same")
     with pytest.raises(Exception, match="route_prefix"):
         serve.run(b.bind(), name="appb", route_prefix="/same")
+
+
+# ---------------------------------------------------------------- streaming
+
+def test_streaming_response_over_handle():
+    import time as _time
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    def token_stream(request):
+        for i in range(4):
+            _time.sleep(0.2)
+            yield f"tok{i}"
+
+    handle = serve.run(token_stream.bind(), name="stream_app", route_prefix=None)
+    gen = handle.options(stream=True).remote(None)
+    t0 = _time.monotonic()
+    first = next(gen)
+    first_latency = _time.monotonic() - t0
+    assert first == "tok0"
+    rest = list(gen)
+    assert rest == ["tok1", "tok2", "tok3"]
+    assert first_latency < 10.0  # arrives before the ~0.8s full stream only on a warm node
+    serve.delete("stream_app")
+
+
+def test_streaming_http_chunked():
+    import json as _json
+    import socket
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    def sse(request):
+        for i in range(3):
+            yield {"n": i}
+
+    serve.run(sse.bind(), name="sse_app", route_prefix="/sse")
+    port = serve.get_proxy_port()
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+        s.sendall(b"GET /sse HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.settimeout(30)
+        data = b""
+        while True:
+            try:
+                chunk = s.recv(65536)
+            except TimeoutError:
+                break
+            if not chunk:
+                break
+            data += chunk
+    head, _, body = data.partition(b"\r\n\r\n")
+    assert b"Transfer-Encoding: chunked" in head
+    # Parse chunked body.
+    items = []
+    while body:
+        size_line, _, rest = body.partition(b"\r\n")
+        size = int(size_line, 16)
+        if size == 0:
+            break
+        items.append(rest[:size])
+        body = rest[size + 2:]
+    parsed = [_json.loads(x) for x in items]
+    assert parsed == [{"n": 0}, {"n": 1}, {"n": 2}]
+    serve.delete("sse_app")
+
+
+# ---------------------------------------------------------------- multiplexing
+
+def test_model_multiplexing():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def load_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        async def __call__(self, request):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.load_model(model_id)
+            return {"served_by": model["id"], "loads": list(self.loads)}
+
+    handle = serve.run(MuxModel.bind(), name="mux_app", route_prefix=None)
+
+    r1 = handle.options(multiplexed_model_id="alpha").remote(None).result(timeout_s=60)
+    assert r1["served_by"] == "alpha"
+    r2 = handle.options(multiplexed_model_id="alpha").remote(None).result(timeout_s=60)
+    assert r2["served_by"] == "alpha"
+    assert r2["loads"].count("alpha") == 1  # cached, loaded once
+
+    # LRU eviction at max 2: loading beta+gamma evicts alpha; re-request reloads.
+    handle.options(multiplexed_model_id="beta").remote(None).result(timeout_s=60)
+    handle.options(multiplexed_model_id="gamma").remote(None).result(timeout_s=60)
+    r5 = handle.options(multiplexed_model_id="alpha").remote(None).result(timeout_s=60)
+    assert r5["loads"].count("alpha") == 2
+    serve.delete("mux_app")
+
+
+# ---------------------------------------------------------------- per-node proxies
+
+def test_proxy_port_and_table():
+    from ray_tpu import serve
+
+    @serve.deployment
+    def hello(request):
+        return "hi"
+
+    serve.run(hello.bind(), name="hello_app", route_prefix="/hello")
+    ports = serve.proxy_ports()
+    assert len(ports) >= 1  # one proxy per alive node
+    assert serve.get_proxy_port() in ports.values()
+    serve.delete("hello_app")
