@@ -80,10 +80,19 @@ class _ArenaHandle:
         self._h = handle
         self._view = _ArenaView(self._lib, self._h)
 
+    def _handle(self):
+        """The live native handle. Raises instead of letting ctypes pass NULL into
+        the library (a closed client's handle is None; C would segfault on it —
+        e.g. a racing reader during worker shutdown)."""
+        h = self._h
+        if h is None:
+            raise KeyError(f"arena {self.name!r} is closed")
+        return h
+
     def lookup(self, object_id: bytes) -> Optional[Tuple[int, int]]:
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        if self._lib.shmstore_lookup(self._h, object_id, ctypes.byref(off),
+        if self._lib.shmstore_lookup(self._handle(), object_id, ctypes.byref(off),
                                      ctypes.byref(size)) != 0:
             return None
         return off.value, size.value
@@ -95,7 +104,10 @@ class _ArenaHandle:
         self._view.view[offset : offset + len(data)] = data
 
     def pin(self, object_id: bytes) -> bool:
-        return self._lib.shmstore_pin(self._h, object_id) == 0
+        h = self._h
+        if h is None:
+            return False
+        return self._lib.shmstore_pin(h, object_id) == 0
 
     def release(self, object_id: bytes) -> bool:
         if self._h is None:
@@ -150,7 +162,7 @@ class NativeStoreServer(_ArenaHandle):
 
     def alloc(self, object_id: bytes, size: int) -> Optional[int]:
         """Returns payload offset, None if full, or raises on duplicate."""
-        off = self._lib.shmstore_alloc(self._h, object_id, size)
+        off = self._lib.shmstore_alloc(self._handle(), object_id, size)
         if off == _ALLOC_FULL:
             return None
         if off == _ALLOC_EXISTS:
@@ -158,32 +170,32 @@ class NativeStoreServer(_ArenaHandle):
         return off
 
     def seal(self, object_id: bytes) -> bool:
-        return self._lib.shmstore_seal(self._h, object_id) == 0
+        return self._lib.shmstore_seal(self._handle(), object_id) == 0
 
     def free(self, object_id: bytes, eager: bool = False) -> bool:
-        return self._lib.shmstore_free_obj(self._h, object_id, 1 if eager else 0) == 0
+        return self._lib.shmstore_free_obj(self._handle(), object_id, 1 if eager else 0) == 0
 
     def list_spillable(self, max_out: int = 256) -> list:
         """Sealed, unpinned object keys in LRU order (spill candidates)."""
         buf = ctypes.create_string_buffer(16 * max_out)
-        n = self._lib.shmstore_list_spillable(self._h, buf, max_out)
+        n = self._lib.shmstore_list_spillable(self._handle(), buf, max_out)
         return [buf.raw[16 * i : 16 * (i + 1)] for i in range(n)]
 
     @property
     def used(self) -> int:
-        return self._lib.shmstore_used(self._h)
+        return self._lib.shmstore_used(self._handle())
 
     @property
     def capacity(self) -> int:
-        return self._lib.shmstore_capacity(self._h)
+        return self._lib.shmstore_capacity(self._handle())
 
     @property
     def num_objects(self) -> int:
-        return self._lib.shmstore_count(self._h)
+        return self._lib.shmstore_count(self._handle())
 
     @property
     def num_evictions(self) -> int:
-        return self._lib.shmstore_num_evictions(self._h)
+        return self._lib.shmstore_num_evictions(self._handle())
 
     def destroy(self):
         if self._h:

@@ -23,7 +23,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "max_object_reconstructions": (int, 3, "how many times a lost plasma object may be rebuilt by re-running its producing task (0 disables lineage reconstruction)"),
     "max_lineage_entries": (int, 10000, "max owned objects whose producing task spec is retained for reconstruction; oldest entries are evicted first"),
     "max_actor_restarts_default": (int, 0, "default max_restarts for actors"),
-    "worker_register_timeout_s": (float, 30.0, "how long the raylet waits for a spawned worker to register"),
+    "worker_register_timeout_s": (float, 60.0, "how long the raylet waits for a spawned worker to register (covers slow interpreter+jax imports on loaded hosts)"),
     "worker_pool_prestart": (int, 0, "number of workers to prestart per node"),
     "idle_worker_kill_s": (float, 300.0, "kill idle workers after this many seconds"),
     "get_poll_interval_s": (float, 0.002, "poll interval for blocking gets"),

@@ -4,8 +4,9 @@ Design parity: reference `python/ray/train/v2/_internal/execution/controller/
 controller.py:99` — run() :487 creates a worker group per attempt (ScalingPolicy),
 polls worker health (:266), routes reported results to the CheckpointManager, and on
 failure consults the FailurePolicy to restart from the latest checkpoint or raise.
-Runs in the driver process (the reference detaches it as an actor so the job survives
-driver death; divergence documented in docs/divergences.md).
+By default the controller runs as a DETACHED named actor (`DetachedControllerRunner`,
+reference :99 detached actor) so the run survives driver death; a driver that comes
+back with the same run name re-attaches to the live controller.
 """
 
 from __future__ import annotations
@@ -186,3 +187,90 @@ class TrainController:
             error=error,
             best_checkpoints=self._checkpoints.best_checkpoints,
         )
+
+
+class DetachedControllerRunner:
+    """Actor hosting a TrainController so the run survives driver death.
+
+    Reference: the v2 TrainController is spawned as a detached actor
+    (data_parallel_trainer.py:268) and the driver merely polls it. Named actors
+    in this runtime are not fate-shared with the driver, so the run continues if
+    the driver disappears; a new driver re-attaches by run name.
+    """
+
+    def __init__(self, kwargs_blob: bytes):
+        import cloudpickle
+        import threading
+
+        self._controller = TrainController(**cloudpickle.loads(kwargs_blob))
+        self._result: Result | None = None
+        self._run_error: str | None = None
+        self._started = False
+        self._start_lock = threading.Lock()
+        self._done = threading.Event()
+
+    def start(self) -> bool:
+        with self._start_lock:  # concurrent attachers must not double-start
+            if self._started:
+                return False  # already running (re-attach)
+            self._started = True
+        import threading
+
+        def run():
+            try:
+                self._result = self._controller.run()
+            except BaseException:
+                import traceback
+
+                self._run_error = traceback.format_exc()
+            finally:
+                self._done.set()
+
+        threading.Thread(target=run, daemon=True, name="train-controller").start()
+        return True
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def result_blob(self) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps((self._result, self._run_error))
+
+
+def run_controller_detached(kwargs: dict, run_name: str, poll_interval_s: float = 0.5) -> Result:
+    """Start (or re-attach to) a detached controller actor and block for its Result."""
+    import cloudpickle
+
+    import ray_tpu
+
+    blob = cloudpickle.dumps(kwargs)
+    runner_cls = ray_tpu.remote(num_cpus=0)(DetachedControllerRunner)
+    actor = runner_cls.options(
+        name=f"TRAIN_CONTROLLER:{run_name}",
+        namespace="_train",
+        get_if_exists=True,
+        max_concurrency=8,
+    ).remote(blob)
+    ray_tpu.get(actor.start.remote())
+    while True:
+        # Transient slowness (loaded node, GCS restart) must not abort the poll:
+        # killing a live run over a slow reply would defeat detaching. Only a
+        # dead CONTROLLER (ActorDiedError from the get) escapes the loop.
+        try:
+            if ray_tpu.get(actor.is_done.remote(), timeout=60):
+                break
+        except ray_tpu.exceptions.GetTimeoutError:
+            continue
+        time.sleep(poll_interval_s)
+    result, run_error = cloudpickle.loads(ray_tpu.get(actor.result_blob.remote()))
+    # The run is complete and its Result is in hand: release the actor so the
+    # name can be reused. A driver killed mid-poll never reaches this, leaving
+    # the controller alive — that is the point of detaching.
+    try:
+        ray_tpu.kill(actor)
+    except Exception:
+        pass
+    if result is None:
+        raise TrainingFailedError(f"controller crashed:\n{run_error}")
+    return result

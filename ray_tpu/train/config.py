@@ -105,6 +105,11 @@ class RunConfig:
     # Tune: stop condition — {"metric": threshold} (stop when reached) or
     # callable(trial_id, result) -> bool. Parity: air RunConfig.stop.
     stop: Optional[Any] = None
+    # Run the controller as a detached named actor so the run survives driver
+    # death (reference: v2 TrainController detached actor). None = auto: detach
+    # when fit() is called from a driver, run in-process when already inside an
+    # actor/worker (e.g. a Tune trial, which is driver-independent anyway).
+    detach_controller: Optional[bool] = None
 
     def __post_init__(self):
         if self.storage_path is None:

@@ -52,8 +52,11 @@ class DataParallelTrainer:
         )
 
     def fit(self) -> Result:
+        import ray_tpu
+        from ray_tpu.train._internal.controller import run_controller_detached
+
         backend = self._backend_config.backend_cls()()
-        controller = TrainController(
+        kwargs = dict(
             train_fn=self._train_loop,
             train_fn_config=self._train_loop_config,
             scaling_config=self.scaling_config,
@@ -62,7 +65,21 @@ class DataParallelTrainer:
             backend_config=self._backend_config,
             datasets=self._datasets,
         )
-        result = controller.run()
+        detach = self.run_config.detach_controller
+        if detach is None:
+            # Auto: detach only for NAMED, driver-initiated runs. Re-attach — the
+            # payoff of detaching — needs a name the user knows; and a fit()
+            # already inside an actor (e.g. a Tune trial) is driver-independent,
+            # so nesting another actor would only add spawn latency.
+            w = ray_tpu.global_worker_or_none()
+            detach = (
+                w is not None and w.mode == "driver" and self.run_config.name is not None
+            )
+        if detach:
+            run_name = self.run_config.name or f"train_{int(__import__('time').time() * 1000)}"
+            result = run_controller_detached(kwargs, run_name)
+        else:
+            result = TrainController(**kwargs).run()
         if result.error is not None:
             raise result.error
         return result

@@ -192,3 +192,4 @@ def test_scaling_config_tpu_topology_bundles():
     assert bundles[0]["TPU"] == 4.0
     assert "TPU-v4-16-head" not in bundles[1]
     assert sc.pg_strategy == "SPREAD"
+

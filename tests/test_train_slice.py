@@ -6,7 +6,7 @@ slice by granting CPU nodes TPU/TPU-<pod>-head resources.
 
 import ray_tpu
 from ray_tpu import train
-from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
 
 
 def test_jax_trainer_on_fake_tpu_slice(ray_start_cluster):
@@ -31,6 +31,9 @@ def test_jax_trainer_on_fake_tpu_slice(ray_start_cluster):
         loop,
         jax_config=train.JaxConfig(distributed=False),
         scaling_config=ScalingConfig(topology="v4-16"),
-        run_config=RunConfig(name="slice", storage_path="/tmp/rtpu_slice_test"),
+        # max_failures: a worker lost to spawn-storm load on the shared CI host
+        # restarts the group from checkpoint — the recovery path under test.
+        run_config=RunConfig(name="slice", storage_path="/tmp/rtpu_slice_test",
+                             failure_config=FailureConfig(max_failures=2)),
     ).fit()
     assert result.metrics["world"] == 2
