@@ -7,8 +7,11 @@ EnvRunnerGroup of CPU sampling actors, flax RLModule, jitted Learner/LearnerGrou
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, MARWIL, BCConfig, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
     Columns,
@@ -22,9 +25,18 @@ from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
     "Columns",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "MARWIL",
+    "MARWILConfig",
+    "SAC",
+    "SACConfig",
+    "SACModule",
     "DefaultActorCriticModule",
     "EnvRunnerGroup",
     "Learner",

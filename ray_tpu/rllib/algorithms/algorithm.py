@@ -30,9 +30,9 @@ class Algorithm:
         self._total_timesteps = 0
         env_fn = config.env_creator()
         probe = env_fn()
-        self._module = build_default_module(
+        self._module = self._build_module(
             probe.observation_space, probe.action_space,
-            hiddens=tuple(config.model.get("hiddens", (64, 64))),
+            tuple(config.model.get("hiddens", (64, 64))),
         )
         probe.close()
         module_blob = cloudpickle.dumps(self._module)
@@ -52,6 +52,12 @@ class Algorithm:
         self._ret_history: list = []
 
     # -- SPI ---------------------------------------------------------------
+    def _build_module(self, observation_space, action_space, hiddens):
+        """Build the RLModule for this algorithm (default: MLP actor-critic;
+        algorithms with bespoke architectures — e.g. SAC's twin critics —
+        override)."""
+        return build_default_module(observation_space, action_space, hiddens=hiddens)
+
     def loss_fn(self):
         """Return a pure fn(module, params, batch) -> (loss, metrics-dict)."""
         raise NotImplementedError
@@ -61,10 +67,13 @@ class Algorithm:
         raise NotImplementedError
 
     # -- train loop --------------------------------------------------------
-    def _sample_fragments(self):
+    def _sample_fragments(self, sync_weights: bool = True):
         """Shared sampling scaffold: sync weights, fan out sampling, gather
-        fragments + episode stats. Subclass train() loops build on this."""
-        self.env_runner_group.sync_weights(self.learner_group.get_params())
+        fragments + episode stats. Subclass train() loops build on this;
+        sync_weights=False lets off-policy samplers act with stale weights
+        (IMPALA's broadcast_interval)."""
+        if sync_weights:
+            self.env_runner_group.sync_weights(self.learner_group.get_params())
         per_runner = max(
             1, self.config.train_batch_size // max(1, len(self.env_runner_group))
         )

@@ -1,0 +1,153 @@
+"""BC: behavior cloning from offline data (and its MARWIL generalization).
+
+Design parity: reference `rllib/algorithms/bc/` (BCConfig over offline data; the BC
+loss is `-mean(logp(expert_action))`) and `rllib/algorithms/marwil/` (advantage-
+weighted clone: `-mean(exp(beta * adv) * logp)`, beta=0 degenerates to BC). Offline
+input: a callable yielding column batches, a list of batches, or a ray_tpu.data
+Dataset of {obs, actions[, advantages]} rows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.beta: float = 0.0          # MARWIL exponent; 0 = pure BC
+        self.offline_data = None        # callable | list[batch] | data.Dataset
+        self.lr = 1e-3
+        self.train_batch_size = 2000
+        self.minibatch_size = 256
+        self.num_epochs = 1
+        self.num_env_runners = 0        # offline: no sampling actors needed
+
+    def offline(self, data) -> "BCConfig":
+        self.offline_data = data
+        return self
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = MARWIL  # build_algo reads the underscored attribute
+        self.beta = 1.0
+
+
+def _bc_loss_factory(beta: float):
+    def bc_loss(module, params, batch):
+        import jax.numpy as jnp
+
+        out = module.forward_train(params, batch)
+        logp = module.dist_logp(out[Columns.ACTION_DIST_INPUTS], batch[Columns.ACTIONS])
+        if beta > 0.0 and Columns.ADVANTAGES in batch:
+            weights = jnp.exp(beta * batch[Columns.ADVANTAGES])
+            weights = jnp.clip(weights, 0.0, 20.0)  # reference clips the exp weight
+        else:
+            weights = jnp.ones_like(logp)
+        loss = -jnp.mean(weights * logp)
+        return loss, {"bc_logp_mean": jnp.mean(logp), "weight_mean": jnp.mean(weights)}
+
+    return bc_loss
+
+
+class BC(Algorithm):
+    """Offline: train() consumes offline batches; no env sampling."""
+
+    def __init__(self, config):
+        if config.offline_data is None:
+            raise ValueError("BC requires config.offline_data (batches of obs/actions)")
+        super().__init__(config)
+        self._data_iter: Optional[Iterator] = None
+
+    def loss_fn(self):
+        return _bc_loss_factory(self.config.beta)
+
+    def _next_batch(self) -> Dict[str, np.ndarray]:
+        data = self.config.offline_data
+        if callable(data):
+            return data()
+        if hasattr(data, "iter_batches"):  # ray_tpu.data Dataset
+            if self._data_iter is None:
+                self._data_iter = iter(data.iter_batches(
+                    batch_size=self.config.train_batch_size
+                ))
+            try:
+                return next(self._data_iter)
+            except StopIteration:
+                self._data_iter = iter(data.iter_batches(
+                    batch_size=self.config.train_batch_size
+                ))
+                return next(self._data_iter)
+        # list of batches: round-robin
+        return data[(self.iteration - 1) % len(data)]
+
+    def postprocess(self, fragments: List[dict]):  # pragma: no cover - offline only
+        raise NotImplementedError("BC is offline; it does not postprocess rollouts")
+
+    def train(self) -> Dict:
+        import time as _time
+
+        t0 = _time.time()
+        self.iteration += 1
+        c = self.config
+        batch = {k: np.asarray(v) for k, v in self._next_batch().items()}
+        n = len(batch[Columns.OBS])
+        self._total_timesteps += n
+        rng = np.random.default_rng(self.iteration)
+        mb = min(c.minibatch_size, n)
+        learner_metrics: Dict[str, float] = {}
+        for _ in range(c.num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start : start + mb]
+                learner_metrics = self.learner_group.update(
+                    {k: v[idx] for k, v in batch.items()}
+                )
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_trained_lifetime": self._total_timesteps,
+            "time_this_iter_s": _time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict:
+        """Greedy rollouts with the cloned policy (reference: Algorithm.evaluate)."""
+        import jax
+
+        env = self.config.env_creator()()
+        params = self.learner_group.get_params()
+        rets = []
+        try:
+            for ep in range(num_episodes):
+                obs, _ = env.reset(seed=1000 + ep)
+                done = trunc = False
+                total = 0.0
+                while not (done or trunc):
+                    out = self._module.forward_inference(
+                        params, {Columns.OBS: obs[None]}
+                    )
+                    dist_in = np.asarray(out[Columns.ACTION_DIST_INPUTS])[0]
+                    if self._module.discrete:
+                        action = int(np.argmax(dist_in))
+                    else:
+                        action = np.asarray(
+                            self._module.dist_sample(dist_in, jax.random.PRNGKey(0))
+                        )
+                    obs, reward, done, trunc, _ = env.step(action)
+                    total += float(reward)
+                rets.append(total)
+        finally:
+            env.close()
+        return {"evaluation/episode_return_mean": float(np.mean(rets))}
+
+
+class MARWIL(BC):
+    """Advantage-weighted BC (reference rllib/algorithms/marwil)."""

@@ -63,6 +63,32 @@ class ReplayBuffer:
         return self._size
 
 
+def flatten_transitions(fragments: List[dict]) -> Dict[str, np.ndarray]:
+    """Episode fragments -> flat (obs, action, reward, next_obs, done) columns,
+    shared by the off-policy replay algorithms (DQN, SAC).
+
+    The runner records the true successor of the final transition
+    (final_next_obs); a self-successor fallback would make Q bootstrap off its
+    own state."""
+    cols = {"obs": [], "actions": [], "rewards": [], "next_obs": [], "dones": []}
+    for frag in fragments:
+        obs = frag[Columns.OBS]
+        n = len(obs)
+        if n == 0:
+            continue
+        final = frag.get("final_next_obs", obs[-1])
+        next_obs = np.vstack([obs[1:], final[None]])
+        dones = np.zeros(n, np.float32)
+        if frag.get("terminated"):
+            dones[-1] = 1.0
+        cols["obs"].append(obs)
+        cols["actions"].append(frag[Columns.ACTIONS])
+        cols["rewards"].append(frag[Columns.REWARDS])
+        cols["next_obs"].append(next_obs)
+        cols["dones"].append(dones)
+    return {k: np.concatenate(v) for k, v in cols.items()}
+
+
 def _dqn_loss_factory(gamma: float, double_q: bool):
     def dqn_loss(module, params, batch):
         import jax
@@ -130,26 +156,7 @@ class DQN(Algorithm):
         return c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial)
 
     def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
-        """Flatten fragments into (obs, action, reward, next_obs, done) tuples."""
-        cols = {"obs": [], "actions": [], "rewards": [], "next_obs": [], "dones": []}
-        for frag in fragments:
-            obs = frag[Columns.OBS]
-            n = len(obs)
-            if n == 0:
-                continue
-            # The runner records the true successor of the final transition; a
-            # self-successor fallback would make Q bootstrap off its own state.
-            final = frag.get("final_next_obs", obs[-1])
-            next_obs = np.vstack([obs[1:], final[None]])
-            dones = np.zeros(n, np.float32)
-            if frag.get("terminated"):
-                dones[-1] = 1.0
-            cols["obs"].append(obs)
-            cols["actions"].append(frag[Columns.ACTIONS])
-            cols["rewards"].append(frag[Columns.REWARDS])
-            cols["next_obs"].append(next_obs)
-            cols["dones"].append(dones)
-        return {k: np.concatenate(v) for k, v in cols.items()}
+        return flatten_transitions(fragments)
 
     def train(self) -> Dict:
         import time as _time

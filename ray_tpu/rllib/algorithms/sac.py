@@ -1,0 +1,325 @@
+"""SAC: soft actor-critic for continuous control.
+
+Design parity: reference `rllib/algorithms/sac/` (SACConfig defaults, twin Q networks,
+squashed-Gaussian policy, entropy temperature alpha with auto target tuning, polyak
+target updates `tau`, replay-driven updates) on the same new-stack SPI as PPO/DQN —
+CPU env runners sample stochastic tanh-squashed actions; the jitted Learner runs the
+combined policy/critic/alpha update with per-component stop-gradients (the reference
+uses three optimizers; one Adam over a partitioned loss is equivalent here because
+each sub-loss only sees its own parameters).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.core.rl_module import Columns, RLModule
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.replay_buffer_capacity: int = 100_000
+        self.learning_starts: int = 1000
+        self.tau: float = 0.005               # polyak coefficient for target nets
+        self.target_entropy: str | float = "auto"  # auto = -action_dim
+        self.initial_alpha: float = 1.0
+        self.n_updates_per_iter: int = 20
+        self.lr = 3e-4
+        self.train_batch_size = 1000          # env steps sampled per iteration
+        self.minibatch_size = 256             # replay samples per SGD update
+        self.gamma = 0.99
+        self.model = {"hiddens": (256, 256)}  # reference SAC network defaults
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian policy + twin Q critics + learnable log_alpha.
+
+    Params pytree: {"policy", "q1", "q2", "log_alpha"} — the loss cuts gradients
+    between components with stop_gradient over the foreign sub-trees.
+    """
+
+    def __init__(self, obs_dim: int, action_dim: int, hiddens=(256, 256),
+                 initial_alpha: float = 1.0, action_low=None, action_high=None):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.discrete = False
+        self._initial_log_alpha = float(np.log(max(initial_alpha, 1e-8)))
+        # Affine rescale from the tanh range [-1, 1] to the env's Box bounds.
+        low = np.full(action_dim, -1.0) if action_low is None else np.asarray(action_low)
+        high = np.full(action_dim, 1.0) if action_high is None else np.asarray(action_high)
+        self._a_mid = ((high + low) / 2.0).astype(np.float32)
+        self._a_scale = ((high - low) / 2.0).astype(np.float32)
+
+        class _Policy(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = obs.astype(jnp.float32)
+                for h in hiddens:
+                    x = nn.relu(nn.Dense(h)(x))
+                mean = nn.Dense(action_dim)(x)
+                log_std = jnp.clip(
+                    nn.Dense(action_dim)(x), _LOG_STD_MIN, _LOG_STD_MAX
+                )
+                return jnp.concatenate([mean, log_std], axis=-1)
+
+        class _Q(nn.Module):
+            @nn.compact
+            def __call__(self, obs, action):
+                x = jnp.concatenate(
+                    [obs.astype(jnp.float32), action.astype(jnp.float32)], axis=-1
+                )
+                for h in hiddens:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(1)(x)[..., 0]
+
+        self._policy = _Policy()
+        self._q = _Q()
+
+    def init_params(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        act = jnp.zeros((1, self.action_dim), jnp.float32)
+        return {
+            "policy": self._policy.init(k1, obs),
+            "q1": self._q.init(k2, obs, act),
+            "q2": self._q.init(k3, obs, act),
+            "log_alpha": jnp.asarray(self._initial_log_alpha),
+        }
+
+    # -- runner-facing SPI --------------------------------------------------
+    def forward_inference(self, params, batch):
+        dist_in = self._policy.apply(params["policy"], batch[Columns.OBS])
+        import jax.numpy as jnp
+
+        # VF_PREDS is unused by SAC's postprocess but the runner records it.
+        return {
+            Columns.ACTION_DIST_INPUTS: dist_in,
+            Columns.VF_PREDS: jnp.zeros(dist_in.shape[:-1]),
+        }
+
+    def dist_sample(self, dist_inputs, rng):
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+        pre = mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+        return self._a_mid + self._a_scale * jnp.tanh(pre)
+
+    def dist_logp(self, dist_inputs, actions):
+        import jax.numpy as jnp
+
+        mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+        unit = (actions - self._a_mid) / self._a_scale  # back to the tanh range
+        # atanh of the squashed action recovers the pre-squash gaussian sample.
+        pre = jnp.arctanh(jnp.clip(unit, -1 + 1e-6, 1 - 1e-6))
+        var = jnp.exp(2 * log_std)
+        base = (
+            -0.5 * jnp.sum((pre - mean) ** 2 / var, axis=-1)
+            - jnp.sum(log_std, axis=-1)
+            - 0.5 * mean.shape[-1] * np.log(2 * np.pi)
+        )
+        # tanh + affine change-of-variables correction.
+        corr = jnp.sum(
+            jnp.log(1 - unit**2 + 1e-6) + np.log(self._a_scale), axis=-1
+        )
+        return base - corr
+
+    def dist_entropy(self, dist_inputs):
+        import jax.numpy as jnp
+
+        _mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    # -- loss-facing helpers -------------------------------------------------
+    def sample_with_logp(self, policy_params, obs, rng):
+        import jax
+        import jax.numpy as jnp
+
+        dist_in = self._policy.apply(policy_params, obs)
+        mean, log_std = jnp.split(dist_in, 2, axis=-1)
+        pre = mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+        unit = jnp.tanh(pre)
+        action = self._a_mid + self._a_scale * unit
+        var = jnp.exp(2 * log_std)
+        base = (
+            -0.5 * jnp.sum((pre - mean) ** 2 / var, axis=-1)
+            - jnp.sum(log_std, axis=-1)
+            - 0.5 * mean.shape[-1] * np.log(2 * np.pi)
+        )
+        corr = jnp.sum(
+            jnp.log(1 - unit**2 + 1e-6) + np.log(self._a_scale), axis=-1
+        )
+        return action, base - corr
+
+    def q_values(self, q1_params, q2_params, obs, action):
+        return (
+            self._q.apply(q1_params, obs, action),
+            self._q.apply(q2_params, obs, action),
+        )
+
+
+def _sac_loss_factory(gamma: float, target_entropy: float):
+    def sac_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        sg = jax.lax.stop_gradient
+        obs = batch[Columns.OBS]
+        actions = batch[Columns.ACTIONS]
+        rewards = batch[Columns.REWARDS]
+        next_obs = batch["next_obs"]
+        dones = batch["dones"]
+        target = batch["target_params"]  # frozen critic targets (DQN pattern)
+        rng = jax.random.PRNGKey(batch["rng_seed"][0].astype(jnp.int32))
+        k_next, k_pi = jax.random.split(rng)
+        alpha = jnp.exp(params["log_alpha"])
+
+        # --- critic loss: bootstrapped soft target from the target critics.
+        next_a, next_logp = module.sample_with_logp(sg(params["policy"]), next_obs, k_next)
+        tq1, tq2 = module.q_values(target["q1"], target["q2"], next_obs, next_a)
+        soft_next = jnp.minimum(tq1, tq2) - sg(alpha) * next_logp
+        q_target = sg(rewards + gamma * (1.0 - dones) * soft_next)
+        q1, q2 = module.q_values(params["q1"], params["q2"], obs, actions)
+        critic_loss = jnp.mean((q1 - q_target) ** 2) + jnp.mean((q2 - q_target) ** 2)
+
+        # --- policy loss: reparametrized actions through DETACHED critics.
+        pi_a, pi_logp = module.sample_with_logp(params["policy"], obs, k_pi)
+        pq1, pq2 = module.q_values(sg(params["q1"]), sg(params["q2"]), obs, pi_a)
+        policy_loss = jnp.mean(sg(alpha) * pi_logp - jnp.minimum(pq1, pq2))
+
+        # --- temperature loss: drive entropy toward the target.
+        alpha_loss = -jnp.mean(
+            params["log_alpha"] * sg(pi_logp + target_entropy)
+        )
+
+        total = critic_loss + policy_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "policy_loss": policy_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "q1_mean": jnp.mean(q1),
+            "entropy_estimate": -jnp.mean(pi_logp),
+        }
+
+    return sac_loss
+
+
+class SAC(Algorithm):
+    def __init__(self, config):
+        import gymnasium as gym
+
+        if config.use_mesh:
+            raise NotImplementedError(
+                "SAC's target params ride inside the training batch; use_mesh=False"
+            )
+        probe = config.env_creator()()
+        try:
+            if not isinstance(probe.action_space, gym.spaces.Box):
+                raise ValueError(
+                    f"SAC requires a Box action space, got "
+                    f"{type(probe.action_space).__name__}"
+                )
+            self._action_dim = int(np.prod(probe.action_space.shape))
+        finally:
+            probe.close()
+        if config.target_entropy == "auto":
+            config.target_entropy = -float(self._action_dim)
+        super().__init__(config)
+        self._replay = ReplayBuffer(config.replay_buffer_capacity)
+        self._np_rng = np.random.default_rng(config.seed or 0)
+        full = self.learner_group.get_params()
+        self._target_params = {"q1": full["q1"], "q2": full["q2"]}
+
+    def _build_module(self, observation_space, action_space, hiddens):
+        obs_dim = int(np.prod(observation_space.shape))
+        return SACModule(obs_dim, int(np.prod(action_space.shape)),
+                         hiddens=hiddens,
+                         initial_alpha=self.config.initial_alpha,
+                         action_low=action_space.low.reshape(-1),
+                         action_high=action_space.high.reshape(-1))
+
+    def loss_fn(self):
+        c = self.config
+        return _sac_loss_factory(c.gamma, float(c.target_entropy))
+
+    def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
+        from ray_tpu.rllib.algorithms.dqn import flatten_transitions
+
+        batch = flatten_transitions(fragments)
+        return {k: v.astype(np.float32) for k, v in batch.items()}
+
+    def _polyak(self):
+        tau = self.config.tau
+        online = self.learner_group.get_params()
+        import jax
+
+        self._target_params = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - tau) * t + tau * o,
+            self._target_params,
+            {"q1": online["q1"], "q2": online["q2"]},
+        )
+
+    def train(self) -> Dict:
+        import time as _time
+
+        t0 = _time.time()
+        self.iteration += 1
+        c = self.config
+        fragments, returns, lens = self._sample_fragments()
+        if fragments:
+            batch = self.postprocess(fragments)
+            self._total_timesteps += len(batch["obs"])
+            self._replay.add_batch(batch)
+        learner_metrics: Dict[str, float] = {}
+        if len(self._replay) >= c.learning_starts:
+            for u in range(c.n_updates_per_iter):
+                sample = self._replay.sample(c.minibatch_size, self._np_rng)
+                sample["target_params"] = self._target_params
+                sample["rng_seed"] = np.array(
+                    [self.iteration * 1000 + u], np.int32
+                )
+                learner_metrics = self.learner_group.update(sample)
+                self._polyak()
+        self._record_returns(returns)
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_timesteps,
+            "episode_return_mean": self._return_mean(),
+            "episode_len_mean": float(np.mean(lens)) if len(lens) else float("nan"),
+            "episodes_this_iter": int(len(returns)),
+            "replay_size": len(self._replay),
+            "time_this_iter_s": _time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def save_to_path(self, path: str) -> str:
+        out = super().save_to_path(path)
+        import os
+        import pickle
+
+        with open(os.path.join(path, "sac_state.pkl"), "wb") as f:
+            pickle.dump({"target_params": self._target_params}, f)
+        return out
+
+    def restore_from_path(self, path: str):
+        super().restore_from_path(path)
+        import os
+        import pickle
+
+        with open(os.path.join(path, "sac_state.pkl"), "rb") as f:
+            self._target_params = pickle.load(f)["target_params"]

@@ -196,6 +196,11 @@ class DetachedControllerRunner:
     (data_parallel_trainer.py:268) and the driver merely polls it. Named actors
     in this runtime are not fate-shared with the driver, so the run continues if
     the driver disappears; a new driver re-attaches by run name.
+
+    Name-reuse caveat: if a driver dies in the window between run completion and
+    result harvest, the finished actor persists; the NEXT fit() with the same run
+    name harvests that earlier run's Result (and frees the name) instead of
+    training — run names identify experiments, reuse them only for re-attach.
     """
 
     def __init__(self, kwargs_blob: bytes):

@@ -287,3 +287,141 @@ def test_dqn_checkpoint_roundtrip(tmp_path):
             algo2.stop()
     finally:
         algo.stop()
+
+
+class _ContinuousBanditEnv:
+    """One-step continuous env: reward = -(a - 0.5)^2. SAC should steer the
+    squashed-gaussian policy mean toward 0.5."""
+
+    def __init__(self, *_a, **_k):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._obs = np.array([0.3, -0.7], np.float32)
+
+    def reset(self, *, seed=None, options=None):
+        return self._obs, {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1, 1))
+        reward = -((a - 0.5) ** 2)
+        return self._obs, reward, True, False, {}
+
+    def close(self):
+        pass
+
+
+def test_sac_learns_continuous_bandit():
+    from ray_tpu.rllib import SACConfig
+
+    config = (
+        SACConfig()
+        .environment(lambda cfg: _ContinuousBanditEnv())
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .training(
+            train_batch_size=256, minibatch_size=128, lr=3e-3,
+            learning_starts=200, n_updates_per_iter=40, tau=0.02, initial_alpha=0.1,
+        )
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(8):
+            last = algo.train()
+        assert np.isfinite(last["learner/critic_loss"])
+        assert last["learner/alpha"] > 0.0
+        # Optimal reward is 0 (action 0.5); random-ish is around -0.5.
+        assert last["episode_return_mean"] > -0.15, last["episode_return_mean"]
+    finally:
+        algo.stop()
+
+
+def test_impala_vtrace_math():
+    """V-trace targets with rho=c=1 and on-policy logp reduce to n-step returns."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import _impala_loss_factory
+    from ray_tpu.rllib.core.rl_module import DefaultActorCriticModule
+
+    m = DefaultActorCriticModule(obs_dim=2, action_dim=2, discrete=True)
+    params = m.init_params(jax.random.PRNGKey(0))
+    loss = _impala_loss_factory(1.0, 1.0, 0.5, 0.0, 0.9)
+    B, T = 2, 4
+    obs = np.zeros((B, T, 2), np.float32)
+    batch = {
+        Columns.OBS: jnp.asarray(obs),
+        Columns.ACTIONS: jnp.zeros((B, T), jnp.int32),
+        Columns.REWARDS: jnp.ones((B, T), jnp.float32),
+        "dones": jnp.zeros((B, T), jnp.float32),
+        "mask": jnp.ones((B, T), jnp.float32),
+        "bootstrap_value": jnp.zeros((B,), jnp.float32),
+    }
+    # Behavior logp == target logp -> rho = 1 (on-policy): vs must equal the
+    # discounted n-step return of the constant-reward sequence.
+    out = m.forward_inference(params, {Columns.OBS: obs.reshape(B * T, 2)})
+    logp = m.dist_logp(
+        out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1), batch[Columns.ACTIONS]
+    )
+    batch[Columns.ACTION_LOGP] = logp
+    total, metrics = loss(m, params, batch)
+    assert np.isfinite(float(total))
+    # n-step return for T=4, gamma=.9, r=1, v_T=0: 1+.9+.81+.729 at t=0
+    expected_t0 = 1 + 0.9 + 0.81 + 0.729
+    # vtrace_mean averages vs over all t; just sanity-bound it
+    assert 0.9 < float(metrics["vtrace_mean"]) < expected_t0 + 0.1
+
+
+def test_impala_learns_bandit():
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (
+        IMPALAConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .training(train_batch_size=256, lr=0.02, entropy_coeff=0.003,
+                  rollout_fragment_length=8, broadcast_interval=2)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(10):
+            last = algo.train()
+        assert np.isfinite(last["learner/policy_loss"])
+        assert last["episode_return_mean"] > max(0.75, first["episode_return_mean"])
+    finally:
+        algo.stop()
+
+
+def test_bc_clones_expert():
+    from ray_tpu.rllib import BCConfig
+    from ray_tpu.rllib.core.rl_module import Columns as C
+
+    # Expert for _BanditEnv: action = 1 iff obs[0] > 0.
+    rng = np.random.default_rng(0)
+    signs = rng.choice([-1.0, 1.0], size=2000)
+    obs = np.stack([signs, np.ones(2000)], axis=1).astype(np.float32)
+    actions = (signs > 0).astype(np.int64)
+    data = [{C.OBS: obs, C.ACTIONS: actions}]
+
+    config = (
+        BCConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .training(train_batch_size=2000, minibatch_size=256, num_epochs=3, lr=5e-3)
+        .debugging(seed=0)
+    )
+    config.offline(data)
+    algo = config.build_algo()
+    try:
+        for _ in range(5):
+            metrics = algo.train()
+        assert metrics["learner/bc_logp_mean"] > -0.2  # near-deterministic clone
+        ev = algo.evaluate(num_episodes=10)
+        assert ev["evaluation/episode_return_mean"] > 0.9
+    finally:
+        algo.stop()
