@@ -1,0 +1,217 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the `pp` mesh axis.
+
+The reference provides pipeline parallelism only through vLLM/compiled-graph actor
+pipelines (reference: python/ray/dag/ per-actor exec loops; vllm_models.py:219
+pipeline_parallel_size pass-through). TPU-native, the pipeline is a single SPMD
+program: layers are stacked on a leading dim and sharded over `pp` (each stage holds
+L/S layers), microbatched activations circulate stage-to-stage with `lax.ppermute`,
+and the whole forward — scan over (num_microbatches + S - 1) pipeline ticks — is
+differentiable, so jax.grad produces the backward pipeline (reversed ppermutes) with
+gradients accumulated across microbatches automatically.
+
+Schedule: plain GPipe fill-drain. The bubble fraction is (S-1)/(M+S-1); pick
+num_microbatches >= ~4x the stage count. Known inefficiency (documented, v1): the
+head/loss computation runs on every stage each tick and is masked, not skipped —
+negligible for LM heads on small stage counts, an optimization target later.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 top-level; fall back to the experimental location
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+class PipelineState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _check_mesh(mesh: Mesh):
+    for name, size in mesh.shape.items():
+        if name not in ("pp", "dp") and size != 1:
+            raise ValueError(
+                f"pipeline v1 composes pp with dp only; mesh axis {name!r} has "
+                f"size {size} (fold tp/sp into later rounds)"
+            )
+    if mesh.shape["pp"] < 2:
+        raise ValueError("pipeline needs a pp axis of size >= 2")
+
+
+def build_pipeline_loss(
+    embed_fn: Callable,
+    layer_fn: Callable,
+    head_loss_fn: Callable,
+    mesh: Mesh,
+    num_microbatches: int,
+):
+    """Build `loss(params, tokens, targets) -> scalar`, pipelined over `pp`.
+
+    params: {"embed": pytree, "layers": pytree with layers STACKED on dim 0
+    (length divisible by pp), "head": pytree}.
+    embed_fn(embed_params, tokens[b, T]) -> x[b, T, E]
+    layer_fn(one_layer_params, x) -> x
+    head_loss_fn(head_params, x, targets[b, T]) -> scalar mean loss
+    """
+    _check_mesh(mesh)
+    S = mesh.shape["pp"]
+    M = num_microbatches
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def staged_loss(params, tokens, targets):
+        stage = lax.axis_index("pp")
+        b = tokens.shape[0]
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by num_microbatches {M}")
+        mb_tokens = tokens.reshape(M, b // M, *tokens.shape[1:])
+        mb_targets = targets.reshape(M, b // M, *targets.shape[1:])
+        # Embeddings for every microbatch (used at stage 0 only; masked elsewhere).
+        embeds = jax.vmap(lambda t: embed_fn(params["embed"], t))(mb_tokens)
+
+        def local_apply(x):
+            def body(c, layer_params):
+                return layer_fn(layer_params, c), None
+
+            x, _ = lax.scan(body, x, params["layers"])
+            return x
+
+        def tick(carry, t):
+            prev, loss_acc = carry
+            recv = lax.ppermute(prev, "pp", perm)
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x_in = jnp.where(stage == 0, embeds[mb_idx], recv)
+            out = local_apply(x_in)
+            collect = t - (S - 1)
+            cidx = jnp.clip(collect, 0, M - 1)
+            mb_loss = head_loss_fn(params["head"], out, mb_targets[cidx])
+            use = jnp.logical_and(
+                stage == S - 1, jnp.logical_and(collect >= 0, collect < M)
+            )
+            return (out, loss_acc + jnp.where(use, mb_loss, 0.0)), None
+
+        # The scan carry becomes varying across pp (stage-dependent layers and
+        # ppermute) and dp (sharded data); the initial carry must carry the same
+        # varying-manner type or shard_map's typed scan rejects it.
+        vary = tuple(a for a in ("pp", "dp") if mesh.shape[a] > 1)
+
+        def ensure_vary(x):
+            have = getattr(jax.typeof(x), "vma", frozenset())
+            missing = tuple(a for a in vary if a not in have)
+            if not missing:
+                return x
+            if hasattr(lax, "pcast"):  # pvary's replacement in newer jax
+                return lax.pcast(x, missing, to="varying")
+            return lax.pvary(x, missing)
+
+        x0 = ensure_vary(jnp.zeros_like(embeds[0]))
+        loss0 = ensure_vary(jnp.zeros(()))
+        (_, loss_sum), _ = lax.scan(tick, (x0, loss0), jnp.arange(M + S - 1))
+        # Only the last stage accumulated loss; share it with every pp rank, then
+        # average the per-dp-shard means into the global mean.
+        total = lax.psum(loss_sum, "pp") / M
+        if mesh.shape["dp"] > 1:
+            total = lax.pmean(total, "dp")
+        return total
+
+    param_specs = {
+        "embed": P(),
+        "layers": P("pp"),
+        "head": P(),
+    }
+    data_spec = P(("dp",)) if mesh.shape["dp"] > 1 else P()
+    sharded = shard_map(
+        staged_loss,
+        mesh=mesh,
+        in_specs=(param_specs, data_spec, data_spec),
+        out_specs=P(),
+    )
+
+    def loss(params, tokens, targets):
+        return sharded(params, tokens, targets)
+
+    return loss
+
+
+def place_pipeline_params(params, mesh: Mesh):
+    """Device-put pipeline params: layer stack split over pp, the rest replicated."""
+
+    def put(path_is_layers, tree):
+        spec = P("pp") if path_is_layers else P()
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree
+        )
+
+    return {
+        "embed": put(False, params["embed"]),
+        "layers": put(True, params["layers"]),
+        "head": put(False, params["head"]),
+    }
+
+
+def build_pipeline_train_step(
+    embed_fn, layer_fn, head_loss_fn, optimizer, mesh: Mesh, num_microbatches: int
+):
+    """Jitted (state, batch{tokens,targets}) -> (state, metrics) over the pipeline."""
+    loss_fn = build_pipeline_loss(
+        embed_fn, layer_fn, head_loss_fn, mesh, num_microbatches
+    )
+
+    def step(state: PipelineState, batch: dict):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch["tokens"], batch["targets"]
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            PipelineState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    batch_spec = P(("dp",)) if mesh.shape["dp"] > 1 else P()
+    batch_shardings = {
+        "tokens": NamedSharding(mesh, batch_spec),
+        "targets": NamedSharding(mesh, batch_spec),
+    }
+    return jax.jit(step, donate_argnums=(0,)), batch_shardings
+
+
+def init_pipeline_state(params, optimizer, mesh: Mesh) -> PipelineState:
+    placed = place_pipeline_params(params, mesh)
+    return PipelineState(
+        step=jnp.zeros((), jnp.int32),
+        params=placed,
+        opt_state=optimizer.init(placed),
+    )
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """GPipe fill/drain overhead: (S-1)/(M+S-1)."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+def sequential_reference_loss(embed_fn, layer_fn, head_loss_fn):
+    """The unpipelined equivalent (for tests: pipeline must match this exactly)."""
+
+    def loss(params, tokens, targets):
+        x = embed_fn(params["embed"], tokens)
+
+        def body(c, layer_params):
+            return layer_fn(layer_params, c), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        return head_loss_fn(params["head"], x, targets)
+
+    return loss

@@ -138,9 +138,8 @@ class BC(Algorithm):
                     if self._module.discrete:
                         action = int(np.argmax(dist_in))
                     else:
-                        action = np.asarray(
-                            self._module.dist_sample(dist_in, jax.random.PRNGKey(0))
-                        )
+                        # Greedy: the distribution mean (first half of dist inputs).
+                        action = dist_in[: dist_in.shape[-1] // 2]
                     obs, reward, done, trunc, _ = env.step(action)
                     total += float(reward)
                 rets.append(total)

@@ -48,6 +48,7 @@ def _impala_loss_factory(rho_clip, c_clip, vf_coeff, ent_coeff, gamma):
         dones = batch["dones"]                      # [B, T] 1.0 at termination
         mask = batch["mask"]                        # [B, T] 1.0 on real steps
         bootstrap = batch["bootstrap_value"]        # [B]
+        last_idx = batch["last_idx"].astype(jnp.int32)  # [B] last REAL step
 
         B, T = actions.shape
         flat = {Columns.OBS: obs.reshape(B * T, -1)}
@@ -64,8 +65,15 @@ def _impala_loss_factory(rho_clip, c_clip, vf_coeff, ent_coeff, gamma):
         c = jnp.minimum(jnp.exp(log_rho), c_clip)
         v = sg(values)
         discounts = gamma * (1.0 - dones)
-        v_next = jnp.concatenate([v[:, 1:], bootstrap[:, None]], axis=1)
-        deltas = rho * (rewards + discounts * v_next - v)
+        # The bootstrap value is the successor of each sequence's LAST REAL step
+        # (sequences shorter than T are zero-padded; placing the bootstrap at
+        # index T-1 would hand real steps the value of padded observations).
+        B_idx = jnp.arange(v.shape[0])
+        v_next = jnp.concatenate([v[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1)
+        v_next = v_next.at[B_idx, last_idx].set(bootstrap)
+        # Masked deltas: padded steps contribute nothing, and nothing from the pad
+        # region chains backward into real steps through the recursion.
+        deltas = rho * (rewards + discounts * v_next - v) * mask
 
         def back(carry, xs):
             delta_t, disc_t, c_t = xs
@@ -80,7 +88,10 @@ def _impala_loss_factory(rho_clip, c_clip, vf_coeff, ent_coeff, gamma):
             reverse=True,
         )
         vs = v + acc.T                                  # [B, T]
-        vs_next = jnp.concatenate([vs[:, 1:], bootstrap[:, None]], axis=1)
+        vs_next = jnp.concatenate(
+            [vs[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+        )
+        vs_next = vs_next.at[B_idx, last_idx].set(bootstrap)
         pg_adv = sg(rho * (rewards + discounts * vs_next - v))
 
         # --- losses over the valid-step mask ------------------------------
@@ -129,6 +140,7 @@ class IMPALA(Algorithm):
         seqs: Dict[str, list] = {
             Columns.OBS: [], Columns.ACTIONS: [], Columns.ACTION_LOGP: [],
             Columns.REWARDS: [], "dones": [], "mask": [], "bootstrap_value": [],
+            "last_idx": [],
         }
         for frag in fragments:
             obs = frag[Columns.OBS]
@@ -162,6 +174,7 @@ class IMPALA(Algorithm):
                 seqs["mask"].append(
                     np.concatenate([np.ones(L, np.float32), np.zeros(pad, np.float32)])
                 )
+                seqs["last_idx"].append(L - 1)
                 # Mid-fragment chunks bootstrap off the next chunk's first value.
                 if is_tail:
                     seqs["bootstrap_value"].append(boot)
@@ -171,8 +184,10 @@ class IMPALA(Algorithm):
             k: np.stack(v).astype(np.float32) if k != Columns.ACTIONS
             else np.stack(v)
             for k, v in seqs.items()
+            if k not in ("bootstrap_value", "last_idx")
         }
         batch["bootstrap_value"] = np.asarray(seqs["bootstrap_value"], np.float32)
+        batch["last_idx"] = np.asarray(seqs["last_idx"], np.int32)
         return batch
 
     def train(self) -> Dict:

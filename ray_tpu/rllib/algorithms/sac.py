@@ -234,6 +234,12 @@ class SAC(Algorithm):
                     f"SAC requires a Box action space, got "
                     f"{type(probe.action_space).__name__}"
                 )
+            if not (np.isfinite(probe.action_space.low).all()
+                    and np.isfinite(probe.action_space.high).all()):
+                raise ValueError(
+                    "SAC requires finite Box action bounds (the tanh policy "
+                    "rescales to them); wrap the env with a finite action range"
+                )
             self._action_dim = int(np.prod(probe.action_space.shape))
         finally:
             probe.close()

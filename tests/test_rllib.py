@@ -359,6 +359,7 @@ def test_impala_vtrace_math():
         "dones": jnp.zeros((B, T), jnp.float32),
         "mask": jnp.ones((B, T), jnp.float32),
         "bootstrap_value": jnp.zeros((B,), jnp.float32),
+        "last_idx": jnp.full((B,), T - 1, jnp.int32),
     }
     # Behavior logp == target logp -> rho = 1 (on-policy): vs must equal the
     # discounted n-step return of the constant-reward sequence.
@@ -425,3 +426,46 @@ def test_bc_clones_expert():
         assert ev["evaluation/episode_return_mean"] > 0.9
     finally:
         algo.stop()
+
+
+def test_impala_vtrace_truncated_tail_uses_bootstrap():
+    """A sequence shorter than T must bootstrap off its LAST REAL step, with the
+    pad region contributing nothing (regression: bootstrap landed on pad index)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import _impala_loss_factory
+    from ray_tpu.rllib.core.rl_module import DefaultActorCriticModule
+
+    m = DefaultActorCriticModule(obs_dim=2, action_dim=2, discrete=True)
+    params = m.init_params(jax.random.PRNGKey(0))
+    gamma = 0.9
+    loss = _impala_loss_factory(1.0, 1.0, 0.5, 0.0, gamma)
+    B, T, L = 1, 6, 3  # 3 real steps, 3 pads
+    obs = np.zeros((B, T, 2), np.float32)
+    mask = np.zeros((B, T), np.float32); mask[:, :L] = 1.0
+    dones = np.zeros((B, T), np.float32); dones[:, L:] = 1.0  # pads marked done
+    bootstrap = 7.0
+    base = {
+        Columns.OBS: jnp.asarray(obs),
+        Columns.ACTIONS: jnp.zeros((B, T), jnp.int32),
+        Columns.REWARDS: jnp.ones((B, T), jnp.float32),
+        "dones": jnp.asarray(dones),
+        "mask": jnp.asarray(mask),
+        "bootstrap_value": jnp.asarray([bootstrap], jnp.float32),
+        "last_idx": jnp.asarray([L - 1], jnp.int32),
+    }
+    out = m.forward_inference(params, {Columns.OBS: obs.reshape(B * T, 2)})
+    logp = m.dist_logp(
+        out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1), base[Columns.ACTIONS]
+    )
+    base[Columns.ACTION_LOGP] = logp  # on-policy: rho = c = 1
+    _, metrics = loss(m, params, base)
+    # With rho=c=1 on-policy, vs_t for real steps is the discounted n-step return
+    # ending in the bootstrap: vs_2 = 1 + g*7, vs_1 = 1 + g*vs_2, vs_0 = 1 + g*vs_1.
+    v_net = float(np.asarray(out[Columns.VF_PREDS])[0])  # same value every obs
+    vs2 = 1 + gamma * bootstrap
+    vs1 = 1 + gamma * vs2
+    vs0 = 1 + gamma * vs1
+    expected_mean = (vs0 + vs1 + vs2) / 3.0
+    np.testing.assert_allclose(float(metrics["vtrace_mean"]), expected_mean, rtol=1e-5)
