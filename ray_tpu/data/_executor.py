@@ -39,7 +39,9 @@ class RefBundle:
 # module-level function so the function-table export is cached across submissions.
 
 
-def _run_transform(transforms: List[Callable], *inputs) -> tuple:
+def _run_transform(transforms: List[Callable], max_block_bytes: int, *inputs) -> tuple:
+    from ray_tpu.data.block import split_block_by_bytes
+
     blocks: List[Block] = []
     for inp in inputs:
         if isinstance(inp, list):
@@ -49,7 +51,12 @@ def _run_transform(transforms: List[Callable], *inputs) -> tuple:
     it: Iterator[Block] = iter(blocks)
     for t in transforms:
         it = t(it)
-    out = list(it)
+    # Dynamic block splitting: a transform that ballooned a block (flat_map,
+    # tensor columns) must not emit one giant object (reference:
+    # DataContext.target_max_block_size-driven splitting).
+    out: List[Block] = []
+    for b in it:
+        out.extend(split_block_by_bytes(b, max_block_bytes))
     rows = sum(b.num_rows for b in out)
     nbytes = sum(b.nbytes for b in out)
     return out, (rows, nbytes)
@@ -61,13 +68,14 @@ _transform_task = ray_tpu.remote(_run_transform)
 class _MapWorker:
     """Actor for compute=ActorPoolStrategy: holds warm user state (e.g. a model)."""
 
-    def __init__(self, transforms_blob):
+    def __init__(self, transforms_blob, max_block_bytes: int = 128 * 1024 * 1024):
         import cloudpickle
 
         self._transforms = cloudpickle.loads(transforms_blob)
+        self._max_block_bytes = max_block_bytes
 
     def transform(self, *inputs):
-        return _run_transform(self._transforms, *inputs)
+        return _run_transform(self._transforms, self._max_block_bytes, *inputs)
 
     def ready(self):
         return True
@@ -162,6 +170,7 @@ class TaskMapOperator(PhysicalOperator):
         super().__init__()
         self.name = name
         self._transforms = transforms
+        self._max_block_bytes = DataContext.get_current().target_max_block_size
         self._remote_args = {"num_cpus": 1, **(ray_remote_args or {})}
         # For reads: each item is a ReadTask; one task per item, no upstream input.
         self._source_items = deque(source_items) if source_items is not None else None
@@ -189,10 +198,12 @@ class TaskMapOperator(PhysicalOperator):
             if self._source_items is not None:
                 item = self._source_items.popleft()
                 transforms = [lambda _it, item=item: iter(item())] + self._transforms
-                blocks_ref, meta_ref = fn.remote(transforms)
+                blocks_ref, meta_ref = fn.remote(transforms, self._max_block_bytes)
             else:
                 bundle = self.inqueue.popleft()
-                blocks_ref, meta_ref = fn.remote(self._transforms, bundle.block_ref)
+                blocks_ref, meta_ref = fn.remote(
+                    self._transforms, self._max_block_bytes, bundle.block_ref
+                )
             self._pending[meta_ref] = (self._seq, blocks_ref)
             self._seq += 1
             started += 1
@@ -235,6 +246,7 @@ class ActorMapOperator(PhysicalOperator):
         self._reorder: dict = {}
         import cloudpickle
 
+        self._max_block_bytes = DataContext.get_current().target_max_block_size
         self._blob = cloudpickle.dumps(transforms)
 
     def _ensure_pool(self):
@@ -244,7 +256,7 @@ class ActorMapOperator(PhysicalOperator):
             num_cpus=self._strategy.num_cpus, num_tpus=self._strategy.num_tpus
         )(_MapWorker)
         for _ in range(self._strategy.size):
-            a = worker_cls.remote(self._blob)
+            a = worker_cls.remote(self._blob, self._max_block_bytes)
             self._actors.append(a)
             self._load[a._actor_id] = 0
 

@@ -244,3 +244,30 @@ class BlockBuilder:
         if not self._blocks:
             return pa.table({})
         return BlockAccessor.concat(self._blocks)
+
+
+def _compact_table(t: Block) -> Block:
+    """Materialize a table slice into its own buffers. Pickling a zero-copy
+    Arrow slice serializes the ENTIRE parent buffer (verified on pyarrow 25), so
+    slices headed for the object store must be compacted or splitting would
+    multiply stored bytes instead of capping them. IPC round-trip serializes
+    only the slice's rows."""
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return pa.ipc.open_stream(sink.getvalue()).read_all()
+
+
+def split_block_by_bytes(block: Block, max_bytes: int) -> List[Block]:
+    """Dynamic block splitting: slice an oversized block into row ranges so no
+    output block exceeds the target size (reference: dynamic block splitting in
+    _internal/output_buffer.py driven by DataContext.target_max_block_size)."""
+    if max_bytes <= 0 or block.nbytes <= max_bytes or block.num_rows <= 1:
+        return [block]
+    n_splits = min(block.num_rows, -(-block.nbytes // max_bytes))
+    rows_per = -(-block.num_rows // n_splits)
+    out = []
+    for start in range(0, block.num_rows, rows_per):
+        piece = block.slice(start, min(rows_per, block.num_rows - start))
+        out.append(_compact_table(piece))
+    return out

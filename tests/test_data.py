@@ -320,3 +320,65 @@ def test_abandoned_jax_iterator_stops_threads():
     gc.collect()
     time.sleep(1.0)
     assert threading.active_count() <= before + 3
+
+
+def test_dynamic_block_splitting(ray_start_regular):
+    """Oversized transform outputs are split to target_max_block_size
+    (reference: DataContext-driven dynamic block splitting)."""
+    import numpy as np
+
+    import ray_tpu.data as rdata
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    prev = ctx.target_max_block_size
+    ctx.target_max_block_size = 64 * 1024  # 64KB
+    try:
+        # One input block ballooning to ~8MB through map_batches.
+        ds = rdata.from_items([{"n": i} for i in range(8)]).map_batches(
+            lambda b: {"big": np.ones((len(b["n"]), 128 * 1024), np.float64)},
+            batch_size=8,
+        )
+        bundles = list(ds.materialize()._execute())
+        sizes = []
+        for bundle in bundles:
+            for block in bundle.get_blocks():
+                sizes.append(block.nbytes)
+        assert len(sizes) >= 8  # one ~8MB output block split to ~1MB row slices
+        # Every block respects the cap with slack for row granularity (1 row ~ 1MB).
+        assert max(sizes) <= 2 * 1024 * 1024
+        total_rows = sum(b.num_rows for bundle in bundles for b in bundle.get_blocks())
+        assert total_rows == 8
+    finally:
+        ctx.target_max_block_size = prev
+
+
+def test_block_split_helper_zero_copy_roundtrip():
+    import numpy as np
+
+    from ray_tpu.data.block import BlockAccessor, batch_to_block, split_block_by_bytes
+
+    block = batch_to_block({"x": np.arange(1000, dtype=np.int64)})
+    parts = split_block_by_bytes(block, block.nbytes // 4)
+    assert 4 <= len(parts) <= 6
+    assert sum(p.num_rows for p in parts) == 1000
+    recon = np.concatenate(
+        [BlockAccessor.for_block(p).to_batch_format("numpy")["x"] for p in parts]
+    )
+    np.testing.assert_array_equal(recon, np.arange(1000))
+
+
+def test_split_blocks_pickle_small():
+    """Split blocks must serialize at slice size, not parent-buffer size
+    (regression: pickled Arrow slices carry the whole parent table)."""
+    import pickle
+
+    import numpy as np
+
+    from ray_tpu.data.block import batch_to_block, split_block_by_bytes
+
+    block = batch_to_block({"x": np.ones(1_000_000, np.float64)})  # ~8MB
+    parts = split_block_by_bytes(block, block.nbytes // 8)
+    assert len(parts) >= 8
+    blob = pickle.dumps(parts[0], protocol=5)
+    assert len(blob) < 2 * parts[0].nbytes, (len(blob), parts[0].nbytes)
