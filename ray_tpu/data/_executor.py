@@ -68,7 +68,7 @@ _transform_task = ray_tpu.remote(_run_transform)
 class _MapWorker:
     """Actor for compute=ActorPoolStrategy: holds warm user state (e.g. a model)."""
 
-    def __init__(self, transforms_blob, max_block_bytes: int = 128 * 1024 * 1024):
+    def __init__(self, transforms_blob, max_block_bytes: int):
         import cloudpickle
 
         self._transforms = cloudpickle.loads(transforms_blob)

@@ -49,40 +49,58 @@ class LLMConfig:
     tokenizer: Optional[Any] = None
     seed: int = 0
     accelerator_resources: Optional[dict] = None  # e.g. {"TPU": 4}
+    # Multi-LoRA serving (reference: LoraConfig in server_models.py + vLLM
+    # multi-LoRA): {"max_loras": N, "rank": r}. Adapters register at runtime via
+    # LLMServer.load_lora and are selected per request with model="<id>:<adapter>".
+    lora_config: Optional[dict] = None
+
+
+def load_model(config: "LLMConfig"):
+    """Build (cfg, params) for a config — shared by monolithic and PD-disagg
+    deployments."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import CONFIGS, Transformer, get_config
+
+    cfg = config.model_config or get_config(
+        config.model_id if config.model_id in CONFIGS else "test-tiny"
+    )
+    cfg = dataclasses.replace(cfg, scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    if config.checkpoint_path:
+        with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
+            params = pickle.load(f)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(config.seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    return cfg, params
 
 
 class LLMServer:
     """One TPU replica: engine + tokenizer. Parity: llm_server.py LLMServer."""
 
     def __init__(self, config: LLMConfig):
-        import jax
-        import jax.numpy as jnp
-
-        from ray_tpu.models.transformer import CONFIGS, Transformer, get_config
-
-        cfg = config.model_config or get_config(
-            config.model_id if config.model_id in CONFIGS else "test-tiny"
-        )
-        cfg = dataclasses.replace(cfg, scan_layers=False, remat=False)
+        cfg, params = load_model(config)
         self._cfg = cfg
         self._config = config
         self._tokenizer = config.tokenizer or ByteTokenizer()
-        model = Transformer(cfg)
-        if config.checkpoint_path:
-            with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
-                params = pickle.load(f)
-        else:
-            params = model.init(
-                jax.random.PRNGKey(config.seed), jnp.zeros((1, 8), jnp.int32)
-            )["params"]
         self._engine = DecodeEngine(
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
+            lora_config=config.lora_config,
         )
+
+    async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0) -> int:
+        """Register a LoRA adapter on this replica (reference: LoRA checkpoints
+        loaded per model id under Serve multiplexing)."""
+        return self._engine.add_lora(name, layer_weights, alpha)
 
     async def generate(self, prompt: Union[str, List[int]], *,
                        max_tokens: int = 64, temperature: float = 0.0,
-                       top_k: int = 0, stop_token_id: Optional[int] = None) -> dict:
+                       top_k: int = 0, stop_token_id: Optional[int] = None,
+                       lora: str = "") -> dict:
         t0 = time.monotonic()
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
@@ -106,6 +124,7 @@ class LLMServer:
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
             cb,
+            lora=lora,
         )
         await done
         gen = list(out)
@@ -149,7 +168,13 @@ class OpenAIRouter:
             }
         body = request.json()
         model = body.get("model") or next(iter(self._servers))
-        handle = self._servers.get(model)
+        # "base-id:adapter" selects a LoRA adapter on the base model (the vLLM
+        # multi-LoRA model-name convention the reference passes through).
+        lora = ""
+        base = model
+        if model not in self._servers and ":" in model:
+            base, lora = model.split(":", 1)
+        handle = self._servers.get(base)
         if handle is None:
             return {"error": {"message": f"unknown model {model!r}",
                               "type": "invalid_request_error"}}
@@ -166,8 +191,13 @@ class OpenAIRouter:
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
+            lora=lora,
         )
-        result = await response
+        try:
+            result = await response
+        except KeyError:
+            return {"error": {"message": f"unknown lora adapter in model {model!r}",
+                              "type": "invalid_request_error"}}
         created = int(time.time())
         if is_chat:
             return {

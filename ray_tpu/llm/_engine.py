@@ -52,11 +52,23 @@ def _rmsnorm(x, scale, eps):
     return (normed * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg):
+def _lora_delta(x, A, B_, scale):
+    """Per-slot low-rank delta: x [B,S,M]; A [B,M,r]; B_ [B,r,O]; scale [B]."""
+    h = jnp.einsum("bsm,bmr->bsr", x, A.astype(x.dtype))
+    d = jnp.einsum("bsr,bro->bso", h, B_.astype(x.dtype))
+    return d * scale[:, None, None].astype(x.dtype)
+
+
+def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg,
+                 lora_layer=None, adapter_ids=None):
     """One attention layer against the KV cache.
 
     x: [B, S, M]; positions: [B, S]; cache_k/v: [B, T, Hkv, D];
     write_at: [B] start index per slot; kv_mask: [B, S, T] visibility.
+    lora_layer (optional): stacked adapters {"q_A": [A,M,r], "q_B": [A,r,H*D],
+    "v_A", "v_B", "scale": [A]} gathered per slot by adapter_ids [B] — the
+    multi-LoRA batching role of the reference's punica path, as plain gathers +
+    batched matmuls so one jitted program serves any adapter mix.
     """
     B, S, _ = x.shape
     q = _dense(x, layer["q"]["kernel"].reshape(cfg.hidden, -1)).reshape(
@@ -68,6 +80,16 @@ def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg):
     v = _dense(x, layer["v"]["kernel"].reshape(cfg.hidden, -1)).reshape(
         B, S, cfg.n_kv_heads, cfg.head_dim
     )
+    if lora_layer is not None:
+        scale = lora_layer["scale"][adapter_ids]
+        dq = _lora_delta(
+            x, lora_layer["q_A"][adapter_ids], lora_layer["q_B"][adapter_ids], scale
+        )
+        q = q + dq.reshape(B, S, cfg.n_heads, cfg.head_dim)
+        dv = _lora_delta(
+            x, lora_layer["v_A"][adapter_ids], lora_layer["v_B"][adapter_ids], scale
+        )
+        v = v + dv.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
@@ -99,7 +121,7 @@ def _mlp(layer, x):
 
 
 def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_at,
-                    kv_mask):
+                    kv_mask, lora=None, adapter_ids=None):
     """tokens: [B,S] -> logits [B,S,V]; updates caches in place (returned)."""
     embed = params["embedding"]
     x = embed[tokens].astype(cfg.dtype)
@@ -110,6 +132,8 @@ def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_a
         attn_out, ck, cv = _attn_cached(
             layer["attn"], normed, positions, caches[i][0], caches[i][1],
             write_at, kv_mask, cfg,
+            lora_layer=None if lora is None else lora[i],
+            adapter_ids=adapter_ids,
         )
         new_caches.append((ck, cv))
         x = x + attn_out
@@ -157,7 +181,8 @@ class DecodeEngine:
     stepper thread drives prefill + decode."""
 
     def __init__(self, cfg: ModelConfig, params, *, num_slots: int = 4,
-                 max_seq: Optional[int] = None, seed: int = 0):
+                 max_seq: Optional[int] = None, seed: int = 0,
+                 lora_config: Optional[dict] = None, decode_loop: bool = True):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -166,6 +191,26 @@ class DecodeEngine:
         self.B = num_slots
         self.T = max_seq or cfg.max_seq
         self._np_rng = np.random.default_rng(seed)
+        # Multi-LoRA: stacked adapter factors, slot -> adapter index. Index 0 is
+        # the base model (zero factors), so one jitted program serves any mix of
+        # adapters in a batch (reference: LoraConfig + vLLM multi-LoRA).
+        self._lora_cfg = lora_config
+        self._lora = None
+        self._lora_names: Dict[str, int] = {"": 0}
+        if lora_config:
+            A = int(lora_config.get("max_loras", 4)) + 1
+            r = int(lora_config.get("rank", 8))
+            self._lora = [
+                {
+                    "q_A": jnp.zeros((A, cfg.hidden, r), cfg.dtype),
+                    "q_B": jnp.zeros((A, r, cfg.n_heads * cfg.head_dim), cfg.dtype),
+                    "v_A": jnp.zeros((A, cfg.hidden, r), cfg.dtype),
+                    "v_B": jnp.zeros((A, r, cfg.n_kv_heads * cfg.head_dim), cfg.dtype),
+                    "scale": jnp.zeros((A,), jnp.float32),
+                }
+                for _ in range(cfg.n_layers)
+            ]
+        self._adapter_ids = jnp.zeros((num_slots,), jnp.int32)
         kv_shape = (self.B, self.T, cfg.n_kv_heads, cfg.head_dim)
         self._caches = [
             (jnp.zeros(kv_shape, cfg.dtype), jnp.zeros(kv_shape, cfg.dtype))
@@ -179,11 +224,56 @@ class DecodeEngine:
         self._stop = False
         self._jit_prefill = {}
         self._jit_decode = jax.jit(self._decode_step)
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self._thread = None
+        if decode_loop:  # prefill-only servers skip the stepper thread
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- lora registry -----------------------------------------------------
+    def add_lora(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
+                 alpha: float = 1.0) -> int:
+        """Register an adapter. layer_weights: layer index -> {"q_A": [M,r],
+        "q_B": [r,H*D], "v_A": [M,r], "v_B": [r,Hkv*D]} (missing projections
+        stay zero). Returns the adapter index."""
+        if self._lora is None:
+            raise ValueError("engine built without lora_config")
+        if name in self._lora_names:
+            return self._lora_names[name]
+        idx = len(self._lora_names)
+        max_a = int(self._lora[0]["scale"].shape[0])
+        if idx >= max_a:
+            raise ValueError(f"lora capacity {max_a - 1} exhausted")
+        rank = self._lora[0]["q_A"].shape[-1]
+        for li, w in layer_weights.items():
+            entry = self._lora[li]
+            upd = dict(entry)
+            for key in ("q_A", "q_B", "v_A", "v_B"):
+                if key in w:
+                    arr = jnp.asarray(w[key], entry[key].dtype)
+                    upd[key] = entry[key].at[idx].set(arr)
+            upd["scale"] = entry["scale"].at[idx].set(alpha / max(1, rank))
+            self._lora[li] = upd
+        # Layers the adapter doesn't touch still need its scale set (zero factors
+        # make the delta zero regardless).
+        for li in range(self.cfg.n_layers):
+            if li not in layer_weights:
+                self._lora[li] = dict(
+                    self._lora[li],
+                    scale=self._lora[li]["scale"].at[idx].set(alpha / max(1, rank)),
+                )
+        self._lora_names[name] = idx
+        return idx
+
+    def _adapter_index(self, lora: str) -> int:
+        if not lora:
+            return 0
+        if self._lora is None or lora not in self._lora_names:
+            raise KeyError(f"unknown lora adapter {lora!r}")
+        return self._lora_names[lora]
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_one(self, params, tokens, caches, lens, slot, prompt_len):
+    def _prefill_one(self, params, lora, tokens, caches, lens, slot, prompt_len,
+                     adapter_id):
         """tokens: [1, Sbucket] right-padded. Writes slot `slot`'s cache."""
         S = tokens.shape[1]
         positions = jnp.arange(S)[None, :]
@@ -196,6 +286,7 @@ class DecodeEngine:
         logits, new_slot_caches = _forward_cached(
             params, self.cfg, tokens, positions, slot_caches,
             jnp.zeros((1,), jnp.int32), mask,
+            lora=lora, adapter_ids=adapter_id[None],
         )
         out_caches = []
         for (ck_full, cv_full), (ck, cv) in zip(caches, new_slot_caches):
@@ -209,25 +300,100 @@ class DecodeEngine:
         lens = lens.at[slot].set(prompt_len)
         return last, out_caches, lens
 
-    def _decode_step(self, params, last_token, caches, lens):
+    def _decode_step(self, params, lora, adapter_ids, last_token, caches, lens):
         """One token for every slot. last_token: [B]; lens: [B] current lengths."""
         positions = lens[:, None]
         # key j visible iff j <= lens (the new token writes at index lens)
         kv_mask = (jnp.arange(self.T)[None, :] <= lens[:, None])[:, None, :]
         logits, new_caches = _forward_cached(
             params, self.cfg, last_token[:, None], positions, caches, lens, kv_mask,
+            lora=lora, adapter_ids=adapter_ids,
         )
         return logits[:, 0], new_caches, lens + 1
 
+    def _attach_kv(self, caches, kv, slot):
+        """Write a transferred KV prefix into slot's cache rows [0, P).
+        kv: [L, 2, P, Hkv, D] (P = padded prefix bucket)."""
+        out = []
+        for i in range(self.cfg.n_layers):
+            ck = jax.lax.dynamic_update_slice(
+                caches[i][0], kv[i, 0][None].astype(caches[i][0].dtype), (slot, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                caches[i][1], kv[i, 1][None].astype(caches[i][1].dtype), (slot, 0, 0, 0)
+            )
+            out.append((ck, cv))
+        return out
+
     # -- public API --------------------------------------------------------
-    def submit(self, token_ids: List[int], sampling: SamplingParams, callback):
+    def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
+               lora: str = ""):
         """callback(token_id: int, finished: bool) per generated token."""
+        adapter = self._adapter_index(lora)
         with self._lock:
-            self._queue.append((list(token_ids), sampling, callback))
+            self._queue.append(("prompt", list(token_ids), sampling, callback, adapter))
+
+    def submit_prefilled(self, kv: np.ndarray, prompt_len: int,
+                         first_logits: np.ndarray, sampling: SamplingParams,
+                         callback, lora: str = ""):
+        """Admit a request whose prefill ran elsewhere (PD disaggregation,
+        reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
+        transferred cache prefix, first_logits the last-position logits."""
+        adapter = self._adapter_index(lora)
+        with self._lock:
+            self._queue.append(
+                ("prefilled", kv, int(prompt_len), first_logits, sampling, callback,
+                 adapter)
+            )
+
+    def prefill_detached(self, token_ids: List[int], lora: str = ""):
+        """Prefill WITHOUT occupying a decode slot: returns
+        (first_logits [V], kv [L, 2, P, Hkv, D], prompt_len) for transfer to a
+        decode engine. P is the padded bucket length >= prompt_len."""
+        adapter = self._adapter_index(lora)
+        prompt = list(token_ids)[: self.T - 1]
+        bucket = self._bucket(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        key = ("detached", bucket)
+        if key not in self._jit_prefill:
+            cfg = self.cfg
+
+            def detached(params, lora_p, tokens, adapter_id):
+                S = tokens.shape[1]
+                positions = jnp.arange(S)[None, :]
+                caches = [
+                    (
+                        jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                        jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                    )
+                    for _ in range(cfg.n_layers)
+                ]
+                mask = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None]
+                logits, new_caches = _forward_cached(
+                    params, cfg, tokens, positions, caches,
+                    jnp.zeros((1,), jnp.int32), mask,
+                    lora=lora_p, adapter_ids=adapter_id[None],
+                )
+                kv = jnp.stack(
+                    [jnp.stack([ck[0], cv[0]]) for ck, cv in new_caches]
+                )  # [L, 2, S, Hkv, D]
+                return logits[0], kv
+
+            self._jit_prefill[key] = jax.jit(detached)
+        logits, kv = self._jit_prefill[key](
+            self.params, self._lora, jnp.asarray(padded), jnp.int32(adapter)
+        )
+        return (
+            np.asarray(logits[len(prompt) - 1]),
+            np.asarray(kv),
+            len(prompt),
+        )
 
     def shutdown(self):
         self._stop = True
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
     # -- stepper -----------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -243,28 +409,60 @@ class DecodeEngine:
             free = [i for i, s in enumerate(self._slots) if not s.active]
             if not free:
                 return False
-            prompt, sampling, callback = self._queue.pop(0)
+            item = self._queue.pop(0)
             slot = free[0]
-        prompt = prompt[: self.T - sampling.max_tokens - 1]
-        bucket = self._bucket(len(prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prompt)] = prompt
-        if bucket not in self._jit_prefill:
-            self._jit_prefill[bucket] = jax.jit(
-                self._prefill_one, static_argnames=()
+
+        if item[0] == "prefilled":
+            _tag, kv, prompt_len, first_logits, sampling, callback, adapter = item
+            # Same KV headroom contract as the prompt path: the cache must hold
+            # prompt_len + max_tokens rows, so a long transferred prefix shrinks
+            # the generation budget rather than silently wrapping the cache.
+            headroom = self.T - 1 - prompt_len
+            if sampling.max_tokens > headroom:
+                sampling = dataclasses.replace(
+                    sampling, max_tokens=max(1, headroom)
+                )
+            # Pad the transferred prefix to a bucket so attach programs are reused.
+            P = kv.shape[2]
+            bucket = self._bucket(max(P, prompt_len))
+            if P < bucket:
+                pad = np.zeros(
+                    (kv.shape[0], 2, bucket - P) + kv.shape[3:], kv.dtype
+                )
+                kv = np.concatenate([kv, pad], axis=2)
+            elif P > bucket:
+                kv = kv[:, :, :bucket]
+            key = ("attach", bucket)
+            if key not in self._jit_prefill:
+                self._jit_prefill[key] = jax.jit(self._attach_kv)
+            self._caches = self._jit_prefill[key](
+                self._caches, jnp.asarray(kv), jnp.int32(slot)
             )
-        last_logits, self._caches, self._lens = self._jit_prefill[bucket](
-            self.params, jnp.asarray(padded), self._caches, self._lens,
-            jnp.int32(slot), jnp.int32(len(prompt)),
-        )
-        first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)
+            self._lens = self._lens.at[slot].set(prompt_len)
+            first = _sample_host(np.asarray(first_logits), sampling, self._np_rng)
+        else:
+            _tag, prompt, sampling, callback, adapter = item
+            prompt = prompt[: self.T - sampling.max_tokens - 1]
+            bucket = self._bucket(len(prompt))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(prompt)] = prompt
+            if bucket not in self._jit_prefill:
+                self._jit_prefill[bucket] = jax.jit(self._prefill_one)
+            last_logits, self._caches, self._lens = self._jit_prefill[bucket](
+                self.params, self._lora, jnp.asarray(padded), self._caches,
+                self._lens, jnp.int32(slot), jnp.int32(len(prompt)),
+                jnp.int32(adapter),
+            )
+            prompt_len = len(prompt)
+            first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)
         s = self._slots[slot]
         s.active = True
         s.generated = 1
         s.params = sampling
         s.callback = callback
-        s.prompt_len = len(prompt)
+        s.prompt_len = prompt_len
         s.tokens = [first]
+        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
         self._last_token = self._last_token.at[slot].set(first)
         self._emit(slot, first)
         return True
@@ -293,7 +491,8 @@ class DecodeEngine:
                 time.sleep(0.002)
                 continue
             logits, self._caches, self._lens = self._jit_decode(
-                self.params, self._last_token, self._caches, self._lens
+                self.params, self._lora, self._adapter_ids, self._last_token,
+                self._caches, self._lens,
             )
             logits_np = np.asarray(logits)
             new_last = np.array(self._last_token)  # writable copy

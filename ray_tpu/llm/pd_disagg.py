@@ -1,0 +1,188 @@
+"""Prefill/decode disaggregation: separate deployments for the two LLM phases.
+
+Design parity: reference `python/ray/llm/_internal/serve/deployments/
+prefill_decode_disagg/prefill_decode_disagg.py` — prefill replicas (compute-bound,
+batch-friendly) and decode replicas (latency-bound, slot-limited) scale
+independently; the prefill output KV cache transfers to a decode replica which
+continues generation. The reference moves KV over NIXL/RDMA; here the transfer
+rides the shared-memory object store (zero-copy intra-node, chunked push
+inter-node) — the KV prefix is a numpy array result of the prefill actor call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, List, Optional, Union
+
+from ray_tpu.llm import ByteTokenizer, LLMConfig, SamplingParams, load_model
+from ray_tpu.llm._engine import DecodeEngine
+
+
+class PrefillServer:
+    """Prefill-only replica: turns a prompt into (first_logits, KV prefix)."""
+
+    def __init__(self, config: LLMConfig):
+        cfg, params = load_model(config)
+        self._engine = DecodeEngine(
+            cfg, params, num_slots=1,
+            max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
+            lora_config=config.lora_config, decode_loop=False,
+        )
+
+    async def prefill(self, token_ids: List[int], lora: str = "") -> dict:
+        loop = asyncio.get_running_loop()
+        first_logits, kv, prompt_len = await loop.run_in_executor(
+            None, lambda: self._engine.prefill_detached(token_ids, lora)
+        )
+        return {"first_logits": first_logits, "kv": kv, "prompt_len": prompt_len}
+
+    async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
+        return self._engine.add_lora(name, layer_weights, alpha)
+
+    def __del__(self):
+        try:
+            self._engine.shutdown()
+        except Exception:
+            pass
+
+
+class DecodeServer:
+    """Decode-only replica: continues generation from a transferred KV prefix."""
+
+    def __init__(self, config: LLMConfig):
+        cfg, params = load_model(config)
+        self._tokenizer = config.tokenizer or ByteTokenizer()
+        self._engine = DecodeEngine(
+            cfg, params, num_slots=config.num_slots,
+            max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
+            lora_config=config.lora_config,
+        )
+
+    async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
+                                 max_tokens: int = 64, temperature: float = 0.0,
+                                 top_k: int = 0, stop_token_id: Optional[int] = None,
+                                 lora: str = "") -> dict:
+        loop = asyncio.get_running_loop()
+        done: asyncio.Future = loop.create_future()
+        out: List[int] = []
+
+        def cb(token: int, finished: bool):
+            out.append(token)
+            if finished:
+                loop.call_soon_threadsafe(
+                    lambda: done.set_result(None) if not done.done() else None
+                )
+
+        self._engine.submit_prefilled(
+            kv, prompt_len, first_logits,
+            SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                           top_k=top_k, stop_token_id=stop_token_id),
+            cb, lora=lora,
+        )
+        await done
+        gen = list(out)
+        if stop_token_id is not None and gen and gen[-1] == stop_token_id:
+            gen = gen[:-1]
+        return {"token_ids": gen, "text": self._tokenizer.decode(gen)}
+
+    async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
+        return self._engine.add_lora(name, layer_weights, alpha)
+
+    def __del__(self):
+        try:
+            self._engine.shutdown()
+        except Exception:
+            pass
+
+
+class PDRouter:
+    """Request path: tokenize -> prefill replica -> KV transfer -> decode replica."""
+
+    def __init__(self, prefill_handle, decode_handle, config: LLMConfig):
+        self._prefill = prefill_handle
+        self._decode = decode_handle
+        self._tokenizer = config.tokenizer or ByteTokenizer()
+        self._model_id = config.model_id
+
+    async def generate(self, prompt: Union[str, List[int]], *,
+                       max_tokens: int = 64, temperature: float = 0.0,
+                       top_k: int = 0, stop_token_id: Optional[int] = None,
+                       lora: str = "") -> dict:
+        t0 = time.monotonic()
+        token_ids = (
+            self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        pre = await self._prefill.prefill.remote(token_ids, lora)
+        t_prefill = time.monotonic() - t0
+        result = await self._decode.generate_prefilled.remote(
+            pre["kv"], pre["prompt_len"], pre["first_logits"],
+            max_tokens=max_tokens, temperature=temperature, top_k=top_k,
+            stop_token_id=stop_token_id, lora=lora,
+        )
+        return {
+            **result,
+            "usage": {
+                "prompt_tokens": len(token_ids),
+                "completion_tokens": len(result["token_ids"]),
+                "total_tokens": len(token_ids) + len(result["token_ids"]),
+            },
+            "prefill_s": t_prefill,
+            "latency_s": time.monotonic() - t0,
+        }
+
+    async def __call__(self, request) -> dict:
+        body = request.json() if hasattr(request, "json") else dict(request)
+        model = body.get("model", "")
+        lora = model.split(":", 1)[1] if ":" in model else ""
+        stop = body.get("stop_token_id")
+        try:
+            return await self.generate(
+                body.get("prompt", ""),
+                max_tokens=int(body.get("max_tokens", 64)),
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                stop_token_id=None if stop is None else int(stop),
+                lora=lora,
+            )
+        except KeyError as e:
+            return {"error": {"message": f"unknown lora adapter {e}",
+                              "type": "invalid_request_error"}}
+
+    async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
+        """Install an adapter on EVERY replica of both phases (they must agree on
+        factors). Replicas created after this call need a re-broadcast."""
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: (
+                self._prefill.load_lora.broadcast(name, layer_weights, alpha),
+                self._decode.load_lora.broadcast(name, layer_weights, alpha),
+            ),
+        )
+        return True
+
+
+def build_pd_openai_app(config: LLMConfig, *, num_prefill: int = 1,
+                        num_decode: int = 1) -> "Any":
+    """Disaggregated serving app (reference: build_pd_openai_app in
+    prefill_decode_disagg.py): independent prefill and decode replica pools
+    behind one router."""
+    from ray_tpu import serve
+
+    resources = config.accelerator_resources or {}
+    prefill = serve.deployment(
+        name=f"Prefill-{config.model_id}",
+        num_replicas=num_prefill,
+        ray_actor_options={"num_cpus": 0, **resources},
+    )(PrefillServer)
+    decode = serve.deployment(
+        name=f"Decode-{config.model_id}",
+        num_replicas=num_decode,
+        ray_actor_options={"num_cpus": 0, **resources},
+        max_ongoing_requests=config.num_slots * 4,
+    )(DecodeServer)
+    router = serve.deployment(name=f"PDRouter-{config.model_id}")(PDRouter)
+    return router.bind(prefill.bind(config), decode.bind(config), config)

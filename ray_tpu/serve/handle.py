@@ -258,6 +258,19 @@ class DeploymentHandle:
             self._router = _shared_router(self._app, self._deployment)
         return self._router
 
+    def broadcast(self, *args, **kwargs) -> list:
+        """Call the bound method on EVERY current replica and return all results
+        (control-plane operations like installing a LoRA adapter must reach the
+        whole replica set, not one routed pick). Replicas added later — scale-up,
+        recovery — do NOT receive past broadcasts; re-broadcast after scaling."""
+        router = self._get_router()
+        router._refresh(force=True)
+        responses = [
+            r.handle_request.remote(self._method_name, args, kwargs)
+            for r in list(router._replicas)
+        ]
+        return [ray_tpu.get(ref, timeout=120) for ref in responses]
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         # Deployment responses compose: pass the underlying refs so the runtime
         # resolves them as task dependencies (no blocking round-trip here).
