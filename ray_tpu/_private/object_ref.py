@@ -68,10 +68,12 @@ class ObjectRef:
         return (_deserialize_ref, (self.id.binary(), self.owner))
 
     def __del__(self):
+        # Finalizers can run via GC inside runtime critical sections (same
+        # thread, lock already held): never lock here — defer the release.
         w = self._worker
         if w is not None:
             try:
-                w.reference_counter.remove_local_ref(self.id)
+                w.reference_counter.defer_remove(self.id)
             except Exception:
                 pass
 

@@ -32,10 +32,12 @@ from ray_tpu._private.object_store import SharedObjectStore
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen | None, kind: str):
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen | None, kind: str,
+                 env_key: str | None = None):
         self.worker_id = worker_id
         self.proc = proc
         self.kind = kind  # "worker" | "driver" | "actor"
+        self.env_key = env_key  # pip-env hash this worker's interpreter serves
         self.conn: rpc.Connection | None = None
         self.registered = asyncio.Event()
         self.busy_task: dict | None = None  # currently running normal task spec
@@ -162,6 +164,11 @@ class Raylet:
         # GCS after a GCS restart so the (non-persisted, owner-based) object
         # directory can be rebuilt from the nodes that actually hold the data.
         self._sealed_objects: dict[ObjectID, tuple[int, Any]] = {}
+        # pip runtime-env venvs (reference: runtime-env agent + env-keyed worker
+        # pools, worker_pool.h:280): env key -> venv python path once built.
+        self._venv_python: dict[str, str] = {}
+        self._venv_failed: dict[str, tuple[str, float]] = {}  # key -> (err, at)
+        self._venv_building: set[str] = set()
         self._shutdown = False
 
     # ------------------------------------------------------------------ startup
@@ -309,7 +316,8 @@ class Raylet:
 
     # ------------------------------------------------------------------ worker pool
 
-    def _spawn_worker(self, kind: str = "worker") -> WorkerHandle:
+    def _spawn_worker(self, kind: str = "worker", python_exe: str | None = None,
+                      env_key: str | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -324,25 +332,71 @@ class Raylet:
         env["RAY_TPU_RAYLET_PORT"] = str(self.port)
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            [python_exe or sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env,
             stdout=out,
             stderr=subprocess.STDOUT,
         )
-        handle = WorkerHandle(worker_id, proc, kind)
+        handle = WorkerHandle(worker_id, proc, kind, env_key=env_key)
         self.workers[worker_id] = handle
         return handle
 
-    def _find_idle_worker(self) -> WorkerHandle | None:
+    def _find_idle_worker(self, env_key: str | None = None) -> WorkerHandle | None:
         for w in self.workers.values():
             if (
                 w.kind == "worker" and w.alive and w.registered.is_set()
                 and w.busy_task is None and w.actor_id is None
+                and w.env_key == env_key
             ):
                 return w
         return None
 
-    def _maybe_spawn_worker(self):
+    # -- pip runtime-env venvs --------------------------------------------
+
+    def _venv_cache_root(self) -> str:
+        return os.path.join(self.session_dir, "runtime_envs")
+
+    def _resolve_env_python(self, spec: dict) -> tuple[str | None, bool]:
+        """(python_exe, ready). Starts an async venv build on first sight; the
+        scheduler retries the task until the env is ready (or fails it)."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        key = runtime_env_mod.env_key(spec.get("runtime_env"))
+        if key is None:
+            return None, True
+        if key in self._venv_python:
+            return self._venv_python[key], True
+        failed = self._venv_failed.get(key)
+        if failed is not None:
+            err, at = failed
+            if time.monotonic() - at < 60.0:
+                raise RuntimeError(f"runtime_env pip install failed: {err}")
+            # Retry window: a transient failure (wheel house mid-populate, disk
+            # pressure) must not poison the env forever.
+            self._venv_failed.pop(key, None)
+        if key not in self._venv_building:
+            self._venv_building.add(key)
+            loop = asyncio.get_running_loop()
+            renv = spec["runtime_env"]
+
+            def build():
+                return runtime_env_mod.ensure_pip_env(renv, self._venv_cache_root())
+
+            fut = loop.run_in_executor(None, build)
+
+            def done(f):
+                self._venv_building.discard(key)
+                try:
+                    self._venv_python[key] = f.result()
+                except Exception as e:  # noqa: BLE001
+                    self._venv_failed[key] = (str(e), time.monotonic())
+                self._sched_wakeup.set()
+
+            fut.add_done_callback(done)  # asyncio future: callback runs on the loop
+        return None, False
+
+    def _maybe_spawn_worker(self, env_key: str | None = None,
+                            python_exe: str | None = None):
         """Background worker prestart. Bounded to the node's CPU slots plus slack
         under normal load, but when EVERY task worker is busy (e.g. nested
         zero-resource tasks whose parents block in get()), the pool may grow past
@@ -357,6 +411,18 @@ class Raylet:
             if w.kind == "worker" and w.alive and w.actor_id is None
             and w.registered.is_set()
         ]
+        if env_key is not None:
+            # Env-keyed pool: vanilla idle workers cannot serve this task, so the
+            # vanilla cap must not block the spawn; bound the keyed pool itself.
+            keyed = [w for w in task_workers if w.env_key == env_key]
+            if any(w.busy_task is None for w in keyed):
+                return  # an idle keyed worker exists; dispatch will find it
+            if len(keyed) >= max(2, cap // 2) or self._spawning >= 4:
+                return
+            self._spawning += 1
+            handle = self._spawn_worker(python_exe=python_exe, env_key=env_key)
+            self._await_registration(handle)
+            return
         all_busy = all(w.busy_task is not None for w in task_workers)
         over_cap = len(task_workers) + self._spawning >= cap
         if over_cap and not (all_busy and self._spawning == 0):
@@ -364,8 +430,10 @@ class Raylet:
         if self._spawning >= 4:
             return
         self._spawning += 1
-        handle = self._spawn_worker()
+        handle = self._spawn_worker(python_exe=python_exe, env_key=env_key)
+        self._await_registration(handle)
 
+    def _await_registration(self, handle: WorkerHandle):
         async def wait_registered():
             try:
                 await asyncio.wait_for(
@@ -560,12 +628,17 @@ class Raylet:
             self.task_queue = remaining + self.task_queue
 
     def _dispatch_shape(self, spec: dict) -> tuple:
-        """Pass-local memo key: specs with equal shape dispatch-or-fail together."""
+        """Pass-local memo key: specs with equal shape dispatch-or-fail together.
+        Includes the runtime-env key: a pip-env task waiting on its venv must not
+        poison the memo for plain tasks with the same resource shape."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
         strategy = spec.get("scheduling_strategy") or {}
         return (
             tuple(sorted((spec.get("resources") or {}).items())),
             self._pg_key(spec),
             strategy.get("node_id"),
+            runtime_env_mod.env_key(spec.get("runtime_env")),
         )
 
     async def _try_dispatch(self, spec: dict) -> bool:
@@ -593,12 +666,25 @@ class Raylet:
             if await self._maybe_spread(spec):
                 return True
             return False
-        worker = self._find_idle_worker()
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        env_key = runtime_env_mod.env_key(spec.get("runtime_env"))
+        if env_key is not None:
+            try:
+                python_exe, ready = self._resolve_env_python(spec)
+            except RuntimeError as e:
+                await self._fail_task(spec, str(e))
+                return True
+            if not ready:
+                return False  # venv building; wakeup re-dispatches
+        else:
+            python_exe = None
+        worker = self._find_idle_worker(env_key)
         if worker is None:
             # Spawn happens in the BACKGROUND: awaiting a worker's registration
             # inside the dispatch loop would serialize the whole scheduler behind
             # process startup. The task stays queued; registration wakes us.
-            self._maybe_spawn_worker()
+            self._maybe_spawn_worker(env_key=env_key, python_exe=python_exe)
             return False
         # No await separates can_acquire from here (single-threaded loop), so this
         # acquire cannot fail; it performs the actual bookkeeping.
@@ -951,8 +1037,28 @@ class Raylet:
 
     async def rpc_create_actor(self, conn, actor_id: ActorID, spec: dict):
         """From GCS: lease a dedicated worker and instantiate the actor."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
         demand = dict(spec.get("resources") or {})
         pg_key = self._pg_key(spec)
+        # pip runtime env: the actor's worker must run inside the env's venv.
+        # Routed through the same single-flight builder as tasks so concurrent
+        # creations of the same env never race one cache directory.
+        python_exe = None
+        if runtime_env_mod.env_key(spec.get("runtime_env")) is not None:
+            deadline = time.monotonic() + 600
+            while True:
+                try:
+                    python_exe, ready = self._resolve_env_python(spec)
+                except RuntimeError as e:
+                    return {"ok": False, "reason": f"runtime_env failed: {e}",
+                            "fatal": True}
+                if ready:
+                    break
+                if time.monotonic() > deadline:
+                    return {"ok": False, "reason": "runtime_env build timed out",
+                            "fatal": True}
+                await asyncio.sleep(0.25)
         if not self.resources.acquire(demand, pg_key):
             return {"ok": False, "reason": "resources"}
 
@@ -965,7 +1071,7 @@ class Raylet:
             self.resources.release(demand, pg_key)
             await self._kill_worker(handle)
 
-        handle = self._spawn_worker(kind="actor")
+        handle = self._spawn_worker(kind="actor", python_exe=python_exe)
         try:
             await asyncio.wait_for(handle.registered.wait(), CONFIG.worker_register_timeout_s)
         except asyncio.TimeoutError:

@@ -19,12 +19,29 @@ restore so later tasks can't silently pick up stale code.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import os
+import subprocess
 import sys
 import threading
 from typing import Any, Dict, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv"}
+
+
+def _normalize_pip(spec) -> Dict[str, Any]:
+    """Accept ["pkg", ...] or {"packages": [...], "find_links": path}."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not isinstance(spec.get("packages"), list):
+        raise ValueError(
+            'runtime_env pip/uv must be a list of requirements or {"packages": [...]}'
+        )
+    out = {"packages": [str(p) for p in spec["packages"]]}
+    if spec.get("find_links"):
+        out["find_links"] = os.path.abspath(os.path.expanduser(str(spec["find_links"])))
+    return out
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -36,6 +53,14 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
             f"unsupported runtime_env keys {sorted(unknown)}; "
             f"supported: {sorted(_SUPPORTED)}"
         )
+    runtime_env = dict(runtime_env)
+    # "uv" is an alias for "pip" (same venv mechanism; uv used when available).
+    if "uv" in runtime_env:
+        if "pip" in runtime_env:
+            raise ValueError("pass either pip or uv, not both")
+        runtime_env["pip"] = runtime_env.pop("uv")
+    if "pip" in runtime_env:
+        runtime_env["pip"] = _normalize_pip(runtime_env["pip"])
     env_vars = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be str -> str")
@@ -162,3 +187,98 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
                 if any(mod_file.startswith(p + os.sep) or mod_file == p
                        for p in env_paths):
                     sys.modules.pop(name, None)
+
+
+# -- pip/uv virtualenv plugin ----------------------------------------------
+# Reference: python/ray/_private/runtime_env/pip.py + uv.py — the per-node
+# runtime-env agent materializes a virtualenv per unique pip spec and the worker
+# pool launches (and caches) workers inside it (worker_pool.h runtime-env-keyed
+# pools). Installs run OFFLINE (--no-index [+ --find-links]) — this framework
+# targets air-gapped TPU pods; point find_links at a local wheel house.
+
+
+def env_key(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Stable key for the parts of a runtime_env that require a DEDICATED worker
+    process (a different interpreter); None means any vanilla worker can serve
+    it (env_vars/working_dir/py_modules apply in-process)."""
+    if not runtime_env or "pip" not in runtime_env:
+        return None
+    blob = json.dumps(runtime_env["pip"], sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def ensure_pip_env(runtime_env: Dict[str, Any], cache_root: str) -> str:
+    """Materialize (or reuse) the venv for a pip spec; returns its python path.
+
+    Venvs are cached by spec hash under `cache_root` (reference: uri_cache.py),
+    created with --system-site-packages so the baked-in jax/numpy stack stays
+    visible beneath the env's own packages.
+    """
+    key = env_key(runtime_env)
+    spec = runtime_env["pip"]
+    final = os.path.join(cache_root, f"venv_{key}")
+    final_python = os.path.join(final, "bin", "python")
+    stamp_name = ".ready"
+    if os.path.exists(os.path.join(final, stamp_name)):
+        return final_python
+    os.makedirs(cache_root, exist_ok=True)
+    # Cross-process safety (several raylets can share one cache root): build in
+    # a private tmp dir, then atomically rename into place; the loser of the
+    # rename race discards its build and uses the winner's.
+    path = final + f".build{os.getpid()}"
+    python = os.path.join(path, "bin", "python")
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", path],
+            check=True, capture_output=True, timeout=120,
+        )
+        # When the parent interpreter is ITSELF a venv (the common container
+        # layout), --system-site-packages exposes the base python's site dir,
+        # not the parent venv's — link the parent's site-packages explicitly so
+        # the baked-in jax/numpy stack stays importable beneath the new env.
+        import sysconfig
+
+        parent_purelib = sysconfig.get_paths()["purelib"]
+        venv_purelib = subprocess.run(
+            [python, "-c",
+             "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+            check=True, capture_output=True, timeout=60, text=True,
+        ).stdout.strip()
+        with open(os.path.join(venv_purelib, "_ray_tpu_parent.pth"), "w") as f:
+            f.write(parent_purelib + "\n")
+        if spec["packages"]:
+            import shutil
+
+            uv = shutil.which("uv")
+            if uv:
+                cmd = [uv, "pip", "install", "--python", python, "--no-index"]
+            else:
+                cmd = [python, "-m", "pip", "install", "--no-index", "--quiet",
+                       "--no-build-isolation"]
+            if spec.get("find_links"):
+                cmd += ["--find-links", spec["find_links"]]
+            cmd += spec["packages"]
+            proc = subprocess.run(cmd, capture_output=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip env install failed:\n{proc.stderr.decode(errors='replace')[-2000:]}"
+                )
+        with open(os.path.join(path, stamp_name), "w") as f:
+            f.write(json.dumps(spec))
+        try:
+            os.rename(path, final)
+        except OSError:
+            # Another process installed the same env first; keep theirs. The
+            # renamed venv keeps working because only `<venv>/bin/python -m` is
+            # ever invoked (console-script shebangs bake the build path, unused).
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+            if not os.path.exists(os.path.join(final, stamp_name)):
+                raise
+        return final_python
+    except Exception:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+        raise

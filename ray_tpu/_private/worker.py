@@ -15,6 +15,7 @@ import os
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -147,6 +148,31 @@ class ReferenceCounter:
         self._pending_free: set[ObjectID] = set()  # local zero, waiting on borrowers
         self._lock = threading.Lock()
         self._worker = worker
+        # GC-safety: __del__ may fire via garbage collection INSIDE a section
+        # that already holds one of this runtime's locks (same thread), so
+        # finalizers must never lock. They append to this deque (GIL-atomic)
+        # and the release runs later from drain_deferred() on a normal API path.
+        self._deferred: deque = deque()
+
+    def defer_remove(self, object_id: ObjectID):
+        """Finalizer-safe ref release: enqueue only; no locks, no RPC."""
+        self._deferred.append(("ref", object_id))
+
+    def defer_actor_pin_release(self, actor_id):
+        self._deferred.append(("actor_pins", actor_id))
+
+    def drain_deferred(self):
+        """Apply releases queued by finalizers. Called from non-finalizer paths
+        (put/get/submit/...) and the periodic flush loop, never from __del__."""
+        while True:
+            try:
+                kind, ident = self._deferred.popleft()
+            except IndexError:
+                return
+            if kind == "ref":
+                self.remove_local_ref(ident)
+            else:
+                self._worker.release_actor_arg_pins(ident)
 
     def add_owned(self, object_id: ObjectID):
         with self._lock:
@@ -450,6 +476,8 @@ class CoreWorker:
     async def _event_flush_loop(self):
         while self._connected:
             await asyncio.sleep(CONFIG.metrics_report_interval_s)
+            # Backstop drain: refs dropped by GC with no later API activity.
+            self.reference_counter.drain_deferred()
             with self._events_lock:
                 batch, self._task_events = self._task_events, []
             if batch:
@@ -464,6 +492,7 @@ class CoreWorker:
         return {"node_id": self.node_id, "worker_id": self.worker_id}
 
     def put(self, value: Any) -> ObjectRef:
+        self.reference_counter.drain_deferred()
         object_id = ObjectID.from_task(self.current_task_id, 0x40000000 + self._put_counter.next())
         self._put_to_plasma(object_id, value, self._owner_address())
         self.reference_counter.add_owned(object_id)
@@ -481,6 +510,7 @@ class CoreWorker:
         self.raylet_call("store_seal", object_id, total, owner)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        self.reference_counter.drain_deferred()
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for ref in refs:
@@ -564,6 +594,7 @@ class CoreWorker:
         return value
 
     def wait(self, refs: list[ObjectRef], num_returns=1, timeout=None, fetch_local=True):
+        self.reference_counter.drain_deferred()
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: list[ObjectRef] = []
@@ -762,6 +793,7 @@ class CoreWorker:
         scheduling_strategy=None,
         runtime_env: dict | None = None,
     ) -> list[ObjectRef]:
+        self.reference_counter.drain_deferred()
         task_id = TaskID.from_random()
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
         streaming = num_returns == "streaming"
@@ -922,6 +954,7 @@ class CoreWorker:
         kwargs,
         num_returns: int = 1,
     ) -> list[ObjectRef]:
+        self.reference_counter.drain_deferred()
         task_id = TaskID.from_random()
         ser_args, ser_kwargs, promoted = self._serialize_args(args, kwargs)
         if promoted:

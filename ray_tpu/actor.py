@@ -92,13 +92,15 @@ class ActorHandle:
         self._owns_arg_pins = _owns_arg_pins
 
     def __del__(self):
+        # GC-safe: defer (finalizers must not take runtime locks; see
+        # ReferenceCounter.defer_remove).
         if getattr(self, "_owns_arg_pins", False):
             try:
                 from ray_tpu._private.worker import global_worker_or_none
 
                 w = global_worker_or_none()
                 if w is not None:
-                    w.release_actor_arg_pins(self._actor_id)
+                    w.reference_counter.defer_actor_pin_release(self._actor_id)
             except Exception:
                 pass  # interpreter shutdown
 

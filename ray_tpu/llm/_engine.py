@@ -339,6 +339,12 @@ class DecodeEngine:
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
         transferred cache prefix, first_logits the last-position logits."""
+        if prompt_len >= self.T:
+            raise ValueError(
+                f"transferred KV prefix of {prompt_len} tokens does not fit this "
+                f"decode engine's max_seq={self.T}; align prefill and decode "
+                f"max_seq (build_pd_openai_app shares one config)"
+            )
         adapter = self._adapter_index(lora)
         with self._lock:
             self._queue.append(
