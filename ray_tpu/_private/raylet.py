@@ -417,7 +417,10 @@ class Raylet:
             keyed = [w for w in task_workers if w.env_key == env_key]
             if any(w.busy_task is None for w in keyed):
                 return  # an idle keyed worker exists; dispatch will find it
-            if len(keyed) >= max(2, cap // 2) or self._spawning >= 4:
+            # Count in-flight spawns against the keyed bound too: the 20ms
+            # dispatch poll must not stack duplicate spawns while the first
+            # keyed worker is still registering.
+            if len(keyed) + self._spawning >= max(2, cap // 2) or self._spawning >= 4:
                 return
             self._spawning += 1
             handle = self._spawn_worker(python_exe=python_exe, env_key=env_key)

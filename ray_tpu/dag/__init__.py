@@ -6,9 +6,11 @@ steady-state execution does no task submission and no allocation, just channel
 writes/reads between pinned per-actor loops.
 """
 
+from ray_tpu.dag import collective
 from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     InputAttributeNode,
     InputNode,
@@ -17,10 +19,12 @@ from ray_tpu.dag.dag_node import (
 
 __all__ = [
     "ClassMethodNode",
+    "CollectiveOutputNode",
     "CompiledDAG",
     "CompiledDAGRef",
     "DAGNode",
     "InputAttributeNode",
     "InputNode",
     "MultiOutputNode",
+    "collective",
 ]

@@ -1,0 +1,65 @@
+"""Collective operations as compiled-graph nodes.
+
+Design parity: reference `python/ray/dag/collective_node.py` +
+`ray.experimental.collective.allreduce.bind(tensor_nodes)` — an allreduce whose
+participants are DAG nodes on different actors, executed inside the compiled
+graph's pinned loops. TPU-first note: IN-GRAPH device collectives belong inside
+jitted SPMD programs (XLA inserts them over ICI); this DAG-level collective is the
+host/CPU-tensor analog riding the shared-memory channels.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List
+
+from ray_tpu.dag.dag_node import ClassMethodNode, CollectiveOutputNode
+
+_group_counter = itertools.count(1)
+
+REDUCE_OPS = ("sum", "mean", "max", "min")
+
+
+class _AllReduce:
+    def bind(self, nodes: List[ClassMethodNode], op: str = "sum") -> List[CollectiveOutputNode]:
+        """Bind an allreduce over the outputs of `nodes` (one per actor).
+        Returns one CollectiveOutputNode per participant, in the same order."""
+        if op not in REDUCE_OPS:
+            raise ValueError(f"unsupported reduce op {op!r}; one of {REDUCE_OPS}")
+        if len(nodes) < 2:
+            raise ValueError("allreduce needs at least two participants")
+        if not all(isinstance(n, ClassMethodNode) for n in nodes):
+            raise ValueError("allreduce participants must be actor method nodes")
+        actors = {n.actor._actor_id for n in nodes}  # ActorID hashes by value
+        if len(actors) != len(nodes):
+            raise ValueError("allreduce participants must live on distinct actors")
+        gid = next(_group_counter)
+        return [
+            CollectiveOutputNode(nodes, i, op, gid) for i in range(len(nodes))
+        ]
+
+
+allreduce = _AllReduce()
+
+
+def reduce_values(op: str, values: list):
+    """Host-side reduction over numpy/jax arrays or scalars."""
+    import numpy as np
+
+    arrays = [np.asarray(v) for v in values]
+    if op == "sum":
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = out + a  # rebinding allocates; inputs never mutated
+        return out
+    if op == "mean":
+        return reduce_values("sum", arrays) / len(arrays)
+    if op == "max":
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = np.maximum(out, a)
+        return out
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = np.minimum(out, a)
+    return out

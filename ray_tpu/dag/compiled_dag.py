@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     InputAttributeNode,
     InputNode,
@@ -25,14 +26,16 @@ from ray_tpu.experimental.channel import Channel, ChannelClosed
 
 
 class _ExecSpec:
-    """One actor-local step: read input channels / constants, call method, write."""
+    """One actor-local step: read input channels / constants, call method (or
+    reduce, for collective steps), write."""
 
     def __init__(self, method_name: str, arg_sources: list, kwarg_sources: dict,
-                 out_channel: Optional[Channel]):
+                 out_channel: Optional[Channel], reduce_op: Optional[str] = None):
         self.method_name = method_name
         self.arg_sources = arg_sources      # list of ("chan", Channel)|("const", v)
         self.kwarg_sources = kwarg_sources  # name -> same
         self.out_channel = out_channel
+        self.reduce_op = reduce_op          # set for collective steps
 
 
 def _read_source(kind, src):
@@ -71,7 +74,12 @@ def _exec_loop(instance, specs: List[_ExecSpec]):
                 )
                 if err is None:
                     try:
-                        out = getattr(instance, spec.method_name)(*args, **kwargs)
+                        if spec.reduce_op is not None:
+                            from ray_tpu.dag.collective import reduce_values
+
+                            out = reduce_values(spec.reduce_op, args)
+                        else:
+                            out = getattr(instance, spec.method_name)(*args, **kwargs)
                     except Exception as e:  # surfaced at CompiledDAGRef.get
                         out = _WrappedError(e)
                 else:
@@ -141,15 +149,22 @@ class CompiledDAG:
             outputs = [leaf]
         self._num_outputs = len(outputs)
         for out in outputs:
-            if not isinstance(out, ClassMethodNode):
-                raise ValueError("DAG outputs must be actor method nodes")
+            if not isinstance(out, (ClassMethodNode, CollectiveOutputNode)):
+                raise ValueError(
+                    "DAG outputs must be actor method or collective nodes"
+                )
 
         # Consumer counts per node, counted per ARG OCCURRENCE (a node passed twice
         # to one bind() needs two reader slots — source_for allocates one per
-        # occurrence, and every slot must have its own ack word).
+        # occurrence, and every slot must have its own ack word). A collective
+        # step consumes EVERY participant's output (peers read each other's
+        # producer channels and reduce locally).
         consumers: Dict[int, int] = {}
         for n in nodes:
-            if isinstance(n, ClassMethodNode):
+            if isinstance(n, CollectiveOutputNode):
+                for p in n.participants:
+                    consumers[id(p)] = consumers.get(id(p), 0) + 1
+            elif isinstance(n, ClassMethodNode):
                 for u in n.upstream:
                     consumers[id(u)] = consumers.get(id(u), 0) + 1
         # Input channel read by every arg occurrence that consumes the input
@@ -163,10 +178,13 @@ class CompiledDAG:
         for out in outputs:
             consumers[id(out)] = consumers.get(id(out), 0) + 1  # driver reads leaves
 
-        # Create one output channel per ClassMethodNode that anyone consumes.
+        # Create one output channel per producer node that anyone consumes.
         chan_of: Dict[int, Channel] = {}
         for n in nodes:
-            if isinstance(n, ClassMethodNode) and consumers.get(id(n), 0) > 0:
+            if (
+                isinstance(n, (ClassMethodNode, CollectiveOutputNode))
+                and consumers.get(id(n), 0) > 0
+            ):
                 chan_of[id(n)] = Channel(self._buffer, consumers[id(n)])
 
         # Assign reader slots.
@@ -182,7 +200,7 @@ class CompiledDAG:
                 slot = input_next_slot[0]
                 input_next_slot[0] += 1
                 return ("pick", (self._input_channel.reader(slot), value.key))
-            if isinstance(value, ClassMethodNode):
+            if isinstance(value, (ClassMethodNode, CollectiveOutputNode)):
                 ch = chan_of[id(value)]
                 slot = next_slot.get(id(value), 0)
                 next_slot[id(value)] = slot + 1
@@ -194,6 +212,16 @@ class CompiledDAG:
         per_actor: Dict[Any, List[_ExecSpec]] = {}
         actor_of: Dict[Any, Any] = {}
         for n in nodes:
+            if isinstance(n, CollectiveOutputNode):
+                specs = per_actor.setdefault(n.actor._actor_id, [])
+                actor_of[n.actor._actor_id] = n.actor
+                # Fixed participant order on every actor: deterministic reduce.
+                arg_sources = [source_for(p) for p in n.participants]
+                specs.append(
+                    _ExecSpec(None, arg_sources, {}, chan_of.get(id(n)),
+                              reduce_op=n.op)
+                )
+                continue
             if not isinstance(n, ClassMethodNode):
                 continue
             specs = per_actor.setdefault(n.actor._actor_id, [])
@@ -293,6 +321,10 @@ def interpret(leaf: DAGNode, *args) -> Any:
             }
             method = getattr(n.actor, n.method_name)
             out = ray_tpu.get(method.remote(*call_args, **call_kwargs))
+        elif isinstance(n, CollectiveOutputNode):
+            from ray_tpu.dag.collective import reduce_values
+
+            out = reduce_values(n.op, [run(p) for p in n.participants])
         elif isinstance(n, MultiOutputNode):
             out = [run(o) for o in n.outputs]
         else:

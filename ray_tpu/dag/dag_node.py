@@ -88,3 +88,21 @@ class MultiOutputNode(DAGNode):
     def __init__(self, outputs: List[DAGNode]):
         super().__init__(list(outputs))
         self.outputs = list(outputs)
+
+
+class CollectiveOutputNode(DAGNode):
+    """Participant i's view of an in-graph collective (reference:
+    python/ray/dag/collective_node.py). Produced by `collective.allreduce.bind`:
+    each participant's actor reads every peer's contribution channel and reduces
+    locally, so the collective is part of the pinned exec loops — no extra task
+    submissions per round."""
+
+    def __init__(self, participants: List[ClassMethodNode], index: int, op: str,
+                 group_id: int):
+        # Upstream = ALL participants: the reduce consumes every contribution.
+        super().__init__(list(participants))
+        self.participants = list(participants)
+        self.index = index
+        self.op = op
+        self.group_id = group_id
+        self.actor = participants[index].actor

@@ -202,3 +202,75 @@ def test_teardown_with_blocked_writer():
             compiled.execute(i)
     finally:
         compiled.teardown()  # must not hang or leave the actor wedged
+
+
+def test_collective_allreduce_node():
+    """In-graph allreduce: each participant's loop reduces every peer's
+    contribution (reference: dag/collective_node.py + allreduce.bind)."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode, MultiOutputNode, collective
+
+    @ray_tpu.remote
+    class Shard:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def grads(self, x):
+            return np.full(4, float(x) * self.scale)
+
+        def apply(self, reduced):
+            return float(reduced.sum())
+
+    a, b, c = Shard.remote(1.0), Shard.remote(10.0), Shard.remote(100.0)
+    with InputNode() as inp:
+        contribs = [a.grads.bind(inp), b.grads.bind(inp), c.grads.bind(inp)]
+        reduced = collective.allreduce.bind(contribs, op="sum")
+        # Each participant consumes ITS copy of the reduced tensor.
+        outs = MultiOutputNode([
+            a.apply.bind(reduced[0]),
+            b.apply.bind(reduced[1]),
+            c.apply.bind(reduced[2]),
+        ])
+    dag = outs.experimental_compile()
+    try:
+        for x in (2.0, 3.0):
+            refs = dag.execute(x)
+            expect = 4 * x * (1 + 10 + 100)
+            vals = [r.get(timeout=120) for r in refs]
+            assert vals == [expect] * 3, vals
+    finally:
+        dag.teardown()
+
+
+def test_collective_mean_and_validation():
+    import numpy as np
+
+    from ray_tpu.dag import InputNode, MultiOutputNode, collective
+
+    @ray_tpu.remote
+    class W:
+        def val(self, x):
+            return np.asarray([float(x)])
+
+    w1, w2 = W.remote(), W.remote()
+    with InputNode() as inp:
+        n1, n2 = w1.val.bind(inp), w2.val.bind(inp)
+        r = collective.allreduce.bind([n1, n2], op="mean")
+        outs = MultiOutputNode(r)
+    dag = outs.experimental_compile()
+    try:
+        refs = dag.execute(8.0)
+        assert [float(x.get(timeout=120)[0]) for x in refs] == [8.0, 8.0]
+    finally:
+        dag.teardown()
+
+    with pytest.raises(ValueError, match="distinct actors"):
+        with InputNode() as inp:
+            n = w1.val.bind(inp)
+            collective.allreduce.bind([n, n])
+    with pytest.raises(ValueError, match="reduce op"):
+        with InputNode() as inp:
+            collective.allreduce.bind(
+                [w1.val.bind(inp), w2.val.bind(inp)], op="xor"
+            )
