@@ -59,7 +59,9 @@ def init(
 
     Parity: reference `ray.init` (python/ray/_private/worker.py:1427). address=None starts
     a head node locally; address="host:gcs_port" or the RAY_TPU_ADDRESS env var connects to
-    an existing cluster (a raylet must run on this machine; its port is discovered via GCS).
+    an existing cluster through a raylet on this machine; address="ray_tpu://host:gcs_port"
+    attaches as a THIN CLIENT with no local daemons — the data plane rides RPC to the head
+    node's raylet (reference: Ray Client, ray:// in util/client/).
     """
     if is_initialized():
         if ignore_reinit_error:
@@ -93,9 +95,54 @@ def init(
         _driver_state["session_dir"] = session_dir
         gcs_addr = ("127.0.0.1", head.gcs_port)
         raylet_addr = ("127.0.0.1", head.raylet_port)
+        from ray_tpu._private import usage_stats
+
+        usage_stats.start_session(session_dir, {"resources": total})
+    elif address.startswith("ray_tpu://"):
+        # Thin client: discover the head raylet via the GCS; no local daemons.
+        host, port = address[len("ray_tpu://"):].split(":")
+        gcs_addr = (host, int(port))
+        from ray_tpu._private import rpc as _rpclib
+
+        async def _head_raylet():
+            conn = await _rpclib.connect(*gcs_addr, name="client-probe")
+            try:
+                nodes = await conn.call("get_nodes")
+            finally:
+                await conn.close()
+            alive = [n for n in nodes if n["alive"]]
+            heads = [n for n in alive if n.get("is_head")] or alive
+            if not heads:
+                raise RuntimeError(f"no alive nodes behind {address}")
+            return tuple(heads[0]["address"])
+
+        # Probe on a private IO thread: init() must work from inside a running
+        # event loop (notebooks/async apps are the thin client's home turf).
+        probe_loop = _rpclib.IoLoop(name="client-probe")
+        try:
+            raylet_addr = probe_loop.run(_head_raylet(), 30)
+        finally:
+            probe_loop.stop()
+        from ray_tpu._private import usage_stats as _usage
+
+        _usage.start_session(_client_usage_dir(), {"mode": "thin-client"})
+        worker = CoreWorker(
+            mode="driver", raylet_addr=raylet_addr, gcs_addr=gcs_addr,
+            remote_data_plane=True,
+        )
+        set_global_worker(worker)
+        worker.connect()
+        _driver_state["worker"] = worker
+        atexit.register(_atexit_shutdown)
+        ctx = RuntimeContext(worker)
+        _driver_state["context"] = ctx
+        return ctx
     else:
         host, port = address.split(":")
         gcs_addr = (host, int(port))
+        from ray_tpu._private import usage_stats as _usage
+
+        _usage.start_session(_client_usage_dir(), {"mode": "connect"})
         raylet_port = _raylet_port or os.environ.get("RAY_TPU_RAYLET_PORT")
         if raylet_port is None:
             raise RuntimeError(
@@ -112,6 +159,16 @@ def init(
     ctx = RuntimeContext(worker)
     _driver_state["context"] = ctx
     return ctx
+
+
+def _client_usage_dir() -> str:
+    """Per-driver usage dir for drivers that did not start the head node."""
+    import tempfile
+
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu", f"usage_{os.getpid()}")
+    os.makedirs(d, exist_ok=True)
+    _driver_state.setdefault("session_dir", d)
+    return d
 
 
 def _atexit_shutdown():

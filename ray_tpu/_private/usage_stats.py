@@ -1,0 +1,85 @@
+"""Usage statistics: opt-out, local-only usage recording.
+
+Design parity: reference `python/ray/_common/usage/usage_lib.py` — an opt-out
+recorder of coarse cluster/library usage. Divergence by design: this framework
+targets air-gapped TPU pods, so nothing is ever transmitted; records land in a
+local JSON file under the session dir (the reference POSTs to a collector URL).
+Disable with RAY_TPU_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_state = {
+    "schema_version": 1,
+    "session_start": None,
+    "libraries_used": [],
+    "features_used": [],
+    "cluster": {},
+}
+_path: Optional[str] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+def start_session(session_dir: str, cluster_meta: dict):
+    global _path
+    if not enabled():
+        return
+    with _lock:
+        _path = os.path.join(session_dir, "usage_stats.json")
+        _state["session_start"] = time.time()
+        _state["cluster"] = dict(cluster_meta)
+    _flush()
+
+
+def record_library_usage(name: str):
+    """Called by library entry points (train/tune/serve/data/rllib/llm)."""
+    if not enabled():
+        return
+    with _lock:
+        if name not in _state["libraries_used"]:
+            _state["libraries_used"].append(name)
+    _flush()
+
+
+def record_feature(name: str):
+    if not enabled():
+        return
+    with _lock:
+        if name not in _state["features_used"]:
+            _state["features_used"].append(name)
+    _flush()
+
+
+def _flush():
+    with _lock:
+        path = _path
+        if path is None:
+            return
+        blob = json.dumps(_state, indent=2)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def read(session_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(session_dir, "usage_stats.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -361,8 +361,13 @@ class CoreWorker:
         gcs_addr: tuple[str, int],
         worker_id: WorkerID | None = None,
         job_id=None,
+        remote_data_plane: bool = False,
     ):
         self.mode = mode
+        # Thin-client mode (reference: Ray Client, util/client/): this process
+        # runs no local raylet, so plasma traffic rides RPC (put_bytes /
+        # read_chunk) to a remote raylet instead of shared memory.
+        self.remote_data_plane = remote_data_plane
         self.session_token = os.urandom(8).hex()  # distinguishes init/shutdown cycles
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id: NodeID | None = None
@@ -504,10 +509,37 @@ class CoreWorker:
 
     def _put_to_plasma(self, object_id: ObjectID, value: Any, owner: dict):
         pickled, raw_buffers, total = serialization.serialized_size(value)
+        self._write_plasma(object_id, pickled, raw_buffers, total, owner)
+
+    def _write_plasma(self, object_id: ObjectID, pickled, raw_buffers, total: int,
+                      owner: dict):
+        """The single plasma write path: shared memory locally, RPC bytes for
+        thin clients."""
+        if self.remote_data_plane:
+            self.raylet_call(
+                "store_put_bytes", object_id,
+                bytes(serialization.assemble(pickled, raw_buffers)), owner,
+            )
+            return
         shm_name = self.raylet_call("store_create", object_id, total)
         buf = self.reader.read(shm_name, total)
         serialization.write_parts(buf, pickled, raw_buffers)
         self.raylet_call("store_seal", object_id, total, owner)
+
+    def _read_remote_object(self, object_id: ObjectID, size: int) -> bytes:
+        """Thin-client read: stream the object over RPC in store-chunk units."""
+        chunks = []
+        offset = 0
+        step = CONFIG.object_store_min_chunk_bytes
+        while offset < size:
+            data = self.raylet_call(
+                "read_chunk", object_id, offset, min(step, size - offset)
+            )
+            if not data:
+                raise ObjectLostError(object_id, "remote read returned no data")
+            chunks.append(data)
+            offset += len(data)
+        return b"".join(chunks)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
         self.reference_counter.drain_deferred()
@@ -568,6 +600,24 @@ class CoreWorker:
         if "inline" in reply:
             data = reply["inline"]
             value = serialization.loads(data)
+        elif self.remote_data_plane:
+            _shm_name, size = reply["shm"]
+            try:
+                raw = self._read_remote_object(ref.id, size)
+            except rpc.RpcError:
+                # Stale location (freed/evicted between resolve and read): one
+                # re-resolve, mirroring the shared-memory branch below.
+                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+                if reply.get("error") or "shm" not in reply:
+                    raise ObjectLostError(ref.id, f"failed to re-resolve {ref}")
+                _shm_name, size = reply["shm"]
+                try:
+                    raw = self._read_remote_object(ref.id, size)
+                except rpc.RpcError as e:
+                    raise ObjectLostError(
+                        ref.id, f"object location stale twice for {ref}: {e}"
+                    )
+            value = serialization.loads(raw)
         else:
             shm_name, size = reply["shm"]
             try:
@@ -763,10 +813,9 @@ class CoreWorker:
                 object_id = ObjectID.from_task(
                     self.current_task_id, 0x20000000 + self._put_counter.next()
                 )
-                shm_name = self.raylet_call("store_create", object_id, total)
-                buf = self.reader.read(shm_name, total)
-                serialization.write_parts(buf, pickled, raw_buffers)
-                self.raylet_call("store_seal", object_id, total, self._owner_address())
+                self._write_plasma(
+                    object_id, pickled, raw_buffers, total, self._owner_address()
+                )
                 self.reference_counter.add_owned(object_id)
                 self.reference_counter.add_local_ref(object_id)
                 promoted.append(object_id)
@@ -835,7 +884,13 @@ class CoreWorker:
                 self.reference_counter.add_local_ref(pid)
         if promoted:
             self._pending_promoted[task_id] = promoted
-        self._record_event(task_id=task_id.hex(), name=name, state="SUBMITTED")
+        from ray_tpu.util import tracing
+
+        tctx = tracing.propagation_context()
+        if tctx:
+            spec["trace_ctx"] = tctx
+        self._record_event(task_id=task_id.hex(), name=name, state="SUBMITTED",
+                           **tracing.event_fields(tctx))
         if streaming:
             self._streams[task_id] = _StreamState()
         self._submit_when_ready(spec)
@@ -984,6 +1039,11 @@ class CoreWorker:
             self.reference_counter.add_owned(oid)
             self.memory_store.create_pending(oid)
             refs.append(ObjectRef(oid, owner))
+        from ray_tpu.util import tracing
+
+        tctx = tracing.propagation_context()
+        if tctx:
+            spec["trace_ctx"] = tctx
         if streaming:
             self._streams[task_id] = _StreamState()
         self._submit_when_ready(spec, target="submit_actor_task")
@@ -1196,9 +1256,13 @@ class CoreWorker:
             traceback.print_exc()
 
     def _execute_task(self, spec):
+        from ray_tpu.util import tracing
+
         prev_task = getattr(self._tls, "task_id", None)
         self._tls.task_id = spec["task_id"]
-        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING")
+        trace_token = tracing.activate(spec.get("trace_ctx"))
+        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING",
+                           **tracing.event_fields(spec.get("trace_ctx")))
         try:
             from ray_tpu._private import runtime_env as runtime_env_mod
 
@@ -1230,7 +1294,9 @@ class CoreWorker:
             state = "FAILED"
         finally:
             self._tls.task_id = prev_task
-        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state=state)
+            tracing.deactivate(trace_token)
+        self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state=state,
+                           **tracing.event_fields(spec.get("trace_ctx")))
         if spec["type"] == "actor_task":
             self.io.spawn(
                 self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)

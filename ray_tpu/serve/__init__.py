@@ -197,6 +197,9 @@ def run(
 
     route_prefix=None deploys without HTTP exposure (handle-only access).
     """
+    from ray_tpu._private import usage_stats
+
+    usage_stats.record_library_usage("serve")
     controller = start()
     acc: Dict[str, dict] = {}
     _collect_deployments(app, name, acc)

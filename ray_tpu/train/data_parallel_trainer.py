@@ -53,6 +53,9 @@ class DataParallelTrainer:
 
     def fit(self) -> Result:
         import ray_tpu
+        from ray_tpu._private import usage_stats
+
+        usage_stats.record_library_usage("train")
         from ray_tpu.train._internal.controller import run_controller_detached
 
         backend = self._backend_config.backend_cls()()

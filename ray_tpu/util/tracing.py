@@ -1,0 +1,98 @@
+"""Distributed tracing: span context propagated through task submission.
+
+Design parity: reference `python/ray/util/tracing/tracing_helper.py` — opt-in
+tracing that wraps remote calls in spans and propagates the context inside task
+metadata (lazy/optional exporter). Here spans ride the existing task-event
+pipeline (worker event buffer -> GCS -> `ray_tpu.timeline()` Chrome trace), so a
+trace is reconstructable without any external collector: every event of a traced
+call carries (trace_id, span_id, parent_span_id). Enable with
+`tracing.enable()` or RAY_TPU_TRACING=1.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import uuid
+from typing import Optional
+
+_flag = {"enabled": None}
+_ctx: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None
+)
+
+
+def enabled() -> bool:
+    if _flag["enabled"] is not None:
+        return _flag["enabled"]
+    return os.environ.get("RAY_TPU_TRACING", "0").lower() in ("1", "true", "on")
+
+
+def enable():
+    _flag["enabled"] = True
+
+
+def disable():
+    _flag["enabled"] = False
+
+
+def current() -> Optional[dict]:
+    """The active span context {trace_id, span_id} (or None)."""
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def trace(name: str = "root"):
+    """Open a root span: every remote call made inside carries this trace."""
+    ctx = {
+        "trace_id": uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "name": name,
+    }
+    token = _ctx.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx.reset(token)
+
+
+def propagation_context() -> Optional[dict]:
+    """Context to embed in an outgoing task spec: a fresh child span under the
+    caller's active span. An ACTIVE span always propagates — a worker executing
+    a traced task forwards the trace to nested calls even if tracing was never
+    enabled in that worker process (reference: context rides task metadata)."""
+    parent = _ctx.get()
+    if parent is None:
+        if not enabled():
+            return None
+        parent = {"trace_id": uuid.uuid4().hex, "span_id": None}
+    return {
+        "trace_id": parent["trace_id"],
+        "parent_span_id": parent.get("span_id"),
+        "span_id": uuid.uuid4().hex[:16],
+    }
+
+
+def activate(trace_ctx: Optional[dict]):
+    """Executor side: adopt the caller's span for the duration of the task."""
+    if trace_ctx is None:
+        return None
+    return _ctx.set(
+        {"trace_id": trace_ctx["trace_id"], "span_id": trace_ctx["span_id"]}
+    )
+
+
+def deactivate(token):
+    if token is not None:
+        _ctx.reset(token)
+
+
+def event_fields(trace_ctx: Optional[dict]) -> dict:
+    if not trace_ctx:
+        return {}
+    return {
+        "trace_id": trace_ctx.get("trace_id"),
+        "span_id": trace_ctx.get("span_id"),
+        "parent_span_id": trace_ctx.get("parent_span_id"),
+    }

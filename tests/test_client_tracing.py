@@ -1,0 +1,121 @@
+"""Thin-client mode (ray_tpu://), tracing propagation, usage stats.
+
+Reference shapes: Ray Client (ray:// in util/client/), tracing_helper span
+propagation through task metadata, usage_lib opt-out recording.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_thin_client_mode():
+    """ray_tpu://host:port attaches with NO local daemons: the data plane rides
+    RPC to the head raylet (put_bytes / read_chunk) instead of shared memory."""
+    from ray_tpu.cluster_utils import Cluster
+    from tests.conftest import _WORKER_ENV
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 2, "env_vars": _WORKER_ENV}
+    )
+    try:
+        ctx = ray_tpu.init(address=f"ray_tpu://{cluster.address}")
+        assert ctx is not None
+        w = ray_tpu.global_worker()
+        assert w.remote_data_plane
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        assert ray_tpu.get(double.remote(21), timeout=120) == 42
+
+        # Plasma-sized traffic both directions over the RPC data plane.
+        big = np.arange(500_000, dtype=np.float64)
+        ref = ray_tpu.put(big)
+        back = ray_tpu.get(ref, timeout=120)
+        np.testing.assert_array_equal(back, big)
+
+        @ray_tpu.remote
+        def make_big():
+            return np.ones(400_000)
+
+        arr = ray_tpu.get(make_big.remote(), timeout=120)
+        assert float(arr.sum()) == 400_000.0
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=120) == 1
+        assert ray_tpu.get(c.incr.remote(), timeout=120) == 2
+    finally:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_tracing_spans_propagate(ray_start_isolated):
+    """Spans flow through nested remote calls into the task-event pipeline."""
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+
+        @ray_tpu.remote
+        def child(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def parent(x):
+            return ray_tpu.get(child.remote(x)) + 10
+
+        with tracing.trace("workflow") as root:
+            assert ray_tpu.get(parent.remote(1), timeout=120) == 12
+            trace_id = root["trace_id"]
+
+        w = ray_tpu.global_worker()
+
+        def traced_events():
+            events = w.gcs_call("list_task_events", 5000)
+            return [e for e in events if e.get("trace_id") == trace_id]
+
+        deadline = time.monotonic() + 30
+        by_name = {}
+        while time.monotonic() < deadline:
+            evs = traced_events()
+            by_name = {}
+            for e in evs:
+                by_name.setdefault(e["name"], []).append(e)
+            if "parent" in by_name and "child" in by_name:
+                break
+            time.sleep(0.5)
+        assert "parent" in by_name and "child" in by_name, by_name.keys()
+        parent_span = by_name["parent"][0]["span_id"]
+        child_ev = by_name["child"][0]
+        # The child's parent span is the parent TASK's span: one connected trace.
+        assert child_ev["parent_span_id"] == parent_span
+    finally:
+        tracing.disable()
+
+
+def test_usage_stats_recorded(ray_start_isolated):
+    from ray_tpu import _driver_state
+    from ray_tpu._private import usage_stats
+
+    session_dir = _driver_state.get("session_dir")
+    assert session_dir
+    usage_stats.record_library_usage("unit-test-lib")
+    stats = usage_stats.read(session_dir)
+    assert stats is not None
+    assert "unit-test-lib" in stats["libraries_used"]
+    assert stats["cluster"].get("resources")
