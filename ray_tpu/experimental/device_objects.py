@@ -1,0 +1,151 @@
+"""Device-resident objects: tensor payloads that stay in accelerator memory.
+
+Design parity: reference "Ray Direct Transport" (RDT) —
+`python/ray/experimental/gpu_object_manager/` + `@ray.remote(tensor_transport=...)`:
+ObjectRefs whose tensor payload never leaves device memory on the producing actor;
+consumers on the same actor use it with zero transfer, remote consumers fetch it
+through a transport (NCCL/NIXL there). TPU-first shape: jax Arrays live in the
+producing actor's HBM keyed by a small DeviceObjectRef descriptor that travels
+through the ordinary object plane; same-actor resolution is a dict lookup (no
+transfer), cross-process resolution is one host round-trip (device_get -> numpy ->
+object plane). On TPU pods, tensors that must move BETWEEN chips belong inside
+jitted SPMD programs where XLA schedules ICI collectives — this API is for keeping
+large tensors pinned to an actor across calls (KV caches, optimizer state,
+sampled rollouts) without paying host serialization per call.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID
+
+
+@dataclass(frozen=True)
+class DeviceObjectRef:
+    """A handle to a tensor living in a specific actor's device memory."""
+
+    actor_id: ActorID
+    key: str
+    shape: tuple
+    dtype: str
+
+    def __repr__(self):
+        return (
+            f"DeviceObjectRef({self.key[:8]}@{self.actor_id.hex()[:8]}, "
+            f"{self.dtype}{list(self.shape)})"
+        )
+
+
+class _ActorDeviceStore:
+    """Per-process store of device arrays (the gpu_object_store.py role)."""
+
+    def __init__(self):
+        self._objects: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, value):
+        with self._lock:
+            self._objects[key] = value
+
+    def get(self, key: str):
+        with self._lock:
+            if key not in self._objects:
+                raise ValueError(
+                    f"device object {key[:8]}… is not pinned here: it was freed, "
+                    f"its owner restarted, or the descriptor is stale"
+                )
+            return self._objects[key]
+
+    def pop(self, key: str):
+        with self._lock:
+            return self._objects.pop(key, None)
+
+    def keys(self):
+        with self._lock:
+            return list(self._objects)
+
+
+_store = _ActorDeviceStore()
+
+
+def _current_actor_id() -> ActorID:
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    if w.actor_id is None:
+        raise RuntimeError(
+            "device objects live in actor processes; put() must run inside an "
+            "actor method (reference: RDT objects are actor-owned)"
+        )
+    return w.actor_id
+
+
+def put(value) -> DeviceObjectRef:
+    """Pin a (jax) array in THIS actor's device memory; return its descriptor.
+    The descriptor is tiny and travels through the normal object plane."""
+    import jax.numpy as jnp
+
+    actor_id = _current_actor_id()  # validate context BEFORE pinning anything
+    # Unconditional device placement: a numpy input must land in HBM, or every
+    # later use pays host->device per call; no-op for arrays already on device.
+    arr = jnp.asarray(value)
+    key = uuid.uuid4().hex
+    _store.put(key, arr)
+    return DeviceObjectRef(
+        actor_id=actor_id,
+        key=key,
+        shape=tuple(arr.shape),
+        dtype=str(arr.dtype),
+    )
+
+
+def _run_on_owner(ref: DeviceObjectRef, local_fn, remote_fn):
+    """Local dict op on the owner; one remote __rtpu_apply__ hop elsewhere."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    if w.actor_id is not None and w.actor_id == ref.actor_id:
+        return local_fn()
+    import ray_tpu
+    from ray_tpu.actor import ActorHandle, ActorMethod
+
+    handle = ActorHandle(ref.actor_id, [], "DeviceObjectOwner")
+    return ray_tpu.get(
+        ActorMethod(handle, "__rtpu_apply__").remote(remote_fn, ref.key)
+    )
+
+
+def get(ref: DeviceObjectRef):
+    """Resolve a descriptor to its array.
+
+    Same actor: the device array itself, zero transfer. Elsewhere: one fetch
+    through the owning actor (device -> host numpy -> object plane) — the
+    explicit-transport fallback, like RDT's non-collective path."""
+    return _run_on_owner(ref, lambda: _store.get(ref.key), _fetch_host)
+
+
+def free(ref: DeviceObjectRef) -> bool:
+    """Release the pinned array on its owner (descriptors are not refcounted;
+    the owner pins until freed or actor death — divergence from RDT noted in
+    docs/divergences.md)."""
+    return _run_on_owner(ref, lambda: _store.pop(ref.key) is not None, _free_local)
+
+
+def _fetch_host(_instance, key: str):
+    """Runs on the owning actor: device -> host for the object plane."""
+    import numpy as np
+
+    return np.asarray(_store.get(key))
+
+
+def _free_local(_instance, key: str) -> bool:
+    return _store.pop(key) is not None
+
+
+def stored_keys() -> list:
+    """Keys pinned in THIS process (introspection/testing)."""
+    return _store.keys()
