@@ -347,6 +347,11 @@ class GcsService:
         self.subscribers.setdefault(channel, set()).add(conn)
         return True
 
+    async def rpc_publish_worker_logs(self, conn, message):
+        """Raylet log monitor relay: fan worker log lines out to drivers."""
+        await self.publish("worker_logs", message)
+        return True
+
     async def rpc_unsubscribe(self, conn, channel: str):
         self.subscribers.get(channel, set()).discard(conn)
         return True
@@ -552,6 +557,10 @@ class GcsService:
     # ---------------- placement groups ----------------
 
     async def rpc_create_placement_group(self, conn, pg_id: PlacementGroupID, bundles, strategy, name=""):
+        if pg_id in self.placement_groups:
+            # Idempotent under gcs_call's reconnect-retry (same guard as
+            # rpc_register_actor): a replay must not re-reserve bundles.
+            return True
         pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = pg
         self.store.put("pgs", pg_id, {"bundles": bundles, "strategy": strategy, "name": name})

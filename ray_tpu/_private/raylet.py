@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -33,11 +34,12 @@ from ray_tpu._private.object_store import SharedObjectStore
 
 class WorkerHandle:
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen | None, kind: str,
-                 env_key: str | None = None):
+                 env_key: str | None = None, log_path: str | None = None):
         self.worker_id = worker_id
         self.proc = proc
         self.kind = kind  # "worker" | "driver" | "actor"
         self.env_key = env_key  # pip-env hash this worker's interpreter serves
+        self.log_path = log_path  # worker stdout/stderr file (death-cause tail)
         self.conn: rpc.Connection | None = None
         self.registered = asyncio.Event()
         self.busy_task: dict | None = None  # currently running normal task spec
@@ -46,6 +48,10 @@ class WorkerHandle:
         self.acquired: dict[str, float] = {}
         self.pg_key: tuple | None = None  # bundle the acquisition came from, if any
         self.last_idle = time.monotonic()
+        self.started_at = time.monotonic()
+        self.task_started_at = 0.0  # dispatch time of busy_task (OOM kill order)
+        self.oom_killed: tuple | None = None  # (usage_frac, threshold) when reaped
+        self.log_owner: str | None = None  # worker_id hex of current work's owner
 
     @property
     def alive(self):
@@ -169,6 +175,8 @@ class Raylet:
         self._venv_python: dict[str, str] = {}
         self._venv_failed: dict[str, tuple[str, float]] = {}  # key -> (err, at)
         self._venv_building: set[str] = set()
+        self._gcs_connected_at = time.monotonic()  # refreshed on every (re)connect
+        self._full_node_view: dict[NodeID, dict] = {}  # incl. alive=False nodes
         self._shutdown = False
 
     # ------------------------------------------------------------------ startup
@@ -182,6 +190,8 @@ class Raylet:
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._scheduler_loop())
         loop.create_task(self._idle_reaper_loop())
+        loop.create_task(self._log_monitor_loop())
+        loop.create_task(self._memory_monitor_loop())
         return self
 
     async def _connect_gcs(self, deadline_s: float = 60.0):
@@ -219,6 +229,9 @@ class Raylet:
             [(oid, sz, owner) for oid, (sz, owner) in self._sealed_objects.items()],
             list(self.resources.bundles.keys()),
         )
+        # Delegation-recovery grace starts now: peers need time to re-register
+        # with a restarted GCS before their absence can be read as death.
+        self._gcs_connected_at = time.monotonic()
 
     def _on_gcs_lost(self, conn):
         if self._shutdown:
@@ -252,24 +265,36 @@ class Raylet:
                 )
                 nodes = await self.gcs.call("get_nodes")
                 self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
+                self._full_node_view = {n["node_id"]: n for n in nodes}
                 await self._check_delegations()
             except rpc.RpcError:
                 pass
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
 
     async def _check_delegations(self):
-        """Backstop for a missed node-removal pubsub event: a delegation whose
-        target has been absent from the cluster view for two heartbeats is
-        recovered as if the node-death notification had arrived."""
+        """Backstop for a missed node-removal pubsub event.
+
+        A target the GCS affirmatively marks dead (alive=False) is recovered at
+        once. A target merely *absent* from the view gets a longer grace — after
+        a GCS restart, get_nodes only lists re-registered raylets, so a slow
+        peer must not be treated as dead (that would duplicate normal tasks and
+        spuriously fail in-flight actor calls against a live node)."""
         now = time.monotonic()
+        full_view = getattr(self, "_full_node_view", {})
+        in_reconnect_grace = now - self._gcs_connected_at < 4 * CONFIG.heartbeat_interval_s
         dead_targets = set()
         for entry in self.delegated.values():
-            if entry["target"] in self.node_view:
+            target = entry["target"]
+            if target in self.node_view:
+                entry["missing_since"] = None
+            elif target in full_view:  # present but alive=False: confirmed dead
+                dead_targets.add(target)
+            elif in_reconnect_grace:
                 entry["missing_since"] = None
             elif entry["missing_since"] is None:
                 entry["missing_since"] = now
             elif now - entry["missing_since"] > 2 * CONFIG.heartbeat_interval_s:
-                dead_targets.add(entry["target"])
+                dead_targets.add(target)
         for target in dead_targets:
             await self._recover_delegated(target)
 
@@ -321,7 +346,8 @@ class Raylet:
         worker_id = WorkerID.from_random()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "wb")
+        log_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log")
+        out = open(log_path, "wb")
         env = dict(os.environ)
         env.update(self.worker_env)
         from ray_tpu._private.node import _package_pythonpath
@@ -331,13 +357,17 @@ class Raylet:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_RAYLET_PORT"] = str(self.port)
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
+        # Unbuffered so crash tracebacks reach the log file even on abrupt death
+        # (reference: worker stdout/stderr files tailed by log_monitor.py).
+        env["PYTHONUNBUFFERED"] = "1"
         proc = subprocess.Popen(
             [python_exe or sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env,
             stdout=out,
             stderr=subprocess.STDOUT,
         )
-        handle = WorkerHandle(worker_id, proc, kind, env_key=env_key)
+        out.close()  # child owns its duplicated fd; don't leak one per spawn
+        handle = WorkerHandle(worker_id, proc, kind, env_key=env_key, log_path=log_path)
         self.workers[worker_id] = handle
         return handle
 
@@ -460,6 +490,147 @@ class Raylet:
             except Exception:
                 pass
 
+    async def _death_cause(self, handle: WorkerHandle, base: str) -> str:
+        """Structured death cause: exit code / signal + tail of the worker's log.
+
+        Reference: ActorDeathCause (src/ray/protobuf/common.proto) attaches the
+        why to actor death instead of a bare "actor died".
+        """
+        rc = None
+        if handle.proc is not None:
+            for _ in range(10):  # give the OS up to ~1s to reap the exit status
+                rc = handle.proc.poll()
+                if rc is not None:
+                    break
+                await asyncio.sleep(0.1)
+        cause = base
+        if handle.oom_killed is not None:
+            frac, threshold = handle.oom_killed
+            cause = (
+                f"{base}: killed by the node memory monitor (memory usage "
+                f"{frac:.2f} > threshold {threshold:.2f})"
+            )
+        if rc is not None:
+            if rc < 0:
+                try:
+                    signame = signal.Signals(-rc).name
+                except ValueError:
+                    signame = f"signal {-rc}"
+                cause += f" (killed by {signame})"
+            else:
+                cause += f" (exit code {rc})"
+        tail = self._tail_log(handle.log_path)
+        if tail:
+            cause += f"; last lines of {os.path.basename(handle.log_path)}:\n{tail}"
+        return cause
+
+    @staticmethod
+    def _tail_log(log_path: str | None, max_bytes: int = 4096, max_lines: int = 20) -> str:
+        if not log_path:
+            return ""
+        try:
+            with open(log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                data = f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+        lines = [ln for ln in data.splitlines() if ln.strip()]
+        return "\n".join(lines[-max_lines:])
+
+    async def _memory_monitor_loop(self):
+        """OOM defense: kill workers (group-by-owner, retriable first) when node
+        memory crosses the threshold, instead of letting the node thrash/die.
+
+        Reference: memory_monitor.h:52 polling + worker_killing_policy_group_by_owner.h:87.
+        """
+        refresh_ms = CONFIG.memory_monitor_refresh_ms
+        if refresh_ms <= 0:
+            return
+        from ray_tpu._private.memory_monitor import MemoryMonitor, pick_worker_to_kill
+
+        monitor = MemoryMonitor(CONFIG.meminfo_path)
+        threshold = CONFIG.memory_usage_threshold
+        above_since: float | None = None
+        while not self._shutdown:
+            await asyncio.sleep(refresh_ms / 1000.0)
+            frac = monitor.usage_fraction()
+            if frac is None or frac < threshold:
+                above_since = None
+                continue
+            now = time.monotonic()
+            if above_since is None:
+                above_since = now
+                continue
+            if now - above_since < CONFIG.memory_monitor_min_wait_s:
+                continue
+            victim = pick_worker_to_kill(list(self.workers.values()))
+            if victim is None:
+                continue
+            victim.oom_killed = (frac, threshold)
+            above_since = None  # re-debounce before the next kill
+            await self._kill_worker(victim)
+
+    async def _log_monitor_loop(self):
+        """Tail every worker's log file and publish new lines to the driver.
+
+        Reference: python/ray/_private/log_monitor.py streams per-worker
+        stdout/stderr files back to the driver via GCS pubsub.
+        """
+        offsets: dict[str, int] = {}  # log_path -> bytes already shipped
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            for handle in list(self.workers.values()):
+                path = handle.log_path
+                if not path or handle.kind == "driver":
+                    continue
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                off = offsets.get(path, 0)
+                if size <= off:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(min(size - off, 256 * 1024))
+                except OSError:
+                    continue
+                # Ship whole lines only; hold a trailing partial line for later —
+                # unless the window is full with no newline (one giant line):
+                # ship it truncated and advance, or the tail would stall forever.
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    if len(chunk) < 256 * 1024:
+                        continue
+                    offsets[path] = off + len(chunk)
+                    text = chunk.decode("utf-8", "replace") + "...[line truncated]"
+                else:
+                    offsets[path] = off + cut + 1
+                    text = chunk[:cut].decode("utf-8", "replace")
+                lines = [ln for ln in text.splitlines() if ln.strip()]
+                if not lines:
+                    continue
+                owner = getattr(handle, "log_owner", None)
+                msg = {
+                    "kind": handle.kind,
+                    "pid": handle.proc.pid if handle.proc else None,
+                    "node": self.node_id.hex(),
+                    "owner": owner,  # driver scoping: worker_id hex of work's owner
+                    "lines": lines[:200],
+                }
+                try:
+                    await self.gcs.notify("publish_worker_logs", msg)
+                except Exception:
+                    pass
+            # Drop offsets of files whose workers are gone (bounded memory).
+            live = {h.log_path for h in self.workers.values() if h.log_path}
+            for path in list(offsets):
+                if path not in live:
+                    offsets.pop(path)
+
     def _on_worker_lost(self, handle: WorkerHandle):
         """Worker connection dropped: fail or retry its in-flight work."""
         self.workers.pop(handle.worker_id, None)
@@ -480,15 +651,31 @@ class Raylet:
                 self.task_queue.append(spec)
                 self._sched_wakeup.set()
             else:
-                loop.create_task(self._fail_task(spec, "worker died during execution"))
-        if handle.actor_id is not None:
+                async def fail_with_cause(spec=spec):
+                    await self._fail_task(
+                        spec,
+                        await self._death_cause(handle, "worker died during execution"),
+                        oom=handle.oom_killed is not None,
+                    )
+
+                loop.create_task(fail_with_cause())
+        if handle.actor_id is not None or handle.inflight_actor_tasks:
             actor_id = handle.actor_id
-            self.actors.pop(actor_id, None)
-            loop.create_task(self._report_actor_failure(actor_id, "actor worker process died"))
-        # Fail actor calls that were pushed but never completed (caller would hang).
-        for spec in list(handle.inflight_actor_tasks.values()):
-            loop.create_task(self._fail_actor_task(spec, "actor died during method call"))
-        handle.inflight_actor_tasks.clear()
+            inflight = list(handle.inflight_actor_tasks.values())
+            handle.inflight_actor_tasks.clear()
+
+            async def report_with_cause():
+                cause = await self._death_cause(handle, "actor worker process died")
+                if actor_id is not None:
+                    await self._report_actor_failure(actor_id, cause)
+                # Fail actor calls that were pushed but never completed
+                # (caller would hang otherwise).
+                for spec in inflight:
+                    await self._fail_actor_task(spec, cause)
+
+            if actor_id is not None:
+                self.actors.pop(actor_id, None)
+            loop.create_task(report_with_cause())
 
     async def _report_actor_failure(self, actor_id: ActorID, reason: str):
         try:
@@ -496,11 +683,12 @@ class Raylet:
         except rpc.RpcError:
             pass
 
-    async def _fail_task(self, spec: dict, reason: str):
+    async def _fail_task(self, spec: dict, reason: str, oom: bool = False):
         from ray_tpu._private import serialization
-        from ray_tpu.exceptions import WorkerCrashedError
+        from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
 
-        err = serialization.dumps(WorkerCrashedError(f"task {spec.get('name')} failed: {reason}"))
+        err_cls = OutOfMemoryError if oom else WorkerCrashedError
+        err = serialization.dumps(err_cls(f"task {spec.get('name')} failed: {reason}"))
         results = [
             {"object_id": oid, "inline": err, "error": True}
             for oid in spec["return_ids"]
@@ -696,6 +884,9 @@ class Raylet:
         worker.acquired = demand
         worker.pg_key = pg_key
         worker.busy_task = spec
+        worker.task_started_at = time.monotonic()
+        owner_wid = (spec.get("owner") or {}).get("worker_id")
+        worker.log_owner = owner_wid.hex() if hasattr(owner_wid, "hex") else None
         self.running[spec["task_id"]] = spec
         try:
             await worker.conn.notify("push_task", spec)
@@ -1078,20 +1269,26 @@ class Raylet:
         try:
             await asyncio.wait_for(handle.registered.wait(), CONFIG.worker_register_timeout_s)
         except asyncio.TimeoutError:
+            # Kill first so _death_cause sees the exit status immediately
+            # instead of polling a still-live process for its full wait.
             await cleanup(handle)
-            return {"ok": False, "reason": "worker_start_timeout"}
+            reason = await self._death_cause(handle, "actor worker failed to register")
+            return {"ok": False, "reason": reason}
         handle.acquired = demand
         handle.pg_key = pg_key
         try:
             result = await handle.conn.call("init_actor", actor_id, spec, timeout=300)
         except rpc.RpcError as e:
             await cleanup(handle)
-            return {"ok": False, "reason": f"worker died during init: {e}"}
+            reason = await self._death_cause(handle, f"worker died during init: {e}")
+            return {"ok": False, "reason": reason}
         if not result.get("ok"):
             await cleanup(handle)
             # Application error in __init__: retrying cannot help.
             return {"ok": False, "reason": result.get("error", "init failed"), "fatal": True}
         handle.actor_id = actor_id
+        owner_wid = (spec.get("owner") or {}).get("worker_id")
+        handle.log_owner = owner_wid.hex() if hasattr(owner_wid, "hex") else None
         self.actors[actor_id] = handle.worker_id
         return {"ok": True, "worker_id": handle.worker_id}
 
@@ -1111,7 +1308,14 @@ class Raylet:
             return False
         addr = await self._actor_address(actor_id)
         if addr is None:
-            await self._fail_actor_task(spec, "actor not found or dead")
+            reason = "actor not found or dead"
+            try:  # surface the GCS-recorded death cause, not a bare "dead"
+                info = await self.gcs.call("get_actor_info", actor_id)
+                if info is not None and info.get("death_cause"):
+                    reason = f"actor is dead: {info['death_cause']}"
+            except rpc.RpcError:
+                pass
+            await self._fail_actor_task(spec, reason)
             return False
         if addr["node_id"] == self.node_id:
             handle = self.workers.get(addr["worker_id"])

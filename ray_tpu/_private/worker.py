@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import sys
 import threading
 import time
 import traceback
@@ -422,6 +423,10 @@ class CoreWorker:
         self.node_id = reply["node_id"]
         if self.mode == "worker":
             self.raylet.on_close(lambda c: os._exit(0))
+        elif os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") not in ("0", "false"):
+            # Drivers see worker stdout/stderr live (reference: log_monitor.py
+            # tails per-worker files and streams them to the driver).
+            self.io.run(self.gcs.call("subscribe", "worker_logs"))
         if self.job_id is None:
             self.job_id = self.io.run(self.gcs.call("next_job_id"))
         self._connected = True
@@ -1139,6 +1144,22 @@ class CoreWorker:
         return {"data": rec.data}
 
     async def rpc_publish(self, conn, channel, message):
+        if channel == "worker_logs" and self.mode != "worker":
+            # Scope to this driver: a worker's lines are shipped tagged with the
+            # owner of the work it is running (reference: log_monitor publishes
+            # per-job and drivers subscribe to their own job's channel). Lines
+            # from work owned by another driver are dropped; untagged lines
+            # (idle-worker chatter, system actors) go to every driver.
+            owner = message.get("owner")
+            if owner is not None and owner != self.worker_id.hex():
+                return True
+            try:
+                prefix = f"({message.get('kind', 'worker')} pid={message.get('pid')}, node={message.get('node', '')[:8]})"
+                out = "".join(f"{prefix} {ln}\n" for ln in message.get("lines", ()))
+                sys.stderr.write(out)
+                sys.stderr.flush()
+            except Exception:
+                pass
         return True
 
     async def rpc_push_task(self, conn, spec):

@@ -70,11 +70,21 @@ def _rebuild_task_error(function_name, tb_str, cause):
 
 
 class RayTpuActorError(RayTpuError):
-    """The actor died before or during method execution."""
+    """The actor died before or during method execution.
+
+    Carries a structured death cause (reference: ActorDeathCause in
+    src/ray/protobuf/common.proto) — exit code / signal and the tail of the dead
+    worker's log — in the message so `get()` on a dead actor's call explains itself.
+    """
 
     def __init__(self, actor_id=None, msg: str = "actor died"):
         self.actor_id = actor_id
         super().__init__(msg)
+
+    def __reduce__(self):
+        # Default Exception pickling would call cls(msg), shifting the message
+        # into the actor_id slot and silently resetting msg to "actor died".
+        return (type(self), (self.actor_id, self.args[0] if self.args else "actor died"))
 
 
 class ActorDiedError(RayTpuActorError):
@@ -93,6 +103,10 @@ class ObjectLostError(RayTpuError):
     def __init__(self, object_id=None, msg: str | None = None):
         self.object_id = object_id
         super().__init__(msg or f"object {object_id} lost and could not be reconstructed")
+
+    def __reduce__(self):
+        # Same pitfall as RayTpuActorError: keep object_id out of the msg slot.
+        return (type(self), (self.object_id, self.args[0] if self.args else None))
 
 
 class ObjectStoreFullError(RayTpuError):
