@@ -34,6 +34,11 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "object_store_min_chunk_bytes": (int, 1024 * 1024, "chunk size for node-to-node object transfer"),
     "memory_store_max_inline_refs": (int, 10000, "max unresolved inline futures per worker"),
     "actor_queue_warn_size": (int, 5000, "warn when an actor's pending call queue exceeds this"),
+    # --- memory / OOM defense ---
+    "memory_monitor_refresh_ms": (int, 250, "node memory poll interval for the OOM monitor; 0 disables worker killing (reference: memory_monitor_refresh_ms)"),
+    "memory_usage_threshold": (float, 0.95, "kill workers when node memory usage crosses this fraction (reference: memory_usage_threshold)"),
+    "memory_monitor_min_wait_s": (float, 1.0, "usage must stay above threshold this long before a kill (debounce against transient spikes)"),
+    "meminfo_path": (str, "/proc/meminfo", "meminfo source; tests point this at a fake file to simulate pressure"),
     # --- scheduling ---
     "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
     "lease_timeout_s": (float, 30.0, "worker lease validity"),

@@ -1,0 +1,105 @@
+"""Node memory monitor + group-by-owner worker-killing policy (OOM defense).
+
+Design parity: reference `src/ray/common/memory_monitor.h:52` — poll node memory
+usage (cgroup v2 when present, else /proc/meminfo) against a kill threshold —
+and `src/ray/raylet/worker_killing_policy_group_by_owner.h:87` — group running
+tasks by their owner, prefer evicting groups whose tasks are retriable, and
+within the chosen group kill the worker running the newest task, so older
+(further-progressed) work survives and the node never thrashes to death.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _read_meminfo(path: str) -> tuple[int, int] | None:
+    """(total_bytes, available_bytes) from a /proc/meminfo-format file."""
+    total = avail = None
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                if total is not None and avail is not None:
+                    return total, avail
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _read_cgroup_v2() -> tuple[int, int] | None:
+    """(limit_bytes, current_bytes) for a memory-limited cgroup, else None."""
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw == "max":
+            return None
+        limit = int(raw)
+        with open("/sys/fs/cgroup/memory.current") as f:
+            current = int(f.read().strip())
+        return limit, current
+    except (OSError, ValueError):
+        return None
+
+
+class MemoryMonitor:
+    """Computes the node's memory usage fraction on demand."""
+
+    def __init__(self, meminfo_path: str = "/proc/meminfo"):
+        self._meminfo_path = meminfo_path
+
+    def usage_fraction(self) -> float | None:
+        # A test-provided meminfo path bypasses cgroup discovery so fakes work
+        # deterministically (reference tests monkeypatch MemoryMonitor the same
+        # way, python/ray/tests/test_memory_pressure.py).
+        if self._meminfo_path == "/proc/meminfo":
+            cg = _read_cgroup_v2()
+            if cg is not None:
+                limit, current = cg
+                if limit > 0:
+                    return current / limit
+        info = _read_meminfo(self._meminfo_path)
+        if info is None:
+            return None
+        total, avail = info
+        if total <= 0:
+            return None
+        return 1.0 - avail / total
+
+
+def pick_worker_to_kill(handles: list) -> object | None:
+    """Group-by-owner, retriable-first, newest-task-first victim selection.
+
+    `handles` are raylet WorkerHandles. Never selects drivers. Returns None when
+    there is nothing safe to kill (an empty node cannot relieve pressure by
+    killing workers).
+    """
+    tasks = [
+        h for h in handles
+        if h.kind == "worker" and h.busy_task is not None
+    ]
+    if tasks:
+        groups: dict[str, list] = {}
+        for h in tasks:
+            owner = (h.busy_task.get("owner") or {}).get("worker_id")
+            key = owner.hex() if hasattr(owner, "hex") else str(owner)
+            groups.setdefault(key, []).append(h)
+
+        def group_rank(members: list) -> tuple:
+            retriable = all(
+                m.busy_task.get("retries_left", 0) > 0 for m in members
+            )
+            newest = max(getattr(m, "task_started_at", 0.0) for m in members)
+            # Retriable groups first (their work is recoverable); then the
+            # group whose newest task started last (least progress lost).
+            return (0 if retriable else 1, -newest)
+
+        victims = min(groups.values(), key=group_rank)
+        return max(victims, key=lambda m: getattr(m, "task_started_at", 0.0))
+    actors = [h for h in handles if h.actor_id is not None and h.kind != "driver"]
+    if actors:
+        return max(actors, key=lambda m: getattr(m, "started_at", 0.0))
+    return None
