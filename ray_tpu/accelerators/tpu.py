@@ -13,13 +13,55 @@ from __future__ import annotations
 
 import os
 
-# chips per host for common TPU generations (full-host slices)
-_CHIPS_PER_HOST = 4
-
 
 def _env(name: str) -> str | None:
     v = os.environ.get(name)
     return v if v else None
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _gce_metadata(key: str) -> str | None:
+    """GCE instance metadata lookup (reference tpu.py:199-250); best-effort,
+    short timeout — returns None off-GCE or when the metadata server is absent.
+    Cached: off-GCE the DNS stall must happen at most once per process."""
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://metadata.google.internal/computeMetadata/v1/instance/attributes/{key}",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=0.5) as resp:
+            return resp.read().decode() or None
+    except Exception:
+        return None
+
+
+def _accelerator_type() -> str | None:
+    return _env("TPU_ACCELERATOR_TYPE") or _gce_metadata("accelerator-type")
+
+
+def _chips_per_host(accel: str) -> int:
+    """Chips this host contributes to the slice, derived from the accelerator type.
+
+    v2/v3/v4/v5p name slices by TensorCore count (2 cores/chip, up to 4 chips per
+    host); v5e (v5litepod) and v6e name them by chip count (1 core/chip). A
+    single-host v5e/v6e slice packs up to 8 chips (v5e-8 = one 8-chip host), but
+    multi-host slices are built from 4-chip hosts (v5e-16 = 4 hosts x 4 chips).
+    Reference: python/ray/_private/accelerators/tpu.py:199-547.
+    """
+    parts = accel.split("-")
+    gen = parts[0].lower()
+    try:
+        num = int(parts[-1])
+    except ValueError:
+        return 4
+    if gen in ("v5e", "v5litepod", "v6e") or gen.endswith("litepod"):
+        return num if num <= 8 else 4
+    return min(max(num // 2, 1), 4)
 
 
 class TPUAcceleratorManager:
@@ -30,7 +72,7 @@ class TPUAcceleratorManager:
         explicit = _env("TPU_CHIPS_PER_HOST")
         if explicit:
             return int(explicit)
-        accel = _env("TPU_ACCELERATOR_TYPE")  # e.g. "v4-16"
+        accel = _accelerator_type()  # e.g. "v4-16", "v5e-8"
         if accel is None:
             # Fall back to live JAX discovery when running on a TPU VM.
             try:
@@ -39,11 +81,11 @@ class TPUAcceleratorManager:
                 return len([d for d in jax.devices() if d.platform == "tpu"])
             except Exception:
                 return 0
-        return _CHIPS_PER_HOST
+        return _chips_per_host(accel)
 
     @staticmethod
     def get_current_node_accelerator_type() -> str | None:
-        accel = _env("TPU_ACCELERATOR_TYPE")
+        accel = _accelerator_type()
         if accel is None:
             return None
         return "TPU-" + accel.split("-")[0].upper()  # e.g. TPU-V4
@@ -51,7 +93,7 @@ class TPUAcceleratorManager:
     @staticmethod
     def get_current_pod_type_resource() -> str | None:
         """e.g. TPU_ACCELERATOR_TYPE=v4-16 -> 'TPU-v4-16'."""
-        accel = _env("TPU_ACCELERATOR_TYPE")
+        accel = _accelerator_type()
         if accel is None:
             return None
         return f"TPU-{accel}"

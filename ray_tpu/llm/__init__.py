@@ -36,6 +36,33 @@ class ByteTokenizer:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
 
+class HFTokenizer:
+    """Adapter over a HuggingFace tokenizer (encode/decode protocol)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # baked in; local paths work offline
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def resolve_tokenizer(tokenizer) -> Any:
+    """None -> ByteTokenizer; str -> HF AutoTokenizer (model id or local path);
+    anything with encode/decode passes through (reference: tokenizer plumbed via
+    server_models.py LLMConfig.model_loading_config)."""
+    if tokenizer is None:
+        return ByteTokenizer()
+    if isinstance(tokenizer, str):
+        return HFTokenizer(tokenizer)
+    return tokenizer
+
+
 @dataclasses.dataclass
 class LLMConfig:
     """Parity: reference `ray.serve.llm.LLMConfig` (server_models.py)."""
@@ -85,7 +112,7 @@ class LLMServer:
         cfg, params = load_model(config)
         self._cfg = cfg
         self._config = config
-        self._tokenizer = config.tokenizer or ByteTokenizer()
+        self._tokenizer = resolve_tokenizer(config.tokenizer)
         self._engine = DecodeEngine(
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
@@ -142,6 +169,48 @@ class LLMServer:
             "latency_s": time.monotonic() - t0,
         }
 
+    async def generate_stream(self, prompt: Union[str, List[int]], *,
+                              max_tokens: int = 64, temperature: float = 0.0,
+                              top_k: int = 0, stop_token_id: Optional[int] = None,
+                              lora: str = ""):
+        """Async generator: yields text increments as tokens are decoded.
+
+        SSE-ready: the OpenAI router maps each item to one `data:` event
+        (reference: vllm_engine.py generate -> StreamingResponse path).
+        """
+        token_ids = (
+            self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def cb(token: int, finished: bool):
+            loop.call_soon_threadsafe(queue.put_nowait, (token, finished))
+
+        self._engine.submit(
+            token_ids,
+            SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                           top_k=top_k, stop_token_id=stop_token_id),
+            cb,
+            lora=lora,
+        )
+        # Incremental detokenization: only the undecoded token tail is re-decoded
+        # per step (a full-prefix decode would be O(N^2) across a stream), held
+        # back while it ends mid-codepoint so multi-byte chars emit whole.
+        pending: List[int] = []
+        while True:
+            token, finished = await queue.get()
+            if not (finished and stop_token_id is not None and token == stop_token_id):
+                pending.append(token)
+            text = self._tokenizer.decode(pending) if pending else ""
+            if text.endswith("�") and not finished:
+                pass  # mid-codepoint: hold until the remaining bytes arrive
+            elif text:
+                yield text
+                pending = []
+            if finished:
+                return
+
     async def model_id(self) -> str:
         return self._config.model_id
 
@@ -159,13 +228,20 @@ class OpenAIRouter:
     def __init__(self, servers: Dict[str, Any]):
         self._servers = servers  # model_id -> DeploymentHandle
 
-    async def __call__(self, request) -> dict:
+    async def __call__(self, request):
+        """Async generator ingress: one JSON item for regular calls, a stream of
+        SSE `data:` events when the request sets "stream": true (reference:
+        router.py -> StreamingResponse with text/event-stream)."""
+        import json as _json
+
         path = request.path
         if path.endswith("/v1/models"):
-            return {
+            yield {"__serve_content_type__": "application/json"}
+            yield {
                 "object": "list",
                 "data": [{"id": mid, "object": "model"} for mid in self._servers],
             }
+            return
         body = request.json()
         model = body.get("model") or next(iter(self._servers))
         # "base-id:adapter" selects a LoRA adapter on the base model (the vLLM
@@ -176,8 +252,10 @@ class OpenAIRouter:
             base, lora = model.split(":", 1)
         handle = self._servers.get(base)
         if handle is None:
-            return {"error": {"message": f"unknown model {model!r}",
-                              "type": "invalid_request_error"}}
+            yield {"__serve_content_type__": "application/json"}
+            yield {"error": {"message": f"unknown model {model!r}",
+                             "type": "invalid_request_error"}}
+            return
         is_chat = path.endswith("/v1/chat/completions")
         if is_chat:
             prompt = "\n".join(
@@ -186,21 +264,60 @@ class OpenAIRouter:
             ) + "\nassistant:"
         else:
             prompt = body.get("prompt", "")
-        response = handle.generate.remote(
-            prompt,
+        gen_kwargs = dict(
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             lora=lora,
         )
+        created = int(time.time())
+        if body.get("stream"):
+            yield {"__serve_content_type__": "text/event-stream"}
+            rid = f"{'chatcmpl' if is_chat else 'cmpl'}-{uuid.uuid4().hex[:16]}"
+            obj = "chat.completion.chunk" if is_chat else "text_completion"
+
+            def sse(delta_text, finish_reason=None, first=False):
+                if is_chat:
+                    delta = {}
+                    if first:
+                        delta["role"] = "assistant"
+                    if delta_text:
+                        delta["content"] = delta_text
+                    choice = {"index": 0, "delta": delta,
+                              "finish_reason": finish_reason}
+                else:
+                    choice = {"index": 0, "text": delta_text or "",
+                              "finish_reason": finish_reason}
+                chunk = {"id": rid, "object": obj, "created": created,
+                         "model": model, "choices": [choice]}
+                return f"data: {_json.dumps(chunk)}\n\n"
+
+            try:
+                stream = handle.options(stream=True).generate_stream.remote(
+                    prompt, **gen_kwargs
+                )
+                first = True
+                async for delta_text in stream:
+                    yield sse(delta_text, first=first)
+                    first = False
+            except KeyError:
+                yield sse("", finish_reason="error")
+                yield "data: [DONE]\n\n"
+                return
+            yield sse("", finish_reason="length")
+            yield "data: [DONE]\n\n"
+            return
+        response = handle.generate.remote(prompt, **gen_kwargs)
         try:
             result = await response
         except KeyError:
-            return {"error": {"message": f"unknown lora adapter in model {model!r}",
-                              "type": "invalid_request_error"}}
-        created = int(time.time())
+            yield {"__serve_content_type__": "application/json"}
+            yield {"error": {"message": f"unknown lora adapter in model {model!r}",
+                             "type": "invalid_request_error"}}
+            return
+        yield {"__serve_content_type__": "application/json"}
         if is_chat:
-            return {
+            yield {
                 "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
                 "object": "chat.completion",
                 "created": created,
@@ -212,7 +329,8 @@ class OpenAIRouter:
                 }],
                 "usage": result["usage"],
             }
-        return {
+            return
+        yield {
             "id": f"cmpl-{uuid.uuid4().hex[:16]}",
             "object": "text_completion",
             "created": created,
@@ -246,6 +364,7 @@ def build_openai_app(llm_configs: List[LLMConfig]) -> "serve.Application":
 __all__ = [
     "ByteTokenizer",
     "DecodeEngine",
+    "HFTokenizer",
     "LLMConfig",
     "LLMServer",
     "OpenAIRouter",

@@ -15,7 +15,7 @@ import asyncio
 import time
 from typing import Any, List, Optional, Union
 
-from ray_tpu.llm import ByteTokenizer, LLMConfig, SamplingParams, load_model
+from ray_tpu.llm import ByteTokenizer, LLMConfig, SamplingParams, load_model, resolve_tokenizer
 from ray_tpu.llm._engine import DecodeEngine
 
 
@@ -52,7 +52,7 @@ class DecodeServer:
 
     def __init__(self, config: LLMConfig):
         cfg, params = load_model(config)
-        self._tokenizer = config.tokenizer or ByteTokenizer()
+        self._tokenizer = resolve_tokenizer(config.tokenizer)
         self._engine = DecodeEngine(
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
@@ -102,7 +102,7 @@ class PDRouter:
     def __init__(self, prefill_handle, decode_handle, config: LLMConfig):
         self._prefill = prefill_handle
         self._decode = decode_handle
-        self._tokenizer = config.tokenizer or ByteTokenizer()
+        self._tokenizer = resolve_tokenizer(config.tokenizer)
         self._model_id = config.model_id
 
     async def generate(self, prompt: Union[str, List[int]], *,

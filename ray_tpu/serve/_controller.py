@@ -82,14 +82,14 @@ class ServeController:
             if nid not in alive:
                 handle, _port = self._proxies.pop(nid)
                 self._kill(handle)
-        # One proxy per alive node. The head node binds the configured port; the
-        # other nodes bind an ephemeral port (on real multi-host clusters each
-        # node has its own address, so the reference binds one fixed port per
-        # host; single-host test clusters would collide on it).
+        # One proxy per alive node, every node offered the SAME configured port
+        # (reference operating model: "any node, one port", proxy.py:706). On a
+        # single-host test cluster the extra binds collide and the proxy falls
+        # back to an ephemeral port (see HTTPProxy.start).
         for nid, info in alive.items():
             if nid in self._proxies:
                 continue
-            port = self._http_options.get("port", 8000) if info.get("is_head") else 0
+            port = self._http_options.get("port", 8000)
             host = self._http_options.get("host", "127.0.0.1")
             proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
             try:

@@ -31,9 +31,16 @@ class HTTPProxy:
             # Idempotent: a second driver's serve.start() reaches the existing
             # proxy actor via get_if_exists; re-binding would EADDRINUSE.
             return self._port
-        self._server = await asyncio.start_server(
-            self._handle_conn, self._host, self._port
-        )
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._port
+            )
+        except OSError:
+            # Same-host port collision (single-host test clusters run every
+            # "node" on one IP). Real multi-host deployments bind the same
+            # fixed port on each host (reference: one proxy port per node,
+            # proxy.py:706); fall back to ephemeral only when taken.
+            self._server = await asyncio.start_server(self._handle_conn, self._host, 0)
         self._port = self._server.sockets[0].getsockname()[1]
         asyncio.get_running_loop().create_task(self._route_refresh_loop())
         return self._port
@@ -110,8 +117,14 @@ class HTTPProxy:
         gen = await loop.run_in_executor(
             None, lambda: self._handles[app].options(stream=True).remote(request)
         )
+        ctype = "text/plain"
         try:
             first = await gen.__anext__()
+            # A leading {"__serve_content_type__": ...} item sets the response
+            # content type (e.g. text/event-stream for SSE) without a body chunk.
+            if isinstance(first, dict) and "__serve_content_type__" in first:
+                ctype = first["__serve_content_type__"]
+                first = await gen.__anext__()
             have_first = True
         except StopAsyncIteration:
             first, have_first = None, False
@@ -130,7 +143,7 @@ class HTTPProxy:
                     yield encode(item)
 
         try:
-            await write_http_chunked(writer, 200, "text/plain", chunks())
+            await write_http_chunked(writer, 200, ctype, chunks())
         except Exception:
             # Mid-stream failure (endpoint error or client disconnect): headers
             # are already sent, so drop the connection; never write a second

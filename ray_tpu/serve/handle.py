@@ -88,13 +88,15 @@ class DeploymentResponseGenerator:
     def __next__(self):
         try:
             ref = next(self._ref_gen)
+            return ray_tpu.get(ref)
         except StopIteration:
             self._finish()
             raise
         except Exception:
+            # An error ref mid-stream must also release the router's inflight
+            # count, or repeated streaming errors skew the pow-2 load metric.
             self._finish()
             raise
-        return ray_tpu.get(ref)
 
     def __aiter__(self):
         return self

@@ -65,14 +65,19 @@ class _ModelCache:
             if victim_id is None:
                 return  # everything is mid-load; momentary overshoot is unavoidable
             evicted = self._models.pop(victim_id)
-            del_fn = getattr(evicted, "__del__", None)
-            if callable(del_fn):
-                try:
-                    out = del_fn()
-                    if inspect.isawaitable(out):
-                        await out
-                except Exception:
-                    pass
+            # Prefer an explicit cleanup hook; never call __del__ directly (GC
+            # would invoke it a second time — a double-release for models whose
+            # finalizer frees device memory or shuts down an engine).
+            for hook in ("close", "shutdown", "cleanup"):
+                fn = getattr(evicted, hook, None)
+                if callable(fn):
+                    try:
+                        out = fn()
+                        if inspect.isawaitable(out):
+                            await out
+                    except Exception:
+                        pass
+                    break
 
     async def get(self, model_id: str):
         cached = self._models.get(model_id)

@@ -122,6 +122,27 @@ def test_openai_app_http():
     assert chat["object"] == "chat.completion"
     assert chat["choices"][0]["message"]["role"] == "assistant"
 
+    # SSE streaming: "stream": true yields text/event-stream data: events
+    # terminated by [DONE] (reference: router.py StreamingResponse path).
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps({"model": "test-tiny",
+                         "messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 5, "stream": True}).encode(),
+        method="POST", headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=240) as resp:
+        assert resp.headers.get("Content-Type", "").startswith("text/event-stream")
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    streamed = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert streamed  # tokens actually arrived incrementally
+
 
 def test_pd_disagg_matches_monolithic():
     """Prefill-elsewhere + decode must produce the same greedy tokens as the
