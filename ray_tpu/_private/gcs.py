@@ -210,12 +210,17 @@ class GcsService:
                                   sealed_objects: list, reserved_bundles: list):
         """A raylet re-registered (typically after a GCS restart): re-learn the live
         state it hosts — actor addresses, object locations, PG bundle reservations."""
-        for actor_id, worker_id in hosted_actors.items():
+        for actor_id, info in hosted_actors.items():
+            # info is {"worker_id", "direct_addr"} (bare worker_id accepted for
+            # compatibility with older raylets mid-rolling-restart).
+            worker_id = info["worker_id"] if isinstance(info, dict) else info
+            direct_addr = info.get("direct_addr") if isinstance(info, dict) else None
             actor = self.actors.get(actor_id)
             if actor is None or actor.state == ALIVE:
                 continue
             actor.state = ALIVE
-            actor.address = {"node_id": node_id, "worker_id": worker_id}
+            actor.address = {"node_id": node_id, "worker_id": worker_id,
+                             "direct_addr": direct_addr}
             actor.placing = False
             actor.awaiting_report = False
             await self.publish("actors", {"actor": actor.view()})
@@ -462,7 +467,9 @@ class GcsService:
                 continue
             if result.get("ok"):
                 actor.state = ALIVE
-                actor.address = {"node_id": node.node_id, "worker_id": result["worker_id"]}
+                actor.address = {"node_id": node.node_id,
+                                 "worker_id": result["worker_id"],
+                                 "direct_addr": result.get("direct_addr")}
                 await self.publish("actors", {"actor": actor.view()})
                 ev = self._actor_events.pop(actor.actor_id, None)
                 if ev:

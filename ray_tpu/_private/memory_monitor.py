@@ -77,28 +77,44 @@ def pick_worker_to_kill(handles: list) -> object | None:
     there is nothing safe to kill (an empty node cannot relieve pressure by
     killing workers).
     """
+    def _owner_key(h) -> str | None:
+        if h.busy_task is not None:
+            owner = (h.busy_task.get("owner") or {}).get("worker_id")
+            return owner.hex() if hasattr(owner, "hex") else str(owner)
+        leased = getattr(h, "leased_to", None)
+        if leased is not None:  # leased workers run owner-retried pushed tasks
+            return leased.hex() if hasattr(leased, "hex") else str(leased)
+        return None
+
+    def _retry_rank(h) -> float:
+        """0 = known retriable (kill first), 1 = known non-retriable (protect),
+        0.5 = leased (the raylet cannot see the pushed task's retry budget —
+        rank between the two so neither certainty is inverted)."""
+        if h.busy_task is not None:
+            return 0.0 if h.busy_task.get("retries_left", 0) > 0 else 1.0
+        return 0.5
+
+    def _started(h) -> float:
+        return getattr(h, "task_started_at", 0.0) or getattr(h, "started_at", 0.0)
+
     tasks = [
         h for h in handles
-        if h.kind == "worker" and h.busy_task is not None
+        if h.kind == "worker" and _owner_key(h) is not None
     ]
     if tasks:
         groups: dict[str, list] = {}
         for h in tasks:
-            owner = (h.busy_task.get("owner") or {}).get("worker_id")
-            key = owner.hex() if hasattr(owner, "hex") else str(owner)
-            groups.setdefault(key, []).append(h)
+            groups.setdefault(_owner_key(h), []).append(h)
 
         def group_rank(members: list) -> tuple:
-            retriable = all(
-                m.busy_task.get("retries_left", 0) > 0 for m in members
-            )
-            newest = max(getattr(m, "task_started_at", 0.0) for m in members)
+            rank = max(_retry_rank(m) for m in members)
+            newest = max(_started(m) for m in members)
             # Retriable groups first (their work is recoverable); then the
             # group whose newest task started last (least progress lost).
-            return (0 if retriable else 1, -newest)
+            return (rank, -newest)
 
         victims = min(groups.values(), key=group_rank)
-        return max(victims, key=lambda m: getattr(m, "task_started_at", 0.0))
+        return max(victims, key=_started)
     actors = [h for h in handles if h.actor_id is not None and h.kind != "driver"]
     if actors:
         return max(actors, key=lambda m: getattr(m, "started_at", 0.0))

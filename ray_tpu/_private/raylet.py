@@ -52,6 +52,8 @@ class WorkerHandle:
         self.task_started_at = 0.0  # dispatch time of busy_task (OOM kill order)
         self.oom_killed: tuple | None = None  # (usage_frac, threshold) when reaped
         self.log_owner: str | None = None  # worker_id hex of current work's owner
+        self.direct_addr: tuple[str, int] | None = None  # worker's direct-call server
+        self.leased_to: WorkerID | None = None  # owner holding a cached lease
 
     @property
     def alive(self):
@@ -222,10 +224,17 @@ class Raylet:
         # Actor state changes invalidate the local address cache (restart support).
         await self.gcs.call("subscribe", "actors")
         await self.gcs.call("subscribe", "nodes")
+        hosted = {}
+        for actor_id, worker_id in self.actors.items():
+            h = self.workers.get(worker_id)
+            hosted[actor_id] = {
+                "worker_id": worker_id,
+                "direct_addr": h.direct_addr if h is not None else None,
+            }
         await self.gcs.call(
             "sync_node_state",
             self.node_id,
-            dict(self.actors),
+            hosted,
             [(oid, sz, owner) for oid, (sz, owner) in self._sealed_objects.items()],
             list(self.resources.bundles.keys()),
         )
@@ -308,6 +317,7 @@ class Raylet:
                 if w.kind == "worker"
                 and w.busy_task is None
                 and w.actor_id is None
+                and w.leased_to is None
                 and w.alive
                 and now - w.last_idle > CONFIG.idle_worker_kill_s
             ]
@@ -376,7 +386,7 @@ class Raylet:
             if (
                 w.kind == "worker" and w.alive and w.registered.is_set()
                 and w.busy_task is None and w.actor_id is None
-                and w.env_key == env_key
+                and w.leased_to is None and w.env_key == env_key
             ):
                 return w
         return None
@@ -570,6 +580,27 @@ class Raylet:
                 continue
             victim.oom_killed = (frac, threshold)
             above_since = None  # re-debounce before the next kill
+            if victim.leased_to is not None:
+                # The raylet holds no spec for leased pushed tasks: hand the
+                # lessee the cause so exhausted retries surface OutOfMemoryError
+                # instead of a generic crash. A CALL (not notify): the ack
+                # guarantees the cause is recorded before the conn-close from
+                # the kill races it.
+                owner = self.workers.get(victim.leased_to)
+                if owner is not None and owner.alive:
+                    try:
+                        await asyncio.wait_for(
+                            owner.conn.call(
+                                "lease_oom",
+                                {"worker_id": victim.worker_id,
+                                 "cause": f"killed by the node memory monitor "
+                                          f"(memory usage {frac:.2f} > "
+                                          f"threshold {threshold:.2f})"},
+                            ),
+                            2.0,
+                        )
+                    except Exception:
+                        pass
             await self._kill_worker(victim)
 
     async def _log_monitor_loop(self):
@@ -638,6 +669,18 @@ class Raylet:
             self.resources.release(handle.acquired, handle.pg_key)
             handle.acquired = {}
             handle.pg_key = None
+            handle.leased_to = None
+        # A dying owner's cached leases must not strand workers (reference:
+        # leases are tied to the lessee's liveness). The worker may still be
+        # executing a pushed task the raylet cannot see (leased tasks never set
+        # busy_task here), so returning it to the idle pool would double-book
+        # it — kill it instead; _on_worker_lost releases its resources.
+        loop = asyncio.get_running_loop()
+        for w in list(self.workers.values()):
+            if w.leased_to == handle.worker_id:
+                w.leased_to = None
+                loop.create_task(self._kill_worker(w))
+        self._sched_wakeup.set()
         spec = handle.busy_task
         loop = asyncio.get_running_loop()
         if spec is not None:
@@ -953,13 +996,16 @@ class Raylet:
 
     # ------------------------------------------------------------------ RPC: workers
 
-    async def rpc_register_worker(self, conn, worker_id: WorkerID, kind: str, pid: int):
+    async def rpc_register_worker(self, conn, worker_id: WorkerID, kind: str, pid: int,
+                                  direct_port: int | None = None):
         handle = self.workers.get(worker_id)
         if handle is None:
             handle = WorkerHandle(worker_id, None, kind)
             self.workers[worker_id] = handle
         handle.conn = conn
         handle.kind = kind if handle.kind == "worker" and kind == "driver" else handle.kind
+        if direct_port:
+            handle.direct_addr = ("127.0.0.1", direct_port)
         handle.registered.set()
         conn.on_close(lambda c: self._on_worker_lost(handle))
         return {"node_id": self.node_id, "store_capacity": self.store.capacity}
@@ -1027,6 +1073,56 @@ class Raylet:
             return await handle.conn.call(method, payload)
         except rpc.RpcError:
             return {"error": "worker_lost"}
+
+    async def rpc_request_lease(self, conn, resources: dict, runtime_env=None,
+                                owner_worker_id: WorkerID | None = None):
+        """Grant a cached worker lease to a submitting worker.
+
+        Reference: NormalTaskSubmitter's lease caching
+        (task_submission/normal_task_submitter.h:81) — the owner holds the lease
+        and pushes same-shape tasks straight to the worker, returning it when the
+        local queue drains. The raylet only does resource accounting here; the
+        per-task hot path never touches it.
+        """
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        demand = resources or {"CPU": 1}
+        if not self.resources.feasible(demand, None):
+            return {"ok": False, "infeasible": True}
+        env_key = runtime_env_mod.env_key(runtime_env)
+        python_exe = None
+        if env_key is not None:
+            try:
+                python_exe, ready = self._resolve_env_python({"runtime_env": runtime_env})
+            except RuntimeError as e:
+                return {"ok": False, "error": str(e)}
+            if not ready:
+                return {"ok": False}
+        if not self.resources.can_acquire(demand, None):
+            return {"ok": False}
+        worker = self._find_idle_worker(env_key)
+        if worker is None or worker.direct_addr is None:
+            self._maybe_spawn_worker(env_key=env_key, python_exe=python_exe)
+            return {"ok": False}
+        self.resources.acquire(demand, None)
+        worker.acquired = demand
+        worker.leased_to = owner_worker_id
+        owner_hex = owner_worker_id.hex() if hasattr(owner_worker_id, "hex") else None
+        worker.log_owner = owner_hex
+        return {"ok": True, "worker_id": worker.worker_id,
+                "direct_addr": worker.direct_addr}
+
+    async def rpc_release_lease(self, conn, worker_id: WorkerID):
+        handle = self.workers.get(worker_id)
+        if handle is None or handle.leased_to is None:
+            return False
+        self.resources.release(handle.acquired, None)
+        handle.acquired = {}
+        handle.leased_to = None
+        handle.log_owner = None
+        handle.last_idle = time.monotonic()
+        self._sched_wakeup.set()
+        return True
 
     async def rpc_call_worker(self, conn, target: dict, method: str, payload):
         """Worker-to-worker request routed by address (e.g. borrower asking the
@@ -1290,7 +1386,8 @@ class Raylet:
         owner_wid = (spec.get("owner") or {}).get("worker_id")
         handle.log_owner = owner_wid.hex() if hasattr(owner_wid, "hex") else None
         self.actors[actor_id] = handle.worker_id
-        return {"ok": True, "worker_id": handle.worker_id}
+        return {"ok": True, "worker_id": handle.worker_id,
+                "direct_addr": handle.direct_addr}
 
     async def rpc_submit_actor_task(self, conn, spec: dict):
         """Route an actor method call to the actor's host node/worker."""

@@ -394,6 +394,24 @@ class CoreWorker:
         self._recon_attempts: dict[ObjectID, int] = {}
         self._actor_seq: dict[ActorID, _Counter] = {}
         self._actor_arg_pins: dict[ActorID, list[ObjectID]] = {}
+        # Direct actor-call path (reference: ActorTaskSubmitter pushes method
+        # calls straight to the actor process, no raylet per call,
+        # task_submission/actor_task_submitter.h:67). Per-actor: cached direct
+        # connection, in-flight specs (failed on conn loss), and a seq-ordered
+        # send queue (deps may resolve out of order; sends must not).
+        self._direct_server: rpc.RpcServer | None = None
+        self._direct_actor: dict[ActorID, Any] = {}  # conn | None(=use raylet)
+        self._direct_inflight: dict[ActorID, dict] = {}  # aid -> {task_id: spec}
+        self._direct_send: dict[ActorID, dict] = {}  # aid -> {"next": int, "ready": {}}
+        self._direct_lock = threading.Lock()
+        # Cached worker leases for normal tasks (reference: lease caching +
+        # PushNormalTask, normal_task_submitter.h:81,220): per resource shape,
+        # leased workers that execute pushed tasks back-to-back with no raylet
+        # hop per task.
+        self._leases: dict[tuple, dict] = {}  # shape -> {"workers", "queue", ...}
+        self._lease_inflight: dict[TaskID, tuple] = {}  # task_id -> (shape, wid)
+        self._lease_oom: dict[WorkerID, str] = {}  # OOM causes from the raylet
+        self._lease_lock = threading.Lock()
         self._streams: dict[TaskID, _StreamState] = {}  # owner side of streaming tasks
         self._task_executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-exec")
         self._future_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rtpu-fut")
@@ -417,8 +435,16 @@ class CoreWorker:
             rpc.connect(*self.raylet_addr, handler=self, name=f"{self.mode}->raylet")
         )
         self.gcs = self.io.run(rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs"))
+        direct_port = None
+        if self.mode == "worker" and not self.remote_data_plane:
+            # Direct-call server: peers (owners of actor calls / leased tasks)
+            # push work here without a raylet hop on the hot path.
+            self._direct_server = self.io.run(rpc.RpcServer(lambda conn: self).start())
+            direct_port = self._direct_server.port
         reply = self.io.run(
-            self.raylet.call("register_worker", self.worker_id, self.mode, os.getpid())
+            self.raylet.call(
+                "register_worker", self.worker_id, self.mode, os.getpid(), direct_port
+            )
         )
         self.node_id = reply["node_id"]
         if self.mode == "worker":
@@ -436,6 +462,18 @@ class CoreWorker:
     def disconnect(self):
         self._connected = False
         try:
+            for conn in list(self._direct_actor.values()):
+                if conn is not None and not conn.closed:
+                    self.io.run(conn.close())
+            with self._lease_lock:
+                lease_conns = [
+                    w["conn"] for st in self._leases.values()
+                    for w in st["workers"].values()
+                ]
+                self._leases.clear()
+            for conn in lease_conns:
+                if not conn.closed:
+                    self.io.run(conn.close())
             if self.raylet is not None:
                 self.io.run(self.raylet.close())
             if self.gcs is not None:
@@ -767,6 +805,7 @@ class CoreWorker:
                 self._recon_attempts[oid] = attempts + 1
                 self._reconstructing.add(oid)
         spec["retries_left"] = max(1, spec.get("retries_left", 1))
+        spec.pop("__direct__", None)  # rebuild rides the raylet, not a stale lease
         self._record_event(
             task_id=spec["task_id"].hex(), name=spec["name"], state="RECONSTRUCTING"
         )
@@ -898,21 +937,18 @@ class CoreWorker:
                            **tracing.event_fields(tctx))
         if streaming:
             self._streams[task_id] = _StreamState()
-        self._submit_when_ready(spec)
+        if self._lease_eligible(spec):
+            self._when_args_ready(spec, lambda: self._lease_submit(spec))
+        else:
+            self._submit_when_ready(spec)
         if streaming:
             return ObjectRefGenerator(task_id, self)
         return refs
 
-    def _submit_when_ready(self, spec, target="submit_task", on_send_failure=None):
-        """Dependency gating: hold until owned pending ref-args resolve (DependencyResolver)."""
-
-        async def send():
-            try:
-                await self.raylet.notify(target, spec)
-            except Exception:
-                if on_send_failure is not None:
-                    on_send_failure()
-
+    def _when_args_ready(self, spec, fn):
+        """Dependency gating: run fn once owned pending ref-args resolve
+        (DependencyResolver parity). fn may run on the caller thread (no deps)
+        or on whatever thread resolves the last dependency."""
         dep_ids = []
         for loc in list(spec["args"]) + list(spec["kwargs"].values()):
             if "ref" in loc:
@@ -921,7 +957,7 @@ class CoreWorker:
                 if rec is not None and not rec.resolved:
                     dep_ids.append(oid)
         if not dep_ids:
-            self.io.spawn(send())
+            fn()
             return
         remaining = {"n": len(dep_ids)}
         lock = threading.Lock()
@@ -931,11 +967,21 @@ class CoreWorker:
                 remaining["n"] -= 1
                 done = remaining["n"] == 0
             if done:
-                self.io.spawn(send())
+                fn()
 
         for oid in dep_ids:
             if not self.memory_store.add_done_callback(oid, on_done):
                 on_done(oid, None)
+
+    def _submit_when_ready(self, spec, target="submit_task", on_send_failure=None):
+        async def send():
+            try:
+                await self.raylet.notify(target, spec)
+            except Exception:
+                if on_send_failure is not None:
+                    on_send_failure()
+
+        self._when_args_ready(spec, lambda: self.io.spawn(send()))
 
     # ------------------------------------------------------------------ actors
 
@@ -1051,14 +1097,450 @@ class CoreWorker:
             spec["trace_ctx"] = tctx
         if streaming:
             self._streams[task_id] = _StreamState()
-        self._submit_when_ready(spec, target="submit_actor_task")
+        # Hot path: push the call straight to the actor process over a cached
+        # direct connection — no raylet hop per call (reference:
+        # actor_task_submitter.h:67 direct gRPC to the actor after creation).
+        # Streaming specs ride the SAME ordered direct queue (a raylet detour
+        # would leave a hole at their seq and wedge every later call) but are
+        # not flagged __direct__: their items/end still route via the raylet.
+        use_direct = not self.remote_data_plane and self._submit_actor_direct(
+            actor_id, spec
+        )
+        if not use_direct:
+            self._submit_when_ready(spec, target="submit_actor_task")
         if streaming:
             return ObjectRefGenerator(task_id, self)
         return refs
 
+    # ------------------------------------------------------------------ lease caching (normal tasks)
+
+    def _lease_eligible(self, spec) -> bool:
+        """The lease fast path serves plain tasks; anything needing the
+        scheduler's policy zoo (placement groups, affinity, spread) or stream
+        bookkeeping takes the classic raylet route."""
+        return (
+            not self.remote_data_plane
+            and spec.get("placement_group") is None
+            and spec.get("scheduling_strategy") is None
+            and spec.get("num_returns") != "streaming"
+        )
+
+    def _lease_shape(self, spec) -> tuple:
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        return (
+            tuple(sorted((spec.get("resources") or {}).items())),
+            runtime_env_mod.env_key(spec.get("runtime_env")),
+        )
+
+    def _lease_submit(self, spec):
+        shape = self._lease_shape(spec)
+        with self._lease_lock:
+            st = self._leases.setdefault(
+                shape, {"workers": {}, "queue": deque(), "requesting": False,
+                        "classic_until": 0.0},
+            )
+            if time.monotonic() < st["classic_until"]:
+                classic = True
+            else:
+                classic = False
+                st["queue"].append(spec)
+        if classic:
+            self.io.spawn(self.raylet.notify("submit_task", spec))
+            return
+        self._lease_pump(shape)
+
+    def _lease_pump(self, shape):
+        """Assign queued specs to idle leased workers; request more leases while
+        work outstrips them (one outstanding request per shape)."""
+        to_send, request = [], False
+        with self._lease_lock:
+            st = self._leases.get(shape)
+            if st is None:
+                return
+            for w in st["workers"].values():
+                if not st["queue"]:
+                    break
+                if w["spec"] is None and not w["conn"].closed:
+                    spec = st["queue"].popleft()
+                    w["spec"] = spec
+                    self._lease_inflight[spec["task_id"]] = (shape, w["worker_id"])
+                    to_send.append((w, spec))
+            if st["queue"] and not st["requesting"]:
+                st["requesting"] = True
+                request = True
+        for w, spec in to_send:
+            spec["__direct__"] = True
+
+            async def send(w=w, spec=spec):
+                try:
+                    await w["conn"].notify("push_task", spec)
+                except Exception:
+                    self._lease_worker_lost(shape, w["worker_id"], w["conn"])
+
+            self.io.spawn(send())
+        if request:
+            self.io.spawn(self._lease_request(shape))
+
+    async def _lease_request(self, shape):
+        resources, env_key = dict(shape[0]), shape[1]
+        with self._lease_lock:
+            st = self._leases.get(shape)
+            sample = st["queue"][0] if st and st["queue"] else None
+        renv = sample.get("runtime_env") if sample else None
+        try:
+            resp = await self.raylet.call(
+                "request_lease", resources or {"CPU": 1}, renv, self.worker_id
+            )
+        except Exception:
+            resp = None
+        conn = None
+        if resp and resp.get("ok"):
+            try:
+                conn = await rpc.connect(
+                    *resp["direct_addr"], handler=self, name="lease-worker"
+                )
+            except Exception:  # OSError or connect timeout: give the lease back
+                conn = None
+                self.io.spawn(self.raylet.notify("release_lease", resp["worker_id"]))
+        drain_classic = []
+        with self._lease_lock:
+            st = self._leases.get(shape)
+            if st is None:
+                if conn is not None:
+                    self.io.spawn(self.raylet.notify("release_lease", resp["worker_id"]))
+                return
+            st["requesting"] = False
+            if conn is not None:
+                wid = resp["worker_id"]
+                w = {"worker_id": wid, "conn": conn, "spec": None}
+                st["workers"][wid] = w
+                st["retries"] = 0
+                conn.on_close(lambda c: self._lease_worker_lost(shape, wid, c))
+            elif resp and resp.get("infeasible"):
+                # This node can never run the shape: hand everything queued to
+                # the raylet (spillback machinery) and stop fast-pathing it
+                # for a while.
+                st["classic_until"] = time.monotonic() + 10.0
+                while st["queue"]:
+                    drain_classic.append(st["queue"].popleft())
+            elif st["queue"]:
+                st["retries"] = st.get("retries", 0) + 1
+                if st["retries"] > 40 and not st["workers"]:
+                    # Long-denied with no leased worker: the node may be wedged
+                    # by blocked parents (nested zero-slot tasks). The classic
+                    # scheduler has the deadlock-avoidance spawn logic; use it.
+                    st["classic_until"] = time.monotonic() + 10.0
+                    st["retries"] = 0
+                    while st["queue"]:
+                        drain_classic.append(st["queue"].popleft())
+                else:
+                    # Busy node: retry while demand remains.
+                    st["requesting"] = True
+                    self.io.loop.call_later(
+                        0.05, lambda: self.io.spawn(self._lease_request(shape))
+                    )
+        for spec in drain_classic:
+            self.io.spawn(self.raylet.notify("submit_task", spec))
+        if conn is not None:
+            self._lease_pump(shape)
+            # The queue may have drained while this grant was in flight (an
+            # existing leased worker took the work): an unused grant must not
+            # pin the worker forever.
+            with self._lease_lock:
+                st = self._leases.get(shape)
+                w = st["workers"].get(resp["worker_id"]) if st else None
+                idle = w is not None and w["spec"] is None and (not st["queue"])
+            if idle:
+                self._schedule_lease_release(shape, resp["worker_id"])
+
+    def _schedule_lease_release(self, shape, wid):
+        """Return the lease after a short grace if the worker is still idle —
+        bursty submitters keep their warm worker. Must run on the io thread."""
+
+        def maybe_release():
+            with self._lease_lock:
+                st = self._leases.get(shape)
+                if st is None:
+                    return
+                w = st.get("workers", {}).get(wid)
+                if w is None or w["spec"] is not None or st["queue"]:
+                    return
+                st["workers"].pop(wid, None)
+                conn = w["conn"]
+            self.io.spawn(self.raylet.notify("release_lease", wid))
+            self.io.spawn(conn.close())
+
+        self.io.loop.call_later(0.25, maybe_release)
+
+    def _lease_task_finished(self, task_id):
+        entry = self._lease_inflight.pop(task_id, None)
+        if entry is None:
+            return
+        shape, wid = entry
+        with self._lease_lock:
+            st = self._leases.get(shape)
+            if st is None:
+                return
+            w = st["workers"].get(wid)
+            if w is not None:
+                w["spec"] = None
+                if not st["queue"]:
+                    self._schedule_lease_release(shape, wid)
+        self._lease_pump(shape)
+
+    def _lease_worker_lost(self, shape, wid, conn):
+        """A leased worker died: retry its in-flight task or fail it."""
+        respec = None
+        with self._lease_lock:
+            st = self._leases.get(shape)
+            if st is None:
+                return
+            w = st["workers"].pop(wid, None)
+            if w is None:
+                return
+            respec = w["spec"]
+            if respec is not None:
+                self._lease_inflight.pop(respec["task_id"], None)
+                if respec.get("retries_left", 0) > 0:
+                    respec["retries_left"] -= 1
+                    respec.pop("__direct__", None)
+                    st["queue"].appendleft(respec)
+                    respec = None  # handled by requeue
+        if respec is not None:
+            from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
+
+            oom_cause = self._lease_oom.pop(wid, None)
+            if oom_cause is not None:
+                err_obj = OutOfMemoryError(
+                    f"task {respec.get('name')} failed: {oom_cause}"
+                )
+            else:
+                err_obj = WorkerCrashedError(
+                    f"task {respec.get('name')} failed: leased worker died during execution"
+                )
+            err = serialization.dumps(err_obj)
+            for oid in respec["return_ids"]:
+                self.memory_store.resolve(oid, err, True, False)
+        self._lease_pump(shape)
+
+    async def rpc_lease_oom(self, conn, payload):
+        """Raylet forewarning: a leased worker is being OOM-killed for cause."""
+        self._lease_oom[payload["worker_id"]] = payload["cause"]
+        if len(self._lease_oom) > 256:  # bound stale entries
+            self._lease_oom.pop(next(iter(self._lease_oom)))
+        return True
+
+    # ------------------------------------------------------------------ direct actor path
+
+    def _submit_actor_direct(self, actor_id: ActorID, spec) -> bool:
+        """Route an actor call over the direct worker connection.
+
+        Returns True when the direct path owns delivery (possibly queued behind
+        address resolution). The first submission per actor decides the path
+        STICKILY — mixing transports would break per-caller seq ordering at the
+        executor. Sends flush strictly in seq order, so the executor's
+        first-arrival-sets-baseline logic always sees the lowest outstanding seq
+        first (reference: ActorSubmitQueue sends in order even when dependencies
+        resolve out of order).
+        """
+        with self._direct_lock:
+            st = self._direct_send.get(actor_id)
+            if st is None:
+                if self._direct_actor.get(actor_id, "?") is None:
+                    return False  # resolved earlier: raylet path forever
+                st = self._direct_send[actor_id] = {
+                    "next": spec["seq"], "ready": {}, "state": "resolving",
+                }
+                self.io.spawn(self._resolve_actor_direct(actor_id))
+            elif st["state"] == "raylet":
+                # Fallback decided: keep every later call on the raylet too.
+                return False
+        self._when_args_ready(spec, lambda: self._direct_mark_ready(actor_id, spec))
+        return True
+
+    def _direct_mark_ready(self, actor_id: ActorID, spec):
+        with self._direct_lock:
+            st = self._direct_send.get(actor_id)
+            if st is None:
+                self._submit_when_ready(spec, target="submit_actor_task")
+                return
+            st["ready"][spec["seq"]] = spec
+        self._direct_flush(actor_id)
+
+    def _direct_flush(self, actor_id: ActorID):
+        fallback, drain = [], False
+        with self._direct_lock:
+            st = self._direct_send.get(actor_id)
+            if st is None:
+                return
+            if st["state"] == "connected":
+                while st["next"] in st["ready"]:
+                    spec = st["ready"].pop(st["next"])
+                    st["next"] += 1
+                    if spec.get("num_returns") != "streaming":
+                        spec["__direct__"] = True
+                    self._direct_inflight[spec["task_id"]] = spec
+                    st.setdefault("sendq", deque()).append(spec)
+                if st.get("sendq") and not st.get("draining"):
+                    st["draining"] = True
+                    drain = True
+            elif st["state"] == "raylet":
+                # Resolution failed after calls queued: replay them via the
+                # raylet in seq order (legacy transport, legacy semantics).
+                for seq in sorted(st["ready"]):
+                    fallback.append(st["ready"].pop(seq))
+        if drain:
+            self.io.spawn(self._direct_drain(actor_id))
+        for spec in fallback:
+            self.io.spawn(self.raylet.notify("submit_actor_task", spec))
+
+    async def _direct_drain(self, actor_id: ActorID):
+        """Single in-flight drainer per actor: ships everything queued since the
+        last write in ONE frame (push_batch) — a submit burst coalesces into a
+        few pickles/syscalls instead of one per call."""
+        while True:
+            with self._direct_lock:
+                st = self._direct_send.get(actor_id)
+                if st is None:
+                    return
+                batch = list(st.get("sendq") or ())
+                if st.get("sendq"):
+                    st["sendq"].clear()
+                if not batch:
+                    st["draining"] = False
+                    return
+                conn = self._direct_actor.get(actor_id)
+            if conn is None or getattr(conn, "closed", True):
+                with self._direct_lock:
+                    if st is self._direct_send.get(actor_id):
+                        st["draining"] = False
+                return
+            try:
+                if len(batch) == 1:
+                    await conn.notify("push_task", batch[0])
+                else:
+                    await conn.notify("push_batch", batch)
+            except Exception:
+                with self._direct_lock:
+                    st["draining"] = False
+                self._direct_conn_lost(actor_id, conn)
+                return
+
+    async def _resolve_actor_direct(self, actor_id: ActorID):
+        """Resolve the actor's direct address via the GCS and connect (io thread)."""
+        conn = None
+        dead = False
+        try:
+            for _attempt in range(3):
+                info = await self.gcs.call("wait_actor_alive", actor_id, 60.0)
+                if info is None or info["state"] == "DEAD":
+                    dead = True
+                    break
+                if info["state"] == "ALIVE":
+                    daddr = (info.get("address") or {}).get("direct_addr")
+                    if daddr:
+                        conn = await rpc.connect(
+                            *daddr, handler=self,
+                            name=f"direct->{actor_id.hex()[:8]}",
+                        )
+                    break
+                # PENDING/RESTARTING: wait again
+        except Exception:
+            conn = None
+        with self._direct_lock:
+            st = self._direct_send.get(actor_id)
+            if conn is not None:
+                self._direct_actor[actor_id] = conn
+                if st is not None:
+                    st["state"] = "connected"
+                conn.on_close(lambda c: self._direct_conn_lost(actor_id, c))
+            else:
+                self._direct_actor[actor_id] = None
+                if st is not None:
+                    st["state"] = "raylet"
+        self._direct_flush(actor_id)
+        if dead:
+            # Only for DEAD actors: a LIVE actor's "raylet" tombstone must stay
+            # (dropping it would let a later call retry direct mid-stream and
+            # break per-caller seq ordering across transports).
+            self._direct_gc(actor_id)
+
+    def _direct_conn_lost(self, actor_id: ActorID, conn):
+        """Direct connection dropped (actor death or restart): fail the calls it
+        carried — with the GCS-recorded cause — and re-resolve for later calls."""
+        with self._direct_lock:
+            if self._direct_actor.get(actor_id) is not conn:
+                return  # stale callback (already re-resolved)
+            self._direct_actor.pop(actor_id, None)
+            st = self._direct_send.get(actor_id)
+            if st is not None and st["state"] == "connected":
+                if self._connected:
+                    st["state"] = "resolving"
+                    self.io.spawn(self._resolve_actor_direct(actor_id))
+                else:
+                    st["state"] = "raylet"  # shutting down: no re-resolution
+            inflight = []
+            for tid, s in list(self._direct_inflight.items()):
+                if s.get("actor_id") == actor_id:
+                    self._direct_inflight.pop(tid, None)
+                    inflight.append(s)
+        if inflight and self._connected:
+            self.io.spawn(self._fail_direct_inflight(actor_id, inflight))
+        else:
+            # No in-flight calls to fail: reclaim the per-actor state here
+            # (the only other gc site is _fail_direct_inflight).
+            self._direct_gc(actor_id)
+
+    async def _fail_direct_inflight(self, actor_id: ActorID, inflight: list):
+        from ray_tpu.exceptions import ActorDiedError
+
+        await asyncio.sleep(0.3)  # let the raylet report the death cause to GCS
+        reason = "actor died (direct connection lost)"
+        try:
+            info = await self.gcs.call("get_actor_info", actor_id)
+            if info is not None and info.get("death_cause"):
+                reason = f"actor died: {info['death_cause']}"
+            elif info is not None and info["state"] == "RESTARTING":
+                reason = "actor died during method call (restarting)"
+        except Exception:
+            pass
+        exc = ActorDiedError(actor_id, reason)
+        err = serialization.dumps(exc)
+        for spec in inflight:
+            if spec.get("num_returns") == "streaming":
+                st = self._streams.get(spec["task_id"])
+                if st is not None:
+                    with st.cond:
+                        st.abort_error = exc
+                        st.cond.notify_all()
+            else:
+                for oid in spec["return_ids"]:
+                    self.memory_store.resolve(oid, err, True, False)
+        self._direct_gc(actor_id)
+
+    def _direct_gc(self, actor_id: ActorID):
+        """Drop per-actor direct state once it holds nothing live — long-lived
+        drivers churning thousands of short-lived actors must not accumulate
+        send-state dicts and dead Connection objects forever."""
+        with self._direct_lock:
+            st = self._direct_send.get(actor_id)
+            if st is not None and (st["ready"] or st.get("sendq") or
+                                   st.get("draining") or
+                                   st["state"] == "resolving"):
+                return  # pending work or a resolver in flight: not yet
+            conn = self._direct_actor.get(actor_id)
+            if conn is not None and not getattr(conn, "closed", True):
+                return
+            self._direct_send.pop(actor_id, None)
+            self._direct_actor.pop(actor_id, None)
+
     # ------------------------------------------------------------------ RPC handlers (io thread)
 
     async def rpc_task_result(self, conn, payload):
+        with self._direct_lock:
+            self._direct_inflight.pop(payload.get("task_id"), None)
+        self._lease_task_finished(payload.get("task_id"))
         promoted = self._pending_promoted.pop(payload.get("task_id"), None)
         if promoted:
             for oid in promoted:
@@ -1107,6 +1589,8 @@ class CoreWorker:
         return True
 
     async def rpc_stream_end(self, conn, payload):
+        with self._direct_lock:
+            self._direct_inflight.pop(payload.get("task_id"), None)
         st = self._streams.get(payload["task_id"])
         if st is not None:
             with st.cond:
@@ -1163,10 +1647,21 @@ class CoreWorker:
         return True
 
     async def rpc_push_task(self, conn, spec):
+        if spec.get("__direct__") and conn is not self.raylet:
+            # Pushed straight from the owner: results reply over this very
+            # connection, no raylet hop (reference: PushTask replies carry
+            # small results inline to the caller). The raylet guard covers a
+            # retried/reconstructed spec whose stale flag survived — those are
+            # raylet-dispatched and must answer via task_done.
+            spec["__reply_conn__"] = conn
         if spec["type"] == "actor_task":
             self._enqueue_actor_task(spec)
         else:
             self._task_executor.submit(self._execute_task_guarded, spec)
+
+    async def rpc_push_batch(self, conn, specs):
+        for spec in specs:
+            await self.rpc_push_task(conn, spec)
 
     async def rpc_init_actor(self, conn, actor_id: ActorID, spec):
         fut = self._task_executor.submit(self._init_actor, actor_id, spec)
@@ -1266,9 +1761,21 @@ class CoreWorker:
                     results = []
                 else:
                     results = self._package_error(spec, e)
+            self._reply_actor_result(spec, results)
+
+    def _reply_actor_result(self, spec, results):
+        """Route actor-call results: straight back over the owner's direct
+        connection when the call arrived on one, else via the raylet."""
+        rconn = spec.pop("__reply_conn__", None)
+        if rconn is not None and not rconn.closed:
             self.io.spawn(
-                self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
+                rconn.notify("task_result",
+                             {"task_id": spec["task_id"], "results": results})
             )
+            return
+        self.io.spawn(
+            self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
+        )
 
     def _execute_task_guarded(self, spec):
         try:
@@ -1319,11 +1826,18 @@ class CoreWorker:
         self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state=state,
                            **tracing.event_fields(spec.get("trace_ctx")))
         if spec["type"] == "actor_task":
-            self.io.spawn(
-                self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
-            )
+            self._reply_actor_result(spec, results)
         else:
-            self.io.spawn(self.raylet.notify("task_done", spec["task_id"], results))
+            rconn = spec.pop("__reply_conn__", None)
+            if rconn is not None and not rconn.closed:
+                # Leased direct task: results go straight to the owner; the
+                # raylet holds no per-task state for it.
+                self.io.spawn(
+                    rconn.notify("task_result",
+                                 {"task_id": spec["task_id"], "results": results})
+                )
+            else:
+                self.io.spawn(self.raylet.notify("task_done", spec["task_id"], results))
 
     def _package_results(self, spec, result) -> list:
         num_returns = spec["num_returns"]

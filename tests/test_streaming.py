@@ -151,3 +151,30 @@ def test_actor_death_aborts_stream(ray_start_regular):
     with pytest.raises(Exception):  # ActorDiedError / WorkerCrashedError at some index
         for _ in range(10_000):
             ray_tpu.get(next(gen), timeout=30)
+
+
+def test_streaming_interleaved_with_plain_calls(ray_start_regular):
+    """A streaming call between plain calls must not wedge the actor's ordered
+    direct send queue (regression: a raylet-detoured streaming seq left a
+    permanent hole and every later call hung)."""
+
+    @ray_tpu.remote
+    class Mixed:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def stream(self, k):
+            for i in range(k):
+                yield i
+
+    a = Mixed.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    gen = a.stream.options(num_returns="streaming").remote(3)
+    # Plain calls AFTER the streaming call must still execute.
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 2
+    assert [ray_tpu.get(r, timeout=60) for r in gen] == [0, 1, 2]
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 3
