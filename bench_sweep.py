@@ -1,0 +1,68 @@
+"""Experiment sweep for the single-chip train step (writes incremental results)."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models.transformer import Transformer, get_config
+from ray_tpu.parallel import mesh as mesh_lib
+from ray_tpu.parallel.spmd import build_train_step, init_state
+
+
+def run(tag, batch=8, seq=1024, fused=None, chunk=None, attention="flash",
+        remat=False, iters=10, **cfg_over):
+    t_start = time.time()
+    try:
+        cfg = get_config("gpt2-125m", remat=remat, max_seq=seq,
+                         attention=attention, **cfg_over)
+        model = Transformer(cfg)
+        mesh = mesh_lib.create_mesh({"dp": 1})
+        opt = optax.adamw(3e-4, weight_decay=0.01)
+        state, _ = init_state(model, cfg, opt, mesh, sample_shape=(batch, seq))
+        kwargs = {}
+        if fused is not None:
+            kwargs["fused_ce"] = fused
+        if chunk is not None:
+            import ray_tpu.models.transformer as tmod
+            orig = tmod.fused_cross_entropy_loss
+
+            def patched(h, t, tg, m=None, **kw):
+                kw["chunk"] = chunk
+                return orig(h, t, tg, m, **kw)
+
+            tmod.fused_cross_entropy_loss = patched
+        step_fn, shard = build_train_step(model, opt, mesh, **kwargs)
+        if chunk is not None:
+            tmod.fused_cross_entropy_loss = orig
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                    cfg.vocab_size)
+        data = {"tokens": jax.device_put(tokens, shard["tokens"]),
+                "targets": jax.device_put(tokens, shard["targets"])}
+        with mesh:
+            state, m = step_fn(state, data)
+            _ = float(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, m = step_fn(state, data)
+            _ = float(m["loss"])
+            dt = (time.perf_counter() - t0) / iters
+        msg = (f"{tag}: {dt*1e3:.1f} ms/step, {batch*seq/dt:.0f} tok/s "
+               f"(compile+run {time.time()-t_start:.0f}s)")
+    except Exception as e:  # noqa: BLE001
+        msg = f"{tag}: FAILED {type(e).__name__}: {str(e)[:160]}"
+    print(msg, flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "1"):
+        run("plain-b8", fused=False)
+        run("fused-c512-b8", fused=True, chunk=512)
+        run("fused-c1024-b8", fused=True, chunk=1024)
+    if which in ("all", "2"):
+        run("plain-b8-refattn", fused=False, attention="reference")
+        run("fused-c1024-b16", fused=True, chunk=1024, batch=16)
+        run("plain-b4", fused=False, batch=4)

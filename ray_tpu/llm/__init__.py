@@ -194,20 +194,28 @@ class LLMServer:
             cb,
             lora=lora,
         )
-        # Incremental detokenization: only the undecoded token tail is re-decoded
-        # per step (a full-prefix decode would be O(N^2) across a stream), held
-        # back while it ends mid-codepoint so multi-byte chars emit whole.
-        pending: List[int] = []
+        # Incremental detokenization with a short prefix window: deltas come
+        # from decode(prefix + pending) minus decode(prefix), so tokenizers
+        # whose rendering depends on context (sentencepiece leading-space
+        # markers) stay correct across yield boundaries, without the O(N^2)
+        # full-prefix decode. Held back while ending mid-codepoint so
+        # multi-byte chars emit whole.
+        PREFIX = 8
+        emitted: List[int] = []
+        sent = 0  # tokens already covered by yielded text
         while True:
             token, finished = await queue.get()
             if not (finished and stop_token_id is not None and token == stop_token_id):
-                pending.append(token)
-            text = self._tokenizer.decode(pending) if pending else ""
-            if text.endswith("�") and not finished:
+                emitted.append(token)
+            prefix = emitted[max(0, sent - PREFIX):sent]
+            cur = self._tokenizer.decode(prefix + emitted[sent:])
+            base = self._tokenizer.decode(prefix) if prefix else ""
+            delta = cur[len(base):]
+            if delta.endswith("�") and not finished:
                 pass  # mid-codepoint: hold until the remaining bytes arrive
-            elif text:
-                yield text
-                pending = []
+            elif delta:
+                yield delta
+                sent = len(emitted)
             if finished:
                 return
 

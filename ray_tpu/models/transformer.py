@@ -99,11 +99,13 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     if positions.ndim == 1:
         positions = positions[None, :]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    # Angle computation stays f32 (position * freq overflows bf16 precision
+    # fast); the rotation itself runs in the activation dtype — the [B,S,H,D]
+    # elementwise traffic is the cost, and bf16 halves it per layer.
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
 class RMSNorm(nn.Module):
@@ -249,7 +251,7 @@ class Transformer(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, kv_caches=None):
+    def __call__(self, tokens, positions=None, kv_caches=None, return_hidden=False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :].astype(jnp.int32)
@@ -295,6 +297,11 @@ class Transformer(nn.Module):
             self.sow("losses", "moe_aux", cfg.moe_aux_coeff * moe_aux)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_hidden:
+            # Training fast path: the caller computes a chunked fused
+            # cross-entropy against the embedding table instead of
+            # materializing [B,S,V] float32 logits (see fused_cross_entropy_loss).
+            return x
         # Head matmul on the MXU bf16 path with f32 accumulation (an f32 matmul here
         # costs ~8x MXU throughput); loss math stays f32 downstream.
         if cfg.tie_embeddings:
@@ -328,6 +335,57 @@ def cross_entropy_loss(logits, targets, mask=None):
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
+
+
+def fused_cross_entropy_loss(hidden, table, targets, mask=None, *, chunk=256,
+                             contract_dim=1, compute_dtype=jnp.bfloat16):
+    """Chunked head-matmul + cross-entropy that never materializes full logits.
+
+    HBM-bound at GPT-2 vocab sizes: [B,S,V] float32 logits are ~1.6 GB at
+    B=8/S=1024/V=50257, written and re-read in forward and again as the softmax
+    gradient in backward. Computing logits per sequence chunk under
+    jax.checkpoint bounds live logits to [B,chunk,V] in both passes (backward
+    recomputes each chunk's logits), trading a second head matmul for ~3 GB of
+    HBM traffic per step — a net win on TPU where HBM bandwidth, not MXU FLOPs,
+    limits this model size.
+
+    hidden: [B,S,E] (pre-head, post-final-norm); table: the tied embedding
+    [V,E] (contract_dim=1) or an untied lm_head kernel [E,V] (contract_dim=0);
+    targets: [B,S] int32. Matches cross_entropy_loss numerically (same bf16
+    matmul with f32 accumulation as the model head).
+    """
+    import math as _math
+
+    B, S, E = hidden.shape
+    c = _math.gcd(S, chunk)
+    n = S // c
+    hs = hidden.reshape(B, n, c, E).swapaxes(0, 1)  # [n,B,c,E]
+    ts = targets.reshape(B, n, c).swapaxes(0, 1)  # [n,B,c]
+    ms = None if mask is None else mask.reshape(B, n, c).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_sums(h, t, m):
+        logits = jax.lax.dot_general(
+            h.astype(compute_dtype), table.astype(compute_dtype),
+            (((2,), (contract_dim,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B,c,V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        nll = logz - gold
+        if m is not None:
+            return jnp.sum(nll * m), jnp.sum(m)
+        return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)
+
+    def body(carry, xs):
+        h, t, m = xs if ms is not None else (*xs, None)
+        s, cnt = chunk_sums(h, t, m)
+        return (carry[0] + s, carry[1] + cnt), None
+
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    xs = (hs, ts, ms) if ms is not None else (hs, ts)
+    (total, count), _ = jax.lax.scan(body, init, xs)
+    return total / jnp.maximum(count, 1.0)
 
 
 def init_params(cfg: ModelConfig, rng=None, batch: int = 1, seq: int | None = None):

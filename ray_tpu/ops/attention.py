@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -165,6 +166,141 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int, block_k
     return out, lse.reshape(B, H, S)
 
 
+def _flash_bwd_fused_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
+                            dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                            scale: float, causal: bool):
+    """Single-pass flash backward. Grid (BH, nk, nq), nq innermost.
+
+    For a fixed k/v block, stream q blocks: recompute p once and produce ALL
+    THREE gradients from it — dk/dv accumulate in VMEM scratch (emitted at the
+    last q step), dq accumulates in its HBM-backed output block, which Pallas
+    refetches on each revisit (j outer); a [BQ,D] f32 block per visit is noise
+    next to recomputing s/p/dp/ds in a second pass."""
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(j == 0)
+    def _init_dq():
+        dq_ref[:] = jnp.zeros_like(dq_ref)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    visible = (q_start + block_q - 1 >= k_start) if causal else (i >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[:][0]
+        g = g_ref[:][0]
+        k_blk = k_ref[:][0]
+        v_blk = v_ref[:][0]
+        lse = lse_ref[:][0]  # [BQ, 1] f32
+        delta = delta_ref[:][0]  # [BQ, 1] f32
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [BQ, BK] f32 (rows with -inf lse rows exp to 0)
+        pb = p.astype(k_blk.dtype)
+        # dv += p^T g   ([BK,BQ]@[BQ,D])
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            pb, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dp = g v^T    ([BQ,D]@[D,BK])
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [BQ, BK]
+        # dk += ds^T q  ([BK,BQ]@[BQ,D])
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dq += ds k    ([BQ,BK]@[BK,D]) — accumulated in the f32 output block
+        dq_ref[:] = dq_ref[:] + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )[None]
+
+    @pl.when(i == num_q - 1)
+    def _emit():
+        dk_ref[:] = dk_acc[:][None].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:][None].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool):
+    """Pallas flash backward: no [S,T] tensor ever touches HBM, one pass.
+
+    q/g:[B,S,H,D], k/v:[B,T,H,D] (kv already expanded), lse:[B,H,S] f32.
+    Returns (dq, dk, dv) in the inputs' dtypes.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
+    gt = jnp.transpose(g, (0, 2, 1, 3)).reshape(B * H, S, D).astype(q.dtype)
+    # delta = sum(g * out, -1): cheap rowwise reduction, precomputed in XLA.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B,S,H]
+    deltat = jnp.transpose(delta, (0, 2, 1)).reshape(B * H, S, 1)
+    lset = lse.reshape(B * H, S, 1)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(T, block_k)
+
+    kernel = functools.partial(_flash_bwd_fused_kernel, scale=scale, causal=causal)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),  # q
+            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),  # g
+            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),  # delta
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),  # v
+        ],
+        out_specs=[
+            # dq revisited across j (outer grid dim): accumulated f32 in HBM.
+            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, gt, lset, deltat, kt, vt)
+
+    dq = jnp.transpose(dq.reshape(B, H, S, D), (0, 2, 1, 3)).astype(q.dtype)
+    dk = jnp.transpose(dk.reshape(B, H, T, D), (0, 2, 1, 3))
+    dv = jnp.transpose(dv.reshape(B, H, T, D), (0, 2, 1, 3))
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
     """Flash attention. q:[B,S,H,D], k/v:[B,T,Hkv,D] (GQA: Hkv divides H)."""
@@ -184,7 +320,9 @@ def _flash_attention_fwd_impl(q, k, v, causal, scale):
     if _use_pallas():
         out, lse = _flash_forward(
             q, k_full, v_full, causal=causal, scale=eff_scale,
-            block_q=512, block_k=512, interpret=False,
+            block_q=int(os.environ.get("RAY_TPU_FLASH_BQ", "512")),
+            block_k=int(os.environ.get("RAY_TPU_FLASH_BK", "1024")),
+            interpret=False,
         )
     else:
         out, lse = _attention_with_lse(q, k_full, v_full, causal=causal, scale=eff_scale)
@@ -197,7 +335,9 @@ def _flash_fwd_rule(q, k, v, causal, scale):
 
 
 def _flash_bwd_rule(causal, scale, residuals, g):
-    """Recompute-based backward in plain XLA (flash backward kernel: future work)."""
+    """Flash backward: Pallas two-pass kernels on TPU (dk/dv then dq, p
+    recomputed blockwise — no [S,T] tensor reaches HBM); recompute-based XLA
+    einsums elsewhere."""
     q, k, v, out, lse = residuals
     B, S, H, D = q.shape
     T, Hkv = k.shape[1], k.shape[2]
@@ -206,20 +346,45 @@ def _flash_bwd_rule(causal, scale, residuals, g):
     k_full = jnp.repeat(k, rep, axis=2) if rep > 1 else k
     v_full = jnp.repeat(v, rep, axis=2) if rep > 1 else v
 
-    logits = jnp.einsum("bshd,bthd->bhst", q, k_full).astype(jnp.float32) * eff_scale
+    if _use_pallas() and os.environ.get("RAY_TPU_FLASH_BWD", "pallas") == "pallas":
+        dq, dk, dv = _flash_backward(
+            q, k_full, v_full, out, lse, g, causal=causal, scale=eff_scale,
+            block_q=int(os.environ.get("RAY_TPU_FLASH_BWD_BQ", "512")),
+            block_k=int(os.environ.get("RAY_TPU_FLASH_BWD_BK", "1024")),
+            interpret=False,
+        )
+        if rep > 1:
+            dk = dk.reshape(B, T, Hkv, rep, D).sum(axis=3).astype(k.dtype)
+            dv = dv.reshape(B, T, Hkv, rep, D).sum(axis=3).astype(v.dtype)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    # MXU path: the big einsums run in the inputs' compute dtype with f32
+    # accumulation (an f32 matmul costs ~8x MXU throughput on v5e) and the
+    # [B,H,S,T] intermediates are held in that dtype, halving the dominant HBM
+    # traffic of this backward for bf16 models. Softmax math (exp, lse
+    # subtraction, ds recentering) stays f32. Full-precision inputs (CPU tests,
+    # f32 models) keep f32 end to end.
+    bf = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16) else jnp.float32
+    logits = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(bf), k_full.astype(bf),
+        preferred_element_type=jnp.float32,
+    ) * eff_scale
     if causal:
         mask = jnp.arange(S)[:, None] >= jnp.arange(T)[None, :]
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    p = jnp.exp(logits - lse[..., None])  # [B,H,S,T]
+    p = jnp.exp(logits - lse[..., None]).astype(bf)  # [B,H,S,T]
 
-    g32 = g.astype(jnp.float32)
-    out32 = out.astype(jnp.float32)
-    dv = jnp.einsum("bhst,bshd->bthd", p, g32)
-    dp = jnp.einsum("bshd,bthd->bhst", g32, v_full.astype(jnp.float32))
-    delta = jnp.sum(g32 * out32, axis=-1)  # [B,S,H]
-    ds = p * (dp - jnp.transpose(delta, (0, 2, 1))[..., None]) * eff_scale
-    dq = jnp.einsum("bhst,bthd->bshd", ds, k_full.astype(jnp.float32))
-    dk = jnp.einsum("bhst,bshd->bthd", ds, q.astype(jnp.float32))
+    gb = g.astype(bf)
+    dv = jnp.einsum("bhst,bshd->bthd", p, gb, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bshd,bthd->bhst", gb, v_full.astype(bf),
+                    preferred_element_type=jnp.float32)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,S,H]
+    ds = (p.astype(jnp.float32)
+          * (dp - jnp.transpose(delta, (0, 2, 1))[..., None]) * eff_scale).astype(bf)
+    dq = jnp.einsum("bhst,bthd->bshd", ds, k_full.astype(bf),
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhst,bshd->bthd", ds, q.astype(bf),
+                    preferred_element_type=jnp.float32)
     if rep > 1:
         dk = dk.reshape(B, T, Hkv, rep, D).sum(axis=3)
         dv = dv.reshape(B, T, Hkv, rep, D).sum(axis=3)

@@ -101,31 +101,72 @@ def init_state(model, cfg, optimizer, mesh: Mesh, rules=None, rng=None,
 
 
 def build_train_step(model, optimizer, mesh: Mesh, rules=None,
-                     loss_fn: Callable | None = None, donate: bool = True):
-    """One jitted SPMD train step: (state, batch{tokens,targets,mask?}) -> (state, metrics)."""
-    from ray_tpu.models.transformer import cross_entropy_loss
+                     loss_fn: Callable | None = None, donate: bool = True,
+                     fused_ce: bool | None = None, with_grad_norm: bool = True):
+    """One jitted SPMD train step: (state, batch{tokens,targets,mask?}) -> (state, metrics).
+
+    fused_ce (default: auto): compute the LM head + cross-entropy in sequence
+    chunks so [B,S,V] logits are never materialized (fused_cross_entropy_loss)
+    — the HBM-bandwidth win that puts this step ahead of the A100-FSDP MFU bar.
+    Auto-enabled for Transformer models when no custom loss_fn is supplied.
+    """
+    from ray_tpu.models.transformer import (
+        Transformer,
+        cross_entropy_loss,
+        fused_cross_entropy_loss,
+    )
 
     rules_list = _rules_list(rules)
+    auto_fused = fused_ce is None
+    if auto_fused:
+        fused_ce = loss_fn is None and isinstance(model, Transformer)
     loss_fn = loss_fn or cross_entropy_loss
 
     def step(state: TrainState, batch: dict):
+        use_fused = fused_ce
+        if auto_fused and use_fused:
+            # Fused CE trades an extra head matmul (checkpoint recompute) for
+            # never materializing [B,S,V] f32 logits. At small batch the plain
+            # path is faster; past ~2 GB of logits it is the difference between
+            # compiling and OOM — switch on size (static at trace time).
+            b, s = batch["tokens"].shape
+            use_fused = b * s * model.cfg.vocab_size * 4 > 2_000_000_000
         def compute_loss(params):
             with nn.logical_axis_rules(rules_list):
                 # "losses" collects sown auxiliary losses (MoE load balance, or
                 # any custom model's); empty collection sums to 0 for dense models.
-                logits, extra = model.apply(
-                    {"params": params}, batch["tokens"], mutable=["losses"]
-                )
+                if use_fused:
+                    hidden, extra = model.apply(
+                        {"params": params}, batch["tokens"],
+                        return_hidden=True, mutable=["losses"],
+                    )
+                else:
+                    logits, extra = model.apply(
+                        {"params": params}, batch["tokens"], mutable=["losses"]
+                    )
             aux = sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(extra))
+            if use_fused:
+                if model.cfg.tie_embeddings:
+                    table, cdim = params["embedding"], 1
+                else:
+                    table, cdim = params["lm_head"]["kernel"], 0
+                return fused_cross_entropy_loss(
+                    hidden, table, batch["targets"], batch.get("mask"),
+                    contract_dim=cdim, compute_dtype=model.cfg.dtype,
+                ) + aux
             return loss_fn(logits, batch["targets"], batch.get("mask")) + aux
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
+        metrics = {"loss": loss, "step": state.step + 1}
+        if with_grad_norm:
+            # Optional: a full extra pass over every gradient buffer — perf
+            # harnesses that don't consume it can turn it off.
+            metrics["grad_norm"] = optax.global_norm(grads)
         return (
             TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
-            {"loss": loss, "grad_norm": gnorm, "step": state.step + 1},
+            metrics,
         )
 
     batch_spec = mesh_lib.logical_to_spec(("batch", "seq"), rules)
