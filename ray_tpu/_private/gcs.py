@@ -449,11 +449,29 @@ class GcsService:
 
         return max(candidates, key=utilization)
 
+    def _node_for_pg_bundle(self, pg_spec: dict) -> NodeInfo | None:
+        """PG-bound actors go to their bundle's allocated node — the bundle has
+        the resources RESERVED there, so availability-based picking would (a)
+        land elsewhere and (b) find nothing when the bundle claims a node's
+        whole supply (reference: bundle scheduling policy)."""
+        pg = self.placement_groups.get(pg_spec.get("pg_id"))
+        if pg is None or pg.state != ALIVE:
+            return None
+        idx = pg_spec.get("bundle_index", 0)
+        if idx >= len(pg.allocations) or pg.allocations[idx] is None:
+            return None
+        node = self.nodes.get(pg.allocations[idx])
+        return node if node is not None and node.alive else None
+
     async def _schedule_actor(self, actor: ActorInfo, retries: int = 60):
         spec = actor.spec
         resources = dict(spec.get("resources") or {})
+        pg_spec = spec.get("placement_group")
         for attempt in range(retries):
-            node = self._pick_node_for(resources, spec.get("scheduling_strategy"))
+            if pg_spec:
+                node = self._node_for_pg_bundle(pg_spec)
+            else:
+                node = self._pick_node_for(resources, spec.get("scheduling_strategy"))
             if node is None:
                 actor.placing = False  # truly unplaceable: autoscaler demand
                 await asyncio.sleep(0.25)

@@ -436,9 +436,11 @@ class CoreWorker:
         )
         self.gcs = self.io.run(rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs"))
         direct_port = None
-        if self.mode == "worker" and not self.remote_data_plane:
-            # Direct-call server: peers (owners of actor calls / leased tasks)
-            # push work here without a raylet hop on the hot path.
+        if not self.remote_data_plane:
+            # Direct-call server: peers (owners of actor calls / leased tasks,
+            # cross-node channel readers) reach this process without a raylet
+            # hop on the hot path. Drivers host one too: they are the writer
+            # side of a compiled DAG's input channel.
             self._direct_server = self.io.run(rpc.RpcServer(lambda conn: self).start())
             direct_port = self._direct_server.port
         reply = self.io.run(
@@ -1662,6 +1664,29 @@ class CoreWorker:
     async def rpc_push_batch(self, conn, specs):
         for spec in specs:
             await self.rpc_push_task(conn, spec)
+
+    async def rpc_chan_pull(self, conn, name, reader, index, poll: float = 25.0):
+        """Cross-node channel long-poll: serve one ring item to a remote reader
+        (ring lives in this process — see experimental/channel.py RpcChannel).
+        Poll interval backs off 0.5ms -> 10ms so a hot pipeline sees sub-ms
+        latency while an idle one doesn't spin the shared event loop."""
+        from ray_tpu.experimental.channel import _ring_pull
+
+        deadline = time.monotonic() + min(poll, 25.0)
+        delay = 0.0005
+        while True:
+            resp = _ring_pull(name, reader, index)
+            if "wait" not in resp and "unknown" not in resp:
+                return resp
+            if time.monotonic() > deadline:
+                return resp  # reader loop retries (keeps conns live/cancellable)
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 0.01)
+
+    async def rpc_chan_close(self, conn, name):
+        from ray_tpu.experimental.channel import _ring_close
+
+        return _ring_close(name)
 
     async def rpc_init_actor(self, conn, actor_id: ActorID, spec):
         fut = self._task_executor.submit(self._init_actor, actor_id, spec)

@@ -22,7 +22,7 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
-from ray_tpu.experimental.channel import Channel, ChannelClosed
+from ray_tpu.experimental.channel import Channel, ChannelClosed, RpcChannel
 
 
 class _ExecSpec:
@@ -57,6 +57,19 @@ def _read_source(kind, src):
 
 def _exec_loop(instance, specs: List[_ExecSpec]):
     """Runs inside the actor (as one pinned long-running method call)."""
+    try:
+        return _exec_loop_inner(instance, specs)
+    finally:
+        # Reclaim writer-side ring state of cross-node channels hosted here.
+        for spec in specs:
+            if spec.out_channel is not None:
+                try:
+                    spec.out_channel.destroy()
+                except Exception:
+                    pass
+
+
+def _exec_loop_inner(instance, specs: List[_ExecSpec]):
     while True:
         try:
             for spec in specs:
@@ -167,6 +180,46 @@ class CompiledDAG:
             elif isinstance(n, ClassMethodNode):
                 for u in n.upstream:
                     consumers[id(u)] = consumers.get(id(u), 0) + 1
+        # Edge placement: shm channels only connect processes on ONE node;
+        # edges that cross nodes get an RpcChannel (ring in the writer process,
+        # readers pull over the direct worker servers). Reference: cross-node
+        # mutable-plasma channels, experimental_mutable_object_provider.h:143.
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        driver_node = w.node_id
+        node_cache: Dict[Any, Any] = {}
+
+        def node_of(actor):
+            aid = actor._actor_id
+            if aid not in node_cache:
+                info = w.gcs_call("wait_actor_alive", aid, 60.0)
+                addr = (info or {}).get("address") or {}
+                node_cache[aid] = addr.get("node_id")
+            return node_cache[aid]
+
+        consumer_nodes: Dict[int, set] = {}
+        for n in nodes:
+            if isinstance(n, CollectiveOutputNode):
+                for p in n.participants:
+                    consumer_nodes.setdefault(id(p), set()).add(node_of(n.actor))
+            elif isinstance(n, ClassMethodNode):
+                for u in n.upstream:
+                    consumer_nodes.setdefault(id(u), set()).add(node_of(n.actor))
+        for out in outputs:
+            consumer_nodes.setdefault(id(out), set()).add(driver_node)
+
+        def make_channel(writer_node, reader_nodes, n_readers, owner):
+            if all(rn == writer_node for rn in reader_nodes):
+                return Channel(self._buffer, n_readers)
+            if owner is None:
+                raise RuntimeError(
+                    "compiled DAGs with cross-node edges need a local data "
+                    "plane: this driver has no direct server (thin-client "
+                    "mode), so actors on other nodes cannot pull its channels"
+                )
+            return RpcChannel(self._buffer, n_readers, owner=owner)
+
         # Input channel read by every arg occurrence that consumes the input
         # (directly or through attribute nodes).
         input_consumers = consumers.get(id(self._input_node), 0) + sum(
@@ -174,7 +227,18 @@ class CompiledDAG:
             for n in nodes
             if isinstance(n, InputAttributeNode)
         )
-        self._input_channel = Channel(self._buffer, max(1, input_consumers))
+        input_reader_nodes = set()
+        for n in nodes:
+            if isinstance(n, (InputNode, InputAttributeNode)):
+                input_reader_nodes |= consumer_nodes.get(id(n), set())
+        direct_server = getattr(w, "_direct_server", None)
+        driver_addr = (
+            ("addr", ("127.0.0.1", direct_server.port))
+            if direct_server is not None else None
+        )
+        self._input_channel = make_channel(
+            driver_node, input_reader_nodes, max(1, input_consumers), driver_addr
+        )
         for out in outputs:
             consumers[id(out)] = consumers.get(id(out), 0) + 1  # driver reads leaves
 
@@ -185,7 +249,10 @@ class CompiledDAG:
                 isinstance(n, (ClassMethodNode, CollectiveOutputNode))
                 and consumers.get(id(n), 0) > 0
             ):
-                chan_of[id(n)] = Channel(self._buffer, consumers[id(n)])
+                chan_of[id(n)] = make_channel(
+                    node_of(n.actor), consumer_nodes.get(id(n), set()),
+                    consumers[id(n)], ("actor", n.actor._actor_id),
+                )
 
         # Assign reader slots.
         next_slot: Dict[int, int] = {}

@@ -185,3 +185,220 @@ class Channel:
             self._shm.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------
+# Cross-node channel: same ring semantics over the workers' direct RPC servers.
+#
+# Design parity: reference cross-node channels are raylet-mediated mutable
+# plasma objects (shared_memory_channel.py:151 + experimental_mutable_object_
+# provider.h:143). Here the ring buffer lives in the WRITER's process and
+# readers long-poll it over the direct worker connections the runtime already
+# maintains — one RTT per item per reader, no per-item raylet involvement.
+# --------------------------------------------------------------------------
+
+
+class _RingState:
+    """Writer-process state of one RpcChannel."""
+
+    def __init__(self, num_readers: int, num_slots: int):
+        import threading
+
+        self.num_readers = num_readers
+        self.num_slots = num_slots
+        self.slots: list = [None] * num_slots
+        self.write_version = 0
+        self.acks = [0] * num_readers
+        self.closed = False
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+
+
+_rpc_rings: dict = {}  # channel name -> _RingState (writer process only)
+_conn_cache: dict = {}  # (host, port) -> rpc.Connection (reader process)
+
+
+def _ring_pull(name: str, reader: int, index: int):
+    """One non-blocking pull attempt (called from the worker's RPC handler).
+    Returns {"data"}|{"closed"}|{"wait"}|{"unknown"}."""
+    ring = _rpc_rings.get(name)
+    if ring is None:
+        return {"unknown": True}
+    with ring.lock:
+        if ring.write_version > index:
+            data = ring.slots[index % ring.num_slots]
+            if 0 <= reader < ring.num_readers:
+                ring.acks[reader] = index + 1
+            ring.cond.notify_all()
+            return {"data": data}
+        if ring.closed:
+            return {"closed": True}
+    return {"wait": True}
+
+
+def _ring_close(name: str):
+    ring = _rpc_rings.get(name)
+    if ring is not None:
+        with ring.lock:
+            ring.closed = True
+            ring.cond.notify_all()
+    return True
+
+
+def _ring_destroy(name: str):
+    """Release payload memory but keep a CLOSED tombstone: a remote reader that
+    polls after destroy must see {"closed"} and unwind its pinned loop, not spin
+    on {"unknown"} forever."""
+    ring = _rpc_rings.get(name)
+    if ring is not None:
+        with ring.lock:
+            ring.closed = True
+            ring.slots = [None] * ring.num_slots
+            ring.cond.notify_all()
+
+
+class RpcChannel:
+    """Channel whose ring lives in the writer's process; readers pull over the
+    direct worker RPC servers. Interface-compatible with Channel (write/read,
+    reader(slot), close/destroy, picklable by name)."""
+
+    def __init__(self, capacity: int = 4 << 20, num_readers: int = 1,
+                 num_slots: int = 4, owner=None, _name: Optional[str] = None,
+                 _reader_slot: Optional[int] = None):
+        self._capacity = capacity  # advisory only (no fixed slot size)
+        self._num_readers = num_readers
+        self._num_slots = num_slots
+        # owner: where the writer lives — ("actor", ActorID) resolved via the
+        # GCS, or ("addr", (host, port)) for a driver-owned channel.
+        self._owner = owner
+        self._name = _name or f"rtpurpc_{uuid.uuid4().hex[:12]}"
+        self._reader_slot = _reader_slot
+        self._next = 0  # reader-side: next item index to pull
+        self._conn = None
+
+    def __reduce__(self):
+        return (
+            RpcChannel,
+            (self._capacity, self._num_readers, self._num_slots, self._owner,
+             self._name, self._reader_slot),
+        )
+
+    def reader(self, slot: int) -> "RpcChannel":
+        return RpcChannel(self._capacity, self._num_readers, self._num_slots,
+                          self._owner, self._name, slot)
+
+    # -- writer (runs in the owner process) --------------------------------
+    def _ring(self) -> _RingState:
+        ring = _rpc_rings.get(self._name)
+        if ring is None:
+            ring = _rpc_rings[self._name] = _RingState(
+                self._num_readers, self._num_slots
+            )
+        return ring
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        self.write_bytes(
+            cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL), timeout
+        )
+
+    def write_bytes(self, data: bytes, timeout: Optional[float] = None):
+        ring = self._ring()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with ring.lock:
+            while True:
+                if ring.closed:
+                    raise ChannelClosed()
+                if ring.write_version - min(ring.acks) < ring.num_slots:
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "channel write timed out waiting for readers"
+                    )
+                ring.cond.wait(0.05)
+            ring.slots[ring.write_version % ring.num_slots] = data
+            ring.write_version += 1
+            ring.cond.notify_all()
+
+    # -- reader (any process) ----------------------------------------------
+    def _writer_conn(self):
+        from ray_tpu._private import rpc
+        from ray_tpu._private.worker import global_worker
+
+        if self._conn is not None and not self._conn.closed:
+            return self._conn
+        w = global_worker()
+        if self._owner is None:
+            raise ChannelClosed()
+        kind, ref = self._owner
+        if kind == "addr":
+            addr = tuple(ref)
+        else:
+            info = w.gcs_call("get_actor_info", ref)
+            if info is None or info["state"] == "DEAD":
+                raise ChannelClosed()
+            addr = (info.get("address") or {}).get("direct_addr")
+            if addr is None:
+                raise ChannelClosed()
+        # One socket per (process, writer address), shared by every channel
+        # view into that writer — k edges into one stage must not open k conns.
+        cached = _conn_cache.get(addr)
+        if cached is not None and not cached.closed:
+            self._conn = cached
+            return cached
+        self._conn = w.io.run(
+            rpc.connect(*addr, handler=w, name=f"chan->{addr[1]}")
+        )
+        _conn_cache[addr] = self._conn
+        return self._conn
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return cloudpickle.loads(self.read_bytes(timeout))
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        from ray_tpu._private import rpc
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        reader = self._reader_slot or 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError("channel read timed out")
+            # The server long-polls at most `poll` seconds, so a short read
+            # timeout is honored to within one RPC, not one 25s poll.
+            poll = 25.0 if remaining is None else max(0.05, min(25.0, remaining))
+            try:
+                conn = self._writer_conn()
+                resp = w.io.run(
+                    conn.call("chan_pull", self._name, reader, self._next, poll),
+                    timeout=poll + 10,
+                )
+            except (rpc.RpcError, TimeoutError, OSError):
+                self._conn = None
+                raise ChannelClosed()  # writer gone: the pinned loop unwinds
+            if "data" in resp:
+                self._next += 1
+                return resp["data"]
+            if resp.get("closed"):
+                raise ChannelClosed()
+            # "wait"/"unknown": ring not created yet or nothing new yet.
+
+    def close(self):
+        # Writer-local rings close directly; otherwise tell the writer.
+        if self._name in _rpc_rings:
+            _ring_close(self._name)
+            return
+        try:
+            conn = self._writer_conn()
+            from ray_tpu._private.worker import global_worker
+
+            global_worker().io.run(conn.notify("chan_close", self._name))
+        except Exception:
+            pass  # writer already dead: nothing to close
+
+    def destroy(self):
+        _ring_destroy(self._name)
+        # The reader conn is shared per writer address (_conn_cache): just drop
+        # the reference; other channels into the same writer keep using it.
+        self._conn = None

@@ -274,3 +274,50 @@ def test_collective_mean_and_validation():
             collective.allreduce.bind(
                 [w1.val.bind(inp), w2.val.bind(inp)], op="xor"
             )
+
+
+def test_compiled_dag_across_two_nodes():
+    """A compiled DAG pins loops on actors on TWO nodes: cross-node edges ride
+    RpcChannel (ring in the writer, readers pull over direct worker conns) and
+    same-node edges stay on shm — selection is automatic (VERDICT #6;
+    reference: cross-node mutable-object channels,
+    experimental_mutable_object_provider.h:143)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+
+    ray_tpu.shutdown()
+    env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1, "env_vars": env})
+    cluster.add_node(num_cpus=1, resources={"stage2": 1.0}, env_vars=env)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class A:
+            def double(self, x):
+                return x * 2
+
+        @ray_tpu.remote(num_cpus=0, resources={"stage2": 0.1})
+        class B:
+            def add_one(self, x):
+                return x + 1
+
+        a, b = A.remote(), B.remote()
+        with InputNode() as inp:
+            mid = a.double.bind(inp)      # head node
+            out = b.add_one.bind(mid)     # second node: cross-node edge
+        dag = out.experimental_compile()
+        try:
+            from ray_tpu.experimental.channel import RpcChannel
+
+            # The a->b edge and the b->driver edge must be RPC channels; the
+            # driver->a input edge stays local (driver and A share the head).
+            kinds = [type(ch).__name__ for ch in dag._channels]
+            assert "RpcChannel" in kinds, kinds
+            for i in range(5):
+                assert dag.execute(i).get(timeout=120) == i * 2 + 1
+        finally:
+            dag.teardown()
+    finally:
+        cluster.shutdown()
