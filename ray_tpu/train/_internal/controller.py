@@ -55,7 +55,14 @@ class TrainController:
         self._failure_policy = DefaultFailurePolicy(
             run_config.failure_config.max_failures
         )
-        self._scaling_policy = ScalingPolicy(scaling_config)
+        if getattr(scaling_config, "min_workers", None) is not None:
+            from ray_tpu.train._internal.failure_policy import ElasticScalingPolicy
+
+            self._scaling_policy = ElasticScalingPolicy(
+                scaling_config, scaling_config.min_workers
+            )
+        else:
+            self._scaling_policy = ScalingPolicy(scaling_config)
         self._checkpoints = CheckpointManager(run_config.checkpoint_config)
         self._latest_metrics: dict | None = None
         self._experiment_name = run_config.name or f"train_{int(time.time())}"

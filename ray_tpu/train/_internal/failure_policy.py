@@ -33,11 +33,56 @@ class DefaultFailurePolicy(FailurePolicy):
 
 
 class ScalingPolicy:
-    """Decides the world size for (re)starts. Fixed for now; elastic policies return a
-    different size after failures (reference scaling_policy/)."""
+    """Decides the world size for (re)starts (reference scaling_policy/):
+    the fixed policy always returns the configured size."""
 
     def __init__(self, scaling_config):
         self.scaling_config = scaling_config
 
     def world_size_for_attempt(self, attempt: int) -> int:
         return self.scaling_config.num_workers
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Resize the world at restart to what the cluster can actually place.
+
+    Reference: python/ray/train/v2/_internal/execution/scaling_policy/ — a
+    lost node means the next attempt continues at reduced size (bounded below
+    by min_workers) from the latest checkpoint; when capacity returns, a later
+    restart scales back toward the configured size. Feasibility is computed
+    from the live per-node available-resource view, packing worker bundles
+    greedily the way the placement group will.
+    """
+
+    def __init__(self, scaling_config, min_workers: int):
+        super().__init__(scaling_config)
+        self.min_workers = max(1, int(min_workers))
+
+    def world_size_for_attempt(self, attempt: int) -> int:
+        target = self.scaling_config.num_workers
+        if attempt == 0:
+            return target
+        import ray_tpu
+
+        demand = self.scaling_config._resources_per_worker_not_none
+        feasible = 0
+        try:
+            view = ray_tpu.nodes()
+        except Exception:
+            return target
+        for node in view:
+            if not node.get("alive"):
+                continue
+            # CAPACITY of live nodes, not instantaneous availability: the dead
+            # attempt's placement group may not have released its bundles yet,
+            # and elasticity is about cluster membership, not transient load.
+            total = dict(node.get("resources_total") or {})
+            while feasible < target and all(
+                total.get(r, 0.0) + 1e-9 >= amt for r, amt in demand.items()
+            ):
+                for r, amt in demand.items():
+                    total[r] = total.get(r, 0.0) - amt
+                feasible += 1
+            if feasible >= target:
+                break
+        return max(self.min_workers, min(target, feasible))

@@ -29,10 +29,23 @@ class ScalingConfig:
     resources_per_worker: Optional[dict] = None
     placement_strategy: str = "PACK"
     chips_per_host: int = 4
+    # Elastic training: restarts may resize the world down to min_workers when
+    # capacity is lost and back up when it returns (reference:
+    # train/v2/_internal/execution/scaling_policy/). None = fixed size.
+    min_workers: Optional[int] = None
 
     def __post_init__(self):
         if self.num_workers is None and self.topology is None:
             self.num_workers = 1
+        if (
+            self.min_workers is not None
+            and self.num_workers is not None
+            and self.min_workers > self.num_workers
+        ):
+            raise ValueError(
+                f"min_workers ({self.min_workers}) must be <= num_workers "
+                f"({self.num_workers})"
+            )
         if self.topology is not None:
             # "v4-16" -> 16 cores -> hosts = cores / (2 cores-per-chip * chips-per-host)
             # Keep the simple public convention: N in vX-N counts chips for v5e/v6e and
