@@ -9,7 +9,9 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, MARWIL, BCConfig, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.multi_agent import MultiAgentPPO
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
@@ -20,14 +22,23 @@ from ray_tpu.rllib.core.rl_module import (
     build_default_module,
 )
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent_env_runner import (
+    MultiAgentEnvRunner,
+    MultiAgentEnvRunnerGroup,
+)
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
     "BC",
     "BCConfig",
     "Columns",
+    "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
+    "MultiAgentPPO",
     "DQN",
     "DQNConfig",
     "IMPALA",

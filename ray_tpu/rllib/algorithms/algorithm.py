@@ -34,7 +34,8 @@ class Algorithm:
             probe.observation_space, probe.action_space,
             tuple(config.model.get("hiddens", (64, 64))),
         )
-        probe.close()
+        if hasattr(probe, "close"):
+            probe.close()
         module_blob = cloudpickle.dumps(self._module)
         self.env_runner_group = EnvRunnerGroup(
             cloudpickle.dumps(env_fn), module_blob,

@@ -35,6 +35,11 @@ class AlgorithmConfig:
         self.learner_resources: Optional[dict] = None
         # debugging
         self.seed: Optional[int] = None
+        # multi-agent (reference: AlgorithmConfig.multi_agent()): policy ids ->
+        # None (derive module from the mapped agents' spaces) and a mapping fn
+        # agent_id -> policy_id. Empty = single-agent.
+        self.policies: Dict[str, Any] = {}
+        self.policy_mapping_fn: Optional[Callable] = None
         # algo-specific extras live as attributes set by subclasses
         self.extra: Dict[str, Any] = {}
 
@@ -99,6 +104,21 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
+    def multi_agent(self, *, policies=None, policy_mapping_fn: Optional[Callable] = None):
+        """Configure per-policy training over a multi-agent env (reference:
+        algorithm_config.py multi_agent()). `policies` is a dict policy_id ->
+        None (module derived from the mapped agents' spaces) or a prebuilt
+        RLModule; `policy_mapping_fn(agent_id)` routes agents to policies
+        (default: identity, one policy per agent id)."""
+        if policies is not None:
+            self.policies = (
+                {p: None for p in policies} if not isinstance(policies, dict)
+                else dict(policies)
+            )
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def copy(self) -> "AlgorithmConfig":
         return copy.deepcopy(self)
 
@@ -106,6 +126,16 @@ class AlgorithmConfig:
     def build_algo(self):
         if self._algo_class is None:
             raise ValueError("config has no algorithm class; use PPOConfig() etc.")
+        if self.policies:
+            from ray_tpu.rllib.algorithms.multi_agent import MultiAgentPPO
+            from ray_tpu.rllib.algorithms.ppo import PPO
+
+            if self._algo_class is PPO:
+                return MultiAgentPPO(self.copy())
+            raise ValueError(
+                f"multi_agent() is supported for PPO (got "
+                f"{self._algo_class.__name__})"
+            )
         return self._algo_class(self.copy())
 
     build = build_algo  # legacy alias, parity with the reference

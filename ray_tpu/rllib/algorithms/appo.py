@@ -1,0 +1,73 @@
+"""APPO: asynchronous PPO — IMPALA's V-trace machinery + PPO's clipped surrogate.
+
+Design parity: reference `rllib/algorithms/appo/appo.py` (APPOConfig defaults,
+`training_step` inherits IMPALA's async sample/broadcast loop) and the APPO learner
+loss (V-trace-corrected advantages fed into the PPO clip objective,
+`appo/torch/appo_torch_learner.py`). Sampling runs with stale weights like IMPALA
+(broadcast_interval); V-trace corrects the off-policyness, and the PPO clip bounds
+each update — the combination is what lets APPO take multiple epochs per batch
+where IMPALA takes one.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, _vtrace_forward
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=APPO)
+        self.vtrace_clip_rho_threshold: float = 1.0
+        self.vtrace_clip_c_threshold: float = 1.0
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.rollout_fragment_length: int = 50
+        self.broadcast_interval: int = 2
+        self.lr = 5e-4
+        self.train_batch_size = 1000
+        self.minibatch_size = 0  # whole [B, T] batches, like IMPALA
+        self.num_epochs = 2  # the PPO clip makes batch reuse safe (IMPALA uses 1)
+        self.gamma = 0.99
+
+
+def _appo_loss_factory(rho_clip, c_clip, clip_param, vf_coeff, ent_coeff, gamma):
+    def appo_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        sg = jax.lax.stop_gradient
+        target_logp, entropy, values, vs, pg_adv, rho, mask, norm = (
+            _vtrace_forward(module, params, batch, rho_clip, c_clip, gamma)
+        )
+        # PPO clip on the importance ratio, with V-trace advantages. Unlike
+        # IMPALA's -logp * adv, the ratio carries the gradient and the clip
+        # bounds how far one batch can move the policy.
+        ratio = jnp.exp(target_logp - batch["action_logp"])
+        surrogate = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * pg_adv,
+        )
+        policy_loss = -jnp.sum(surrogate * mask) / norm
+        vf_loss = 0.5 * jnp.sum(((values - sg(vs)) ** 2) * mask) / norm
+        ent = jnp.sum(entropy * mask) / norm
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "mean_rho": jnp.sum(rho * mask) / norm,
+            "mean_ratio": jnp.sum(ratio * mask) / norm,
+        }
+
+    return appo_loss
+
+
+class APPO(IMPALA):
+    def loss_fn(self):
+        c = self.config
+        return _appo_loss_factory(
+            c.vtrace_clip_rho_threshold, c.vtrace_clip_c_threshold,
+            c.clip_param, c.vf_loss_coeff, c.entropy_coeff, c.gamma,
+        )

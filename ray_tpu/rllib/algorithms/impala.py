@@ -36,66 +36,82 @@ class IMPALAConfig(AlgorithmConfig):
         self.gamma = 0.99
 
 
+def _vtrace_forward(module, params, batch, rho_clip, c_clip, gamma):
+    """Shared V-trace machinery: forward the module over [B,T] sequences and
+    compute clipped-IS value targets + policy-gradient advantages.
+
+    Returns (target_logp, entropy, values, vs, pg_adv, rho, mask, norm).
+    Reference: rllib/algorithms/impala/vtrace (the same recurrence APPO's
+    learner reuses, rllib/algorithms/appo/appo.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    obs = batch[Columns.OBS]                    # [B, T, obs]
+    actions = batch[Columns.ACTIONS]            # [B, T]
+    behavior_logp = batch[Columns.ACTION_LOGP]  # [B, T]
+    rewards = batch[Columns.REWARDS]            # [B, T]
+    dones = batch["dones"]                      # [B, T] 1.0 at termination
+    mask = batch["mask"]                        # [B, T] 1.0 on real steps
+    bootstrap = batch["bootstrap_value"]        # [B]
+    last_idx = batch["last_idx"].astype(jnp.int32)  # [B] last REAL step
+
+    B, T = actions.shape
+    flat = {Columns.OBS: obs.reshape(B * T, -1)}
+    out = module.forward_train(params, flat)
+    dist_in = out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
+    values = out[Columns.VF_PREDS].reshape(B, T)
+    target_logp = module.dist_logp(dist_in, actions)
+    entropy = module.dist_entropy(dist_in)
+
+    # --- V-trace targets (stop-gradient region) -----------------------
+    sg = jax.lax.stop_gradient
+    log_rho = sg(target_logp) - behavior_logp
+    rho = jnp.minimum(jnp.exp(log_rho), rho_clip)
+    c = jnp.minimum(jnp.exp(log_rho), c_clip)
+    v = sg(values)
+    discounts = gamma * (1.0 - dones)
+    # The bootstrap value is the successor of each sequence's LAST REAL step
+    # (sequences shorter than T are zero-padded; placing the bootstrap at
+    # index T-1 would hand real steps the value of padded observations).
+    B_idx = jnp.arange(v.shape[0])
+    v_next = jnp.concatenate([v[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1)
+    v_next = v_next.at[B_idx, last_idx].set(bootstrap)
+    # Masked deltas: padded steps contribute nothing, and nothing from the pad
+    # region chains backward into real steps through the recursion.
+    deltas = rho * (rewards + discounts * v_next - v) * mask
+
+    def back(carry, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * carry
+        return acc, acc
+
+    # scan over time reversed; operate time-major [T, B]
+    _, acc = jax.lax.scan(
+        back,
+        jnp.zeros_like(bootstrap),
+        (deltas.T, discounts.T, c.T),
+        reverse=True,
+    )
+    vs = v + acc.T                                  # [B, T]
+    vs_next = jnp.concatenate(
+        [vs[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+    )
+    vs_next = vs_next.at[B_idx, last_idx].set(bootstrap)
+    pg_adv = sg(rho * (rewards + discounts * vs_next - v))
+
+    norm = jnp.maximum(1.0, jnp.sum(mask))
+    return target_logp, entropy, values, vs, pg_adv, rho, mask, norm
+
+
 def _impala_loss_factory(rho_clip, c_clip, vf_coeff, ent_coeff, gamma):
     def impala_loss(module, params, batch):
         import jax
         import jax.numpy as jnp
 
-        obs = batch[Columns.OBS]                    # [B, T, obs]
-        actions = batch[Columns.ACTIONS]            # [B, T]
-        behavior_logp = batch[Columns.ACTION_LOGP]  # [B, T]
-        rewards = batch[Columns.REWARDS]            # [B, T]
-        dones = batch["dones"]                      # [B, T] 1.0 at termination
-        mask = batch["mask"]                        # [B, T] 1.0 on real steps
-        bootstrap = batch["bootstrap_value"]        # [B]
-        last_idx = batch["last_idx"].astype(jnp.int32)  # [B] last REAL step
-
-        B, T = actions.shape
-        flat = {Columns.OBS: obs.reshape(B * T, -1)}
-        out = module.forward_train(params, flat)
-        dist_in = out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
-        values = out[Columns.VF_PREDS].reshape(B, T)
-        target_logp = module.dist_logp(dist_in, actions)
-        entropy = module.dist_entropy(dist_in)
-
-        # --- V-trace targets (stop-gradient region) -----------------------
         sg = jax.lax.stop_gradient
-        log_rho = sg(target_logp) - behavior_logp
-        rho = jnp.minimum(jnp.exp(log_rho), rho_clip)
-        c = jnp.minimum(jnp.exp(log_rho), c_clip)
-        v = sg(values)
-        discounts = gamma * (1.0 - dones)
-        # The bootstrap value is the successor of each sequence's LAST REAL step
-        # (sequences shorter than T are zero-padded; placing the bootstrap at
-        # index T-1 would hand real steps the value of padded observations).
-        B_idx = jnp.arange(v.shape[0])
-        v_next = jnp.concatenate([v[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1)
-        v_next = v_next.at[B_idx, last_idx].set(bootstrap)
-        # Masked deltas: padded steps contribute nothing, and nothing from the pad
-        # region chains backward into real steps through the recursion.
-        deltas = rho * (rewards + discounts * v_next - v) * mask
-
-        def back(carry, xs):
-            delta_t, disc_t, c_t = xs
-            acc = delta_t + disc_t * c_t * carry
-            return acc, acc
-
-        # scan over time reversed; operate time-major [T, B]
-        _, acc = jax.lax.scan(
-            back,
-            jnp.zeros_like(bootstrap),
-            (deltas.T, discounts.T, c.T),
-            reverse=True,
+        target_logp, entropy, values, vs, pg_adv, rho, mask, norm = (
+            _vtrace_forward(module, params, batch, rho_clip, c_clip, gamma)
         )
-        vs = v + acc.T                                  # [B, T]
-        vs_next = jnp.concatenate(
-            [vs[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
-        )
-        vs_next = vs_next.at[B_idx, last_idx].set(bootstrap)
-        pg_adv = sg(rho * (rewards + discounts * vs_next - v))
-
-        # --- losses over the valid-step mask ------------------------------
-        norm = jnp.maximum(1.0, jnp.sum(mask))
         policy_loss = -jnp.sum(target_logp * pg_adv * mask) / norm
         vf_loss = 0.5 * jnp.sum(((values - sg(vs)) ** 2) * mask) / norm
         ent = jnp.sum(entropy * mask) / norm
@@ -124,7 +140,8 @@ class IMPALA(Algorithm):
                     "indexes [B, T] action sequences"
                 )
         finally:
-            probe.close()
+            if hasattr(probe, "close"):
+                probe.close()
         super().__init__(config)
 
     def loss_fn(self):
@@ -204,7 +221,10 @@ class IMPALA(Algorithm):
         if fragments:
             batch = self.postprocess(fragments)
             self._total_timesteps += int(batch["mask"].sum())
-            learner_metrics = self.learner_group.update(batch)
+            # IMPALA takes one pass (num_epochs=1 default); APPO's clipped
+            # objective safely reuses the batch for num_epochs > 1.
+            for _ in range(max(1, getattr(c, "num_epochs", 1))):
+                learner_metrics = self.learner_group.update(batch)
         self._record_returns(returns)
         return {
             "training_iteration": self.iteration,

@@ -1,0 +1,167 @@
+"""MultiAgentPPO: per-policy PPO training over a multi-agent env.
+
+Design parity: reference multi-agent stack — `rllib/env/multi_agent_env_runner.py`
+episodes routed through `policy_mapping_fn`, per-policy (`module_id`) losses in the
+learner, shared or per-agent policies. Configured through
+`PPOConfig().multi_agent(policies=..., policy_mapping_fn=...)` and built by
+`AlgorithmConfig.build_algo()`.
+
+Each policy gets its own RLModule + LearnerGroup (TPU-resourceable); sampling
+runs on CPU multi-agent env-runner actors that batch per-policy inference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.ppo import _ppo_loss_factory, ppo_postprocess
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import Columns, build_default_module  # noqa: E501
+from ray_tpu.rllib.env.multi_agent_env_runner import (
+    MultiAgentEnvRunnerGroup,
+    agent_spaces,
+)
+
+
+class MultiAgentPPO:
+    def __init__(self, config):
+        import cloudpickle
+
+        self.config = config
+        self.iteration = 0
+        self._total_timesteps = 0
+        self._ret_history: List[float] = []
+        if not config.policies:
+            raise ValueError("MultiAgentPPO needs config.multi_agent(policies=...)")
+        mapping = config.policy_mapping_fn or (lambda aid: aid)
+        self._mapping = mapping
+
+        env_fn = config.env_creator()
+        probe = env_fn()
+        try:
+            # A representative agent per policy supplies the module's spaces.
+            agents = list(getattr(probe, "possible_agents", []) or [])
+            rep: Dict[str, Any] = {}
+            for aid in agents:
+                pid = mapping(aid)
+                if pid not in config.policies:
+                    raise ValueError(
+                        f"policy_mapping_fn maps agent {aid!r} to {pid!r}, "
+                        f"which is not in policies {sorted(config.policies)}"
+                    )
+                rep.setdefault(pid, aid)
+            self._modules: Dict[str, Any] = {}
+            for pid, module in config.policies.items():
+                if module is not None:
+                    self._modules[pid] = module
+                    continue
+                obs_sp, act_sp = agent_spaces(probe, rep.get(pid))
+                self._modules[pid] = build_default_module(
+                    obs_sp, act_sp,
+                    hiddens=tuple(config.model.get("hiddens", (64, 64))),
+                )
+        finally:
+            if hasattr(probe, "close"):
+                probe.close()
+
+        loss = _ppo_loss_factory(
+            config.clip_param, config.vf_clip_param, config.vf_loss_coeff,
+            config.entropy_coeff,
+        )
+        self.learner_groups: Dict[str, LearnerGroup] = {
+            pid: LearnerGroup(
+                cloudpickle.dumps(module), cloudpickle.dumps(loss),
+                num_learners=config.num_learners, lr=config.lr,
+                grad_clip=config.grad_clip, seed=(config.seed or 0) + i,
+                learner_resources=config.learner_resources,
+                use_mesh=config.use_mesh,
+            )
+            for i, (pid, module) in enumerate(self._modules.items())
+        }
+        self.env_runner_group = MultiAgentEnvRunnerGroup(
+            cloudpickle.dumps(env_fn), cloudpickle.dumps(self._modules),
+            cloudpickle.dumps(mapping),
+            num_env_runners=config.num_env_runners, seed=config.seed,
+        )
+
+    # ------------------------------------------------------------------ train
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        self.iteration += 1
+        c = self.config
+        self.env_runner_group.sync_weights(
+            {pid: lg.get_params() for pid, lg in self.learner_groups.items()}
+        )
+        per_runner = max(1, c.train_batch_size // max(1, len(self.env_runner_group)))
+        runner_batches = self.env_runner_group.sample(per_runner)
+        frags_by_policy: Dict[str, List[dict]] = {pid: [] for pid in self._modules}
+        returns, lens = [], []
+        for b in runner_batches:
+            for pid, frs in b["fragments"].items():
+                frags_by_policy.setdefault(pid, []).extend(frs)
+            returns.extend(b.get("episode_returns", []))
+            lens.extend(b.get("episode_lens", []))
+        metrics: Dict[str, Any] = {}
+        rng = np.random.default_rng(self.iteration)
+        for pid, frags in frags_by_policy.items():
+            if not frags:
+                continue
+            batch = ppo_postprocess(frags, c.gamma, c.lambda_)
+            n = len(batch[Columns.OBS])
+            self._total_timesteps += n
+            mb = min(c.minibatch_size, n)
+            lg = self.learner_groups[pid]
+            pol_metrics: Dict[str, float] = {}
+            for _epoch in range(c.num_epochs):
+                perm = rng.permutation(n)
+                # Fixed-size minibatches only: a ragged tail would recompile
+                # the jitted loss for every new remainder shape.
+                for start in range(0, n - mb + 1, mb):
+                    idx = perm[start:start + mb]
+                    pol_metrics = lg.update({k: v[idx] for k, v in batch.items()})
+            metrics.update({f"{pid}/{k}": v for k, v in pol_metrics.items()})
+        if returns:
+            self._ret_history.extend([float(r) for r in returns])
+            self._ret_history = self._ret_history[-100:]
+        mean_ret = (
+            float(np.mean(self._ret_history)) if self._ret_history else float("nan")
+        )
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_timesteps,
+            "episode_return_mean": mean_ret,
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+            "episodes_this_iter": len(returns),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    def get_params(self) -> Dict[str, Any]:
+        return {pid: lg.get_params() for pid, lg in self.learner_groups.items()}
+
+    def stop(self):
+        self.env_runner_group.stop()
+
+    # Checkpointable-mixin parity (save/restore per-policy params).
+    def save_to_path(self, path: str):
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "params.pkl"), "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "params": self.get_params()}, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        import os
+        import pickle
+
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        for pid, params in state["params"].items():
+            self.learner_groups[pid].set_params(params)

@@ -77,6 +77,32 @@ def _ppo_loss_factory(clip_param, vf_clip_param, vf_loss_coeff, entropy_coeff):
     return ppo_loss
 
 
+def ppo_postprocess(fragments: List[dict], gamma: float, lambda_: float
+                    ) -> Dict[str, np.ndarray]:
+    """GAE over fragments -> one flat standardized training batch (shared by
+    PPO and MultiAgentPPO)."""
+    cols: Dict[str, list] = {
+        Columns.OBS: [], Columns.ACTIONS: [], Columns.ACTION_LOGP: [],
+        Columns.ADVANTAGES: [], Columns.VALUE_TARGETS: [],
+    }
+    for frag in fragments:
+        adv, targets = compute_gae(
+            frag[Columns.REWARDS], frag[Columns.VF_PREDS],
+            float(frag["bootstrap_value"]), gamma, lambda_,
+        )
+        cols[Columns.OBS].append(frag[Columns.OBS])
+        cols[Columns.ACTIONS].append(frag[Columns.ACTIONS])
+        cols[Columns.ACTION_LOGP].append(frag[Columns.ACTION_LOGP])
+        cols[Columns.ADVANTAGES].append(adv)
+        cols[Columns.VALUE_TARGETS].append(targets)
+    batch = {k: np.concatenate(v).astype(np.float32) if k != Columns.ACTIONS
+             else np.concatenate(v) for k, v in cols.items()}
+    # Advantage standardization (reference default).
+    adv = batch[Columns.ADVANTAGES]
+    batch[Columns.ADVANTAGES] = (adv - adv.mean()) / max(1e-6, adv.std())
+    return batch
+
+
 class PPO(Algorithm):
     def loss_fn(self):
         c = self.config
@@ -85,24 +111,4 @@ class PPO(Algorithm):
         )
 
     def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
-        c = self.config
-        cols: Dict[str, list] = {
-            Columns.OBS: [], Columns.ACTIONS: [], Columns.ACTION_LOGP: [],
-            Columns.ADVANTAGES: [], Columns.VALUE_TARGETS: [],
-        }
-        for frag in fragments:
-            adv, targets = compute_gae(
-                frag[Columns.REWARDS], frag[Columns.VF_PREDS],
-                float(frag["bootstrap_value"]), c.gamma, c.lambda_,
-            )
-            cols[Columns.OBS].append(frag[Columns.OBS])
-            cols[Columns.ACTIONS].append(frag[Columns.ACTIONS])
-            cols[Columns.ACTION_LOGP].append(frag[Columns.ACTION_LOGP])
-            cols[Columns.ADVANTAGES].append(adv)
-            cols[Columns.VALUE_TARGETS].append(targets)
-        batch = {k: np.concatenate(v).astype(np.float32) if k != Columns.ACTIONS
-                 else np.concatenate(v) for k, v in cols.items()}
-        # Advantage standardization (reference default).
-        adv = batch[Columns.ADVANTAGES]
-        batch[Columns.ADVANTAGES] = (adv - adv.mean()) / max(1e-6, adv.std())
-        return batch
+        return ppo_postprocess(fragments, self.config.gamma, self.config.lambda_)

@@ -1,0 +1,243 @@
+"""MultiAgentEnvRunner: sample a multi-agent env with per-policy modules.
+
+Design parity: reference `rllib/env/multi_agent_env_runner.py` + multi-agent
+episodes — one env per runner; each step batches the present agents' observations
+per policy, samples actions from that policy's module, and records per-agent
+trajectories that postprocess into per-policy training batches.
+
+Env protocol (duck-typed MultiAgentEnv, reference rllib/env/multi_agent_env.py):
+    reset(seed=..., options=...) -> (obs_dict, info_dict)
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos) dicts
+        keyed by agent id; terminateds/truncateds may carry "__all__".
+    observation_space(s)/action_space(s): per-agent dicts, or shared single
+        spaces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+def agent_spaces(env, agent_id):
+    """Per-agent (obs_space, act_space), falling back to shared spaces."""
+    obs_sp = getattr(env, "observation_spaces", None)
+    act_sp = getattr(env, "action_spaces", None)
+    if isinstance(obs_sp, dict) and agent_id in obs_sp:
+        return obs_sp[agent_id], act_sp[agent_id]
+    return env.observation_space, env.action_space
+
+
+class MultiAgentEnvRunner:
+    def __init__(self, env_spec: bytes, module_blobs: bytes, mapping_blob: bytes,
+                 seed: Optional[int] = None, worker_index: int = 0):
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"  # samplers stay off the chips
+        import cloudpickle
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        self._env = cloudpickle.loads(env_spec)()
+        self._modules: Dict[str, Any] = cloudpickle.loads(module_blobs)
+        self._mapping = cloudpickle.loads(mapping_blob) or (lambda aid: aid)
+        self._params: Dict[str, Any] = {}
+        self._rng = jax.random.PRNGKey(
+            (seed if seed is not None else 0) * 10007 + worker_index
+        )
+        self._jit_steps: Dict[str, Any] = {}
+        self._obs, _ = self._env.reset(
+            seed=None if seed is None else seed + worker_index
+        )
+        self._episodes: Dict[str, Dict[str, list]] = {}
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._ep_returns: List[float] = []
+        self._ep_lens: List[float] = []
+
+    @staticmethod
+    def _new_ep() -> Dict[str, list]:
+        return {Columns.OBS: [], Columns.ACTIONS: [], Columns.REWARDS: [],
+                Columns.ACTION_LOGP: [], Columns.VF_PREDS: []}
+
+    def set_weights(self, params_by_policy: Dict[str, Any]):
+        self._params = dict(params_by_policy)
+
+    def _policy_step(self, pid: str, obs_batch, rng):
+        import jax
+
+        if pid not in self._jit_steps:
+            if pid not in self._modules:
+                raise KeyError(
+                    f"policy_mapping_fn returned {pid!r}, which is not in "
+                    f"config.policies {sorted(self._modules)}"
+                )
+            module = self._modules[pid]
+
+            def step(params, obs, rng):
+                out = module.forward_exploration(params, {Columns.OBS: obs})
+                dist_in = out[Columns.ACTION_DIST_INPUTS]
+                action = module.dist_sample(dist_in, rng)
+                logp = module.dist_logp(dist_in, action)
+                return action, logp, out[Columns.VF_PREDS]
+
+            self._jit_steps[pid] = jax.jit(step)
+        return self._jit_steps[pid](self._params[pid], obs_batch, rng)
+
+    def sample(self, num_timesteps: int) -> Dict[str, Any]:
+        """Roll ~num_timesteps env steps; fragments grouped per policy."""
+        import jax
+
+        assert self._params, "set_weights() before sample()"
+        frags: Dict[str, List[dict]] = {pid: [] for pid in self._modules}
+        for _ in range(num_timesteps):
+            agents = list(self._obs.keys())
+            if not agents:
+                self._reset_episode(frags, terminateds={}, truncateds={})
+                continue
+            # Batch present agents per policy for one forward pass each.
+            actions: Dict[str, Any] = {}
+            logps: Dict[str, float] = {}
+            vfs: Dict[str, float] = {}
+            by_policy: Dict[str, list] = {}
+            for aid in agents:
+                by_policy.setdefault(self._mapping(aid), []).append(aid)
+            for pid, aids in by_policy.items():
+                obs_batch = np.stack(
+                    [np.asarray(self._obs[a], np.float32) for a in aids]
+                )
+                self._rng, sub = jax.random.split(self._rng)
+                act, logp, vf = self._policy_step(pid, obs_batch, sub)
+                act, logp, vf = np.asarray(act), np.asarray(logp), np.asarray(vf)
+                for j, a in enumerate(aids):
+                    actions[a] = act[j]
+                    logps[a] = float(logp[j])
+                    vfs[a] = float(vf[j])
+            next_obs, rewards, terms, truncs, _infos = self._env.step(actions)
+            for aid in agents:
+                ep = self._episodes.setdefault(aid, self._new_ep())
+                ep[Columns.OBS].append(np.asarray(self._obs[aid], np.float32))
+                ep[Columns.ACTIONS].append(actions[aid])
+                ep[Columns.REWARDS].append(float(rewards.get(aid, 0.0)))
+                ep[Columns.ACTION_LOGP].append(logps[aid])
+                ep[Columns.VF_PREDS].append(vfs[aid])
+                self._ep_return += float(rewards.get(aid, 0.0))
+            self._ep_len += 1
+            done_all = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            # Individually finished agents flush their fragment now.
+            for aid in agents:
+                if terms.get(aid) and not done_all:
+                    self._finish_agent(frags, aid, terminated=True, next_obs=None)
+            if done_all:
+                self._reset_episode(frags, terms, truncs, next_obs)
+            else:
+                self._obs = {a: o for a, o in next_obs.items()}
+        # Flush in-progress trajectories (bootstrap off the agent's last value).
+        for aid in list(self._episodes.keys()):
+            self._finish_agent(frags, aid, terminated=False,
+                               next_obs=self._obs.get(aid))
+        out = {
+            "fragments": frags,
+            "episode_returns": np.asarray(self._ep_returns, np.float32),
+            "episode_lens": np.asarray(self._ep_lens, np.float32),
+        }
+        # Per-sample stats: without this reset every later sample() re-reports
+        # all episodes since actor start.
+        self._ep_returns, self._ep_lens = [], []
+        return out
+
+    def _finish_agent(self, frags, aid, terminated: bool, next_obs):
+        import jax
+
+        ep = self._episodes.pop(aid, None)
+        if ep is None or not ep[Columns.OBS]:
+            return
+        pid = self._mapping(aid)
+        if terminated or next_obs is None:
+            bootstrap = 0.0
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            _a, _lp, vf = self._policy_step(
+                pid, np.asarray(next_obs, np.float32)[None], sub
+            )
+            bootstrap = float(np.asarray(vf)[0])
+        frags[pid].append({
+            Columns.OBS: np.asarray(ep[Columns.OBS], np.float32),
+            Columns.ACTIONS: np.asarray(ep[Columns.ACTIONS]),
+            Columns.REWARDS: np.asarray(ep[Columns.REWARDS], np.float32),
+            Columns.ACTION_LOGP: np.asarray(ep[Columns.ACTION_LOGP], np.float32),
+            Columns.VF_PREDS: np.asarray(ep[Columns.VF_PREDS], np.float32),
+            "bootstrap_value": np.float32(bootstrap),
+            "terminated": terminated,
+            "agent_id": aid,
+        })
+
+    def _reset_episode(self, frags, terminateds, truncateds, next_obs=None):
+        for aid in list(self._episodes.keys()):
+            term = bool(terminateds.get(aid, terminateds.get("__all__")))
+            self._finish_agent(
+                frags, aid, terminated=term,
+                next_obs=None if term or next_obs is None else next_obs.get(aid),
+            )
+        self._ep_returns.append(self._ep_return)
+        self._ep_lens.append(float(self._ep_len))
+        self._ep_return, self._ep_len = 0.0, 0
+        self._obs, _ = self._env.reset()
+
+
+class MultiAgentEnvRunnerGroup:
+    """Fan-out sampling over multi-agent runner actors (reference:
+    env_runner_group.py with MultiAgentEnvRunner workers)."""
+
+    def __init__(self, env_spec: bytes, module_blobs: bytes, mapping_blob: bytes,
+                 *, num_env_runners: int, seed: Optional[int] = None,
+                 runner_cpus: float = 1):
+        import ray_tpu
+
+        self._args = (env_spec, module_blobs, mapping_blob, seed)
+        self._cls = ray_tpu.remote(num_cpus=runner_cpus)(MultiAgentEnvRunner)
+        self._runners = [
+            self._cls.remote(env_spec, module_blobs, mapping_blob, seed, i)
+            for i in range(max(1, num_env_runners))
+        ]
+
+    def __len__(self):
+        return len(self._runners)
+
+    def sync_weights(self, params_by_policy: Dict[str, Any]):
+        import ray_tpu
+
+        ref = ray_tpu.put(params_by_policy)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self._runners])
+
+    def sample(self, timesteps_per_runner: int) -> List[Dict[str, Any]]:
+        import ray_tpu
+
+        refs = [r.sample.remote(timesteps_per_runner) for r in self._runners]
+        out = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=300))
+            except Exception:
+                try:
+                    ray_tpu.kill(self._runners[i])
+                except Exception:
+                    pass
+                env_spec, module_blobs, mapping_blob, seed = self._args
+                self._runners[i] = self._cls.remote(
+                    env_spec, module_blobs, mapping_blob, seed, i
+                )
+        return out
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

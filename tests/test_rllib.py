@@ -469,3 +469,115 @@ def test_impala_vtrace_truncated_tail_uses_bootstrap():
     vs0 = 1 + gamma * vs1
     expected_mean = (vs0 + vs1 + vs2) / 3.0
     np.testing.assert_allclose(float(metrics["vtrace_mean"]), expected_mean, rtol=1e-5)
+
+
+class _TwoPolicyBandit:
+    """Multi-agent bandit: two agents with OPPOSITE reward structures, so the
+    test fails unless each policy actually learns its own mapping (shared
+    weights would cap joint reward at one agent's optimum)."""
+
+    possible_agents = ["good", "evil"]
+
+    def __init__(self):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self.observation_spaces = {a: self.observation_space
+                                   for a in self.possible_agents}
+        self.action_spaces = {a: self.action_space for a in self.possible_agents}
+        self._t = 0
+
+    def reset(self, seed=None, options=None):
+        self._t = 0
+        obs = {a: np.zeros(2, np.float32) for a in self.possible_agents}
+        return obs, {}
+
+    def step(self, actions):
+        self._t += 1
+        rewards = {
+            "good": 1.0 if actions.get("good") == 1 else 0.0,
+            "evil": 1.0 if actions.get("evil") == 0 else 0.0,
+        }
+        done = self._t >= 8
+        obs = {a: np.zeros(2, np.float32) for a in self.possible_agents}
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def test_multi_agent_ppo_two_policies_learn():
+    """Two-policy multi-agent env trains with per-policy losses (VERDICT #8;
+    reference: rllib/env/multi_agent_env_runner.py + policy_mapping_fn)."""
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _TwoPolicyBandit())
+        .env_runners(num_env_runners=1)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=6, lr=0.02,
+                  entropy_coeff=0.0)
+        .multi_agent(policies=["good", "evil"],
+                     policy_mapping_fn=lambda aid: aid)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    from ray_tpu.rllib import MultiAgentPPO
+
+    assert isinstance(algo, MultiAgentPPO)
+    try:
+        last = None
+        for _ in range(8):
+            last = algo.train()
+        # Per-policy learner metrics reported under "<policy>/<metric>".
+        assert np.isfinite(last["good/total_loss"])
+        assert np.isfinite(last["evil/total_loss"])
+        # Joint return approaches 16 (8 steps x 2 agents x reward 1) only if
+        # BOTH policies learned their (opposite) optimal actions.
+        assert last["episode_return_mean"] > 12.0, last["episode_return_mean"]
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_shared_policy():
+    """Many agents can share one policy via the mapping fn."""
+    config = (
+        PPOConfig()
+        .environment(lambda cfg: _TwoPolicyBandit())
+        .env_runners(num_env_runners=1)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=2, lr=0.01)
+        .multi_agent(policies=["shared"], policy_mapping_fn=lambda aid: "shared")
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        assert result["episodes_this_iter"] >= 1
+        assert np.isfinite(result["shared/total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_appo_learns_bandit_and_beats_impala_roundtrip():
+    """APPO trains on the same env/machinery as IMPALA with the PPO clip
+    objective (VERDICT #8; reference rllib/algorithms/appo/appo.py)."""
+    from ray_tpu.rllib import APPOConfig
+
+    config = (
+        APPOConfig()
+        .environment(lambda cfg: _BanditEnv())
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .training(train_batch_size=256, lr=0.02, entropy_coeff=0.003,
+                  rollout_fragment_length=8)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(10):
+            last = algo.train()
+        assert np.isfinite(last["learner/policy_loss"])
+        assert "learner/mean_ratio" in last
+        assert last["episode_return_mean"] > max(0.75, first["episode_return_mean"])
+    finally:
+        algo.stop()
