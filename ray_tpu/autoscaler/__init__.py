@@ -89,6 +89,7 @@ class AutoscalingConfig:
     max_workers: int = 4
     worker_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1})
     idle_timeout_s: float = 30.0
+    boot_grace_s: float = 300.0  # address-less remote nodes count as in-flight this long
     poll_interval_s: float = 1.0
     upscaling_speed: int = 2  # max nodes added per reconcile round
 
@@ -117,6 +118,7 @@ class Autoscaler:
         self._provider = provider
         self._config = config or AutoscalingConfig()
         self._idle_since: Dict[str, float] = {}  # provider node id -> first idle t
+        self._created_at: Dict[str, float] = {}  # provider node id -> launch t
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_scale_ups = 0
@@ -162,15 +164,29 @@ class Autoscaler:
             registered = {
                 tuple(n["address"]) for n in gcs_nodes if n["alive"] and not n["is_head"]
             }
-            in_flight = sum(
-                1 for pid in provider_nodes
-                if self._provider.cluster_address(pid) not in registered
-            )
+            registered_ips = {a[0] for a in registered}
+            now_mono = time.monotonic()
+            in_flight = 0
+            for pid in provider_nodes:
+                addr = self._provider.cluster_address(pid)
+                if addr is None:
+                    # Address unknown (remote slice still booting): count it as
+                    # in-flight only within the boot grace — a node that never
+                    # registers must not suppress upscaling forever.
+                    created = self._created_at.get(pid)
+                    if created is not None and now_mono - created < cfg.boot_grace_s:
+                        in_flight += 1
+                elif addr[1] in (None, 0):
+                    if addr[0] not in registered_ips:
+                        in_flight += 1
+                elif tuple(addr) not in registered:
+                    in_flight += 1
             need = max(0, need - in_flight)
             room = cfg.max_workers - len(provider_nodes)
             to_add = max(0, min(need, room, cfg.upscaling_speed))
             for _ in range(to_add):
-                self._provider.create_node(dict(per_node))
+                pid = self._provider.create_node(dict(per_node))
+                self._created_at[pid] = time.monotonic()
                 self.num_scale_ups += 1
                 actions["added"] += 1
         # Downscale: provider nodes idle past the timeout. Idle = no running work
@@ -185,6 +201,7 @@ class Autoscaler:
             and not any(n.get("pending_demand", {}).values())
             and n["node_id"].hex() not in occupied
         }
+        idle_ips = {a[0] for a in idle_cluster_nodes}
         now = time.monotonic()
         provider_nodes = self._provider.non_terminated_nodes()
         removable = len(provider_nodes) - max(cfg.min_workers, 0)
@@ -192,7 +209,11 @@ class Autoscaler:
             if removable <= 0:
                 break
             addr = self._provider.cluster_address(node_id)
-            if addr is not None and tuple(addr) in idle_cluster_nodes:
+            idle = addr is not None and (
+                addr[0] in idle_ips if addr[1] in (None, 0)
+                else tuple(addr) in idle_cluster_nodes
+            )
+            if idle:
                 first = self._idle_since.setdefault(node_id, now)
                 if now - first >= cfg.idle_timeout_s:
                     self._provider.terminate_node(node_id)

@@ -1,0 +1,170 @@
+"""GCE TPU-VM node provider: provision whole TPU slices as cluster nodes.
+
+Design parity: reference `python/ray/autoscaler/_private/gcp/node_provider.py`
+(+ tpu.py accelerator discovery) — nodes are TPU VM slices created through the
+Cloud TPU REST API (tpu.googleapis.com v2); each slice boots a startup script
+that joins the cluster (`ray_tpu start --address=<head>`), advertising its
+chips and slice-head resource so gang scheduling works the moment it registers.
+
+The HTTP transport is injectable: production uses urllib against
+tpu.googleapis.com with a metadata-server access token; tests drive the
+provider against recorded responses (this environment has zero egress, the
+same way the reference's provider unit tests mock the discovery client).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler import NodeProvider
+
+_TPU_API = "https://tpu.googleapis.com/v2"
+
+
+def _metadata_token() -> str:
+    """Access token from the GCE metadata server (TPU VMs and GCE heads)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())["access_token"]
+
+
+def _default_transport(method: str, url: str, body: Optional[dict]) -> dict:
+    import urllib.request
+
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={
+            "Authorization": f"Bearer {_metadata_token()}",
+            "Content-Type": "application/json",
+        },
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+class GCETPUNodeProvider(NodeProvider):
+    """Each provider node is one whole TPU slice (possibly multi-host).
+
+    Config:
+        project, zone: GCE placement.
+        accelerator_type: e.g. "v5litepod-16" — every created node is one slice
+            of this topology.
+        runtime_version: TPU VM image, e.g. "tpu-ubuntu2204-base".
+        head_address: "host:port" the slice's hosts join on boot.
+        cluster_name: label + name prefix for created slices.
+        transport: fn(method, url, body) -> dict, injectable for tests.
+    """
+
+    def __init__(self, project: str, zone: str, accelerator_type: str,
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 head_address: str = "", cluster_name: str = "ray-tpu",
+                 transport: Optional[Callable] = None):
+        self._project = project
+        self._zone = zone
+        self._accel = accelerator_type
+        self._runtime = runtime_version
+        self._head = head_address
+        self._cluster = cluster_name
+        self._transport = transport or _default_transport
+        self._parent = f"projects/{project}/locations/{zone}"
+
+    # -- SPI ----------------------------------------------------------------
+    def create_node(self, resources: Dict[str, float]) -> str:
+        node_id = f"{self._cluster}-{uuid.uuid4().hex[:8]}"
+        startup = (
+            "#! /bin/bash\n"
+            f"ray_tpu start --address={self._head} "
+            f"--resources='{json.dumps({k: v for k, v in resources.items() if k != 'CPU'})}'\n"
+        )
+        body = {
+            "acceleratorType": self._accel,
+            "runtimeVersion": self._runtime,
+            "labels": {"ray-tpu-cluster": self._cluster},
+            "metadata": {"startup-script": startup},
+        }
+        self._transport(
+            "POST", f"{_TPU_API}/{self._parent}/nodes?nodeId={node_id}", body
+        )
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._transport(
+            "DELETE", f"{_TPU_API}/{self._parent}/nodes/{node_id}", None
+        )
+
+    def non_terminated_nodes(self) -> List[str]:
+        resp = self._transport("GET", f"{_TPU_API}/{self._parent}/nodes", None)
+        out = []
+        for node in resp.get("nodes", []):
+            labels = node.get("labels") or {}
+            if labels.get("ray-tpu-cluster") != self._cluster:
+                continue
+            if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            out.append(node["name"].rsplit("/", 1)[-1])
+        return out
+
+    def cluster_address(self, node_id: str) -> Optional[tuple]:
+        """First worker's internal IP: the raylet of slice host 0. The raylet
+        port is unknown to the provider — (ip, None) tells the reconciler to
+        match cluster nodes by IP alone."""
+        try:
+            node = self._transport(
+                "GET", f"{_TPU_API}/{self._parent}/nodes/{node_id}", None
+            )
+        except Exception:
+            return None
+        endpoints = node.get("networkEndpoints") or []
+        if not endpoints:
+            return None
+        return (endpoints[0].get("ipAddress"), None)
+
+
+class RecordedTransport:
+    """Test double: replays canned responses and records every request —
+    the 'dryrun against recorded GCE responses' harness."""
+
+    def __init__(self, responses: Optional[Dict[str, Any]] = None):
+        self.requests: List[tuple] = []
+        self._responses = responses or {}
+        self._nodes: Dict[str, dict] = {}  # emulated live state
+
+    def __call__(self, method: str, url: str, body: Optional[dict]) -> dict:
+        self.requests.append((method, url, body))
+        key = f"{method} {url}"
+        if key in self._responses:
+            return self._responses[key]
+        # Default emulation: stateful create/list/get/delete.
+        if method == "POST" and "nodes?nodeId=" in url:
+            node_id = url.rsplit("nodeId=", 1)[-1]
+            self._nodes[node_id] = {
+                "name": f"nodes/{node_id}",
+                "state": "READY",
+                "labels": (body or {}).get("labels", {}),
+                "acceleratorType": (body or {}).get("acceleratorType"),
+                "networkEndpoints": [{"ipAddress": f"10.0.0.{len(self._nodes) + 2}"}],
+                "metadata": (body or {}).get("metadata", {}),
+            }
+            return {"name": f"operations/create-{node_id}", "done": True}
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": list(self._nodes.values())}
+        if method == "GET":
+            node_id = url.rsplit("/", 1)[-1]
+            if node_id in self._nodes:
+                return self._nodes[node_id]
+            raise KeyError(f"no such node {node_id}")
+        if method == "DELETE":
+            node_id = url.rsplit("/", 1)[-1]
+            self._nodes.pop(node_id, None)
+            return {"name": f"operations/delete-{node_id}", "done": True}
+        raise ValueError(f"unhandled request {method} {url}")

@@ -89,6 +89,164 @@ def cmd_start(args):
                     pass
 
 
+def _load_cluster_yaml(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    cfg.setdefault("cluster_name", "ray-tpu")
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("workers", {})
+    return cfg
+
+
+class _LocalWorkerProvider:
+    """`ray_tpu up` local provider: worker nodes as raylet processes joined to
+    the head this command just started (NodeProvider SPI)."""
+
+    def __init__(self, gcs_addr: tuple):
+        self._gcs_addr = gcs_addr
+        self._nodes = {}
+        self._counter = 0
+
+    def create_node(self, resources):
+        from ray_tpu._private import node as node_mod
+
+        handle = node_mod.start_node(
+            head=False, gcs_addr=self._gcs_addr,
+            resources={k: float(v) for k, v in resources.items()}, labels=None,
+            session_dir=node_mod.make_session_dir(), object_store_bytes=0,
+            worker_env=None,
+        )
+        self._counter += 1
+        name = f"local-{self._counter}"
+        self._nodes[name] = handle
+        return name
+
+    def terminate_node(self, node_id):
+        handle = self._nodes.pop(node_id, None)
+        if handle is not None:
+            handle.terminate()
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def cluster_address(self, node_id):
+        handle = self._nodes.get(node_id)
+        return None if handle is None else ("127.0.0.1", handle.raylet_port)
+
+
+def _head_ip() -> str:
+    """The head's network-reachable address for worker startup scripts —
+    loopback would make remote slices join themselves."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))  # no traffic sent; picks the egress iface
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _build_provider(cfg: dict, head_address: str, gcs_addr: tuple | None = None):
+    provider_cfg = dict(cfg["provider"])
+    ptype = provider_cfg.pop("type", "local")
+    if ptype in ("gcp", "gcp_tpu", "tpu"):
+        from ray_tpu.autoscaler.gcp import GCETPUNodeProvider
+
+        return GCETPUNodeProvider(
+            head_address=head_address, cluster_name=cfg["cluster_name"],
+            **provider_cfg,
+        )
+    if ptype == "local":
+        if gcs_addr is None:
+            addr = read_addr()
+            if addr is None:
+                raise RuntimeError("no running head found for the local provider")
+            gcs_addr = ("127.0.0.1", addr["gcs_port"])
+        return _LocalWorkerProvider(gcs_addr)
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def cmd_up(args):
+    """Launch a cluster from a YAML config: start the head HERE and run the
+    autoscaler against the configured provider (reference: `ray up` +
+    commands.py; the SSH-to-remote-head provisioning step is collapsed — run
+    this on the head host, e.g. the first TPU VM)."""
+    from ray_tpu._private import node as node_mod
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig
+
+    cfg = _load_cluster_yaml(args.config)
+    head_cfg = cfg["head"]
+    session_dir = node_mod.make_session_dir()
+    resources = {"CPU": float(head_cfg.get("num_cpus", os.cpu_count() or 1))}
+    resources.update(head_cfg.get("resources") or {})
+    handle = node_mod.start_node(
+        head=True, gcs_addr=None, resources=resources, labels=None,
+        session_dir=session_dir, object_store_bytes=0, worker_env=None,
+    )
+    _write_addr(handle.gcs_port, handle.raylet_port)
+    local_address = f"127.0.0.1:{handle.gcs_port}"
+    # Remote workers (TPU slices) must dial a reachable address, not loopback.
+    public_address = (
+        head_cfg.get("address") or f"{_head_ip()}:{handle.gcs_port}"
+    )
+    print(f"head started: gcs={local_address} (workers join {public_address})")
+
+    import ray_tpu
+
+    ray_tpu.init(address=local_address)
+    workers = cfg["workers"]
+    provider = _build_provider(
+        cfg, public_address, gcs_addr=("127.0.0.1", handle.gcs_port)
+    )
+    autoscaler = Autoscaler(provider, AutoscalingConfig(
+        min_workers=int(workers.get("min_workers", 0)),
+        max_workers=int(workers.get("max_workers", 4)),
+        worker_resources=workers.get("resources") or {"CPU": 1},
+        idle_timeout_s=float(workers.get("idle_timeout_s", 60.0)),
+    ))
+    autoscaler.start()
+    print(f"autoscaler running: {workers.get('min_workers', 0)}-"
+          f"{workers.get('max_workers', 4)} workers of "
+          f"{workers.get('resources') or {'CPU': 1}}")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        autoscaler.stop()
+        for nid in provider.non_terminated_nodes():
+            try:
+                provider.terminate_node(nid)
+            except Exception:
+                pass
+        handle.terminate()
+        try:
+            os.remove(_ADDR_FILE)
+        except OSError:
+            pass
+
+
+def cmd_down(args):
+    """Terminate every provider node of the YAML cluster, then stop the head."""
+    cfg = _load_cluster_yaml(args.config)
+    provider = _build_provider(cfg, head_address="")
+    for nid in provider.non_terminated_nodes():
+        print(f"terminating {nid}")
+        try:
+            provider.terminate_node(nid)
+        except Exception as e:  # noqa: BLE001
+            print(f"  failed: {e}", file=sys.stderr)
+    cmd_stop(args)
+
+
 def cmd_stop(_args):
     addr = read_addr()
     if addr is None:
@@ -251,6 +409,12 @@ def main(argv=None):
     p.add_argument("--block", action="store_true")
     p.set_defaults(fn=cmd_start)
 
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+    p = sub.add_parser("down", help="tear down a YAML-configured cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
     sub.add_parser("stop", help="stop the local head").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)
 
