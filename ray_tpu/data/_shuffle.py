@@ -234,6 +234,110 @@ def _stable_hash(v) -> int:
     return zlib.crc32(repr(v).encode())
 
 
+def _schema_of(blocks: List[Block]):
+    return blocks[0].schema if blocks else None
+
+
+_schema_remote = ray_tpu.remote(_schema_of)
+
+
+def _join_task(how: str, on: List[str], right_suffix: str, n_left: int,
+               lschema, rschema, *part_lists) -> tuple:
+    """Join one co-partition: concat left/right sides, pyarrow hash join.
+
+    Empty sides are reconstructed from the side's global schema so every
+    co-partition yields the SAME output schema (an empty pa.table({}) would
+    crash the join, and skipping the join would silently drop the non-empty
+    side for outer joins)."""
+    left_blocks: List[Block] = []
+    right_blocks: List[Block] = []
+    for j, parts in enumerate(part_lists):
+        (left_blocks if j < n_left else right_blocks).extend(parts)
+    lt = BlockAccessor.concat(left_blocks) if left_blocks else None
+    rt = BlockAccessor.concat(right_blocks) if right_blocks else None
+    if lt is None:
+        if lschema is None:
+            raise ValueError("join: left side has no blocks and no schema")
+        lt = lschema.empty_table()
+    if rt is None:
+        if rschema is None:
+            raise ValueError("join: right side has no blocks and no schema")
+        rt = rschema.empty_table()
+    join_type = {"inner": "inner", "left": "left outer",
+                 "right": "right outer", "outer": "full outer"}[how]
+    joined = lt.join(
+        rt, keys=on, join_type=join_type, right_suffix=right_suffix,
+    )
+    joined = joined.combine_chunks()
+    return [joined], (joined.num_rows, joined.nbytes)
+
+
+_join_remote = ray_tpu.remote(_join_task)
+
+
+def hash_join(left: List[RefBundle], right: List[RefBundle], on: List[str],
+              how: str = "inner", n_out: Optional[int] = None,
+              right_suffix: str = "_1") -> List[RefBundle]:
+    """Distributed hash join (reference: the hash-join physical operator,
+    python/ray/data/_internal/execution/operators/). Both sides are
+    hash-partitioned on the key columns with the same stable hash; each
+    co-partition joins remotely via pyarrow, so no full table ever lands on
+    the driver."""
+    if how not in ("inner", "left", "right", "outer"):
+        raise ValueError(f"unsupported join type {how!r}")
+    if not left and not right:
+        return []
+    if not left or not right:
+        # One side is entirely empty: inner joins are empty; outer joins
+        # cannot invent the absent side's columns, so they degrade to the
+        # present side only when its rows survive the join semantics.
+        if how == "inner" or (how == "left" and not left) or (
+            how == "right" and not right
+        ):
+            return []
+        return left if left else right
+    n_out = n_out or min(max(1, max(len(left), len(right))), 8)
+
+    def part_fn(block: Block) -> List[Block]:
+        if n_out == 1:
+            return [block]
+        acc = BlockAccessor.for_block(block)
+        cols = acc.to_numpy(list(on))
+        def key_of(i):
+            # .item() strips numpy scalar wrappers so both sides hash alike.
+            return tuple(
+                cols[k][i].item() if hasattr(cols[k][i], "item") else cols[k][i]
+                for k in on
+            )
+
+        hashes = np.array([
+            _stable_hash(key_of(i)) % n_out for i in range(block.num_rows)
+        ]) if block.num_rows else np.zeros(0, np.int64)
+        return [acc.take_rows(np.nonzero(hashes == i)[0]) for i in range(n_out)]
+
+    left_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in left]
+    right_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in right]
+    lschema = ray_tpu.get(_schema_remote.remote(left[0].block_ref))
+    rschema = ray_tpu.get(_schema_remote.remote(right[0].block_ref))
+    out: List[RefBundle] = []
+    join_out = []
+    for i in range(n_out):
+        selects = (
+            [_select_remote.remote(i, m) for m in left_maps]
+            + [_select_remote.remote(i, m) for m in right_maps]
+        )
+        join_out.append(
+            _join_remote.options(num_returns=2).remote(
+                how, list(on), right_suffix, len(left_maps), lschema, rschema,
+                *selects
+            )
+        )
+    for blocks_ref, meta_ref in join_out:
+        rows, nbytes = ray_tpu.get(meta_ref)
+        out.append(RefBundle(blocks_ref, rows, nbytes))
+    return out
+
+
 def hash_aggregate(
     bundles: List[RefBundle],
     key: Optional[str],

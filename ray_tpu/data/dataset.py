@@ -324,6 +324,27 @@ class Dataset:
 
         return self._with(AllToAllStage("Union", bulk))
 
+    def join(self, other: "Dataset", on, *, how: str = "inner",
+             num_partitions: Optional[int] = None,
+             right_suffix: str = "_1") -> "Dataset":
+        """Distributed hash join with `other` on key column(s) `on`.
+
+        Parity: reference `Dataset.join` (hash-join physical operator under
+        `python/ray/data/_internal/execution/operators/`). how: "inner",
+        "left", "right", or "outer". Both sides are hash-partitioned on the
+        keys; co-partitions join remotely (pyarrow), so neither table is ever
+        materialized on the driver.
+        """
+        keys = [on] if isinstance(on, str) else list(on)
+
+        def bulk(bundles, other=other):
+            return _shuffle.hash_join(
+                bundles, list(other._execute()), keys, how=how,
+                n_out=num_partitions, right_suffix=right_suffix,
+            )
+
+        return self._with(AllToAllStage("Join", bulk))
+
     def zip(self, other: "Dataset") -> "Dataset":
         def bulk(bundles, other=other):
             left = _collect_blocks(bundles)

@@ -298,6 +298,29 @@ def get_deployment_handle(deployment_name: str, app_name: str = DEFAULT_APP_NAME
     return DeploymentHandle(app_name, deployment_name)
 
 
+def get_grpc_port() -> Optional[int]:
+    """Port of the head node's gRPC ingress (None unless serve.start ran with
+    http_options={"grpc_port": N}). Parity: the reference's gRPC proxy."""
+    import ray_tpu
+    from ray_tpu.serve._common import SERVE_NAMESPACE
+
+    controller = _existing_controller()
+    if controller is None:
+        return None
+    try:
+        ports = ray_tpu.get(controller.proxy_ports.remote())
+        head_hex = next(
+            (n["node_id"].hex() for n in ray_tpu.nodes() if n.get("is_head")), None
+        )
+        if head_hex is None or head_hex not in ports:
+            return None
+        proxy = ray_tpu.get_actor(f"SERVE_PROXY:{head_hex[:12]}",
+                                  namespace=SERVE_NAMESPACE)
+        return ray_tpu.get(proxy.get_grpc_port.remote())
+    except Exception:
+        return None
+
+
 def get_proxy_port() -> Optional[int]:
     if _proxy_state.get("port") is not None:
         return _proxy_state["port"]
@@ -337,6 +360,7 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "get_grpc_port",
     "get_proxy_port",
     "ingress",
     "multiplexed",

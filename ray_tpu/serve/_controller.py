@@ -49,7 +49,11 @@ class ServeController:
         if http_options:
             prev = self._http_options
             self._http_options = {**(prev or {}), **http_options}
-            if prev is not None and prev.get("port") != self._http_options.get("port"):
+            changed = prev is not None and any(
+                prev.get(k) != self._http_options.get(k)
+                for k in ("port", "grpc_port")
+            )
+            if changed:
                 for _nid, (handle, _port) in list(self._proxies.items()):
                     self._kill(handle)
                 self._proxies.clear()
@@ -91,6 +95,7 @@ class ServeController:
                 continue
             port = self._http_options.get("port", 8000)
             host = self._http_options.get("host", "127.0.0.1")
+            grpc_port = self._http_options.get("grpc_port")
             proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
             try:
                 proxy = proxy_cls.options(
@@ -99,7 +104,7 @@ class ServeController:
                     scheduling_strategy=NodeAffinitySchedulingStrategy(
                         info["node_id"], soft=False
                     ),
-                ).remote(host, port)
+                ).remote(host, port, grpc_port)
                 bound = await async_get(proxy.start.remote(), timeout=30)
             except Exception:
                 continue  # node may have just died; next pass retries

@@ -1,0 +1,88 @@
+"""gRPC ingress for Serve (reference: python/ray/serve/_private/proxy.py gRPC
+proxy next to the HTTP one).
+
+A generic unary-unary service runs inside the proxy actor alongside HTTP: any
+method path `/<app>/<method>` routes to app `<app>`'s ingress deployment with a
+`Request` whose body is the raw request bytes, `path` is the gRPC method, and
+`headers` carries the invocation metadata. bytes replies pass through verbatim;
+anything else is JSON-encoded — so clients don't need this framework's protos
+(the reference similarly serves user-defined protos through a generic router).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ray_tpu.serve._common import Request
+
+
+class GrpcIngress:
+    """grpc.aio server bound inside the proxy actor's event loop."""
+
+    def __init__(self, proxy, host: str = "127.0.0.1", port: int = 9000):
+        self._proxy = proxy  # HTTPProxy: reuses its routing table + handles
+        self._host = host
+        self._port = port
+        self._server = None
+
+    async def start(self) -> int:
+        import grpc
+
+        proxy = self._proxy
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                method = call_details.method  # "/<app>/<rpc>"
+
+                async def unary(request_bytes: bytes, context):
+                    return await _dispatch_grpc(proxy, method, request_bytes,
+                                                dict(context.invocation_metadata()))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        try:
+            bound = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        except Exception:
+            bound = 0
+        if not bound:
+            # Same-host port collision (single-host test clusters): ephemeral.
+            bound = self._server.add_insecure_port(f"{self._host}:0")
+        self._port = bound
+        await self._server.start()
+        return bound
+
+    async def stop(self):
+        if self._server is not None:
+            await self._server.stop(grace=0.5)
+
+
+async def _dispatch_grpc(proxy, method: str, body: bytes, metadata: dict):
+    import asyncio
+
+    parts = [p for p in method.split("/") if p]
+    app = parts[0] if parts else None
+    if app not in proxy._handles:
+        # Fall back to route matching like HTTP ("/" prefix apps).
+        app = proxy._match_app("/" + "/".join(parts))
+    if app is None or app not in proxy._handles:
+        raise KeyError(f"no Serve application for gRPC method {method!r}")
+    request = Request(
+        method="GRPC", path=method, query_params={}, headers=metadata, body=body,
+    )
+    handle = proxy._handles[app]
+    loop = asyncio.get_running_loop()
+    result = await loop.run_in_executor(
+        None, lambda: handle.remote(request).result(timeout_s=60)
+    )
+    if isinstance(result, bytes):
+        return result
+    if isinstance(result, str):
+        return result.encode()
+    return json.dumps(result, default=str).encode()

@@ -18,9 +18,22 @@ from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE, Request
 class HTTPProxy:
     """Async actor: one per serve instance (head node)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 grpc_port: Optional[int] = None):
         self._host = host
         self._port = port
+        self._grpc_port = grpc_port
+        if grpc_port is not None:
+            # Fail fast in the actor's __init__ (a fatal, surfaced error):
+            # deferring to start() would read as a transient node failure and
+            # silently leave the user without their requested gRPC ingress.
+            try:
+                import grpc  # noqa: F401
+            except ImportError as e:
+                raise ImportError(
+                    "serve http_options['grpc_port'] requires grpcio"
+                ) from e
+        self._grpc = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._routes: Dict[str, str] = {}  # route_prefix -> app name
         self._streaming: Dict[str, bool] = {}  # app -> ingress is a generator
@@ -42,8 +55,17 @@ class HTTPProxy:
             # proxy.py:706); fall back to ephemeral only when taken.
             self._server = await asyncio.start_server(self._handle_conn, self._host, 0)
         self._port = self._server.sockets[0].getsockname()[1]
+        if self._grpc_port is not None:
+            # gRPC ingress beside HTTP (reference: gRPC proxy, proxy.py).
+            from ray_tpu.serve._grpc import GrpcIngress
+
+            self._grpc = GrpcIngress(self, self._host, self._grpc_port)
+            self._grpc_port = await self._grpc.start()
         asyncio.get_running_loop().create_task(self._route_refresh_loop())
         return self._port
+
+    async def get_grpc_port(self) -> Optional[int]:
+        return self._grpc_port if self._grpc is not None else None
 
     async def _route_refresh_loop(self):
         import ray_tpu

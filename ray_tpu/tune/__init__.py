@@ -31,7 +31,9 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Domain,
+    OptunaSearch,
     Searcher,
+    TPESearch,
     choice,
     grid_search,
     loguniform,
@@ -200,7 +202,9 @@ __all__ = [
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
+    "OptunaSearch",
     "Searcher",
+    "TPESearch",
     "TrialScheduler",
     "TuneConfig",
     "Tuner",

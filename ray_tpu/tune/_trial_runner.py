@@ -148,22 +148,27 @@ class TuneController:
             param_space, num_samples=tune_config.num_samples, seed=tune_config.seed
         )
         self.trials: List[Trial] = []
-        n = (
-            self._searcher.total_variants
-            if isinstance(self._searcher, BasicVariantGenerator)
-            else tune_config.num_samples
-        )
-        for i in range(n):
-            cfg = self._searcher.suggest(f"trial_{i:05d}")
-            if cfg is None:
-                break
-            self.trials.append(Trial(f"trial_{i:05d}", cfg, experiment_dir))
+        if isinstance(self._searcher, BasicVariantGenerator):
+            # Static searcher: the whole variant set exists up front.
+            n = self._searcher.total_variants
+            for i in range(n):
+                cfg = self._searcher.suggest(f"trial_{i:05d}")
+                if cfg is None:
+                    break
+                self.trials.append(Trial(f"trial_{i:05d}", cfg, experiment_dir))
+            self._target_samples = len(self.trials)
+        else:
+            # Adaptive searcher (TPE/optuna/...): trials are created LAZILY in
+            # step() so each suggest() sees the completed results so far.
+            self._target_samples = tune_config.num_samples
         self._scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
         if getattr(self._scheduler, "metric", None) is None:
             self._scheduler.metric = tune_config.metric
         if getattr(self._scheduler, "mode", None) is None:
             self._scheduler.mode = tune_config.mode or "max"
-        self._max_concurrent = tune_config.max_concurrent_trials or len(self.trials)
+        self._max_concurrent = tune_config.max_concurrent_trials or max(
+            1, self._target_samples
+        )
         self._resources = tune_config.resources_per_trial or {"num_cpus": 1}
         self._exploits: List[tuple] = []
 
@@ -213,6 +218,19 @@ class TuneController:
 
     def step(self) -> bool:
         """One scheduling round; returns True while any trial is live."""
+        # Lazy trial creation for adaptive searchers: suggest only when a slot
+        # is free, so later suggestions benefit from completed results.
+        while (
+            len(self.trials) < self._target_samples
+            and sum(1 for t in self.trials if t.status in (PENDING, RUNNING))
+            < self._max_concurrent
+        ):
+            tid = f"trial_{len(self.trials):05d}"
+            cfg = self._searcher.suggest(tid)
+            if cfg is None:
+                self._target_samples = len(self.trials)
+                break
+            self.trials.append(Trial(tid, cfg, self._experiment_dir))
         running = [t for t in self.trials if t.status == RUNNING]
         pending = [t for t in self.trials if t.status == PENDING]
         for trial in pending[: max(0, self._max_concurrent - len(running))]:
@@ -255,7 +273,10 @@ class TuneController:
                     trial.trial_id, trial.last_result, error=poll["status"] == ERROR
                 )
         self._apply_exploits()
-        return any(t.status in (PENDING, RUNNING) for t in self.trials)
+        return (
+            any(t.status in (PENDING, RUNNING) for t in self.trials)
+            or len(self.trials) < self._target_samples
+        )
 
     def run(self):
         while self.step():

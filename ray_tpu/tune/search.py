@@ -169,3 +169,212 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._idx]
         self._idx += 1
         return cfg
+
+
+class TPESearch(Searcher):
+    """Tree-structured Parzen Estimator search, dependency-free.
+
+    Parity target: the reference's search-algorithm integrations
+    (python/ray/tune/search/hyperopt/hyperopt_search.py wraps hyperopt's TPE;
+    optuna's default sampler is also TPE). This native implementation covers
+    the same Domain space (uniform/loguniform/randint/choice) so adaptive
+    search works on air-gapped TPU pods; OptunaSearch/HyperOptSearch below
+    adapt the external libraries when they are installed.
+
+    Algorithm: after n_initial random trials, completed trials split into the
+    top-gamma "good" set and the rest; numeric params draw candidates from a
+    Gaussian around good observations (per-observation kernels, Parzen style)
+    and keep the candidate maximizing the good/bad density ratio; categorical
+    params sample from good-set counts with add-one smoothing.
+    """
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 n_initial: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self._space = space
+        self._metric = metric
+        self._mode = mode
+        self._n_initial = n_initial
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._observed: List[tuple] = []  # (flat_config, score)
+        self._suggested: Dict[str, dict] = {}
+
+    # -- flat param helpers -------------------------------------------------
+    def _flatten(self, space, prefix=()):
+        for k, v in space.items():
+            if isinstance(v, dict) and not _is_grid(v):
+                yield from self._flatten(v, (*prefix, k))
+            else:
+                yield (*prefix, k), v
+
+    @staticmethod
+    def _set_path(cfg, path, value):
+        node = cfg
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = value
+
+    def _random_config(self) -> dict:
+        cfg: dict = {}
+        deferred = []
+        for path, v in self._flatten(self._space):
+            if isinstance(v, SampleFrom):
+                deferred.append((path, v))
+            elif isinstance(v, Domain):
+                self._set_path(cfg, path, v.sample(self._rng))
+            elif _is_grid(v):
+                self._set_path(cfg, path, self._rng.choice(v["grid_search"]))
+            else:
+                self._set_path(cfg, path, v)
+        for path, v in deferred:
+            self._set_path(cfg, path, v.fn(cfg))
+        return cfg
+
+    def _sample_param(self, path, domain, good_vals, bad_vals):
+        import math as _math
+
+        if isinstance(domain, Choice):
+            counts = {repr(o): 1.0 for o in domain.options}  # add-one smoothing
+            for v in good_vals:
+                counts[repr(v)] = counts.get(repr(v), 1.0) + 1.0
+            total = sum(counts.values())
+            r = self._rng.random() * total
+            acc = 0.0
+            for opt in domain.options:
+                acc += counts[repr(opt)]
+                if r <= acc:
+                    return opt
+            return domain.options[-1]
+        if not isinstance(domain, (Uniform, LogUniform, Randint)):
+            return domain.sample(self._rng)
+        log = isinstance(domain, LogUniform)
+        lo, hi = (domain.low, domain.high)
+        tlo, thi = (_math.log(lo), _math.log(hi)) if log else (lo, hi)
+        xform = _math.log if log else (lambda x: x)
+        good = [xform(v) for v in good_vals] or [(tlo + thi) / 2]
+        bad = [xform(v) for v in bad_vals]
+        width = (thi - tlo) or 1.0
+        bw = max(width / 6.0 / max(1, len(good)) ** 0.5, 1e-9)
+
+        def density(x, pts):
+            if not pts:
+                return 1.0 / width
+            return sum(
+                _math.exp(-0.5 * ((x - p) / bw) ** 2) for p in pts
+            ) / (len(pts) * bw)
+
+        best, best_score = None, -float("inf")
+        for _ in range(self._n_candidates):
+            center = self._rng.choice(good)
+            x = min(max(self._rng.gauss(center, bw * 2), tlo), thi)
+            score = density(x, good) / max(density(x, bad), 1e-12)
+            if score > best_score:
+                best, best_score = x, score
+        value = _math.exp(best) if log else best
+        if isinstance(domain, Randint):
+            value = min(max(int(round(value)), domain.low), domain.high - 1)
+        return value
+
+    # -- Searcher SPI -------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._observed) < self._n_initial:
+            cfg = self._random_config()
+        else:
+            ranked = sorted(
+                self._observed, key=lambda t: t[1],
+                reverse=(self._mode == "max"),
+            )
+            n_good = max(1, int(len(ranked) * self._gamma))
+            good, bad = ranked[:n_good], ranked[n_good:]
+            cfg = {}
+            deferred = []
+            for path, v in self._flatten(self._space):
+                if isinstance(v, SampleFrom):
+                    deferred.append((path, v))
+                elif isinstance(v, Domain):
+                    gv = [g[0][path] for g in good if path in g[0]]
+                    bv = [b[0][path] for b in bad if path in b[0]]
+                    self._set_path(cfg, path, self._sample_param(path, v, gv, bv))
+                elif _is_grid(v):
+                    self._set_path(cfg, path, self._rng.choice(v["grid_search"]))
+                else:
+                    self._set_path(cfg, path, v)
+            for path, v in deferred:
+                self._set_path(cfg, path, v.fn(cfg))
+        flat = {p: self._get_path(cfg, p) for p, _ in self._flatten(self._space)}
+        self._suggested[trial_id] = flat
+        return cfg
+
+    @staticmethod
+    def _get_path(cfg, path):
+        node = cfg
+        for k in path:
+            node = node[k]
+        return node
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        flat = self._suggested.pop(trial_id, None)
+        if flat is None or error or not result or self._metric not in result:
+            return
+        self._observed.append((flat, float(result[self._metric])))
+
+
+class OptunaSearch(Searcher):
+    """Adapter over optuna's sampler (reference:
+    python/ray/tune/search/optuna/optuna_search.py). Requires `optuna`."""
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 seed: Optional[int] = None, sampler=None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires `pip install optuna`; on air-gapped "
+                "pods use the dependency-free TPESearch instead"
+            ) from e
+        self._optuna = optuna
+        self._space = space
+        self._metric = metric
+        direction = "maximize" if mode == "max" else "minimize"
+        self._study = optuna.create_study(
+            direction=direction,
+            sampler=sampler or optuna.samplers.TPESampler(seed=seed),
+        )
+        self._trials: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        ot = self._study.ask()
+        cfg = {}
+        for key, v in self._space.items():
+            if isinstance(v, Uniform):
+                cfg[key] = ot.suggest_float(key, v.low, v.high)
+            elif isinstance(v, LogUniform):
+                cfg[key] = ot.suggest_float(key, v.low, v.high, log=True)
+            elif isinstance(v, Randint):
+                cfg[key] = ot.suggest_int(key, v.low, v.high - 1)
+            elif isinstance(v, Choice):
+                cfg[key] = ot.suggest_categorical(key, v.options)
+            elif isinstance(v, (dict, SampleFrom)) or _is_grid(v):
+                raise ValueError(
+                    f"OptunaSearch supports flat Domain spaces; {key!r} is "
+                    f"{type(v).__name__} — use TPESearch or flatten the space"
+                )
+            else:
+                cfg[key] = v
+        self._trials[trial_id] = ot
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        ot = self._trials.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or not result or self._metric not in result:
+            self._study.tell(ot, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, float(result[self._metric]))

@@ -382,3 +382,55 @@ def test_split_blocks_pickle_small():
     assert len(parts) >= 8
     blob = pickle.dumps(parts[0], protocol=5)
     assert len(blob) < 2 * parts[0].nbytes, (len(blob), parts[0].nbytes)
+
+
+def test_join_inner_and_left(ray_start_regular):
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": i, "x": i * 10} for i in range(8)])
+    right = rd.from_items([{"id": i, "y": i * 100} for i in range(4, 12)])
+    joined = left.join(right, on="id").sort("id").take_all()
+    assert [r["id"] for r in joined] == [4, 5, 6, 7]
+    assert all(r["y"] == r["id"] * 100 and r["x"] == r["id"] * 10 for r in joined)
+
+    lj = left.join(right, on="id", how="left").sort("id").take_all()
+    assert [r["id"] for r in lj] == list(range(8))
+    assert lj[0]["y"] is None and lj[7]["y"] == 700
+
+    # Multi-key join + non-key column collision gets the right suffix.
+    l2 = rd.from_items([{"a": 1, "b": 2, "v": 7}])
+    r2 = rd.from_items([{"a": 1, "b": 2, "v": 9}])
+    out = l2.join(r2, on=["a", "b"]).take_all()
+    assert out == [{"a": 1, "b": 2, "v": 7, "v_1": 9}]
+
+
+def test_join_partitioned_matches_single_partition(ray_start_regular):
+    import ray_tpu.data as rd
+
+    left = rd.range(50).map(lambda r: {"id": r["id"] % 13, "x": r["id"]})
+    right = rd.from_items([{"id": i, "tag": f"t{i}"} for i in range(13)])
+    many = left.join(right, on="id", num_partitions=4).take_all()
+    one = left.join(right, on="id", num_partitions=1).take_all()
+    key = lambda r: (r["id"], r["x"])  # noqa: E731
+    assert sorted(many, key=key) == sorted(one, key=key)
+    assert len(many) == 50
+
+
+def test_join_empty_copartitions_and_empty_sides(ray_start_regular):
+    """Left/outer joins survive co-partitions where one side is empty
+    (regression: empty side crashed the pyarrow join or silently dropped
+    the other side's rows)."""
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": i, "x": i} for i in range(12)])
+    right = rd.from_items([{"id": 0, "y": 99}])  # one key: most partitions empty
+    lj = left.join(right, on="id", how="left", num_partitions=4).sort("id").take_all()
+    assert len(lj) == 12
+    assert lj[0]["y"] == 99 and all(r["y"] is None for r in lj[1:])
+
+    rj = right.join(left, on="id", how="right", num_partitions=4).sort("id").take_all()
+    assert len(rj) == 12
+
+    empty = rd.from_items([{"id": 1, "z": 2}]).filter(lambda r: False)
+    assert left.join(empty, on="id").take_all() == []
+    assert len(left.join(empty, on="id", how="left").take_all()) == 12

@@ -419,3 +419,32 @@ def test_proxy_port_and_table():
     assert len(ports) >= 1  # one proxy per alive node
     assert serve.get_proxy_port() in ports.values()
     serve.delete("hello_app")
+
+
+def test_grpc_ingress(_cluster):
+    """gRPC ingress beside HTTP (reference: the serve gRPC proxy): any
+    /<app>/<method> unary call routes to the app's ingress with raw bytes."""
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            assert request.method == "GRPC"
+            body = request.body.decode()
+            return {"echo": body, "path": request.path}
+
+    serve.start(http_options={"grpc_port": 0})
+    serve.run(Echo.bind(), name="grpcapp", route_prefix="/grpcapp", _timeout_s=120)
+    port = serve.get_grpc_port()
+    assert port, "grpc ingress did not start"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    rpc = channel.unary_unary(
+        "/grpcapp/Predict",
+        request_serializer=None,
+        response_deserializer=None,
+    )
+    out = rpc(b"hello-grpc", timeout=120)
+    payload = json.loads(out)
+    assert payload["echo"] == "hello-grpc"
+    assert payload["path"] == "/grpcapp/Predict"
+    channel.close()
