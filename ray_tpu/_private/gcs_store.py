@@ -54,7 +54,9 @@ class FileStoreClient(InMemoryStoreClient):
 
     Records are pickle-framed (op, table, key, value) tuples. Writes flush to the OS
     on every append (crash of the GCS process loses nothing; host crash can lose the
-    tail, same class of guarantee as default Redis AOF everysec).
+    tail, same class of guarantee as default Redis AOF everysec). Set
+    RAY_TPU_GCS_STORE_FSYNC=1 for fsync-per-append (Redis AOF always): host crashes
+    then lose at most the torn tail record, at a per-write latency cost.
     """
 
     _COMPACT_THRESHOLD = 50_000
@@ -67,6 +69,9 @@ class FileStoreClient(InMemoryStoreClient):
         self._lock = threading.Lock()
         self._log = None
         self._appends_since_compact = 0
+        self._fsync = os.environ.get(
+            "RAY_TPU_GCS_STORE_FSYNC", "0"
+        ).lower() in ("1", "true", "on")
 
     @property
     def persistent(self) -> bool:
@@ -102,6 +107,8 @@ class FileStoreClient(InMemoryStoreClient):
         with self._lock:
             pickle.dump(record, self._log, protocol=5)
             self._log.flush()
+            if self._fsync:
+                os.fsync(self._log.fileno())
             self._appends_since_compact += 1
             if self._appends_since_compact >= self._COMPACT_THRESHOLD:
                 self._compact_locked()
@@ -116,6 +123,15 @@ class FileStoreClient(InMemoryStoreClient):
             os.fsync(f.fileno())
         self._log.close()
         os.replace(tmp, self._path)
+        if self._fsync:
+            # The rename itself must be durable, or a host crash can strand the
+            # directory pointing at the pre-compaction inode — losing the
+            # snapshot and every fsynced append after it.
+            dir_fd = os.open(self._dir, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
         self._log = open(self._path, "ab")
         self._appends_since_compact = 0
 

@@ -145,3 +145,25 @@ def test_calls_retry_through_gcs_downtime():
         assert _wait_for(lambda: w.gcs_kv_get("app", b"k") == b"v1", timeout=30)
     finally:
         cluster.shutdown()
+
+
+def test_file_store_fsync_mode(tmp_path, monkeypatch):
+    """RAY_TPU_GCS_STORE_FSYNC=1 syncs every append (host-crash durability,
+    VERDICT weak #7); data survives reload either way."""
+    import os
+
+    from ray_tpu._private.gcs_store import FileStoreClient
+
+    monkeypatch.setenv("RAY_TPU_GCS_STORE_FSYNC", "1")
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd)))
+    store = FileStoreClient(str(tmp_path))
+    store.load()
+    store.put("kv", b"a", b"1")
+    assert synced, "fsync mode did not sync the append"
+    monkeypatch.setenv("RAY_TPU_GCS_STORE_FSYNC", "0")
+    store2 = FileStoreClient(str(tmp_path))
+    assert not store2._fsync  # default mode actually exercised on reload
+    store2.load()
+    assert store2.get("kv", b"a") == b"1"
