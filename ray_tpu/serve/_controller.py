@@ -13,7 +13,7 @@ import asyncio
 import math
 import time
 import traceback
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 class ServeController:
@@ -38,6 +38,7 @@ class ServeController:
         # membership in the control loop once ensure_proxies() arms it.
         self._http_options: Optional[dict] = None
         self._proxies: Dict[str, tuple] = {}
+        self._mux_ids: Dict[str, dict] = {}  # "app#dep" -> {actor_id: [model ids]}
 
     # -- proxies -----------------------------------------------------------
     async def ensure_proxies(self, http_options: Optional[dict] = None) -> int:
@@ -144,6 +145,7 @@ class ServeController:
             if name != "__meta__" and name not in deployments:
                 for r in live.pop(name, []):
                     self._kill(r)
+                self._mux_ids.pop(f"{app}#{name}", None)
         self._apps[app] = deployments
         meta = self._apps[app].setdefault("__meta__", {})
         meta["route_prefix"] = route_prefix
@@ -154,6 +156,8 @@ class ServeController:
 
     async def delete_app(self, app: str) -> bool:
         self._apps.pop(app, None)
+        for key in [k for k in self._mux_ids if k.startswith(f"{app}#")]:
+            self._mux_ids.pop(key, None)
         for replicas in self._replicas.pop(app, {}).values():
             for r in replicas:
                 self._kill(r)
@@ -183,6 +187,7 @@ class ServeController:
         return {
             "version": self._versions.get(key, 0),
             "replicas": list(self._replicas.get(app, {}).get(deployment, [])),
+            "multiplexed": dict(self._mux_ids.get(key, {})),
         }
 
     async def get_app_meta(self, app: str) -> Optional[dict]:
@@ -333,10 +338,14 @@ class ServeController:
                 results = await asyncio.gather(*(probe(r) for r in replicas))
                 stats = []
                 dead = []
+                mux_ids: Dict[Any, list] = {}
                 for r, res in zip(replicas, results):
                     if not isinstance(res, Exception):
                         stats.append(res)
                         health["healthy"].add(r._actor_id)
+                        ids = res.get("multiplexed_ids") or []
+                        if ids:
+                            mux_ids[r._actor_id] = list(ids)
                         continue
                     died = type(res).__name__ == "ActorDiedError"
                     started = health["created"].get(r._actor_id, now)
@@ -348,6 +357,11 @@ class ServeController:
                         dead.append(r._actor_id)
                 if dead:
                     spec["_dead"] = dead
+                # Cluster-wide multiplex view: replicas report loaded model ids
+                # through get_stats; routers prefer replicas that already hold
+                # the model (reference routes on replica-reported ids,
+                # python/ray/serve/multiplex.py).
+                self._mux_ids[f"{app}#{name}"] = mux_ids
                 cfg = spec["config"]
                 if cfg.autoscaling_config is not None and stats:
                     self._autoscale(app, name, spec, stats)

@@ -134,9 +134,10 @@ class _Router:
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[Any, int] = {}
-        # Multiplexing cache affinity: model_id -> actor_id that loaded it last
-        # (reference routes on replica-reported loaded ids; here the map is
-        # learned locally per process, which converges for steady callers).
+        # Multiplexing: cluster-wide replica-reported model ids (refreshed with
+        # the routing table — reference routes on replica-reported ids) plus a
+        # local fallback affinity for models routed between controller polls.
+        self._mux: Dict[Any, list] = {}  # actor_id -> [model ids]
         self._model_affinity: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
@@ -153,6 +154,7 @@ class _Router:
         with self._lock:
             self._version = info["version"]
             self._replicas = info["replicas"]
+            self._mux = info.get("multiplexed") or {}
             self._fetched_at = now
             self._inflight = {
                 a._actor_id: self._inflight.get(a._actor_id, 0) for a in self._replicas
@@ -170,7 +172,25 @@ class _Router:
             self._refresh(force=True)
         with self._lock:
             if model_id:
-                # Cache affinity: send the request where the model already lives.
+                # Cluster-wide affinity first: any replica REPORTING the model
+                # loaded (controller-polled) serves it without a reload, even
+                # if this caller never routed it before. Least-loaded among
+                # the holders; local last-routed affinity as the fallback for
+                # models loaded since the last poll.
+                holders = [
+                    r for r in self._replicas
+                    if model_id in self._mux.get(r._actor_id, ())
+                ]
+                if holders:
+                    pick = min(
+                        holders,
+                        key=lambda r: self._inflight.get(r._actor_id, 0),
+                    )
+                    self._inflight[pick._actor_id] = (
+                        self._inflight.get(pick._actor_id, 0) + 1
+                    )
+                    self._model_affinity[model_id] = pick._actor_id
+                    return pick
                 aff = self._model_affinity.get(model_id)
                 if aff is not None:
                     for r in self._replicas:
