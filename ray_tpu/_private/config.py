@@ -88,3 +88,55 @@ class _Config:
 
 
 CONFIG = _Config()
+
+
+_LOOPBACK = ("127.0.0.1", "localhost", "::1", "0.0.0.0")
+
+
+def get_node_ip(probe_host: str | None = None) -> str:
+    """The IP this node should advertise to cluster peers.
+
+    Resolution order (reference: `python/ray/_private/services.py`
+    get_node_ip_address — UDP-connect trick, env overridable):
+    1. `RAY_TPU_NODE_IP` env var, set by the autoscaler startup script or the
+       operator on multi-host deployments.
+    2. If the GCS (or any probe host) is non-loopback, the source IP the kernel
+       picks to reach it — the interface actually routable from the cluster.
+    3. Loopback, for single-host clusters and tests.
+    """
+    ip = os.environ.get(_ENV_PREFIX + "NODE_IP")
+    if ip:
+        return ip
+    if probe_host and probe_host not in _LOOPBACK:
+        import socket
+
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((probe_host, 80))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            # Registering loopback on a multi-host cluster makes every peer
+            # dial itself for this node — degrade loudly, not silently.
+            import logging
+
+            logging.getLogger("ray_tpu").warning(
+                "could not determine a routable node IP (probe host %s); "
+                "falling back to 127.0.0.1 — set RAY_TPU_NODE_IP on "
+                "multi-host clusters", probe_host,
+            )
+    return "127.0.0.1"
+
+
+def bind_host_for(node_ip: str) -> str:
+    """Listen host for a server whose address is advertised as `node_ip`.
+
+    Loopback nodes stay loopback-only. Routable nodes listen on all interfaces
+    rather than `node_ip` alone: local peers (workers, drivers, the raylet's
+    own GCS connection) dial 127.0.0.1 while remote peers dial the advertised
+    IP, and both must reach the same socket. The RPC plane is unauthenticated —
+    same trust model as the reference's gRPC servers, which also listen
+    beyond loopback inside the cluster's network boundary."""
+    return "127.0.0.1" if node_ip in _LOOPBACK else "0.0.0.0"

@@ -19,7 +19,7 @@ import time
 import traceback
 from typing import Any
 
-from ray_tpu._private.config import CONFIG
+from ray_tpu._private.config import CONFIG, _LOOPBACK
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu._private.rpc import Connection
 
@@ -198,6 +198,21 @@ class GcsService:
 
     # ---------------- node management ----------------
 
+    def _vet_direct_addr(self, node_id, direct_addr):
+        """Drop loopback direct addrs published by workers on nodes that
+        registered a routable IP: a loopback addr is only dialable from the
+        same host, so remote peers would reach themselves (or an unrelated
+        local process on port collision). Dropping it makes callers fall back
+        to the raylet-mediated route, which is always correct."""
+        if not direct_addr:
+            return None
+        node = self.nodes.get(node_id)
+        if (node is not None
+                and node.address[0] not in _LOOPBACK
+                and direct_addr[0] in _LOOPBACK):
+            return None
+        return tuple(direct_addr)
+
     async def rpc_register_node(self, conn, node_id: NodeID, address, resources, labels, is_head):
         info = NodeInfo(node_id, tuple(address), resources, labels, conn)
         info.is_head = bool(is_head)
@@ -220,7 +235,7 @@ class GcsService:
                 continue
             actor.state = ALIVE
             actor.address = {"node_id": node_id, "worker_id": worker_id,
-                             "direct_addr": direct_addr}
+                             "direct_addr": self._vet_direct_addr(node_id, direct_addr)}
             actor.placing = False
             actor.awaiting_report = False
             await self.publish("actors", {"actor": actor.view()})
@@ -487,7 +502,8 @@ class GcsService:
                 actor.state = ALIVE
                 actor.address = {"node_id": node.node_id,
                                  "worker_id": result["worker_id"],
-                                 "direct_addr": result.get("direct_addr")}
+                                 "direct_addr": self._vet_direct_addr(
+                                     node.node_id, result.get("direct_addr"))}
                 await self.publish("actors", {"actor": actor.view()})
                 ev = self._actor_events.pop(actor.actor_id, None)
                 if ev:

@@ -16,6 +16,7 @@ import signal
 import sys
 
 from ray_tpu._private import rpc
+from ray_tpu._private.config import bind_host_for, get_node_ip
 from ray_tpu._private.gcs import GcsService
 from ray_tpu._private.gcs_store import FileStoreClient, InMemoryStoreClient
 
@@ -24,7 +25,9 @@ async def amain(args):
     store = FileStoreClient(args.store_dir) if args.store_dir else InMemoryStoreClient()
     gcs = GcsService(store=store)
     server = rpc.RpcServer(lambda conn: gcs)
-    await server.start(port=args.port)
+    # Raylets on other hosts must be able to register: listen beyond loopback
+    # whenever this node advertises a routable IP (RAY_TPU_NODE_IP).
+    await server.start(host=bind_host_for(get_node_ip()), port=args.port)
     gcs.start_background()
 
     if args.ready_file:

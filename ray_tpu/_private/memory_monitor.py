@@ -30,19 +30,54 @@ def _read_meminfo(path: str) -> tuple[int, int] | None:
     return None
 
 
-def _read_cgroup_v2() -> tuple[int, int] | None:
-    """(limit_bytes, current_bytes) for a memory-limited cgroup, else None."""
+def _own_cgroup_v2_path(proc_cgroup: str = "/proc/self/cgroup") -> str | None:
+    """This process's cgroup-v2 directory, from /proc/self/cgroup ("0::/a/b")."""
     try:
-        with open("/sys/fs/cgroup/memory.max") as f:
-            raw = f.read().strip()
-        if raw == "max":
-            return None
-        limit = int(raw)
-        with open("/sys/fs/cgroup/memory.current") as f:
-            current = int(f.read().strip())
-        return limit, current
-    except (OSError, ValueError):
-        return None
+        with open(proc_cgroup) as f:
+            for line in f:
+                # v2 unified hierarchy entries are "0::<path>"; v1 controllers
+                # ("N:<name>:<path>") don't map onto /sys/fs/cgroup directly.
+                if line.startswith("0::"):
+                    rel = line.split("::", 1)[1].strip().lstrip("/")
+                    return os.path.join("/sys/fs/cgroup", rel) if rel else "/sys/fs/cgroup"
+    except OSError:
+        pass
+    return None
+
+
+def _read_cgroup_v2() -> tuple[int, int] | None:
+    """(limit_bytes, current_bytes) for the nearest memory-limited ancestor of
+    this process's own cgroup, else None.
+
+    Walking up from /proc/self/cgroup (not reading the fixed cgroup root)
+    matters when the raylet runs in a systemd slice or container sub-group with
+    a memory limit: the root's memory.max is usually "max", so a root-only read
+    would miss the limit and fall back to host-wide meminfo — and the kernel
+    would OOM-kill the node before the monitor ever triggered."""
+    path = _own_cgroup_v2_path() or "/sys/fs/cgroup"
+    root = "/sys/fs/cgroup"
+    # The binding constraint is the ancestor closest to its limit, not the
+    # deepest one with a limit set (a loose leaf limit must not mask a tight
+    # parent slice limit) — so inspect every level and keep the worst ratio.
+    tightest: tuple[int, int] | None = None
+    while True:
+        try:
+            with open(os.path.join(path, "memory.max")) as f:
+                raw = f.read().strip()
+            if raw != "max":
+                limit = int(raw)
+                with open(os.path.join(path, "memory.current")) as f:
+                    current = int(f.read().strip())
+                if limit > 0 and (
+                    tightest is None
+                    or current / limit > tightest[1] / tightest[0]
+                ):
+                    tightest = (limit, current)
+        except (OSError, ValueError):
+            pass
+        if path == root or not path.startswith(root):
+            return tightest
+        path = os.path.dirname(path)
 
 
 class MemoryMonitor:

@@ -27,7 +27,7 @@ import traceback
 from typing import Any
 
 from ray_tpu._private import rpc
-from ray_tpu._private.config import CONFIG
+from ray_tpu._private.config import CONFIG, bind_host_for, get_node_ip
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._private.object_store import SharedObjectStore
 
@@ -129,9 +129,14 @@ class Raylet:
         session_dir: str = "/tmp/ray_tpu",
         object_store_bytes: int | None = None,
         worker_env: dict | None = None,
+        node_ip: str | None = None,
     ):
         self.node_id = node_id
         self.gcs_addr = gcs_addr
+        # The address peers dial: never advertise loopback on a multi-host
+        # cluster (reference: NodeManager registers node_manager_address, not
+        # localhost). Direct worker servers advertise this IP too.
+        self.node_ip = node_ip or get_node_ip(gcs_addr[0])
         self.is_head = is_head
         self.labels = labels or {}
         self.session_dir = session_dir
@@ -185,7 +190,7 @@ class Raylet:
 
     async def start(self, port: int = 0):
         self.server = rpc.RpcServer(lambda conn: self)
-        await self.server.start(port=port)
+        await self.server.start(host=bind_host_for(self.node_ip), port=port)
         self.port = self.server.port
         await self._connect_gcs()
         loop = asyncio.get_running_loop()
@@ -216,7 +221,7 @@ class Raylet:
         await self.gcs.call(
             "register_node",
             self.node_id,
-            ("127.0.0.1", self.port),
+            (self.node_ip, self.port),
             self.resources.total,
             self.labels,
             self.is_head,
@@ -365,6 +370,12 @@ class Raylet:
         env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        # Workers must agree with this raylet on the node's advertised IP: they
+        # bind their direct server per get_node_ip(), and the raylet publishes
+        # direct_addr on self.node_ip — a mismatch (e.g. Raylet(node_ip=...)
+        # without the env var) would advertise an interface the worker never
+        # bound.
+        env["RAY_TPU_NODE_IP"] = self.node_ip
         env["RAY_TPU_RAYLET_PORT"] = str(self.port)
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
         # Unbuffered so crash tracebacks reach the log file even on abrupt death
@@ -997,7 +1008,8 @@ class Raylet:
     # ------------------------------------------------------------------ RPC: workers
 
     async def rpc_register_worker(self, conn, worker_id: WorkerID, kind: str, pid: int,
-                                  direct_port: int | None = None):
+                                  direct_port: int | None = None,
+                                  direct_bind_host: str | None = None):
         handle = self.workers.get(worker_id)
         if handle is None:
             handle = WorkerHandle(worker_id, None, kind)
@@ -1005,10 +1017,20 @@ class Raylet:
         handle.conn = conn
         handle.kind = kind if handle.kind == "worker" and kind == "driver" else handle.kind
         if direct_port:
-            handle.direct_addr = ("127.0.0.1", direct_port)
+            # Advertise the node IP only when the worker's bind actually covers
+            # it (raylet-spawned workers always do — they inherit
+            # RAY_TPU_NODE_IP — but an externally-started driver may have bound
+            # loopback while this raylet advertises a routable IP). A loopback
+            # direct_addr stays correct for same-host peers; the GCS vets it
+            # out of cross-host records.
+            covers = direct_bind_host in (None, "0.0.0.0", self.node_ip)
+            handle.direct_addr = (
+                (self.node_ip, direct_port) if covers else ("127.0.0.1", direct_port)
+            )
         handle.registered.set()
         conn.on_close(lambda c: self._on_worker_lost(handle))
-        return {"node_id": self.node_id, "store_capacity": self.store.capacity}
+        return {"node_id": self.node_id, "store_capacity": self.store.capacity,
+                "node_ip": self.node_ip}
 
     async def rpc_submit_task(self, conn, spec: dict):
         self.task_queue.append(spec)

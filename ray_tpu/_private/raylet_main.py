@@ -15,6 +15,7 @@ import signal
 import sys
 
 from ray_tpu._private import rpc
+from ray_tpu._private.config import bind_host_for, get_node_ip
 from ray_tpu._private.gcs import GcsService
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.raylet import Raylet
@@ -27,7 +28,9 @@ async def amain(args):
         # (node.py) runs the GCS as its own restartable process via gcs_main.
         gcs = GcsService()
         gcs_server = rpc.RpcServer(lambda conn: gcs)
-        await gcs_server.start(port=0)
+        await gcs_server.start(
+            host=bind_host_for(args.node_ip or get_node_ip()), port=0
+        )
         gcs.start_background()
         gcs_port = gcs_server.port
 
@@ -41,6 +44,7 @@ async def amain(args):
         session_dir=args.session_dir,
         object_store_bytes=args.object_store_bytes or None,
         worker_env=json.loads(args.worker_env),
+        node_ip=args.node_ip or None,
     )
     await raylet.start(port=args.port)
 
@@ -76,6 +80,7 @@ def main():
     p.add_argument("--gcs-port", type=int, default=0)
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--node-id", default="")
+    p.add_argument("--node-ip", default="")
     p.add_argument("--resources", default="{}")
     p.add_argument("--labels", default="{}")
     p.add_argument("--worker-env", default="{}")

@@ -21,7 +21,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
 
 from ray_tpu._private import rpc, serialization
-from ray_tpu._private.config import CONFIG
+from ray_tpu._private.config import CONFIG, bind_host_for, get_node_ip
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.object_ref import ObjectRef
@@ -372,6 +372,8 @@ class CoreWorker:
         self.session_token = os.urandom(8).hex()  # distinguishes init/shutdown cycles
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id: NodeID | None = None
+        self.node_ip: str = "127.0.0.1"
+        self._direct_bind_host: str = "127.0.0.1"
         self.job_id = job_id
         self.io = rpc.IoLoop(name=f"rtpu-io-{mode}")
         self.raylet: rpc.Connection | None = None
@@ -440,15 +442,29 @@ class CoreWorker:
             # Direct-call server: peers (owners of actor calls / leased tasks,
             # cross-node channel readers) reach this process without a raylet
             # hop on the hot path. Drivers host one too: they are the writer
-            # side of a compiled DAG's input channel.
-            self._direct_server = self.io.run(rpc.RpcServer(lambda conn: self).start())
+            # side of a compiled DAG's input channel. Bound on all interfaces
+            # when this node advertises a routable IP, so remote-node peers can
+            # actually dial the direct_addr the raylet publishes for us.
+            bind = bind_host_for(get_node_ip(self.gcs_addr[0]))
+            self._direct_server = self.io.run(
+                rpc.RpcServer(lambda conn: self).start(host=bind)
+            )
             direct_port = self._direct_server.port
+            self._direct_bind_host = bind
         reply = self.io.run(
             self.raylet.call(
-                "register_worker", self.worker_id, self.mode, os.getpid(), direct_port
+                "register_worker", self.worker_id, self.mode, os.getpid(), direct_port,
+                self._direct_bind_host,
             )
         )
         self.node_id = reply["node_id"]
+        node_ip = reply.get("node_ip", "127.0.0.1")
+        # The IP peers may dial this worker's direct server on. Loopback when we
+        # bound loopback-only, whatever the node advertises (compiled DAG driver
+        # channels publish this).
+        self.node_ip = (
+            node_ip if self._direct_bind_host in ("0.0.0.0", node_ip) else "127.0.0.1"
+        )
         if self.mode == "worker":
             self.raylet.on_close(lambda c: os._exit(0))
         elif os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") not in ("0", "false"):

@@ -81,10 +81,24 @@ class GCETPUNodeProvider(NodeProvider):
     # -- SPI ----------------------------------------------------------------
     def create_node(self, resources: Dict[str, float]) -> str:
         node_id = f"{self._cluster}-{uuid.uuid4().hex[:8]}"
+        # TPU/pod/head resources are derived per host by accelerator discovery
+        # (accelerators/tpu.py: chips from the local topology, the slice-head
+        # resource only on TPU_WORKER_ID==0). The startup script runs on EVERY
+        # host of a multi-host slice, so baking them into --resources would make
+        # all N hosts advertise the gang-scheduling head resource — one slice
+        # would present N heads, breaking slice-atomic placement.
+        custom = {
+            k: v for k, v in resources.items()
+            # Discovery outputs are exactly "TPU" (chip count) and "TPU-*"
+            # (pod type, "-head", slice name — accelerators/tpu.py
+            # node_resources); every other name is a user-defined custom
+            # resource and passes through.
+            if k not in ("CPU", "TPU") and not k.startswith("TPU-")
+        }
         startup = (
             "#! /bin/bash\n"
             f"ray_tpu start --address={self._head} "
-            f"--resources='{json.dumps({k: v for k, v in resources.items() if k != 'CPU'})}'\n"
+            f"--resources='{json.dumps(custom)}'\n"
         )
         body = {
             "acceleratorType": self._accel,

@@ -233,7 +233,7 @@ class CompiledDAG:
                 input_reader_nodes |= consumer_nodes.get(id(n), set())
         direct_server = getattr(w, "_direct_server", None)
         driver_addr = (
-            ("addr", ("127.0.0.1", direct_server.port))
+            ("addr", (getattr(w, "node_ip", "127.0.0.1"), direct_server.port))
             if direct_server is not None else None
         )
         self._input_channel = make_channel(

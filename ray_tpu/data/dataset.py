@@ -334,6 +334,12 @@ class Dataset:
         "left", "right", or "outer". Both sides are hash-partitioned on the
         keys; co-partitions join remotely (pyarrow), so neither table is ever
         materialized on the driver.
+
+        Degenerate case: joining against a dataset with zero blocks (not just
+        zero rows — no schema exists at all) cannot reconstruct the absent
+        side's columns, so "left"/"right"/"outer" return the present side's
+        bundles unchanged (the other side's columns are dropped rather than
+        emitted as nulls, which a row-empty-but-schema-bearing side would get).
         """
         keys = [on] if isinstance(on, str) else list(on)
 

@@ -129,11 +129,20 @@ def test_gce_tpu_provider_dryrun():
         head_address="10.0.0.1:6379", cluster_name="testcl",
         transport=transport,
     )
-    nid = provider.create_node({"CPU": 1, "TPU": 4, "TPU-v5litepod-16": 1})
+    nid = provider.create_node({
+        "CPU": 1, "TPU": 4, "TPU-v5litepod-16": 1, "TPU-v5litepod-16-head": 1,
+        "TPU-testslice": 1, "my_custom": 2, "very_custom": 1,
+    })
     method, url, body = transport.requests[-1]
     assert method == "POST" and f"nodeId={nid}" in url
     assert body["acceleratorType"] == "v5litepod-16"
-    assert "ray_tpu start --address=10.0.0.1:6379" in body["metadata"]["startup-script"]
+    script = body["metadata"]["startup-script"]
+    assert "ray_tpu start --address=10.0.0.1:6379" in script
+    # TPU/pod/head resources must NOT be baked into the startup script: it runs
+    # on every host of the slice, and only TPU_WORKER_ID==0 may advertise the
+    # gang-scheduling head resource (per-host discovery derives all of these).
+    assert "head" not in script and "TPU" not in script and "v5litepod" not in script
+    assert "my_custom" in script and "very_custom" in script
     assert body["labels"]["ray-tpu-cluster"] == "testcl"
 
     assert provider.non_terminated_nodes() == [nid]
