@@ -23,7 +23,7 @@ def load() -> Optional[ctypes.CDLL]:
         return None
     lib = ctypes.CDLL(path)
     lib.shmstore_create.restype = ctypes.c_void_p
-    lib.shmstore_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmstore_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.shmstore_open.restype = ctypes.c_void_p
     lib.shmstore_open.argtypes = [ctypes.c_char_p]
     lib.shmstore_alloc.restype = ctypes.c_uint64
@@ -41,6 +41,8 @@ def load() -> Optional[ctypes.CDLL]:
     lib.shmstore_list_spillable.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
     ]
+    lib.shmstore_reap_stale_allocated.restype = ctypes.c_uint32
+    lib.shmstore_reap_stale_allocated.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.shmstore_pin.restype = ctypes.c_int
     lib.shmstore_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shmstore_release.restype = ctypes.c_int
@@ -114,6 +116,27 @@ class _ArenaHandle:
             return False
         return self._lib.shmstore_release(self._h, object_id) == 0
 
+    # Allocation/seal/free run directly in shared memory under the arena's
+    # process-shared robust mutex, so BOTH the server (raylet) and clients
+    # (workers) can drive the full create→write→seal lifecycle without an RPC
+    # on the hot put path (plasma parity in spirit; plasma routes creates
+    # through the store socket, we don't need to).
+    def alloc(self, object_id: bytes, size: int) -> Optional[int]:
+        """Returns payload offset from arena base, None if full, or raises
+        FileExistsError on duplicate id."""
+        off = self._lib.shmstore_alloc(self._handle(), object_id, size)
+        if off == _ALLOC_FULL:
+            return None
+        if off == _ALLOC_EXISTS:
+            raise FileExistsError(object_id.hex())
+        return off
+
+    def seal(self, object_id: bytes) -> bool:
+        return self._lib.shmstore_seal(self._handle(), object_id) == 0
+
+    def free(self, object_id: bytes, eager: bool = False) -> bool:
+        return self._lib.shmstore_free_obj(self._handle(), object_id, 1 if eager else 0) == 0
+
     def read_pinned(self, object_id: bytes, offset: int, size: int) -> memoryview:
         """A zero-copy view that PINS the object: the arena will not recycle the
         payload while this view (or any memoryview/ndarray sliced from it) is
@@ -151,29 +174,18 @@ class _PinnedRegion:
 class NativeStoreServer(_ArenaHandle):
     """Owns the arena segment (raylet side)."""
 
-    def __init__(self, name: str, capacity: int):
+    def __init__(self, name: str, capacity: int, pretouch: int = 0):
         lib = load()
         if lib is None:
             raise RuntimeError("native shmstore unavailable")
-        h = lib.shmstore_create(name.encode(), capacity)
+        h = lib.shmstore_create(name.encode(), capacity, pretouch)
         if not h:
             raise RuntimeError(f"failed to create arena {name!r}")
         super().__init__(name, h)
 
-    def alloc(self, object_id: bytes, size: int) -> Optional[int]:
-        """Returns payload offset, None if full, or raises on duplicate."""
-        off = self._lib.shmstore_alloc(self._handle(), object_id, size)
-        if off == _ALLOC_FULL:
-            return None
-        if off == _ALLOC_EXISTS:
-            raise FileExistsError(object_id.hex())
-        return off
-
-    def seal(self, object_id: bytes) -> bool:
-        return self._lib.shmstore_seal(self._handle(), object_id) == 0
-
-    def free(self, object_id: bytes, eager: bool = False) -> bool:
-        return self._lib.shmstore_free_obj(self._handle(), object_id, 1 if eager else 0) == 0
+    def reap_stale_allocated(self, age_ms: int) -> int:
+        """Evict never-sealed entries older than age_ms (writer died mid-put)."""
+        return int(self._lib.shmstore_reap_stale_allocated(self._handle(), age_ms))
 
     def list_spillable(self, max_out: int = 256) -> list:
         """Sealed, unpinned object keys in LRU order (spill candidates)."""

@@ -31,6 +31,10 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "heartbeat_interval_s": (float, 1.0, "raylet -> GCS resource/health report interval"),
     "node_death_timeout_s": (float, 5.0, "GCS marks a node dead after missing heartbeats for this long"),
     "object_store_memory_fraction": (float, 0.3, "fraction of system memory for the per-node shared-memory object store"),
+    "store_pretouch_bytes": (int, 1 << 30, "fault in this much of the shm arena at store startup so first puts run at warm-page speed (0 disables)"),
+    "object_report_flush_s": (float, 0.02, "raylet batching window for GCS object-directory reports/frees"),
+    "pull_chunk_window": (int, 8, "pipelined in-flight chunk requests per remote object pull"),
+    "pull_budget_bytes": (int, 1 << 30, "cap on total bytes of concurrently in-flight remote pulls (backpressure)"),
     "object_store_min_chunk_bytes": (int, 1024 * 1024, "chunk size for node-to-node object transfer"),
     "memory_store_max_inline_refs": (int, 10000, "max unresolved inline futures per worker"),
     "actor_queue_warn_size": (int, 5000, "warn when an actor's pending call queue exceeds this"),
@@ -42,6 +46,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     # --- scheduling ---
     "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
     "lease_timeout_s": (float, 30.0, "worker lease validity"),
+    "lease_worker_slots": (int, 4, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker)"),
     # --- logging / observability ---
     "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
     "event_buffer_size": (int, 10000, "per-worker task event buffer entries"),

@@ -386,6 +386,18 @@ class GcsService:
         entry["size"] = size
         return True
 
+    async def rpc_object_ops_batch(self, conn, ops: list):
+        """Amortized directory update (raylets batch per-object seal/free
+        traffic; on small hosts per-put GCS round trips dominated put cost).
+        Ops apply in the order the raylet recorded them, so free-then-re-seal
+        and seal-then-free sequences resolve exactly as unbatched calls would."""
+        for op in ops:
+            if op[0] == "report":
+                _, object_id, node_id, size, owner = op
+                await self.rpc_report_object(conn, object_id, node_id, size, owner)
+            else:
+                await self.rpc_free_object(conn, op[1])
+
     async def rpc_object_locations(self, conn, object_id: ObjectID):
         entry = self.object_dir.get(object_id)
         if entry is None:

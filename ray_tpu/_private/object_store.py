@@ -107,9 +107,14 @@ class NativeSharedObjectStore:
     def __init__(self, capacity_bytes: int, spill_dir: str | None = None):
         from ray_tpu._native.shmstore import NativeStoreServer
 
+        from ray_tpu._private.config import CONFIG
+
         self.capacity = capacity_bytes
         self._arena_name = f"rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
-        self._srv = NativeStoreServer(self._arena_name, capacity_bytes)
+        self._srv = NativeStoreServer(
+            self._arena_name, capacity_bytes,
+            pretouch=min(capacity_bytes, CONFIG.store_pretouch_bytes),
+        )
         spill_root = os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "spill"
         )

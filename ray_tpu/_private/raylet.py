@@ -60,6 +60,57 @@ class WorkerHandle:
         return self.conn is not None and not self.conn.closed
 
 
+class PullManager:
+    """Prioritized, byte-budgeted admission for remote object pulls.
+
+    Reference: `src/ray/object_manager/pull_manager.h:49` — three priority
+    tiers (gets ahead of waits ahead of task args) and an in-flight byte cap
+    so a burst of large pulls backpressures instead of blowing the store.
+    Admission is FIFO within a tier; one oversized pull is always admitted
+    when the manager is idle (progress guarantee)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.inflight_bytes = 0
+        self.inflight_count = 0
+        self._seq = 0
+        self._waiters: list[tuple] = []  # sorted (priority, seq, size, event)
+
+    def _admissible(self, size: int) -> bool:
+        if self.inflight_count == 0:
+            return True  # never deadlock on one object larger than the budget
+        return self.inflight_bytes + size <= self.budget
+
+    async def admit(self, object_id, size: int, priority: int):
+        if self._waiters or not self._admissible(size):
+            ev = asyncio.Event()
+            self._seq += 1
+            entry = (priority, self._seq, size, ev)
+            self._waiters.append(entry)
+            self._waiters.sort(key=lambda e: (e[0], e[1]))
+            while True:
+                await ev.wait()
+                ev.clear()
+                head = self._waiters[0] if self._waiters else None
+                if head is entry and self._admissible(size):
+                    self._waiters.pop(0)
+                    break
+                if head is not None and head is not entry:
+                    head[3].set()  # misdirected wakeup: forward to the head
+                # else: we're head but capacity is short — wait for a release
+        self.inflight_bytes += size
+        self.inflight_count += 1
+        # Chain-admit: room may remain for the next waiter.
+        if self._waiters and self._admissible(self._waiters[0][2]):
+            self._waiters[0][3].set()
+
+    def release(self, object_id, size: int):
+        self.inflight_bytes -= size
+        self.inflight_count -= 1
+        if self._waiters:
+            self._waiters[0][3].set()
+
+
 class ResourceManager:
     """Reference: LocalResourceManager + placement_group_resource_manager."""
 
@@ -177,6 +228,17 @@ class Raylet:
         # GCS after a GCS restart so the (non-persisted, owner-based) object
         # directory can be rebuilt from the nodes that actually hold the data.
         self._sealed_objects: dict[ObjectID, tuple[int, Any]] = {}
+        # Batched object-directory traffic: per-put GCS round trips dominated
+        # put cost on small hosts (reference: object directory updates are
+        # similarly async/batched via the ray_syncer). Ops keep their relative
+        # order (a free must not be applied before the report that precedes it,
+        # nor after a re-report that follows it); a seal+free pair inside one
+        # window cancels out only when the GCS never learned the object.
+        self._obj_ops: list = []  # ordered ("report", ...) | ("free", oid) | None
+        self._obj_pending_report: dict[ObjectID, int] = {}  # oid -> _obj_ops index
+        self._obj_known: set[ObjectID] = set()  # flushed to GCS, not yet freed
+        self._obj_flush_scheduled = False
+        self.pull_manager = PullManager(CONFIG.pull_budget_bytes)
         # pip runtime-env venvs (reference: runtime-env agent + env-keyed worker
         # pools, worker_pool.h:280): env key -> venv python path once built.
         self._venv_python: dict[str, str] = {}
@@ -315,6 +377,14 @@ class Raylet:
     async def _idle_reaper_loop(self):
         while not self._shutdown:
             await asyncio.sleep(10)
+            # Reclaim arena blocks of direct-path puts whose writer died between
+            # alloc and seal (no raylet create record exists for them).
+            srv = getattr(self.store, "_srv", None)
+            if srv is not None:
+                try:
+                    srv.reap_stale_allocated(60_000)
+                except Exception:
+                    pass
             now = time.monotonic()
             idle = [
                 w
@@ -1030,7 +1100,9 @@ class Raylet:
         handle.registered.set()
         conn.on_close(lambda c: self._on_worker_lost(handle))
         return {"node_id": self.node_id, "store_capacity": self.store.capacity,
-                "node_ip": self.node_ip}
+                "node_ip": self.node_ip,
+                # Native arenas support the workers' zero-RPC put/get fast path.
+                "store_arena": getattr(self.store, "_arena_name", None)}
 
     async def rpc_submit_task(self, conn, spec: dict):
         self.task_queue.append(spec)
@@ -1185,6 +1257,52 @@ class Raylet:
 
     # ------------------------------------------------------------------ RPC: object store
 
+    def _queue_object_report(self, object_id: ObjectID, size: int, owner):
+        self._obj_pending_report[object_id] = len(self._obj_ops)
+        self._obj_ops.append(("report", object_id, self.node_id, size, owner))
+        self._schedule_obj_flush()
+
+    def _queue_object_free(self, object_id: ObjectID):
+        idx = self._obj_pending_report.pop(object_id, None)
+        if idx is not None and object_id not in self._obj_known:
+            # Sealed and freed within one window AND never flushed before:
+            # the GCS never knew — both ops cancel.
+            self._obj_ops[idx] = None
+            return
+        self._obj_ops.append(("free", object_id))
+        self._schedule_obj_flush()
+
+    def _drain_obj_ops(self) -> list:
+        ops = [op for op in self._obj_ops if op is not None]
+        self._obj_ops.clear()
+        self._obj_pending_report.clear()
+        for op in ops:
+            if op[0] == "report":
+                self._obj_known.add(op[1])
+            else:
+                self._obj_known.discard(op[1])
+        return ops
+
+    def _schedule_obj_flush(self):
+        if self._obj_flush_scheduled:
+            return
+        self._obj_flush_scheduled = True
+
+        async def _flush():
+            await asyncio.sleep(CONFIG.object_report_flush_s)
+            self._obj_flush_scheduled = False
+            ops = self._drain_obj_ops()
+            if not ops:
+                return
+            try:
+                await self.gcs.notify("object_ops_batch", ops)
+            except Exception:
+                # GCS down/reconnecting: sealed objects are re-reported by the
+                # reconnect sync (sync_node_state); frees are best-effort.
+                pass
+
+        asyncio.get_running_loop().create_task(_flush())
+
     async def rpc_store_create(self, conn, object_id: ObjectID, size: int):
         # Off-loop: under memory pressure create() spills LRU objects to disk,
         # which must not stall scheduling/heartbeats/resolves on the event loop.
@@ -1194,32 +1312,43 @@ class Raylet:
     async def rpc_store_seal(self, conn, object_id: ObjectID, size: int, owner):
         self.store.seal(object_id)
         self._sealed_objects[object_id] = (size, owner)
-        try:
-            await self.gcs.call("report_object", object_id, self.node_id, size, owner)
-        except rpc.RpcError:
-            pass
+        self._queue_object_report(object_id, size, owner)
         return True
+
+    async def rpc_store_ops_batch(self, conn, ops: list):
+        """Batched worker store bookkeeping for the zero-RPC direct-arena data
+        plane: [("sealed", oid, size, owner) | ("free", oid)], in the order the
+        worker performed them. The store itself needs no action for "sealed"
+        (the worker sealed in shared memory); only location bookkeeping runs."""
+        for op in ops:
+            if op[0] == "sealed":
+                _, object_id, size, owner = op
+                self._sealed_objects[object_id] = (size, owner)
+                self._queue_object_report(object_id, size, owner)
+            else:
+                _, object_id = op
+                self.store.free(object_id, eager=True)
+                self._sealed_objects.pop(object_id, None)
+                self._queue_object_free(object_id)
 
     async def rpc_store_put_bytes(self, conn, object_id: ObjectID, data: bytes, owner):
         loop = asyncio.get_running_loop()
         name = await loop.run_in_executor(None, self.store.put_bytes, object_id, data)
         self._sealed_objects[object_id] = (len(data), owner)
-        try:
-            await self.gcs.call("report_object", object_id, self.node_id, len(data), owner)
-        except rpc.RpcError:
-            pass
+        self._queue_object_report(object_id, len(data), owner)
         return name
 
     async def rpc_store_info(self, conn, object_id: ObjectID):
         return self.store.info(object_id)
 
     async def rpc_store_free(self, conn, object_id: ObjectID):
-        self.store.free(object_id)
+        # The owner's refcount hit zero: no ObjectRef exists anywhere, so the
+        # payload can never be legally read again. Eager eviction returns the
+        # block to the freelist immediately (reuse keeps put pages warm) —
+        # pinned readers still defer the actual recycle to their release.
+        self.store.free(object_id, eager=True)
         self._sealed_objects.pop(object_id, None)
-        try:
-            await self.gcs.notify("free_object", object_id)
-        except rpc.RpcError:
-            pass
+        self._queue_object_free(object_id)
         return True
 
     async def rpc_evict_object(self, conn, object_id: ObjectID):
@@ -1230,7 +1359,13 @@ class Raylet:
     async def rpc_read_chunk(self, conn, object_id: ObjectID, offset: int, length: int):
         return self.store.read_bytes(object_id, offset, length)
 
-    async def rpc_resolve_object(self, conn, object_id: ObjectID, owner=None, timeout: float = 300.0):
+    async def rpc_store_stats(self, conn):
+        stats = self.store.stats()
+        stats["pull_inflight_bytes"] = self.pull_manager.inflight_bytes
+        return stats
+
+    async def rpc_resolve_object(self, conn, object_id: ObjectID, owner=None, timeout: float = 300.0,
+                                 priority: int = 1):
         """Ensure the object is readable on this node.
 
         Returns {"shm": (name, size)} for store objects or {"inline": bytes} fetched from
@@ -1239,6 +1374,7 @@ class Raylet:
         """
         deadline = time.monotonic() + timeout
         lost_polls = 0
+        unknown_polls = 0
         while True:
             info = self.store.info(object_id)
             if info is not None:
@@ -1248,8 +1384,10 @@ class Raylet:
                 await inflight
                 continue
             loc = None
+            got_loc = False
             try:
                 loc = await self.gcs.call("object_locations", object_id)
+                got_loc = True
             except rpc.RpcError:
                 pass
             if loc is not None and not loc["locations"]:
@@ -1262,11 +1400,22 @@ class Raylet:
                     return {"error": "lost"}
             else:
                 lost_polls = 0
+            if got_loc and loc is None:
+                # The directory has never heard of this object. Location reports
+                # are batched, so a fresh seal can be unknown for a window — but
+                # a persistently-unknown plasma object means its holder died
+                # before its report flushed. Declare it lost so the owner can
+                # rebuild from lineage instead of burning the resolve timeout.
+                unknown_polls += 1
+                if unknown_polls >= 25 and owner is not None:
+                    return {"error": "lost"}
+            else:
+                unknown_polls = 0
             if loc and loc["locations"]:
                 fut = asyncio.get_running_loop().create_future()
                 self._pulls_inflight[object_id] = fut
                 try:
-                    ok = await self._pull_object(object_id, loc)
+                    ok = await self._pull_object(object_id, loc, priority)
                 finally:
                     self._pulls_inflight.pop(object_id, None)
                     fut.set_result(None)
@@ -1304,8 +1453,21 @@ class Raylet:
             return reply["data"]
         return None
 
-    async def _pull_object(self, object_id: ObjectID, loc: dict) -> bool:
-        """Chunked pull from a remote node (reference: PullManager + ObjectBufferPool)."""
+    async def _pull_object(self, object_id: ObjectID, loc: dict,
+                           priority: int = 1) -> bool:
+        """Pull a remote object under the pull manager's byte budget
+        (reference: pull_manager.h:49 — prioritized admission with in-flight
+        byte caps so a burst of large pulls cannot exhaust the store)."""
+        await self.pull_manager.admit(object_id, loc["size"], priority)
+        try:
+            return await self._pull_object_now(object_id, loc)
+        finally:
+            self.pull_manager.release(object_id, loc["size"])
+
+    async def _pull_object_now(self, object_id: ObjectID, loc: dict) -> bool:
+        """Chunked-parallel pull from a remote node (reference: PullManager +
+        ObjectBufferPool chunked receives). A window of pipelined read_chunk
+        requests keeps the wire full instead of paying one RTT per chunk."""
         size = loc["size"]
         for location in loc["locations"]:
             if location["node_id"] == self.node_id:
@@ -1318,27 +1480,39 @@ class Raylet:
                 from ray_tpu._private.object_store import LocalObjectReader
 
                 chunk = CONFIG.object_store_min_chunk_bytes
-                offset = 0
+                window = max(1, CONFIG.pull_chunk_window)
                 reader = LocalObjectReader()
                 try:
                     buf = reader.read(shm_name, size)
-                    while offset < size:
-                        data = await peer.call(
-                            "read_chunk", object_id, offset, min(chunk, size - offset)
-                        )
-                        buf[offset : offset + len(data)] = data
-                        offset += len(data)
+                    sem = asyncio.Semaphore(window)
+
+                    async def fetch(off: int):
+                        ln = min(chunk, size - off)
+                        async with sem:
+                            data = await peer.call("read_chunk", object_id, off, ln)
+                        if not data or len(data) != ln:
+                            raise IOError(
+                                f"short chunk at {off}: {0 if not data else len(data)}"
+                                f"/{ln} of {object_id}"
+                            )
+                        buf[off : off + ln] = data
+
+                    # return_exceptions: every fetch settles before this line
+                    # passes, so a failed attempt never leaves orphan tasks
+                    # writing into the buffer during the next location's retry.
+                    results = await asyncio.gather(
+                        *[fetch(o) for o in range(0, size, chunk)],
+                        return_exceptions=True,
+                    )
+                    errs = [r for r in results if isinstance(r, BaseException)]
+                    if errs:
+                        raise errs[0]
                     del buf
                 finally:
                     reader.close()
                 self.store.seal(object_id)
                 self._sealed_objects[object_id] = (size, loc.get("owner"))
-                try:
-                    await self.gcs.call(
-                        "report_object", object_id, self.node_id, size, loc.get("owner")
-                    )
-                except rpc.RpcError:
-                    pass
+                self._queue_object_report(object_id, size, loc.get("owner"))
                 return True
             except Exception:
                 traceback.print_exc()
@@ -1563,6 +1737,15 @@ class Raylet:
 
     async def shutdown(self):
         self._shutdown = True
+        # Flush batched object-directory traffic: a clean shutdown must not
+        # strand seals/frees in the window (holders that die unreported are
+        # covered by the resolve path's unknown-object lost detection).
+        ops = self._drain_obj_ops()
+        if ops:
+            try:
+                await self.gcs.notify("object_ops_batch", ops)
+            except Exception:
+                pass
         for handle in list(self.workers.values()):
             if handle.kind != "driver":
                 await self._kill_worker(handle)

@@ -35,6 +35,7 @@ from ray_tpu.exceptions import (
 
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
+_MISS = object()  # local-arena fast-path miss sentinel
 
 
 def global_worker() -> "CoreWorker":
@@ -374,6 +375,13 @@ class CoreWorker:
         self.node_id: NodeID | None = None
         self.node_ip: str = "127.0.0.1"
         self._direct_bind_host: str = "127.0.0.1"
+        self._store_arena: str | None = None
+        self._store_ops: list[tuple] = []
+        self._store_ops_lock = threading.Lock()
+        self._store_ops_flushing = False
+        self._result_queues: dict[int, tuple] = {}  # id(conn) -> (conn, [payloads])
+        self._result_sending: set[int] = set()
+        self._result_lock = threading.Lock()
         self.job_id = job_id
         self.io = rpc.IoLoop(name=f"rtpu-io-{mode}")
         self.raylet: rpc.Connection | None = None
@@ -416,6 +424,11 @@ class CoreWorker:
         self._lease_lock = threading.Lock()
         self._streams: dict[TaskID, _StreamState] = {}  # owner side of streaming tasks
         self._task_executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-exec")
+        # Owner-pushed lease tasks run on ONE thread: the owner pipelines up to
+        # lease_worker_slots specs ahead so the wire never idles, but execution
+        # stays sequential per worker — a lease holds one resource slot
+        # (reference: a core worker executes one task at a time).
+        self._lease_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-lease")
         self._future_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rtpu-fut")
         self.actor_runtime: _ActorRuntime | None = None
         self.actor_id: ActorID | None = None
@@ -458,6 +471,12 @@ class CoreWorker:
             )
         )
         self.node_id = reply["node_id"]
+        # Native-store direct data plane: with the arena name in hand, put/get
+        # run entirely in shared memory (alloc/write/seal and lookup/read under
+        # the arena's process-shared mutex) — no raylet RPC on the hot path.
+        # Thin clients live on another host: the arena is unreachable for them.
+        if not self.remote_data_plane:
+            self._store_arena = reply.get("store_arena")
         node_ip = reply.get("node_ip", "127.0.0.1")
         # The IP peers may dial this worker's direct server on. Loopback when we
         # bound loopback-only, whatever the node advertises (compiled DAG driver
@@ -479,6 +498,10 @@ class CoreWorker:
 
     def disconnect(self):
         self._connected = False
+        try:
+            self._drain_store_ops_sync()
+        except Exception:
+            pass
         try:
             for conn in list(self._direct_actor.values()):
                 if conn is not None and not conn.closed:
@@ -582,10 +605,111 @@ class CoreWorker:
                 bytes(serialization.assemble(pickled, raw_buffers)), owner,
             )
             return
+        if self._store_arena is not None and self._put_direct(
+            object_id, pickled, raw_buffers, total, owner
+        ):
+            return
         shm_name = self.raylet_call("store_create", object_id, total)
         buf = self.reader.read(shm_name, total)
         serialization.write_parts(buf, pickled, raw_buffers)
         self.raylet_call("store_seal", object_id, total, owner)
+
+    def _put_direct(self, object_id: ObjectID, pickled, raw_buffers, total: int,
+                    owner: dict) -> bool:
+        """Allocate, write, and seal straight in the shared arena; the raylet
+        only learns about the sealed object via an async notify (location
+        tracking + GCS directory). Falls back to the RPC path (returns False)
+        when the arena is full — the raylet's create() spills LRU objects to
+        disk, which only it can orchestrate.
+
+        Reference: plasma clients memcpy into store-allocated buffers
+        (`object_buffer_pool.h:32`); here even create/seal skip the socket."""
+        from ray_tpu._private.object_store import _native_key
+
+        key = _native_key(object_id)
+        try:
+            arena = self.reader._arena(self._store_arena)
+        except Exception:
+            self._store_arena = None  # arena gone (store restarted): RPC path
+            return False
+        try:
+            off = arena.alloc(key, total)
+        except FileExistsError:
+            # Same id re-put (retry/reconstruction): if sealed it's already
+            # readable — re-notify bookkeeping; otherwise another writer is
+            # mid-put and the RPC path serializes against it.
+            if arena.lookup(key) is None:
+                return False
+            self._notify_sealed(object_id, total, owner)
+            return True
+        except KeyError:
+            return False
+        if off is None:
+            return False
+        buf = arena.read(off, total)
+        serialization.write_parts(buf, pickled, raw_buffers)
+        arena.seal(key)
+        self._notify_sealed(object_id, total, owner)
+        return True
+
+    def _notify_sealed(self, object_id: ObjectID, total: int, owner: dict):
+        # Fire-and-forget: the arena itself is the source of truth for local
+        # resolution; the notify only feeds the raylet's location bookkeeping
+        # and the GCS object directory (cross-node discovery).
+        self._queue_store_op(("sealed", object_id, total, owner))
+
+    def _queue_store_op(self, op: tuple):
+        """Batch store bookkeeping notifies (sealed/free): one IO-thread wakeup
+        and one frame per window instead of per object. Order is preserved —
+        seal-then-free of the same id must apply in order at the raylet."""
+        with self._store_ops_lock:
+            self._store_ops.append(op)
+            if self._store_ops_flushing:
+                return
+            self._store_ops_flushing = True
+        self.io.spawn(self._flush_store_ops())
+
+    async def _flush_store_ops(self):
+        await asyncio.sleep(CONFIG.object_report_flush_s / 2)
+        with self._store_ops_lock:
+            ops, self._store_ops = self._store_ops, []
+            self._store_ops_flushing = False
+        if ops and self.raylet is not None and not self.raylet.closed:
+            try:
+                await self.raylet.notify("store_ops_batch", ops)
+            except Exception:
+                pass
+
+    def _drain_store_ops_sync(self):
+        """Flush pending store ops before disconnect so frees/seals aren't lost."""
+        with self._store_ops_lock:
+            ops, self._store_ops = self._store_ops, []
+        if ops and self.raylet is not None and not self.raylet.closed:
+            try:
+                self.io.run(self.raylet.notify("store_ops_batch", ops))
+            except Exception:
+                pass
+
+    def _get_direct(self, object_id: ObjectID):
+        """Zero-RPC read of a locally-sealed object, or _MISS. The pinned view
+        keeps the payload alive while any deserialized alias exists."""
+        from ray_tpu._private.object_store import _native_key
+
+        key = _native_key(object_id)
+        try:
+            arena = self.reader._arena(self._store_arena)
+        except Exception:
+            self._store_arena = None  # arena unopenable: stop trying per-get
+            return _MISS
+        try:
+            found = arena.lookup(key)
+            if found is None:
+                return _MISS
+            off, size = found
+            buf = arena.read_pinned(key, off, size)
+        except Exception:
+            return _MISS  # evicted/spilled mid-read: resolve path re-locates
+        return serialization.loads(buf)
 
     def _read_remote_object(self, object_id: ObjectID, size: int) -> bytes:
         """Thin-client read: stream the object over RPC in store-chunk units."""
@@ -627,6 +751,16 @@ class CoreWorker:
         rec = self.memory_store.get(ref.id)
         if rec is not None and rec.resolved and not rec.in_plasma:
             return self._decode_inline(rec)
+        # Local-arena fast path: a direct (pinning) lookup in shared memory
+        # skips the resolve RPC entirely when the object lives on this node.
+        if self._store_arena is not None:
+            value = self._get_direct(ref.id)
+            if value is not _MISS:
+                if isinstance(value, RayTpuTaskError):
+                    raise value.as_instanceof_cause()
+                if isinstance(value, RayTpuError):
+                    raise value
+                return value
         # Plasma or borrowed: resolve via the raylet. "lost" (known object, zero live
         # copies) triggers lineage reconstruction: the owner re-runs the producing
         # task and the loop waits for the fresh copy to be sealed.
@@ -634,7 +768,7 @@ class CoreWorker:
         recon_next = 0.0  # owner requests dedupe internally; borrowers back off
         while True:
             remaining = max(0.0, hard_deadline - time.monotonic())
-            reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+            reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining, 0)
             if reply.get("error") == "lost":
                 # A rebuild may already have routed an (inline) error result back.
                 rec = self.memory_store.get(ref.id)
@@ -668,7 +802,7 @@ class CoreWorker:
             except rpc.RpcError:
                 # Stale location (freed/evicted between resolve and read): one
                 # re-resolve, mirroring the shared-memory branch below.
-                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining, 0)
                 if reply.get("error") or "shm" not in reply:
                     raise ObjectLostError(ref.id, f"failed to re-resolve {ref}")
                 _shm_name, size = reply["shm"]
@@ -687,7 +821,7 @@ class CoreWorker:
                 # Location went stale between resolve and read (the store spilled,
                 # evicted, or freed+unlinked the object); one re-resolve gets the
                 # new location. A second stale read means the object is gone.
-                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining)
+                reply = self.raylet_call("resolve_object", ref.id, ref.owner, remaining, 0)
                 if reply.get("error") or "shm" not in reply:
                     raise ObjectLostError(ref.id, f"failed to re-resolve {ref}")
                 shm_name, size = reply["shm"]
@@ -749,8 +883,22 @@ class CoreWorker:
         self.memory_store.pop(object_id)
         self._drop_lineage(object_id)
         if rec is not None and rec.in_plasma and self._connected:
+            # Direct-arena eviction first: the block returns to the freelist
+            # synchronously, so the next put reuses its (warm) pages instead of
+            # faulting fresh ones. Pinned readers defer recycle to release.
+            # The raylet notify keeps location bookkeeping + GCS in sync
+            # (its own store.free of the already-evicted key is a no-op).
+            if self._store_arena is not None:
+                from ray_tpu._private.object_store import _native_key
+
+                try:
+                    self.reader._arena(self._store_arena).free(
+                        _native_key(object_id), eager=True
+                    )
+                except Exception:
+                    pass
             try:
-                self.io.spawn(self.raylet.notify("store_free", object_id))
+                self._queue_store_op(("free", object_id))
             except Exception:
                 pass
 
@@ -1169,9 +1317,18 @@ class CoreWorker:
         self._lease_pump(shape)
 
     def _lease_pump(self, shape):
-        """Assign queued specs to idle leased workers; request more leases while
-        work outstrips them (one outstanding request per shape)."""
-        to_send, request = [], False
+        """Assign queued specs to leased workers with free pipeline slots;
+        request more leases while work outstrips them (one outstanding request
+        per shape).
+
+        Each worker takes up to lease_worker_slots in-flight tasks (reference:
+        the owner pipelines pushes ahead of completions so small tasks never
+        pay a full owner<->worker round trip between executions), and pushes
+        ride a per-worker send queue whose drainer packs everything accumulated
+        into one push_batch frame — a burst of .remote() calls coalesces into
+        a few frames instead of one frame (and one event-loop wakeup) per task."""
+        slots = max(1, CONFIG.lease_worker_slots)
+        to_wake, request = [], False
         with self._lease_lock:
             st = self._leases.get(shape)
             if st is None:
@@ -1179,26 +1336,41 @@ class CoreWorker:
             for w in st["workers"].values():
                 if not st["queue"]:
                     break
-                if w["spec"] is None and not w["conn"].closed:
+                if w["conn"].closed:
+                    continue
+                while st["queue"] and len(w["inflight"]) < slots:
                     spec = st["queue"].popleft()
-                    w["spec"] = spec
+                    spec["__direct__"] = True
+                    w["inflight"][spec["task_id"]] = spec
+                    w["sendq"].append(spec)
                     self._lease_inflight[spec["task_id"]] = (shape, w["worker_id"])
-                    to_send.append((w, spec))
+                if w["sendq"] and not w["sending"]:
+                    w["sending"] = True
+                    to_wake.append(w)
             if st["queue"] and not st["requesting"]:
                 st["requesting"] = True
                 request = True
-        for w, spec in to_send:
-            spec["__direct__"] = True
-
-            async def send(w=w, spec=spec):
-                try:
-                    await w["conn"].notify("push_task", spec)
-                except Exception:
-                    self._lease_worker_lost(shape, w["worker_id"], w["conn"])
-
-            self.io.spawn(send())
+        for w in to_wake:
+            self.io.spawn(self._lease_send_loop(shape, w))
         if request:
             self.io.spawn(self._lease_request(shape))
+
+    async def _lease_send_loop(self, shape, w):
+        """Drain the worker's send queue, one frame per accumulated batch."""
+        while True:
+            with self._lease_lock:
+                batch = list(w["sendq"])
+                w["sendq"].clear()
+                if not batch:
+                    w["sending"] = False
+                    return
+            try:
+                await w["conn"].notify("push_batch", batch)
+            except Exception:
+                with self._lease_lock:
+                    w["sending"] = False
+                self._lease_worker_lost(shape, w["worker_id"], w["conn"])
+                return
 
     async def _lease_request(self, shape):
         resources, env_key = dict(shape[0]), shape[1]
@@ -1231,7 +1403,8 @@ class CoreWorker:
             st["requesting"] = False
             if conn is not None:
                 wid = resp["worker_id"]
-                w = {"worker_id": wid, "conn": conn, "spec": None}
+                w = {"worker_id": wid, "conn": conn, "inflight": {},
+                     "sendq": deque(), "sending": False}
                 st["workers"][wid] = w
                 st["retries"] = 0
                 conn.on_close(lambda c: self._lease_worker_lost(shape, wid, c))
@@ -1268,7 +1441,7 @@ class CoreWorker:
             with self._lease_lock:
                 st = self._leases.get(shape)
                 w = st["workers"].get(resp["worker_id"]) if st else None
-                idle = w is not None and w["spec"] is None and (not st["queue"])
+                idle = w is not None and not w["inflight"] and (not st["queue"])
             if idle:
                 self._schedule_lease_release(shape, resp["worker_id"])
 
@@ -1282,7 +1455,7 @@ class CoreWorker:
                 if st is None:
                     return
                 w = st.get("workers", {}).get(wid)
-                if w is None or w["spec"] is not None or st["queue"]:
+                if w is None or w["inflight"] or st["queue"]:
                     return
                 st["workers"].pop(wid, None)
                 conn = w["conn"]
@@ -1302,14 +1475,14 @@ class CoreWorker:
                 return
             w = st["workers"].get(wid)
             if w is not None:
-                w["spec"] = None
-                if not st["queue"]:
+                w["inflight"].pop(task_id, None)
+                if not st["queue"] and not w["inflight"]:
                     self._schedule_lease_release(shape, wid)
         self._lease_pump(shape)
 
     def _lease_worker_lost(self, shape, wid, conn):
-        """A leased worker died: retry its in-flight task or fail it."""
-        respec = None
+        """A leased worker died: retry its in-flight tasks or fail them."""
+        failed = []
         with self._lease_lock:
             st = self._leases.get(shape)
             if st is None:
@@ -1317,29 +1490,30 @@ class CoreWorker:
             w = st["workers"].pop(wid, None)
             if w is None:
                 return
-            respec = w["spec"]
-            if respec is not None:
+            for respec in w["inflight"].values():
                 self._lease_inflight.pop(respec["task_id"], None)
                 if respec.get("retries_left", 0) > 0:
                     respec["retries_left"] -= 1
                     respec.pop("__direct__", None)
                     st["queue"].appendleft(respec)
-                    respec = None  # handled by requeue
-        if respec is not None:
+                else:
+                    failed.append(respec)
+        if failed:
             from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
 
             oom_cause = self._lease_oom.pop(wid, None)
-            if oom_cause is not None:
-                err_obj = OutOfMemoryError(
-                    f"task {respec.get('name')} failed: {oom_cause}"
-                )
-            else:
-                err_obj = WorkerCrashedError(
-                    f"task {respec.get('name')} failed: leased worker died during execution"
-                )
-            err = serialization.dumps(err_obj)
-            for oid in respec["return_ids"]:
-                self.memory_store.resolve(oid, err, True, False)
+            for respec in failed:
+                if oom_cause is not None:
+                    err_obj = OutOfMemoryError(
+                        f"task {respec.get('name')} failed: {oom_cause}"
+                    )
+                else:
+                    err_obj = WorkerCrashedError(
+                        f"task {respec.get('name')} failed: leased worker died during execution"
+                    )
+                err = serialization.dumps(err_obj)
+                for oid in respec["return_ids"]:
+                    self.memory_store.resolve(oid, err, True, False)
         self._lease_pump(shape)
 
     async def rpc_lease_oom(self, conn, payload):
@@ -1555,6 +1729,10 @@ class CoreWorker:
 
     # ------------------------------------------------------------------ RPC handlers (io thread)
 
+    async def rpc_task_results(self, conn, payloads: list):
+        for payload in payloads:
+            await self.rpc_task_result(conn, payload)
+
     async def rpc_task_result(self, conn, payload):
         with self._direct_lock:
             self._direct_inflight.pop(payload.get("task_id"), None)
@@ -1674,6 +1852,8 @@ class CoreWorker:
             spec["__reply_conn__"] = conn
         if spec["type"] == "actor_task":
             self._enqueue_actor_task(spec)
+        elif spec.get("__direct__"):
+            self._lease_executor.submit(self._execute_task_guarded, spec)
         else:
             self._task_executor.submit(self._execute_task_guarded, spec)
 
@@ -1872,13 +2052,42 @@ class CoreWorker:
             rconn = spec.pop("__reply_conn__", None)
             if rconn is not None and not rconn.closed:
                 # Leased direct task: results go straight to the owner; the
-                # raylet holds no per-task state for it.
-                self.io.spawn(
-                    rconn.notify("task_result",
-                                 {"task_id": spec["task_id"], "results": results})
+                # raylet holds no per-task state for it. Batched per
+                # connection — a burst of small-task completions coalesces
+                # into a few frames instead of one send per result.
+                self._queue_direct_result(
+                    rconn, {"task_id": spec["task_id"], "results": results}
                 )
             else:
                 self.io.spawn(self.raylet.notify("task_done", spec["task_id"], results))
+
+    def _queue_direct_result(self, rconn, payload: dict):
+        key = id(rconn)
+        with self._result_lock:
+            self._result_queues.setdefault(key, (rconn, []))[1].append(payload)
+            if key in self._result_sending:
+                return
+            self._result_sending.add(key)
+        self.io.spawn(self._result_send_loop(key))
+
+    async def _result_send_loop(self, key):
+        while True:
+            with self._result_lock:
+                entry = self._result_queues.get(key)
+                if entry is None or not entry[1]:
+                    self._result_sending.discard(key)
+                    self._result_queues.pop(key, None)
+                    return
+                rconn, pending = entry
+                batch = pending[:]
+                pending.clear()
+            try:
+                await rconn.notify("task_results", batch)
+            except Exception:
+                with self._result_lock:
+                    self._result_sending.discard(key)
+                    self._result_queues.pop(key, None)
+                return  # owner gone: its raylet re-routes or fails the tasks
 
     def _package_results(self, spec, result) -> list:
         num_returns = spec["num_returns"]
@@ -1901,10 +2110,8 @@ class CoreWorker:
     def _package_one(self, oid: ObjectID, value, owner: dict) -> dict:
         pickled, raw_buffers, total = serialization.serialized_size(value)
         if total > CONFIG.max_direct_call_object_size:
-            shm_name = self.raylet_call("store_create", oid, total)
-            buf = self.reader.read(shm_name, total)
-            serialization.write_parts(buf, pickled, raw_buffers)
-            self.raylet_call("store_seal", oid, total, owner)
+            # Rides the zero-RPC direct-arena path when available.
+            self._write_plasma(oid, pickled, raw_buffers, total, owner)
             return {"object_id": oid, "in_plasma": True, "size": total}
         return {"object_id": oid, "inline": serialization.assemble(pickled, raw_buffers)}
 
