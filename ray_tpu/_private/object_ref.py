@@ -64,7 +64,15 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
-        # Crossing a process boundary: the receiver registers a borrowed reference.
+        # Crossing a process boundary: the receiver registers a borrowed
+        # reference. When a task's results are being packaged, the executor
+        # captures every serialized ref so the reply can carry a sequenced
+        # borrow handoff to the caller (see ReferenceCounter docstring).
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        if w is not None:
+            w._note_serialized_ref(self.id, self.owner)
         return (_deserialize_ref, (self.id.binary(), self.owner))
 
     def __del__(self):

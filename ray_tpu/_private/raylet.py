@@ -1109,7 +1109,8 @@ class Raylet:
         self._sched_wakeup.set()
         return True
 
-    async def rpc_task_done(self, conn, task_id, results: list, resources_released=True):
+    async def rpc_task_done(self, conn, task_id, results: list, extra: dict | None = None,
+                            resources_released=True):
         spec = self.running.pop(task_id, None)
         handle = None
         for w in self.workers.values():
@@ -1124,13 +1125,14 @@ class Raylet:
             handle.last_idle = time.monotonic()
             self._sched_wakeup.set()
         if spec is not None:
-            await self._route_results_to_owner(spec, results)
+            await self._route_results_to_owner(spec, results, extra)
             await self._settle_delegation(spec)
         return True
 
-    async def _route_results_to_owner(self, spec: dict, results: list):
+    async def _route_results_to_owner(self, spec: dict, results: list,
+                                      extra: dict | None = None):
         owner = spec["owner"]
-        payload = {"task_id": spec["task_id"], "results": results}
+        payload = {"task_id": spec["task_id"], "results": results, **(extra or {})}
         await self._route_to_worker(owner["node_id"], owner["worker_id"], "task_result", payload)
 
     async def _route_to_worker(self, node_id: NodeID, worker_id: WorkerID, method: str, payload):
@@ -1247,13 +1249,39 @@ class Raylet:
         )
         return True
 
-    async def rpc_report_borrow(self, conn, object_id: ObjectID, owner: dict, delta: int):
-        """Forward a borrower's ref registration/release to the owning worker."""
+    async def rpc_report_borrow(self, conn, object_id: ObjectID, owner: dict, delta: int,
+                                borrower=None):
+        """Forward a borrower's ref registration/release to the parent worker."""
         await self._route_to_worker(
             owner["node_id"], owner["worker_id"], "borrow_update",
-            {"object_id": object_id, "delta": delta},
+            {"object_id": object_id, "delta": delta, "borrower": borrower},
         )
         return True
+
+    async def rpc_check_worker_alive(self, conn, node_hex: str, worker_hex: str):
+        """Borrow-audit probe: is the given worker's process still alive?
+        Local workers are checked directly; remote ones through their raylet.
+        Unknown nodes (dead per the GCS view) report not-alive."""
+        if node_hex == self.node_id.hex():
+            for wid, handle in self.workers.items():
+                if wid.hex() == worker_hex:
+                    return handle.alive
+            return False
+        target = None
+        for nid, view in self.node_view.items():
+            if nid.hex() == node_hex:
+                target = nid
+                break
+        if target is None:
+            return False  # node gone from the cluster view
+        peer = await self._peer(target)
+        if peer is None:
+            return False
+        try:
+            return await peer.call("check_worker_alive", node_hex, worker_hex,
+                                   timeout=5.0)
+        except Exception:
+            return False
 
     # ------------------------------------------------------------------ RPC: object store
 
@@ -1660,7 +1688,8 @@ class Raylet:
             )
         await self._settle_delegation(spec)
 
-    async def rpc_actor_task_done(self, conn, spec_owner, task_id, results):
+    async def rpc_actor_task_done(self, conn, spec_owner, task_id, results,
+                                  extra: dict | None = None):
         """Actor worker finished a method call; route results to owner."""
         spec = None
         for w in self.workers.values():
@@ -1671,7 +1700,7 @@ class Raylet:
             spec_owner["node_id"],
             spec_owner["worker_id"],
             "task_result",
-            {"task_id": task_id, "results": results},
+            {"task_id": task_id, "results": results, **(extra or {})},
         )
         if spec is not None:
             await self._settle_delegation(spec)

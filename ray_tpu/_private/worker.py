@@ -38,6 +38,11 @@ _global_lock = threading.Lock()
 _MISS = object()  # local-arena fast-path miss sentinel
 
 
+def _addr_key(addr: dict) -> tuple:
+    """Hashable identity of a worker address (borrower bookkeeping)."""
+    return (addr["node_id"].hex(), addr["worker_id"].hex())
+
+
 def global_worker() -> "CoreWorker":
     if _global_worker is None:
         raise RuntimeError("ray_tpu.init() has not been called")
@@ -130,24 +135,52 @@ class MemoryStore:
 
 
 class ReferenceCounter:
-    """Distributed reference counts: local refs + borrower reports back to the owner.
+    """Distributed reference counts with a sequenced borrowing protocol.
 
-    Reference: `src/ray/core_worker/reference_counter.h`. The owner frees an object
-    cluster-wide only when (a) its own local count is zero AND (b) every borrower that
-    reported a borrow has reported releasing it. Borrowers register on ObjectRef
-    deserialization (first local ref to a foreign-owned id) and report the release when
-    their last local ref dies. A borrower that crashes without reporting leaks its count;
-    lineage reconstruction makes premature frees recoverable, crashes are bounded by the
-    borrowing process's raylet failing its in-flight work (divergence noted in
-    docs/divergences.md).
+    Reference: `src/ray/core_worker/reference_counter.h` — the owner frees an
+    object cluster-wide only when (a) its own local count is zero AND (b) every
+    registered borrower has released.
+
+    Borrow registration is SEQUENCED through the task protocol, never a bare
+    fire-and-forget racing the owner's release:
+
+    - **Task args**: while a task executes, its borrowed arg refs are protected
+      by the caller's arg pins, so the executor defers registration entirely
+      (a per-task borrow sink). Refs still held at completion ride the reply's
+      `borrows` list; the caller records the executor as a borrower BEFORE it
+      releases those pins (same message, strict order). The executor's later
+      release routes to the caller (its borrow parent), forming the reference's
+      borrower tree rather than a flat owner-centric count.
+    - **Result refs**: refs serialized into a task's results are captured at
+      pickle time; the executor pre-registers the caller as a sub-borrower
+      before replying and the reply's `result_refs` pre-seed the caller's
+      parent table, so the caller's first local ref never emits a racing +1
+      and its release routes back to the executor.
+    - Refs that arrive outside the task protocol (inside a put object) keep
+      the legacy immediate report as a best-effort fallback.
+
+    Borrower counts are keyed per borrower address; an audit loop drops
+    borrowers whose process died without releasing (raylet/GCS death signals +
+    direct pings), so crashes reconcile instead of leaking the object.
     """
 
     def __init__(self, worker: "CoreWorker"):
         self._counts: dict[ObjectID, int] = {}
         self._owned: set[ObjectID] = set()
-        self._borrows: dict[ObjectID, int] = {}  # owned id -> outstanding borrower refs
-        self._borrowed_owner: dict[ObjectID, dict] = {}  # borrowed id -> owner address
+        # id -> {borrower_key: count}; for owned ids these are direct borrowers,
+        # for borrowed ids they are sub-borrowers this process handed refs to.
+        self._borrows: dict[ObjectID, dict[str, int]] = {}
+        self._borrowed_owner: dict[ObjectID, dict] = {}  # borrowed id -> PARENT address
         self._pending_free: set[ObjectID] = set()  # local zero, waiting on borrowers
+        # Borrowed ids whose local count hit zero while sub-borrowers remain:
+        # the upstream release is deferred until they drain.
+        self._pending_upstream: set[ObjectID] = set()
+        # Borrowed ids registered via the sequenced paths that have not yet
+        # taken a local ref (pre-seeded by result_refs): the first local ref
+        # must not emit the legacy racing report.
+        self._preregistered: set[ObjectID] = set()
+        # Ids first borrowed inside the currently-executing task (deferred).
+        self._task_deferred: set[ObjectID] = set()
         self._lock = threading.Lock()
         self._worker = worker
         # GC-safety: __del__ may fire via garbage collection INSIDE a section
@@ -182,20 +215,43 @@ class ReferenceCounter:
 
     def add_local_ref(self, object_id: ObjectID, owner: dict | None = None):
         report_to = None
+        materialized = False
         with self._lock:
             n = self._counts.get(object_id, 0)
             self._counts[object_id] = n + 1
             self._pending_free.discard(object_id)  # re-acquired before borrowers drained
+            self._pending_upstream.discard(object_id)
             if (
                 n == 0
                 and owner is not None
                 and object_id not in self._owned
-                and object_id not in self._borrowed_owner
                 and owner.get("worker_id") is not None
                 and owner["worker_id"] != self._worker.worker_id
             ):
-                self._borrowed_owner[object_id] = owner
-                report_to = owner
+                if object_id in self._preregistered:
+                    # Sequenced handoff (result_refs): parent already seeded,
+                    # parent already counted us — no report. The materialized
+                    # note runs after this lock drops (lock order: never take
+                    # _embedded_lock under rc._lock — _settle_embedded_on_free
+                    # holds them in the opposite order).
+                    self._preregistered.discard(object_id)
+                    materialized = True
+                elif object_id not in self._borrowed_owner:
+                    sink = self._worker._task_borrow_sink()
+                    if sink is not None:
+                        # Executing a task: the caller's arg pins protect the
+                        # object until completion; registration (if the ref
+                        # survives the task) rides the reply, sequenced.
+                        sink[object_id] = owner
+                        self._borrowed_owner[object_id] = owner
+                        self._task_deferred.add(object_id)
+                    else:
+                        # Outside the task protocol (ref inside a put object):
+                        # legacy immediate report, best effort.
+                        self._borrowed_owner[object_id] = owner
+                        report_to = owner
+        if materialized:
+            self._worker._note_embedded_materialized(object_id)
         if report_to is not None:
             self._worker._report_borrow(object_id, report_to, +1)
 
@@ -208,9 +264,31 @@ class ReferenceCounter:
                 self._counts[object_id] = n
             else:
                 self._counts.pop(object_id, None)
-                report_to = self._borrowed_owner.pop(object_id, None)
-                if report_to is None and object_id in self._owned:
-                    if self._borrows.get(object_id, 0) > 0:
+                if object_id in self._task_deferred:
+                    if self._borrow_total_locked(object_id) > 0:
+                        # A sub-borrower registered with us mid-task (we handed
+                        # the ref onward): we must stay in the chain — the
+                        # reply handoff re-parents us to the caller and lists
+                        # the id in `borrows`.
+                        self._task_deferred.discard(object_id)
+                        self._pending_upstream.add(object_id)
+                    else:
+                        # Dropped before the task finished: registration never
+                        # happened anywhere, so nothing to report.
+                        self._task_deferred.discard(object_id)
+                        self._borrowed_owner.pop(object_id, None)
+                        sink = self._worker._task_borrow_sink()
+                        if sink is not None:
+                            sink.pop(object_id, None)
+                elif object_id in self._borrowed_owner:
+                    if self._borrow_total_locked(object_id) > 0:
+                        # Sub-borrowers still hold refs we handed out: the
+                        # upstream release waits for them.
+                        self._pending_upstream.add(object_id)
+                    else:
+                        report_to = self._borrowed_owner.pop(object_id)
+                elif object_id in self._owned:
+                    if self._borrow_total_locked(object_id) > 0:
                         self._pending_free.add(object_id)
                     else:
                         self._owned.discard(object_id)
@@ -220,15 +298,108 @@ class ReferenceCounter:
         if free:
             self._worker._free_owned_object(object_id)
 
-    def update_borrow(self, object_id: ObjectID, delta: int):
-        """Owner side: a borrower registered (+1) or released (-1) the object."""
-        free = False
+    def _borrow_total_locked(self, object_id: ObjectID) -> int:
+        # Negative entries are pending releases whose registration is still in
+        # flight (see _apply_borrow): they hold nothing alive.
+        return sum(v for v in self._borrows.get(object_id, {}).values() if v > 0)
+
+    def add_sub_borrow(self, object_id: ObjectID, borrower_key: str):
+        """Count a downstream borrower BEFORE the message that informs it is
+        sent (the sequencing that makes the handoff race-free)."""
         with self._lock:
-            n = self._borrows.get(object_id, 0) + delta
-            if n > 0:
-                self._borrows[object_id] = n
+            per = self._borrows.setdefault(object_id, {})
+            per[borrower_key] = per.get(borrower_key, 0) + 1
+
+    def pre_register_borrow(self, object_id: ObjectID, parent: dict):
+        """Caller side of a result-ref handoff: seed the parent so the first
+        local ref neither re-reports nor routes its release to the raw owner."""
+        with self._lock:
+            if (
+                object_id in self._owned
+                or object_id in self._borrowed_owner
+                or parent.get("worker_id") == self._worker.worker_id
+            ):
+                return False
+            self._borrowed_owner[object_id] = parent
+            self._preregistered.add(object_id)
+            return True
+
+    def settle_unmaterialized(self, object_id: ObjectID) -> dict | None:
+        """A reply's embedded ref was never deserialized and its containing
+        result is gone: undo the pre-registration; returns the parent to
+        release to (the executor pre-counted us)."""
+        with self._lock:
+            if object_id not in self._preregistered:
+                return None
+            self._preregistered.discard(object_id)
+            return self._borrowed_owner.pop(object_id, None)
+
+    def promote_task_borrows(self, kept: dict, parent: dict):
+        """Executor side at task completion: arg borrows that survived the task
+        re-parent to the caller (whose reply-side registration is sequenced
+        ahead of its pin release)."""
+        with self._lock:
+            for object_id in kept:
+                if object_id in self._task_deferred:
+                    self._task_deferred.discard(object_id)
+                    self._borrowed_owner[object_id] = parent
+                elif (
+                    object_id in self._pending_upstream
+                    and object_id in self._borrowed_owner
+                ):
+                    # Held only by sub-borrowers now: re-route the eventual
+                    # upstream release to the caller, who counts us via the
+                    # reply's `borrows` list.
+                    self._borrowed_owner[object_id] = parent
+
+    def promote_captured(self, object_ids, parent: dict) -> list:
+        """Deferred arg borrows captured into a task's results: re-parent to
+        the caller immediately (their only local ref may die with the frame)
+        and return those promoted, for the reply's `borrows` list."""
+        promoted = []
+        with self._lock:
+            for object_id in object_ids:
+                if object_id in self._task_deferred:
+                    self._task_deferred.discard(object_id)
+                    self._borrowed_owner[object_id] = parent
+                    promoted.append(object_id)
+        return promoted
+
+    def update_borrow(self, object_id: ObjectID, delta: int,
+                      borrower_key: str = "?"):
+        """Parent side: a borrower registered (+1) or released (-1)."""
+        self._apply_borrow(object_id, delta, borrower_key)
+
+    def drop_borrower(self, borrower_key: str):
+        """A borrower process died without releasing: reconcile its counts."""
+        with self._lock:
+            stale = [
+                oid for oid, per in self._borrows.items() if borrower_key in per
+            ]
+        for oid in stale:
+            self._apply_borrow(oid, None, borrower_key)
+
+    def _apply_borrow(self, object_id: ObjectID, delta, borrower_key: str):
+        free = False
+        report_to = None
+        with self._lock:
+            per = self._borrows.setdefault(object_id, {})
+            if delta is None:
+                per.pop(borrower_key, None)  # borrower died: drop all its refs
             else:
-                self._borrows.pop(object_id, None)
+                # A release may arrive BEFORE its matching registration when the
+                # two ride different channels (reply-borne +1 vs raylet-routed
+                # -1): keep the negative entry as a pending release so the late
+                # +1 nets to zero instead of resurrecting a count nobody will
+                # ever release.
+                n = per.get(borrower_key, 0) + delta
+                if n == 0:
+                    per.pop(borrower_key, None)
+                else:
+                    per[borrower_key] = n
+            if not any(v > 0 for v in per.values()):
+                if not per:
+                    self._borrows.pop(object_id, None)
                 if (
                     object_id in self._pending_free
                     and self._counts.get(object_id, 0) <= 0
@@ -237,8 +408,25 @@ class ReferenceCounter:
                     self._pending_free.discard(object_id)
                     self._owned.discard(object_id)
                     free = True
+                elif (
+                    object_id in self._pending_upstream
+                    and self._counts.get(object_id, 0) <= 0
+                ):
+                    self._pending_upstream.discard(object_id)
+                    report_to = self._borrowed_owner.pop(object_id, None)
+        if report_to is not None:
+            self._worker._report_borrow(object_id, report_to, -1)
         if free:
             self._worker._free_owned_object(object_id)
+
+    def borrower_snapshot(self) -> dict[str, list[ObjectID]]:
+        """borrower_key -> ids it holds (for the crash-audit loop)."""
+        with self._lock:
+            out: dict[str, list[ObjectID]] = {}
+            for oid, per in self._borrows.items():
+                for key in per:
+                    out.setdefault(key, []).append(oid)
+            return out
 
     def num_refs(self, object_id: ObjectID) -> int:
         with self._lock:
@@ -246,7 +434,7 @@ class ReferenceCounter:
 
     def num_borrows(self, object_id: ObjectID) -> int:
         with self._lock:
-            return self._borrows.get(object_id, 0)
+            return self._borrow_total_locked(object_id)
 
 
 class _StreamState:
@@ -382,6 +570,11 @@ class CoreWorker:
         self._result_queues: dict[int, tuple] = {}  # id(conn) -> (conn, [payloads])
         self._result_sending: set[int] = set()
         self._result_lock = threading.Lock()
+        # Sequenced borrow handoffs embedded in task replies (see
+        # ReferenceCounter docstring): task_id -> {refs, returns, src}.
+        self._reply_embedded: dict = {}
+        self._embedded_materialized: set[ObjectID] = set()
+        self._embedded_lock = threading.Lock()
         self.job_id = job_id
         self.io = rpc.IoLoop(name=f"rtpu-io-{mode}")
         self.raylet: rpc.Connection | None = None
@@ -494,6 +687,7 @@ class CoreWorker:
             self.job_id = self.io.run(self.gcs.call("next_job_id"))
         self._connected = True
         self.io.spawn(self._event_flush_loop())
+        self.io.spawn(self._borrow_audit_loop())
         return self
 
     def disconnect(self):
@@ -882,6 +1076,7 @@ class CoreWorker:
         rec = self.memory_store.get(object_id)
         self.memory_store.pop(object_id)
         self._drop_lineage(object_id)
+        self._settle_embedded_on_free(object_id)
         if rec is not None and rec.in_plasma and self._connected:
             # Direct-arena eviction first: the block returns to the freelist
             # synchronously, so the next put reuses its (warm) pages instead of
@@ -905,10 +1100,129 @@ class CoreWorker:
     def _report_borrow(self, object_id: ObjectID, owner: dict, delta: int):
         if not self._connected or self.raylet is None:
             return
+
+        async def _send():
+            delay = CONFIG.test_delay_borrow_report_ms
+            if delay:  # fault injection: stress the reorder the sequenced
+                await asyncio.sleep(delay / 1000)  # protocol must be immune to
+            await self.raylet.notify(
+                "report_borrow", object_id, owner, delta,
+                _addr_key(self._owner_address()),
+            )
+
         try:
-            self.io.spawn(self.raylet.notify("report_borrow", object_id, owner, delta))
+            self.io.spawn(_send())
         except Exception:
             pass
+
+    # ---------------------------------------------------- sequenced borrowing
+
+    def _task_borrow_sink(self) -> dict | None:
+        """The per-task borrow sink of the calling thread, if it is executing
+        a task (executors defer borrow registration to the reply)."""
+        return getattr(self._tls, "borrow_sink", None)
+
+    def _note_serialized_ref(self, object_id: ObjectID, owner: dict | None):
+        """ObjectRef.__reduce__ hook: capture refs pickled into task results."""
+        cap = getattr(self._tls, "ref_capture", None)
+        if cap is not None and owner is not None:
+            cap.append((object_id, owner))
+
+    def _note_embedded_materialized(self, object_id: ObjectID):
+        """A pre-seeded result ref took its first local ref: its release now
+        rides the normal borrow lifecycle, not the unmaterialized settle."""
+        with self._embedded_lock:
+            self._embedded_materialized.add(object_id)
+
+    def _register_reply_embeds(self, payload: dict):
+        """Caller side, BEFORE arg-pin release: absorb the reply's sequenced
+        borrow handoffs."""
+        src = payload.get("src")
+        if src is None:
+            return
+        src_key = _addr_key(src)
+        for oid in payload.get("borrows", ()):
+            # The executor kept a borrowed arg ref beyond the task: count it
+            # before releasing our pins (we are its borrow parent now).
+            self.reference_counter.update_borrow(oid, +1, src_key)
+        embeds = payload.get("result_refs") or ()
+        pending = []
+        for oid, _owner in embeds:
+            if self.reference_counter.pre_register_borrow(oid, src):
+                pending.append(oid)
+            else:
+                # We already own or borrow this id: the executor's pre-count
+                # for us is unneeded — release it immediately (our existing
+                # ref keeps the object alive through our own lifecycle).
+                self._report_borrow(oid, src, -1)
+        if pending:
+            # Only returns still alive can carry the embedded refs to user
+            # code; if every return was already dropped (fire-and-forget
+            # submission), settle straight away.
+            returns = {
+                r["object_id"] for r in payload.get("results", ())
+                if self.memory_store.get(r["object_id"]) is not None
+            }
+            if returns:
+                with self._embedded_lock:
+                    self._reply_embedded[payload["task_id"]] = {
+                        "refs": pending, "returns": returns, "src": src,
+                    }
+            else:
+                for oid in pending:
+                    parent = self.reference_counter.settle_unmaterialized(oid)
+                    if parent is not None:
+                        self._report_borrow(oid, parent, -1)
+
+    def _settle_embedded_on_free(self, freed_oid: ObjectID):
+        """A result record was freed: embedded refs never materialized release
+        back to the executor that pre-counted us."""
+        if not self._reply_embedded:
+            return
+        candidates = []
+        with self._embedded_lock:
+            for task_id, entry in list(self._reply_embedded.items()):
+                entry["returns"].discard(freed_oid)
+                if entry["returns"]:
+                    continue
+                del self._reply_embedded[task_id]
+                for oid in entry["refs"]:
+                    if oid in self._embedded_materialized:
+                        self._embedded_materialized.discard(oid)
+                        continue
+                    candidates.append(oid)
+        # settle outside _embedded_lock: it takes the rc lock, and add_local_ref
+        # orders rc._lock -> (after release) _embedded_lock.
+        for oid in candidates:
+            parent = self.reference_counter.settle_unmaterialized(oid)
+            if parent is not None:
+                self._report_borrow(oid, parent, -1)
+
+    async def _borrow_audit_loop(self):
+        """Reconcile borrowers that died without releasing: ping each borrower
+        address; persistent unreachability drops its counts (reference:
+        reference_counter subscribes to borrower death via the raylet)."""
+        failures: dict[str, int] = {}
+        while self._connected:
+            await asyncio.sleep(CONFIG.borrow_audit_interval_s)
+            snapshot = self.reference_counter.borrower_snapshot()
+            for key in snapshot:
+                node_hex, worker_hex = key
+                if node_hex == "?":
+                    continue  # legacy unkeyed entry: no address to audit
+                try:
+                    alive = await self.raylet.call(
+                        "check_worker_alive", node_hex, worker_hex, timeout=10.0
+                    )
+                except Exception:
+                    continue  # raylet unreachable: no verdict this round
+                if alive:
+                    failures.pop(key, None)
+                    continue
+                failures[key] = failures.get(key, 0) + 1
+                if failures[key] >= 2:  # two strikes: not a transient blip
+                    failures.pop(key, None)
+                    self.reference_counter.drop_borrower(key)
 
     # ------------------------------------------------------------------ lineage
 
@@ -1737,6 +2051,11 @@ class CoreWorker:
         with self._direct_lock:
             self._direct_inflight.pop(payload.get("task_id"), None)
         self._lease_task_finished(payload.get("task_id"))
+        # Sequenced borrow handoff: the executor's kept borrows and result-ref
+        # pre-registrations MUST be absorbed before the arg pins release — same
+        # message, strict order, no reorder window (the race the round-1
+        # fire-and-forget registration admitted).
+        self._register_reply_embeds(payload)
         promoted = self._pending_promoted.pop(payload.get("task_id"), None)
         if promoted:
             for oid in promoted:
@@ -1806,7 +2125,10 @@ class CoreWorker:
         return True
 
     async def rpc_borrow_update(self, conn, payload):
-        self.reference_counter.update_borrow(payload["object_id"], payload["delta"])
+        self.reference_counter.update_borrow(
+            payload["object_id"], payload["delta"],
+            tuple(payload.get("borrower") or ("?", "?")),
+        )
         return True
 
     async def rpc_reconstruct_object(self, conn, payload):
@@ -1962,9 +2284,23 @@ class CoreWorker:
         rt = self.actor_runtime
         async with rt.semaphore:
             method = self._resolve_actor_method(rt.instance, spec["method_name"])
+            # The sink outlives the materializer thread: refs the async method
+            # keeps past completion ride the reply's sequenced handoff exactly
+            # like sync tasks (packaging and handoff are synchronous sections
+            # on the loop thread, so their thread-locals cannot interleave).
+            sink: dict = {}
+
+            def _materialize_sinked():
+                self._tls.borrow_sink = sink
+                try:
+                    return self._materialize_args(spec)
+                finally:
+                    self._tls.borrow_sink = None
+
+            args = kwargs = result = None
             try:
                 args, kwargs = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: self._materialize_args(spec)
+                    None, _materialize_sinked
                 )
                 result = method(*args, **kwargs)
                 if asyncio.iscoroutine(result):
@@ -1982,20 +2318,24 @@ class CoreWorker:
                     results = []
                 else:
                     results = self._package_error(spec, e)
-            self._reply_actor_result(spec, results)
+            args = kwargs = result = None  # noqa: F841 — drop frame refs first
+            self.reference_counter.drain_deferred()
+            self._reply_actor_result(spec, results, self._borrow_handoff(spec, sink))
 
-    def _reply_actor_result(self, spec, results):
+    def _reply_actor_result(self, spec, results, extra: dict | None = None):
         """Route actor-call results: straight back over the owner's direct
         connection when the call arrived on one, else via the raylet."""
+        extra = extra or {}
         rconn = spec.pop("__reply_conn__", None)
         if rconn is not None and not rconn.closed:
             self.io.spawn(
                 rconn.notify("task_result",
-                             {"task_id": spec["task_id"], "results": results})
+                             {"task_id": spec["task_id"], "results": results, **extra})
             )
             return
         self.io.spawn(
-            self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"], results)
+            self.raylet.notify("actor_task_done", spec["owner"], spec["task_id"],
+                               results, extra)
         )
 
     def _execute_task_guarded(self, spec):
@@ -2008,7 +2348,11 @@ class CoreWorker:
         from ray_tpu.util import tracing
 
         prev_task = getattr(self._tls, "task_id", None)
+        prev_sink = getattr(self._tls, "borrow_sink", None)
         self._tls.task_id = spec["task_id"]
+        # Borrowed refs first seen during this task defer registration to the
+        # reply (the caller's arg pins protect them meanwhile).
+        self._tls.borrow_sink = {}
         trace_token = tracing.activate(spec.get("trace_ctx"))
         self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state="RUNNING",
                            **tracing.event_fields(spec.get("trace_ctx")))
@@ -2042,12 +2386,22 @@ class CoreWorker:
                 results = self._package_error(spec, e)
             state = "FAILED"
         finally:
+            # Drop the frame's own arg/result refs and apply their deferred
+            # releases BEFORE snapshotting the sink: `kept` must mean "the task
+            # body stored the ref somewhere", not "the executing frame hasn't
+            # exited yet" — otherwise every borrowed arg ships a useless
+            # +1/-1 pair per call.
+            args = kwargs = result = None  # noqa: F841
+            self.reference_counter.drain_deferred()
+            sink = getattr(self._tls, "borrow_sink", None) or {}
             self._tls.task_id = prev_task
+            self._tls.borrow_sink = prev_sink
             tracing.deactivate(trace_token)
+        extra = self._borrow_handoff(spec, sink)
         self._record_event(task_id=spec["task_id"].hex(), name=spec["name"], state=state,
                            **tracing.event_fields(spec.get("trace_ctx")))
         if spec["type"] == "actor_task":
-            self._reply_actor_result(spec, results)
+            self._reply_actor_result(spec, results, extra)
         else:
             rconn = spec.pop("__reply_conn__", None)
             if rconn is not None and not rconn.closed:
@@ -2056,10 +2410,48 @@ class CoreWorker:
                 # connection — a burst of small-task completions coalesces
                 # into a few frames instead of one send per result.
                 self._queue_direct_result(
-                    rconn, {"task_id": spec["task_id"], "results": results}
+                    rconn, {"task_id": spec["task_id"], "results": results, **extra}
                 )
             else:
-                self.io.spawn(self.raylet.notify("task_done", spec["task_id"], results))
+                self.io.spawn(self.raylet.notify(
+                    "task_done", spec["task_id"], results, extra
+                ))
+
+    def _borrow_handoff(self, spec, sink: dict) -> dict:
+        """Build the reply's sequenced borrow metadata (see ReferenceCounter).
+
+        - `borrows`: borrowed arg refs this executor still holds; the caller
+          counts us as borrower before releasing its arg pins.
+        - `result_refs`: refs pickled into the results; we pre-count the caller
+          as sub-borrower HERE, before the reply leaves, so its first local ref
+          is already covered whenever it lands.
+        """
+        caller = spec.get("owner")
+        if caller is None:
+            return {}
+        kept = {
+            oid: owner for oid, owner in sink.items()
+            if self.reference_counter.num_refs(oid) > 0
+            or self.reference_counter.num_borrows(oid) > 0
+        }
+        if kept:
+            self.reference_counter.promote_task_borrows(kept, caller)
+        # The sub-borrows were pre-counted at capture time (_package_results);
+        # the reply only needs the lists. captured_kept = borrowed args that
+        # were returned in the results (promoted at capture, must be in
+        # `borrows` even though the frame dropped their last local ref).
+        result_refs = list(getattr(self._tls, "result_refs", None) or ())
+        captured_kept = list(getattr(self._tls, "captured_kept", None) or ())
+        self._tls.result_refs = None
+        self._tls.captured_kept = None
+        borrows = list({*kept.keys(), *captured_kept})
+        if not borrows and not result_refs:
+            return {}
+        return {
+            "borrows": borrows,
+            "result_refs": result_refs,
+            "src": self._owner_address(),
+        }
 
     def _queue_direct_result(self, rconn, payload: dict):
         key = id(rconn)
@@ -2102,10 +2494,32 @@ class CoreWorker:
                     f"task {spec['name']} declared num_returns={num_returns} "
                     f"but returned {len(values)} values"
                 )
-        return [
-            self._package_one(oid, value, spec["owner"])
-            for oid, value in zip(spec["return_ids"], values)
-        ]
+        # Capture refs pickled into the results: the reply hands the caller a
+        # sequenced borrow on each (see _borrow_handoff).
+        self._tls.ref_capture = cap = []
+        try:
+            packaged = [
+                self._package_one(oid, value, spec["owner"])
+                for oid, value in zip(spec["return_ids"], values)
+            ]
+        finally:
+            self._tls.ref_capture = None
+        caller = spec.get("owner")
+        if caller is not None:
+            # Pre-count the caller RIGHT HERE, while the executing frame still
+            # holds its own refs: the frame's refs drop (and may free) before
+            # the reply is built, and the sub-borrow must already be in place.
+            caller_key = _addr_key(caller)
+            for oid, _owner in cap:
+                self.reference_counter.add_sub_borrow(oid, caller_key)
+            # A returned BORROWED arg must survive the frame drop with its
+            # registration intact: re-parent it to the caller now and force it
+            # into the reply's `borrows` list (the frame may hold its only ref).
+            self._tls.captured_kept = self.reference_counter.promote_captured(
+                [oid for oid, _ in cap], caller
+            )
+        self._tls.result_refs = cap
+        return packaged
 
     def _package_one(self, oid: ObjectID, value, owner: dict) -> dict:
         pickled, raw_buffers, total = serialization.serialized_size(value)
