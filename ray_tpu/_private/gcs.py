@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import time
 import traceback
+from collections import deque
 from typing import Any
 
 from ray_tpu._private.config import CONFIG, _LOOPBACK
@@ -117,6 +118,8 @@ class GcsService:
         self.subscribers: dict[str, set[Connection]] = {}
         self.job_counter = 0
         self.task_events: list[dict] = []
+        self._task_event_seq = 0
+        self._task_event_chunks: "deque[tuple[int, int]]" = deque()
         self._actor_events: dict[ActorID, asyncio.Event] = {}
         self._death_task = None
         self._restored_from_store = False
@@ -128,6 +131,13 @@ class GcsService:
         for (ns, key), value in self.store.items("kv"):
             self.kv.setdefault(ns, {})[key] = value
         self.job_counter = self.store.get("meta", "job_counter", 0)
+        # Seq derives from the stored chunk keys (no separate counter record:
+        # it would double append traffic and reusing a stale counter after a
+        # crash between the two puts would overwrite a persisted chunk).
+        for seq, events in sorted(self.store.items("task_events")):
+            self.task_events.extend(events)
+            self._task_event_chunks.append((seq, len(events)))
+            self._task_event_seq = max(self._task_event_seq, seq)
         for actor_id, rec in self.store.items("actors"):
             spec = rec["spec"]
             actor = ActorInfo(actor_id, spec)
@@ -765,10 +775,25 @@ class GcsService:
     # ---------------- task events (observability) ----------------
 
     async def rpc_report_task_events(self, conn, events: list):
+        """Task events persist in chunk-sized store records (a GCS restart
+        keeps the timeline; reference round-2 gap: events were memory-only).
+        Trimming drops whole chunks from memory AND the store, so the log
+        cannot grow unboundedly."""
         self.task_events.extend(events)
+        self._task_event_seq += 1
+        seq = self._task_event_seq
+        self.store.put("task_events", seq, events)
+        self._task_event_chunks.append((seq, len(events)))
         max_events = 100000
-        if len(self.task_events) > max_events:
-            del self.task_events[: len(self.task_events) - max_events]
+        excess = len(self.task_events) - max_events
+        while excess > 0 and self._task_event_chunks:
+            old_seq, count = self._task_event_chunks[0]
+            if count > excess:
+                break  # only whole chunks are dropped; a little slack is fine
+            self._task_event_chunks.popleft()
+            self.store.delete("task_events", old_seq)
+            del self.task_events[:count]
+            excess -= count
         return True
 
     async def rpc_list_task_events(self, conn, limit: int = 1000):

@@ -1259,29 +1259,33 @@ class Raylet:
         return True
 
     async def rpc_check_worker_alive(self, conn, node_hex: str, worker_hex: str):
-        """Borrow-audit probe: is the given worker's process still alive?
-        Local workers are checked directly; remote ones through their raylet.
-        Unknown nodes (dead per the GCS view) report not-alive."""
+        """Borrow-audit probe: True = alive, False = CONFIRMED dead (its own
+        raylet denies it, or the GCS marked its node dead), None = no verdict
+        (unreachable/partitioned — the audit must not free on a maybe)."""
         if node_hex == self.node_id.hex():
             for wid, handle in self.workers.items():
                 if wid.hex() == worker_hex:
                     return handle.alive
-            return False
+            return False  # our own table is authoritative for our node
         target = None
-        for nid, view in self.node_view.items():
+        for nid in self.node_view:
             if nid.hex() == node_hex:
                 target = nid
                 break
         if target is None:
-            return False  # node gone from the cluster view
+            # Not in the live view: only a confirmed-dead record is a verdict.
+            for nid, view in self._full_node_view.items():
+                if nid.hex() == node_hex and not view.get("alive", True):
+                    return False
+            return None
         peer = await self._peer(target)
         if peer is None:
-            return False
+            return None  # dial failure != death
         try:
             return await peer.call("check_worker_alive", node_hex, worker_hex,
                                    timeout=5.0)
         except Exception:
-            return False
+            return None
 
     # ------------------------------------------------------------------ RPC: object store
 

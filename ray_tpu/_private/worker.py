@@ -1216,6 +1216,8 @@ class CoreWorker:
                     )
                 except Exception:
                     continue  # raylet unreachable: no verdict this round
+                if alive is None:
+                    continue  # unreachable != dead: never free on a maybe
                 if alive:
                     failures.pop(key, None)
                     continue
@@ -2093,6 +2095,22 @@ class CoreWorker:
             return True
         self.reference_counter.add_owned(oid)
         self.memory_store.create_pending(oid)
+        # Sequenced handoff for refs yielded inside the item (mirrors
+        # _register_reply_embeds for task results): pre-seed parents before
+        # user code can deserialize them; settle when this item is freed.
+        src = result.get("src")
+        if src is not None:
+            pending = []
+            for roid, _o in result.get("result_refs") or ():
+                if self.reference_counter.pre_register_borrow(roid, src):
+                    pending.append(roid)
+                else:
+                    self._report_borrow(roid, src, -1)
+            if pending:
+                with self._embedded_lock:
+                    self._reply_embedded[("stream", oid)] = {
+                        "refs": pending, "returns": {oid}, "src": src,
+                    }
         self.memory_store.resolve(
             oid, None if in_plasma else result["inline"],
             result.get("error", False), in_plasma,
@@ -2543,7 +2561,24 @@ class CoreWorker:
             if error:
                 out = {"object_id": oid, "inline": serialization.dumps(value), "error": True}
             else:
-                out = self._package_one(oid, value, owner)
+                # Refs yielded into the stream ride the same sequenced handoff
+                # as task results: pre-count the consumer before the item
+                # leaves, and re-parent deferred arg borrows so they survive
+                # the generator frame (see ReferenceCounter docstring).
+                self._tls.ref_capture = cap = []
+                try:
+                    out = self._package_one(oid, value, owner)
+                finally:
+                    self._tls.ref_capture = None
+                if cap:
+                    okey = _addr_key(owner)
+                    for roid, _o in cap:
+                        self.reference_counter.add_sub_borrow(roid, okey)
+                    self.reference_counter.promote_captured(
+                        [roid for roid, _o in cap], owner
+                    )
+                    out["result_refs"] = cap
+                    out["src"] = self._owner_address()
             self.io.run(self.raylet.notify("stream_item", owner, task_id, index, out))
 
         def finish():
