@@ -43,30 +43,111 @@ DEFAULT_RULES: dict[str, object] = {
 
 
 def create_mesh(
-    axes: Mapping[str, int] | None = None, devices: Sequence | None = None
+    axes: Mapping[str, int] | None = None,
+    devices: Sequence | None = None,
+    dcn_axes: Mapping[str, int] | None = None,
 ) -> Mesh:
     """Build a Mesh from {axis_name: size}. Missing axes get size 1; a single axis may
-    be -1 to absorb the remaining devices."""
+    be -1 to absorb the remaining devices.
+
+    Multi-slice (DCN) meshes: pass ``dcn_axes={"dp": n_slices}`` to build a
+    hybrid mesh where those axes span TPU slices over the data-center network
+    and the ``axes`` sizes are per-slice (ICI). Device layout follows the
+    hybrid-mesh recipe (`jax.experimental.mesh_utils.create_hybrid_device_mesh`
+    semantics): DCN axes vary across slice groups, ICI axes within a slice, so
+    gradient all-reduces on a DCN-mapped dp axis cross slices exactly once
+    while every other collective rides ICI. Slices are identified by the
+    devices' ``slice_index`` attribute; devices without one (CPU test meshes)
+    are split evenly into ``prod(dcn_axes)`` contiguous groups."""
     devices = list(devices if devices is not None else jax.devices())
     axes = dict(axes or {})
     for name in axes:
         if name not in AXIS_ORDER:
             raise ValueError(f"unknown mesh axis {name!r}; valid: {AXIS_ORDER}")
-    sizes = {name: axes.get(name, 1) for name in AXIS_ORDER}
-    wild = [name for name, s in sizes.items() if s == -1]
-    if len(wild) > 1:
-        raise ValueError("at most one axis may be -1")
-    fixed = math.prod(s for s in sizes.values() if s != -1)
-    if wild:
-        if len(devices) % fixed:
-            raise ValueError(f"{len(devices)} devices not divisible by {fixed}")
-        sizes[wild[0]] = len(devices) // fixed
+    if dcn_axes:
+        return _create_hybrid_mesh(axes, dict(dcn_axes), devices)
+    sizes = _resolve_sizes(axes, len(devices))
     total = math.prod(sizes.values())
     if total > len(devices):
         raise ValueError(f"mesh of {total} devices > {len(devices)} available")
     shape = tuple(sizes[name] for name in AXIS_ORDER)
     dev_array = np.asarray(devices[:total]).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
+
+
+def _resolve_sizes(axes: Mapping[str, int], n_devices: int) -> dict[str, int]:
+    """Fill missing axes with 1 and resolve a single -1 wildcard against
+    n_devices (shared by the flat and hybrid mesh paths)."""
+    sizes = {name: axes.get(name, 1) for name in AXIS_ORDER}
+    wild = [name for name, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wild:
+        if n_devices % fixed:
+            raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+        sizes[wild[0]] = n_devices // fixed
+    return sizes
+
+
+def _slice_groups(devices: Sequence, n_slices: int) -> list[list]:
+    """Group devices by hardware slice. TPU devices carry slice_index; CPU test
+    devices don't and are chunked evenly (each chunk plays one fake slice)."""
+    by_slice: dict[int, list] = {}
+    for d in devices:
+        idx = getattr(d, "slice_index", None)
+        if idx is None:
+            by_slice = {}
+            break
+        by_slice.setdefault(idx, []).append(d)
+    if by_slice:
+        if len(by_slice) < n_slices:
+            raise ValueError(
+                f"dcn axes need {n_slices} slices; devices span {len(by_slice)}"
+            )
+        return [by_slice[k] for k in sorted(by_slice)][:n_slices]
+    if len(devices) % n_slices:
+        raise ValueError(f"{len(devices)} devices not divisible into {n_slices} slices")
+    per = len(devices) // n_slices
+    return [devices[i * per : (i + 1) * per] for i in range(n_slices)]
+
+
+def _create_hybrid_mesh(axes: dict, dcn_axes: dict, devices: list) -> Mesh:
+    for name, size in dcn_axes.items():
+        if name not in AXIS_ORDER:
+            raise ValueError(f"unknown dcn axis {name!r}; valid: {AXIS_ORDER}")
+        if int(size) < 1:
+            raise ValueError(
+                f"dcn axis {name!r} must be a positive slice count, got {size} "
+                "(-1 wildcards are only valid for per-slice axes)"
+            )
+    dcn_sizes = {name: int(dcn_axes.get(name, 1)) for name in AXIS_ORDER}
+    n_slices = math.prod(dcn_sizes.values())
+    groups = _slice_groups(devices, n_slices)
+    per_slice = len(groups[0])
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError("slices must be homogeneous for a hybrid mesh")
+    # Per-slice (ICI) sizes; a -1 wildcard absorbs the per-slice remainder.
+    ici_sizes = _resolve_sizes(axes, per_slice)
+    if math.prod(ici_sizes.values()) != per_slice:
+        raise ValueError(
+            f"per-slice axes {ici_sizes} use {math.prod(ici_sizes.values())} "
+            f"devices, slice has {per_slice}"
+        )
+    dcn_shape = tuple(dcn_sizes[name] for name in AXIS_ORDER)
+    ici_shape = tuple(ici_sizes[name] for name in AXIS_ORDER)
+    # (*dcn_shape, *ici_shape) -> interleave (dcn_0, ici_0, dcn_1, ici_1, ...)
+    # -> merge each pair: axis k spans dcn_k * ici_k with DCN-major order.
+    arr = np.empty(dcn_shape + ici_shape, dtype=object)
+    flat_slices = arr.reshape(n_slices, per_slice)
+    for i, group in enumerate(groups):
+        flat_slices[i] = np.asarray(group, dtype=object).reshape(per_slice)
+    n = len(AXIS_ORDER)
+    perm = [k for pair in ((i, i + n) for i in range(n)) for k in pair]
+    merged = arr.transpose(perm).reshape(
+        tuple(dcn_shape[i] * ici_shape[i] for i in range(n))
+    )
+    return Mesh(merged, AXIS_ORDER)
 
 
 def logical_to_spec(

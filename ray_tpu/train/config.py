@@ -26,6 +26,12 @@ class ScalingConfig:
     num_workers: Optional[int] = None
     use_tpu: bool = False
     topology: Optional[str] = None  # e.g. "v4-16": reserve one whole slice
+    # Multi-slice (DCN) training: gang-reserve this many whole slices of the
+    # topology; workers = hosts_per_slice * num_slices, and the training loop
+    # typically maps a dp axis across slices via create_mesh(dcn_axes=...)
+    # (reference precedent: python/ray/_private/accelerators/tpu.py:482-547
+    # multi-slice gang scheduling).
+    num_slices: int = 1
     resources_per_worker: Optional[dict] = None
     placement_strategy: str = "PACK"
     chips_per_host: int = 4
@@ -46,6 +52,11 @@ class ScalingConfig:
                 f"min_workers ({self.min_workers}) must be <= num_workers "
                 f"({self.num_workers})"
             )
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {self.num_slices}")
+        if self.num_slices > 1 and self.topology is None:
+            raise ValueError("num_slices > 1 requires a topology")
+        self._workers_explicit = self.num_workers is not None
         if self.topology is not None:
             # "v4-16" -> 16 cores -> hosts = cores / (2 cores-per-chip * chips-per-host)
             # Keep the simple public convention: N in vX-N counts chips for v5e/v6e and
@@ -54,8 +65,17 @@ class ScalingConfig:
             n = int(n)
             chips = n if gen in ("v5e", "v5litepod", "v6e") else n // 2
             hosts = max(1, chips // self.chips_per_host)
+            self.hosts_per_slice = hosts
             if self.num_workers is None:
-                self.num_workers = hosts
+                self.num_workers = hosts * self.num_slices
+            elif self.num_slices > 1 and self.num_workers != hosts * self.num_slices:
+                # Silently under-provisioning head bundles would reserve fewer
+                # slices than configured.
+                raise ValueError(
+                    f"num_workers ({self.num_workers}) must equal "
+                    f"hosts_per_slice ({hosts}) * num_slices ({self.num_slices}) "
+                    "for a multi-slice gang"
+                )
             self.use_tpu = True
 
     @property
@@ -69,12 +89,18 @@ class ScalingConfig:
         return {k: float(v) for k, v in resources.items() if v}
 
     def bundles(self) -> list[dict]:
-        """Placement-group bundles for the worker gang. With a topology, bundle 0 also
-        claims the slice-head resource so the whole slice is reserved atomically."""
+        """Placement-group bundles for the worker gang. With a topology, the
+        first bundle of EACH slice's host block claims the slice-head resource
+        (advertised once per slice, on TPU_WORKER_ID==0), so k slices are
+        reserved atomically and no two head bundles can land on one slice."""
         per = self._resources_per_worker_not_none
         bundles = [dict(per) for _ in range(self.num_workers)]
         if self.topology:
-            bundles[0][f"TPU-{self.topology}-head"] = 1.0
+            # __post_init__ validated num_workers == hosts * num_slices for
+            # k > 1, so every head index is in range.
+            hosts = getattr(self, "hosts_per_slice", self.num_workers)
+            for s in range(self.num_slices):
+                bundles[s * hosts][f"TPU-{self.topology}-head"] = 1.0
         return bundles
 
     @property

@@ -116,7 +116,25 @@ class JaxTrainer(DataParallelTrainer):
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[dict] = None,
+        num_slices: Optional[int] = None,
     ):
+        if num_slices is not None:
+            # Multi-slice convenience: gang-reserve k slices of the configured
+            # topology; the loop maps dp across slices via
+            # create_mesh(dcn_axes={"dp": k}).
+            if scaling_config is None:
+                raise ValueError("num_slices requires a scaling_config with a topology")
+            from dataclasses import replace
+
+            # An explicitly-set worker count is honored (and validated against
+            # hosts_per_slice * num_slices in ScalingConfig); a derived one is
+            # recomputed for the new slice count.
+            explicit = getattr(scaling_config, "_workers_explicit", False)
+            scaling_config = replace(
+                scaling_config,
+                num_slices=num_slices,
+                num_workers=scaling_config.num_workers if explicit else None,
+            )
         super().__init__(
             train_loop_per_worker,
             train_loop_config=train_loop_config,
