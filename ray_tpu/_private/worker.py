@@ -575,6 +575,9 @@ class CoreWorker:
         self._reply_embedded: dict = {}
         self._embedded_materialized: set[ObjectID] = set()
         self._embedded_lock = threading.Lock()
+        # Owned ids with an attached resource (e.g. a device-object HBM pin):
+        # the hook runs when the id's last reference dies cluster-wide.
+        self._owned_free_hooks: dict[ObjectID, Any] = {}
         self.job_id = job_id
         self.io = rpc.IoLoop(name=f"rtpu-io-{mode}")
         self.raylet: rpc.Connection | None = None
@@ -773,6 +776,21 @@ class CoreWorker:
 
     def _owner_address(self) -> dict:
         return {"node_id": self.node_id, "worker_id": self.worker_id}
+
+    def put_inline_owned(self, data: bytes, free_hook=None) -> ObjectRef:
+        """Register a small owned object resolving to pre-serialized bytes,
+        with an optional hook that runs when its last reference dies
+        cluster-wide (device objects pin HBM behind these)."""
+        self.reference_counter.drain_deferred()
+        object_id = ObjectID.from_task(
+            self.current_task_id, 0x50000000 + self._put_counter.next()
+        )
+        self.reference_counter.add_owned(object_id)
+        self.memory_store.create_pending(object_id)
+        self.memory_store.resolve(object_id, data, False, False)
+        if free_hook is not None:
+            self._owned_free_hooks[object_id] = free_hook
+        return ObjectRef(object_id, self._owner_address())
 
     def put(self, value: Any) -> ObjectRef:
         self.reference_counter.drain_deferred()
@@ -1077,6 +1095,12 @@ class CoreWorker:
         self.memory_store.pop(object_id)
         self._drop_lineage(object_id)
         self._settle_embedded_on_free(object_id)
+        hook = self._owned_free_hooks.pop(object_id, None)
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass
         if rec is not None and rec.in_plasma and self._connected:
             # Direct-arena eviction first: the block returns to the freelist
             # synchronously, so the next put reuses its (warm) pages instead of
@@ -2293,7 +2317,18 @@ class CoreWorker:
         used by compiled DAGs to install their pinned exec loops)."""
         if method_name == "__rtpu_apply__":
             def apply(fn, *args, **kwargs):
-                return fn(instance, *args, **kwargs)
+                res = fn(instance, *args, **kwargs)
+                if asyncio.iscoroutine(res):
+                    # Coroutine fns let callers avoid stalling an async
+                    # actor's event loop (the async executor awaits the
+                    # returned coroutine); on sync actors run it to completion
+                    # on this executor thread.
+                    try:
+                        asyncio.get_running_loop()
+                        return res
+                    except RuntimeError:
+                        return asyncio.run(res)
+                return res
 
             return apply
         return getattr(instance, method_name)

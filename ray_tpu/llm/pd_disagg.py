@@ -35,7 +35,16 @@ class PrefillServer:
         first_logits, kv, prompt_len = await loop.run_in_executor(
             None, lambda: self._engine.prefill_detached(token_ids, lora)
         )
-        return {"first_logits": first_logits, "kv": kv, "prompt_len": prompt_len}
+        # The KV prefix stays pinned HERE as a refcounted device object; only
+        # its tiny descriptor rides through the router. The decode replica
+        # pulls the payload straight from this actor (no router data hop —
+        # reference moves this over NIXL; the descriptor + direct pull is the
+        # TPU-object-plane analog), and the pin evicts when the last
+        # descriptor holder drops it.
+        from ray_tpu.experimental import device_objects
+
+        kv_ref = device_objects.put(kv)
+        return {"first_logits": first_logits, "kv": kv_ref, "prompt_len": prompt_len}
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
@@ -64,6 +73,14 @@ class DecodeServer:
                                  top_k: int = 0, stop_token_id: Optional[int] = None,
                                  lora: str = "") -> dict:
         loop = asyncio.get_running_loop()
+        from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
+
+        if isinstance(kv, DeviceObjectRef):
+            # Pull the KV prefix peer-to-peer from the prefill replica. The
+            # pin there releases when the ROUTER drops its reply reference
+            # (the descriptor in `pre`) after generate() returns — this call's
+            # borrowed arg holds it only transiently.
+            kv = await loop.run_in_executor(None, dev_get, kv)
         done: asyncio.Future = loop.create_future()
         out: List[int] = []
 
