@@ -120,6 +120,8 @@ class GcsService:
         self.task_events: list[dict] = []
         self._task_event_seq = 0
         self._task_event_chunks: "deque[tuple[int, int]]" = deque()
+        self._recent_logs: dict[str, dict] = {}  # worker hex -> {buf, meta, at}
+        self._task_events_total = 0  # monotonic: events ever received
         self._actor_events: dict[ActorID, asyncio.Event] = {}
         self._death_task = None
         self._restored_from_store = False
@@ -377,8 +379,47 @@ class GcsService:
         self.subscribers.setdefault(channel, set()).add(conn)
         return True
 
+    async def rpc_list_log_workers(self, conn):
+        """Workers with retained log lines (dashboard log-viewer index)."""
+        return [
+            {"worker": wid, **entry["meta"], "lines": len(entry["buf"])}
+            for wid, entry in self._recent_logs.items()
+        ]
+
+    async def rpc_get_worker_log(self, conn, worker_hex: str, limit: int = 200):
+        entry = self._recent_logs.get(worker_hex)
+        if entry is None or limit <= 0:
+            return []
+        return list(entry["buf"])[-limit:]
+
+    def _retain_log_tail(self, message: dict):
+        """Keep a bounded per-worker tail so the dashboard can show any
+        worker's recent output without tailing files on its node (reference:
+        dashboard log endpoints read the log_monitor's files; here the stream
+        already flows through GCS pubsub, so a ring buffer rides along)."""
+        wid = message.get("worker")
+        if not wid:
+            return
+        entry = self._recent_logs.get(wid)
+        if entry is None:
+            if len(self._recent_logs) >= 512:
+                # bound memory: drop the stalest worker's tail
+                oldest = min(self._recent_logs,
+                             key=lambda k: self._recent_logs[k]["at"])
+                self._recent_logs.pop(oldest, None)
+            entry = self._recent_logs[wid] = {
+                "buf": deque(maxlen=400),
+                "meta": {"kind": message.get("kind"),
+                         "pid": message.get("pid"),
+                         "node": message.get("node")},
+                "at": 0.0,
+            }
+        entry["buf"].extend(message.get("lines", ()))
+        entry["at"] = time.monotonic()
+
     async def rpc_publish_worker_logs(self, conn, message):
-        """Raylet log monitor relay: fan worker log lines out to drivers."""
+        """Raylet log monitor relay: retain a tail, then fan out to drivers."""
+        self._retain_log_tail(message)
         await self.publish("worker_logs", message)
         return True
 
@@ -780,6 +821,7 @@ class GcsService:
         Trimming drops whole chunks from memory AND the store, so the log
         cannot grow unboundedly."""
         self.task_events.extend(events)
+        self._task_events_total += len(events)
         self._task_event_seq += 1
         seq = self._task_event_seq
         self.store.put("task_events", seq, events)
@@ -798,6 +840,10 @@ class GcsService:
 
     async def rpc_list_task_events(self, conn, limit: int = 1000):
         return self.task_events[-limit:]
+
+    async def rpc_task_event_stats(self, conn):
+        """Cheap counters for samplers (no event payloads cross the wire)."""
+        return {"total": self._task_events_total, "retained": len(self.task_events)}
 
     async def rpc_cluster_resources(self, conn):
         total: dict[str, float] = {}

@@ -730,6 +730,7 @@ class Raylet:
                     "kind": handle.kind,
                     "pid": handle.proc.pid if handle.proc else None,
                     "node": self.node_id.hex(),
+                    "worker": handle.worker_id.hex(),  # log-viewer identity
                     "owner": owner,  # driver scoping: worker_id hex of work's owner
                     "lines": lines[:200],
                 }

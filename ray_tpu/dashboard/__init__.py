@@ -27,10 +27,28 @@ _PAGE = """<!doctype html>
  .ALIVE, .SUCCEEDED, .FINISHED { background: #d4efd4; }
  .DEAD, .FAILED { background: #f3d0d0; }
  .PENDING_CREATION, .RUNNING, .PENDING { background: #fdeec7; }
+ .charts { display: flex; flex-wrap: wrap; gap: 1rem; }
+ .chart { background: #fff; border: 1px solid #ddd; padding: 6px; }
+ .chart .t { font-size: 0.8rem; color: #555; margin-bottom: 2px; }
+ #logbox { background: #111; color: #d6d6d6; font: 0.78rem/1.3 monospace;
+           padding: 8px; height: 220px; overflow-y: scroll; white-space: pre-wrap; }
+ #timeline { background: #fff; border: 1px solid #ddd; }
+ select { font-size: 0.85rem; }
 </style></head>
 <body>
 <h1>ray_tpu dashboard</h1>
 <div id="summary"></div>
+<h2>Metrics</h2>
+<div class="charts">
+  <div class="chart"><div class="t">CPU in use / total</div><svg id="c_cpu" width="320" height="90"></svg></div>
+  <div class="chart"><div class="t">TPU in use / total</div><svg id="c_tpu" width="320" height="90"></svg></div>
+  <div class="chart"><div class="t">Alive actors</div><svg id="c_actors" width="320" height="90"></svg></div>
+  <div class="chart"><div class="t">Task events /s</div><svg id="c_tasks" width="320" height="90"></svg></div>
+</div>
+<h2>Task timeline <span style="font-weight:normal;font-size:0.8rem">(one lane per worker; green=done, red=failed, amber=running)</span></h2>
+<canvas id="timeline" width="1000" height="160"></canvas>
+<h2>Worker logs <select id="logsel"></select></h2>
+<div id="logbox"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -44,12 +62,91 @@ function row(cells, tag) {
   return "<tr>" + cells.map(c => `<${tag||"td"}>${c}</${tag||"td"}>`).join("") + "</tr>";
 }
 function pill(s) { return `<span class="pill ${esc(s)}">${esc(s)}</span>`; }
+
+// -- line charts over the server-side history ring ---------------------------
+function drawChart(id, series, colors) {
+  const svg = document.getElementById(id), W = 320, H = 90, P = 4;
+  let max = 1;
+  series.forEach(s => s.forEach(v => { if (v > max) max = v; }));
+  const paths = series.map((s, i) => {
+    if (!s.length) return "";
+    const pts = s.map((v, j) => {
+      const x = P + (W - 2 * P) * j / Math.max(1, s.length - 1);
+      const y = H - P - (H - 2 * P) * v / max;
+      return `${x.toFixed(1)},${y.toFixed(1)}`;
+    });
+    return `<polyline fill="none" stroke="${colors[i]}" stroke-width="1.5" points="${pts.join(" ")}"/>`;
+  });
+  svg.innerHTML = paths.join("") +
+    `<text x="${W-P}" y="12" text-anchor="end" font-size="10" fill="#888">${max.toFixed(0)}</text>`;
+}
+
+// -- task timeline: lanes per worker, bars per task --------------------------
+function drawTimeline(events) {
+  const cv = document.getElementById("timeline"), ctx = cv.getContext("2d");
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  const spans = {};  // task_id -> {start, end, state, worker}
+  events.forEach(e => {
+    const s = spans[e.task_id] = spans[e.task_id] ||
+      {start: null, end: null, state: "RUNNING", worker: e.worker_id || "?", name: e.name};
+    if (e.state === "RUNNING") s.start = e.time;
+    else { s.end = e.time; s.state = e.state; }
+  });
+  const list = Object.values(spans).filter(s => s.start);
+  if (!list.length) return;
+  const now = Date.now() / 1000;
+  const t0 = Math.min(...list.map(s => s.start));
+  const t1 = Math.max(now, ...list.map(s => s.end || now));
+  const lanes = [...new Set(list.map(s => s.worker))].slice(0, 12);
+  const laneH = Math.min(24, (cv.height - 14) / Math.max(1, lanes.length));
+  const X = t => 60 + (cv.width - 70) * (t - t0) / Math.max(1e-9, t1 - t0);
+  ctx.font = "9px monospace"; ctx.fillStyle = "#666";
+  lanes.forEach((w, i) => ctx.fillText(w.slice(0, 8), 2, 12 + i * laneH + laneH / 2));
+  list.forEach(s => {
+    const lane = lanes.indexOf(s.worker);
+    if (lane < 0) return;
+    const xa = X(s.start), xb = X(s.end || now);
+    ctx.fillStyle = s.state === "FINISHED" ? "#7cbf7c" : s.state === "FAILED" ? "#d98080" : "#e8c464";
+    ctx.fillRect(xa, 6 + lane * laneH, Math.max(2, xb - xa), laneH - 4);
+  });
+  ctx.fillStyle = "#888";
+  ctx.fillText(new Date(t0 * 1000).toLocaleTimeString(), 60, cv.height - 2);
+  ctx.fillText(new Date(t1 * 1000).toLocaleTimeString(), cv.width - 70, cv.height - 2);
+}
+
+// -- log viewer --------------------------------------------------------------
+let logWorker = "";
+async function refreshLogs() {
+  const sel = document.getElementById("logsel");
+  const workers = await (await fetch("/api/log_workers")).json();
+  const current = sel.value || logWorker;
+  sel.innerHTML = workers.map(w =>
+    `<option value="${esc(w.worker)}">${esc(w.kind)} pid=${esc(w.pid)} ${esc(w.worker.slice(0,10))} (${w.lines})</option>`
+  ).join("");
+  if (current) sel.value = current;
+  logWorker = sel.value;
+  if (!logWorker) return;
+  const lines = await (await fetch(`/api/worker_log?worker=${logWorker}&limit=200`)).json();
+  const box = document.getElementById("logbox");
+  const pinned = box.scrollTop + box.clientHeight >= box.scrollHeight - 8;
+  box.textContent = lines.join("\\n");
+  if (pinned) box.scrollTop = box.scrollHeight;
+}
+document.getElementById("logsel").addEventListener("change", e => {
+  logWorker = e.target.value; refreshLogs();
+});
+
 async function refresh() {
   const s = await (await fetch("/api/cluster")).json();
   document.getElementById("summary").innerHTML =
     `<b>${s.alive_nodes}</b> nodes · CPU ${JSON.stringify(s.resources_available.CPU||0)}` +
     ` / ${JSON.stringify(s.resources_total.CPU||0)} available` +
     ` · actors ${JSON.stringify(s.actors)} · tasks ${JSON.stringify(s.tasks)}`;
+  const hist = await (await fetch("/api/metrics_history")).json();
+  drawChart("c_cpu", [hist.map(h => h.cpu_used), hist.map(h => h.cpu_total)], ["#4a7dbd", "#bbb"]);
+  drawChart("c_tpu", [hist.map(h => h.tpu_used), hist.map(h => h.tpu_total)], ["#9a5fb5", "#bbb"]);
+  drawChart("c_actors", [hist.map(h => h.actors_alive)], ["#3e9e5f"]);
+  drawChart("c_tasks", [hist.map(h => h.task_events_rate)], ["#cf8a3b"]);
   const nodes = await (await fetch("/api/nodes")).json();
   document.getElementById("nodes").innerHTML = row(["node", "address", "total", "available", "state"], "th") +
     nodes.map(n => row([esc(n.node_id), esc(n.address), esc(JSON.stringify(n.resources_total)),
@@ -62,9 +159,11 @@ async function refresh() {
   const jobs = await (await fetch("/api/jobs")).json();
   document.getElementById("jobs").innerHTML = row(["job", "status", "entrypoint"], "th") +
     jobs.map(j => row([esc(j.job_id), pill(j.status), esc(j.entrypoint)])).join("");
-  const tasks = await (await fetch("/api/tasks?limit=50")).json();
+  const tasks = await (await fetch("/api/tasks?limit=400")).json();
+  drawTimeline(tasks);
   document.getElementById("tasks").innerHTML = row(["task", "name", "state"], "th") +
     tasks.slice(-50).reverse().map(t => row([esc(t.task_id), esc(t.name), pill(t.state)])).join("");
+  await refreshLogs();
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -78,13 +177,69 @@ class DashboardActor:
         self._host = host
         self._port = port
         self._server = None
+        # Server-side metrics history ring: ~12 min at 3s resolution, sampled
+        # from the same GCS state the JSON API reads (reference:
+        # dashboard/modules/metrics serves Grafana panels; here the chart data
+        # lives in-process and the page renders SVG).
+        from collections import deque
+
+        self._history = deque(maxlen=240)
+        self._last_events_total = None
 
     async def start(self) -> int:
         if self._server is not None:
             return self._port
         self._server = await asyncio.start_server(self._handle, self._host, self._port)
         self._port = self._server.sockets[0].getsockname()[1]
+        # Hold the task reference: loops keep only weak refs, and a GC'd
+        # sampler silently freezes every chart.
+        self._sampler = asyncio.get_running_loop().create_task(self._sample_loop())
         return self._port
+
+    async def _sample_loop(self, interval_s: float = 3.0):
+        """Cheap per-tick sampling: counters and resource maps only — never the
+        event payloads (a busy cluster retains up to 100k of them)."""
+        import time as _time
+
+        loop = asyncio.get_running_loop()
+
+        def sample():
+            import ray_tpu
+            from ray_tpu.util import state as state_mod
+
+            nodes = state_mod.list_nodes()
+            actors = state_mod.list_actors()
+            return {
+                "total": ray_tpu.cluster_resources(),
+                "avail": ray_tpu.available_resources(),
+                "alive_nodes": sum(1 for n in nodes if n.get("alive", True)),
+                "actors_alive": sum(1 for a in actors if a.get("state") == "ALIVE"),
+                "events_total": _gcs_call("task_event_stats")["total"],
+            }
+
+        while True:
+            try:
+                s = await loop.run_in_executor(None, sample)
+                total, avail = s["total"], s["avail"]
+                events = s["events_total"]
+                if self._last_events_total is None:
+                    rate = 0.0
+                else:
+                    rate = max(0.0, (events - self._last_events_total) / interval_s)
+                self._last_events_total = events
+                self._history.append({
+                    "ts": _time.time(),
+                    "cpu_total": float(total.get("CPU", 0) or 0),
+                    "cpu_used": float((total.get("CPU", 0) or 0) - (avail.get("CPU", 0) or 0)),
+                    "tpu_total": float(total.get("TPU", 0) or 0),
+                    "tpu_used": float((total.get("TPU", 0) or 0) - (avail.get("TPU", 0) or 0)),
+                    "actors_alive": s["actors_alive"],
+                    "alive_nodes": s["alive_nodes"],
+                    "task_events_rate": rate,
+                })
+            except Exception:
+                pass  # sampling must never kill the server
+            await asyncio.sleep(interval_s)
 
     async def _state(self, path: str, query: dict):
         from ray_tpu.util import state
@@ -103,6 +258,18 @@ class DashboardActor:
             return await loop.run_in_executor(None, state.list_objects)
         if path == "/api/jobs":
             return await loop.run_in_executor(None, state.list_jobs)
+        if path == "/api/metrics_history":
+            return list(self._history)
+        if path == "/api/log_workers":
+            return await loop.run_in_executor(
+                None, lambda: _gcs_call("list_log_workers")
+            )
+        if path == "/api/worker_log":
+            worker = query.get("worker", "")
+            limit = int(query.get("limit", "200"))
+            return await loop.run_in_executor(
+                None, lambda: _gcs_call("get_worker_log", worker, limit)
+            )
         return None
 
     async def _handle(self, reader, writer):
@@ -115,6 +282,13 @@ class DashboardActor:
                 return
             if request.path in ("/", "/index.html"):
                 body, ctype, status = _PAGE.encode(), "text/html", 200
+            elif request.path == "/metrics":
+                # Prometheus exposition of every flushed cluster metric.
+                from ray_tpu.util import metrics as metrics_mod
+
+                loop = asyncio.get_running_loop()
+                text = await loop.run_in_executor(None, metrics_mod.prometheus_text)
+                body, ctype, status = text.encode(), "text/plain; version=0.0.4", 200
             else:
                 data = await self._state(request.path, request.query)
                 if data is None:
@@ -131,6 +305,12 @@ class DashboardActor:
 
     async def get_port(self) -> int:
         return self._port
+
+
+def _gcs_call(method: str, *args):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs_call(method, *args)
 
 
 _state: dict = {}

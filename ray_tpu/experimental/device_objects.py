@@ -182,9 +182,14 @@ def transfer(ref: DeviceObjectRef, dst_actor,
     return out
 
 
-def _pull_and_pin(_instance, ref: DeviceObjectRef) -> DeviceObjectRef:
-    """Runs on the DESTINATION actor: fetch from the owner, pin locally."""
-    value = get(ref)  # owner-direct fetch; zero-copy if ref is already local
+async def _pull_and_pin(_instance, ref: DeviceObjectRef) -> DeviceObjectRef:
+    """Runs on the DESTINATION actor: fetch from the owner, pin locally.
+    Async so an async-actor destination's event loop never stalls behind the
+    (possibly multi-MB) pull; sync actors run the coroutine on their executor
+    thread via __rtpu_apply__."""
+    import asyncio
+
+    value = await asyncio.to_thread(get, ref)  # owner-direct fetch
     return put(value)
 
 

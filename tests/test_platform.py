@@ -334,5 +334,45 @@ def test_dashboard_endpoints():
         assert any(a["class_name"] == "Dash" for a in actors)
         with urllib.request.urlopen(base + "/api/nodes", timeout=30) as r:
             assert _json.loads(r.read())
+        # round-3 operability surface: metrics history, prometheus, log viewer
+        import time as _t
+
+        deadline = _t.monotonic() + 30
+        hist = []
+        while _t.monotonic() < deadline and len(hist) < 2:
+            with urllib.request.urlopen(base + "/api/metrics_history", timeout=30) as r:
+                hist = _json.loads(r.read())
+            _t.sleep(1.0)
+        assert len(hist) >= 2, "metrics sampler produced no history"
+        assert hist[-1]["cpu_total"] > 0 and "task_events_rate" in hist[-1]
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+        # the worker that ran Dash.ping has logged at least its banner by now;
+        # poll briefly (the log monitor ships every 0.5s)
+        @ray_tpu.remote
+        def chatty():
+            print("dashboard-log-viewer-probe")
+            return 1
+
+        ray_tpu.get(chatty.remote())
+        deadline = _t.monotonic() + 30
+        workers = []
+        while _t.monotonic() < deadline:
+            with urllib.request.urlopen(base + "/api/log_workers", timeout=30) as r:
+                workers = _json.loads(r.read())
+            if workers:
+                break
+            _t.sleep(0.5)
+        assert workers, "no worker logs retained for the viewer"
+        found = False
+        for w in workers:
+            with urllib.request.urlopen(
+                base + f"/api/worker_log?worker={w['worker']}&limit=200", timeout=30
+            ) as r:
+                lines = _json.loads(r.read())
+            if any("dashboard-log-viewer-probe" in ln for ln in lines):
+                found = True
+                break
+        assert found, "probe line never reached the log viewer"
     finally:
         stop_dashboard()
