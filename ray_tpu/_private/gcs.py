@@ -845,6 +845,16 @@ class GcsService:
         """Cheap counters for samplers (no event payloads cross the wire)."""
         return {"total": self._task_events_total, "retained": len(self.task_events)}
 
+    async def rpc_list_dag_op_events(self, conn, prefix: str):
+        """Latest compiled-DAG per-op profile event per id, filtered server-side
+        (shipping the whole retained event log per profile call is 100k dicts)."""
+        latest: dict[str, dict] = {}
+        for e in self.task_events:
+            tid = str(e.get("task_id", ""))
+            if e.get("dag_op") and tid.startswith(prefix):
+                latest[tid] = e  # log order: the last occurrence is newest
+        return list(latest.values())
+
     async def rpc_cluster_resources(self, conn):
         total: dict[str, float] = {}
         avail: dict[str, float] = {}

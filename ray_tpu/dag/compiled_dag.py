@@ -55,10 +55,10 @@ def _read_source(kind, src):
     return src
 
 
-def _exec_loop(instance, specs: List[_ExecSpec]):
+def _exec_loop(instance, specs: List[_ExecSpec], token: str = ""):
     """Runs inside the actor (as one pinned long-running method call)."""
     try:
-        return _exec_loop_inner(instance, specs)
+        return _exec_loop_inner(instance, specs, token)
     finally:
         # Reclaim writer-side ring state of cross-node channels hosted here.
         for spec in specs:
@@ -69,46 +69,241 @@ def _exec_loop(instance, specs: List[_ExecSpec]):
                     pass
 
 
-def _exec_loop_inner(instance, specs: List[_ExecSpec]):
-    while True:
-        try:
-            for spec in specs:
-                args = [_read_source(kind, src) for kind, src in spec.arg_sources]
-                kwargs = {
-                    k: _read_source(kind, src)
-                    for k, (kind, src) in spec.kwarg_sources.items()
-                }
-                # Errors flow THROUGH the graph (as wrapped values) so one bad
-                # input poisons only its execution, not the pinned loops.
-                err = next(
-                    (v for v in list(args) + list(kwargs.values())
-                     if isinstance(v, _WrappedError)),
-                    None,
-                )
-                if err is None:
-                    try:
-                        if spec.reduce_op is not None:
-                            from ray_tpu.dag.collective import reduce_values
+class _OpStats:
+    """Per-op read/compute/write accumulators, pushed into the task-event
+    timeline periodically and at loop close (reference: compiled_dag_node.py
+    op-level profiling)."""
 
-                            out = reduce_values(spec.reduce_op, args)
-                        else:
-                            out = getattr(instance, spec.method_name)(*args, **kwargs)
-                    except Exception as e:  # surfaced at CompiledDAGRef.get
-                        out = _WrappedError(e)
-                else:
-                    out = err
-                if spec.out_channel is not None:
-                    try:
-                        spec.out_channel.write(out)
-                    except ChannelClosed:
-                        raise
-                    except Exception as e:
-                        # e.g. result larger than the channel slot: report the
-                        # error IN PLACE of the oversized value so the loop (and
-                        # downstream consumers) stay alive and in sync.
-                        spec.out_channel.write(_WrappedError(e))
-        except ChannelClosed:
-            return "closed"
+    def __init__(self, token: str, specs: List[_ExecSpec]):
+        import time
+
+        self.token = token
+        self.per_op = [
+            {"read_s": 0.0, "compute_s": 0.0, "write_s": 0.0, "iters": 0}
+            for _ in specs
+        ]
+        self._names = [s.method_name for s in specs]
+        self._last_emit = time.monotonic()
+        self._emitted_iters = 0
+
+    def maybe_emit(self, force: bool = False):
+        import time
+
+        total_iters = self.per_op[0]["iters"] if self.per_op else 0
+        if not force:
+            if total_iters == self._emitted_iters:
+                return
+            if (
+                total_iters - self._emitted_iters < 8
+                and time.monotonic() - self._last_emit < 0.5
+            ):
+                return
+        self._last_emit = time.monotonic()
+        self._emitted_iters = total_iters
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            w = global_worker()
+            for i, st in enumerate(self.per_op):
+                w._record_event(
+                    task_id=f"dagop:{self.token}:{i}",
+                    name=f"dag:{self._names[i]}",
+                    state="FINISHED",
+                    dag_op=True,
+                    **{k: round(v, 6) if isinstance(v, float) else v
+                       for k, v in st.items()},
+                )
+        except Exception:
+            pass
+
+
+def _exec_loop_inner(instance, specs: List[_ExecSpec], token: str = ""):
+    """Overlap-scheduled loop (reference: `python/ray/dag/dag_node_operation.py`
+    reorders per-actor READ/COMPUTE/WRITE ops so channel I/O overlaps compute).
+
+    Decomposition here: inputs NOT produced by this actor's own loop are
+    prefetched by a reader thread (one iteration ahead, bounded queues), and
+    all channel writes drain through a writer thread — same-actor consumers
+    stay correct because ring reads block until their item exists. COMPUTE for
+    iteration i therefore overlaps the reads of i+1 and the writes of i."""
+    import queue as queue_mod
+    import threading
+    import time
+
+    stats = _OpStats(token, specs)
+
+    def _chan_ident(chan):
+        # Reader views are distinct objects over the same segment/ring: compare
+        # by transport identity, never object id.
+        shm = getattr(chan, "_shm", None)
+        if shm is not None:
+            return ("shm", shm.name)
+        return ("rpc", getattr(chan, "_name", id(chan)))
+
+    own_outputs = {
+        _chan_ident(s.out_channel) for s in specs if s.out_channel is not None
+    }
+
+    def _chan_of(kind, src):
+        if kind == "chan":
+            return src
+        if kind == "pick":
+            return src[0]
+        return None
+
+    # (spec_idx, slot) -> prefetch queue; slot is ("arg", j) | ("kw", name)
+    plan: list = []
+    for i, spec in enumerate(specs):
+        for j, (kind, src) in enumerate(spec.arg_sources):
+            chan = _chan_of(kind, src)
+            if chan is not None and _chan_ident(chan) not in own_outputs:
+                plan.append((i, ("arg", j), kind, src))
+        for name, (kind, src) in spec.kwarg_sources.items():
+            chan = _chan_of(kind, src)
+            if chan is not None and _chan_ident(chan) not in own_outputs:
+                plan.append((i, ("kw", name), kind, src))
+    queues = {(i, slot): queue_mod.Queue(maxsize=2) for i, slot, _k, _s in plan}
+    stop = threading.Event()
+    reader_exc: list = []
+    writer_q: queue_mod.Queue = queue_mod.Queue(maxsize=8)
+    writer_exc: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for i, slot, kind, src in plan:
+                    t0 = time.monotonic()
+                    v = _read_source(kind, src)
+                    stats.per_op[i]["read_s"] += time.monotonic() - t0
+                    queues[(i, slot)].put(v)
+        except BaseException as e:  # noqa: BLE001 - surface into the main loop
+            reader_exc.append(e)
+            for q in queues.values():
+                try:
+                    q.put_nowait(_LOOP_STOP)
+                except queue_mod.Full:
+                    pass
+
+    def writer():
+        try:
+            while True:
+                item = writer_q.get()
+                if item is _LOOP_STOP:
+                    return
+                i, chan, out = item
+                t0 = time.monotonic()
+                try:
+                    chan.write(out)
+                except ChannelClosed:
+                    raise
+                except Exception as e:
+                    # e.g. result larger than the channel slot: report the
+                    # error IN PLACE of the oversized value so the loop (and
+                    # downstream consumers) stay alive and in sync.
+                    chan.write(_WrappedError(e))
+                stats.per_op[i]["write_s"] += time.monotonic() - t0
+        except BaseException as e:  # noqa: BLE001
+            writer_exc.append(e)
+
+    threads = []
+    if plan:
+        threads.append(threading.Thread(target=reader, name="dag-reader", daemon=True))
+    threads.append(threading.Thread(target=writer, name="dag-writer", daemon=True))
+    for t in threads:
+        t.start()
+
+    def _get_input(i, slot, kind, src):
+        key = (i, slot)
+        q = queues.get(key)
+        if q is None:
+            t0 = time.monotonic()
+            v = _read_source(kind, src)
+            stats.per_op[i]["read_s"] += time.monotonic() - t0
+            return v
+        while True:
+            try:
+                v = q.get(timeout=0.5)
+                break
+            except queue_mod.Empty:
+                if reader_exc:  # reader died with other queues still full
+                    raise reader_exc[0]
+        if v is _LOOP_STOP:
+            raise reader_exc[0] if reader_exc else ChannelClosed("reader stopped")
+        return v
+
+    def _put_output(item):
+        while True:
+            if writer_exc:
+                raise writer_exc[0]
+            try:
+                writer_q.put(item, timeout=0.5)
+                return
+            except queue_mod.Full:
+                continue
+
+    try:
+        while True:
+            try:
+                for i, spec in enumerate(specs):
+                    if writer_exc:
+                        raise writer_exc[0]
+                    args = [
+                        _get_input(i, ("arg", j), kind, src)
+                        for j, (kind, src) in enumerate(spec.arg_sources)
+                    ]
+                    kwargs = {
+                        k: _get_input(i, ("kw", k), kind, src)
+                        for k, (kind, src) in spec.kwarg_sources.items()
+                    }
+                    # Errors flow THROUGH the graph (as wrapped values) so one
+                    # bad input poisons only its execution, not the pinned loops.
+                    err = next(
+                        (v for v in list(args) + list(kwargs.values())
+                         if isinstance(v, _WrappedError)),
+                        None,
+                    )
+                    if err is None:
+                        t0 = time.monotonic()
+                        try:
+                            if spec.reduce_op is not None:
+                                from ray_tpu.dag.collective import reduce_values
+
+                                out = reduce_values(spec.reduce_op, args)
+                            else:
+                                out = getattr(instance, spec.method_name)(*args, **kwargs)
+                        except Exception as e:  # surfaced at CompiledDAGRef.get
+                            out = _WrappedError(e)
+                        stats.per_op[i]["compute_s"] += time.monotonic() - t0
+                    else:
+                        out = err
+                    stats.per_op[i]["iters"] += 1
+                    if spec.out_channel is not None:
+                        _put_output((i, spec.out_channel, out))
+                stats.maybe_emit()
+            except ChannelClosed:
+                return "closed"
+    finally:
+        stop.set()
+        # Drop queued (stale) writes, then a guaranteed stop slot: on an error
+        # exit the writer must not keep pushing desynchronized results into
+        # live downstream channels, nor block forever on an empty queue.
+        while True:
+            try:
+                writer_q.get_nowait()
+            except queue_mod.Empty:
+                break
+        writer_q.put(_LOOP_STOP)
+        # Unblock a reader parked on a full queue so it can observe closed
+        # channels and exit (its channels are being torn down by the driver).
+        for q in queues.values():
+            try:
+                q.get_nowait()
+            except queue_mod.Empty:
+                pass
+        stats.maybe_emit(force=True)
+
+
+_LOOP_STOP = object()
 
 
 class CompiledDAGRef:
@@ -138,9 +333,12 @@ class _WrappedError:
 class CompiledDAG:
     def __init__(self, leaf: DAGNode, *, buffer_size_bytes: int = 8 << 20,
                  _timeout_s: float = 60.0):
+        import uuid as _uuid
+
         self._buffer = buffer_size_bytes
         self._timeout = _timeout_s
         self._torn_down = False
+        self._token = _uuid.uuid4().hex[:12]  # op-profile event namespace
         self._exec_count = 0
         self._pending: Dict[int, Any] = {}
         self._build(leaf)
@@ -312,12 +510,16 @@ class CompiledDAG:
         self._actors = list(actor_of.values())
         from ray_tpu.actor import ActorMethod
 
-        for actor_id, specs in per_actor.items():
+        for a_idx, (actor_id, specs) in enumerate(per_actor.items()):
             actor = actor_of[actor_id]
             # Pin the loop: one long-running call per actor via the generic
             # apply hook (the reference's __ray_call__ + do_exec_tasks pattern).
+            # Per-actor token suffix: spec indices are per-actor, so profile
+            # event ids must not collide across actors.
             self._loop_refs.append(
-                ActorMethod(actor, "__rtpu_apply__").remote(_exec_loop, specs)
+                ActorMethod(actor, "__rtpu_apply__").remote(
+                    _exec_loop, specs, f"{self._token}:a{a_idx}"
+                )
             )
 
     # -- execution ---------------------------------------------------------
@@ -343,6 +545,24 @@ class CompiledDAG:
 
     def __getattr__(self, name):
         raise AttributeError(name)
+
+    def op_profile(self) -> dict:
+        """Latest per-op timing (read/compute/write seconds + iterations),
+        keyed by op label. Sourced from the task-event timeline, which the
+        pinned loops feed periodically and at teardown (reference:
+        compiled_dag_node.py op-level profiling)."""
+        from ray_tpu._private.worker import global_worker
+
+        prefix = f"dagop:{self._token}:"
+        events = global_worker().gcs_call("list_dag_op_events", prefix)
+        out: dict = {}
+        for e in events:
+            tid = str(e.get("task_id", ""))
+            out[f"{tid[len(prefix):]}:{e.get('name')}"] = {
+                k: e[k] for k in ("read_s", "compute_s", "write_s", "iters")
+                if k in e
+            }
+        return out
 
     def teardown(self):
         if self._torn_down:

@@ -88,9 +88,13 @@ function drawTimeline(events) {
   const spans = {};  // task_id -> {start, end, state, worker}
   events.forEach(e => {
     const s = spans[e.task_id] = spans[e.task_id] ||
-      {start: null, end: null, state: "RUNNING", worker: e.worker_id || "?", name: e.name};
-    if (e.state === "RUNNING") s.start = e.time;
-    else { s.end = e.time; s.state = e.state; }
+      {start: null, end: null, state: "RUNNING", worker: null, name: e.name};
+    if (e.state === "RUNNING") {
+      // lane = the EXECUTING worker (SUBMITTED events come from the driver)
+      s.start = e.time; s.worker = e.worker_id || "?";
+    } else if (e.state === "FINISHED" || e.state === "FAILED") {
+      s.end = e.time; s.state = e.state;
+    }
   });
   const list = Object.values(spans).filter(s => s.start);
   if (!list.length) return;
@@ -308,9 +312,9 @@ class DashboardActor:
 
 
 def _gcs_call(method: str, *args):
-    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util.state import _gcs
 
-    return global_worker().gcs_call(method, *args)
+    return _gcs(method, *args)
 
 
 _state: dict = {}
