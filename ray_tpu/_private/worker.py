@@ -2279,13 +2279,17 @@ class CoreWorker:
             cls = self.functions.load(spec["cls_key"])
             args, kwargs = self._materialize_args(spec)
             instance = cls.__new__(cls)
+            # Identity is visible DURING __init__ (reference:
+            # get_runtime_context().get_actor_id() works in constructors —
+            # e.g. replicas registering themselves with coordinators).
+            self.actor_id = actor_id
             instance.__init__(*args, **kwargs)
             self.actor_runtime = _ActorRuntime(
                 instance, spec.get("max_concurrency", 1), spec.get("is_async", False)
             )
-            self.actor_id = actor_id
             return {"ok": True}
         except Exception:
+            self.actor_id = None
             return {"ok": False, "error": traceback.format_exc()}
 
     def _enqueue_actor_task(self, spec):

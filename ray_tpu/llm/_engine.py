@@ -165,7 +165,8 @@ def _sample_host(logits_row: np.ndarray, sampling: SamplingParams,
 
 
 class Slot:
-    __slots__ = ("active", "generated", "params", "callback", "prompt_len", "tokens")
+    __slots__ = ("active", "generated", "params", "callback", "prompt_len",
+                 "tokens", "host_len", "adapter")
 
     def __init__(self):
         self.active = False
@@ -174,6 +175,8 @@ class Slot:
         self.callback = None
         self.prompt_len = 0
         self.tokens: List[int] = []
+        self.host_len = 0  # kv rows present for this slot (host mirror of lens)
+        self.adapter = 0
 
 
 class DecodeEngine:
@@ -182,7 +185,8 @@ class DecodeEngine:
 
     def __init__(self, cfg: ModelConfig, params, *, num_slots: int = 4,
                  max_seq: Optional[int] = None, seed: int = 0,
-                 lora_config: Optional[dict] = None, decode_loop: bool = True):
+                 lora_config: Optional[dict] = None, decode_loop: bool = True,
+                 spec_config: Optional[dict] = None):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -224,6 +228,40 @@ class DecodeEngine:
         self._stop = False
         self._jit_prefill = {}
         self._jit_decode = jax.jit(self._decode_step)
+        # Speculative decoding (reference: vLLM speculative decoding /
+        # spec_decode workers): a cheap DRAFT model proposes k tokens in ONE
+        # jitted lax.scan program; the target verifies all k in one forward.
+        # Greedy-only; engaged at batch==1 (the latency-bound regime).
+        self._spec = None
+        if spec_config:
+            d_cfg = spec_config.get("draft_cfg") or cfg
+            d_params = unbox(spec_config.get("draft_params", self.params))
+            assert not d_cfg.scan_layers
+            k = int(spec_config.get("num_spec_tokens", 6))
+            self._spec = {
+                "cfg": d_cfg,
+                "params": d_params,
+                "k": max(1, k),
+                "caches": [
+                    (jnp.zeros((self.B, self.T, d_cfg.n_kv_heads, d_cfg.head_dim),
+                               d_cfg.dtype),
+                     jnp.zeros((self.B, self.T, d_cfg.n_kv_heads, d_cfg.head_dim),
+                               d_cfg.dtype))
+                    for _ in range(d_cfg.n_layers)
+                ],
+                "host_lens": [0] * self.B,  # draft kv rows per slot (host-side)
+                # slots with draft KV in sync (prompt-prefilled here, not PD)
+                "ready": [False] * self.B,
+                # all-k-accepted leaves one proposed token's kv missing from the
+                # draft cache; it catches up at the next round's scan head.
+                "pending": [None] * self.B,
+            }
+            self._spec_dirty: set = set()
+            self._jit_spec_propose = jax.jit(
+                self._spec_propose, static_argnames=("k", "catchup")
+            )
+            self._jit_spec_verify = {}
+            self._jit_spec_prefill = {}
         self._thread = None
         if decode_loop:  # prefill-only servers skip the stepper thread
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -310,6 +348,171 @@ class DecodeEngine:
             lora=lora, adapter_ids=adapter_ids,
         )
         return logits[:, 0], new_caches, lens + 1
+
+    def _scatter_slot(self, caches, new_slot, slot):
+        """Write a [1, T, ...] slot view back into the full [B, T, ...] caches."""
+        out = []
+        for (ck_full, cv_full), (ck, cv) in zip(caches, new_slot):
+            out.append((
+                jax.lax.dynamic_update_slice(ck_full, ck.astype(ck_full.dtype),
+                                             (slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(cv_full, cv.astype(cv_full.dtype),
+                                             (slot, 0, 0, 0)),
+            ))
+        return out
+
+    # -- speculative decoding ---------------------------------------------
+    def _spec_propose(self, params_d, first_tok, t0, caches, l, slot, *, k,
+                      catchup):
+        """Draft k greedy tokens in ONE program (lax.scan): the whole proposal
+        costs one dispatch instead of k. With catchup=True the scan's first
+        step ingests `first_tok` (the previous round's fully-accepted final
+        proposal, whose kv never landed) and the chain restarts from t0 —
+        the catch-up costs zero extra dispatches. Returns ([k] proposed
+        tokens, updated full draft caches)."""
+        dcfg = self._spec["cfg"]
+        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
+        steps = k + 1 if catchup else k
+
+        def step(carry, idx):
+            tok, sc, pos = carry
+            kv_mask = (jnp.arange(self.T)[None, :] <= pos)[None]
+            logits, new_sc = _forward_cached(
+                params_d, dcfg, tok[None, None], pos[None, None], sc,
+                pos[None], kv_mask, lora=None, adapter_ids=None,
+            )
+            nxt = jnp.argmax(logits[0, 0]).astype(jnp.int32)
+            if catchup:
+                nxt = jnp.where(idx == 0, t0, nxt)  # restart the chain at t0
+            return (nxt, new_sc, pos + 1), nxt
+
+        (_tok, out_slot, _pos), toks = jax.lax.scan(
+            step, (first_tok, slot_caches, l), jnp.arange(steps)
+        )
+        if catchup:
+            toks = toks[1:]
+        return toks, self._scatter_slot(caches, out_slot, slot)
+
+    def _spec_verify(self, params, lora, adapter_id, t0, proposed, caches, l, slot):
+        """Target forward over [t0, d1..dk] at positions l..l+k (one dispatch).
+        logits[i] scores position l+i+1; rows beyond the accepted prefix stay
+        invisible behind lens."""
+        tokens = jnp.concatenate([t0[None], proposed])[None]
+        S = tokens.shape[1]
+        positions = (l + jnp.arange(S))[None]
+        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
+        mask = (jnp.arange(self.T)[None, :] <= positions[0][:, None])[None]
+        logits, new_slot = _forward_cached(
+            params, self.cfg, tokens, positions, slot_caches, l[None], mask,
+            lora=lora, adapter_ids=adapter_id[None],
+        )
+        # device-side argmax: the host needs k+1 ints, not [k+1, V] logits
+        return (
+            jnp.argmax(logits[0], axis=-1).astype(jnp.int32),
+            self._scatter_slot(caches, new_slot, slot),
+        )
+
+    def _draft_prefill(self, params_d, tokens, caches, slot):
+        """Prefill the DRAFT cache on the prompt (spec decode needs the draft's
+        kv history in lockstep with the target's)."""
+        S = tokens.shape[1]
+        positions = jnp.arange(S)[None, :]
+        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
+        mask = (jnp.arange(S)[:, None] >= jnp.arange(self.T)[None, :])[None]
+        _logits, new_slot = _forward_cached(
+            params_d, self._spec["cfg"], tokens, positions, slot_caches,
+            jnp.zeros((1,), jnp.int32), mask, lora=None, adapter_ids=None,
+        )
+        return self._scatter_slot(caches, new_slot, slot)
+
+    def _sync_device_state(self):
+        """Push host-side slot state (lens, last token) back to device after a
+        run of spec rounds, before plain decode or admission reads it."""
+        if not self._spec_dirty:
+            return
+        lens = np.asarray(self._lens).copy()
+        last = np.asarray(self._last_token).copy()
+        for slot in self._spec_dirty:
+            s = self._slots[slot]
+            lens[slot] = s.host_len
+            if s.tokens:
+                last[slot] = s.tokens[-1]
+        self._lens = jnp.asarray(lens)
+        self._last_token = jnp.asarray(last)
+        self._spec_dirty.clear()
+
+    def _spec_eligible(self, slot: int) -> bool:
+        s = self._slots[slot]
+        return (
+            self._spec is not None
+            and self._spec["ready"][slot]
+            and s.params.temperature == 0.0
+            and s.params.top_k in (0, 1)
+            # verify writes k+1 rows at host_len; past the cache end XLA would
+            # CLAMP the dynamic_update_slice start and corrupt valid history —
+            # the final rounds near the cap fall back to plain decode.
+            and s.host_len + self._spec["k"] + 1 <= self.T
+        )
+
+    def _spec_round(self, slot: int):
+        """One speculative round: draft-k (catch-up fused) + verify — exactly
+        TWO dispatches emitting 1..k+1 tokens (plain decode pays one each).
+        Lengths and last-token ride host-side slot state; only caches live on
+        device between rounds."""
+        d = self._spec
+        k = d["k"]
+        s = self._slots[slot]
+        t0 = s.tokens[-1]
+        l = s.host_len
+        dlens = d["host_lens"][slot]
+        pend = d["pending"][slot]
+        catchup = pend is not None
+        proposed, d["caches"] = self._jit_spec_propose(
+            d["params"], jnp.int32(pend if catchup else t0), jnp.int32(t0),
+            d["caches"], jnp.int32(dlens), jnp.int32(slot), k=k, catchup=catchup,
+        )
+        if catchup:
+            dlens += 1
+            d["pending"][slot] = None
+        # Verify takes the proposals as a DEVICE array (concat happens inside
+        # the program): the host readback of `proposed` then overlaps the
+        # verify dispatch instead of gating it.
+        key = ("verify", k + 1)
+        if key not in self._jit_spec_verify:
+            self._jit_spec_verify[key] = jax.jit(self._spec_verify)
+        greedy_dev, self._caches = self._jit_spec_verify[key](
+            self.params, self._lora, jnp.int32(s.adapter), jnp.int32(t0),
+            proposed, self._caches, jnp.int32(l), jnp.int32(slot),
+        )
+        proposed = [int(x) for x in np.asarray(proposed)]
+        greedy = np.asarray(greedy_dev)  # [k+1] ints
+        emitted: List[int] = []
+        m = 0
+        while m < k and int(greedy[m]) == proposed[m]:
+            emitted.append(proposed[m])
+            m += 1
+        emitted.append(int(greedy[m]))  # correction (or extension when m == k)
+        # Bookkeeping: lens covers t0..d_m (m+1 new rows); the draft holds
+        # t0..d_{m-1} after the scan — d_m's kv is present for m<k, missing
+        # when every proposal was accepted (catch-up next round).
+        new_len = l + m + 1
+        s.host_len = new_len
+        if m == k:
+            d["host_lens"][slot] = dlens + k
+            d["pending"][slot] = proposed[-1]
+        else:
+            d["host_lens"][slot] = new_len
+            d["pending"][slot] = None
+        # Device lens/last_token sync is DEFERRED (two extra dispatches per
+        # round otherwise): _sync_device_state() runs before any plain decode
+        # or admission touches them.
+        self._spec_dirty.add(slot)
+        for token in emitted:
+            if not s.active:
+                break
+            s.generated += 1
+            s.tokens.append(token)
+            self._emit(slot, token)
 
     def _attach_kv(self, caches, kv, slot):
         """Write a transferred KV prefix into slot's cache rows [0, P).
@@ -417,6 +620,8 @@ class DecodeEngine:
                 return False
             item = self._queue.pop(0)
             slot = free[0]
+        if self._spec is not None:
+            self._sync_device_state()  # prefill reads/writes device lens
 
         if item[0] == "prefilled":
             _tag, kv, prompt_len, first_logits, sampling, callback, adapter = item
@@ -446,6 +651,9 @@ class DecodeEngine:
             )
             self._lens = self._lens.at[slot].set(prompt_len)
             first = _sample_host(np.asarray(first_logits), sampling, self._np_rng)
+            if self._spec is not None:
+                # Transferred prefixes carry no draft KV: plain decode here.
+                self._spec["ready"][slot] = False
         else:
             _tag, prompt, sampling, callback, adapter = item
             prompt = prompt[: self.T - sampling.max_tokens - 1]
@@ -461,12 +669,25 @@ class DecodeEngine:
             )
             prompt_len = len(prompt)
             first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)
+            if self._spec is not None:
+                dkey = ("dprefill", bucket)
+                if dkey not in self._jit_spec_prefill:
+                    self._jit_spec_prefill[dkey] = jax.jit(self._draft_prefill)
+                self._spec["caches"] = self._jit_spec_prefill[dkey](
+                    self._spec["params"], jnp.asarray(padded), self._spec["caches"],
+                    jnp.int32(slot),
+                )
+                self._spec["host_lens"][slot] = len(prompt)
+                self._spec["ready"][slot] = True
+                self._spec["pending"][slot] = None
         s = self._slots[slot]
         s.active = True
         s.generated = 1
         s.params = sampling
         s.callback = callback
         s.prompt_len = prompt_len
+        s.host_len = prompt_len
+        s.adapter = adapter
         s.tokens = [first]
         self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
         self._last_token = self._last_token.at[slot].set(first)
@@ -496,6 +717,20 @@ class DecodeEngine:
             if not active:
                 time.sleep(0.002)
                 continue
+            if len(active) == 1 and self._spec_eligible(active[0]):
+                # batch==1 latency regime: draft-k + verify beats one-token steps
+                self._spec_round(active[0])
+                continue
+            if self._spec is not None:
+                self._sync_device_state()
+                for i in active:
+                    # A plain step advances the target but not the draft: the
+                    # draft cache is now behind and its proposals would be
+                    # garbage (2 dispatches per ~1 token). Disable spec for the
+                    # slot; a fresh request re-enables it at prefill.
+                    if self._spec["ready"][i]:
+                        self._spec["ready"][i] = False
+                        self._spec["pending"][i] = None
             logits, self._caches, self._lens = self._jit_decode(
                 self.params, self._lora, self._adapter_ids, self._last_token,
                 self._caches, self._lens,
@@ -506,6 +741,7 @@ class DecodeEngine:
                 s = self._slots[i]
                 token = _sample_host(logits_np[i], s.params, self._np_rng)
                 s.generated += 1
+                s.host_len += 1  # the decode step wrote last_token's kv row
                 s.tokens.append(token)
                 new_last[i] = token
                 self._emit(i, token)

@@ -1,0 +1,138 @@
+"""Data-parallel LLM serving: dp_size engine replicas as ONE logical engine.
+
+Design parity: reference `python/ray/llm/_internal/serve/deployments/
+data_parallel/dp_server.py` + `dp_rank_assigner.py` — each replica claims a
+unique dp rank from a rank-assigner actor at startup, and requests fan out
+across the rank set. TPU shape: every rank is a full DecodeEngine on its own
+slice/chip; the serve handle's power-of-two router spreads requests, and the
+rank identity travels in responses for placement-aware callers (e.g. a KV
+router pinning conversations to a rank).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Union
+
+import ray_tpu
+from ray_tpu.llm import LLMConfig, LLMServer
+
+
+class DPRankAssigner:
+    """Rank handout keyed by the holder's ACTOR identity, with health-checked
+    reclamation: a replica that crashes (or a whole app deleted and redeployed)
+    leaves a DEAD holder whose rank is reclaimed the next time demand exceeds
+    the free list. Parity: dp_rank_assigner.DPRankAssigner."""
+
+    def __init__(self, dp_size: int):
+        self._dp_size = dp_size
+        self._free = list(range(dp_size))
+        self._held: dict = {}  # holder actor-id hex -> rank
+
+    def _reclaim_dead(self):
+        from ray_tpu.util.state import list_actors
+
+        alive = {a["actor_id"].hex() for a in list_actors()
+                 if a.get("state") == "ALIVE"}
+        for token in [t for t in self._held if t not in alive]:
+            self._free.append(self._held.pop(token))
+        self._free.sort()
+
+    def assign(self, replica_token: str) -> int:
+        if replica_token in self._held:
+            return self._held[replica_token]
+        if not self._free:
+            self._reclaim_dead()
+        if not self._free:
+            raise RuntimeError(f"all {self._dp_size} dp ranks assigned")
+        rank = self._free.pop(0)
+        self._held[replica_token] = rank
+        return rank
+
+    def release(self, replica_token: str) -> bool:
+        rank = self._held.pop(replica_token, None)
+        if rank is None:
+            return False
+        self._free.append(rank)
+        self._free.sort()
+        return True
+
+    def ranks(self) -> dict:
+        return dict(self._held)
+
+
+class DPLLMServer(LLMServer):
+    """One DP rank: a full engine replica that claims its rank at startup."""
+
+    def __init__(self, config: LLMConfig, assigner):
+        # Token = this replica ACTOR's id: stable for the replica's lifetime
+        # and auditable by the assigner's liveness reclamation when it dies.
+        self._replica_token = (
+            ray_tpu.get_runtime_context().get_actor_id().hex()
+        )
+        self._assigner = assigner
+        self.dp_rank = ray_tpu.get(assigner.assign.remote(self._replica_token))
+        super().__init__(config)
+
+    async def get_dp_rank(self) -> int:
+        return self.dp_rank
+
+    async def generate(self, prompt: Union[str, List[int]], **kw) -> dict:
+        out = await super().generate(prompt, **kw)
+        out["dp_rank"] = self.dp_rank
+        return out
+
+    def __del__(self):
+        try:
+            self._assigner.release.remote(self._replica_token)
+        except Exception:
+            pass
+
+
+class DPRouter:
+    """Front door over the DP rank set: requests ride the serve handle's
+    power-of-two-choices balancing across replicas (parity: dp_server's
+    request fanout); `ranks()` exposes the live rank map for diagnostics."""
+
+    def __init__(self, server_handle, assigner):
+        self._server = server_handle
+        self._assigner = assigner
+
+    async def generate(self, prompt: Union[str, List[int]], **kw) -> dict:
+        return await self._server.generate.remote(prompt, **kw)
+
+    async def ranks(self) -> dict:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: ray_tpu.get(self._assigner.ranks.remote())
+        )
+
+    async def __call__(self, request) -> dict:
+        body = request.json() if hasattr(request, "json") else dict(request)
+        return await self.generate(
+            body.get("prompt", ""),
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+        )
+
+
+def build_dp_openai_app(config: LLMConfig, *, dp_size: int = 2):
+    """A data-parallel serving app: dp_size engine replicas + rank assigner
+    behind one router (parity: build_dp_openai_app / DPServer)."""
+    from ray_tpu import serve
+
+    assigner = ray_tpu.remote(num_cpus=0)(DPRankAssigner).options(
+        name=f"DPRankAssigner-{config.model_id}", get_if_exists=True,
+        namespace="llm_dp",
+    ).remote(dp_size)
+    resources = config.accelerator_resources or {}
+    server = serve.deployment(
+        name=f"DPLLMServer-{config.model_id}",
+        num_replicas=dp_size,
+        ray_actor_options={"num_cpus": 0, **resources},
+        max_ongoing_requests=config.num_slots * 4,
+    )(DPLLMServer).bind(config, assigner)
+    router = serve.deployment(name=f"DPRouter-{config.model_id}")(DPRouter)
+    return router.bind(server, assigner)
+
+
+__all__ = ["DPRankAssigner", "DPLLMServer", "DPRouter", "build_dp_openai_app"]

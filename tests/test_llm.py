@@ -262,3 +262,99 @@ def test_pd_disagg_app_end_to_end():
     assert resp["usage"]["completion_tokens"] == 8
     assert resp["prefill_s"] > 0
     serve.delete("pd_app")
+
+
+def test_speculative_decode_correct_and_faster():
+    """Spec decode (draft-k scan + single verify) emits exactly the greedy
+    sequence and beats plain decode tokens/s at batch 1 (VERDICT r2 #9;
+    reference: vLLM speculative decoding). A self-draft makes every proposal
+    accepted, so the speedup bound is deterministic: k+1 tokens for ~2-3
+    dispatches vs one per token."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # The plain engine is the greedy reference: test_engine_matches_full_forward
+    # already proves it bit-exact against the unjitted full forward.
+    prompt, N = [5, 9, 17, 3], 96
+
+    def run(engine):
+        out, done, marks = [], threading.Event(), []
+
+        def cb(tok, fin):
+            if not out:
+                marks.append(time.monotonic())  # first token: decode begins
+            out.append(tok)
+            if fin:
+                marks.append(time.monotonic())
+                done.set()
+
+        # warm the programs with one full generation, then take best-of-3
+        # timings (this 1-core CI host runs cluster daemons concurrently;
+        # min-time is the standard noise-robust estimator)
+        engine.submit(prompt, SamplingParams(max_tokens=N), cb)
+        assert done.wait(300)
+        first = list(out)
+        times, last = [], None
+        for _ in range(3):
+            out.clear(); done.clear(); marks.clear()
+            engine.submit(prompt, SamplingParams(max_tokens=N), cb)
+            assert done.wait(300)
+            # decode tokens/s: first-token -> done (prefill/admit excluded)
+            times.append(marks[-1] - marks[0])
+            last = list(out)
+        return first, last, min(times)
+
+    plain = DecodeEngine(cfg, params, num_slots=2, max_seq=128)
+    try:
+        _, plain_toks, plain_t = run(plain)
+    finally:
+        plain.shutdown()
+    spec = DecodeEngine(
+        cfg, params, num_slots=2, max_seq=128,
+        spec_config={"num_spec_tokens": 6},  # self-draft: all accepted
+    )
+    try:
+        spec_first, spec_toks, spec_t = run(spec)
+    finally:
+        spec.shutdown()
+
+    expected = plain_toks
+    assert len(expected) == N
+    assert spec_first == expected and spec_toks == expected
+    speedup = plain_t / spec_t
+    assert speedup >= 1.5, f"spec decode {speedup:.2f}x (plain {plain_t:.2f}s, spec {spec_t:.2f}s)"
+
+
+def test_dp_serving_routes_across_replicas():
+    """Data-parallel serving: dp_size=2 engine replicas claim distinct ranks
+    and concurrent requests reach BOTH (VERDICT r2 #9; reference:
+    deployments/data_parallel/dp_server.py + dp_rank_assigner.py)."""
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=2
+    )
+    handle = serve.run(app, name="dp-llm", route_prefix=None, _timeout_s=300)
+
+    ranks = handle.ranks.remote().result(timeout_s=120)
+    assert sorted(ranks.values()) == [0, 1], ranks
+
+    rs = [handle.generate.remote(f"req {i}", max_tokens=4) for i in range(12)]
+    outs = [r.result(timeout_s=300) for r in rs]
+    assert all(len(o["token_ids"]) == 4 for o in outs)
+    seen = {o["dp_rank"] for o in outs}
+    assert seen == {0, 1}, f"requests reached only ranks {seen}"
+    # determinism across ranks: same prompt, greedy -> same tokens everywhere
+    a = handle.generate.remote("same", max_tokens=6).result(timeout_s=120)
+    b = handle.generate.remote("same", max_tokens=6).result(timeout_s=120)
+    assert a["token_ids"] == b["token_ids"]
