@@ -507,7 +507,25 @@ class GcsService:
         })
 
     def _pick_node_for(self, resources: dict, scheduling=None) -> NodeInfo | None:
-        """Reference: GcsActorScheduler + hybrid policy. Greedy best-fit over alive nodes."""
+        """Reference: GcsActorScheduler + hybrid policy + label policy
+        (`node_label_scheduling_policy.cc`). Greedy best-fit over alive nodes;
+        composite strategies take the first sub-strategy with any candidate."""
+        if scheduling and scheduling.get("composite"):
+            # Same semantics as the raylet task path: a sub-strategy is
+            # COMMITTED when any node's TOTAL supply can ever satisfy it —
+            # transient busyness waits (the caller retries) rather than
+            # falling through to a weaker sub, so actors and tasks place
+            # identically under one strategy.
+            for sub in scheduling["composite"]:
+                node = self._pick_node_for(resources, sub or None)
+                if node is not None:
+                    return node
+                if self._satisfiable_by_total(resources, sub or None):
+                    return None  # right sub, currently busy: wait here
+            return None
+        from ray_tpu.util.scheduling_strategies import match_labels
+
+        labels = (scheduling or {}).get("labels") or {}
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
@@ -515,10 +533,17 @@ class GcsService:
             if scheduling and scheduling.get("node_id") is not None:
                 if node.node_id != scheduling["node_id"]:
                     continue
+            if labels.get("hard") and not match_labels(node.labels, labels["hard"]):
+                continue
             if all(node.resources_available.get(r, 0) >= amt for r, amt in resources.items()):
                 candidates.append(node)
         if not candidates:
             return None
+        soft = labels.get("soft")
+        if soft:
+            preferred = [n for n in candidates if match_labels(n.labels, soft)]
+            if preferred:
+                candidates = preferred
         # Pack onto the most-utilized feasible node (hybrid default behavior).
         def utilization(n: NodeInfo):
             tot = sum(n.resources_total.values()) or 1
@@ -526,6 +551,24 @@ class GcsService:
             return (tot - avail) / tot
 
         return max(candidates, key=utilization)
+
+    def _satisfiable_by_total(self, resources: dict, scheduling) -> bool:
+        """Could ANY alive node ever run this (total supply, labels, affinity)?"""
+        from ray_tpu.util.scheduling_strategies import match_labels
+
+        hard = ((scheduling or {}).get("labels") or {}).get("hard")
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            if scheduling and scheduling.get("node_id") is not None:
+                if node.node_id != scheduling["node_id"]:
+                    continue
+            if hard and not match_labels(node.labels, hard):
+                continue
+            if all(node.resources_total.get(r, 0) >= amt
+                   for r, amt in resources.items()):
+                return True
+        return False
 
     def _node_for_pg_bundle(self, pg_spec: dict) -> NodeInfo | None:
         """PG-bound actors go to their bundle's allocated node — the bundle has

@@ -239,6 +239,7 @@ class Raylet:
         self._obj_known: set[ObjectID] = set()  # flushed to GCS, not yet freed
         self._obj_flush_scheduled = False
         self.pull_manager = PullManager(CONFIG.pull_budget_bytes)
+        self._last_authoritative_views = 0.0  # composite-scheduling GCS probes
         # pip runtime-env venvs (reference: runtime-env agent + env-keyed worker
         # pools, worker_pool.h:280): env key -> venv python path once built.
         self._venv_python: dict[str, str] = {}
@@ -950,16 +951,142 @@ class Raylet:
         from ray_tpu._private import runtime_env as runtime_env_mod
 
         strategy = spec.get("scheduling_strategy") or {}
+        # Label/composite selectors join the key for the same reason env_key
+        # did: an undispatchable labeled task must not poison the memo for
+        # plain tasks of the same resource shape.
+        label_key = None
+        if strategy.get("labels") or strategy.get("composite"):
+            label_key = repr((strategy.get("labels"), strategy.get("composite")))
         return (
             tuple(sorted((spec.get("resources") or {}).items())),
             self._pg_key(spec),
             strategy.get("node_id"),
+            label_key,
             runtime_env_mod.env_key(spec.get("runtime_env")),
         )
+
+    def _label_feasible_nodes(self, hard: dict, demand: dict,
+                              views: dict | None = None) -> list:
+        """Alive peers (from the GCS view) matching a hard label selector with
+        the resource shape in their total supply."""
+        from ray_tpu.util.scheduling_strategies import match_labels
+
+        out = []
+        for node_id, view in (views or self.node_view).items():
+            if node_id == self.node_id or not view.get("alive", True):
+                continue
+            if not match_labels(view.get("labels"), hard):
+                continue
+            total = view.get("resources_total") or {}
+            if all(total.get(r, 0) >= amt for r, amt in demand.items()):
+                out.append((node_id, view))
+        return out
+
+    async def _authoritative_views(self) -> dict:
+        """Current cluster membership straight from the GCS: composite
+        resolution must not miss a labeled node whose subscription update is
+        still in flight."""
+        try:
+            nodes = await self.gcs.call("get_nodes")
+            return {v["node_id"]: v for v in nodes}
+        except Exception:
+            return self.node_view
+
+    def _composite_choose(self, spec: dict, subs: list,
+                          views: dict | None = None) -> dict | None:
+        """First sub-strategy that is satisfiable RIGHT NOW (reference shape:
+        composite policies over node_label_scheduling_policy.cc). None = no
+        sub currently satisfiable (the task stays queued)."""
+        from ray_tpu.util.scheduling_strategies import match_labels
+
+        demand = spec.get("resources") or {}
+        views = views if views is not None else self.node_view
+        for sub in subs:
+            sub = sub or {}
+            if sub.get("node_id") is not None:
+                view = views.get(sub["node_id"])
+                if sub["node_id"] == self.node_id or (
+                    view is not None and view.get("alive", True)
+                ):
+                    return sub
+                continue
+            hard = (sub.get("labels") or {}).get("hard")
+            if hard:
+                local_ok = match_labels(self.labels, hard) and self.resources.feasible(
+                    demand, None
+                )
+                if local_ok or self._label_feasible_nodes(hard, demand, views):
+                    return sub
+                continue
+            # plain resource scheduling: satisfiable if anyone can ever run it
+            if self.resources.feasible(demand, None):
+                return sub
+            for _nid, view in views.items():
+                total = view.get("resources_total") or {}
+                if view.get("alive", True) and all(
+                    total.get(r, 0) >= amt for r, amt in demand.items()
+                ):
+                    return sub
+        return None
 
     async def _try_dispatch(self, spec: dict) -> bool:
         demand = spec.get("resources") or {}
         strategy = spec.get("scheduling_strategy")
+        views = None  # None => the subscribed node_view
+        if strategy and strategy.get("composite"):
+            chosen = self._composite_choose(spec, strategy["composite"])
+            if chosen is None:
+                # The subscribed view may lag a just-registered labeled node:
+                # consult the GCS directly, but rate-limited — this loop runs
+                # per queued task per pass and must not head-of-line block on
+                # an RPC each time.
+                now = time.monotonic()
+                if now - self._last_authoritative_views < 1.0:
+                    return False
+                self._last_authoritative_views = now
+                views = await self._authoritative_views()
+                chosen = self._composite_choose(spec, strategy["composite"], views)
+                if chosen is None:
+                    return False  # nothing satisfiable yet: stay queued
+            # chosen applies to THIS dispatch only (spec keeps the composite,
+            # so forwarded peers and retries re-evaluate against fresh views)
+            strategy = dict(chosen) or None
+        if strategy and strategy.get("labels"):
+            from ray_tpu.util.scheduling_strategies import match_labels
+
+            sel = strategy["labels"]
+            hard = sel.get("hard")
+            soft = sel.get("soft")
+            if hard and not match_labels(self.labels, hard):
+                # Must run on a labeled node: forward to a matching peer
+                # (soft-preferred), else wait for one to join. Reuse the fresh
+                # views when the composite step fetched them — the node that
+                # made the sub satisfiable may not be in the subscribed view.
+                peers = self._label_feasible_nodes(hard, demand, views)
+                if soft:
+                    preferred = [
+                        p for p in peers if match_labels(p[1].get("labels"), soft)
+                    ]
+                    peers = preferred or peers
+                for node_id, _view in peers:
+                    if await self._forward_to_peer(spec, node_id):
+                        return True
+                return False
+            if soft and not match_labels(self.labels, soft):
+                # Soft-only preference: route to an idle soft-matching peer if
+                # one exists (it will keep the task — its own labels match);
+                # otherwise run here.
+                for node_id, view in self._label_feasible_nodes(
+                    {**(hard or {})}, demand, views
+                ):
+                    if not match_labels(view.get("labels"), soft):
+                        continue
+                    avail = view.get("resources_available") or {}
+                    if all(avail.get(r, 0) >= amt for r, amt in demand.items()):
+                        if await self._forward_to_peer(spec, node_id):
+                            return True
+                # no idle preferred peer: fall through to local dispatch
+            # local node matches (or soft best-effort): normal dispatch
         if strategy and strategy.get("node_id") is not None:
             target = strategy["node_id"]
             if target != self.node_id:
@@ -1020,11 +1147,24 @@ class Raylet:
             return False
         return True
 
+    @staticmethod
+    def _spec_hard_labels(spec: dict) -> dict | None:
+        strategy = spec.get("scheduling_strategy") or {}
+        return (strategy.get("labels") or {}).get("hard") or None
+
+    def _peer_label_ok(self, spec: dict, view: dict) -> bool:
+        hard = self._spec_hard_labels(spec)
+        if not hard:
+            return True
+        from ray_tpu.util.scheduling_strategies import match_labels
+
+        return match_labels(view.get("labels"), hard)
+
     async def _spill(self, spec: dict) -> bool:
         """Task infeasible on this node: find a feasible node and forward (spillback)."""
         demand = spec.get("resources") or {}
         for node_id, info in self.node_view.items():
-            if node_id == self.node_id:
+            if node_id == self.node_id or not self._peer_label_ok(spec, info):
                 continue
             if all(info["resources_total"].get(r, 0) >= amt for r, amt in demand.items()):
                 if await self._forward_to_peer(spec, node_id):
@@ -1036,7 +1176,7 @@ class Raylet:
         if not demand:
             return False
         for node_id, info in self.node_view.items():
-            if node_id == self.node_id:
+            if node_id == self.node_id or not self._peer_label_ok(spec, info):
                 continue
             avail = info.get("resources_available", {})
             if all(avail.get(r, 0) >= amt for r, amt in demand.items()):

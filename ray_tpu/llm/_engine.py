@@ -326,14 +326,7 @@ class DecodeEngine:
             jnp.zeros((1,), jnp.int32), mask,
             lora=lora, adapter_ids=adapter_id[None],
         )
-        out_caches = []
-        for (ck_full, cv_full), (ck, cv) in zip(caches, new_slot_caches):
-            out_caches.append((
-                jax.lax.dynamic_update_slice(ck_full, ck.astype(ck_full.dtype),
-                                             (slot, 0, 0, 0)),
-                jax.lax.dynamic_update_slice(cv_full, cv.astype(cv_full.dtype),
-                                             (slot, 0, 0, 0)),
-            ))
+        out_caches = self._scatter_slot(caches, new_slot_caches, slot)
         last = logits[0, prompt_len - 1]
         lens = lens.at[slot].set(prompt_len)
         return last, out_caches, lens

@@ -32,8 +32,11 @@ class DPRankAssigner:
     def _reclaim_dead(self):
         from ray_tpu.util.state import list_actors
 
+        # Replicas claim ranks DURING __init__, while their actor is still
+        # PENDING_CREATION — any not-confirmed-dead state counts as live, or a
+        # loading replica's rank could be handed out twice.
         alive = {a["actor_id"].hex() for a in list_actors()
-                 if a.get("state") == "ALIVE"}
+                 if a.get("state") != "DEAD"}
         for token in [t for t in self._held if t not in alive]:
             self._free.append(self._held.pop(token))
         self._free.sort()

@@ -50,7 +50,9 @@ def _resolve_scheduling(opts):
     if strategy is None:
         return None, opts
     from ray_tpu.util.scheduling_strategies import (
+        CompositeSchedulingStrategy,
         NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
         PlacementGroupSchedulingStrategy,
     )
 
@@ -61,6 +63,8 @@ def _resolve_scheduling(opts):
         return None, opts
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         return {"node_id": strategy.node_id, "soft": strategy.soft}, opts
+    if isinstance(strategy, (NodeLabelSchedulingStrategy, CompositeSchedulingStrategy)):
+        return strategy.to_spec(), opts
     return None, opts
 
 

@@ -21,7 +21,100 @@ class NodeAffinitySchedulingStrategy:
         self.soft = soft
 
 
+class In:
+    """Label value must be one of `values` (reference :123 label operators)."""
+
+    def __init__(self, *values):
+        self.values = [str(v) for v in values]
+
+    def to_spec(self):
+        return {"op": "in", "values": self.values}
+
+
+class NotIn:
+    def __init__(self, *values):
+        self.values = [str(v) for v in values]
+
+    def to_spec(self):
+        return {"op": "not_in", "values": self.values}
+
+
+class Exists:
+    def to_spec(self):
+        return {"op": "exists"}
+
+
+class DoesNotExist:
+    def to_spec(self):
+        return {"op": "absent"}
+
+
+def _selector_spec(selector: dict) -> dict:
+    """{key: op|plain-value} -> wire form (plain values mean In(value))."""
+    out = {}
+    for key, op in (selector or {}).items():
+        out[key] = op.to_spec() if hasattr(op, "to_spec") else In(op).to_spec()
+    return out
+
+
+def match_labels(node_labels: dict, selector: dict) -> bool:
+    """Evaluate a wire-form selector against a node's label map (reference:
+    `node_label_scheduling_policy.cc` hard-match semantics)."""
+    labels = {str(k): str(v) for k, v in (node_labels or {}).items()}
+    for key, op in (selector or {}).items():
+        kind = op.get("op")
+        present = key in labels
+        if kind == "exists":
+            if not present:
+                return False
+        elif kind == "absent":
+            if present:
+                return False
+        elif kind == "in":
+            if not present or labels[key] not in op.get("values", ()):
+                return False
+        elif kind == "not_in":
+            if present and labels[key] in op.get("values", ()):
+                return False
+        else:
+            return False
+    return True
+
+
 class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes matching label selectors (reference :123-148).
+
+    hard: every expression must match; soft: preferred but not required.
+    Values may be plain strings (equality) or In/NotIn/Exists/DoesNotExist."""
+
     def __init__(self, hard: dict | None = None, soft: dict | None = None):
         self.hard = hard or {}
         self.soft = soft or {}
+
+    def to_spec(self) -> dict:
+        return {"labels": {"hard": _selector_spec(self.hard),
+                           "soft": _selector_spec(self.soft)}}
+
+
+class CompositeSchedulingStrategy:
+    """First-satisfiable-wins over sub-strategies (e.g. a label selector OR
+    plain resource scheduling when no labeled node exists). Reference shape:
+    composite policies layered over node_label_scheduling_policy.cc."""
+
+    def __init__(self, any_of: list):
+        if not any_of:
+            raise ValueError("composite needs at least one sub-strategy")
+        self.any_of = list(any_of)
+
+    def to_spec(self) -> dict:
+        subs = []
+        for s in self.any_of:
+            if s is None:
+                subs.append({})  # plain resource scheduling
+            elif hasattr(s, "to_spec"):
+                subs.append(s.to_spec())
+            elif isinstance(s, NodeAffinitySchedulingStrategy):
+                subs.append({"node_id": s.node_id, "soft": s.soft})
+            else:
+                raise TypeError(f"unsupported composite member {type(s).__name__}")
+        return {"composite": subs}
