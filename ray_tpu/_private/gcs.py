@@ -69,6 +69,10 @@ class ActorInfo:
         self.death_cause = None
         self.placing = False  # a create_actor RPC is in flight to a chosen node
         self.awaiting_report = False  # restored after GCS restart; host not yet re-reported
+        # kill() arrived while creation was in flight: the schedule coroutine
+        # must reap the worker when create_actor returns, or its resources leak
+        # (the raylet only learns the actor_id->worker binding at completion).
+        self.kill_requested = False
 
     def view(self):
         return {
@@ -589,6 +593,8 @@ class GcsService:
         resources = dict(spec.get("resources") or {})
         pg_spec = spec.get("placement_group")
         for attempt in range(retries):
+            if actor.kill_requested or actor.state == DEAD:
+                return  # killed while waiting for placement: nothing to reap yet
             if pg_spec:
                 node = self._node_for_pg_bundle(pg_spec)
             else:
@@ -605,6 +611,20 @@ class GcsService:
                 await asyncio.sleep(0.1)
                 continue
             if result.get("ok"):
+                if actor.kill_requested or actor.state == DEAD:
+                    # kill() landed during the create_actor flight. The raylet
+                    # registered the binding just now, so the kill can finally
+                    # reach the worker — without this, the worker and its
+                    # resources outlive the DEAD actor forever.
+                    try:
+                        await node.conn.call("kill_actor_worker", actor.actor_id)
+                    except Exception:
+                        pass
+                    if actor.state != DEAD:
+                        await self._mark_actor_dead(
+                            actor, "killed via ray_tpu.kill (during creation)"
+                        )
+                    return
                 actor.state = ALIVE
                 actor.address = {"node_id": node.node_id,
                                  "worker_id": result["worker_id"],
@@ -620,8 +640,36 @@ class GcsService:
                 await self._mark_actor_dead(actor, result.get("reason", "actor __init__ failed"))
                 return
             await asyncio.sleep(0.1)
+        avail = {
+            n.node_id.hex()[:8]: dict(n.resources_available)
+            for n in self.nodes.values() if n.alive
+        }
+        async def probe(n):
+            try:
+                stats = await asyncio.wait_for(n.conn.call("node_stats"), 5)
+            except Exception:
+                return None
+            hs = stats.get("resource_holders") or []
+            for h in hs:
+                prefix = h.get("actor_id") or ""
+                for aid, info in self.actors.items():
+                    if prefix and aid.hex().startswith(prefix):
+                        h["actor_class"] = str(
+                            (info.spec or {}).get("class_name")
+                            or (info.spec or {}).get("name")
+                        )
+                        h["actor_state"] = info.state
+                        h["restarts"] = info.num_restarts
+                        break
+            return (n.node_id.hex()[:8], hs)
+
+        alive = [n for n in self.nodes.values() if n.alive]
+        holders = dict(
+            r for r in await asyncio.gather(*(probe(n) for n in alive)) if r
+        )
         await self._mark_actor_dead(
             actor, "unschedulable: no node with resources " + repr(resources)
+            + f" (alive-node availability: {avail!r}; holders: {holders!r})"
         )
 
     async def _mark_actor_dead(self, actor: ActorInfo, reason: str):
@@ -672,6 +720,9 @@ class GcsService:
             return False
         if no_restart:
             actor.restarts_left = 0
+            # If a create_actor RPC is in flight, only the schedule coroutine
+            # will ever learn the worker binding — flag it to reap on return.
+            actor.kill_requested = True
         if actor.address is not None:
             node = self.nodes.get(actor.address["node_id"])
             if node is not None and node.alive:
@@ -682,6 +733,12 @@ class GcsService:
         if actor.state == DEAD:
             return True
         if actor.restarts_left != 0:
+            if actor.placing and actor.address is None:
+                # Creation still in flight: the schedule coroutine owns
+                # placement. Restart-killing a not-yet-started actor is a
+                # no-op; a second _schedule_actor here would double-create
+                # and leak the first worker's resources.
+                return True
             # kill(no_restart=False): restart immediately, per the kill contract.
             await self._handle_actor_failure(actor, "killed via ray_tpu.kill (restarting)")
         else:

@@ -1907,6 +1907,22 @@ class Raylet:
             "queued_tasks": len(self.task_queue),
             "running_tasks": len(self.running),
             "store": self.store.stats(),
+            # Who holds what: the first question of every "why is this node
+            # full" investigation (reference: node manager debug state dump).
+            "resource_holders": [
+                {
+                    "worker_id": h.worker_id.hex()[:12],
+                    "kind": h.kind,
+                    "actor_id": h.actor_id.hex()[:12] if h.actor_id else None,
+                    "leased": h.leased_to is not None,
+                    "acquired": dict(h.acquired),
+                    "pg_key": repr(h.pg_key) if h.pg_key else None,
+                }
+                for h in self.workers.values() if h.acquired
+            ],
+            "pg_bundles": {
+                repr(k): v["reserved"] for k, v in self.resources.bundles.items()
+            },
         }
 
     async def shutdown(self):

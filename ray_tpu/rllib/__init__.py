@@ -8,7 +8,14 @@ EnvRunnerGroup of CPU sampling actors, flax RLModule, jitted Learner/LearnerGrou
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, MARWIL, BCConfig, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.algorithms.iql import IQL, IQLConfig, IQLModule
+from ray_tpu.rllib.algorithms.offline import (
+    OfflineAlgorithm,
+    OfflineData,
+    evaluate_greedy,
+)
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent import MultiAgentPPO
@@ -35,7 +42,15 @@ __all__ = [
     "AlgorithmConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "Columns",
+    "IQL",
+    "IQLConfig",
+    "IQLModule",
+    "OfflineAlgorithm",
+    "OfflineData",
+    "evaluate_greedy",
     "MultiAgentEnvRunner",
     "MultiAgentEnvRunnerGroup",
     "MultiAgentPPO",

@@ -49,6 +49,8 @@ class Algorithm:
             grad_clip=config.grad_clip, seed=config.seed or 0,
             learner_resources=config.learner_resources,
             use_mesh=config.use_mesh,
+            target_spec=self.target_spec(),
+            target_polyak_tau=self.target_polyak_tau(),
         )
         self._ret_history: list = []
 
@@ -62,6 +64,17 @@ class Algorithm:
     def loss_fn(self):
         """Return a pure fn(module, params, batch) -> (loss, metrics-dict)."""
         raise NotImplementedError
+
+    def target_spec(self):
+        """Which top-level param sub-trees need a frozen target copy held by the
+        Learner ("all", a key sequence, or None). The loss sees the copy as
+        batch["target_params"], injected inside the jitted step — mesh-safe."""
+        return None
+
+    def target_polyak_tau(self):
+        """Polyak coefficient for in-step target updates (None = hard sync only,
+        via learner_group.sync_target())."""
+        return None
 
     def postprocess(self, batch_fragments: list) -> Dict[str, np.ndarray]:
         """Turn raw runner fragments into one training batch (e.g. GAE)."""
@@ -144,6 +157,8 @@ class Algorithm:
             "iteration": self.iteration,
             "total_timesteps": self._total_timesteps,
         }
+        if self.target_spec():
+            state["target"] = self.learner_group.get_target()
         with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
             pickle.dump(state, f)
         return path
@@ -152,6 +167,14 @@ class Algorithm:
         with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
         self.learner_group.set_params(state["params"])
+        if self.target_spec():
+            if state.get("target") is not None:
+                self.learner_group.set_target(state["target"])
+            else:
+                # Checkpoint predates learner-held targets: hard-sync from the
+                # restored online params rather than training against the
+                # fresh random init the Learner was constructed with.
+                self.learner_group.sync_target()
         self.iteration = state["iteration"]
         self._total_timesteps = state["total_timesteps"]
 

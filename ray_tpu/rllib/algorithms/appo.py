@@ -25,6 +25,8 @@ class APPOConfig(AlgorithmConfig):
         self.entropy_coeff: float = 0.01
         self.rollout_fragment_length: int = 50
         self.broadcast_interval: int = 2
+        self.sample_async: bool = True
+        self.async_chunk_timesteps: int = 0  # per-request size; 0 = T * num_envs
         self.lr = 5e-4
         self.train_batch_size = 1000
         self.minibatch_size = 0  # whole [B, T] batches, like IMPALA

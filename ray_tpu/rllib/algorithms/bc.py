@@ -120,32 +120,12 @@ class BC(Algorithm):
 
     def evaluate(self, num_episodes: int = 5) -> Dict:
         """Greedy rollouts with the cloned policy (reference: Algorithm.evaluate)."""
-        import jax
+        from ray_tpu.rllib.algorithms.offline import evaluate_greedy
 
-        env = self.config.env_creator()()
-        params = self.learner_group.get_params()
-        rets = []
-        try:
-            for ep in range(num_episodes):
-                obs, _ = env.reset(seed=1000 + ep)
-                done = trunc = False
-                total = 0.0
-                while not (done or trunc):
-                    out = self._module.forward_inference(
-                        params, {Columns.OBS: obs[None]}
-                    )
-                    dist_in = np.asarray(out[Columns.ACTION_DIST_INPUTS])[0]
-                    if self._module.discrete:
-                        action = int(np.argmax(dist_in))
-                    else:
-                        # Greedy: the distribution mean (first half of dist inputs).
-                        action = dist_in[: dist_in.shape[-1] // 2]
-                    obs, reward, done, trunc, _ = env.step(action)
-                    total += float(reward)
-                rets.append(total)
-        finally:
-            env.close()
-        return {"evaluation/episode_return_mean": float(np.mean(rets))}
+        return evaluate_greedy(
+            self._module, self.learner_group.get_params(),
+            self.config.env_creator(), num_episodes,
+        )
 
 
 class MARWIL(BC):

@@ -125,11 +125,6 @@ class DQN(Algorithm):
     def __init__(self, config):
         import gymnasium as gym
 
-        if config.use_mesh:
-            raise NotImplementedError(
-                "DQN's target params ride inside the training batch, which the "
-                "dp-mesh learner would shard; use_mesh=False for DQN"
-            )
         probe = config.env_creator()()
         try:
             if not isinstance(probe.action_space, gym.spaces.Discrete):
@@ -142,12 +137,16 @@ class DQN(Algorithm):
         super().__init__(config)
         self._replay = ReplayBuffer(config.replay_buffer_capacity)
         self._np_rng = np.random.default_rng(config.seed or 0)
-        self._target_params = self.learner_group.get_params()
         self._steps_since_target_sync = 0
 
     def loss_fn(self):
         c = self.config
         return _dqn_loss_factory(c.gamma, c.double_q)
+
+    def target_spec(self):
+        # The whole Q network gets a frozen copy, hard-synced on the
+        # target_network_update_freq cadence (never polyak'd).
+        return "all"
 
     # -- epsilon schedule ---------------------------------------------------
     def _epsilon(self) -> float:
@@ -181,10 +180,9 @@ class DQN(Algorithm):
         if len(self._replay) >= c.learning_starts:
             for _ in range(c.n_updates_per_iter):
                 sample = self._replay.sample(c.minibatch_size, self._np_rng)
-                sample["target_params"] = self._target_params
                 learner_metrics = self.learner_group.update(sample)
             if self._steps_since_target_sync >= c.target_network_update_freq:
-                self._target_params = self.learner_group.get_params()
+                self.learner_group.sync_target()
                 self._steps_since_target_sync = 0
         self._record_returns(returns)
         return {
@@ -200,13 +198,12 @@ class DQN(Algorithm):
         }
 
     def save_to_path(self, path: str) -> str:
-        out = super().save_to_path(path)
+        out = super().save_to_path(path)  # includes the learner-held target
         import os
         import pickle
 
         with open(os.path.join(path, "dqn_state.pkl"), "wb") as f:
-            pickle.dump({"target_params": self._target_params,
-                         "steps_since_sync": self._steps_since_target_sync}, f)
+            pickle.dump({"steps_since_sync": self._steps_since_target_sync}, f)
         return out
 
     def restore_from_path(self, path: str):
@@ -216,5 +213,4 @@ class DQN(Algorithm):
 
         with open(os.path.join(path, "dqn_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        self._target_params = state["target_params"]
         self._steps_since_target_sync = state["steps_since_sync"]

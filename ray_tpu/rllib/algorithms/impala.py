@@ -4,10 +4,12 @@ Design parity: reference `rllib/algorithms/impala/` (V-trace off-policy correcti
 per Espeholt et al. 2018; decoupled acting and learning) on the new-stack SPI.
 TPU-first: V-trace is computed INSIDE the jitted loss with a reversed `lax.scan`
 over [B, T] sequences — compiler-friendly recurrence instead of a host loop.
-Divergence from the fully-async reference: sampling is round-based, but weights
-broadcast only every `broadcast_interval` iterations, so runners act with stale
-policies and the learner genuinely exercises the off-policy correction.
-"""
+Sampling is async by default (`sample_async=True`): every runner keeps a
+sample() in flight, the learner consumes arrivals as they land, and weight
+pushes ride resubmissions every `broadcast_interval` updates — so runners act
+with stale policies and V-trace genuinely corrects the off-policyness.
+`sample_async=False` falls back to round-based sampling (useful for
+deterministic comparisons)."""
 
 from __future__ import annotations
 
@@ -28,7 +30,9 @@ class IMPALAConfig(AlgorithmConfig):
         self.vf_loss_coeff: float = 0.5
         self.entropy_coeff: float = 0.01
         self.rollout_fragment_length: int = 50   # T of each [B, T] sequence
-        self.broadcast_interval: int = 2         # iterations between weight syncs
+        self.broadcast_interval: int = 2         # update cycles between weight syncs
+        self.sample_async: bool = True           # actor-queue sampling (reference default)
+        self.async_chunk_timesteps: int = 0      # per-request size; 0 = T * num_envs
         self.lr = 5e-4
         self.train_batch_size = 1000
         self.minibatch_size = 0    # unused: IMPALA updates on whole [B, T] batches
@@ -207,14 +211,101 @@ class IMPALA(Algorithm):
         batch["last_idx"] = np.asarray(seqs["last_idx"], np.int32)
         return batch
 
-    def train(self) -> Dict:
+    def _pad_batch_rows(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Pad the sequence dim B up to the next power of two with all-zero-mask
+        rows. Async arrivals have episode-boundary-dependent B; bucketing keeps
+        the jitted loss from retracing on every distinct B (zero-mask rows are
+        inert through the masked V-trace recursion)."""
+        B = len(batch["mask"])
+        target = 1
+        while target < B:
+            target *= 2
+        if target == B:
+            return batch
+        pad = target - B
+        out = {}
+        for k, v in batch.items():
+            shape = (pad,) + v.shape[1:]
+            out[k] = np.concatenate([v, np.zeros(shape, v.dtype)])
+        return out
+
+    def _train_async(self) -> Dict:
+        """Actor-queue loop: every runner keeps a sample() in flight; the learner
+        updates on whichever batch lands first while the rest keep acting
+        (reference IMPALA's async_update + aggregator-actor pipeline,
+        rllib/algorithms/impala/impala.py). Weights are staged every
+        `broadcast_interval` learner updates and ride each runner's next
+        resubmission — no sampling barrier anywhere."""
         import time as _time
 
         t0 = _time.time()
         self.iteration += 1
         c = self.config
-        # Stale-weights broadcast: runners keep acting with the policy from up to
-        # broadcast_interval iterations ago; V-trace corrects the off-policyness.
+        g = self.env_runner_group
+        if not getattr(self, "_async_armed", False):
+            g.set_async_weights(self.learner_group.get_params())
+            # Default request size: one T-length fragment per vector-env lane —
+            # the reference's sampling unit (rollout_fragment_length per env).
+            chunk = getattr(c, "async_chunk_timesteps", 0) or (
+                c.rollout_fragment_length * max(1, c.num_envs_per_env_runner)
+            )
+            g.sample_async_start(chunk)
+            self._async_armed = True
+            self._updates_since_broadcast = 0
+        # Accumulate arrivals up to train_batch_size, then run ONE update cycle
+        # (the reference learner-queue pattern: sample batches concatenate to
+        # train_batch_size per SGD step). Runners keep sampling THROUGH the
+        # update — their next chunks are already in flight.
+        consumed = 0
+        returns_all: list = []
+        lens_all: list = []
+        episodes = 0
+        all_fragments: list = []
+        learner_metrics: Dict[str, float] = {}
+        attempts, max_attempts = 0, 64 * max(1, len(g))
+        while consumed < c.train_batch_size and attempts < max_attempts:
+            attempts += 1
+            arrived = g.sample_async_next()
+            if arrived is None:  # a runner died and was replaced
+                continue
+            rets = arrived.get("episode_returns", np.zeros(0))
+            returns_all.extend(rets.tolist())
+            lens_all.extend(arrived.get("episode_lens", np.zeros(0)).tolist())
+            episodes += len(rets)
+            fragments = arrived.get("fragments", [])
+            all_fragments.extend(fragments)
+            consumed += sum(len(f[Columns.OBS]) for f in fragments)
+        if all_fragments:
+            batch = self._pad_batch_rows(self.postprocess(all_fragments))
+            self._total_timesteps += int(batch["mask"].sum())
+            for _ in range(max(1, getattr(c, "num_epochs", 1))):
+                learner_metrics = self.learner_group.update(batch)
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= max(1, c.broadcast_interval):
+                g.set_async_weights(self.learner_group.get_params())
+                self._updates_since_broadcast = 0
+        self._record_returns(np.asarray(returns_all))
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_timesteps,
+            "episode_return_mean": self._return_mean(),
+            "episode_len_mean": float(np.mean(lens_all)) if lens_all else float("nan"),
+            "episodes_this_iter": episodes,
+            "time_this_iter_s": _time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def train(self) -> Dict:
+        import time as _time
+
+        if getattr(self.config, "sample_async", False):
+            return self._train_async()
+        t0 = _time.time()
+        self.iteration += 1
+        c = self.config
+        # Round-based fallback (sample_async=False): stale-weights broadcast —
+        # runners keep acting with the policy from up to broadcast_interval
+        # iterations ago; V-trace corrects the off-policyness.
         sync = (self.iteration - 1) % max(1, c.broadcast_interval) == 0
         fragments, returns, lens = self._sample_fragments(sync_weights=sync)
         learner_metrics: Dict[str, float] = {}

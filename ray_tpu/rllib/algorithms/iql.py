@@ -1,0 +1,153 @@
+"""IQL: implicit Q-learning for offline RL (Kostrikov et al. 2021).
+
+Design parity: reference `rllib/algorithms/iql/` — expectile-regressed value
+function (never queries out-of-distribution actions), TD-trained twin critics
+against that value, and advantage-weighted-regression policy extraction. All
+three losses run in ONE jitted step over a shared Adam (each sub-loss only sees
+its own param sub-tree via stop-gradients); the frozen critic targets are
+Learner-held state, polyak'd inside the same step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.offline import OfflineAlgorithm
+from ray_tpu.rllib.algorithms.sac import SACModule
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+class IQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IQL)
+        self.offline_data = None
+        self.expectile: float = 0.8      # tau of the expectile value regression
+        self.beta: float = 3.0           # AWR inverse temperature
+        self.adv_clip: float = 100.0     # cap on exp(beta * adv)
+        self.tau: float = 0.005          # polyak for the critic targets
+        self.n_updates_per_iter: int = 50
+        self.lr = 3e-4
+        self.train_batch_size = 2000     # offline rows fetched per iteration
+        self.minibatch_size = 256
+        self.gamma = 0.99
+        self.model = {"hiddens": (256, 256)}
+        self.num_env_runners = 0
+
+    def offline(self, data) -> "IQLConfig":
+        self.offline_data = data
+        return self
+
+
+class IQLModule(SACModule):
+    """SAC's squashed-gaussian policy + twin critics, plus a state-value net.
+
+    Params pytree: {"policy", "q1", "q2", "v"} (no temperature — IQL has none).
+    """
+
+    def __init__(self, obs_dim: int, action_dim: int, hiddens=(256, 256),
+                 action_low=None, action_high=None):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        super().__init__(obs_dim, action_dim, hiddens=hiddens,
+                         action_low=action_low, action_high=action_high)
+
+        class _V(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = obs.astype(jnp.float32)
+                for h in hiddens:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(1)(x)[..., 0]
+
+        self._v = _V()
+
+    def init_params(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        act = jnp.zeros((1, self.action_dim), jnp.float32)
+        return {
+            "policy": self._policy.init(k1, obs),
+            "q1": self._q.init(k2, obs, act),
+            "q2": self._q.init(k3, obs, act),
+            "v": self._v.init(k4, obs),
+        }
+
+    def v_values(self, v_params, obs):
+        return self._v.apply(v_params, obs)
+
+
+def _iql_loss_factory(gamma: float, expectile: float, beta: float, adv_clip: float):
+    def iql_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        sg = jax.lax.stop_gradient
+        obs = batch[Columns.OBS]
+        actions = batch[Columns.ACTIONS]
+        rewards = batch[Columns.REWARDS]
+        next_obs = batch["next_obs"]
+        dones = batch["dones"]
+        target = batch["target_params"]  # frozen twin critics (Learner state)
+
+        # --- value loss: expectile regression toward min target-Q of the
+        # DATASET action — never evaluates out-of-distribution actions.
+        tq1, tq2 = module.q_values(target["q1"], target["q2"], obs, actions)
+        tq = sg(jnp.minimum(tq1, tq2))
+        v = module.v_values(params["v"], obs)
+        u = tq - v
+        w = jnp.where(u < 0, 1.0 - expectile, expectile)
+        v_loss = jnp.mean(w * u * u)
+
+        # --- critic loss: one-step TD against the (detached) value net at s'.
+        next_v = sg(module.v_values(params["v"], next_obs))
+        q_target = sg(rewards + gamma * (1.0 - dones) * next_v)
+        q1, q2 = module.q_values(params["q1"], params["q2"], obs, actions)
+        q_loss = jnp.mean((q1 - q_target) ** 2) + jnp.mean((q2 - q_target) ** 2)
+
+        # --- policy extraction: advantage-weighted regression on dataset actions.
+        adv = tq - sg(v)
+        awr_w = jnp.minimum(jnp.exp(beta * adv), adv_clip)
+        dist_in = module._policy.apply(params["policy"], obs)
+        logp = module.dist_logp(dist_in, actions)
+        pi_loss = -jnp.mean(sg(awr_w) * logp)
+
+        total = v_loss + q_loss + pi_loss
+        return total, {
+            "v_loss": v_loss,
+            "q_loss": q_loss,
+            "pi_loss": pi_loss,
+            "adv_mean": jnp.mean(adv),
+            "awr_weight_mean": jnp.mean(awr_w),
+            "v_mean": jnp.mean(v),
+        }
+
+    return iql_loss
+
+
+class IQL(OfflineAlgorithm, Algorithm):
+    """Offline: train() consumes logged transitions; no env sampling."""
+
+    def _build_module(self, observation_space, action_space, hiddens):
+        obs_dim = int(np.prod(observation_space.shape))
+        return IQLModule(obs_dim, int(np.prod(action_space.shape)),
+                         hiddens=hiddens,
+                         action_low=action_space.low.reshape(-1),
+                         action_high=action_space.high.reshape(-1))
+
+    def loss_fn(self):
+        c = self.config
+        return _iql_loss_factory(c.gamma, c.expectile, c.beta, c.adv_clip)
+
+    def target_spec(self):
+        return ("q1", "q2")
+
+    def target_polyak_tau(self):
+        return self.config.tau

@@ -1,0 +1,140 @@
+"""Offline-RL plumbing shared by BC/MARWIL/CQL/IQL.
+
+Design parity: the role of the reference's offline data pipeline
+(`rllib/offline/offline_data.py`, `offline_prelearner.py`) — feed column batches
+of logged transitions into the learner. Sources: a callable yielding batches, a
+list of batches (round-robin), or a `ray_tpu.data.Dataset` (iter_batches with
+rewind-on-exhaustion, i.e. epochs).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class OfflineData:
+    """Uniform batch source over the three accepted offline-data forms."""
+
+    def __init__(self, data, batch_size: int):
+        if data is None:
+            raise ValueError("offline algorithm requires config.offline_data")
+        self._data = data
+        self._batch_size = batch_size
+        self._iter: Optional[Iterator] = None
+
+    def next(self, iteration: int) -> Dict[str, np.ndarray]:
+        data = self._data
+        if callable(data):
+            batch = data()
+        elif hasattr(data, "iter_batches"):  # ray_tpu.data Dataset
+            if self._iter is None:
+                self._iter = iter(data.iter_batches(batch_size=self._batch_size))
+            try:
+                batch = next(self._iter)
+            except StopIteration:
+                self._iter = iter(data.iter_batches(batch_size=self._batch_size))
+                try:
+                    batch = next(self._iter)
+                except StopIteration:
+                    raise ValueError("offline dataset yielded no batches") from None
+        else:  # list of batches: round-robin
+            batch = data[(iteration - 1) % len(data)]
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class OfflineAlgorithm:
+    """Shared scaffold for offline continuous-control algorithms (CQL, IQL):
+    Box-space probe, an OfflineData source, a fetch-then-minibatch train loop,
+    and greedy evaluation. Subclasses supply the module/loss (Algorithm SPI)
+    plus `_augment_sample` for per-update batch extras (e.g. CQL's rng seed).
+
+    Mixed in BEFORE Algorithm in the MRO: `class CQL(OfflineAlgorithm,
+    Algorithm)`.
+    """
+
+    def __init__(self, config):
+        import gymnasium as gym
+
+        probe = config.env_creator()()
+        try:
+            if not isinstance(probe.action_space, gym.spaces.Box):
+                raise ValueError(
+                    f"{type(self).__name__} requires a Box action space, got "
+                    f"{type(probe.action_space).__name__}"
+                )
+            self._action_dim = int(np.prod(probe.action_space.shape))
+        finally:
+            probe.close()
+        self._pre_build(config)
+        super().__init__(config)
+        self._offline = OfflineData(config.offline_data, config.train_batch_size)
+        self._np_rng = np.random.default_rng(config.seed or 0)
+
+    def _pre_build(self, config) -> None:
+        """Config fix-ups that need the probed action_dim before the module
+        and loss are built (e.g. target_entropy='auto')."""
+
+    def _augment_sample(self, sample: Dict[str, np.ndarray],
+                        update_index: int) -> Dict[str, np.ndarray]:
+        return sample
+
+    def postprocess(self, fragments):  # pragma: no cover - offline only
+        raise NotImplementedError(
+            f"{type(self).__name__} is offline; it does not postprocess rollouts"
+        )
+
+    def train(self) -> Dict[str, float]:
+        import time as _time
+
+        t0 = _time.time()
+        self.iteration += 1
+        c = self.config
+        batch = self._offline.next(self.iteration)
+        n = len(batch["obs"])
+        self._total_timesteps += n
+        learner_metrics: Dict[str, float] = {}
+        mb = min(c.minibatch_size, n)
+        for u in range(c.n_updates_per_iter):
+            idx = self._np_rng.integers(0, n, size=mb)
+            sample = self._augment_sample({k: v[idx] for k, v in batch.items()}, u)
+            learner_metrics = self.learner_group.update(sample)
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_trained_lifetime": self._total_timesteps,
+            "time_this_iter_s": _time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
+        return evaluate_greedy(
+            self._module, self.learner_group.get_params(),
+            self.config.env_creator(), num_episodes,
+        )
+
+
+def evaluate_greedy(module, params, env_fn, num_episodes: int = 5,
+                    seed: int = 1000) -> Dict[str, float]:
+    """Greedy rollouts with the learned policy (reference: Algorithm.evaluate).
+    Uses the module's `dist_greedy` so squashed (SAC-family) and plain gaussian
+    policies both decode correctly."""
+    from ray_tpu.rllib.core.rl_module import Columns
+
+    env = env_fn()
+    rets = []
+    try:
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            done = trunc = False
+            total = 0.0
+            while not (done or trunc):
+                out = module.forward_inference(params, {Columns.OBS: obs[None]})
+                dist_in = np.asarray(out[Columns.ACTION_DIST_INPUTS])[0]
+                action = module.dist_greedy(dist_in)
+                obs, reward, done, trunc, _ = env.step(action)
+                total += float(reward)
+            rets.append(total)
+    finally:
+        env.close()
+    return {"evaluation/episode_return_mean": float(np.mean(rets))}

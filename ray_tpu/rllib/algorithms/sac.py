@@ -144,6 +144,11 @@ class SACModule(RLModule):
         _mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
         return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
 
+    def dist_greedy(self, dist_inputs):
+        """Mode: squash the gaussian mean and rescale to the env bounds."""
+        mean = np.asarray(dist_inputs)[..., : self.action_dim]
+        return self._a_mid + self._a_scale * np.tanh(mean)
+
     # -- loss-facing helpers -------------------------------------------------
     def sample_with_logp(self, policy_params, obs, rng):
         import jax
@@ -223,10 +228,6 @@ class SAC(Algorithm):
     def __init__(self, config):
         import gymnasium as gym
 
-        if config.use_mesh:
-            raise NotImplementedError(
-                "SAC's target params ride inside the training batch; use_mesh=False"
-            )
         probe = config.env_creator()()
         try:
             if not isinstance(probe.action_space, gym.spaces.Box):
@@ -248,8 +249,6 @@ class SAC(Algorithm):
         super().__init__(config)
         self._replay = ReplayBuffer(config.replay_buffer_capacity)
         self._np_rng = np.random.default_rng(config.seed or 0)
-        full = self.learner_group.get_params()
-        self._target_params = {"q1": full["q1"], "q2": full["q2"]}
 
     def _build_module(self, observation_space, action_space, hiddens):
         obs_dim = int(np.prod(observation_space.shape))
@@ -263,22 +262,17 @@ class SAC(Algorithm):
         c = self.config
         return _sac_loss_factory(c.gamma, float(c.target_entropy))
 
+    def target_spec(self):
+        return ("q1", "q2")  # twin critic targets, polyak'd inside the jitted step
+
+    def target_polyak_tau(self):
+        return self.config.tau
+
     def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
         from ray_tpu.rllib.algorithms.dqn import flatten_transitions
 
         batch = flatten_transitions(fragments)
         return {k: v.astype(np.float32) for k, v in batch.items()}
-
-    def _polyak(self):
-        tau = self.config.tau
-        online = self.learner_group.get_params()
-        import jax
-
-        self._target_params = jax.tree_util.tree_map(
-            lambda t, o: (1.0 - tau) * t + tau * o,
-            self._target_params,
-            {"q1": online["q1"], "q2": online["q2"]},
-        )
 
     def train(self) -> Dict:
         import time as _time
@@ -295,12 +289,12 @@ class SAC(Algorithm):
         if len(self._replay) >= c.learning_starts:
             for u in range(c.n_updates_per_iter):
                 sample = self._replay.sample(c.minibatch_size, self._np_rng)
-                sample["target_params"] = self._target_params
                 sample["rng_seed"] = np.array(
                     [self.iteration * 1000 + u], np.int32
                 )
+                # Polyak target update runs inside the same jitted step
+                # (target_polyak_tau) — no per-update host roundtrip.
                 learner_metrics = self.learner_group.update(sample)
-                self._polyak()
         self._record_returns(returns)
         return {
             "training_iteration": self.iteration,
@@ -313,19 +307,3 @@ class SAC(Algorithm):
             **{f"learner/{k}": v for k, v in learner_metrics.items()},
         }
 
-    def save_to_path(self, path: str) -> str:
-        out = super().save_to_path(path)
-        import os
-        import pickle
-
-        with open(os.path.join(path, "sac_state.pkl"), "wb") as f:
-            pickle.dump({"target_params": self._target_params}, f)
-        return out
-
-    def restore_from_path(self, path: str):
-        super().restore_from_path(path)
-        import os
-        import pickle
-
-        with open(os.path.join(path, "sac_state.pkl"), "rb") as f:
-            self._target_params = pickle.load(f)["target_params"]

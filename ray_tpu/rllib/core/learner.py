@@ -15,11 +15,22 @@ import numpy as np
 
 
 class Learner:
-    """Holds params + optimizer state; applies loss_fn minibatch updates, jitted."""
+    """Holds params + optimizer state; applies loss_fn minibatch updates, jitted.
+
+    Target networks are LEARNER state, not batch payload: `target_spec` names the
+    top-level param sub-trees that get a frozen copy ("all" for the whole tree).
+    The jitted update injects that copy into the batch as `batch["target_params"]`
+    INSIDE the traced function with replicated sharding — so `use_mesh`
+    data-parallel learners work for DQN/SAC-style algorithms (the reference keeps
+    targets inside the Learner too, rllib/core/learner/learner.py TARGET_NETWORK
+    handling). With `target_polyak_tau` set, the polyak move
+    `t <- (1-tau) t + tau p` is fused into the same jitted step.
+    """
 
     def __init__(self, module, loss_fn: Callable, *, lr: float = 3e-4,
                  grad_clip: Optional[float] = None, seed: int = 0,
-                 use_mesh: bool = False):
+                 use_mesh: bool = False, target_spec=None,
+                 target_polyak_tau: Optional[float] = None):
         import jax
         import optax
 
@@ -33,7 +44,15 @@ class Learner:
         self._params = module.init_params(jax.random.PRNGKey(seed))
         self._opt_state = self._tx.init(self._params)
         self._use_mesh = use_mesh
-        self._jit_update = None
+        self._target_spec = target_spec
+        self._target_tau = target_polyak_tau
+        self._target = self._target_subset(self._params) if target_spec else None
+        self._jit_cache: Dict[tuple, Any] = {}  # batch signature -> compiled update
+        self._mesh = None
+        if use_mesh:
+            from ray_tpu.parallel import mesh as mesh_lib
+
+            self._mesh = mesh_lib.create_mesh({"dp": -1})
 
     @property
     def params(self):
@@ -42,12 +61,34 @@ class Learner:
     def set_params(self, params):
         self._params = params
 
-    def _build_update(self):
+    def _target_subset(self, params):
+        if self._target_spec == "all":
+            return params
+        return {k: params[k] for k in self._target_spec}
+
+    # -- target state (checkpointing + hard sync) ---------------------------
+    def sync_target(self):
+        """Hard-copy the online params into the target slot (DQN cadence sync)."""
+        if self._target_spec:
+            self._target = self._target_subset(self._params)
+
+    def get_target(self):
+        return self._target
+
+    def set_target(self, target):
+        self._target = target
+
+    def _build_update(self, batch):
         import jax
 
         module, loss_fn, tx = self._module, self._loss_fn, self._tx
+        target_spec, tau = self._target_spec, self._target_tau
 
-        def update(params, opt_state, batch):
+        def update(params, opt_state, target, batch):
+            if target_spec:
+                batch = dict(batch)
+                batch["target_params"] = target
+
             def total_loss(p):
                 return loss_fn(module, p, batch)
 
@@ -56,31 +97,60 @@ class Learner:
             params = jax.tree_util.tree_map(
                 lambda a, u: a + u, params, updates
             )
-            return params, opt_state, loss, metrics
+            if target_spec and tau is not None:
+                target = jax.tree_util.tree_map(
+                    lambda t, o: (1.0 - tau) * t + tau * o,
+                    target, self._target_subset(params),
+                )
+            return params, opt_state, target, loss, metrics
 
         if self._use_mesh:
             # Data-parallel learner over all local devices: batch sharded on dp,
-            # params replicated; XLA inserts the cross-device gradient reductions
-            # (the role NCCL allreduce plays in the reference's DDP learner).
+            # params/targets replicated; XLA inserts the cross-device gradient
+            # reductions (the role NCCL allreduce plays in the reference's DDP
+            # learner). Per-leaf batch shardings: leaves whose leading dim
+            # doesn't divide over dp (e.g. SAC's [1] rng_seed) stay replicated.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ray_tpu.parallel import mesh as mesh_lib
-
-            m = mesh_lib.create_mesh({"dp": -1})
-            data_sharding = NamedSharding(m, P("dp"))
+            m = self._mesh
+            data = NamedSharding(m, P("dp"))
             rep = NamedSharding(m, P())
+            batch_shardings = {
+                k: data if self._leaf_shardable(v) else rep
+                for k, v in batch.items()
+            }
             return jax.jit(
                 update,
-                in_shardings=(rep, rep, data_sharding),
-                out_shardings=(rep, rep, rep, rep),
+                in_shardings=(rep, rep, rep, batch_shardings),
+                out_shardings=(rep, rep, rep, rep, rep),
             )
         return jax.jit(update)
 
+    def _leaf_shardable(self, x) -> bool:
+        shaped = getattr(x, "shape", None)
+        ndev = self._mesh.devices.size
+        return bool(shaped) and len(shaped) >= 1 and shaped[0] > 0 and shaped[0] % ndev == 0
+
+    def _batch_signature(self, batch) -> tuple:
+        """What the compiled update is specialized on. Under a mesh this
+        includes each leaf's shardability (leading-dim divisibility): a batch
+        whose dims stop dividing over dp must rebuild with fresh shardings, not
+        hit a cache entry that would shard it wrong (or crash)."""
+        keys = frozenset(batch.keys())
+        if not self._use_mesh:
+            return (keys,)
+        return (keys, tuple(sorted(k for k, v in batch.items()
+                                   if self._leaf_shardable(v))))
+
     def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
-        if self._jit_update is None:
-            self._jit_update = self._build_update()
-        self._params, self._opt_state, loss, metrics = self._jit_update(
-            self._params, self._opt_state, batch
+        # Keyed cache, not a single slot: workloads that alternate signatures
+        # (epoch tail batches under a mesh) must not recompile on every flip.
+        sig = self._batch_signature(batch)
+        jit_update = self._jit_cache.get(sig)
+        if jit_update is None:
+            jit_update = self._jit_cache[sig] = self._build_update(batch)
+        self._params, self._opt_state, self._target, loss, metrics = jit_update(
+            self._params, self._opt_state, self._target, batch
         )
         out = {k: float(v) for k, v in metrics.items()}
         out["total_loss"] = float(loss)
@@ -93,7 +163,8 @@ class LearnerGroup:
 
     def __init__(self, module_blob: bytes, loss_blob: bytes, *, num_learners: int = 0,
                  lr: float = 3e-4, grad_clip: Optional[float] = None, seed: int = 0,
-                 learner_resources: Optional[dict] = None, use_mesh: bool = False):
+                 learner_resources: Optional[dict] = None, use_mesh: bool = False,
+                 target_spec=None, target_polyak_tau: Optional[float] = None):
         import cloudpickle
 
         self._local: Optional[Learner] = None
@@ -102,13 +173,15 @@ class LearnerGroup:
             self._local = Learner(
                 cloudpickle.loads(module_blob), cloudpickle.loads(loss_blob),
                 lr=lr, grad_clip=grad_clip, seed=seed, use_mesh=use_mesh,
+                target_spec=target_spec, target_polyak_tau=target_polyak_tau,
             )
         else:
             import ray_tpu
 
             res = learner_resources or {"num_cpus": 1}
             cls = ray_tpu.remote(**res)(_LearnerActor)
-            self._actor = cls.remote(module_blob, loss_blob, lr, grad_clip, seed, use_mesh)
+            self._actor = cls.remote(module_blob, loss_blob, lr, grad_clip, seed,
+                                     use_mesh, target_spec, target_polyak_tau)
 
     def update(self, batch) -> Dict[str, float]:
         if self._local is not None:
@@ -132,6 +205,29 @@ class LearnerGroup:
 
             ray_tpu.get(self._actor.set_params.remote(params))
 
+    def sync_target(self):
+        if self._local is not None:
+            self._local.sync_target()
+        else:
+            import ray_tpu
+
+            ray_tpu.get(self._actor.sync_target.remote())
+
+    def get_target(self):
+        if self._local is not None:
+            return self._local.get_target()
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.get_target.remote())
+
+    def set_target(self, target):
+        if self._local is not None:
+            self._local.set_target(target)
+        else:
+            import ray_tpu
+
+            ray_tpu.get(self._actor.set_target.remote(target))
+
     def stop(self):
         if self._actor is not None:
             import ray_tpu
@@ -143,12 +239,14 @@ class LearnerGroup:
 
 
 class _LearnerActor:
-    def __init__(self, module_blob, loss_blob, lr, grad_clip, seed, use_mesh):
+    def __init__(self, module_blob, loss_blob, lr, grad_clip, seed, use_mesh,
+                 target_spec=None, target_polyak_tau=None):
         import cloudpickle
 
         self._learner = Learner(
             cloudpickle.loads(module_blob), cloudpickle.loads(loss_blob),
             lr=lr, grad_clip=grad_clip, seed=seed, use_mesh=use_mesh,
+            target_spec=target_spec, target_polyak_tau=target_polyak_tau,
         )
 
     def update(self, batch):
@@ -159,4 +257,15 @@ class _LearnerActor:
 
     def set_params(self, params):
         self._learner.set_params(params)
+        return True
+
+    def sync_target(self):
+        self._learner.sync_target()
+        return True
+
+    def get_target(self):
+        return self._learner.get_target()
+
+    def set_target(self, target):
+        self._learner.set_target(target)
         return True

@@ -128,6 +128,13 @@ class DefaultActorCriticModule(RLModule):
         _mean, log_std = self._split(dist_inputs)
         return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
 
+    def dist_greedy(self, dist_inputs):
+        """Mode of the action distribution (host-side numpy, for evaluation)."""
+        if self.discrete:
+            return int(np.argmax(dist_inputs))
+        mean, _ = self._split(dist_inputs)
+        return np.asarray(mean)
+
     @staticmethod
     def _split(dist_inputs):
         d = dist_inputs.shape[-1] // 2

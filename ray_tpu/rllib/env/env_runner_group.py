@@ -3,7 +3,13 @@
 Design parity: reference `rllib/env/env_runner_group.py:69` — owns N runner actors,
 broadcasts weights (one object-store put, N refs), gathers sample batches, restarts
 failed runners (the FaultAwareApply role of `rllib/utils/actor_manager.py`).
-"""
+
+The async stream (`sample_async_start`/`sample_async_next`) is the actor-queue
+sampling loop of the reference's IMPALA (`rllib/algorithms/impala/impala.py`
+async_update + aggregator actors): every runner always has a sample() in flight;
+the learner consumes whichever batch lands first and that runner is immediately
+resubmitted — acting and learning genuinely overlap. Weight pushes are versioned
+per-runner and ride the resubmission (no barrier)."""
 
 from __future__ import annotations
 
@@ -26,6 +32,12 @@ class EnvRunnerGroup:
         self._runners = [
             self._make_runner(i) for i in range(max(1, num_env_runners))
         ]
+        # async-stream state
+        self._inflight: Dict[Any, int] = {}       # sample ref -> runner index
+        self._async_timesteps = 0
+        self._weights_ref = None
+        self._weights_version = 0
+        self._runner_version = [0] * len(self._runners)
 
     def _make_runner(self, index: int):
         return self._cls.remote(
@@ -59,7 +71,69 @@ class EnvRunnerGroup:
                 # Re-arm the fresh runner with no weights; caller re-syncs next iter.
         return out
 
+    # -- async actor-queue sampling (IMPALA/APPO) ---------------------------
+    def set_async_weights(self, params) -> None:
+        """Stage new weights for the stream: each runner picks them up at its
+        NEXT resubmission (in-flight samples finish with the stale policy —
+        that's the off-policyness V-trace corrects)."""
+        self._weights_ref = ray_tpu.put(params)
+        self._weights_version += 1
+
+    def sample_async_start(self, timesteps_per_runner: int) -> None:
+        """Arm the stream: push current weights everywhere, one sample() in
+        flight per runner."""
+        if self._weights_ref is None:
+            # Without staged weights every sample() dies on its params assert
+            # and the failure path replaces runners forever — fail loudly here.
+            raise RuntimeError("set_async_weights() before sample_async_start()")
+        ray_tpu.get([
+            r.set_weights.remote(self._weights_ref) for r in self._runners
+        ])
+        self._runner_version = [self._weights_version] * len(self._runners)
+        self._async_timesteps = timesteps_per_runner
+        self._inflight = {
+            r.sample.remote(timesteps_per_runner): i
+            for i, r in enumerate(self._runners)
+        }
+
+    def _resubmit(self, i: int) -> None:
+        r = self._runners[i]
+        if self._weights_ref is not None and self._runner_version[i] != self._weights_version:
+            r.set_weights.remote(self._weights_ref)  # ordered before the sample
+            self._runner_version[i] = self._weights_version
+        self._inflight[r.sample.remote(self._async_timesteps)] = i
+
+    def sample_async_next(self, timeout: float = 300) -> Optional[Dict[str, Any]]:
+        """Block until the FIRST in-flight sample lands, resubmit that runner,
+        return its batch. A dead runner is replaced and resubmitted; returns
+        None for that round (caller just calls again)."""
+        if not self._inflight:
+            raise RuntimeError("sample_async_next before sample_async_start")
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError(f"no env-runner batch within {timeout}s")
+        ref = ready[0]
+        i = self._inflight.pop(ref)
+        try:
+            batch = ray_tpu.get(ref, timeout=timeout)
+        except Exception:
+            try:
+                ray_tpu.kill(self._runners[i])
+            except Exception:
+                pass
+            self._runners[i] = self._make_runner(i)
+            self._runner_version[i] = -1  # force a weight push at resubmission
+            self._resubmit(i)
+            return None
+        self._resubmit(i)
+        return batch
+
+    def sample_async_stop(self) -> None:
+        """Disarm the stream: drop in-flight refs (results are discarded)."""
+        self._inflight = {}
+
     def stop(self):
+        self.sample_async_stop()
         for r in self._runners:
             try:
                 ray_tpu.kill(r)

@@ -115,6 +115,42 @@ def test_kill_actor(ray_start_regular):
         ray_tpu.get(c.incr.remote(), timeout=10)
 
 
+def test_kill_during_creation_releases_resources(ray_start_regular):
+    """kill() while the actor's worker is still being created must not leak the
+    worker or its resource hold (regression: DEAD runners pinned CPUs until the
+    cluster reported 0 available and every later actor went unschedulable)."""
+    import time
+
+    @ray_tpu.remote(num_cpus=1)
+    class SlowInit:
+        def __init__(self):
+            time.sleep(3)  # keep create_actor in flight while kill() lands
+
+        def ping(self):
+            return "pong"
+
+    def cpu_avail():
+        return ray_tpu.available_resources().get("CPU", 0)
+
+    baseline = cpu_avail()
+    actors = [SlowInit.remote() for _ in range(2)]
+    time.sleep(0.3)  # creation definitely started, init still sleeping
+    for a in actors:
+        ray_tpu.kill(a)
+    deadline = time.monotonic() + 30
+    avail = -1.0
+    while time.monotonic() < deadline:
+        avail = cpu_avail()
+        if avail >= baseline:
+            break
+        time.sleep(0.25)
+    assert avail >= baseline, f"leaked CPUs: {avail} available, baseline {baseline}"
+    # and the killed actors are reported dead, not resurrected
+    for a in actors:
+        with pytest.raises(Exception):
+            ray_tpu.get(a.ping.remote(), timeout=10)
+
+
 def test_actor_creation_error(ray_start_regular):
     @ray_tpu.remote
     class BadInit:

