@@ -98,14 +98,27 @@ def init(
         from ray_tpu._private import usage_stats
 
         usage_stats.start_session(session_dir, {"resources": total})
-    elif address.startswith("ray_tpu://"):
+    elif address.startswith(("ray_tpu://", "ray_tpu+proxy://")):
         # Thin client: discover the head raylet via the GCS; no local daemons.
-        host, port = address[len("ray_tpu://"):].split(":")
-        gcs_addr = (host, int(port))
+        # ray_tpu+proxy:// tunnels EVERY dial through a ClientProxy
+        # (util/client/proxier.py; reference: Ray Client's proxier) — the
+        # client only ever reaches the proxy's single public port.
+        via = None
+        if address.startswith("ray_tpu+proxy://"):
+            rest = address[len("ray_tpu+proxy://"):]
+            token = None
+            if "@" in rest:  # ray_tpu+proxy://<token>@host:port
+                token, rest = rest.split("@", 1)
+            host, port = rest.split(":")
+            via = (host, int(port), os.urandom(8).hex(), token)
+            gcs_addr = ("gcs", 0)  # symbolic: the proxy substitutes its GCS
+        else:
+            host, port = address[len("ray_tpu://"):].split(":")
+            gcs_addr = (host, int(port))
         from ray_tpu._private import rpc as _rpclib
 
         async def _head_raylet():
-            conn = await _rpclib.connect(*gcs_addr, name="client-probe")
+            conn = await _rpclib.connect(*gcs_addr, name="client-probe", via=via)
             try:
                 nodes = await conn.call("get_nodes")
             finally:
@@ -128,7 +141,7 @@ def init(
         _usage.start_session(_client_usage_dir(), {"mode": "thin-client"})
         worker = CoreWorker(
             mode="driver", raylet_addr=raylet_addr, gcs_addr=gcs_addr,
-            remote_data_plane=True,
+            remote_data_plane=True, proxy=via,
         )
         set_global_worker(worker)
         worker.connect()

@@ -214,9 +214,33 @@ class RpcServer:
 
 
 async def connect(
-    host: str, port: int, handler: Any = None, name: str = "client", timeout: float = 10.0
+    host: str, port: int, handler: Any = None, name: str = "client",
+    timeout: float = 10.0, via: tuple | None = None,
 ) -> Connection:
-    reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+    """Open a peer connection. `via=(proxy_host, proxy_port, client_id)` tunnels
+    through a client proxy (util/client/proxier.py): the first frame on the wire
+    is a routing envelope naming the real (host, port) target; everything after
+    is the normal symmetric protocol, relayed by the proxy."""
+    if via is not None:
+        proxy_host, proxy_port, client_id = via[0], via[1], via[2]
+        token = via[3] if len(via) > 3 else None
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(proxy_host, proxy_port), timeout
+        )
+        # The envelope is JSON, not pickle: the proxy terminates untrusted
+        # connections and must never unpickle pre-auth client bytes.
+        import json as _json
+
+        env = {"route": [host, int(port)], "client_id": client_id}
+        if token:
+            env["token"] = token
+        payload = _json.dumps(env).encode()
+        writer.write(struct.pack(_LEN_FMT, len(payload)) + payload)
+        await writer.drain()
+    else:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
     return Connection(reader, writer, handler, name=name).start()
 
 

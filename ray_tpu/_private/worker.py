@@ -552,12 +552,17 @@ class CoreWorker:
         worker_id: WorkerID | None = None,
         job_id=None,
         remote_data_plane: bool = False,
+        proxy: tuple | None = None,
     ):
         self.mode = mode
         # Thin-client mode (reference: Ray Client, util/client/): this process
         # runs no local raylet, so plasma traffic rides RPC (put_bytes /
         # read_chunk) to a remote raylet instead of shared memory.
         self.remote_data_plane = remote_data_plane
+        # (host, port, client_id) of a client proxy (util/client/proxier.py):
+        # every control-plane dial tunnels through it (reference: proxier's
+        # per-client routing of the Ray Client data channel).
+        self.proxy = proxy
         self.session_token = os.urandom(8).hex()  # distinguishes init/shutdown cycles
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id: NodeID | None = None
@@ -643,9 +648,13 @@ class CoreWorker:
 
     def connect(self):
         self.raylet = self.io.run(
-            rpc.connect(*self.raylet_addr, handler=self, name=f"{self.mode}->raylet")
+            rpc.connect(*self.raylet_addr, handler=self, name=f"{self.mode}->raylet",
+                        via=self.proxy)
         )
-        self.gcs = self.io.run(rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs"))
+        self.gcs = self.io.run(
+            rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs",
+                        via=self.proxy)
+        )
         direct_port = None
         if not self.remote_data_plane:
             # Direct-call server: peers (owners of actor calls / leased tasks,
@@ -741,7 +750,8 @@ class CoreWorker:
                     raise
                 try:
                     self.gcs = self.io.run(
-                        rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs")
+                        rpc.connect(*self.gcs_addr, handler=self,
+                                    name=f"{self.mode}->gcs", via=self.proxy)
                     )
                 except OSError:
                     time.sleep(0.5)
@@ -1074,6 +1084,14 @@ class CoreWorker:
         rec = self.memory_store.get(ref.id)
         if rec is not None and rec.resolved:
             return True  # inline value present, or plasma object sealed (owner saw completion)
+        owner = ref.owner
+        if rec is not None and (
+            owner is None or owner.get("worker_id") == self.worker_id
+        ):
+            # Self-owned pending object: completion lands in the memstore via
+            # the task-reply/push path, so polling raylet/GCS per wait() cycle
+            # adds pure RPC load (it cannot learn anything the memstore won't).
+            return False
         # Borrowed ref: check the local/global store.
         try:
             info = self.raylet_call("store_info", ref.id)
@@ -1728,7 +1746,8 @@ class CoreWorker:
         if resp and resp.get("ok"):
             try:
                 conn = await rpc.connect(
-                    *resp["direct_addr"], handler=self, name="lease-worker"
+                    *resp["direct_addr"], handler=self, name="lease-worker",
+                    via=self.proxy,
                 )
             except Exception:  # OSError or connect timeout: give the lease back
                 conn = None
@@ -1975,6 +1994,7 @@ class CoreWorker:
                         conn = await rpc.connect(
                             *daddr, handler=self,
                             name=f"direct->{actor_id.hex()[:8]}",
+                            via=self.proxy,
                         )
                     break
                 # PENDING/RESTARTING: wait again

@@ -263,6 +263,34 @@ def cmd_stop(_args):
         pass
 
 
+def cmd_client_proxy(args):
+    """Run a ClientProxy fronting the cluster for ray_tpu+proxy:// clients
+    (reference: util/client/server/proxier.py as `ray client-server`)."""
+    import time as _time
+
+    from ray_tpu.util.client.proxier import serve_proxy
+
+    if args.address:
+        host, port = args.address.split(":")
+        gcs_addr = (host, int(port))
+    else:
+        addr = read_addr()
+        if addr is None:
+            print("no running head found; pass --address host:gcs_port")
+            return
+        gcs_addr = ("127.0.0.1", addr["gcs_port"])
+    proxy, _loop = serve_proxy(gcs_addr, host=args.host, port=args.port,
+                               token=args.token)
+    auth = f"{args.token}@" if args.token else ""
+    print(f"client proxy listening on {args.host}:{proxy.port} "
+          f"(clients: ray_tpu+proxy://{auth}<this-host>:{proxy.port})")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_status(_args):
     import ray_tpu
     from ray_tpu.util import state
@@ -436,6 +464,15 @@ def main(argv=None):
     pl = jsub.add_parser("logs")
     pl.add_argument("job_id")
     pl.set_defaults(fn=cmd_job_logs)
+
+    p = sub.add_parser("client-proxy",
+                       help="proxy ray_tpu+proxy:// clients into the cluster")
+    p.add_argument("--address", help="gcs address host:port (default: local head)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=10001)
+    p.add_argument("--token", help="shared secret clients must present "
+                                   "(ray_tpu+proxy://<token>@host:port)")
+    p.set_defaults(fn=cmd_client_proxy)
 
     sub.add_parser("microbenchmark", help="core op throughput").set_defaults(
         fn=cmd_microbenchmark

@@ -1,0 +1,10 @@
+"""Ray-Client-style remote connectivity.
+
+`proxier.ClientProxy` is the dedicated proxy process (reference:
+`python/ray/util/client/server/proxier.py`) that fronts a cluster for
+`ray_tpu+proxy://` thin clients.
+"""
+
+from ray_tpu.util.client.proxier import ClientProxy, serve_proxy
+
+__all__ = ["ClientProxy", "serve_proxy"]

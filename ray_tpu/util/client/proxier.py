@@ -1,0 +1,289 @@
+"""Client proxy: one public endpoint fronting a whole cluster for thin clients.
+
+Design parity: reference `python/ray/util/client/server/proxier.py` — a dedicated
+proxy process that terminates every external client connection, tracks per-client
+sessions, and isolates clients from each other and from the cluster's internal
+ports. Re-designed for this runtime's symmetric framed-RPC protocol instead of
+gRPC: each tunneled connection opens with a length-prefixed JSON routing
+envelope `{"route": [host, port], "client_id": ..., "token": ...}` (written by
+`rpc.connect(via=...)`), the proxy validates the target against the cluster's
+registered raylet/GCS endpoints (exact host:port), dials it, and relays frames
+verbatim in both directions. Per-client isolation properties:
+
+- clients never learn or reach GCS/raylet/worker ports directly — only the
+  proxy's single public port needs to be reachable (the proxier's main job);
+- every client's tunnels are separate upstream TCP connections tagged with its
+  client_id; one client's disconnect tears down exactly its own tunnels, and
+  the upstream raylet/GCS observe the drop and run their normal driver-death
+  cleanup (leases released, owned objects freed);
+- a control channel (`{"control": true}` envelope) serves ping/list_clients/
+  stats for operators, the reference proxier's Datapath bookkeeping role;
+- the proxy process never unpickles client bytes: envelopes and control frames
+  are JSON, tunneled frames are relayed opaquely. (The reference runs one
+  "SpecificServer" subprocess per client because its server must deserialize
+  client payloads; here that happens only in the client process and in task
+  workers.)
+
+Trust boundary, stated honestly: relayed frames ARE this runtime's pickled RPC
+protocol, and the upstream GCS/raylet unpickle them — exactly as they do for
+any in-cluster peer. The proxy therefore restricts WHO can reach those ports
+(optional shared `token`, checked before any dial) and WHERE they can dial
+(exact registered endpoints), but a client that passes both is trusted the way
+an in-cluster driver is. Expose the proxy port to networks you would let run
+drivers, not the open internet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private import rpc as _rpc
+
+_LEN_FMT = "<Q"
+
+
+async def _read_json_frame(reader: asyncio.StreamReader, max_len: int = 1 << 16) -> Any:
+    header = await reader.readexactly(8)
+    (length,) = struct.unpack(_LEN_FMT, header)
+    if length > max_len:
+        raise ValueError("oversized envelope")
+    return json.loads(await reader.readexactly(length))
+
+
+def _json_frame(msg: Any) -> bytes:
+    payload = json.dumps(msg).encode()
+    return struct.pack(_LEN_FMT, len(payload)) + payload
+
+
+class _ClientSession:
+    __slots__ = ("client_id", "connected_at", "last_seen", "tunnels", "bytes_up",
+                 "bytes_down")
+
+    def __init__(self, client_id: str):
+        self.client_id = client_id
+        self.connected_at = time.time()
+        self.last_seen = self.connected_at
+        self.tunnels = 0
+        self.bytes_up = 0
+        self.bytes_down = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "client_id": self.client_id,
+            "connected_at": self.connected_at,
+            "last_seen": self.last_seen,
+            "tunnels": self.tunnels,
+            "bytes_up": self.bytes_up,
+            "bytes_down": self.bytes_down,
+        }
+
+
+class ClientProxy:
+    """Accepts tunneled client connections and relays them to validated cluster
+    endpoints. Run via `serve_proxy()` or the `ray_tpu client-proxy` CLI."""
+
+    def __init__(self, gcs_addr: Tuple[str, int], *, host: str = "0.0.0.0",
+                 port: int = 0, node_cache_s: float = 5.0,
+                 token: Optional[str] = None):
+        self._gcs_addr = (gcs_addr[0], int(gcs_addr[1]))
+        self._token = token
+        self._host = host
+        self._requested_port = port
+        self._node_cache_s = node_cache_s
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: Optional[int] = None
+        self._sessions: Dict[str, _ClientSession] = {}
+        self._allowed: set = set()
+        self._allowed_at = 0.0
+
+    # ------------------------------------------------------------------ server
+    async def start(self) -> "ClientProxy":
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, self._requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ target policy
+    async def _refresh_allowed(self):
+        """Exact-endpoint allowlist: the GCS itself plus every registered
+        raylet address. Thin clients only ever dial those two service classes
+        (remote_data_plane disables worker-direct fast paths), so anything
+        else — including other ports on cluster hosts — is refused. This is
+        what keeps the proxy from being a generic TCP relay."""
+        now = time.monotonic()
+        if now - self._allowed_at < self._node_cache_s and self._allowed:
+            return
+        conn = await _rpc.connect(*self._gcs_addr, name="proxy-nodes")
+        try:
+            nodes = await conn.call("get_nodes")
+        finally:
+            await conn.close()
+        allowed = {self._gcs_addr}
+        for n in nodes:
+            addr = n.get("address")
+            if addr:
+                allowed.add((addr[0], int(addr[1])))
+        self._allowed = allowed
+        self._allowed_at = now
+
+    async def _target_allowed(self, target: Tuple[str, int]) -> bool:
+        endpoint = (target[0], int(target[1]))
+        if endpoint == self._gcs_addr:
+            return True
+        await self._refresh_allowed()
+        return endpoint in self._allowed
+
+    # ------------------------------------------------------------------ relays
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            envelope = await asyncio.wait_for(_read_json_frame(reader), 15)
+        except Exception:
+            writer.close()
+            return
+        if not isinstance(envelope, dict):
+            writer.close()
+            return
+        if self._token is not None:
+            import hmac
+
+            presented = envelope.get("token")
+            if not isinstance(presented, str) or not hmac.compare_digest(
+                presented.encode(), self._token.encode()
+            ):
+                writer.close()
+                return
+        if envelope.get("control"):
+            await self._serve_control(reader, writer)
+            return
+        route = envelope.get("route")
+        if isinstance(route, list):
+            route = tuple(route)
+        client_id = str(envelope.get("client_id", "anonymous"))
+        if isinstance(route, tuple) and len(route) == 2 and route[0] == "gcs":
+            # Symbolic target: proxy clients know only the proxy's address; the
+            # proxy substitutes its configured GCS (clients never see it).
+            route = self._gcs_addr
+        try:
+            if (not isinstance(route, tuple) or len(route) != 2
+                    or not await self._target_allowed(route)):
+                writer.close()
+                return
+            up_reader, up_writer = await asyncio.wait_for(
+                asyncio.open_connection(route[0], int(route[1])), 15
+            )
+        except Exception:
+            # Validation itself can fail transiently (GCS restarting): fail the
+            # tunnel fast with a reset rather than wedging the client half-open.
+            writer.close()
+            return
+        sess = self._sessions.get(client_id)
+        if sess is None:
+            sess = self._sessions[client_id] = _ClientSession(client_id)
+        sess.tunnels += 1
+
+        async def pump(src, dst, up: bool):
+            try:
+                while True:
+                    chunk = await src.read(1 << 16)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+                    sess.last_seen = time.time()
+                    if up:
+                        sess.bytes_up += len(chunk)
+                    else:
+                        sess.bytes_down += len(chunk)
+            except Exception:
+                pass
+            finally:
+                try:
+                    dst.close()
+                except Exception:
+                    pass
+
+        try:
+            await asyncio.gather(
+                pump(reader, up_writer, True), pump(up_reader, writer, False)
+            )
+        finally:
+            sess.tunnels -= 1
+            if sess.tunnels <= 0:
+                # Last tunnel gone: the client is disconnected. Upstream
+                # raylet/GCS conns just closed with it, which triggers their
+                # normal driver-disconnect cleanup; drop the session record.
+                self._sessions.pop(client_id, None)
+
+    # ----------------------------------------------------------------- control
+    async def _serve_control(self, reader, writer):
+        """Tiny framed request/response loop for operators and tests."""
+        try:
+            while True:
+                req = await _read_json_frame(reader)
+                op = req.get("op")
+                if op == "ping":
+                    resp = {"ok": True, "gcs": self._gcs_addr}
+                elif op == "list_clients":
+                    resp = {"clients": [s.snapshot() for s in self._sessions.values()]}
+                elif op == "stats":
+                    resp = {
+                        "num_clients": len(self._sessions),
+                        "num_tunnels": sum(s.tunnels for s in self._sessions.values()),
+                    }
+                else:
+                    resp = {"error": f"unknown op {op!r}"}
+                writer.write(_json_frame(resp))
+                await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+def serve_proxy(gcs_addr: Tuple[str, int], *, host: str = "0.0.0.0",
+                port: int = 0, token: Optional[str] = None) -> Tuple[ClientProxy, Any]:
+    """Start a proxy on a private IO loop; returns (proxy, io_loop). Blocking
+    callers (CLI) should then sleep/join; tests use proxy.port."""
+    loop = _rpc.IoLoop(name="client-proxy")
+    proxy = ClientProxy(gcs_addr, host=host, port=port, token=token)
+    loop.run(proxy.start(), 30)
+    return proxy, loop
+
+
+def control_call(proxy_addr: Tuple[str, int], op: str, timeout: float = 10.0,
+                 token: Optional[str] = None) -> dict:
+    """One-shot control request against a running proxy (CLI/tests)."""
+    import socket
+
+    env = {"control": True}
+    if token:
+        env["token"] = token
+    with socket.create_connection(proxy_addr, timeout=timeout) as s:
+        s.sendall(_json_frame(env))
+        s.sendall(_json_frame({"op": op}))
+        header = _recv_exact(s, 8)
+        (length,) = struct.unpack(_LEN_FMT, header)
+        return json.loads(_recv_exact(s, length))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("proxy closed control connection")
+        buf += chunk
+    return buf
