@@ -78,6 +78,20 @@ def init(
         total = dict(resources or {})
         if "CPU" not in total:
             total["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+        if "memory" not in total:
+            # Schedulable memory (bytes): host RAM minus the object-store share
+            # (reference: ray auto-advertises `memory` the same way).
+            try:
+                import psutil
+
+                from ray_tpu._private.config import CONFIG as _CFG
+
+                total["memory"] = float(int(
+                    psutil.virtual_memory().total
+                    * (1.0 - _CFG.object_store_memory_fraction)
+                ))
+            except Exception:
+                pass
         from ray_tpu.accelerators import detect_accelerator_resources
 
         for r, amt in detect_accelerator_resources(num_tpus).items():

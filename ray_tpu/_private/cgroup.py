@@ -1,0 +1,252 @@
+"""cgroup-v2 resource isolation for worker processes.
+
+Design parity: reference `src/ray/common/cgroup2/` (SysFsCgroupDriver +
+CgroupManager: a per-session cgroup subtree splitting "system" daemons from
+"workers", memory/cpu controllers enabled, workers placed on spawn and capped
+so a runaway task cannot OOM the raylet/GCS). Re-designed for this runtime:
+
+    <base>/ray_tpu_<session>/
+        system/            raylet + GCS (memory.min reservation)
+        workers/           memory.max = node total - reservation; NO procs —
+                           cgroup-v2's no-internal-process rule forbids member
+                           pids in a cgroup whose subtree_control is enabled
+        workers/shared/    leaf pool where workers actually live
+        workers/w_<pid>/   per-worker leaf when the task/actor declares a
+                           "memory" resource (memory.max = that many bytes)
+
+Setup order matters on real kernels: children are created and the base's
+existing member pids migrate into system/ BEFORE subtree_control is written
+(a cgroup with member procs rejects controller enablement with EBUSY).
+Everything degrades gracefully: on hosts where /sys/fs/cgroup isn't writable
+(non-root, shared CI) `available` is False and the raylet runs exactly as
+before. The sysfs root is injectable (RAY_TPU_CGROUP_BASE) so tests drive the
+full write path against a fake tree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Flag semantics: "auto" = enable iff the base is writable; "1" = required
+# (setup failures are logged loudly); "0" = off.
+ENV_FLAG = "RAY_TPU_CGROUP_ISOLATION"
+ENV_BASE = "RAY_TPU_CGROUP_BASE"
+ENV_RESERVED = "RAY_TPU_CGROUP_SYSTEM_RESERVED_BYTES"
+_DEFAULT_RESERVED = 2 << 30  # memory.min for raylet/GCS (reference default ~2G)
+
+
+class CgroupV2Manager:
+    """Owns one session's cgroup subtree. All methods are best-effort: cgroup
+    writes that fail (race with worker death, controller missing) log through
+    the caller, never raise into scheduling paths."""
+
+    def __init__(self, session_name: str, *, base: Optional[str] = None,
+                 total_memory: Optional[int] = None,
+                 system_reserved: Optional[int] = None):
+        self._base = base or os.environ.get(ENV_BASE) or self._discover_base()
+        self._session_dir = (
+            os.path.join(self._base, f"ray_tpu_{session_name}") if self._base else None
+        )
+        self._system = self._workers = self._shared = None
+        if total_memory is None:
+            total_memory = _host_memory_bytes()
+        self._total_memory = total_memory
+        self._reserved = (
+            system_reserved
+            if system_reserved is not None
+            else int(os.environ.get(ENV_RESERVED, _DEFAULT_RESERVED))
+        )
+        self._active = False
+
+    # -- discovery ---------------------------------------------------------
+    @staticmethod
+    def _discover_base() -> Optional[str]:
+        """The deepest cgroup-v2 dir this process may create children in: its
+        own cgroup (delegated subtrees) or the root mount when running as root."""
+        from ray_tpu._private.memory_monitor import _own_cgroup_v2_path
+
+        for candidate in (_own_cgroup_v2_path(), "/sys/fs/cgroup"):
+            if candidate and os.path.isdir(candidate) and os.access(candidate, os.W_OK):
+                return candidate
+        return None
+
+    @property
+    def available(self) -> bool:
+        return self._active
+
+    # -- lifecycle ---------------------------------------------------------
+    def setup(self) -> bool:
+        """Create the session subtree and enable memory/cpu controllers.
+        Returns True when isolation is active."""
+        if not self._session_dir:
+            return False
+        try:
+            self._reap_stale_siblings()
+            os.makedirs(self._session_dir, exist_ok=True)
+            self._system = os.path.join(self._session_dir, "system")
+            self._workers = os.path.join(self._session_dir, "workers")
+            self._shared = os.path.join(self._workers, "shared")
+            os.makedirs(self._system, exist_ok=True)
+            os.makedirs(self._shared, exist_ok=True)
+            # Migrate the base's member pids (this raylet, co-located daemons)
+            # into system/ FIRST — a cgroup holding procs rejects
+            # subtree_control writes (no-internal-process rule).
+            self._migrate_base_procs()
+            for d in (self._base, self._session_dir, self._workers):
+                self._enable_controllers(d)
+            # Reserve memory for the control plane; cap the worker pool at the
+            # remainder so worker pressure lands on workers, not the raylet.
+            self._write(os.path.join(self._system, "memory.min"),
+                        str(self._reserved))
+            if self._total_memory:
+                cap = max(self._total_memory - self._reserved, 256 << 20)
+                self._write(os.path.join(self._workers, "memory.max"), str(cap))
+            self._active = True
+            return True
+        except OSError:
+            self._active = False
+            return False
+
+    def _migrate_base_procs(self) -> None:
+        procs = os.path.join(self._base, "cgroup.procs")
+        try:
+            with open(procs) as f:
+                pids = [p.strip() for p in f if p.strip()]
+        except OSError:
+            return  # base is the cgroupfs root (kernel hides procs) or gone
+        for pid in pids:
+            self._write(os.path.join(self._system, "cgroup.procs"), pid)
+
+    def _reap_stale_siblings(self) -> None:
+        """rmdir leftover ray_tpu_* trees whose processes are gone (empty
+        cgroups remove cleanly; live ones refuse with EBUSY and are kept)."""
+        try:
+            entries = os.listdir(self._base)
+        except OSError:
+            return
+        for name in entries:
+            if not name.startswith("ray_tpu_") or name == os.path.basename(
+                self._session_dir or ""
+            ):
+                continue
+            top = os.path.join(self._base, name)
+            for root, dirs, _files in os.walk(top, topdown=False):
+                for d in dirs:
+                    try:
+                        os.rmdir(os.path.join(root, d))
+                    except OSError:
+                        pass
+            try:
+                os.rmdir(top)
+            except OSError:
+                pass
+
+    def place_system_process(self, pid: int) -> bool:
+        """Move a control-plane process (raylet, GCS) into system/."""
+        if not self._active:
+            return False
+        return self._write(os.path.join(self._system, "cgroup.procs"), str(pid))
+
+    def place_worker(self, pid: int, *, memory_bytes: Optional[int] = None,
+                     cpu_weight: Optional[int] = None) -> bool:
+        """Place a worker: the shared pool by default, a dedicated capped
+        sub-group when the task/actor declared a memory resource."""
+        if not self._active:
+            return False
+        if memory_bytes or cpu_weight:
+            d = os.path.join(self._workers, f"w_{pid}")
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError:
+                return False
+            if memory_bytes:
+                self._write(os.path.join(d, "memory.max"), str(int(memory_bytes)))
+            if cpu_weight:
+                self._write(os.path.join(d, "cpu.weight"), str(int(cpu_weight)))
+            return self._write(os.path.join(d, "cgroup.procs"), str(pid))
+        # Leaf pool, not workers/ itself: workers/ has subtree_control enabled
+        # and therefore cannot hold member pids (no-internal-process rule).
+        return self._write(os.path.join(self._shared, "cgroup.procs"), str(pid))
+
+    def remove_worker(self, pid: int) -> None:
+        """Reap a dead worker's dedicated sub-group (empty cgroups rmdir)."""
+        if not self._active:
+            return
+        d = os.path.join(self._workers, f"w_{pid}")
+        if os.path.isdir(d):
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass  # still has procs or already gone
+
+    def teardown(self) -> None:
+        if not self._active or not self._session_dir:
+            return
+        # Best-effort: move this process back to the base so system/ empties.
+        # Fails (EBUSY) when the base's subtree_control was enabled by setup —
+        # then the tree lingers until the next session's stale reap.
+        self._write(os.path.join(self._base, "cgroup.procs"), str(os.getpid()))
+        for sub in (self._shared, self._system, self._workers, self._session_dir):
+            try:
+                if sub and os.path.isdir(sub):
+                    for child in os.listdir(sub):
+                        p = os.path.join(sub, child)
+                        if os.path.isdir(p):
+                            try:
+                                os.rmdir(p)
+                            except OSError:
+                                pass
+                    os.rmdir(sub)
+            except OSError:
+                pass
+        self._active = False
+
+    # -- helpers -----------------------------------------------------------
+    def _enable_controllers(self, path: str) -> None:
+        # The kernel materializes cgroup.subtree_control in every cgroup dir;
+        # writing may still fail when the controller isn't delegated — then
+        # limits simply won't apply (isolation stays best-effort).
+        try:
+            with open(os.path.join(path, "cgroup.subtree_control"), "w") as f:
+                f.write("+memory +cpu")
+        except OSError:
+            pass
+
+    @staticmethod
+    def _write(path: str, value: str) -> bool:
+        try:
+            with open(path, "w") as f:
+                f.write(value)
+            return True
+        except OSError:
+            return False
+
+
+def _host_memory_bytes() -> Optional[int]:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
+
+
+def manager_from_env(session_name: str) -> Optional[CgroupV2Manager]:
+    """Build + set up a manager per the env flag; None when disabled/unavailable."""
+    flag = os.environ.get(ENV_FLAG, "auto").lower()
+    if flag in ("0", "false", "off"):
+        return None
+    mgr = CgroupV2Manager(session_name)
+    if mgr.setup():
+        return mgr
+    if flag in ("1", "true", "on", "required"):
+        import logging
+
+        logging.getLogger("ray_tpu.cgroup").warning(
+            "cgroup isolation requested (%s=1) but setup failed at base %r",
+            ENV_FLAG, mgr._base,
+        )
+    return None

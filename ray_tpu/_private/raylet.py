@@ -192,6 +192,20 @@ class Raylet:
         self.labels = labels or {}
         self.session_dir = session_dir
         self.worker_env = worker_env or {}
+        resources = dict(resources)
+        if "memory" not in resources:
+            # Every node advertises schedulable memory (bytes) so
+            # @remote(memory=N) is feasible however the node was started —
+            # init(), `ray_tpu start`, the YAML launcher, or cluster_utils.
+            try:
+                import psutil
+
+                resources["memory"] = float(int(
+                    psutil.virtual_memory().total
+                    * (1.0 - CONFIG.object_store_memory_fraction)
+                ))
+            except Exception:
+                pass
         self.resources = ResourceManager(resources)
         if object_store_bytes is None:
             try:
@@ -245,9 +259,19 @@ class Raylet:
         self._venv_python: dict[str, str] = {}
         self._venv_failed: dict[str, tuple[str, float]] = {}  # key -> (err, at)
         self._venv_building: set[str] = set()
+        self._env_specs: dict[str, dict] = {}  # env key -> its runtime_env
         self._gcs_connected_at = time.monotonic()  # refreshed on every (re)connect
         self._full_node_view: dict[NodeID, dict] = {}  # incl. alive=False nodes
         self._shutdown = False
+        # cgroup-v2 worker isolation (reference src/ray/common/cgroup2/):
+        # active only where the cgroupfs is writable; the raylet itself moves
+        # into the reserved "system" group so worker memory pressure can't
+        # starve the control plane.
+        from ray_tpu._private.cgroup import manager_from_env
+
+        self._cgroup = manager_from_env(node_id.hex()[:12])
+        if self._cgroup is not None:
+            self._cgroup.place_system_process(os.getpid())
 
     # ------------------------------------------------------------------ startup
 
@@ -452,13 +476,35 @@ class Raylet:
         # Unbuffered so crash tracebacks reach the log file even on abrupt death
         # (reference: worker stdout/stderr files tailed by log_monitor.py).
         env["PYTHONUNBUFFERED"] = "1"
+        renv = self._env_specs.get(env_key) if env_key else None
+        if renv and renv.get("image_uri"):
+            # Containerized worker (reference runtime_env/image_uri.py): the
+            # engine runs on the host; host network/IPC keeps raylet RPC and
+            # the shm object store reachable. PYTHONPATH stays host-side —
+            # the image must contain ray_tpu.
+            from ray_tpu._private import runtime_env as runtime_env_mod
+
+            passthrough = {k: v for k, v in env.items()
+                           if k.startswith("RAY_TPU_") or k == "PYTHONUNBUFFERED"
+                           or k in self.worker_env}
+            cmd = runtime_env_mod.container_command(
+                renv, session_dir=self.session_dir, env=passthrough,
+            )
+        else:
+            cmd = [python_exe or sys.executable,
+                   "-m", "ray_tpu._private.default_worker"]
         proc = subprocess.Popen(
-            [python_exe or sys.executable, "-m", "ray_tpu._private.default_worker"],
+            cmd,
             env=env,
             stdout=out,
             stderr=subprocess.STDOUT,
         )
         out.close()  # child owns its duplicated fd; don't leak one per spawn
+        if self._cgroup is not None and not (renv and renv.get("image_uri")):
+            # Containerized workers: proc is the engine CLI, not the worker —
+            # the engine owns the container's cgroup, placing the client pid
+            # would cap the wrong process.
+            self._cgroup.place_worker(proc.pid)
         handle = WorkerHandle(worker_id, proc, kind, env_key=env_key, log_path=log_path)
         self.workers[worker_id] = handle
         return handle
@@ -492,7 +538,7 @@ class Raylet:
         if failed is not None:
             err, at = failed
             if time.monotonic() - at < 60.0:
-                raise RuntimeError(f"runtime_env pip install failed: {err}")
+                raise RuntimeError(f"runtime_env setup failed: {err}")
             # Retry window: a transient failure (wheel house mid-populate, disk
             # pressure) must not poison the env forever.
             self._venv_failed.pop(key, None)
@@ -500,8 +546,21 @@ class Raylet:
             self._venv_building.add(key)
             loop = asyncio.get_running_loop()
             renv = spec["runtime_env"]
+            self._env_specs[key] = renv
 
             def build():
+                if "conda" in renv:
+                    return runtime_env_mod.ensure_conda_env(
+                        renv, self._venv_cache_root()
+                    )
+                if "image_uri" in renv:
+                    # No python to build — just fail fast here when no
+                    # container engine exists on this node (the spawn would
+                    # otherwise die repeatedly and opaquely).
+                    runtime_env_mod.container_command(
+                        renv, session_dir=self.session_dir, env={}
+                    )
+                    return None
                 return runtime_env_mod.ensure_pip_env(renv, self._venv_cache_root())
 
             fut = loop.run_in_executor(None, build)
@@ -748,6 +807,8 @@ class Raylet:
     def _on_worker_lost(self, handle: WorkerHandle):
         """Worker connection dropped: fail or retry its in-flight work."""
         self.workers.pop(handle.worker_id, None)
+        if self._cgroup is not None and handle.proc is not None:
+            self._cgroup.remove_worker(handle.proc.pid)
         if handle.acquired:
             self.resources.release(handle.acquired, handle.pg_key)
             handle.acquired = {}
@@ -1730,7 +1791,10 @@ class Raylet:
             self.resources.release(demand, pg_key)
             await self._kill_worker(handle)
 
-        handle = self._spawn_worker(kind="actor", python_exe=python_exe)
+        handle = self._spawn_worker(
+            kind="actor", python_exe=python_exe,
+            env_key=runtime_env_mod.env_key(spec.get("runtime_env")),
+        )
         try:
             await asyncio.wait_for(handle.registered.wait(), CONFIG.worker_register_timeout_s)
         except asyncio.TimeoutError:
@@ -1752,6 +1816,13 @@ class Raylet:
             # Application error in __init__: retrying cannot help.
             return {"ok": False, "reason": result.get("error", "init failed"), "fatal": True}
         handle.actor_id = actor_id
+        if (self._cgroup is not None and handle.proc is not None
+                and demand.get("memory")
+                and not (spec.get("runtime_env") or {}).get("image_uri")):
+            # A declared memory resource becomes a hard per-worker memory.max
+            # (native workers only: for containers, proc is the engine CLI).
+            self._cgroup.place_worker(handle.proc.pid,
+                                      memory_bytes=int(demand["memory"]))
         owner_wid = (spec.get("owner") or {}).get("worker_id")
         handle.log_owner = owner_wid.hex() if hasattr(owner_wid, "hex") else None
         self.actors[actor_id] = handle.worker_id
@@ -1942,3 +2013,5 @@ class Raylet:
         if self.server is not None:
             await self.server.close()
         self.store.destroy()
+        if self._cgroup is not None:
+            self._cgroup.teardown()

@@ -27,7 +27,8 @@ import sys
 import threading
 from typing import Any, Dict, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
+              "image_uri"}
 
 
 def _normalize_pip(spec) -> Dict[str, Any]:
@@ -61,6 +62,23 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         runtime_env["pip"] = runtime_env.pop("uv")
     if "pip" in runtime_env:
         runtime_env["pip"] = _normalize_pip(runtime_env["pip"])
+    if "conda" in runtime_env:
+        conda = runtime_env["conda"]
+        if not (isinstance(conda, str)
+                or (isinstance(conda, dict) and isinstance(
+                    conda.get("dependencies"), list))):
+            raise ValueError(
+                'runtime_env conda must be an env name or {"dependencies": [...]}'
+            )
+        if "pip" in runtime_env:
+            raise ValueError("pass either pip or conda, not both")
+    if "image_uri" in runtime_env:
+        if not isinstance(runtime_env["image_uri"], str):
+            raise ValueError("runtime_env image_uri must be a string")
+        if "pip" in runtime_env or "conda" in runtime_env:
+            # The image defines the interpreter environment wholesale
+            # (reference image_uri.py: container excludes pip/conda).
+            raise ValueError("image_uri cannot be combined with pip/conda")
     env_vars = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be str -> str")
@@ -201,9 +219,13 @@ def env_key(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     """Stable key for the parts of a runtime_env that require a DEDICATED worker
     process (a different interpreter); None means any vanilla worker can serve
     it (env_vars/working_dir/py_modules apply in-process)."""
-    if not runtime_env or "pip" not in runtime_env:
+    if not runtime_env:
         return None
-    blob = json.dumps(runtime_env["pip"], sort_keys=True).encode()
+    dedicated = {k: runtime_env[k] for k in ("pip", "conda", "image_uri")
+                 if k in runtime_env}
+    if not dedicated:
+        return None
+    blob = json.dumps(dedicated, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
@@ -282,3 +304,94 @@ def ensure_pip_env(runtime_env: Dict[str, Any], cache_root: str) -> str:
 
         shutil.rmtree(path, ignore_errors=True)
         raise
+
+
+def ensure_conda_env(runtime_env: Dict[str, Any], cache_root: str,
+                     conda_exe: Optional[str] = None) -> str:
+    """Resolve (named env) or materialize (spec dict) a conda env; returns its
+    python path. Parity: reference `python/ray/_private/runtime_env/conda.py` —
+    named envs resolve against the local conda install, spec dicts build cached
+    envs keyed by content hash."""
+    import shutil
+
+    conda_exe = conda_exe or shutil.which("conda") or shutil.which("mamba") \
+        or shutil.which("micromamba")
+    if conda_exe is None:
+        raise RuntimeError(
+            "runtime_env conda requires a conda/mamba install on every node"
+        )
+    spec = runtime_env["conda"]
+    if isinstance(spec, str):
+        # Named env: ask conda where its envs live.
+        proc = subprocess.run([conda_exe, "info", "--base"],
+                              capture_output=True, timeout=60, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"conda info --base failed: {proc.stderr[-500:]}")
+        base = proc.stdout.strip()
+        python = os.path.join(base, "envs", spec, "bin", "python")
+        if not os.path.exists(python):
+            raise RuntimeError(f"conda env {spec!r} not found under {base}/envs")
+        return python
+    key = env_key({"conda": spec})
+    final = os.path.join(cache_root, f"conda_{key}")
+    python = os.path.join(final, "bin", "python")
+    if os.path.exists(os.path.join(final, ".ready")):
+        return python
+    os.makedirs(cache_root, exist_ok=True)
+    build = final + f".build{os.getpid()}"
+    yml = build + ".yml"
+    try:
+        import json as _json
+
+        with open(yml, "w") as f:
+            # environment.yml is YAML, but flow-style JSON is valid YAML 1.2 —
+            # no yaml dependency needed to emit {"dependencies": [...]}.
+            f.write(_json.dumps({"dependencies": spec["dependencies"]}))
+        proc = subprocess.run(
+            [conda_exe, "env", "create", "-y", "-p", build, "-f", yml],
+            capture_output=True, timeout=1800, text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"conda env create failed: {proc.stderr[-2000:]}")
+        with open(os.path.join(build, ".ready"), "w") as f:
+            f.write(key or "")
+        try:
+            os.rename(build, final)
+        except OSError:
+            import shutil as _sh
+
+            _sh.rmtree(build, ignore_errors=True)
+            if not os.path.exists(os.path.join(final, ".ready")):
+                raise
+        return python
+    finally:
+        try:
+            os.remove(yml)
+        except OSError:
+            pass
+
+
+def container_command(runtime_env: Dict[str, Any], *, session_dir: str,
+                      env: Dict[str, str], engine: Optional[str] = None) -> list:
+    """Build the host command that launches a worker inside the runtime_env's
+    container image. Parity: reference `runtime_env/image_uri.py` — the image
+    must contain ray_tpu; host networking + IPC so the worker reaches the
+    raylet's ports and the shared-memory object store exactly like a native
+    worker; the session dir is mounted for runtime-env artifacts."""
+    import shutil
+
+    engine = engine or shutil.which("podman") or shutil.which("docker")
+    if engine is None:
+        raise RuntimeError(
+            "runtime_env image_uri requires podman or docker on every node"
+        )
+    image = runtime_env["image_uri"]
+    for prefix in ("docker://",):
+        if image.startswith(prefix):
+            image = image[len(prefix):]
+    cmd = [engine, "run", "--rm", "--network=host", "--ipc=host",
+           "-v", f"{session_dir}:{session_dir}"]
+    for k, v in env.items():
+        cmd += ["--env", f"{k}={v}"]
+    cmd += [image, "python3", "-m", "ray_tpu._private.default_worker"]
+    return cmd

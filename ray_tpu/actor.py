@@ -18,6 +18,7 @@ from ray_tpu.remote_function import _build_pg_spec, _build_resources, _resolve_s
 _ACTOR_DEFAULTS = {
     "num_cpus": 0,
     "num_tpus": 0,
+    "memory": None,  # bytes; schedulable + enforced via cgroup-v2 where active
     "resources": None,
     "name": None,
     "namespace": None,

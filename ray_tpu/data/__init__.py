@@ -65,6 +65,32 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return _read(BinaryDatasource(paths), parallelism)
 
 
+def read_lance(uri: str, *, columns=None, filter=None, parallelism: int = -1,
+               **kw) -> Dataset:
+    from ray_tpu.data.ext_datasources import LanceDatasource
+
+    return _read(LanceDatasource(uri, columns=columns, filter=filter, **kw),
+                 parallelism)
+
+
+def read_iceberg(table_identifier: str, *, row_filter=None,
+                 selected_fields=("*",), snapshot_id=None, catalog_kwargs=None,
+                 parallelism: int = -1, **kw) -> Dataset:
+    from ray_tpu.data.ext_datasources import IcebergDatasource
+
+    return _read(IcebergDatasource(
+        table_identifier, row_filter=row_filter, selected_fields=selected_fields,
+        snapshot_id=snapshot_id, catalog_kwargs=catalog_kwargs, **kw), parallelism)
+
+
+def read_bigquery(project_id: str, *, dataset=None, query=None,
+                  parallelism: int = -1, **kw) -> Dataset:
+    from ray_tpu.data.ext_datasources import BigQueryDatasource
+
+    return _read(BigQueryDatasource(project_id, dataset=dataset, query=query, **kw),
+                 parallelism)
+
+
 def from_pandas(dfs) -> Dataset:
     import pyarrow as pa
 
@@ -119,9 +145,12 @@ __all__ = [
     "from_pandas",
     "range",
     "read_binary_files",
+    "read_bigquery",
     "read_csv",
     "read_datasource",
+    "read_iceberg",
     "read_json",
+    "read_lance",
     "read_parquet",
     "read_text",
 ]

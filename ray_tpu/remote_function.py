@@ -14,6 +14,7 @@ from ray_tpu._private.worker import global_worker
 _DEFAULTS = {
     "num_cpus": 1,
     "num_tpus": 0,
+    "memory": None,  # bytes; schedulable + enforced via cgroup-v2 where active
     "resources": None,
     "num_returns": 1,
     "max_retries": None,
@@ -31,6 +32,8 @@ def _build_resources(opts) -> dict:
         resources["CPU"] = float(opts["num_cpus"])
     if opts.get("num_tpus"):
         resources["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory"):
+        resources["memory"] = float(opts["memory"])
     return {r: amt for r, amt in resources.items() if amt}
 
 

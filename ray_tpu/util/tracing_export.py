@@ -1,0 +1,183 @@
+"""OpenTelemetry export for distributed traces.
+
+Design parity: reference `python/ray/util/tracing/tracing_helper.py:36-60` —
+spans recorded around remote calls flow to an OpenTelemetry backend. Here spans
+already ride the task-event pipeline (util/tracing.py: every event of a traced
+call carries trace_id/span_id/parent_span_id), so export is a pure transform:
+pair each task's RUNNING -> FINISHED/FAILED events into spans and emit them as
+OTLP. Two sinks, no SDK dependency:
+
+- `export_otlp_http(endpoint)` POSTs OTLP/JSON to any OpenTelemetry collector's
+  HTTP receiver (`/v1/traces`), built with urllib only — works wherever an
+  otel-collector is reachable, regardless of which otel packages are installed.
+- `export_otlp_file(path)` writes the same OTLP/JSON payload to disk (replay
+  with `otel-cli` / collector `filelogreceiver`, or inspect directly).
+
+If the full `opentelemetry-sdk` happens to be installed, `spans_to_otel(spans)`
+also re-emits them through the user's configured global TracerProvider, so
+existing OTel pipelines (sampling, processors) apply unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+_UNSET = 0  # OTLP enums (trace/v1/trace.proto): STATUS_CODE_UNSET
+_ERROR = 2  # STATUS_CODE_ERROR
+
+
+def spans_from_task_events(events: List[dict]) -> List[dict]:
+    """Pair per-task lifecycle events into spans. Only traced events (those
+    carrying a trace_id) produce spans; SUBMITTED time is attached as the
+    queueing attribute when present."""
+    starts: Dict[str, dict] = {}
+    submitted: Dict[str, dict] = {}
+    spans: List[dict] = []
+    for e in events:
+        if not e.get("trace_id"):
+            continue
+        tid = e.get("task_id")
+        state = e.get("state")
+        if state == "SUBMITTED":
+            submitted[tid] = e
+        elif state == "RUNNING":
+            starts[tid] = e
+        elif state in ("FINISHED", "FAILED") and tid in starts:
+            s = starts.pop(tid)
+            sub = submitted.pop(tid, None)
+            spans.append({
+                "trace_id": s["trace_id"],
+                "span_id": s.get("span_id") or tid[:16],
+                "parent_span_id": s.get("parent_span_id"),
+                "name": e.get("name") or s.get("name") or "task",
+                "start_s": s["time"],
+                "end_s": e["time"],
+                "ok": state == "FINISHED",
+                "attributes": {
+                    "ray_tpu.task_id": tid,
+                    "ray_tpu.worker_id": s.get("worker_id"),
+                    **({"ray_tpu.submitted_s": sub["time"]} if sub else {}),
+                },
+            })
+    return spans
+
+
+def _otlp_attr(key: str, value) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def to_otlp_json(spans: List[dict], service_name: str = "ray_tpu") -> dict:
+    """OTLP/JSON ExportTraceServiceRequest (opentelemetry-proto JSON mapping:
+    ids hex-encoded, times in unix nanos as strings)."""
+    otlp_spans = []
+    for s in spans:
+        span = {
+            "traceId": s["trace_id"],
+            "spanId": s["span_id"],
+            "name": s["name"],
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(s["start_s"] * 1e9)),
+            "endTimeUnixNano": str(int(s["end_s"] * 1e9)),
+            "attributes": [
+                _otlp_attr(k, v) for k, v in (s.get("attributes") or {}).items()
+                if v is not None
+            ],
+            "status": {"code": _UNSET if s.get("ok", True) else _ERROR},
+        }
+        if s.get("parent_span_id"):
+            span["parentSpanId"] = s["parent_span_id"]
+        otlp_spans.append(span)
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [_otlp_attr("service.name", service_name)]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.util.tracing"},
+                "spans": otlp_spans,
+            }],
+        }]
+    }
+
+
+def _fetch_events(events: Optional[List[dict]]) -> List[dict]:
+    if events is not None:
+        return events
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs_call("list_task_events", 100000)
+
+
+def export_otlp_http(endpoint: str, *, events: Optional[List[dict]] = None,
+                     service_name: str = "ray_tpu", timeout: float = 30.0) -> int:
+    """POST the cluster's traced spans to an OTLP/HTTP collector. `endpoint` is
+    the collector base (e.g. "http://collector:4318") or a full /v1/traces URL.
+    Returns the number of spans exported."""
+    spans = spans_from_task_events(_fetch_events(events))
+    if not spans:
+        return 0
+    url = endpoint if endpoint.endswith("/v1/traces") else (
+        endpoint.rstrip("/") + "/v1/traces"
+    )
+    body = json.dumps(to_otlp_json(spans, service_name)).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if resp.status >= 300:
+            raise RuntimeError(f"OTLP export failed: HTTP {resp.status}")
+    return len(spans)
+
+
+def export_otlp_file(path: str, *, events: Optional[List[dict]] = None,
+                     service_name: str = "ray_tpu") -> int:
+    """Write the cluster's traced spans as an OTLP/JSON document."""
+    spans = spans_from_task_events(_fetch_events(events))
+    with open(path, "w") as f:
+        json.dump(to_otlp_json(spans, service_name), f)
+    return len(spans)
+
+
+def spans_to_otel(spans: List[dict]) -> int:
+    """Re-emit spans through an installed opentelemetry-sdk TracerProvider (if
+    the user configured one); returns spans emitted. Requires the optional
+    `opentelemetry-sdk` package — the OTLP/HTTP path above does not."""
+    try:
+        from opentelemetry import trace as otel_trace
+        from opentelemetry.trace import SpanContext, TraceFlags, NonRecordingSpan
+        import opentelemetry.context as otel_ctx
+    except ImportError as e:  # pragma: no cover - api package is present here
+        raise RuntimeError("opentelemetry api not installed") from e
+    tracer = otel_trace.get_tracer("ray_tpu.util.tracing")
+    n = 0
+    for s in spans:
+        parent_ctx = None
+        if s.get("parent_span_id"):
+            parent_ctx = otel_trace.set_span_in_context(NonRecordingSpan(SpanContext(
+                trace_id=int(s["trace_id"], 16),
+                span_id=int(s["parent_span_id"], 16),
+                is_remote=True,
+                trace_flags=TraceFlags(TraceFlags.SAMPLED),
+            )))
+        span = tracer.start_span(
+            s["name"], context=parent_ctx,
+            start_time=int(s["start_s"] * 1e9),
+            attributes={k: v for k, v in (s.get("attributes") or {}).items()
+                        if v is not None},
+        )
+        if not s.get("ok", True):
+            from opentelemetry.trace import Status, StatusCode
+
+            span.set_status(Status(StatusCode.ERROR))
+        span.end(end_time=int(s["end_s"] * 1e9))
+        n += 1
+    return n

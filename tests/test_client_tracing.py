@@ -108,6 +108,96 @@ def test_tracing_spans_propagate(ray_start_isolated):
         tracing.disable()
 
 
+def test_otlp_span_conversion():
+    """Task events -> OTLP/JSON spans: pairing, parenting, error status, and
+    the proto JSON mapping (hex ids, nano strings)."""
+    from ray_tpu.util.tracing_export import spans_from_task_events, to_otlp_json
+
+    t = 1000.0
+    events = [
+        {"task_id": "a" * 24, "name": "parent", "state": "SUBMITTED", "time": t,
+         "trace_id": "f" * 32, "span_id": "1" * 16, "worker_id": "w1"},
+        {"task_id": "a" * 24, "name": "parent", "state": "RUNNING", "time": t + 0.5,
+         "trace_id": "f" * 32, "span_id": "1" * 16, "worker_id": "w1"},
+        {"task_id": "b" * 24, "name": "child", "state": "RUNNING", "time": t + 1,
+         "trace_id": "f" * 32, "span_id": "2" * 16,
+         "parent_span_id": "1" * 16, "worker_id": "w2"},
+        {"task_id": "b" * 24, "name": "child", "state": "FAILED", "time": t + 2,
+         "trace_id": "f" * 32, "span_id": "2" * 16,
+         "parent_span_id": "1" * 16, "worker_id": "w2"},
+        {"task_id": "a" * 24, "name": "parent", "state": "FINISHED", "time": t + 3,
+         "trace_id": "f" * 32, "span_id": "1" * 16, "worker_id": "w1"},
+        # untraced event: must not produce a span
+        {"task_id": "c" * 24, "name": "plain", "state": "RUNNING", "time": t},
+    ]
+    spans = spans_from_task_events(events)
+    assert {s["name"] for s in spans} == {"parent", "child"}
+    child = next(s for s in spans if s["name"] == "child")
+    assert child["parent_span_id"] == "1" * 16 and not child["ok"]
+    parent = next(s for s in spans if s["name"] == "parent")
+    assert parent["attributes"]["ray_tpu.submitted_s"] == t
+
+    otlp = to_otlp_json(spans, service_name="svc")
+    scope_spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(scope_spans) == 2
+    oc = next(s for s in scope_spans if s["name"] == "child")
+    assert oc["traceId"] == "f" * 32 and oc["parentSpanId"] == "1" * 16
+    assert oc["status"]["code"] == 2  # STATUS_CODE_ERROR
+    assert oc["startTimeUnixNano"] == str(int((t + 1) * 1e9))
+
+
+def test_otlp_http_export_end_to_end(ray_start_isolated):
+    """Traced cluster spans POST to an OTLP/HTTP collector (in-process stub)."""
+    import http.server
+    import json as _json
+    import threading
+
+    from ray_tpu.util import tracing
+    from ray_tpu.util.tracing_export import export_otlp_http
+
+    received = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, _json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    tracing.enable()
+    try:
+
+        @ray_tpu.remote
+        def traced(x):
+            return x * 2
+
+        with tracing.trace("export-test"):
+            assert ray_tpu.get(traced.remote(5), timeout=120) == 10
+
+        w = ray_tpu.global_worker()
+        deadline = time.monotonic() + 30
+        n = 0
+        while time.monotonic() < deadline:
+            n = export_otlp_http(f"http://127.0.0.1:{srv.server_port}")
+            if n > 0:
+                break
+            time.sleep(0.5)
+        assert n > 0
+        path, payload = received[-1]
+        assert path == "/v1/traces"
+        names = [s["name"] for s in
+                 payload["resourceSpans"][0]["scopeSpans"][0]["spans"]]
+        assert "traced" in names
+    finally:
+        tracing.disable()
+        srv.shutdown()
+
+
 def test_usage_stats_recorded(ray_start_isolated):
     from ray_tpu import _driver_state
     from ray_tpu._private import usage_stats
