@@ -88,9 +88,9 @@ class IcebergDatasource(Datasource):
             catalog_mod = _require("pyiceberg.catalog", "read_iceberg")
 
             def catalog_factory():
-                name = self._catalog_kwargs.pop("name", "default") if isinstance(
-                    self._catalog_kwargs, dict) else "default"
-                return catalog_mod.load_catalog(name, **self._catalog_kwargs)
+                kwargs = dict(self._catalog_kwargs)
+                name = kwargs.pop("name", "default")
+                return catalog_mod.load_catalog(name, **kwargs)
 
         self._catalog_factory = catalog_factory
 

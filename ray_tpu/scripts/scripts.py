@@ -279,8 +279,13 @@ def cmd_client_proxy(args):
             print("no running head found; pass --address host:gcs_port")
             return
         gcs_addr = ("127.0.0.1", addr["gcs_port"])
-    proxy, _loop = serve_proxy(gcs_addr, host=args.host, port=args.port,
-                               token=args.token)
+    try:
+        proxy, _loop = serve_proxy(gcs_addr, host=args.host, port=args.port,
+                                   token=args.token,
+                                   insecure=args.insecure_no_token)
+    except ValueError as e:
+        print(e)
+        sys.exit(1)
     auth = f"{args.token}@" if args.token else ""
     print(f"client proxy listening on {args.host}:{proxy.port} "
           f"(clients: ray_tpu+proxy://{auth}<this-host>:{proxy.port})")
@@ -468,8 +473,13 @@ def main(argv=None):
     p = sub.add_parser("client-proxy",
                        help="proxy ray_tpu+proxy:// clients into the cluster")
     p.add_argument("--address", help="gcs address host:port (default: local head)")
-    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; non-loopback without --token is refused "
+                        "unless --insecure-no-token is also passed")
     p.add_argument("--port", type=int, default=10001)
+    p.add_argument("--insecure-no-token", action="store_true",
+                   help="allow binding a non-loopback host with no --token "
+                        "(any network peer gets in-cluster-driver trust)")
     p.add_argument("--token", help="shared secret clients must present "
                                    "(ray_tpu+proxy://<token>@host:port)")
     p.set_defaults(fn=cmd_client_proxy)

@@ -322,11 +322,13 @@ def get_grpc_port() -> Optional[int]:
 
 
 def get_proxy_port() -> Optional[int]:
-    if _proxy_state.get("port") is not None:
-        return _proxy_state["port"]
+    """Head-node proxy port as ACTUALLY BOUND (the controller's table is fed
+    from each proxy's bind result, so port-conflict ephemeral fallback shows
+    up here). The driver-side cache is only a fallback when the controller is
+    briefly unreachable — it must never shadow the live table."""
     controller = _existing_controller()
     if controller is None:
-        return None
+        return _proxy_state.get("port")
     try:
         port = ray_tpu.get(controller.ensure_proxies.remote(None))
         if port:
@@ -334,7 +336,7 @@ def get_proxy_port() -> Optional[int]:
             return port
         return None
     except Exception:
-        return None
+        return _proxy_state.get("port")
 
 
 def proxy_ports() -> Dict[str, int]:

@@ -38,6 +38,10 @@ class ServeController:
         # membership in the control loop once ensure_proxies() arms it.
         self._http_options: Optional[dict] = None
         self._proxies: Dict[str, tuple] = {}
+        # Serializes proxy reconciliation: concurrent ensure_proxies calls
+        # (driver + control loop) must not both create/start the same node's
+        # proxy — interleaved starts split the bound-port table.
+        self._proxy_lock = asyncio.Lock()
         self._mux_ids: Dict[str, dict] = {}  # "app#dep" -> {actor_id: [model ids]}
 
     # -- proxies -----------------------------------------------------------
@@ -47,20 +51,25 @@ class ServeController:
         Explicit options always take effect: serve.run()/get_proxy_port() arm the
         defaults with {}, and a later serve.start(http_options={'port': N}) must
         not be silently ignored — a port change restarts the proxies."""
-        if http_options:
-            prev = self._http_options
-            self._http_options = {**(prev or {}), **http_options}
-            changed = prev is not None and any(
-                prev.get(k) != self._http_options.get(k)
-                for k in ("port", "grpc_port")
-            )
-            if changed:
-                for _nid, (handle, _port) in list(self._proxies.items()):
-                    self._kill(handle)
-                self._proxies.clear()
-        elif self._http_options is None:
-            self._http_options = {}
-        await self._reconcile_proxies()
+        # Option merge + port-change restart must happen under the same lock
+        # as reconciliation: an in-flight reconcile may be about to register a
+        # proxy started with the OLD port, and a kill/clear outside the lock
+        # would miss it, leaving a stale-port proxy in the table.
+        async with self._proxy_lock:
+            if http_options:
+                prev = self._http_options
+                self._http_options = {**(prev or {}), **http_options}
+                changed = prev is not None and any(
+                    prev.get(k) != self._http_options.get(k)
+                    for k in ("port", "grpc_port")
+                )
+                if changed:
+                    for _nid, (handle, _port) in list(self._proxies.items()):
+                        self._kill(handle)
+                    self._proxies.clear()
+            elif self._http_options is None:
+                self._http_options = {}
+            await self._reconcile_proxies_locked()
         import ray_tpu
 
         head_hex = next(
@@ -76,6 +85,10 @@ class ServeController:
     async def _reconcile_proxies(self):
         if self._http_options is None:
             return
+        async with self._proxy_lock:
+            await self._reconcile_proxies_locked()
+
+    async def _reconcile_proxies_locked(self):
         import ray_tpu
         from ray_tpu.serve._common import SERVE_NAMESPACE, async_get
         from ray_tpu.serve._proxy import HTTPProxy

@@ -34,12 +34,21 @@ class HTTPProxy:
                     "serve http_options['grpc_port'] requires grpcio"
                 ) from e
         self._grpc = None
+        self._start_lock = asyncio.Lock()
         self._server: Optional[asyncio.AbstractServer] = None
         self._routes: Dict[str, str] = {}  # route_prefix -> app name
         self._streaming: Dict[str, bool] = {}  # app -> ingress is a generator
         self._handles: Dict[str, object] = {}
 
     async def start(self) -> int:
+        # Serialize concurrent starts: this is an async actor, so two callers
+        # (driver ensure_proxies + controller reconcile loop) can interleave
+        # across the bind await; without the lock the loser EADDRINUSEs on its
+        # own sibling and silently rebinds ephemeral, splitting the port table.
+        async with self._start_lock:
+            return await self._start_locked()
+
+    async def _start_locked(self) -> int:
         if self._server is not None:
             # Idempotent: a second driver's serve.start() reaches the existing
             # proxy actor via get_if_exists; re-binding would EADDRINUSE.

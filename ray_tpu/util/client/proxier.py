@@ -86,7 +86,7 @@ class ClientProxy:
     """Accepts tunneled client connections and relays them to validated cluster
     endpoints. Run via `serve_proxy()` or the `ray_tpu client-proxy` CLI."""
 
-    def __init__(self, gcs_addr: Tuple[str, int], *, host: str = "0.0.0.0",
+    def __init__(self, gcs_addr: Tuple[str, int], *, host: str = "127.0.0.1",
                  port: int = 0, node_cache_s: float = 5.0,
                  token: Optional[str] = None):
         self._gcs_addr = (gcs_addr[0], int(gcs_addr[1]))
@@ -253,10 +253,22 @@ class ClientProxy:
                 pass
 
 
-def serve_proxy(gcs_addr: Tuple[str, int], *, host: str = "0.0.0.0",
-                port: int = 0, token: Optional[str] = None) -> Tuple[ClientProxy, Any]:
+def serve_proxy(gcs_addr: Tuple[str, int], *, host: str = "127.0.0.1",
+                port: int = 0, token: Optional[str] = None,
+                insecure: bool = False) -> Tuple[ClientProxy, Any]:
     """Start a proxy on a private IO loop; returns (proxy, io_loop). Blocking
-    callers (CLI) should then sleep/join; tests use proxy.port."""
+    callers (CLI) should then sleep/join; tests use proxy.port.
+
+    Binding a non-loopback host without a token is refused unless
+    ``insecure=True``: any peer that can reach the port would get
+    in-cluster-driver trust (relayed frames are the cluster's pickled RPC
+    protocol)."""
+    if host not in ("127.0.0.1", "::1", "localhost") and not token and not insecure:
+        raise ValueError(
+            f"refusing to bind {host} without a token: any peer that can "
+            "reach the port gets in-cluster-driver trust. Pass token=..., "
+            "or insecure=True to override on a trusted network."
+        )
     loop = _rpc.IoLoop(name="client-proxy")
     proxy = ClientProxy(gcs_addr, host=host, port=port, token=token)
     loop.run(proxy.start(), 30)

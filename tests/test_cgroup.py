@@ -32,7 +32,9 @@ def test_manager_subtree_and_placement(tmp_path):
     assert mgr.place_system_process(111)
     assert (sess / "system" / "cgroup.procs").read_text() == "111"
     assert mgr.place_worker(222)
-    assert (sess / "workers" / "cgroup.procs").read_text() == "222"
+    # workers/ has subtree_control enabled, so pids live in the shared/ leaf
+    # (cgroup-v2 no-internal-process rule), never in workers/ itself.
+    assert (sess / "workers" / "shared" / "cgroup.procs").read_text() == "222"
     # declared memory -> dedicated capped sub-group
     assert mgr.place_worker(333, memory_bytes=512 << 20, cpu_weight=50)
     wd = sess / "workers" / "w_333"
@@ -91,7 +93,7 @@ def test_raylet_places_workers_and_caps_memory_actors(cgroup_cluster):
     pid = ray_tpu.get(f.remote(), timeout=120)
     sessions = [d for d in base.iterdir() if d.name.startswith("ray_tpu_")]
     assert sessions, "raylet did not create its cgroup session subtree"
-    procs = sessions[0] / "workers" / "cgroup.procs"
+    procs = sessions[0] / "workers" / "shared" / "cgroup.procs"
     assert procs.exists() and procs.read_text().strip()
 
     @ray_tpu.remote(memory=256 << 20)

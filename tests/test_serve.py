@@ -421,6 +421,43 @@ def test_proxy_port_and_table():
     serve.delete("hello_app")
 
 
+def test_proxy_port_reports_bound_port_on_conflict():
+    """Contract (reference proxy.py: one fixed port per node): when the
+    configured port is taken, the proxy falls back to an ephemeral port and
+    get_proxy_port()/proxy_ports() must report the port ACTUALLY BOUND —
+    never the configured number — and HTTP must answer on it."""
+    import socket
+
+    from ray_tpu import serve
+
+    squat = socket.socket()
+    squat.bind(("127.0.0.1", 0))
+    squat.listen(1)
+    taken = squat.getsockname()[1]
+    try:
+        serve.start(http_options={"port": taken})
+
+        @serve.deployment
+        def pong(request):
+            return "pong"
+
+        serve.run(pong.bind(), name="pong_app", route_prefix="/pong")
+        port = serve.get_proxy_port()
+        assert port and port != taken, (
+            f"get_proxy_port() returned the configured (unbindable) port {taken}"
+        )
+        assert port in serve.proxy_ports().values()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/pong", timeout=60
+        ).read()
+        assert body == b"pong"
+        serve.delete("pong_app")
+    finally:
+        squat.close()
+        # Restore default options so later tests aren't pinned to `taken`.
+        serve.shutdown()
+
+
 def test_grpc_ingress(_cluster):
     """gRPC ingress beside HTTP (reference: the serve gRPC proxy): any
     /<app>/<method> unary call routes to the app's ingress with raw bytes."""
