@@ -46,7 +46,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     # --- scheduling ---
     "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
     "lease_timeout_s": (float, 30.0, "worker lease validity"),
-    "lease_worker_slots": (int, 4, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker)"),
+    "lease_worker_slots": (int, 32, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker); deep pipelines coalesce submit bursts into few large frames"),
     "borrow_audit_interval_s": (float, 30.0, "how often owners audit registered borrowers for liveness (crashed borrowers are reconciled)"),
     "test_delay_borrow_report_ms": (int, 0, "fault injection: delay legacy borrow-report notifies by this long (stress the sequenced protocol)"),
     # --- logging / observability ---

@@ -37,6 +37,14 @@ _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 _MISS = object()  # local-arena fast-path miss sentinel
 
+# Starting per-worker pipeline depth for the lease fast path. Shallow by
+# default so a burst queues work and acquires more workers (parallelism);
+# lease denials ramp the depth toward CONFIG.lease_worker_slots (throughput
+# via large coalesced frames once the node is saturated). 2, not 1: one task
+# executing + one parked keeps the worker from going idle during the
+# result/refill round trip.
+_LEASE_DEPTH_MIN = 2
+
 
 def _addr_key(addr: dict) -> tuple:
     """Hashable identity of a worker address (borrower bookkeeping)."""
@@ -1662,7 +1670,7 @@ class CoreWorker:
         with self._lease_lock:
             st = self._leases.setdefault(
                 shape, {"workers": {}, "queue": deque(), "requesting": False,
-                        "classic_until": 0.0},
+                        "classic_until": 0.0, "depth": _LEASE_DEPTH_MIN},
             )
             if time.monotonic() < st["classic_until"]:
                 classic = True
@@ -1685,23 +1693,41 @@ class CoreWorker:
         ride a per-worker send queue whose drainer packs everything accumulated
         into one push_batch frame — a burst of .remote() calls coalesces into
         a few frames instead of one frame (and one event-loop wakeup) per task."""
-        slots = max(1, CONFIG.lease_worker_slots)
         to_wake, request = [], False
         with self._lease_lock:
             st = self._leases.get(shape)
-            if st is None:
+            if st is None or not st["queue"]:
+                # Completion hot path: nothing queued means nothing to assign,
+                # and any non-empty sendq already has its send loop running.
                 return
-            for w in st["workers"].values():
-                if not st["queue"]:
-                    break
-                if w["conn"].closed:
-                    continue
-                while st["queue"] and len(w["inflight"]) < slots:
+            # Adaptive pipeline depth: start shallow (_LEASE_DEPTH_MIN) so a
+            # burst leaves work queued and lease requests fan it out across
+            # workers; _lease_request doubles the depth toward
+            # lease_worker_slots each time the raylet DENIES a lease with work
+            # still queued (the node is saturated — parallelism is exhausted,
+            # so pipeline deeper instead: bigger frames, fewer wakeups).
+            slots = max(1, min(st.get("depth", _LEASE_DEPTH_MIN),
+                               CONFIG.lease_worker_slots))
+            # Round-robin one task per worker per pass: a greedy fill would
+            # park a whole burst on the first worker while the rest idle;
+            # breadth-first keeps execution parallel and the per-worker sendq
+            # still coalesces everything a pass assigns into one frame.
+            live = [
+                w for w in st["workers"].values()
+                if not w["conn"].closed and len(w["inflight"]) < slots
+            ]
+            while st["queue"] and live:
+                for w in list(live):
+                    if not st["queue"]:
+                        break
                     spec = st["queue"].popleft()
                     spec["__direct__"] = True
                     w["inflight"][spec["task_id"]] = spec
                     w["sendq"].append(spec)
                     self._lease_inflight[spec["task_id"]] = (shape, w["worker_id"])
+                    if len(w["inflight"]) >= slots:
+                        live.remove(w)
+            for w in st["workers"].values():
                 if w["sendq"] and not w["sending"]:
                     w["sending"] = True
                     to_wake.append(w)
@@ -1766,6 +1792,9 @@ class CoreWorker:
                      "sendq": deque(), "sending": False}
                 st["workers"][wid] = w
                 st["retries"] = 0
+                # Capacity exists again: go back to shallow pipelines so the
+                # next burst spreads before it deepens.
+                st["depth"] = _LEASE_DEPTH_MIN
                 conn.on_close(lambda c: self._lease_worker_lost(shape, wid, c))
             elif resp and resp.get("infeasible"):
                 # This node can never run the shape: hand everything queued to
@@ -1775,6 +1804,13 @@ class CoreWorker:
                 while st["queue"]:
                     drain_classic.append(st["queue"].popleft())
             elif st["queue"]:
+                # Denied with work queued: the node can't lease more workers
+                # for this shape right now. Deepen the per-worker pipeline so
+                # the backlog rides existing leases in large frames.
+                st["depth"] = min(
+                    max(st.get("depth", _LEASE_DEPTH_MIN), 1) * 2,
+                    CONFIG.lease_worker_slots,
+                )
                 st["retries"] = st.get("retries", 0) + 1
                 if st["retries"] > 40 and not st["workers"]:
                     # Long-denied with no leased worker: the node may be wedged
@@ -1792,8 +1828,11 @@ class CoreWorker:
                     )
         for spec in drain_classic:
             self.io.spawn(self.raylet.notify("submit_task", spec))
+        # Pump in both cases: a grant added a worker; a denial deepened the
+        # pipeline, so the backlog rides existing workers at the new depth
+        # (`requesting` was re-armed above — pump won't double-request).
+        self._lease_pump(shape)
         if conn is not None:
-            self._lease_pump(shape)
             # The queue may have drained while this grant was in flight (an
             # existing leased worker took the work): an unused grant must not
             # pin the worker forever.
