@@ -226,6 +226,9 @@ class DecodeEngine:
         self._queue: List = []
         self._lock = threading.Lock()
         self._stop = False
+        # Set when the stepper thread dies on an exception; submitters check it
+        # instead of waiting forever on callbacks that will never fire.
+        self.error: Optional[BaseException] = None
         self._jit_prefill = {}
         self._jit_decode = jax.jit(self._decode_step)
         # Speculative decoding (reference: vLLM speculative decoding /
@@ -702,6 +705,29 @@ class DecodeEngine:
             # slot cache naturally reused on next admit (lens reset at prefill)
 
     def _loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 - stepper death must be visible
+            self.error = e
+            # Callers blocked on per-request callbacks would otherwise hang
+            # forever: fail every active/queued request loudly.
+            with self._lock:
+                queued, self._queue = self._queue, []
+            for slot in self._slots:
+                if slot.active and slot.callback is not None:
+                    slot.active = False
+                    try:
+                        slot.callback(-1, True)
+                    except Exception:
+                        pass
+            for item in queued:
+                cb = item[3] if item[0] == "prompt" else item[5]
+                try:
+                    cb(-1, True)
+                except Exception:
+                    pass
+
+    def _loop_inner(self):
         while not self._stop:
             admitted = True
             while admitted:
