@@ -25,7 +25,14 @@ from ray_tpu._private.worker import (
     global_worker_or_none,
     set_global_worker,
 )
-from ray_tpu.actor import ActorClass, ActorHandle, exit_actor, get_actor, kill  # noqa: F401
+from ray_tpu.actor import (  # noqa: F401
+    ActorClass,
+    ActorHandle,
+    exit_actor,
+    get_actor,
+    kill,
+    method,
+)
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"
@@ -344,6 +351,7 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "method",
     "nodes",
     "put",
     "remote",

@@ -84,6 +84,11 @@ class ActorInfo:
             "class_name": self.spec.get("class_name"),
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
+            # Handle-shaping metadata: get_actor handles must behave like the
+            # creator's (method num_returns/group bindings, ooo transport).
+            "method_names": self.spec.get("method_names") or [],
+            "method_opts": self.spec.get("method_opts") or {},
+            "out_of_order": self.spec.get("allow_out_of_order_execution", False),
         }
 
 

@@ -529,25 +529,57 @@ class ObjectRefGenerator:
 
 
 class _ActorRuntime:
-    """Execution state when this worker hosts an actor."""
+    """Execution state when this worker hosts an actor.
 
-    def __init__(self, instance, max_concurrency: int, is_async: bool):
+    Concurrency groups (reference: core_worker/task_execution/
+    concurrency_group_manager.cc): each named group gets its OWN thread pool
+    (sync actors) or semaphore (async actors) sized to its declared limit, so
+    a method bound to one group cannot starve another — the default pool
+    keeps max_concurrency for unbound methods. Dispatch releases tasks in
+    per-caller seq order but never blocks on execution, so in-group ordering
+    holds while groups stay independent. `out_of_order` skips seq gating
+    entirely (reference: out_of_order_actor_submit_queue.cc)."""
+
+    def __init__(self, instance, max_concurrency: int, is_async: bool,
+                 concurrency_groups: dict | None = None,
+                 method_groups: dict | None = None,
+                 out_of_order: bool = False):
         self.instance = instance
         self.max_concurrency = max_concurrency
         self.is_async = is_async
+        self.out_of_order = out_of_order
+        self.concurrency_groups = dict(concurrency_groups or {})
+        self.method_groups = dict(method_groups or {})
         self.expected_seq: dict[bytes, int] = {}
         self.buffered: dict[tuple[bytes, int], dict] = {}
         self.executor = ThreadPoolExecutor(max_workers=max_concurrency)
+        self.group_executors: dict[str, ThreadPoolExecutor] = {}
+        if not is_async:
+            for gname, limit in self.concurrency_groups.items():
+                self.group_executors[gname] = ThreadPoolExecutor(
+                    max_workers=max(1, int(limit)),
+                    thread_name_prefix=f"actor-cg-{gname}",
+                )
         self.async_loop: asyncio.AbstractEventLoop | None = None
         self.semaphore: asyncio.Semaphore | None = None
+        self.group_semaphores: dict[str, asyncio.Semaphore] = {}
         if is_async:
             self.async_loop = asyncio.new_event_loop()
             t = threading.Thread(target=self._run_loop, daemon=True, name="actor-asyncio")
             t.start()
 
+    def group_of(self, spec) -> str | None:
+        """Resolve a call's concurrency group: per-call override first, then
+        the class-declared method binding. None = default pool."""
+        return spec.get("concurrency_group") or self.method_groups.get(
+            spec["method_name"]
+        )
+
     def _run_loop(self):
         asyncio.set_event_loop(self.async_loop)
         self.semaphore = asyncio.Semaphore(self.max_concurrency)
+        for gname, limit in self.concurrency_groups.items():
+            self.group_semaphores[gname] = asyncio.Semaphore(max(1, int(limit)))
         self.async_loop.run_forever()
 
 
@@ -1536,6 +1568,10 @@ class CoreWorker:
         scheduling_strategy=None,
         method_names=(),
         runtime_env=None,
+        concurrency_groups=None,
+        method_groups=None,
+        method_opts=None,
+        allow_out_of_order_execution=False,
     ) -> ActorID:
         actor_id = ActorID.from_random()
         # Promoted/borrowed init args stay pinned while the actor can restart
@@ -1560,6 +1596,10 @@ class CoreWorker:
             "owner": self._owner_address(),
             "method_names": list(method_names),
             "runtime_env": runtime_env,
+            "concurrency_groups": dict(concurrency_groups or {}),
+            "method_groups": dict(method_groups or {}),
+            "method_opts": dict(method_opts or {}),
+            "allow_out_of_order_execution": bool(allow_out_of_order_execution),
         }
         reply = self.gcs_call("register_actor", actor_id, spec)
         actual_id = reply["actor_id"]
@@ -1591,6 +1631,8 @@ class CoreWorker:
         args,
         kwargs,
         num_returns: int = 1,
+        concurrency_group: str | None = None,
+        out_of_order: bool = False,
     ) -> list[ObjectRef]:
         self.reference_counter.drain_deferred()
         task_id = TaskID.from_random()
@@ -1617,6 +1659,10 @@ class CoreWorker:
             "caller_id": self.worker_id.binary(),
             "seq": counter.next(),
         }
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
+        if out_of_order:
+            spec["ooo"] = True
         refs = []
         for oid in return_ids:
             self.reference_counter.add_owned(oid)
@@ -1955,6 +2001,8 @@ class CoreWorker:
             if st is None:
                 self._submit_when_ready(spec, target="submit_actor_task")
                 return
+            if spec.pop("ooo", None):
+                st["ooo"] = True
             st["ready"][spec["seq"]] = spec
         self._direct_flush(actor_id)
 
@@ -1972,6 +2020,20 @@ class CoreWorker:
                         spec["__direct__"] = True
                     self._direct_inflight[spec["task_id"]] = spec
                     st.setdefault("sendq", deque()).append(spec)
+                # Out-of-order actors take no ordering guarantee end to end:
+                # ship whatever is ready (args resolved) regardless of seq
+                # continuity — the executor side skips gating symmetrically.
+                # The flag is STICKY per actor (set by the first tagged spec),
+                # so every pending spec ships even if some arrived through a
+                # handle that predates the flag.
+                if st.get("ooo"):
+                    for seq in sorted(st["ready"]):
+                        spec = st["ready"].pop(seq)
+                        st["next"] = max(st["next"], seq + 1)
+                        if spec.get("num_returns") != "streaming":
+                            spec["__direct__"] = True
+                        self._direct_inflight[spec["task_id"]] = spec
+                        st.setdefault("sendq", deque()).append(spec)
                 if st.get("sendq") and not st.get("draining"):
                     st["draining"] = True
                     drain = True
@@ -2344,7 +2406,10 @@ class CoreWorker:
             self.actor_id = actor_id
             instance.__init__(*args, **kwargs)
             self.actor_runtime = _ActorRuntime(
-                instance, spec.get("max_concurrency", 1), spec.get("is_async", False)
+                instance, spec.get("max_concurrency", 1), spec.get("is_async", False),
+                concurrency_groups=spec.get("concurrency_groups"),
+                method_groups=spec.get("method_groups"),
+                out_of_order=spec.get("allow_out_of_order_execution", False),
             )
             return {"ok": True}
         except Exception:
@@ -2355,6 +2420,12 @@ class CoreWorker:
         """Per-caller sequence ordering (ActorSchedulingQueue parity). Runs on io thread."""
         rt = self.actor_runtime
         if rt is None:
+            return
+        if rt.out_of_order:
+            # Explicit out-of-order mode (reference:
+            # out_of_order_actor_submit_queue.cc): dispatch on arrival, no
+            # seq gating — threaded actors trade ordering for latency.
+            self._dispatch_actor_task(rt, spec)
             return
         caller = spec["caller_id"]
         # First message from a caller sets the baseline: after an actor restart the
@@ -2369,10 +2440,27 @@ class CoreWorker:
             ready = rt.buffered.pop((caller, expected))
             expected += 1
             rt.expected_seq[caller] = expected
-            if rt.is_async:
-                asyncio.run_coroutine_threadsafe(self._execute_async_actor_task(ready), rt.async_loop)
-            else:
-                rt.executor.submit(self._execute_task_guarded, ready)
+            self._dispatch_actor_task(rt, ready)
+
+    def _dispatch_actor_task(self, rt, spec):
+        """Route a released call to its concurrency group's executor. Dispatch
+        never blocks on execution, so a wedged group cannot stall another."""
+        group = rt.group_of(spec)
+        if group is not None and group not in rt.concurrency_groups:
+            # Unknown group: fail THIS call with a proper error result instead
+            # of wedging the queue (validated caller-side too when declared).
+            spec["__invalid_group__"] = (
+                f"actor has no concurrency group {group!r} "
+                f"(declared: {sorted(rt.concurrency_groups)})"
+            )
+            group = None
+        if rt.is_async:
+            asyncio.run_coroutine_threadsafe(
+                self._execute_async_actor_task(spec), rt.async_loop
+            )
+        else:
+            executor = rt.group_executors.get(group, rt.executor)
+            executor.submit(self._execute_task_guarded, spec)
 
     def _resolve_actor_method(self, instance, method_name: str):
         """Method lookup plus the __rtpu_apply__ escape hatch: run an arbitrary
@@ -2398,7 +2486,9 @@ class CoreWorker:
 
     async def _execute_async_actor_task(self, spec):
         rt = self.actor_runtime
-        async with rt.semaphore:
+        group = rt.group_of(spec)
+        sem = rt.group_semaphores.get(group, rt.semaphore)
+        async with sem:
             method = self._resolve_actor_method(rt.instance, spec["method_name"])
             # The sink outlives the materializer thread: refs the async method
             # keeps past completion ride the reply's sequenced handoff exactly
@@ -2415,6 +2505,8 @@ class CoreWorker:
 
             args = kwargs = result = None
             try:
+                if "__invalid_group__" in spec:
+                    raise ValueError(spec["__invalid_group__"])
                 args, kwargs = await asyncio.get_running_loop().run_in_executor(
                     None, _materialize_sinked
                 )
@@ -2479,6 +2571,8 @@ class CoreWorker:
             # depend on py_modules/working_dir being importable.
             with runtime_env_mod.applied(spec.get("runtime_env")):
                 if spec["type"] == "actor_task":
+                    if "__invalid_group__" in spec:
+                        raise ValueError(spec["__invalid_group__"])
                     fn = self._resolve_actor_method(
                         self.actor_runtime.instance, spec["method_name"]
                     )

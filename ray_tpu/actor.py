@@ -26,6 +26,8 @@ _ACTOR_DEFAULTS = {
     "lifetime": None,
     "max_restarts": 0,
     "max_concurrency": None,
+    "concurrency_groups": None,
+    "allow_out_of_order_execution": False,
     "placement_group": None,
     "placement_group_bundle_index": 0,
     "scheduling_strategy": None,
@@ -43,6 +45,16 @@ def _public_methods(cls) -> list[str]:
     return names
 
 
+def _declared_method_opts(cls) -> dict:
+    """Collect @ray_tpu.method declarations: name -> opts dict."""
+    out = {}
+    for name, member in inspect.getmembers(cls, predicate=callable):
+        opts = getattr(member, "__ray_tpu_method_opts__", None)
+        if opts:
+            out[name] = dict(opts)
+    return out
+
+
 def _has_async_methods(cls) -> bool:
     return any(
         inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
@@ -50,19 +62,52 @@ def _has_async_methods(cls) -> bool:
     )
 
 
+def method(num_returns: int = 1, concurrency_group: str | None = None):
+    """Method decorator (reference: @ray.method, python/ray/actor.py): bind a
+    method to a declared concurrency group and/or set its return arity.
+    Bare `@method` (no parentheses) decorates with the defaults."""
+
+    def wrap(fn):
+        fn.__ray_tpu_method_opts__ = {
+            "num_returns": num_returns,
+            "concurrency_group": concurrency_group,
+        }
+        return fn
+
+    if callable(num_returns):
+        fn, num_returns = num_returns, 1
+        return wrap(fn)
+    return wrap
+
+
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int | None = None,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._method_name = method_name
-        self._num_returns = num_returns
+        declared = handle._method_opts.get(method_name, {})
+        self._num_returns = (
+            num_returns if num_returns is not None
+            else declared.get("num_returns", 1)
+        )
+        self._concurrency_group = (
+            concurrency_group if concurrency_group is not None
+            else declared.get("concurrency_group")
+        )
 
-    def options(self, num_returns: int = 1, **_ignored):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int | None = None,
+                concurrency_group: str | None = None, **_ignored):
+        return ActorMethod(
+            self._handle, self._method_name, num_returns, concurrency_group
+        )
 
     def remote(self, *args, **kwargs):
         worker = global_worker()
         refs = worker.submit_actor_task(
-            self._handle._actor_id, self._method_name, args, kwargs, self._num_returns
+            self._handle._actor_id, self._method_name, args, kwargs,
+            self._num_returns, concurrency_group=self._concurrency_group,
+            out_of_order=self._handle._out_of_order,
         )
         if self._num_returns == 1:
             return refs[0]
@@ -82,11 +127,17 @@ class ActorHandle:
         actor_id: ActorID,
         method_names: list[str],
         class_name: str = "",
+        method_opts: dict | None = None,
+        out_of_order: bool = False,
         _owns_arg_pins: bool = False,
     ):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._class_name = class_name
+        self._out_of_order = out_of_order
+        # method name -> {"num_returns": n, "concurrency_group": g} from
+        # @ray_tpu.method declarations (travels with serialized handles).
+        self._method_opts = dict(method_opts or {})
         # Only the handle returned to the CREATOR guards the actor's pinned init
         # args; deserialized copies (__reduce__) do not, so a borrower dropping
         # its copy cannot release pins it never took.
@@ -118,7 +169,11 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names, self._class_name))
+        return (
+            ActorHandle,
+            (self._actor_id, self._method_names, self._class_name,
+             self._method_opts, self._out_of_order),
+        )
 
 
 class ActorClass:
@@ -149,6 +204,20 @@ class ActorClass:
         from ray_tpu._private import runtime_env as runtime_env_mod
 
         method_names = _public_methods(self._cls)
+        method_opts = _declared_method_opts(self._cls)
+        cgroups = dict(opts["concurrency_groups"] or {})
+        method_groups = {}
+        for mname, mopts in method_opts.items():
+            group = mopts.get("concurrency_group")
+            if group is not None:
+                if group not in cgroups:
+                    raise ValueError(
+                        f"method {mname!r} is bound to concurrency group "
+                        f"{group!r} but the actor declares only "
+                        f"{sorted(cgroups)} (pass concurrency_groups= to "
+                        f"@ray_tpu.remote)"
+                    )
+                method_groups[mname] = group
         actor_id, owns_pins = worker.create_actor(
             cls_key=self._cls_key,
             class_name=self._cls.__name__,
@@ -165,9 +234,15 @@ class ActorClass:
             scheduling_strategy=strategy,
             method_names=method_names,
             runtime_env=runtime_env_mod.validate(opts.get("runtime_env")),
+            concurrency_groups=cgroups,
+            method_groups=method_groups,
+            method_opts=method_opts,
+            allow_out_of_order_execution=opts["allow_out_of_order_execution"],
         )
         return ActorHandle(
-            actor_id, method_names, self._cls.__name__, _owns_arg_pins=owns_pins
+            actor_id, method_names, self._cls.__name__, method_opts,
+            out_of_order=opts["allow_out_of_order_execution"],
+            _owns_arg_pins=owns_pins,
         )
 
     def __call__(self, *args, **kwargs):
@@ -186,7 +261,13 @@ def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
     info = worker.gcs_call("get_actor_info", None, name, namespace)
     if info is None or info["state"] == "DEAD":
         raise ValueError(f"actor {name!r} not found in namespace {namespace!r}")
-    return ActorHandle(info["actor_id"], [], info.get("class_name") or "")
+    return ActorHandle(
+        info["actor_id"],
+        info.get("method_names") or [],
+        info.get("class_name") or "",
+        info.get("method_opts"),
+        out_of_order=info.get("out_of_order", False),
+    )
 
 
 def kill(actor: ActorHandle, no_restart: bool = True):
