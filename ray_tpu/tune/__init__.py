@@ -124,6 +124,8 @@ class Tuner:
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
+        self._restore_state: Optional[dict] = None
+        self._restart_errored = False
         if self._tune_config.resources_per_trial is None:
             res = getattr(trainable, "_tune_resources", None)
             if res:
@@ -161,17 +163,51 @@ class Tuner:
             return trainable
         raise TypeError(f"unsupported trainable: {type(trainable).__name__}")
 
+    _TUNER_FILE = "tuner.pkl"
+
     def fit(self) -> ResultGrid:
+        import cloudpickle
+
         name = self._run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
         experiment_dir = os.path.join(self._run_config.storage_path, name)
         os.makedirs(experiment_dir, exist_ok=True)
+        # Persist the tuner definition FIRST (reference: tuner.pkl written at
+        # experiment start, python/ray/tune/impl/tuner_internal.py) so a killed
+        # driver's experiment is restorable even before the first snapshot.
+        # Written on restored fits too: a trainable override passed to
+        # restore() must survive the NEXT crash/restore cycle.
+        tmp = os.path.join(experiment_dir, self._TUNER_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            # cloudpickle throughout: configs may hold locally-defined
+            # searchers/schedulers/stoppers that stdlib pickle rejects.
+            cloudpickle.dump(
+                {
+                    "fn_blob": cloudpickle.dumps(self._trainable),
+                    "param_space": self._param_space,
+                    "tune_config": self._tune_config,
+                    "run_config": self._run_config,
+                },
+                f,
+            )
+        os.replace(tmp, os.path.join(experiment_dir, self._TUNER_FILE))
+        state_file = os.path.join(experiment_dir, TuneController._STATE_FILE)
+        if self._restore_state is None and os.path.isfile(state_file):
+            # Fresh run into a reused experiment name: a stale snapshot from
+            # the previous experiment must not be restorable against the new
+            # definition.
+            os.remove(state_file)
         controller = TuneController(
             self._trainable,
             param_space=self._param_space,
             tune_config=self._tune_config,
             run_config=self._run_config,
             experiment_dir=experiment_dir,
+            restoring=self._restore_state is not None,
         )
+        if self._restore_state is not None:
+            controller.apply_restore_state(
+                self._restore_state, restart_errored=self._restart_errored
+            )
         controller.run()
         results = []
         for trial in controller.trials:
@@ -190,6 +226,59 @@ class Tuner:
             default_metric=self._tune_config.metric,
             default_mode=self._tune_config.mode,
         )
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        """True when `path` holds a restorable experiment (reference:
+        Tuner.can_restore, python/ray/tune/tuner.py)."""
+        return os.path.isfile(os.path.join(path, cls._TUNER_FILE))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable=None,
+        *,
+        restart_errored: bool = False,
+    ) -> "Tuner":
+        """Resume a killed/interrupted experiment from its directory
+        (reference: Tuner.restore, python/ray/tune/tuner.py + the
+        experiment-state snapshots of tune_controller.py:68).
+
+        Unfinished trials resume from their latest checkpoints; finished
+        trials keep their results; searcher/scheduler state (TPE
+        observations, ASHA rungs) survives. `trainable` overrides the
+        persisted one (pass it when the original isn't picklable across
+        versions); `restart_errored=True` also reruns errored trials."""
+        import pickle
+
+        import cloudpickle
+
+        with open(os.path.join(path, cls._TUNER_FILE), "rb") as f:
+            saved = cloudpickle.load(f)
+        tuner = cls.__new__(cls)
+        tuner._trainable = (
+            cls._normalize_trainable(trainable)
+            if trainable is not None
+            else cloudpickle.loads(saved["fn_blob"])
+        )
+        tuner._param_space = saved["param_space"]
+        tuner._tune_config = saved["tune_config"]
+        run_config = saved["run_config"]
+        # Pin the experiment back to ITS directory, whatever storage_path the
+        # restoring process has configured.
+        run_config.name = os.path.basename(os.path.normpath(path))
+        run_config.storage_path = os.path.dirname(os.path.normpath(path))
+        tuner._run_config = run_config
+        tuner._restart_errored = restart_errored
+        state_file = os.path.join(path, TuneController._STATE_FILE)
+        if os.path.isfile(state_file):
+            with open(state_file, "rb") as f:
+                tuner._restore_state = pickle.load(f)
+        else:
+            # Killed before the first snapshot: rerun from the definition.
+            tuner._restore_state = {"trials": [], "target_samples": None}
+        return tuner
 
 
 __all__ = [

@@ -27,6 +27,20 @@ TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
 
+def _checkpoint_iteration(ckpt: Optional[Checkpoint]) -> int:
+    """Iteration covered by a trial-dir checkpoint (from its checkpoint_%06d
+    basename); 0 for None/foreign paths."""
+    if ckpt is None:
+        return 0
+    name = os.path.basename(os.path.normpath(ckpt.path))
+    if name.startswith("checkpoint_"):
+        try:
+            return int(name.split("_", 1)[1])
+        except ValueError:
+            pass
+    return 0
+
+
 class Trial:
     def __init__(self, trial_id: str, config: dict, experiment_dir: str):
         self.trial_id = trial_id
@@ -42,6 +56,9 @@ class Trial:
         self.rungs_passed: set = set()
         self.last_perturbation_t: int = 0
         self.restore_checkpoint: Optional[Checkpoint] = None
+        # Iteration numbering continues from here after a restore (the actor
+        # offsets training_iteration so replayed rows don't restart at 1).
+        self.start_iteration: int = 0
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status}, {self.config})"
@@ -51,7 +68,7 @@ class _TrialActor:
     """Runs one trial's user function on a thread; buffers reported results."""
 
     def __init__(self, fn_blob: bytes, config: dict, trial_id: str, trial_dir: str,
-                 restore_from: Optional[str]):
+                 restore_from: Optional[str], start_iteration: int = 0):
         import cloudpickle
 
         self._fn = cloudpickle.loads(fn_blob)
@@ -63,7 +80,7 @@ class _TrialActor:
         self._lock = threading.Lock()
         self._status = RUNNING
         self._error: Optional[str] = None
-        self._iteration = 0
+        self._iteration = int(start_iteration)
         self._restore_from = restore_from
         self._start_time = time.time()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -135,6 +152,7 @@ class TuneController:
         tune_config,
         run_config,
         experiment_dir: str,
+        restoring: bool = False,
     ):
         import cloudpickle
 
@@ -148,19 +166,11 @@ class TuneController:
             param_space, num_samples=tune_config.num_samples, seed=tune_config.seed
         )
         self.trials: List[Trial] = []
-        if isinstance(self._searcher, BasicVariantGenerator):
-            # Static searcher: the whole variant set exists up front.
-            n = self._searcher.total_variants
-            for i in range(n):
-                cfg = self._searcher.suggest(f"trial_{i:05d}")
-                if cfg is None:
-                    break
-                self.trials.append(Trial(f"trial_{i:05d}", cfg, experiment_dir))
-            self._target_samples = len(self.trials)
-        else:
-            # Adaptive searcher (TPE/optuna/...): trials are created LAZILY in
-            # step() so each suggest() sees the completed results so far.
-            self._target_samples = tune_config.num_samples
+        self._target_samples = tune_config.num_samples
+        if not restoring:
+            # Restores rebuild trials from the snapshot instead (or call
+            # _generate_initial_trials when killed pre-snapshot).
+            self._generate_initial_trials()
         self._scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
         if getattr(self._scheduler, "metric", None) is None:
             self._scheduler.metric = tune_config.metric
@@ -171,6 +181,132 @@ class TuneController:
         )
         self._resources = tune_config.resources_per_trial or {"num_cpus": 1}
         self._exploits: List[tuple] = []
+        self._last_snapshot = 0.0
+        # Experiment-state checkpoint interval (reference: TUNE_GLOBAL_CHECKPOINT_S
+        # auto-tuning in tune_controller.py; a fixed short period suffices here).
+        self._snapshot_period_s = float(
+            os.environ.get("RAY_TPU_TUNE_CHECKPOINT_PERIOD_S", "1.0")
+        )
+
+    def _generate_initial_trials(self):
+        from ray_tpu.tune.search import BasicVariantGenerator
+
+        if isinstance(self._searcher, BasicVariantGenerator):
+            # Static searcher: the whole variant set exists up front.
+            for i in range(self._searcher.total_variants):
+                cfg = self._searcher.suggest(f"trial_{i:05d}")
+                if cfg is None:
+                    break
+                self.trials.append(Trial(f"trial_{i:05d}", cfg, self._experiment_dir))
+            self._target_samples = len(self.trials)
+        # Adaptive searchers (TPE/optuna/...) create trials LAZILY in step()
+        # so each suggest() sees the completed results so far.
+
+    # -- experiment-state checkpointing -----------------------------------
+    _STATE_FILE = "experiment_state.pkl"
+
+    def snapshot(self):
+        """Write a restorable snapshot of the whole experiment (reference:
+        tune_controller.py experiment-state checkpointing + searcher save).
+        Atomic via tmp+rename so a killed driver never leaves a torn file.
+        cloudpickle throughout — user configs/searchers are often local
+        objects stdlib pickle rejects. Checkpoint paths are stored relative
+        to the experiment dir so a moved experiment still restores."""
+        import cloudpickle
+
+        trials = []
+        for t in self.trials:
+            ckpt = t.latest_checkpoint.path if t.latest_checkpoint else None
+            if ckpt:
+                rel = os.path.relpath(ckpt, self._experiment_dir)
+                if not rel.startswith(".."):
+                    ckpt = rel
+            trials.append({
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": t.status,
+                "error": t.error,
+                "results": t.results,
+                "last_result": t.last_result,
+                "latest_checkpoint": ckpt,
+                "rungs_passed": sorted(t.rungs_passed),
+                "last_perturbation_t": t.last_perturbation_t,
+            })
+        state = {
+            "trials": trials,
+            "target_samples": self._target_samples,
+            "searcher": None,
+            "scheduler": None,
+        }
+        # Searcher/scheduler state rides the snapshot when picklable (TPE's
+        # observations, ASHA rungs); otherwise restore falls back to fresh.
+        for key, obj in (("searcher", self._searcher), ("scheduler", self._scheduler)):
+            try:
+                state[key] = cloudpickle.dumps(obj)
+            except Exception:
+                state[key] = None
+        tmp = os.path.join(self._experiment_dir, self._STATE_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(self._experiment_dir, self._STATE_FILE))
+        self._last_snapshot = time.time()
+
+    def apply_restore_state(self, state: dict, *, restart_errored: bool = False):
+        """Rebuild trial/searcher/scheduler state from a snapshot. Unfinished
+        trials go back to PENDING and resume from their latest checkpoint; a
+        checkpointed trial is never rerun from scratch."""
+        import pickle
+
+        for key, setter in (
+            ("searcher", lambda v: setattr(self, "_searcher", v)),
+            ("scheduler", lambda v: setattr(self, "_scheduler", v)),
+        ):
+            blob = state.get(key)
+            if blob is not None:
+                try:
+                    setter(pickle.loads(blob))
+                except Exception:
+                    pass
+        if state.get("target_samples"):
+            self._target_samples = state["target_samples"]
+        if not state.get("trials"):
+            # Killed before the first snapshot: run from the definition
+            # (static searchers regenerate their variant set here when
+            # __init__ deferred it for the restore path).
+            if not self.trials:
+                self._generate_initial_trials()
+            return
+        self.trials = []
+        for ts in state["trials"]:
+            t = Trial(ts["trial_id"], ts["config"], self._experiment_dir)
+            t.results = list(ts.get("results") or [])
+            t.last_result = dict(ts.get("last_result") or {})
+            t.error = ts.get("error")
+            t.rungs_passed = set(ts.get("rungs_passed") or ())
+            t.last_perturbation_t = ts.get("last_perturbation_t", 0)
+            ckpt = ts.get("latest_checkpoint")
+            if ckpt and not os.path.isabs(ckpt):
+                ckpt = os.path.join(self._experiment_dir, ckpt)
+            if ckpt and os.path.isdir(ckpt):
+                t.latest_checkpoint = Checkpoint(ckpt)
+            status = ts["status"]
+            if status in (PENDING, RUNNING) or (status == ERROR and restart_errored):
+                t.status = PENDING
+                t.error = None
+                t.restore_checkpoint = t.latest_checkpoint
+                # Resume replays iterations PAST the checkpoint: drop recorded
+                # results the replay will re-report (duplicates would skew
+                # scheduler statistics), and renumber from the checkpoint.
+                k = _checkpoint_iteration(t.latest_checkpoint)
+                t.results = [
+                    r for r in t.results
+                    if r.get("training_iteration", 0) <= k
+                ]
+                t.last_result = dict(t.results[-1]) if t.results else {}
+                t.start_iteration = k
+            else:
+                t.status = status
+            self.trials.append(t)
 
     # -- PBT hook ---------------------------------------------------------
     def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
@@ -185,7 +321,8 @@ class TuneController:
         actor_cls = ray_tpu.remote(**self._resources)(_TrialActor)
         restore = trial.restore_checkpoint.path if trial.restore_checkpoint else None
         trial.actor = actor_cls.remote(
-            self._fn_blob, trial.config, trial.trial_id, trial.local_dir, restore
+            self._fn_blob, trial.config, trial.trial_id, trial.local_dir, restore,
+            trial.start_iteration,
         )
         trial.status = RUNNING
 
@@ -280,7 +417,10 @@ class TuneController:
 
     def run(self):
         while self.step():
+            if time.time() - self._last_snapshot >= self._snapshot_period_s:
+                self.snapshot()
             time.sleep(0.05)
+        self.snapshot()
         failed = [t for t in self.trials if t.status == ERROR]
         if failed and len(failed) == len(self.trials):
             raise RuntimeError(
