@@ -1460,6 +1460,40 @@ class Raylet:
         )
         return True
 
+    async def rpc_check_borrows(self, conn, node_hex: str, worker_hex: str,
+                                object_ids):
+        """Borrow-audit holdings probe: ask the worker which of object_ids it
+        still borrows. None = no verdict (unreachable); the audit must not
+        reconcile on a maybe."""
+        if node_hex == self.node_id.hex():
+            for wid, handle in self.workers.items():
+                if wid.hex() == worker_hex:
+                    if not handle.alive:
+                        return None
+                    try:
+                        return await handle.conn.call(
+                            "borrow_check", {"object_ids": object_ids},
+                            timeout=10.0,
+                        )
+                    except Exception:
+                        return None
+            return None
+        target = None
+        for nid in self.node_view:
+            if nid.hex() == node_hex:
+                target = nid
+                break
+        if target is None:
+            return None
+        peer = await self._peer(target)
+        if peer is None:
+            return None
+        try:
+            return await peer.call("check_borrows", node_hex, worker_hex,
+                                   object_ids, timeout=15.0)
+        except Exception:
+            return None
+
     async def rpc_check_worker_alive(self, conn, node_hex: str, worker_hex: str):
         """Borrow-audit probe: True = alive, False = CONFIRMED dead (its own
         raylet denies it, or the GCS marked its node dead), None = no verdict

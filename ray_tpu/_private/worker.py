@@ -189,6 +189,12 @@ class ReferenceCounter:
         self._preregistered: set[ObjectID] = set()
         # Ids first borrowed inside the currently-executing task (deferred).
         self._task_deferred: set[ObjectID] = set()
+        # borrowed id -> the object's TRUE owner (never re-parented). Used to
+        # mirror sub-borrower registrations to the owner so an INTERMEDIATE
+        # borrower's crash cannot free an object a live grandchild holds
+        # (reference: transitive borrower propagation,
+        # src/ray/core_worker/reference_counter.h:43).
+        self._true_owner: dict[ObjectID, dict] = {}
         self._lock = threading.Lock()
         self._worker = worker
         # GC-safety: __del__ may fire via garbage collection INSIDE a section
@@ -222,6 +228,8 @@ class ReferenceCounter:
             self._owned.add(object_id)
 
     def add_local_ref(self, object_id: ObjectID, owner: dict | None = None):
+        if owner is not None:
+            self.record_true_owner(object_id, owner)
         report_to = None
         materialized = False
         with self._lock:
@@ -295,6 +303,7 @@ class ReferenceCounter:
                         self._pending_upstream.add(object_id)
                     else:
                         report_to = self._borrowed_owner.pop(object_id)
+                        self._true_owner.pop(object_id, None)
                 elif object_id in self._owned:
                     if self._borrow_total_locked(object_id) > 0:
                         self._pending_free.add(object_id)
@@ -317,6 +326,23 @@ class ReferenceCounter:
         with self._lock:
             per = self._borrows.setdefault(object_id, {})
             per[borrower_key] = per.get(borrower_key, 0) + 1
+            mirror = self._mirror_target_locked(object_id)
+        if mirror is not None:
+            self._worker._report_borrow(object_id, mirror, +1, borrower_key)
+
+    def _mirror_target_locked(self, object_id: ObjectID) -> dict | None:
+        """The true owner to mirror a sub-borrower count to — None when this
+        process IS the owner (its table is already authoritative)."""
+        if object_id in self._owned:
+            return None
+        return self._true_owner.get(object_id)
+
+    def record_true_owner(self, object_id: ObjectID, owner: dict | None):
+        if owner is None or owner.get("worker_id") == self._worker.worker_id:
+            return
+        with self._lock:
+            if object_id not in self._owned:
+                self._true_owner.setdefault(object_id, owner)
 
     def pre_register_borrow(self, object_id: ObjectID, parent: dict):
         """Caller side of a result-ref handoff: seed the parent so the first
@@ -378,6 +404,11 @@ class ReferenceCounter:
         """Parent side: a borrower registered (+1) or released (-1)."""
         self._apply_borrow(object_id, delta, borrower_key)
 
+    def drop_borrow_entry(self, object_id: ObjectID, borrower_key: str):
+        """Audit verdict: a live borrower no longer holds this id (its release
+        was lost to a crashed parent): reconcile just that entry."""
+        self._apply_borrow(object_id, None, borrower_key)
+
     def drop_borrower(self, borrower_key: str):
         """A borrower process died without releasing: reconcile its counts."""
         with self._lock:
@@ -390,8 +421,21 @@ class ReferenceCounter:
     def _apply_borrow(self, object_id: ObjectID, delta, borrower_key: str):
         free = False
         report_to = None
+        mirror_to = None
+        mirror_delta = 0
         with self._lock:
             per = self._borrows.setdefault(object_id, {})
+            # Mirror every sub-borrower count change to the TRUE owner (no-op
+            # when we are the owner): the owner's table then lists every
+            # transitive borrower, so this process crashing cannot strand a
+            # live grandchild's count. Mirrors land via the same routed
+            # borrow_update; negative-entry tolerance absorbs reorders.
+            mirror_to = self._mirror_target_locked(object_id)
+            if mirror_to is not None:
+                if delta is None:
+                    mirror_delta = -max(per.get(borrower_key, 0), 0)
+                else:
+                    mirror_delta = delta
             if delta is None:
                 per.pop(borrower_key, None)  # borrower died: drop all its refs
             else:
@@ -422,6 +466,10 @@ class ReferenceCounter:
                 ):
                     self._pending_upstream.discard(object_id)
                     report_to = self._borrowed_owner.pop(object_id, None)
+                    self._true_owner.pop(object_id, None)
+        if mirror_to is not None and mirror_delta:
+            self._worker._report_borrow(object_id, mirror_to, mirror_delta,
+                                        borrower_key)
         if report_to is not None:
             self._worker._report_borrow(object_id, report_to, -1)
         if free:
@@ -620,6 +668,9 @@ class CoreWorker:
         self._reply_embedded: dict = {}
         self._embedded_materialized: set[ObjectID] = set()
         self._embedded_lock = threading.Lock()
+        # put object id -> refs embedded in its payload, pinned until the put
+        # object is freed (contained-in protection; see put()).
+        self._put_embedded_pins: dict[ObjectID, list[ObjectID]] = {}
         # Owned ids with an attached resource (e.g. a device-object HBM pin):
         # the hook runs when the id's last reference dies cluster-wide.
         self._owned_free_hooks: dict[ObjectID, Any] = {}
@@ -845,7 +896,23 @@ class CoreWorker:
     def put(self, value: Any) -> ObjectRef:
         self.reference_counter.drain_deferred()
         object_id = ObjectID.from_task(self.current_task_id, 0x40000000 + self._put_counter.next())
-        self._put_to_plasma(object_id, value, self._owner_address())
+        # Capture refs embedded in the payload and pin them for the put
+        # object's lifetime: the putter holds live refs at serialization time,
+        # so the pin is sequenced (no fire-and-forget racing the owner's
+        # free). Released in _free_owned_object when the put object dies —
+        # the "contained_in" protection of the reference's reference_counter.
+        prev_cap = getattr(self._tls, "ref_capture", None)
+        self._tls.ref_capture = cap = []
+        try:
+            self._put_to_plasma(object_id, value, self._owner_address())
+        finally:
+            self._tls.ref_capture = prev_cap
+        if cap:
+            pins = []
+            for eid, eowner in cap:
+                self.reference_counter.add_local_ref(eid, eowner)
+                pins.append(eid)
+            self._put_embedded_pins[object_id] = pins
         self.reference_counter.add_owned(object_id)
         rec = self.memory_store.create_pending(object_id)
         rec.in_plasma = True
@@ -1153,6 +1220,8 @@ class CoreWorker:
         self.memory_store.pop(object_id)
         self._drop_lineage(object_id)
         self._settle_embedded_on_free(object_id)
+        for eid in self._put_embedded_pins.pop(object_id, ()):
+            self.reference_counter.remove_local_ref(eid)
         hook = self._owned_free_hooks.pop(object_id, None)
         if hook is not None:
             try:
@@ -1179,17 +1248,23 @@ class CoreWorker:
             except Exception:
                 pass
 
-    def _report_borrow(self, object_id: ObjectID, owner: dict, delta: int):
+    def _report_borrow(self, object_id: ObjectID, owner: dict, delta: int,
+                       borrower_key=None):
+        """Route a borrow count change to `owner`. `borrower_key` defaults to
+        this process; transitive mirrors pass the SUB-borrower's key so the
+        true owner's table lists the actual holder."""
         if not self._connected or self.raylet is None:
             return
+        key = borrower_key if borrower_key is not None else _addr_key(
+            self._owner_address()
+        )
 
         async def _send():
             delay = CONFIG.test_delay_borrow_report_ms
             if delay:  # fault injection: stress the reorder the sequenced
                 await asyncio.sleep(delay / 1000)  # protocol must be immune to
             await self.raylet.notify(
-                "report_borrow", object_id, owner, delta,
-                _addr_key(self._owner_address()),
+                "report_borrow", object_id, owner, delta, key,
             )
 
         try:
@@ -1230,6 +1305,8 @@ class CoreWorker:
         embeds = payload.get("result_refs") or ()
         pending = []
         for oid, _owner in embeds:
+            if _owner is not None:
+                self.reference_counter.record_true_owner(oid, _owner)
             if self.reference_counter.pre_register_borrow(oid, src):
                 pending.append(oid)
             else:
@@ -1285,9 +1362,11 @@ class CoreWorker:
         address; persistent unreachability drops its counts (reference:
         reference_counter subscribes to borrower death via the raylet)."""
         failures: dict[str, int] = {}
+        stale: dict[tuple, int] = {}  # (borrower_key, oid) -> not-held strikes
         while self._connected:
             await asyncio.sleep(CONFIG.borrow_audit_interval_s)
             snapshot = self.reference_counter.borrower_snapshot()
+            stale = {k: v for k, v in stale.items() if k[0] in snapshot}
             for key in snapshot:
                 node_hex, worker_hex = key
                 if node_hex == "?":
@@ -1302,6 +1381,39 @@ class CoreWorker:
                     continue  # unreachable != dead: never free on a maybe
                 if alive:
                     failures.pop(key, None)
+                    # Liveness is not enough: a borrower that released into a
+                    # crashed parent's void still has a count here (the -1
+                    # never arrived). Ask what it actually still holds; two
+                    # consecutive not-held verdicts reconcile the entry
+                    # (one-shot would race an in-flight handoff the holder
+                    # hasn't learned about yet).
+                    try:
+                        resp = await self.raylet.call(
+                            "check_borrows", node_hex, worker_hex,
+                            snapshot[key], timeout=15.0,
+                        )
+                    except Exception:
+                        resp = None
+                    if not isinstance(resp, dict) or "held" not in resp:
+                        continue
+                    held = set(resp["held"])
+                    now = time.monotonic()
+                    for oid in snapshot[key]:
+                        sk = (key, oid)
+                        if oid in held:
+                            stale.pop(sk, None)
+                            continue
+                        strikes, first_t = stale.get(sk, (0, now))
+                        strikes += 1
+                        # Three consecutive not-held rounds AND a minimum
+                        # wall-clock age: a sequenced handoff still in flight
+                        # (reply not yet processed by the holder) must never
+                        # be reconciled away on a fast audit interval.
+                        if strikes >= 3 and now - first_t >= 2.0:
+                            stale.pop(sk, None)
+                            self.reference_counter.drop_borrow_entry(oid, key)
+                        else:
+                            stale[sk] = (strikes, first_t)
                     continue
                 failures[key] = failures.get(key, 0) + 1
                 if failures[key] >= 2:  # two strikes: not a transient blip
@@ -2247,6 +2359,8 @@ class CoreWorker:
         if src is not None:
             pending = []
             for roid, _o in result.get("result_refs") or ():
+                if _o is not None:
+                    self.reference_counter.record_true_owner(roid, _o)
                 if self.reference_counter.pre_register_borrow(roid, src):
                     pending.append(roid)
                 else:
@@ -2286,6 +2400,23 @@ class CoreWorker:
                 st.abort_error = WorkerCrashedError(payload.get("reason", "stream lost"))
                 st.cond.notify_all()
         return True
+
+    async def rpc_borrow_check(self, conn, payload):
+        """Audit probe: which of these ids does this process still hold (as a
+        local ref, a sub-borrower parent, or an in-flight handoff)?"""
+        rc = self.reference_counter
+        held = []
+        with rc._lock:
+            for oid in payload["object_ids"]:
+                if (
+                    rc._counts.get(oid, 0) > 0
+                    or rc._borrow_total_locked(oid) > 0
+                    or oid in rc._preregistered
+                    or oid in rc._task_deferred
+                    or oid in rc._pending_upstream
+                ):
+                    held.append(oid)
+        return {"held": held}
 
     async def rpc_borrow_update(self, conn, payload):
         self.reference_counter.update_borrow(
