@@ -1,0 +1,140 @@
+"""Serving benchmark: TTFT + decode throughput of the TPU decode engine.
+
+Measures the BASELINE.md "Serve LLM tokens/s + TTFT" north star directly on the
+continuous-batching engine (`ray_tpu/llm/_engine.py`) — no cluster in the
+measurement path, so the numbers are the engine's own ceiling:
+
+- TTFT: submit -> first token on a warm engine (compiled prefill bucket),
+  single request, empty batch (the latency-bound regime).
+- decode tokens/s at concurrency 1/2/4/8: all requests in flight together
+  through the slot scheduler; total generated tokens / wall time.
+- speculative decoding on/off at concurrency 1 (self-draft upper bound: the
+  draft IS the target, so every proposal verifies — measures the dispatch
+  mechanics' best case, reference vllm spec_decode).
+
+Writes BENCH_SERVE.json: a list of measurement dicts + environment metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+def build_engine(spec: bool = False, slots: int = 8):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import LLMConfig, load_model
+    from ray_tpu.llm._engine import DecodeEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_id = "gpt2-125m" if on_tpu else "test-tiny"
+    cfg, params = load_model(LLMConfig(model_id=model_id))
+    max_seq = 1024 if on_tpu else 128
+    spec_config = None
+    if spec:
+        spec_config = {"draft_cfg": cfg, "draft_params": params,
+                       "num_spec_tokens": 6}
+    engine = DecodeEngine(
+        cfg, params, num_slots=slots, max_seq=max_seq, seed=0,
+        spec_config=spec_config,
+    )
+    return engine, cfg, model_id, on_tpu
+
+
+def run_requests(engine, vocab: int, n: int, prompt_len: int, max_tokens: int):
+    """Submit n concurrent requests; returns (ttft_first_req_s, tokens/s, total)."""
+    from ray_tpu.llm._engine import SamplingParams
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    done = [threading.Event() for _ in range(n)]
+    first_token_t = [None] * n
+    counts = [0] * n
+    t0 = time.perf_counter()
+
+    def cb_for(i):
+        def cb(token, finished):
+            if first_token_t[i] is None:
+                first_token_t[i] = time.perf_counter() - t0
+            counts[i] += 1
+            if finished:
+                done[i].set()
+
+        return cb
+
+    for i in range(n):
+        prompt = rng.integers(0, vocab, prompt_len).tolist()
+        engine.submit(prompt, SamplingParams(max_tokens=max_tokens), cb_for(i))
+    for ev in done:
+        if not ev.wait(timeout=600):
+            raise TimeoutError("generation did not finish")
+    elapsed = time.perf_counter() - t0
+    total = sum(counts)
+    return first_token_t[0], total / elapsed, total
+
+
+def main():
+    import jax
+
+    results = []
+    engine, cfg, model_id, on_tpu = build_engine(spec=False, slots=8)
+    prompt_len, max_tokens = (128, 64) if on_tpu else (16, 16)
+
+    # Warm every compiled program off-clock: prefill bucket, batched decode,
+    # and every multi-step chunk bucket the measured budget will use
+    # (8/4/2/1 for max_tokens=64).
+    run_requests(engine, cfg.vocab_size, 2, prompt_len, max_tokens)
+
+    # TTFT: warm single request into an empty engine.
+    ttfts = []
+    for _ in range(3):
+        ttft, _, _ = run_requests(engine, cfg.vocab_size, 1, prompt_len, 2)
+        ttfts.append(ttft)
+    results.append({
+        "metric": "ttft_warm_s", "value": round(min(ttfts), 4),
+        "prompt_len": prompt_len, "model": model_id,
+    })
+
+    # Decode throughput vs concurrency (continuous batching).
+    for conc in (1, 2, 4, 8):
+        _, tps, total = run_requests(
+            engine, cfg.vocab_size, conc, prompt_len, max_tokens
+        )
+        results.append({
+            "metric": "decode_tokens_per_s", "concurrency": conc,
+            "value": round(tps, 1), "tokens": total, "model": model_id,
+        })
+    engine.shutdown()
+
+    # Speculative decoding (self-draft upper bound), concurrency 1.
+    engine_spec, cfg_s, _, _ = build_engine(spec=True, slots=8)
+    run_requests(engine_spec, cfg_s.vocab_size, 1, prompt_len, max_tokens)  # warm
+    _, tps_spec, _ = run_requests(
+        engine_spec, cfg_s.vocab_size, 1, prompt_len, max_tokens
+    )
+    engine_spec.shutdown()
+    base = next(r["value"] for r in results
+                if r["metric"] == "decode_tokens_per_s" and r["concurrency"] == 1)
+    results.append({
+        "metric": "decode_tokens_per_s_specdecode", "concurrency": 1,
+        "value": round(tps_spec, 1), "speedup_vs_plain": round(tps_spec / base, 2),
+        "model": model_id, "note": "self-draft k=6: all-accept upper bound",
+    })
+
+    out = {
+        "bench": "serve_engine",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0].device_kind),
+        "results": results,
+    }
+    with open("BENCH_SERVE.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

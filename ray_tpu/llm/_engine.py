@@ -186,7 +186,7 @@ class DecodeEngine:
     def __init__(self, cfg: ModelConfig, params, *, num_slots: int = 4,
                  max_seq: Optional[int] = None, seed: int = 0,
                  lora_config: Optional[dict] = None, decode_loop: bool = True,
-                 spec_config: Optional[dict] = None):
+                 spec_config: Optional[dict] = None, multi_step: int = 8):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -231,6 +231,17 @@ class DecodeEngine:
         self.error: Optional[BaseException] = None
         self._jit_prefill = {}
         self._jit_decode = jax.jit(self._decode_step)
+        # Multi-step decode: N greedy tokens per dispatch (argmax on device,
+        # lax.scan over decode steps) — one host round trip per CHUNK instead
+        # of per token. The win is dispatch-latency-bound regimes (remote
+        # tunnels, small models where the step is microseconds); the role of
+        # vLLM's multi-step scheduling (num_scheduler_steps). Engaged only
+        # when every active slot samples greedily; host-side stop/max_tokens
+        # handling rolls per-slot state back after the readback.
+        self._multi_step = max(1, int(multi_step))
+        self._jit_decode_multi = jax.jit(
+            self._decode_multi, static_argnames=("n",)
+        )  # jax caches one program per distinct static n
         # Speculative decoding (reference: vLLM speculative decoding /
         # spec_decode workers): a cheap DRAFT model proposes k tokens in ONE
         # jitted lax.scan program; the target verifies all k in one forward.
@@ -344,6 +355,22 @@ class DecodeEngine:
             lora=lora, adapter_ids=adapter_ids,
         )
         return logits[:, 0], new_caches, lens + 1
+
+    def _decode_multi(self, params, lora, adapter_ids, last_token, caches, lens,
+                      *, n):
+        """n greedy tokens for every slot in ONE program: lax.scan over decode
+        steps with on-device argmax. Returns ([n, B] tokens, final caches/lens)."""
+
+        def step(carry, _):
+            last, c, l = carry
+            logits, c, l = self._decode_step(params, lora, adapter_ids, last, c, l)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, c, l), nxt
+
+        (last, caches, lens), toks = jax.lax.scan(
+            step, (last_token, caches, lens), None, length=n
+        )
+        return toks, caches, lens
 
     def _scatter_slot(self, caches, new_slot, slot):
         """Write a [1, T, ...] slot view back into the full [B, T, ...] caches."""
@@ -750,6 +777,10 @@ class DecodeEngine:
                     if self._spec["ready"][i]:
                         self._spec["ready"][i] = False
                         self._spec["pending"][i] = None
+            n = self._choose_multi_step(active)
+            if n > 1:
+                self._multi_round(active, n)
+                continue
             logits, self._caches, self._lens = self._jit_decode(
                 self.params, self._lora, self._adapter_ids, self._last_token,
                 self._caches, self._lens,
@@ -765,3 +796,58 @@ class DecodeEngine:
                 new_last[i] = token
                 self._emit(i, token)
             self._last_token = jnp.asarray(new_last)
+
+    def _choose_multi_step(self, active) -> int:
+        """Tokens to decode in the next dispatch: >1 only when every active
+        slot is greedy (on-device argmax is exact then), no request is queued
+        (a waiting request needs a slot to free promptly), and capped at the
+        smallest remaining budget (power-of-two bucketed to bound the jit
+        cache)."""
+        if self._multi_step <= 1:
+            return 1
+        with self._lock:
+            if self._queue:
+                return 1
+        if any(self._slots[i].params.temperature > 0 for i in active):
+            return 1
+        remaining = min(
+            self._slots[i].params.max_tokens - self._slots[i].generated
+            for i in active
+        )
+        n = max(1, min(self._multi_step, remaining))
+        bucket = 1
+        while bucket * 2 <= n:
+            bucket *= 2
+        return bucket
+
+    def _multi_round(self, active, n: int):
+        """One multi-token dispatch + host-side emission with rollback for
+        slots that stop early (stop_token): their device lens/last_token are
+        corrected back to what was actually consumed."""
+        toks_dev, self._caches, lens = self._jit_decode_multi(
+            self.params, self._lora, self._adapter_ids, self._last_token,
+            self._caches, self._lens, n=n,
+        )
+        toks = np.asarray(toks_dev)  # [n, B]
+        new_last = np.array(self._last_token)
+        new_lens = np.asarray(lens).copy()
+        for i in active:
+            s = self._slots[i]
+            consumed = 0
+            for j in range(n):
+                if not s.active:
+                    break
+                token = int(toks[j, i])
+                consumed += 1
+                s.generated += 1
+                s.host_len += 1
+                s.tokens.append(token)
+                new_last[i] = token
+                self._emit(i, token)
+            if consumed < n:
+                # Early stop: rows past the last consumed token are invisible
+                # once lens rolls back (kv_mask <= lens) and get overwritten
+                # by the slot's next occupant.
+                new_lens[i] = s.host_len
+        self._lens = jnp.asarray(new_lens)
+        self._last_token = jnp.asarray(new_last)
