@@ -903,10 +903,15 @@ class GcsService:
     async def rpc_list_objects(self, conn, limit: int = 1000):
         out = []
         for oid, entry in self.object_dir.items():
+            owner = entry.get("owner") or {}
+            owner_worker = owner.get("worker_id")
+            owner_node = owner.get("node_id")
             out.append({
                 "object_id": oid.hex(),
                 "size": entry["size"],
                 "num_locations": len(entry["locations"]),
+                "owner_worker_id": owner_worker.hex() if owner_worker else None,
+                "owner_node_id": owner_node.hex() if owner_node else None,
             })
             if len(out) >= limit:
                 break

@@ -17,26 +17,81 @@ def _gcs(*args):
     return ray_tpu.global_worker().gcs_call(*args)
 
 
-def list_nodes() -> List[Dict[str, Any]]:
-    return _gcs("get_nodes")
+def _coerce_pair(a: Any, b: Any):
+    """Compare numerically when both sides parse as numbers, else as strings
+    (entity fields arrive as heterogeneous python values)."""
+    try:
+        return float(a), float(b)
+    except (TypeError, ValueError):
+        return str(a), str(b)
 
 
-def list_actors(*, filters=None) -> List[Dict[str, Any]]:
-    actors = _gcs("list_actors")
-    if filters:
-        for key, op, value in filters:
-            assert op == "=", "only '=' filters are supported"
-            actors = [a for a in actors if str(a.get(key)) == str(value)]
-    return actors
+_OPS = {
+    "=": lambda a, b: a == b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
 
 
-def list_tasks(*, limit: int = 1000, filters=None) -> List[Dict[str, Any]]:
-    events = _gcs("list_task_events", limit)
-    if filters:
-        for key, op, value in filters:
-            assert op == "=", "only '=' filters are supported"
-            events = [e for e in events if str(e.get(key)) == str(value)]
-    return events
+def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
+    """Filter triples (key, op, value) with the reference's predicate set
+    (python/ray/util/state/common.py supports =/!= plus comparisons)."""
+    for key, op, value in filters or ():
+        try:
+            pred = _OPS[op]
+        except KeyError:
+            raise ValueError(
+                f"unsupported filter op {op!r}; one of {sorted(_OPS)}"
+            ) from None
+        rows = [r for r in rows if pred(*_coerce_pair(r.get(key), value))]
+    return rows
+
+
+def _paginate(rows: List[Dict[str, Any]], limit: Optional[int], offset: int):
+    if offset:
+        rows = rows[offset:]
+    if limit is not None:
+        rows = rows[:limit]
+    return rows
+
+
+def list_nodes(*, filters=None, limit: Optional[int] = None,
+               offset: int = 0) -> List[Dict[str, Any]]:
+    return _paginate(_apply_filters(_gcs("get_nodes"), filters), limit, offset)
+
+
+def list_actors(*, filters=None, limit: Optional[int] = None,
+                offset: int = 0) -> List[Dict[str, Any]]:
+    return _paginate(
+        _apply_filters(_gcs("list_actors"), filters), limit, offset
+    )
+
+
+def get_actor(actor_id_hex: str) -> Optional[Dict[str, Any]]:
+    """Per-entity drill-down (parity: `ray get actors <id>`)."""
+    for a in _gcs("list_actors"):
+        aid = a.get("actor_id")
+        if (aid.hex() if hasattr(aid, "hex") else str(aid)) == actor_id_hex:
+            return a
+    return None
+
+
+def list_tasks(*, limit: Optional[int] = 1000, filters=None,
+               offset: int = 0) -> List[Dict[str, Any]]:
+    fetch = 100_000 if (filters or offset) else (limit or 100_000)
+    events = _apply_filters(_gcs("list_task_events", fetch), filters)
+    return _paginate(events, limit, offset)
+
+
+def get_task(task_id_hex: str) -> List[Dict[str, Any]]:
+    """Per-entity drill-down: every recorded event of one task, time-ordered."""
+    events = [e for e in _gcs("list_task_events", 100_000)
+              if e.get("task_id") == task_id_hex]
+    return sorted(events, key=lambda e: e.get("time", 0.0))
 
 
 def list_objects(*, limit: int = 1000) -> List[Dict[str, Any]]:
@@ -76,6 +131,90 @@ def summarize_actors() -> Dict[str, int]:
     return dict(by_state)
 
 
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Export task events as Chrome trace-event JSON (parity: `ray timeline`,
+    reference python/ray/scripts/scripts.py + GcsTaskManager events). The
+    output loads in Perfetto / chrome://tracing.
+
+    Lanes: one pid per worker (scheduling spans on the submitting worker's
+    lane, execution spans on the executing worker's)."""
+    import json
+
+    events = list_tasks(limit=100_000)
+    per_task: Dict[str, Dict[str, Any]] = {}
+    workers: Dict[str, int] = {}
+
+    def lane(worker_hex: Optional[str]) -> int:
+        key = worker_hex or "?"
+        return workers.setdefault(key, len(workers) + 1)
+
+    for e in events:
+        tid = e.get("task_id")
+        if tid is None:
+            continue
+        rec = per_task.setdefault(tid, {"name": e.get("name", "?")})
+        rec[e.get("state", "UNKNOWN")] = e
+    trace: List[Dict[str, Any]] = []
+    for tid, rec in per_task.items():
+        sub, run = rec.get("SUBMITTED"), rec.get("RUNNING")
+        end = rec.get("FINISHED") or rec.get("FAILED")
+        if sub and run:
+            trace.append({
+                "name": f"schedule:{rec['name']}", "cat": "scheduling",
+                "ph": "X", "ts": sub["time"] * 1e6,
+                "dur": max(run["time"] - sub["time"], 0) * 1e6,
+                "pid": lane(sub.get("worker_id")), "tid": 0,
+                "args": {"task_id": tid},
+            })
+        if run and end:
+            trace.append({
+                "name": rec["name"],
+                "cat": "task",
+                "ph": "X", "ts": run["time"] * 1e6,
+                "dur": max(end["time"] - run["time"], 0) * 1e6,
+                "pid": lane(run.get("worker_id")), "tid": 0,
+                "args": {
+                    "task_id": tid,
+                    "state": "FAILED" if rec.get("FAILED") else "FINISHED",
+                    **{k: run.get(k) for k in ("trace_id", "span_id")
+                       if run.get(k)},
+                },
+            })
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": idx,
+         "args": {"name": f"worker {hex_[:12]}"}}
+        for hex_, idx in workers.items()
+    ]
+    out = meta + sorted(trace, key=lambda ev: ev["ts"])
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(out, f)
+    return out
+
+
+def memory_summary(*, limit: int = 10_000) -> Dict[str, Any]:
+    """Object-store contents grouped by owner (parity: `ray memory`,
+    reference python/ray/_private/internal_api.py memory_summary)."""
+    objects = list_objects(limit=limit)
+    by_owner: Dict[str, Dict[str, Any]] = {}
+    total = 0
+    for o in objects:
+        size = o.get("size") or 0
+        total += size
+        key = o.get("owner_worker_id") or "?"
+        agg = by_owner.setdefault(key, {"count": 0, "bytes": 0})
+        agg["count"] += 1
+        agg["bytes"] += size
+    return {
+        "num_objects": len(objects),
+        "total_bytes": total,
+        # The directory listing is capped: totals cover only what's listed.
+        "truncated": len(objects) >= limit,
+        "by_owner": by_owner,
+        "objects": objects,
+    }
+
+
 def cluster_summary() -> Dict[str, Any]:
     nodes = list_nodes()
     return {
@@ -90,12 +229,16 @@ def cluster_summary() -> Dict[str, Any]:
 
 __all__ = [
     "cluster_summary",
+    "get_actor",
+    "get_task",
     "list_actors",
     "list_jobs",
     "list_nodes",
     "list_objects",
     "list_placement_groups",
     "list_tasks",
+    "memory_summary",
     "summarize_actors",
     "summarize_tasks",
+    "timeline",
 ]

@@ -49,6 +49,103 @@ def test_state_lists():
     assert "CPU" in summary["resources_total"]
 
 
+def test_state_filters_pagination_and_drilldown():
+    """Comparison filters, pagination, and per-entity drill-down (parity:
+    python/ray/util/state predicates + `ray get`)."""
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return "pong"
+
+    probes = [Probe.remote() for _ in range(3)]
+    for p in probes:
+        assert ray_tpu.get(p.ping.remote(), timeout=60) == "pong"
+    alive = state.list_actors(filters=[("state", "=", "ALIVE"),
+                                       ("class_name", "=", "Probe")])
+    assert len(alive) >= 3
+    assert state.list_actors(
+        filters=[("class_name", "=", "Probe"), ("state", "!=", "ALIVE")]
+    ) == []
+    # pagination slices deterministically
+    page1 = state.list_actors(filters=[("class_name", "=", "Probe")], limit=2)
+    page2 = state.list_actors(filters=[("class_name", "=", "Probe")], limit=2,
+                              offset=2)
+    assert len(page1) == 2 and len(page2) >= 1
+    ids = {a["actor_id"].hex() for a in page1} | {
+        a["actor_id"].hex() for a in page2
+    }
+    assert len(ids) >= 3
+    # numeric comparison op
+    assert state.list_actors(filters=[("num_restarts", "<", 1)])
+    # drill-down: one actor, one task's full event history
+    target = alive[0]
+    got = state.get_actor(target["actor_id"].hex())
+    assert got is not None and got.get("class_name") == "Probe"
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        tasks = state.list_tasks(filters=[("name", "=", "ping")])
+        if tasks:
+            break
+        time.sleep(0.5)
+    assert tasks, "no ping task events"
+    history = state.get_task(tasks[0]["task_id"])
+    assert history and [e.get("time") for e in history] == sorted(
+        e.get("time") for e in history
+    )
+    for p in probes:
+        ray_tpu.kill(p)
+
+
+def test_timeline_chrome_trace_export(tmp_path):
+    """`ray_tpu timeline` capability (reference: `ray timeline` Chrome trace
+    export): spans carry ph/ts/dur and the file is valid trace JSON."""
+    import json
+
+    @ray_tpu.remote
+    def traced_work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced_work.remote() for _ in range(3)], timeout=120)
+    out = str(tmp_path / "trace.json")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        events = state.timeline(out)
+        spans = [e for e in events if e.get("ph") == "X"
+                 and "traced_work" in str(e.get("name"))]
+        if len(spans) >= 3:
+            break
+        time.sleep(0.5)
+    assert len(spans) >= 3, events[:5]
+    for span in spans:
+        assert span["dur"] >= 0 and span["ts"] > 0 and "pid" in span
+    loaded = json.load(open(out))  # Perfetto-loadable: plain JSON array
+    assert isinstance(loaded, list) and len(loaded) == len(events)
+
+
+def test_memory_summary_by_owner():
+    """`ray_tpu memory` capability (reference: `ray memory`): live objects
+    grouped by owner with sizes."""
+    import numpy as np
+
+    refs = [ray_tpu.put(np.zeros(300_000, np.uint8)) for _ in range(3)]
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        summary = state.memory_summary()
+        big = [o for o in summary["objects"] if (o.get("size") or 0) >= 300_000]
+        if len(big) >= 3:
+            break
+        time.sleep(0.5)
+    assert len(big) >= 3, summary["objects"][:5]
+    assert summary["total_bytes"] >= 900_000
+    owners = {o.get("owner_worker_id") for o in big}
+    assert owners and None not in owners, "objects missing owner attribution"
+    top_owner = max(summary["by_owner"].items(), key=lambda kv: kv[1]["bytes"])
+    assert top_owner[1]["bytes"] >= 900_000
+    del refs
+
+
 def test_actor_pool_ordered_and_unordered():
     @ray_tpu.remote
     class Sq:
