@@ -151,6 +151,22 @@ class Autoscaler:
         gcs_nodes = worker.gcs_call("get_nodes")
         provider_nodes = self._provider.non_terminated_nodes()
         actions = {"added": 0, "removed": 0}
+        # Floor: min_workers are provisioned up front, demand or not
+        # (reference: `ray up` brings min_workers online at launch).
+        short = cfg.min_workers - len(provider_nodes)
+        if short > 0:
+            for _ in range(min(short, cfg.upscaling_speed)):
+                try:
+                    pid = self._provider.create_node(dict(cfg.worker_resources))
+                except Exception:
+                    # Pool exhausted / transient provisioning failure: the
+                    # floor must not abort the rest of this tick (demand
+                    # upscale + idle downscale still need to run).
+                    break
+                self._created_at[pid] = time.monotonic()
+                self.num_scale_ups += 1
+                actions["added"] += 1
+            provider_nodes = self._provider.non_terminated_nodes()
         # Upscale: enough worker nodes to absorb the unplaceable demand — minus
         # nodes already LAUNCHED but not yet registered with the GCS (counting
         # them again would over-provision to max_workers while they boot).

@@ -169,6 +169,10 @@ def _build_provider(cfg: dict, head_address: str, gcs_addr: tuple | None = None)
                 raise RuntimeError("no running head found for the local provider")
             gcs_addr = ("127.0.0.1", addr["gcs_port"])
         return _LocalWorkerProvider(gcs_addr)
+    if ptype == "ssh":
+        from ray_tpu.autoscaler.ssh import SSHNodeProvider
+
+        return SSHNodeProvider(provider_cfg, head_address=head_address)
     raise ValueError(f"unknown provider type {ptype!r}")
 
 
@@ -192,14 +196,16 @@ def cmd_up(args):
     _write_addr(handle.gcs_port, handle.raylet_port)
     local_address = f"127.0.0.1:{handle.gcs_port}"
     # Remote workers (TPU slices) must dial a reachable address, not loopback.
-    public_address = (
-        head_cfg.get("address") or f"{_head_ip()}:{handle.gcs_port}"
+    # head.address pins host:port outright; head.host pins the host while the
+    # GCS port stays dynamic (single-host/test topologies).
+    public_address = head_cfg.get("address") or (
+        f"{head_cfg.get('host') or _head_ip()}:{handle.gcs_port}"
     )
     print(f"head started: gcs={local_address} (workers join {public_address})")
 
     import ray_tpu
 
-    ray_tpu.init(address=local_address)
+    ray_tpu.init(address=local_address, _raylet_port=handle.raylet_port)
     workers = cfg["workers"]
     provider = _build_provider(
         cfg, public_address, gcs_addr=("127.0.0.1", handle.gcs_port)
@@ -303,6 +309,42 @@ def cmd_status(_args):
     _connect_from_file()
     summary = state.cluster_summary()
     print(json.dumps(summary, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_timeline(args):
+    """Export task events as Chrome trace-event JSON (reference: `ray
+    timeline`, python/ray/scripts/scripts.py). Loads in Perfetto."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _connect_from_file()
+    out = args.output or "ray_tpu_timeline.json"
+    events = state.timeline(out)
+    spans = sum(1 for e in events if e.get("ph") == "X")
+    print(f"wrote {spans} spans to {out} (open in https://ui.perfetto.dev "
+          f"or chrome://tracing)")
+    ray_tpu.shutdown()
+
+
+def cmd_memory(_args):
+    """Summarize object-store contents by owner (reference: `ray memory`,
+    python/ray/_private/internal_api.py)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _connect_from_file()
+    summary = state.memory_summary()
+    cap = " (listing capped; totals are a lower bound)" if summary.get(
+        "truncated") else ""
+    print(f"{summary['num_objects']} objects, "
+          f"{summary['total_bytes'] / (1 << 20):.1f} MiB total{cap}")
+    for owner, agg in sorted(summary["by_owner"].items(),
+                             key=lambda kv: -kv[1]["bytes"]):
+        print(f"  owner {owner[:12]}: {agg['count']} objects, "
+              f"{agg['bytes'] / (1 << 20):.2f} MiB")
+    for obj in summary["objects"][:50]:
+        print(json.dumps(obj, default=str))
     ray_tpu.shutdown()
 
 
@@ -453,6 +495,16 @@ def main(argv=None):
     p.set_defaults(fn=cmd_down)
     sub.add_parser("stop", help="stop the local head").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("timeline",
+                       help="export task events as Chrome trace JSON")
+    p.add_argument("output", nargs="?", help="output file "
+                   "(default ray_tpu_timeline.json)")
+    p.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser(
+        "memory", help="object-store contents by owner"
+    ).set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["nodes", "actors", "tasks", "objects",
