@@ -24,6 +24,8 @@ from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -31,9 +33,11 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Domain,
+    HyperOptSearch,
     OptunaSearch,
     Searcher,
     TPESearch,
+    TuneBOHB,
     choice,
     grid_search,
     loguniform,
@@ -288,6 +292,9 @@ __all__ = [
     "Checkpoint",
     "Domain",
     "FIFOScheduler",
+    "HyperBandForBOHB",
+    "HyperBandScheduler",
+    "HyperOptSearch",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
@@ -295,6 +302,7 @@ __all__ = [
     "Searcher",
     "TPESearch",
     "TrialScheduler",
+    "TuneBOHB",
     "TuneConfig",
     "Tuner",
     "choice",

@@ -23,6 +23,7 @@ from ray_tpu.tune import schedulers as sched_mod
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"  # stopped with a checkpoint, awaiting a scheduler release
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
@@ -269,6 +270,10 @@ class TuneController:
                     pass
         if state.get("target_samples"):
             self._target_samples = state["target_samples"]
+            if self._tune_config.max_concurrent_trials is None:
+                # __init__ computed this before the restore knew the real
+                # trial count (restoring=True skips trial generation).
+                self._max_concurrent = max(1, self._target_samples)
         if not state.get("trials"):
             # Killed before the first snapshot: run from the definition
             # (static searchers regenerate their variant set here when
@@ -290,7 +295,9 @@ class TuneController:
             if ckpt and os.path.isdir(ckpt):
                 t.latest_checkpoint = Checkpoint(ckpt)
             status = ts["status"]
-            if status in (PENDING, RUNNING) or (status == ERROR and restart_errored):
+            if status in (PENDING, RUNNING, PAUSED) or (
+                status == ERROR and restart_errored
+            ):
                 t.status = PENDING
                 t.error = None
                 t.restore_checkpoint = t.latest_checkpoint
@@ -307,6 +314,20 @@ class TuneController:
             else:
                 t.status = status
             self.trials.append(t)
+
+    # -- scheduler hooks (PAUSE: reference trial_scheduler.py PAUSE action) -
+    def pause_trial(self, trial: Trial):
+        """Stop the trial's actor, keeping its latest checkpoint for resume.
+        Used by synchronous schedulers (HyperBand rung barriers)."""
+        if trial.status != RUNNING:
+            return
+        self._stop_trial(trial, PAUSED)
+        trial.restore_checkpoint = trial.latest_checkpoint
+        trial.start_iteration = _checkpoint_iteration(trial.latest_checkpoint)
+
+    def unpause_trial(self, trial: Trial):
+        if trial.status == PAUSED:
+            trial.status = PENDING
 
     # -- PBT hook ---------------------------------------------------------
     def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
@@ -334,6 +355,22 @@ class TuneController:
             except Exception:
                 pass
             trial.actor = None
+
+    def finalize_trial(self, trial: Trial, status: str, *,
+                       notify_scheduler: bool = True):
+        """Terminal stop: every path that ends a trial funnels here so the
+        scheduler (rung barriers!) and searcher each observe the outcome
+        exactly once. PBT exploits / HyperBand pauses are NOT terminal and
+        use _stop_trial/pause_trial directly."""
+        self._stop_trial(trial, status)
+        if getattr(trial, "_finalized", False):
+            return
+        trial._finalized = True
+        if notify_scheduler:
+            self._scheduler.on_trial_complete(self, trial, trial.last_result)
+        self._searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=status == ERROR
+        )
 
     def _apply_exploits(self):
         for trial, donor, new_config in self._exploits:
@@ -368,6 +405,15 @@ class TuneController:
                 self._target_samples = len(self.trials)
                 break
             self.trials.append(Trial(tid, cfg, self._experiment_dir))
+        # New trials (fresh, lazily-suggested, or restored) announce to the
+        # scheduler BEFORE running: synchronous schedulers build their rung
+        # cohorts from created trials, not first-result arrivals.
+        for t in self.trials:
+            if not getattr(t, "_sched_added", False):
+                t._sched_added = True
+                on_add = getattr(self._scheduler, "on_trial_add", None)
+                if on_add is not None:
+                    on_add(self, t)
         running = [t for t in self.trials if t.status == RUNNING]
         pending = [t for t in self.trials if t.status == PENDING]
         for trial in pending[: max(0, self._max_concurrent - len(running))]:
@@ -378,7 +424,7 @@ class TuneController:
                 poll = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
             except Exception as e:
                 trial.error = f"poll failed: {e}"
-                self._stop_trial(trial, ERROR)
+                self.finalize_trial(trial, ERROR)
                 continue
             for result in poll["results"]:
                 ckpt_path = result.pop("__checkpoint_path", None)
@@ -388,7 +434,15 @@ class TuneController:
                 trial.last_result = result
                 decision = self._scheduler.on_trial_result(self, trial, result)
                 if decision == sched_mod.STOP or self._check_stop_condition(result):
-                    self._stop_trial(trial, TERMINATED)
+                    self.finalize_trial(trial, TERMINATED)
+                    break
+                if decision == sched_mod.PAUSE:
+                    # Results past the pause point are from budget the
+                    # scheduler didn't grant: drop the rest of the batch.
+                    self.pause_trial(trial)
+                    hook = getattr(self._scheduler, "trial_paused_hook", None)
+                    if hook is not None:
+                        hook(self, trial)
                     break
                 if self._has_pending_exploit(trial):
                     # Abandon the rest of this buffered batch: the trial is about to
@@ -404,14 +458,10 @@ class TuneController:
                 and poll["status"] in (TERMINATED, ERROR)
             ):
                 trial.error = poll["error"]
-                self._stop_trial(trial, poll["status"])
-                self._scheduler.on_trial_complete(self, trial, trial.last_result)
-                self._searcher.on_trial_complete(
-                    trial.trial_id, trial.last_result, error=poll["status"] == ERROR
-                )
+                self.finalize_trial(trial, poll["status"])
         self._apply_exploits()
         return (
-            any(t.status in (PENDING, RUNNING) for t in self.trials)
+            any(t.status in (PENDING, RUNNING, PAUSED) for t in self.trials)
             or len(self.trials) < self._target_samples
         )
 

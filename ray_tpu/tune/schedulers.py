@@ -19,6 +19,7 @@ from ray_tpu.tune.search import Domain
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -80,6 +81,148 @@ class AsyncHyperBandScheduler(TrialScheduler):
                     if score < cutoff:
                         return STOP
         return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: python/ray/tune/schedulers/
+    hyperband.py). Trials join brackets with geometrically-spaced budgets; a
+    trial reaching its bracket's current rung PAUSES at the barrier, and once
+    every live member reports, the top 1/eta resume with eta-times the budget
+    while the rest stop. The PAUSE/resume ride the controller's
+    checkpoint-resume machinery (pause_trial/unpause_trial), so promoted
+    trials continue from their checkpoints rather than rerunning."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 81, reduction_factor: float = 3):
+        import math
+
+        self._time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self._max_t = max_t
+        self._eta = reduction_factor
+        # +eps: float log of an exact power (log(1000, 10) = 2.999...) must
+        # not truncate a rung away.
+        self._s_max = int(math.log(max_t, reduction_factor) + 1e-9)
+        # Bracket state holds trial IDS only (snapshot/restore pickles this
+        # scheduler; live Trial objects would go stale across a restore).
+        self._brackets: List[dict] = []
+        self._next_s = self._s_max
+        self._bracket_of: Dict[str, int] = {}  # trial_id -> bracket index
+
+    def _new_bracket(self) -> dict:
+        import math
+
+        s = self._next_s
+        self._next_s = self._s_max if s == 0 else s - 1
+        n = int(math.ceil((self._s_max + 1) / (s + 1) * self._eta ** s))
+        r0 = self._max_t * self._eta ** (-s)
+        milestones = [max(1, int(round(r0 * self._eta ** k)))
+                      for k in range(s + 1)]
+        return {"capacity": n, "members": [], "rung": 0,
+                "milestones": milestones, "scores": {}, "done": set()}
+
+    def on_trial_add(self, controller, trial):
+        """Cohort membership forms at trial CREATION (reference:
+        hyperband.py on_trial_add), so the rung barrier waits for every
+        member — including ones max_concurrent hasn't started yet — instead
+        of deciding on whatever partial cohort reported first."""
+        if trial.trial_id in self._bracket_of:
+            return  # restore: membership survived in the pickled scheduler
+        if not self._brackets or len(self._brackets[-1]["members"]) >= \
+                self._brackets[-1]["capacity"]:
+            self._brackets.append(self._new_bracket())
+        self._brackets[-1]["members"].append(trial.trial_id)
+        self._bracket_of[trial.trial_id] = len(self._brackets) - 1
+
+    def _bracket(self, trial_id) -> Optional[dict]:
+        idx = self._bracket_of.get(trial_id)
+        return None if idx is None else self._brackets[idx]
+
+    def _sign(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        t = result.get(self._time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        self.on_trial_add(controller, trial)  # direct use without controller hook
+        b = self._bracket(trial.trial_id)
+        if b["rung"] >= len(b["milestones"]):
+            return STOP
+        milestone = b["milestones"][b["rung"]]
+        if t < milestone:
+            return CONTINUE
+        b["scores"][trial.trial_id] = self._sign(float(metric))
+        if b["rung"] == len(b["milestones"]) - 1:
+            return STOP  # full budget spent
+        return PAUSE  # barrier: promotion happens in trial_paused_hook
+
+    def on_trial_complete(self, controller, trial, result: Optional[dict]):
+        b = self._bracket(trial.trial_id)
+        if b is None:
+            return
+        b["done"].add(trial.trial_id)
+        self._maybe_promote(controller, b)
+
+    def trial_paused_hook(self, controller, trial):
+        """Controller callback right after a PAUSE lands: statuses are
+        consistent now, so the rung barrier can be evaluated."""
+        b = self._bracket(trial.trial_id)
+        if b is not None:
+            self._maybe_promote(controller, b)
+
+    def _maybe_promote(self, controller, bracket):
+        """When every live member is parked at the current rung, release the
+        top 1/eta into the next rung (eta-times the budget) and stop the
+        rest."""
+        import math
+
+        from ray_tpu.tune import _trial_runner as tr
+
+        by_id = {t.trial_id: t for t in controller.trials}
+        live = [
+            by_id[tid] for tid in bracket["members"]
+            if tid in by_id and tid not in bracket["done"]
+            and by_id[tid].status not in (tr.TERMINATED, tr.ERROR)
+        ]
+        waiting = [
+            m for m in live
+            if m.trial_id in bracket["scores"] and m.status == tr.PAUSED
+        ]
+        if not live or len(waiting) < len(live):
+            return
+        keep = max(1, int(math.floor(len(waiting) / self._eta)))
+        ranked = sorted(waiting, key=lambda m: bracket["scores"][m.trial_id],
+                        reverse=True)
+        promoted, demoted = ranked[:keep], ranked[keep:]
+        bracket["rung"] += 1
+        bracket["scores"] = {}
+        for m in demoted:
+            bracket["done"].add(m.trial_id)
+            # notify_scheduler=False: the bracket bookkeeping is right here;
+            # the searcher still observes the demoted outcome.
+            controller.finalize_trial(m, tr.TERMINATED, notify_scheduler=False)
+        for m in promoted:
+            controller.unpause_trial(m)
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand whose rung results feed the searcher's model (reference:
+    python/ray/tune/schedulers/hb_bohb.py): BOHB couples the bandit budget
+    allocation with a density-model searcher, so configurations proposed later
+    benefit from partial-budget observations, not just completed trials."""
+
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        decision = super().on_trial_result(controller, trial, result)
+        metric = result.get(self.metric)
+        searcher = getattr(controller, "_searcher", None)
+        if metric is not None and hasattr(searcher, "on_rung_result"):
+            searcher.on_rung_result(trial.trial_id, trial.config,
+                                    float(metric))
+        return decision
 
 
 class MedianStoppingRule(TrialScheduler):

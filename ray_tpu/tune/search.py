@@ -324,6 +324,134 @@ class TPESearch(Searcher):
         self._observed.append((flat, float(result[self._metric])))
 
 
+class TuneBOHB(TPESearch):
+    """The BOHB model searcher (reference: python/ray/tune/search/bohb/
+    bohb_search.py wraps hpbandster's KDE): a Parzen-density model that also
+    learns from PARTIAL-budget rung results fed by HyperBandForBOHB, so
+    suggestions improve before any trial finishes its full budget. Pair with
+    `HyperBandForBOHB` as the scheduler."""
+
+    def on_rung_result(self, trial_id: str, config: dict, metric: float):
+        flat = self._suggested.get(trial_id)
+        if flat is None:
+            return
+        # Latest (largest-budget) observation per live trial; completion
+        # supersedes it (BOHB's per-budget models collapsed to freshest-wins).
+        self._rung_obs = getattr(self, "_rung_obs", {})
+        self._rung_obs[trial_id] = (dict(flat), float(metric))
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        super().on_trial_complete(trial_id, result, error)
+        self._rung_obs = getattr(self, "_rung_obs", {})
+        self._rung_obs.pop(trial_id, None)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        # The model sees completed observations PLUS the freshest rung result
+        # of every live trial for this one proposal.
+        saved = self._observed
+        try:
+            self._observed = saved + list(
+                getattr(self, "_rung_obs", {}).values()
+            )
+            return super().suggest(trial_id)
+        finally:
+            self._observed = saved
+
+
+class HyperOptSearch(Searcher):
+    """Adapter over hyperopt's TPE (reference:
+    python/ray/tune/search/hyperopt/hyperopt_search.py). Requires
+    `pip install hyperopt`; air-gapped pods use the dependency-free
+    TPESearch, which implements the same algorithm natively."""
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 n_initial_points: int = 20, seed: Optional[int] = None):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires `pip install hyperopt`; on "
+                "air-gapped pods use the dependency-free TPESearch instead"
+            ) from e
+        import numpy as np
+        from hyperopt import hp
+
+        self._hyperopt = hyperopt
+        self._metric = metric
+        self._mode = mode
+        hp_space = {}
+        for key, v in space.items():
+            if isinstance(v, Uniform):
+                hp_space[key] = hp.uniform(key, v.low, v.high)
+            elif isinstance(v, LogUniform):
+                import math
+
+                hp_space[key] = hp.loguniform(key, math.log(v.low),
+                                              math.log(v.high))
+            elif isinstance(v, Randint):
+                hp_space[key] = hp.randint(key, v.low, v.high)
+            elif isinstance(v, Choice):
+                hp_space[key] = hp.choice(key, v.options)
+            elif isinstance(v, (dict, SampleFrom)) or _is_grid(v):
+                raise ValueError(
+                    f"HyperOptSearch supports flat Domain spaces; {key!r} is "
+                    f"{type(v).__name__} — use TPESearch or flatten the space"
+                )
+            else:
+                hp_space[key] = v
+        self._space = space
+        self._domain = hyperopt.Domain(lambda c: 0, hp_space)
+        self._trials = hyperopt.Trials()
+        self._rng = np.random.default_rng(seed)
+        self._n_initial = n_initial_points
+        self._live: Dict[str, int] = {}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        import numpy as np
+
+        tid = len(self._trials.trials)
+        if tid < self._n_initial:
+            algo = self._hyperopt.rand.suggest
+        else:
+            algo = self._hyperopt.tpe.suggest
+        seed_int = int(self._rng.integers(2**31 - 1))
+        new = algo(
+            [tid], self._domain, self._trials, seed_int
+        )
+        self._trials.insert_trial_docs(new)
+        self._trials.refresh()
+        vals = {k: v[0] for k, v in new[0]["misc"]["vals"].items() if v}
+        cfg = {}
+        for key, v in self._space.items():
+            if isinstance(v, Choice):
+                cfg[key] = v.options[int(vals[key])]
+            elif isinstance(v, Randint):
+                cfg[key] = int(vals[key])
+            elif isinstance(v, (Uniform, LogUniform)):
+                cfg[key] = float(vals[key])
+            else:
+                cfg[key] = v
+        self._live[trial_id] = tid
+        _ = np  # keep the numpy import local to adapters
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        tid = self._live.pop(trial_id, None)
+        if tid is None:
+            return
+        doc = self._trials.trials[tid]
+        if error or not result or self._metric not in result:
+            doc["result"] = {"status": self._hyperopt.STATUS_FAIL}
+        else:
+            value = float(result[self._metric])
+            loss = -value if self._mode == "max" else value
+            doc["result"] = {"status": self._hyperopt.STATUS_OK, "loss": loss}
+        doc["state"] = self._hyperopt.JOB_STATE_DONE
+        self._trials.refresh()
+
+
 class OptunaSearch(Searcher):
     """Adapter over optuna's sampler (reference:
     python/ray/tune/search/optuna/optuna_search.py). Requires `optuna`."""

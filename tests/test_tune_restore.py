@@ -65,7 +65,8 @@ def test_killed_driver_experiment_restores(ray_start_regular, tmp_path):
     markers = str(tmp_path / "markers")
     os.makedirs(storage)
     os.makedirs(markers)
-    script = _DRIVER.format(repo="/root/repo", storage=storage, markers=markers)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _DRIVER.format(repo=repo, storage=storage, markers=markers)
     # Own session/process group: the kill below takes out the driver AND its
     # cluster daemons + trial actors in one shot (host-death semantics) —
     # surviving orphan actors would keep executing iterations and taint the
