@@ -47,15 +47,28 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
     "lease_timeout_s": (float, 30.0, "worker lease validity"),
     "lease_worker_slots": (int, 32, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker); deep pipelines coalesce submit bursts into few large frames"),
+    "lease_pipeline_min_depth": (int, 2, "starting per-worker pipeline depth for the lease fast path; lease denials ramp it toward lease_worker_slots"),
     "borrow_audit_interval_s": (float, 30.0, "how often owners audit registered borrowers for liveness (crashed borrowers are reconciled)"),
     "test_delay_borrow_report_ms": (int, 0, "fault injection: delay legacy borrow-report notifies by this long (stress the sequenced protocol)"),
     # --- logging / observability ---
     "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
     "event_buffer_size": (int, 10000, "per-worker task event buffer entries"),
     "metrics_report_interval_s": (float, 5.0, "metrics push interval"),
+    "gcs_max_task_events": (int, 100000, "task events retained by the GCS before the oldest half is dropped (reference: task_events_max_num_task_in_gcs)"),
+    "export_events_dir": (str, "", "when set, the GCS appends structured JSONL export events (tasks/actors/nodes/placement groups) under this directory (reference: export_*.proto + ray_event_recorder)"),
+    # --- channels / client ---
+    "channel_poll_min_s": (float, 0.0005, "cross-node channel long-poll floor: a hot pipeline sees sub-ms latency"),
+    "channel_poll_max_s": (float, 0.01, "cross-node channel long-poll backoff ceiling for idle rings"),
+    "client_proxy_node_cache_s": (float, 5.0, "client proxy's cache TTL for the cluster's registered-endpoint allowlist"),
     # --- train / libraries ---
     "train_health_check_interval_s": (float, 1.0, "train controller worker poll interval"),
     "serve_long_poll_timeout_s": (float, 30.0, "serve long-poll timeout"),
+    "serve_http_port": (int, 8000, "default HTTP port each node's serve proxy binds (reference: serve DEFAULT_HTTP_PORT)"),
+    "serve_handle_max_retries": (int, 3, "deployment-handle resubmissions after replica death before the call fails"),
+    "serve_control_loop_interval_s": (float, 0.25, "serve controller reconcile interval"),
+    "llm_multi_step": (int, 8, "decode tokens per engine dispatch when every active slot is greedy (on-device argmax chunks; 1 disables)"),
+    "llm_prefill_bucket_min": (int, 16, "smallest prompt padding bucket for compiled prefill programs"),
+    "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
 }
 

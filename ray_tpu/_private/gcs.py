@@ -29,6 +29,29 @@ DEAD = "DEAD"
 PENDING = "PENDING_CREATION"
 RESTARTING = "RESTARTING"
 
+# pubsub channel -> export source type (reference export_*.proto source set).
+_EXPORT_CHANNELS = {
+    "nodes": "node",
+    "actors": "actor",
+    "placement_groups": "placement_group",
+}
+
+
+def _export_clean(v):
+    """Render a pubsub/event payload JSON-safe: ids as hex, tuples as lists."""
+    if isinstance(v, dict):
+        return {str(k): _export_clean(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set)):
+        return [_export_clean(x) for x in v]
+    if hasattr(v, "hex") and not isinstance(v, (str, bytes, float)):
+        try:
+            return v.hex()
+        except TypeError:
+            return str(v)
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
 
 class NodeInfo:
     def __init__(self, node_id: NodeID, address, resources_total, labels, conn):
@@ -202,6 +225,8 @@ class GcsService:
     # ---------------- helpers ----------------
 
     async def publish(self, channel: str, message: Any):
+        if channel in _EXPORT_CHANNELS:
+            self._export_event(_EXPORT_CHANNELS[channel], message)
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
@@ -210,6 +235,41 @@ class GcsService:
                 await conn.notify("publish", channel, message)
             except Exception:
                 self.subscribers[channel].discard(conn)
+
+    def _export_event(self, source_type: str, data: Any):
+        self._export_events(source_type, [data])
+
+    def _export_events(self, source_type: str, batch: list):
+        """Structured export events (reference: src/ray/protobuf/export_*.proto
+        written by ray_event_recorder.cc; consumed by the dashboard aggregator).
+        One JSONL file per source type under CONFIG.export_events_dir; each
+        record is {source_type, event_id, timestamp, event_data} with ids
+        rendered as hex. A whole batch lands in ONE append so a task-event
+        flush doesn't stall the GCS loop on thousands of file opens. Disabled
+        (the default) costs one string compare."""
+        dirpath = CONFIG.export_events_dir
+        if not dirpath or not batch:
+            return
+        import json
+        import os as _os
+        import uuid
+
+        now = time.time()
+        lines = []
+        for data in batch:
+            lines.append(json.dumps({
+                "source_type": source_type,
+                "event_id": uuid.uuid4().hex[:16],
+                "timestamp": now,
+                "event_data": _export_clean(data),
+            }))
+        try:
+            _os.makedirs(dirpath, exist_ok=True)
+            with open(_os.path.join(dirpath, f"export_{source_type}.jsonl"),
+                      "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass  # export is observability, never a control-plane failure
 
     def _node_of_conn(self, conn) -> NodeInfo | None:
         for node in self.nodes.values():
@@ -931,12 +991,13 @@ class GcsService:
         Trimming drops whole chunks from memory AND the store, so the log
         cannot grow unboundedly."""
         self.task_events.extend(events)
+        self._export_events("task", events)
         self._task_events_total += len(events)
         self._task_event_seq += 1
         seq = self._task_event_seq
         self.store.put("task_events", seq, events)
         self._task_event_chunks.append((seq, len(events)))
-        max_events = 100000
+        max_events = CONFIG.gcs_max_task_events
         excess = len(self.task_events) - max_events
         while excess > 0 and self._task_event_chunks:
             old_seq, count = self._task_event_chunks[0]

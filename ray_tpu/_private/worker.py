@@ -37,13 +37,14 @@ _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 _MISS = object()  # local-arena fast-path miss sentinel
 
-# Starting per-worker pipeline depth for the lease fast path. Shallow by
-# default so a burst queues work and acquires more workers (parallelism);
-# lease denials ramp the depth toward CONFIG.lease_worker_slots (throughput
-# via large coalesced frames once the node is saturated). 2, not 1: one task
-# executing + one parked keeps the worker from going idle during the
-# result/refill round trip.
-_LEASE_DEPTH_MIN = 2
+# Starting per-worker pipeline depth for the lease fast path
+# (CONFIG.lease_pipeline_min_depth). Shallow by default so a burst queues
+# work and acquires more workers (parallelism); lease denials ramp the depth
+# toward CONFIG.lease_worker_slots (throughput via large coalesced frames
+# once the node is saturated). 2, not 1: one task executing + one parked
+# keeps the worker from going idle during the result/refill round trip.
+def _lease_depth_min() -> int:
+    return max(1, CONFIG.lease_pipeline_min_depth)
 
 
 def _addr_key(addr: dict) -> tuple:
@@ -1828,7 +1829,7 @@ class CoreWorker:
         with self._lease_lock:
             st = self._leases.setdefault(
                 shape, {"workers": {}, "queue": deque(), "requesting": False,
-                        "classic_until": 0.0, "depth": _LEASE_DEPTH_MIN},
+                        "classic_until": 0.0, "depth": _lease_depth_min()},
             )
             if time.monotonic() < st["classic_until"]:
                 classic = True
@@ -1858,13 +1859,13 @@ class CoreWorker:
                 # Completion hot path: nothing queued means nothing to assign,
                 # and any non-empty sendq already has its send loop running.
                 return
-            # Adaptive pipeline depth: start shallow (_LEASE_DEPTH_MIN) so a
+            # Adaptive pipeline depth: start shallow (lease_pipeline_min_depth) so a
             # burst leaves work queued and lease requests fan it out across
             # workers; _lease_request doubles the depth toward
             # lease_worker_slots each time the raylet DENIES a lease with work
             # still queued (the node is saturated — parallelism is exhausted,
             # so pipeline deeper instead: bigger frames, fewer wakeups).
-            slots = max(1, min(st.get("depth", _LEASE_DEPTH_MIN),
+            slots = max(1, min(st.get("depth", _lease_depth_min()),
                                CONFIG.lease_worker_slots))
             # Round-robin one task per worker per pass: a greedy fill would
             # park a whole burst on the first worker while the rest idle;
@@ -1952,7 +1953,7 @@ class CoreWorker:
                 st["retries"] = 0
                 # Capacity exists again: go back to shallow pipelines so the
                 # next burst spreads before it deepens.
-                st["depth"] = _LEASE_DEPTH_MIN
+                st["depth"] = _lease_depth_min()
                 conn.on_close(lambda c: self._lease_worker_lost(shape, wid, c))
             elif resp and resp.get("infeasible"):
                 # This node can never run the shape: hand everything queued to
@@ -1966,7 +1967,7 @@ class CoreWorker:
                 # for this shape right now. Deepen the per-worker pipeline so
                 # the backlog rides existing leases in large frames.
                 st["depth"] = min(
-                    max(st.get("depth", _LEASE_DEPTH_MIN), 1) * 2,
+                    max(st.get("depth", _lease_depth_min()), 1) * 2,
                     CONFIG.lease_worker_slots,
                 )
                 st["retries"] = st.get("retries", 0) + 1
@@ -2485,7 +2486,7 @@ class CoreWorker:
         from ray_tpu.experimental.channel import _ring_pull
 
         deadline = time.monotonic() + min(poll, 25.0)
-        delay = 0.0005
+        delay = CONFIG.channel_poll_min_s
         while True:
             resp = _ring_pull(name, reader, index)
             if "wait" not in resp and "unknown" not in resp:
@@ -2493,7 +2494,7 @@ class CoreWorker:
             if time.monotonic() > deadline:
                 return resp  # reader loop retries (keeps conns live/cancellable)
             await asyncio.sleep(delay)
-            delay = min(delay * 1.5, 0.01)
+            delay = min(delay * 1.5, CONFIG.channel_poll_max_s)
 
     async def rpc_chan_close(self, conn, name):
         from ray_tpu.experimental.channel import _ring_close

@@ -186,7 +186,8 @@ class DecodeEngine:
     def __init__(self, cfg: ModelConfig, params, *, num_slots: int = 4,
                  max_seq: Optional[int] = None, seed: int = 0,
                  lora_config: Optional[dict] = None, decode_loop: bool = True,
-                 spec_config: Optional[dict] = None, multi_step: int = 8):
+                 spec_config: Optional[dict] = None,
+                 multi_step: Optional[int] = None):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -238,6 +239,10 @@ class DecodeEngine:
         # vLLM's multi-step scheduling (num_scheduler_steps). Engaged only
         # when every active slot samples greedily; host-side stop/max_tokens
         # handling rolls per-slot state back after the readback.
+        from ray_tpu._private.config import CONFIG
+
+        if multi_step is None:
+            multi_step = CONFIG.llm_multi_step
         self._multi_step = max(1, int(multi_step))
         self._jit_decode_multi = jax.jit(
             self._decode_multi, static_argnames=("n",)
@@ -629,7 +634,9 @@ class DecodeEngine:
 
     # -- stepper -----------------------------------------------------------
     def _bucket(self, n: int) -> int:
-        b = 16
+        from ray_tpu._private.config import CONFIG
+
+        b = max(1, CONFIG.llm_prefill_bucket_min)
         while b < n:
             b *= 2
         return min(b, self.T)

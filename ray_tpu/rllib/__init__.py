@@ -19,6 +19,11 @@ from ray_tpu.rllib.algorithms.offline import (
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent import MultiAgentPPO
+from ray_tpu.rllib.connectors import (
+    ConnectorPipelineV2,
+    ConnectorV2,
+    default_ppo_learner_pipeline,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup

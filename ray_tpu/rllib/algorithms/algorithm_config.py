@@ -33,6 +33,12 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         self.use_mesh: bool = False
         self.learner_resources: Optional[dict] = None
+        # Connector customization (reference: AlgorithmConfig.learner_connector):
+        # a callable given the algorithm's DEFAULT ConnectorPipelineV2; it may
+        # splice pieces (insert_before/append/...) or return a replacement.
+        # Honored by the learner-pipeline algorithms (PPO/MultiAgentPPO);
+        # replay-buffer algorithms shape batches in their buffers instead.
+        self.learner_connector: Optional[Any] = None
         # debugging
         self.seed: Optional[int] = None
         # multi-agent (reference: AlgorithmConfig.multi_agent()): policy ids ->

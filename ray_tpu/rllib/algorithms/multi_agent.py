@@ -17,7 +17,7 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from ray_tpu.rllib.algorithms.ppo import _ppo_loss_factory, ppo_postprocess
+from ray_tpu.rllib.algorithms.ppo import _ppo_loss_factory
 from ray_tpu.rllib.core.learner import LearnerGroup
 from ray_tpu.rllib.core.rl_module import Columns, build_default_module  # noqa: E501
 from ray_tpu.rllib.env.multi_agent_env_runner import (
@@ -106,10 +106,21 @@ class MultiAgentPPO:
             lens.extend(b.get("episode_lens", []))
         metrics: Dict[str, Any] = {}
         rng = np.random.default_rng(self.iteration)
+        pipeline = getattr(self, "_learner_pipeline", None)
+        if pipeline is None:
+            from ray_tpu.rllib.connectors import (
+                build_learner_pipeline,
+                default_ppo_learner_pipeline,
+            )
+
+            pipeline = self._learner_pipeline = build_learner_pipeline(
+                c, default_ppo_learner_pipeline
+            )
+        ctx = {"gamma": c.gamma, "lambda_": c.lambda_}
         for pid, frags in frags_by_policy.items():
             if not frags:
                 continue
-            batch = ppo_postprocess(frags, c.gamma, c.lambda_)
+            batch = pipeline(frags, ctx)
             n = len(batch[Columns.OBS])
             self._total_timesteps += n
             mb = min(c.minibatch_size, n)

@@ -111,4 +111,22 @@ class PPO(Algorithm):
         )
 
     def postprocess(self, fragments: List[dict]) -> Dict[str, np.ndarray]:
-        return ppo_postprocess(fragments, self.config.gamma, self.config.lambda_)
+        # Composable ConnectorV2 pipeline (GAE -> flatten -> normalize), with
+        # the config's learner_connector hook splicing user pieces in
+        # (reference: ConnectorV2 learner pipeline instead of monolithic
+        # postprocessing).
+        pipeline = getattr(self, "_learner_pipeline", None)
+        if pipeline is None:
+            from ray_tpu.rllib.connectors import (
+                build_learner_pipeline,
+                default_ppo_learner_pipeline,
+            )
+
+            pipeline = build_learner_pipeline(
+                self.config, default_ppo_learner_pipeline
+            )
+            self._learner_pipeline = pipeline
+        return pipeline(
+            fragments,
+            {"gamma": self.config.gamma, "lambda_": self.config.lambda_},
+        )

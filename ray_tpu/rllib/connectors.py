@@ -1,0 +1,204 @@
+"""ConnectorV2: composable sample-processing pipelines.
+
+Design parity: reference `rllib/connectors/connector_v2.py` +
+`connector_pipeline_v2.py` — small reusable pieces transform episode data on
+its way to the learner (or observations on their way to the module), composed
+into an ordered, mutable pipeline instead of per-algorithm monolithic
+postprocessing. Algorithms publish a DEFAULT learner pipeline; users splice
+their own pieces in with append/prepend/insert_before/insert_after
+(`AlgorithmConfig.learner_connector` hook, reference
+algorithm_config.py learner_connector=...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import Columns
+
+
+class ConnectorV2:
+    """One pipeline piece: (data, ctx) -> data. `ctx` carries algorithm
+    config values pieces need (gamma, lambda_, ...)."""
+
+    def __call__(self, data: Any, ctx: Optional[dict] = None) -> Any:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class FnConnector(ConnectorV2):
+    """Wrap a plain function (or lambda) as a pipeline piece."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self._fn = fn
+        self._name = name or getattr(fn, "__name__", "fn")
+
+    def __call__(self, data, ctx=None):
+        return self._fn(data, ctx)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered list of connectors applied left to right (reference:
+    connector_pipeline_v2.py, with the same splice surface)."""
+
+    def __init__(self, connectors: Optional[List[ConnectorV2]] = None):
+        self.connectors: List[ConnectorV2] = list(connectors or [])
+
+    def __call__(self, data, ctx=None):
+        for c in self.connectors:
+            data = c(data, ctx)
+        return data
+
+    # -- splicing ----------------------------------------------------------
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(_as_connector(connector))
+        return self
+
+    def prepend(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.insert(0, _as_connector(connector))
+        return self
+
+    def _index_of(self, name: str) -> int:
+        for i, c in enumerate(self.connectors):
+            if c.name == name or type(c).__name__ == name:
+                return i
+        raise ValueError(
+            f"no connector named {name!r} in {[c.name for c in self.connectors]}"
+        )
+
+    def insert_before(self, name: str, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(self._index_of(name), _as_connector(connector))
+        return self
+
+    def insert_after(self, name: str, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(
+            self._index_of(name) + 1, _as_connector(connector)
+        )
+        return self
+
+    def remove(self, name: str) -> "ConnectorPipelineV2":
+        del self.connectors[self._index_of(name)]
+        return self
+
+
+def _as_connector(c) -> ConnectorV2:
+    return c if isinstance(c, ConnectorV2) else FnConnector(c)
+
+
+# -- standard learner pieces (reference rllib/connectors/learner/) ----------
+
+
+class ComputeGAE(ConnectorV2):
+    """Per-fragment GAE(lambda): adds ADVANTAGES and VALUE_TARGETS (reference:
+    learner/compute_returns_and_advantages... / general_advantage_estimation)."""
+
+    def __call__(self, fragments: List[dict], ctx=None):
+        from ray_tpu.rllib.algorithms.ppo import compute_gae
+
+        gamma = (ctx or {}).get("gamma", 0.99)
+        lam = (ctx or {}).get("lambda_", 1.0)
+        for frag in fragments:
+            adv, targets = compute_gae(
+                frag[Columns.REWARDS], frag[Columns.VF_PREDS],
+                float(frag.get("bootstrap_value", 0.0)), gamma, lam,
+            )
+            frag[Columns.ADVANTAGES] = adv
+            frag[Columns.VALUE_TARGETS] = targets
+        return fragments
+
+
+class FragmentsToBatch(ConnectorV2):
+    """Concatenate episode fragments into one flat training batch (reference:
+    learner/add_columns_from_episodes_to_train_batch)."""
+
+    def __init__(self, columns: Optional[List[str]] = None):
+        self._columns = columns
+
+    def __call__(self, fragments: List[dict], ctx=None):
+        if not fragments:
+            return {}
+        columns = self._columns or [
+            k for k in fragments[0] if isinstance(
+                fragments[0][k], (np.ndarray, list)
+            )
+        ]
+        batch = {}
+        for k in columns:
+            parts = [np.asarray(f[k]) for f in fragments if k in f]
+            arr = np.concatenate(parts)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            batch[k] = arr
+        return batch
+
+
+class NormalizeAdvantages(ConnectorV2):
+    """Standardize advantages across the batch (reference default for PPO)."""
+
+    def __call__(self, batch: Dict[str, np.ndarray], ctx=None):
+        adv = batch.get(Columns.ADVANTAGES)
+        if adv is not None and len(adv):
+            batch[Columns.ADVANTAGES] = (
+                (adv - adv.mean()) / max(1e-6, adv.std())
+            ).astype(np.float32)
+        return batch
+
+
+class ClipRewards(ConnectorV2):
+    """Clip per-step rewards into [-limit, limit] before return computation
+    (reference: env-to-module reward clipping option)."""
+
+    def __init__(self, limit: float = 1.0):
+        self._limit = float(limit)
+
+    def __call__(self, fragments: List[dict], ctx=None):
+        for frag in fragments:
+            frag[Columns.REWARDS] = np.clip(
+                np.asarray(frag[Columns.REWARDS]), -self._limit, self._limit
+            )
+        return fragments
+
+
+def build_learner_pipeline(config, default_factory) -> ConnectorPipelineV2:
+    """Default pipeline + the config's `learner_connector` hook (reference:
+    AlgorithmConfig.learner_connector). Shared by every algorithm that runs a
+    learner pipeline so the hook is honored uniformly."""
+    pipeline = default_factory()
+    hook = getattr(config, "learner_connector", None)
+    if hook is not None:
+        pipeline = hook(pipeline) or pipeline
+    return pipeline
+
+
+def default_ppo_learner_pipeline() -> ConnectorPipelineV2:
+    """PPO's default learner connector pipeline: GAE -> flatten -> normalize
+    (the composable form of the old monolithic ppo_postprocess)."""
+    return ConnectorPipelineV2([
+        ComputeGAE(),
+        FragmentsToBatch(columns=[
+            Columns.OBS, Columns.ACTIONS, Columns.ACTION_LOGP,
+            Columns.ADVANTAGES, Columns.VALUE_TARGETS,
+        ]),
+        NormalizeAdvantages(),
+    ])
+
+
+__all__ = [
+    "ClipRewards",
+    "ComputeGAE",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "FnConnector",
+    "FragmentsToBatch",
+    "NormalizeAdvantages",
+    "default_ppo_learner_pipeline",
+]

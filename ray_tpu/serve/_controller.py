@@ -107,7 +107,9 @@ class ServeController:
         for nid, info in alive.items():
             if nid in self._proxies:
                 continue
-            port = self._http_options.get("port", 8000)
+            from ray_tpu._private.config import CONFIG
+
+            port = self._http_options.get("port", CONFIG.serve_http_port)
             host = self._http_options.get("host", "127.0.0.1")
             grpc_port = self._http_options.get("grpc_port")
             proxy_cls = ray_tpu.remote(num_cpus=0)(HTTPProxy)
@@ -312,7 +314,9 @@ class ServeController:
                 await self._step()
             except Exception:
                 traceback.print_exc()
-            await asyncio.sleep(0.25)
+            from ray_tpu._private.config import CONFIG
+
+            await asyncio.sleep(CONFIG.serve_control_loop_interval_s)
 
     async def _step(self):
         from ray_tpu.serve._common import async_get

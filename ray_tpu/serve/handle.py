@@ -27,7 +27,11 @@ class DeploymentResponse:
     ActorDiedError the request is resubmitted through the router to a live replica.
     """
 
-    _MAX_RETRIES = 3
+    @property
+    def _MAX_RETRIES(self):
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.serve_handle_max_retries
 
     def __init__(self, ref: "ray_tpu.ObjectRef", resubmit=None):
         self._ref = ref

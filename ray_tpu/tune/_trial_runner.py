@@ -185,9 +185,9 @@ class TuneController:
         self._last_snapshot = 0.0
         # Experiment-state checkpoint interval (reference: TUNE_GLOBAL_CHECKPOINT_S
         # auto-tuning in tune_controller.py; a fixed short period suffices here).
-        self._snapshot_period_s = float(
-            os.environ.get("RAY_TPU_TUNE_CHECKPOINT_PERIOD_S", "1.0")
-        )
+        from ray_tpu._private.config import CONFIG
+
+        self._snapshot_period_s = CONFIG.tune_checkpoint_period_s
 
     def _generate_initial_trials(self):
         from ray_tpu.tune.search import BasicVariantGenerator

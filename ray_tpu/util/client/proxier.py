@@ -87,13 +87,18 @@ class ClientProxy:
     endpoints. Run via `serve_proxy()` or the `ray_tpu client-proxy` CLI."""
 
     def __init__(self, gcs_addr: Tuple[str, int], *, host: str = "127.0.0.1",
-                 port: int = 0, node_cache_s: float = 5.0,
+                 port: int = 0, node_cache_s: Optional[float] = None,
                  token: Optional[str] = None):
         self._gcs_addr = (gcs_addr[0], int(gcs_addr[1]))
         self._token = token
         self._host = host
         self._requested_port = port
-        self._node_cache_s = node_cache_s
+        from ray_tpu._private.config import CONFIG
+
+        self._node_cache_s = (
+            node_cache_s if node_cache_s is not None
+            else CONFIG.client_proxy_node_cache_s
+        )
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._sessions: Dict[str, _ClientSession] = {}

@@ -215,6 +215,38 @@ def memory_summary(*, limit: int = 10_000) -> Dict[str, Any]:
     }
 
 
+def list_export_events(directory: Optional[str] = None, *,
+                       source_type: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Read structured export events written by the GCS when
+    RAY_TPU_EXPORT_EVENTS_DIR is set (the aggregator role of the reference's
+    dashboard/modules/aggregator over export_*.proto records)."""
+    import glob
+    import json
+    import os
+
+    from ray_tpu._private.config import CONFIG
+
+    directory = directory or CONFIG.export_events_dir
+    if not directory or not os.path.isdir(directory):
+        return []
+    pattern = (
+        f"export_{source_type}.jsonl" if source_type else "export_*.jsonl"
+    )
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(directory, pattern))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line: the GCS is mid-append
+    out.sort(key=lambda r: r.get("timestamp", 0.0))
+    return out
+
+
 def cluster_summary() -> Dict[str, Any]:
     nodes = list_nodes()
     return {
@@ -232,6 +264,7 @@ __all__ = [
     "get_actor",
     "get_task",
     "list_actors",
+    "list_export_events",
     "list_jobs",
     "list_nodes",
     "list_objects",
