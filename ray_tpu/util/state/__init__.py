@@ -215,6 +215,13 @@ def memory_summary(*, limit: int = 10_000) -> Dict[str, Any]:
     }
 
 
+def get_log(worker_id_hex: str, *, tail: int = 200) -> List[str]:
+    """Recent output lines of one worker (parity: `ray logs worker*` /
+    util/state get_log — served from the GCS's per-worker log ring, which the
+    driver-streaming path already feeds)."""
+    return _gcs("get_worker_log", worker_id_hex, tail)
+
+
 def list_export_events(directory: Optional[str] = None, *,
                        source_type: Optional[str] = None) -> List[Dict[str, Any]]:
     """Read structured export events written by the GCS when
@@ -262,6 +269,7 @@ def cluster_summary() -> Dict[str, Any]:
 __all__ = [
     "cluster_summary",
     "get_actor",
+    "get_log",
     "get_task",
     "list_actors",
     "list_export_events",

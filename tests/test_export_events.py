@@ -45,9 +45,16 @@ def test_export_events_recorded_and_aggregatable(export_cluster):
         def mark(self):
             return "done"
 
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
     a = Recorder.remote()
     assert ray_tpu.get(a.mark.remote(), timeout=120) == "done"
     ray_tpu.kill(a)
+    # Task events flush from live workers on a 5s cadence (a killed actor's
+    # buffer dies with it): a plain task's worker stays alive to flush.
+    assert ray_tpu.get(traced_task.remote(), timeout=120) == 1
 
     # Node + actor transitions and task events flush on their own timers.
     deadline = time.time() + 30
@@ -56,7 +63,7 @@ def test_export_events_recorded_and_aggregatable(export_cluster):
         actors = state.list_export_events(exp, source_type="actor")
         tasks = state.list_export_events(exp, source_type="task")
         if nodes and actors and any(
-            e["event_data"].get("name") == "mark" for e in tasks
+            e["event_data"].get("name") == "traced_task" for e in tasks
         ):
             break
         time.sleep(0.5)
