@@ -51,6 +51,9 @@ _PAGE = """<!doctype html>
 <div id="logbox"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
+<h2>Serve</h2><table id="serve"></table>
+<h2>Train runs</h2><table id="train"></table>
+<h2>Data executions</h2><table id="data"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <script>
@@ -160,6 +163,35 @@ async function refresh() {
   document.getElementById("actors").innerHTML = row(["actor", "class", "name", "state", "restarts"], "th") +
     actors.map(a => row([esc(a.actor_id), esc(a.class_name), esc(a.name || ""),
                          pill(a.state), esc(a.num_restarts)])).join("");
+  // Library views are independent: one failing fetch must not freeze the
+  // core tables below it.
+  try {
+    const sv = await (await fetch("/api/serve")).json();
+    const svRows = [];
+    for (const [app, info] of Object.entries(sv.apps || {})) {
+      for (const [dep, d] of Object.entries(info.deployments || {})) {
+        svRows.push(row([esc(app), esc(info.route_prefix || ""), esc(dep),
+                         `${esc(d.num_replicas)}/${esc(d.target)}`]));
+      }
+    }
+    document.getElementById("serve").innerHTML =
+      row(["app", "route", "deployment", "replicas/target"], "th") + svRows.join("");
+    const tr = await (await fetch("/api/train")).json();
+    document.getElementById("train").innerHTML =
+      row(["run", "state", "workers", "done", "latest metrics"], "th") +
+      tr.map(t => row([esc(t.run_name), pill(t.state || "?"), esc(t.num_workers ?? ""),
+                       esc(t.done ?? ""), esc(JSON.stringify(t.latest_metrics || {}))])).join("");
+    const dt = await (await fetch("/api/data")).json();
+    document.getElementById("data").innerHTML =
+      row(["finished", "duration s", "pipeline", "rows out", "error"], "th") +
+      dt.slice(-12).reverse().map(d => {
+        const last = d.ops[d.ops.length - 1] || {};
+        return row([esc(new Date(d.finished_at * 1000).toLocaleTimeString()),
+                    esc(d.duration_s),
+                    esc(d.ops.map(o => o.name).join(" → ")),
+                    esc(last.out_rows ?? ""), esc(d.error || "")]);
+      }).join("");
+  } catch (e) { /* library views are best-effort */ }
   const jobs = await (await fetch("/api/jobs")).json();
   document.getElementById("jobs").innerHTML = row(["job", "status", "entrypoint"], "th") +
     jobs.map(j => row([esc(j.job_id), pill(j.status), esc(j.entrypoint)])).join("");
@@ -264,6 +296,12 @@ class DashboardActor:
             return await loop.run_in_executor(None, state.list_jobs)
         if path == "/api/metrics_history":
             return list(self._history)
+        if path == "/api/serve":
+            return await loop.run_in_executor(None, _serve_view)
+        if path == "/api/train":
+            return await loop.run_in_executor(None, _train_view)
+        if path == "/api/data":
+            return await loop.run_in_executor(None, _data_view)
         if path == "/api/log_workers":
             return await loop.run_in_executor(
                 None, lambda: _gcs_call("list_log_workers")
@@ -315,6 +353,60 @@ def _gcs_call(method: str, *args):
     from ray_tpu.util.state import _gcs
 
     return _gcs(method, *args)
+
+
+# -- per-library views (reference: dashboard modules for serve/train/data) --
+
+
+def _serve_view() -> dict:
+    """Apps -> deployments -> replica counts + the bound proxy ports."""
+    try:
+        from ray_tpu import serve
+
+        apps = serve.status()
+        return {"apps": apps, "proxy_ports": serve.proxy_ports()}
+    except Exception:
+        return {"apps": {}, "proxy_ports": {}}
+
+
+def _train_view() -> list:
+    """Live/finished train runs from the detached controllers' status()."""
+    out = []
+    try:
+        for a in _gcs_call("list_actors"):
+            name = a.get("name") or ""
+            if a.get("namespace") != "_train" or not name.startswith(
+                "TRAIN_CONTROLLER:"
+            ):
+                continue
+            entry = {"run_name": name.split(":", 1)[1], "state": a.get("state")}
+            if a.get("state") == "ALIVE":
+                try:
+                    handle = ray_tpu.get_actor(name, namespace="_train")
+                    # Short timeout: one wedged controller must not freeze
+                    # every dashboard refresh for the full actor-call window.
+                    entry.update(ray_tpu.get(handle.status.remote(), timeout=2))
+                except Exception:
+                    pass
+            out.append(entry)
+    except Exception:
+        pass
+    return out
+
+
+def _data_view() -> list:
+    """Recent dataset executions published by the streaming executor."""
+    import json as _json
+
+    out = []
+    try:
+        for key in sorted(_gcs_call("kv_keys", "data_stats"))[-20:]:
+            raw = _gcs_call("kv_get", "data_stats", key)
+            if raw:
+                out.append(_json.loads(raw))
+    except Exception:
+        pass
+    return out
 
 
 _state: dict = {}

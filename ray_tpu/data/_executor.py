@@ -415,6 +415,36 @@ class StreamingExecutor:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._stopped = False
+        self._started_at: Optional[float] = None
+
+    def _publish_stats(self):
+        """Best-effort per-execution stats to the GCS KV (namespace
+        `data_stats`): the dashboard's data view reads these (reference: the
+        data dashboard module over DatasetStats). A bounded ring of keys."""
+        import json
+        import time as _time
+        import uuid
+
+        try:
+            import ray_tpu
+
+            w = ray_tpu.global_worker()
+            record = {
+                "finished_at": _time.time(),
+                "duration_s": round(_time.time() - (self._started_at or _time.time()), 3),
+                "error": type(self._error).__name__ if self._error else None,
+                "ops": [
+                    {"name": op.name, "out_rows": op._out_rows}
+                    for op in self._ops
+                ],
+            }
+            key = f"{int(_time.time() * 1000):013d}_{uuid.uuid4().hex[:6]}".encode()
+            w.gcs_call("kv_put", "data_stats", key, json.dumps(record).encode(), True)
+            keys = sorted(w.gcs_call("kv_keys", "data_stats"))
+            for old in keys[:-50]:  # keep the latest 50 executions
+                w.gcs_call("kv_del", "data_stats", old)
+        except Exception:
+            pass  # observability must never fail an execution
 
     def execute(self) -> Iterator[RefBundle]:
         self._thread = threading.Thread(target=self._run_loop, daemon=True)
@@ -439,6 +469,9 @@ class StreamingExecutor:
         self._stopped = True
 
     def _run_loop(self):
+        import time as _time
+
+        self._started_at = _time.time()
         ops = self._ops
         budget = self._ctx.max_tasks_in_flight
         try:
@@ -489,6 +522,7 @@ class StreamingExecutor:
 
                     time.sleep(0.005)
         except _ExecutorStopped:
+            self._publish_stats()
             return
         except BaseException as e:
             self._error = e
@@ -496,6 +530,7 @@ class StreamingExecutor:
                 self._put_output(_Raise(e))
             except _ExecutorStopped:
                 pass
+            self._publish_stats()
             return
         finally:
             for op in ops:
@@ -504,6 +539,9 @@ class StreamingExecutor:
             self._put_output(_DONE)
         except _ExecutorStopped:
             pass
+        # AFTER the consumer is unblocked: stats are observability and must
+        # not sit on any execution's completion critical path.
+        self._publish_stats()
 
 
     def _put_output(self, item):

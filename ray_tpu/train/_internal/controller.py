@@ -244,6 +244,20 @@ class DetachedControllerRunner:
     def is_done(self) -> bool:
         return self._done.is_set()
 
+    def status(self) -> dict:
+        """Run summary for the dashboard's train view (reference: the train
+        dashboard module reads run state from the controller)."""
+        c = self._controller
+        return {
+            "experiment_name": c._experiment_name,
+            "started": self._started,
+            "done": self._done.is_set(),
+            "num_workers": getattr(c._scaling, "num_workers", None),
+            "latest_metrics": c._latest_metrics,
+            "storage_path": c._storage_path,
+            "error_tail": (self._run_error or "")[-400:] or None,
+        }
+
     def result_blob(self) -> bytes:
         import cloudpickle
 

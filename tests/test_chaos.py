@@ -78,12 +78,13 @@ def test_tasks_survive_random_worker_kills(chaos_cluster):
         time.sleep(0.1)
         return i * i, os.getpid()
 
-    def collect(pids):
+    def snapshot_pids():
+        # The killer thread must read under the lock: an unlocked set copy
+        # racing update() raises mid-iteration and silently kills the killer.
         with pid_lock:
-            seen_pids.update(pids)
             return list(seen_pids)
 
-    killer = _WorkerKiller(lambda: list(seen_pids), period_s=1.5)
+    killer = _WorkerKiller(snapshot_pids, period_s=1.5)
     killer.start()
     try:
         results = []

@@ -409,6 +409,7 @@ def test_dashboard_endpoints():
     import json as _json
     import urllib.request
 
+    from ray_tpu import serve
     from ray_tpu.dashboard import start_dashboard, stop_dashboard
 
     @ray_tpu.remote
@@ -471,5 +472,38 @@ def test_dashboard_endpoints():
                 found = True
                 break
         assert found, "probe line never reached the log viewer"
+        # round-4 per-library views (reference: dashboard serve/train/data
+        # modules): serve apps + proxy ports, train runs, data executions.
+        from ray_tpu import data as rdata
+
+        @serve.deployment
+        def dashping(request):
+            return "ok"
+
+        serve.run(dashping.bind(), name="dash_app", route_prefix="/dashping")
+        with urllib.request.urlopen(base + "/api/serve", timeout=60) as r:
+            sv = _json.loads(r.read())
+        assert "dash_app" in sv["apps"]
+        assert sv["apps"]["dash_app"]["deployments"]["dashping"]["target"] == 1
+        assert sv["proxy_ports"]
+        serve.delete("dash_app")
+
+        rdata.range(32).map_batches(lambda b: b).take_all()
+        # Stats publish lands after the consumer is unblocked (off the
+        # completion critical path): poll briefly.
+        deadline = _t.monotonic() + 30
+        executions = []
+        while _t.monotonic() < deadline and not executions:
+            with urllib.request.urlopen(base + "/api/data", timeout=60) as r:
+                executions = _json.loads(r.read())
+            _t.sleep(0.5)
+        assert executions, "no data execution stats published"
+        assert any(
+            any("MapBatches" in op["name"] for op in ex["ops"])
+            for ex in executions
+        )
+        with urllib.request.urlopen(base + "/api/train", timeout=60) as r:
+            assert isinstance(_json.loads(r.read()), list)
     finally:
         stop_dashboard()
+        serve.shutdown()
