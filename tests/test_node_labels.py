@@ -51,7 +51,7 @@ def test_actor_and_task_schedule_by_label(ray_start_cluster):
             return ray_tpu.get_runtime_context().get_node_id().hex()
 
     a = Pinned.remote()
-    assert ray_tpu.get(a.where.remote(), timeout=120) == labeled.node_id_hex
+    assert ray_tpu.get(a.where.remote(), timeout=240) == labeled.node_id_hex
 
     @ray_tpu.remote(num_cpus=1, scheduling_strategy=NodeLabelSchedulingStrategy(
         hard={"disk": In("ssd", "nvme")}
@@ -59,7 +59,7 @@ def test_actor_and_task_schedule_by_label(ray_start_cluster):
     def where_task():
         return ray_tpu.get_runtime_context().get_node_id().hex()
 
-    assert ray_tpu.get(where_task.remote(), timeout=120) == labeled.node_id_hex
+    assert ray_tpu.get(where_task.remote(), timeout=240) == labeled.node_id_hex
 
 
 def test_composite_label_or_resource_fallback(ray_start_cluster):
@@ -80,14 +80,14 @@ def test_composite_label_or_resource_fallback(ray_start_cluster):
     def run():
         return "placed"
 
-    assert ray_tpu.get(run.remote(), timeout=120) == "placed"
+    assert ray_tpu.get(run.remote(), timeout=240) == "placed"
 
     @ray_tpu.remote(num_cpus=1, scheduling_strategy=composite)
     class Svc:
         def ping(self):
             return "ok"
 
-    assert ray_tpu.get(Svc.remote().ping.remote(), timeout=120) == "ok"
+    assert ray_tpu.get(Svc.remote().ping.remote(), timeout=240) == "ok"
 
 
 def test_composite_prefers_matching_label(ray_start_cluster):
@@ -106,4 +106,4 @@ def test_composite_prefers_matching_label(ray_start_cluster):
     def where():
         return ray_tpu.get_runtime_context().get_node_id().hex()
 
-    assert ray_tpu.get(where.remote(), timeout=120) == labeled.node_id_hex
+    assert ray_tpu.get(where.remote(), timeout=240) == labeled.node_id_hex
