@@ -17,6 +17,7 @@ from ray_tpu.rllib.algorithms.offline import (
     evaluate_greedy,
 )
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent import MultiAgentPPO
 from ray_tpu.rllib.connectors import (

@@ -1,0 +1,606 @@
+"""DreamerV3: model-based RL — learn a world model, act in imagination.
+
+Design parity: reference `rllib/algorithms/dreamerv3/` (Hafner et al. 2023) —
+the RSSM world model (GRU deterministic path + categorical stochastic
+latents), symlog-transformed prediction heads, KL balancing with free bits,
+and an actor-critic trained entirely on imagined rollouts with lambda
+returns. Rebuilt TPU-first and compact: the whole world-model update and the
+whole imagination update are each ONE jitted program (`lax.scan` over time /
+horizon — no per-step dispatches), with static shapes throughout.
+
+Deliberate small-scale divergences from the paper (documented, not hidden):
+reward/value heads use symlog MSE instead of twohot-categorical, the critic
+EMA regularizer is a polyak target critic, and sampling runs a single
+in-process vector env (the recurrent acting state doesn't ride the stateless
+EnvRunner SPI). Discrete action spaces only (reinforce actor, as the paper
+uses for discrete control).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3
+
+        self._algo_class = DreamerV3
+        # model sizes (paper XS-ish, scaled down for CPU tests)
+        self.deter_size = 128
+        self.stoch_classes = 8
+        self.stoch_size = 8
+        self.units = 128
+        self.encoder_layers = 2
+        # training
+        self.sequence_length = 16
+        self.batch_size_seqs = 8
+        self.imagination_horizon = 8
+        self.gamma = 0.997
+        self.lambda_ = 0.95
+        self.kl_free_bits = 1.0
+        self.kl_dyn_scale = 0.5
+        self.kl_rep_scale = 0.1
+        self.entropy_coeff = 3e-3
+        self.wm_lr = 1e-3
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.critic_tau = 0.02
+        self.replay_capacity_steps = 100_000
+        self.learning_starts = 256
+        self.updates_per_iter = 4
+        self.env_steps_per_iter = 256
+
+
+# -- pure math helpers -------------------------------------------------------
+
+
+def _symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def _symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class _SequenceReplay:
+    """Ring of environment steps per env slot; samples [B, T] windows that
+    never cross into unwritten space (is_first flags handle episode joins,
+    exactly how the paper's replay treats boundaries)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self._cap = capacity
+        self._obs = np.zeros((capacity, obs_dim), np.float32)
+        self._action = np.zeros((capacity,), np.int64)
+        self._reward = np.zeros((capacity,), np.float32)
+        self._is_first = np.zeros((capacity,), np.bool_)
+        self._cont = np.ones((capacity,), np.float32)
+        self._n = 0
+        self._i = 0
+
+    def add(self, obs, action, reward, is_first, cont):
+        i = self._i
+        self._obs[i] = obs
+        self._action[i] = action
+        self._reward[i] = reward
+        self._is_first[i] = is_first
+        self._cont[i] = cont
+        self._i = (i + 1) % self._cap
+        self._n = min(self._n + 1, self._cap)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, batch: int, length: int, rng: np.random.Generator):
+        # Sample in LOGICAL (oldest-first) coordinates and map modulo the ring:
+        # a window is then always temporally contiguous even when it spans the
+        # physical seam at the write pointer.
+        starts = rng.integers(0, self._n - length, batch)
+        idx = starts[:, None] + np.arange(length)[None, :]
+        if self._n == self._cap:
+            idx = (self._i + idx) % self._cap
+        return {
+            "obs": self._obs[idx],
+            "action": self._action[idx],
+            "reward": self._reward[idx],
+            "is_first": self._is_first[idx].astype(np.float32),
+            "cont": self._cont[idx],
+        }
+
+
+class DreamerV3:
+    """Self-contained trainable (Algorithm-compatible train()/save/stop
+    surface). The reference's DreamerV3 likewise runs its own special path
+    rather than the generic sample->GAE->update loop."""
+
+    def __init__(self, config: DreamerV3Config):
+        import gymnasium as gym
+        import jax
+
+        self.config = config
+        self.iteration = 0
+        self._total_timesteps = 0
+        self._ret_history: List[float] = []
+        env_fn = config.env_creator()
+        self._env = env_fn()
+        if not isinstance(self._env.action_space, gym.spaces.Discrete):
+            raise ValueError("DreamerV3 (this build) supports Discrete actions")
+        self._obs_dim = int(np.prod(self._env.observation_space.shape))
+        self._act_dim = int(self._env.action_space.n)
+        self._np_rng = np.random.default_rng(config.seed or 0)
+        self._replay = _SequenceReplay(config.replay_capacity_steps, self._obs_dim)
+        self._build_model()
+        self._rng = jax.random.PRNGKey(config.seed or 0)
+        obs, _ = self._env.reset(seed=config.seed)
+        self._obs = np.asarray(obs, np.float32).reshape(-1)
+        self._h = np.zeros((config.deter_size,), np.float32)
+        self._z = np.zeros((config.stoch_classes * config.stoch_size,), np.float32)
+        self._prev_action = 0
+        self._episode_return = 0.0
+        self._is_first = True
+        self._arrival_reward = 0.0
+        self._arrival_cont = 1.0
+
+    # -- model -------------------------------------------------------------
+    def _build_model(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config
+        S, K = c.stoch_classes, c.stoch_size
+        feat_dim = c.deter_size + S * K
+        act_dim, obs_dim = self._act_dim, self._obs_dim
+
+        class WorldModel(nn.Module):
+            @nn.compact
+            def __call__(self, h, z_flat, a_onehot, embed):
+                """One posterior step: (h, z, a) -> h'; prior(h');
+                posterior(h', embed). Returns (h', prior_logits, post_logits).
+                """
+                x = jnp.concatenate([z_flat, a_onehot], -1)
+                x = nn.silu(nn.Dense(c.units, name="in_proj")(x))
+                h = nn.GRUCell(features=c.deter_size, name="gru")(h, x)[0]
+                prior = nn.Dense(S * K, name="prior")(
+                    nn.silu(nn.Dense(c.units, name="prior_h")(h))
+                ).reshape(h.shape[:-1] + (S, K))
+                post_in = jnp.concatenate([h, embed], -1)
+                post = nn.Dense(S * K, name="post")(
+                    nn.silu(nn.Dense(c.units, name="post_h")(post_in))
+                ).reshape(h.shape[:-1] + (S, K))
+                return h, prior, post
+
+        class Encoder(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = _symlog(obs)
+                for _ in range(c.encoder_layers):
+                    x = nn.silu(nn.Dense(c.units)(x))
+                return x
+
+        class Heads(nn.Module):
+            @nn.compact
+            def __call__(self, feat):
+                d = nn.silu(nn.Dense(c.units, name="dec_h")(feat))
+                recon = nn.Dense(obs_dim, name="dec")(d)
+                r = nn.silu(nn.Dense(c.units, name="rew_h")(feat))
+                reward = nn.Dense(1, name="rew")(r)[..., 0]
+                ct = nn.silu(nn.Dense(c.units, name="cont_h")(feat))
+                cont = nn.Dense(1, name="cont")(ct)[..., 0]
+                return recon, reward, cont
+
+        class Actor(nn.Module):
+            @nn.compact
+            def __call__(self, feat):
+                x = nn.silu(nn.Dense(c.units)(feat))
+                return nn.Dense(act_dim,
+                                kernel_init=nn.initializers.zeros)(x)
+
+        class Critic(nn.Module):
+            @nn.compact
+            def __call__(self, feat):
+                x = nn.silu(nn.Dense(c.units)(feat))
+                return nn.Dense(1, kernel_init=nn.initializers.zeros)(x)[..., 0]
+
+        self._nets = {
+            "rssm": WorldModel(), "enc": Encoder(), "heads": Heads(),
+            "actor": Actor(), "critic": Critic(),
+        }
+        rng = jax.random.PRNGKey(self.config.seed or 0)
+        ks = jax.random.split(rng, 6)
+        h0 = jnp.zeros((1, c.deter_size))
+        z0 = jnp.zeros((1, S * K))
+        a0 = jnp.zeros((1, act_dim))
+        e0 = jnp.zeros((1, c.units))
+        f0 = jnp.zeros((1, feat_dim))
+        self.params = {
+            "rssm": self._nets["rssm"].init(ks[0], h0, z0, a0, e0),
+            "enc": self._nets["enc"].init(ks[1], jnp.zeros((1, obs_dim))),
+            "heads": self._nets["heads"].init(ks[2], f0),
+            "actor": self._nets["actor"].init(ks[3], f0),
+            "critic": self._nets["critic"].init(ks[4], f0),
+        }
+        self._target_critic = jax.tree.map(lambda x: x, self.params["critic"])
+        self._opt = {
+            "wm": optax.adam(c.wm_lr),
+            "actor": optax.adam(c.actor_lr),
+            "critic": optax.adam(c.critic_lr),
+        }
+        wm_params = {k: self.params[k] for k in ("rssm", "enc", "heads")}
+        self._opt_state = {
+            "wm": self._opt["wm"].init(wm_params),
+            "actor": self._opt["actor"].init(self.params["actor"]),
+            "critic": self._opt["critic"].init(self.params["critic"]),
+        }
+        self._jit_update = jax.jit(self._update)
+        self._jit_act = jax.jit(self._act)
+
+    # -- jitted pieces ------------------------------------------------------
+    def _sample_z(self, logits, rng):
+        """Straight-through categorical sample per stochastic group (shared
+        module-level implementation; see _sample_z_static)."""
+        return _sample_z_static(logits, rng)
+
+    def _act(self, params, h, z, prev_a, obs, is_first, rng):
+        """One recurrent acting step: posterior update + actor sample."""
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        h = h * (1.0 - is_first)
+        z = z * (1.0 - is_first)
+        a_onehot = jax.nn.one_hot(prev_a, self._act_dim) * (1.0 - is_first)
+        embed = self._nets["enc"].apply(params["enc"], obs[None])
+        h2, _prior, post = self._nets["rssm"].apply(
+            params["rssm"], h[None], z[None], a_onehot[None], embed
+        )
+        k1, k2 = jax.random.split(rng)
+        z2 = self._sample_z(post, k1)
+        feat = jnp.concatenate([h2, z2], -1)
+        logits = self._nets["actor"].apply(params["actor"], feat)
+        action = jax.random.categorical(k2, logits, axis=-1)
+        return h2[0], z2[0], action[0]
+
+    def _observe(self, params, batch, rng):
+        """Posterior scan over a [B, T] sequence batch. Returns feats [T, B, F]
+        plus prior/post logits for the KL terms."""
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        B, T = batch["obs"].shape[:2]
+        embed = self._nets["enc"].apply(params["enc"], batch["obs"])  # [B,T,U]
+        a_onehot = jax.nn.one_hot(batch["action"], self._act_dim)
+
+        def step(carry, t_in):
+            h, z, rng = carry
+            emb_t, a_prev, first_t = t_in
+            h = h * (1.0 - first_t)[:, None]
+            z = z * (1.0 - first_t)[:, None]
+            a_prev = a_prev * (1.0 - first_t)[:, None]
+            h2, prior, post = self._nets["rssm"].apply(
+                params["rssm"], h, z, a_prev, emb_t
+            )
+            rng, sub = jax.random.split(rng)
+            z2 = self._sample_z(post, sub)
+            return (h2, z2, rng), (h2, z2, prior, post)
+
+        # previous action at step t is batch action at t-1 (0 at t=0)
+        a_prev = jnp.concatenate(
+            [jnp.zeros_like(a_onehot[:, :1]), a_onehot[:, :-1]], 1
+        )
+        h0 = jnp.zeros((B, c.deter_size))
+        z0 = jnp.zeros((B, c.stoch_classes * c.stoch_size))
+        (_h, _z, _rng), (hs, zs, priors, posts) = jax.lax.scan(
+            step, (h0, z0, rng),
+            (embed.swapaxes(0, 1), a_prev.swapaxes(0, 1),
+             batch["is_first"].swapaxes(0, 1)),
+        )
+        feats = jnp.concatenate([hs, zs], -1)  # [T, B, F]
+        return feats, priors, posts, hs, zs
+
+    def _kl(self, lhs_logits, rhs_logits):
+        import jax
+        import jax.numpy as jnp
+
+        lp = jax.nn.log_softmax(lhs_logits, -1)
+        rp = jax.nn.log_softmax(rhs_logits, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - rp), axis=(-2, -1))
+
+    def _update(self, params, target_critic, opt_state, batch, rng):
+        """One full DreamerV3 update (world model + imagination actor-critic)
+        as a single program."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config
+        k_wm, k_img, k_z = jax.random.split(rng, 3)
+
+        # ---- world model ---------------------------------------------------
+        def wm_loss(wm_params):
+            full = {**params, **wm_params}
+            feats, priors, posts, hs, zs = self._observe(full, batch, k_wm)
+            recon, reward, cont = self._nets["heads"].apply(
+                wm_params["heads"], feats
+            )
+            obs_t = _symlog(batch["obs"]).swapaxes(0, 1)
+            recon_loss = jnp.mean(jnp.sum((recon - obs_t) ** 2, -1))
+            rew_t = _symlog(batch["reward"]).swapaxes(0, 1)
+            reward_loss = jnp.mean((reward - rew_t) ** 2)
+            cont_t = batch["cont"].swapaxes(0, 1)
+            cont_loss = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(cont, cont_t)
+            )
+            # KL balancing with free bits (paper eq. 5)
+            sg = jax.lax.stop_gradient
+            dyn = jnp.maximum(
+                self._kl(sg(posts), priors), c.kl_free_bits
+            ).mean()
+            rep = jnp.maximum(
+                self._kl(posts, sg(priors)), c.kl_free_bits
+            ).mean()
+            loss = (recon_loss + reward_loss + cont_loss
+                    + c.kl_dyn_scale * dyn + c.kl_rep_scale * rep)
+            return loss, (feats, recon_loss, reward_loss, dyn)
+
+        wm_params = {k: params[k] for k in ("rssm", "enc", "heads")}
+        (wm_l, (feats, recon_l, rew_l, dyn_kl)), wm_grads = jax.value_and_grad(
+            wm_loss, has_aux=True
+        )(wm_params)
+        wm_updates, wm_opt = self._opt["wm"].update(wm_grads, opt_state["wm"])
+        wm_params = optax.apply_updates(wm_params, wm_updates)
+        new_params = {**params, **wm_params}
+
+        # ---- imagination ---------------------------------------------------
+        # ONE rollout, differentiated w.r.t. the actor: actions are sampled
+        # (non-differentiable constants), the reinforce gradient flows through
+        # the log-probs only, and dynamics/returns are stop-gradient'd — the
+        # paper's discrete-control gradient in a single scan.
+        start = jax.lax.stop_gradient(feats.reshape(-1, feats.shape[-1]))
+        D = c.deter_size
+
+        def actor_objective(ap):
+            def img_step(carry, _):
+                h, z, rng = carry
+                feat = jnp.concatenate([h, z], -1)
+                logits = self._nets["actor"].apply(ap, feat)
+                rng, k1, k2 = jax.random.split(rng, 3)
+                action = jax.random.categorical(
+                    k1, jax.lax.stop_gradient(logits), -1
+                )
+                a_onehot = jax.nn.one_hot(action, self._act_dim)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, -1), action[:, None], -1
+                )[:, 0]
+                entropy = -jnp.sum(
+                    jax.nn.softmax(logits, -1)
+                    * jax.nn.log_softmax(logits, -1), -1
+                )
+                h2, prior, _post_unused = self._nets["rssm"].apply(
+                    new_params["rssm"], jax.lax.stop_gradient(h),
+                    jax.lax.stop_gradient(z), a_onehot,
+                    jnp.zeros((h.shape[0], c.units)),
+                )
+                z2 = _sample_z_static(prior, k2)
+                return (h2, z2, rng), (
+                    jnp.concatenate([h2, z2], -1), logp, entropy
+                )
+
+            (_h, _z, _r), (img_feats, logps, entropies) = jax.lax.scan(
+                img_step, (start[:, :D], start[:, D:], k_img), None,
+                length=c.imagination_horizon,
+            )
+            img_all = jax.lax.stop_gradient(
+                jnp.concatenate([start[None], img_feats], 0)
+            )  # [H+1, N, F]
+            _rec, img_reward, img_cont = self._nets["heads"].apply(
+                new_params["heads"], img_all
+            )
+            rewards = _symexp(img_reward[1:])                 # [H, N]
+            discounts = c.gamma * jax.nn.sigmoid(img_cont[1:])
+            values_t = _symexp(
+                self._nets["critic"].apply(target_critic, img_all)
+            )
+
+            # lambda returns (raw space, backwards scan)
+            def lam_step(nxt, t_in):
+                r, d, v_next = t_in
+                ret = r + d * ((1 - c.lambda_) * v_next + c.lambda_ * nxt)
+                return ret, ret
+
+            _last, returns = jax.lax.scan(
+                lam_step, values_t[-1],
+                (rewards[::-1], discounts[::-1], values_t[1:][::-1]),
+            )
+            returns = returns[::-1]  # [H, N]
+            # reinforce on normalized advantages (return scale = 5th..95th
+            # percentile range, paper eq. 8) + entropy bonus
+            adv = returns - values_t[:-1]
+            scale = jnp.maximum(
+                jnp.percentile(returns, 95) - jnp.percentile(returns, 5), 1.0
+            )
+            adv = jax.lax.stop_gradient(adv / scale)
+            loss = (-jnp.mean(logps * adv)
+                    - c.entropy_coeff * jnp.mean(entropies))
+            return loss, (img_all, returns)
+
+        (ac_l, (img_all, returns)), ac_grads = jax.value_and_grad(
+            actor_objective, has_aux=True
+        )(params["actor"])
+        ac_updates, ac_opt = self._opt["actor"].update(
+            ac_grads, opt_state["actor"]
+        )
+        new_actor = optax.apply_updates(params["actor"], ac_updates)
+
+        def critic_loss(cp):
+            v = self._nets["critic"].apply(cp, img_all[:-1])
+            tgt = _symlog(jax.lax.stop_gradient(returns))
+            return jnp.mean((v - tgt) ** 2)
+
+        cr_l, cr_grads = jax.value_and_grad(critic_loss)(params["critic"])
+        cr_updates, cr_opt = self._opt["critic"].update(
+            cr_grads, opt_state["critic"]
+        )
+        new_critic = optax.apply_updates(params["critic"], cr_updates)
+
+        new_target = jax.tree.map(
+            lambda t, o: (1 - c.critic_tau) * t + c.critic_tau * o,
+            target_critic, new_critic,
+        )
+        out_params = {**new_params, "actor": new_actor, "critic": new_critic}
+        out_opt = {"wm": wm_opt, "actor": ac_opt, "critic": cr_opt}
+        metrics = {
+            "wm_loss": wm_l, "recon_loss": recon_l, "reward_loss": rew_l,
+            "dyn_kl": dyn_kl, "critic_loss": cr_l, "actor_loss": ac_l,
+            "imag_return_mean": jnp.mean(returns),
+        }
+        return out_params, new_target, out_opt, metrics
+
+    # -- env loop -----------------------------------------------------------
+    def _collect(self, n_steps: int):
+        """Paper replay convention: each record holds (obs_t, action taken AT
+        obs_t, reward that ARRIVED WITH obs_t, is_first, cont_t) — the reward
+        head then predicts r_t from feat_t, which encodes the (s_{t-1},
+        a_{t-1}) transition that produced it. Terminal observations are stored
+        too (dummy action) so their arrival reward and cont=0 are learnable."""
+        import jax
+
+        returns = []
+        for _ in range(n_steps):
+            self._rng, sub = jax.random.split(self._rng)
+            h, z, action = self._jit_act(
+                self.params, self._h, self._z, self._prev_action, self._obs,
+                float(self._is_first), sub,
+            )
+            action = int(action)
+            next_obs, reward, term, trunc, _ = self._env.step(action)
+            self._replay.add(self._obs, action, self._arrival_reward,
+                             self._is_first, self._arrival_cont)
+            self._arrival_reward = float(reward)
+            self._arrival_cont = 0.0 if term else 1.0
+            self._episode_return += float(reward)
+            self._total_timesteps += 1
+            self._h, self._z = np.asarray(h), np.asarray(z)
+            self._prev_action = action
+            self._is_first = False
+            if term or trunc:
+                # Final record: the arrival state with its reward and cont.
+                self._replay.add(
+                    np.asarray(next_obs, np.float32).reshape(-1), 0,
+                    self._arrival_reward, False, self._arrival_cont,
+                )
+                returns.append(self._episode_return)
+                self._episode_return = 0.0
+                obs, _ = self._env.reset()
+                self._obs = np.asarray(obs, np.float32).reshape(-1)
+                self._is_first = True
+                self._arrival_reward = 0.0
+                self._arrival_cont = 1.0
+            else:
+                self._obs = np.asarray(next_obs, np.float32).reshape(-1)
+        return returns
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        self.iteration += 1
+        c = self.config
+        returns = self._collect(c.env_steps_per_iter)
+        metrics_out: Dict[str, float] = {}
+        if len(self._replay) >= max(c.learning_starts,
+                                    c.sequence_length * 2):
+            for _ in range(c.updates_per_iter):
+                batch = self._replay.sample(
+                    c.batch_size_seqs, c.sequence_length, self._np_rng
+                )
+                batch = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+                self._rng, sub = jax.random.split(self._rng)
+                self.params, self._target_critic, self._opt_state, m = (
+                    self._jit_update(
+                        self.params, self._target_critic, self._opt_state,
+                        batch, sub,
+                    )
+                )
+            metrics_out = {k: float(v) for k, v in m.items()}
+        if returns:
+            self._ret_history.extend(returns)
+            self._ret_history = self._ret_history[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_timesteps,
+            "episode_return_mean": (
+                float(np.mean(self._ret_history)) if self._ret_history
+                else float("nan")
+            ),
+            "episodes_this_iter": len(returns),
+            "replay_size": len(self._replay),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": v for k, v in metrics_out.items()},
+        }
+
+    # -- persistence / lifecycle -------------------------------------------
+    def save_to_path(self, path: str) -> str:
+        """Full training state EXCEPT the replay buffer (the reference's
+        checkpoints likewise exclude sample data): params, target critic,
+        all three optimizer states, and the RNGs, so a restored run continues
+        with warm Adam moments instead of an effective LR spike."""
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "dreamer_state.pkl"), "wb") as f:
+            pickle.dump({
+                "params": self.params,
+                "target_critic": self._target_critic,
+                "opt_state": self._opt_state,
+                "rng": self._rng,
+                "np_rng_state": self._np_rng.bit_generator.state,
+                "iteration": self.iteration,
+                "total_timesteps": self._total_timesteps,
+            }, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        import os
+        import pickle
+
+        with open(os.path.join(path, "dreamer_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self._target_critic = state["target_critic"]
+        if "opt_state" in state:
+            self._opt_state = state["opt_state"]
+            self._rng = state["rng"]
+            self._np_rng.bit_generator.state = state["np_rng_state"]
+        self.iteration = state["iteration"]
+        self._total_timesteps = state["total_timesteps"]
+
+    def stop(self):
+        try:
+            self._env.close()
+        except Exception:
+            pass
+
+
+def _sample_z_static(logits, rng):
+    import jax
+    import jax.numpy as jnp
+
+    sample = jax.random.categorical(rng, logits, axis=-1)
+    onehot = jax.nn.one_hot(sample, logits.shape[-1])
+    probs = jax.nn.softmax(logits, -1)
+    onehot = onehot + probs - jax.lax.stop_gradient(probs)
+    return onehot.reshape(onehot.shape[:-2] + (-1,))

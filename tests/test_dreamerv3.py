@@ -1,0 +1,124 @@
+"""DreamerV3: world model + imagination actor-critic.
+
+Shape parity: reference rllib/algorithms/dreamerv3/tests — the world model's
+losses drop on a deterministic environment (it IS learnable dynamics), the
+imagination machinery produces finite lambda-return training signals, and the
+policy improves on a trivially predictable chain task.
+"""
+
+import numpy as np
+import pytest
+
+
+class ChainEnv:
+    """5-state chain: start at 0, action 1 moves right (+reward at the end),
+    action 0 moves left. Deterministic — a world model can learn it exactly."""
+
+    def __init__(self, length=5, horizon=12):
+        import gymnasium as gym
+
+        self._len = length
+        self._horizon = horizon
+        self.observation_space = gym.spaces.Box(0.0, 1.0, (length,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._pos = 0
+        self._t = 0
+
+    def _obs(self):
+        out = np.zeros(self._len, np.float32)
+        out[self._pos] = 1.0
+        return out
+
+    def reset(self, seed=None, options=None):
+        self._pos, self._t = 0, 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        self._pos = min(self._len - 1, self._pos + 1) if action == 1 else max(
+            0, self._pos - 1
+        )
+        reward = 1.0 if self._pos == self._len - 1 else 0.0
+        trunc = self._t >= self._horizon
+        return self._obs(), reward, False, trunc, {}
+
+    def close(self):
+        pass
+
+
+def _config(**over):
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment(lambda c: ChainEnv()).debugging(seed=0)
+    cfg.deter_size = 64
+    cfg.units = 64
+    cfg.stoch_classes = 4
+    cfg.stoch_size = 4
+    cfg.sequence_length = 12
+    cfg.batch_size_seqs = 8
+    cfg.imagination_horizon = 6
+    cfg.env_steps_per_iter = 256
+    cfg.updates_per_iter = 4
+    cfg.learning_starts = 128
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_world_model_learns_deterministic_dynamics():
+    """The RSSM world-model loss (reconstruction + reward + KL) must fall
+    substantially on deterministic dynamics."""
+    algo = _config().build_algo()
+    try:
+        first = None
+        last = None
+        for _ in range(10):
+            m = algo.train()
+            if "learner/wm_loss" in m:
+                if first is None:
+                    first = m["learner/wm_loss"]
+                last = m["learner/wm_loss"]
+        assert first is not None, "world model never trained"
+        assert np.isfinite(last)
+        assert last < 0.7 * first, (first, last)
+        # imagination produced finite return signals
+        assert np.isfinite(m["learner/imag_return_mean"])
+        assert np.isfinite(m["learner/critic_loss"])
+        assert np.isfinite(m["learner/actor_loss"])
+    finally:
+        algo.stop()
+
+
+def test_policy_improves_on_chain():
+    """Acting in imagination reaches the right end of the chain more often
+    as training progresses (return = steps spent at the rewarding state)."""
+    algo = _config(entropy_coeff=1e-3).build_algo()
+    try:
+        early = algo.train()["episode_return_mean"]
+        for _ in range(14):
+            m = algo.train()
+        late = m["episode_return_mean"]
+        # Random walk on the chain rarely reaches the end (return ~<2 of max
+        # 8); a learned go-right policy collects most of the horizon.
+        assert late > max(2.0, early + 1.0), (early, late)
+    finally:
+        algo.stop()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    algo = _config().build_algo()
+    try:
+        for _ in range(3):
+            algo.train()
+        path = algo.save_to_path(str(tmp_path / "ck"))
+        ts = algo._total_timesteps
+        algo2 = _config().build_algo()
+        try:
+            algo2.restore_from_path(path)
+            assert algo2._total_timesteps == ts
+            m = algo2.train()  # restored params keep training
+            assert np.isfinite(m.get("learner/wm_loss", 0.0))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
