@@ -494,6 +494,68 @@ class ReferenceCounter:
             return self._borrow_total_locked(object_id)
 
 
+class _LogDeduplicator:
+    """Collapse identical log lines spamming from many workers (reference:
+    python/ray/_private/ray_logging LogDeduplicator — the '[repeated Nx across
+    cluster]' behavior). Lines are keyed with digits masked so counters and
+    pids don't defeat the match; the first occurrence prints immediately, later
+    ones within the window are counted and summarized when the window expires.
+    Disabled via RAY_TPU_LOG_DEDUP=0 (every line passes through verbatim)."""
+
+    WINDOW_S = 5.0
+
+    def __init__(self):
+        import re
+
+        self._mask = re.compile(r"\d+")
+        self._seen: dict[str, dict] = {}
+        self.enabled = os.environ.get("RAY_TPU_LOG_DEDUP", "1") not in (
+            "0", "false", "off"
+        )
+
+    def ingest(self, prefix: str, pid, lines) -> str:
+        if not self.enabled:
+            return "".join(f"{prefix} {ln}\n" for ln in lines)
+        now = time.monotonic()
+        out = []
+        out.append(self.flush_expired(now))
+        for ln in lines:
+            key = self._mask.sub("#", ln)
+            entry = self._seen.get(key)
+            # flush_expired above evicted every stale entry, so a hit here is
+            # always inside the window.
+            if entry is not None:
+                entry["count"] += 1
+                entry["pids"].add(pid)
+                continue
+            self._seen[key] = {
+                "first_t": now, "count": 0, "line": ln, "prefix": prefix,
+                "pids": {pid},
+            }
+            out.append(f"{prefix} {ln}\n")
+        return "".join(out)
+
+    def flush_expired(self, now: float | None = None) -> str:
+        now = time.monotonic() if now is None else now
+        out = []
+        for key in list(self._seen):
+            entry = self._seen[key]
+            if now - entry["first_t"] >= self.WINDOW_S:
+                del self._seen[key]
+                if entry["count"]:
+                    out.append(self._summary(entry))
+        return "".join(out)
+
+    @staticmethod
+    def _summary(entry) -> str:
+        n, pids = entry["count"], len(entry["pids"])
+        return (
+            f"{entry['prefix']} {entry['line']} "
+            f"[repeated {n}x across {pids} process(es); set RAY_TPU_LOG_DEDUP=0 "
+            f"to disable deduplication]\n"
+        )
+
+
 class _StreamState:
     """Owner-side state of one streaming-generator task (ObjectRefStream parity,
     reference task_manager.h). Items can arrive out of order (RPC dispatch is
@@ -672,6 +734,7 @@ class CoreWorker:
         # put object id -> refs embedded in its payload, pinned until the put
         # object is freed (contained-in protection; see put()).
         self._put_embedded_pins: dict[ObjectID, list[ObjectID]] = {}
+        self._log_dedup = _LogDeduplicator()
         # Owned ids with an attached resource (e.g. a device-object HBM pin):
         # the hook runs when the id's last reference dies cluster-wide.
         self._owned_free_hooks: dict[ObjectID, Any] = {}
@@ -866,6 +929,14 @@ class CoreWorker:
             await asyncio.sleep(CONFIG.metrics_report_interval_s)
             # Backstop drain: refs dropped by GC with no later API activity.
             self.reference_counter.drain_deferred()
+            # Dedup summaries for lines whose repeat window closed quietly.
+            try:
+                pending = self._log_dedup.flush_expired()
+                if pending:
+                    sys.stderr.write(pending)
+                    sys.stderr.flush()
+            except Exception:
+                pass
             with self._events_lock:
                 batch, self._task_events = self._task_events, []
             if batch:
@@ -2452,9 +2523,12 @@ class CoreWorker:
                 return True
             try:
                 prefix = f"({message.get('kind', 'worker')} pid={message.get('pid')}, node={message.get('node', '')[:8]})"
-                out = "".join(f"{prefix} {ln}\n" for ln in message.get("lines", ()))
-                sys.stderr.write(out)
-                sys.stderr.flush()
+                out = self._log_dedup.ingest(
+                    prefix, message.get("pid"), message.get("lines", ())
+                )
+                if out:
+                    sys.stderr.write(out)
+                    sys.stderr.flush()
             except Exception:
                 pass
         return True

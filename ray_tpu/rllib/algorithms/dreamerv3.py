@@ -105,7 +105,7 @@ class _SequenceReplay:
         # Sample in LOGICAL (oldest-first) coordinates and map modulo the ring:
         # a window is then always temporally contiguous even when it spans the
         # physical seam at the write pointer.
-        starts = rng.integers(0, self._n - length, batch)
+        starts = rng.integers(0, max(1, self._n - length + 1), batch)
         idx = starts[:, None] + np.arange(length)[None, :]
         if self._n == self._cap:
             idx = (self._i + idx) % self._cap
@@ -534,7 +534,7 @@ class DreamerV3:
                         batch, sub,
                     )
                 )
-            metrics_out = {k: float(v) for k, v in m.items()}
+                metrics_out = {k: float(v) for k, v in m.items()}
         if returns:
             self._ret_history.extend(returns)
             self._ret_history = self._ret_history[-100:]
