@@ -66,7 +66,7 @@ class NodeProcess:
         self.gcs_proc.kill()
         self.gcs_proc.wait(timeout=5)
 
-    def restart_gcs(self, timeout: float = 30.0):
+    def restart_gcs(self, timeout: float = 90.0):
         """Start a fresh GCS on the same port over the same persistent store
         (reference: gcs_server restart with a Redis backend)."""
         if self.gcs_port is None:
@@ -106,7 +106,7 @@ def _free_port() -> int:
 
 
 def _start_gcs_process(session_dir: str, store_dir: str, port: int,
-                       timeout: float = 30.0) -> subprocess.Popen:
+                       timeout: float = 90.0) -> subprocess.Popen:
     """Spawn the standalone GCS server (reference: gcs_server binary) and wait for
     it to bind. The fixed port lets raylets and drivers reconnect to a restarted
     GCS at the same address."""
@@ -145,7 +145,7 @@ def start_node(
     session_dir: str,
     object_store_bytes: int = 0,
     worker_env: dict | None = None,
-    timeout: float = 30.0,
+    timeout: float = 90.0,
 ) -> NodeProcess:
     ready_file = os.path.join(
         session_dir, f"node_ready_{uuid.uuid4().hex[:8]}.json"
