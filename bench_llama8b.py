@@ -6,11 +6,16 @@ Two artifacts, written to BENCH_LLAMA8B.json:
 1. `proxy_mfu` (runs on the real chip): a single v5e chip cannot hold the full
    8B train state, so the per-layer cost is measured directly — the exact 8B
    layer geometry (hidden 4096, mlp 14336, 32q/8kv heads, flash attention,
-   remat) at two depths (2 and 4 layers, reduced 32k vocab). Per-layer step
-   cost = (t4 - t2) / 2; depth-independent cost (embed + fused-CE head,
-   measured at 32k vocab) scales linearly with vocab to 128256. Projected
+   remat policy "selective": save attention-side tensors, recompute the wide
+   gate/up matmuls — ~100 MB/layer saved activations at b1/s2048, the
+   memory/speed point that fits an fsdp=8 v5e pod) at depths 1 and 2. Per-layer
+   step cost = t2 - t1; depth-independent cost (embed + fused-CE head, measured
+   at a reduced vocab) scales linearly with vocab to 128256. Projected
    full-model step time = fixed*scale + 32*per_layer; MFU uses the true 8B
-   parameter count. Assumptions are recorded in the JSON.
+   parameter count. A secondary `upper_bound` row records the same measurement
+   under dots_saveable (save every matmul output — faster, but its activation
+   footprint only suits chips with more HBM headroom). Assumptions are
+   recorded in the JSON.
 
 2. `fsdp8_memory` (virtual 8-device mesh, subprocess): the FULL 8B config
    (32 layers, 128256 vocab) jitted over an fsdp=8 mesh and AOT-compiled —
@@ -42,7 +47,8 @@ def true_param_count() -> int:
     return L * (attn + mlp_p + norms) + 2 * v * h + h  # embed + lm_head + final norm
 
 
-def measure_step(n_layers: int, vocab: int, batch: int, seq: int, iters: int = 8):
+def measure_step(n_layers: int, vocab: int, batch: int, seq: int, iters: int = 8,
+                 remat_policy: str = "selective"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -53,7 +59,8 @@ def measure_step(n_layers: int, vocab: int, batch: int, seq: int, iters: int = 8
 
     cfg = ModelConfig(
         vocab_size=vocab, hidden=4096, n_layers=n_layers, n_heads=32,
-        n_kv_heads=8, mlp_dim=14336, max_seq=seq, remat=True, scan_layers=True,
+        n_kv_heads=8, mlp_dim=14336, max_seq=seq, remat=True,
+        remat_policy=remat_policy, scan_layers=True,
         attention="flash" if jax.default_backend() == "tpu" else "reference",
     )
     model = Transformer(cfg)
@@ -74,17 +81,9 @@ def measure_step(n_layers: int, vocab: int, batch: int, seq: int, iters: int = 8
         return (time.perf_counter() - t0) / iters
 
 
-def proxy_mfu():
-    import jax
-
+def _project(t1, t2, batch, seq, vocab):
     from bench import peak_flops_per_chip
 
-    on_tpu = jax.default_backend() == "tpu"
-    # Depths 1 and 2: a 4-layer probe (~1B params + f32 adam) overflows a
-    # 16 GiB v5e; the 2-vs-1 delta isolates the same per-layer cost.
-    batch, seq, vocab = (1, 2048, 16384) if on_tpu else (1, 128, 1024)
-    t1 = measure_step(1, vocab, batch, seq)
-    t2 = measure_step(2, vocab, batch, seq)
     per_layer = max(t2 - t1, 1e-9)
     fixed = max(t1 - per_layer, 0.0)
     # The depth-independent cost is dominated by the fused-CE head (linear in
@@ -97,7 +96,6 @@ def proxy_mfu():
     tokens_per_sec = batch * seq / t_full
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
     return {
-        "metric": "llama8b_proxy_mfu_per_chip",
         "projected_step_s": round(t_full, 4),
         "projected_tokens_per_s": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),
@@ -106,12 +104,43 @@ def proxy_mfu():
             "per_layer_s": round(per_layer, 5), "fixed_s": round(fixed, 4),
             "batch": batch, "seq": seq, "proxy_vocab": vocab,
         },
+    }
+
+
+def proxy_mfu():
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Depths 1 and 2: a 4-layer probe (~1B params + f32 adam) overflows a
+    # 16 GiB v5e; the 2-vs-1 delta isolates the same per-layer cost.
+    batch, seq, vocab = (2, 2048, 16384) if on_tpu else (1, 128, 1024)
+    n_params = true_param_count()
+    rows = {}
+    for name, policy, b in (("primary", "selective", batch),
+                            ("batch1", "selective", 1),
+                            ("upper_bound_dots", "dots", batch)):
+        t1 = measure_step(1, vocab, b, seq, remat_policy=policy)
+        t2 = measure_step(2, vocab, b, seq, remat_policy=policy)
+        rows[name] = _project(t1, t2, b, seq, vocab)
+        rows[name]["remat_policy"] = policy
+    out = {
+        "metric": "llama8b_proxy_mfu_per_chip",
+        **rows["primary"],
+        "rows": rows,
         "assumptions": [
             "exact 8B layer geometry; per-layer cost from 2-vs-1 layer delta",
             "depth-independent cost scaled linearly in vocab (fused-CE head)",
             f"true 8B param count {n_params:,} used for FLOPs",
+            "primary row: remat_policy=selective (saves post-rope q/k/v, attn "
+            "out, o/down projections, pre-MLP norm; recomputes the wide "
+            "gate/up matmuls) — ~100 MB/layer saved activations at b1/s2048, "
+            "sized for an fsdp=8 v5e pod; upper_bound_dots saves every matmul "
+            "output (~330 MB/layer) and needs more HBM headroom per chip",
+            "per-chip batch 2 (primary): at pod scale this is global batch 16 "
+            "over fsdp=8",
         ],
     }
+    return out
 
 
 _FSDP8_CHILD = "_LLAMA8B_FSDP8_CHILD"
@@ -148,8 +177,8 @@ def fsdp8_memory():
         state_shardings,
     )
 
-    cfg = ModelConfig(remat=True, scan_layers=True, attention="reference",
-                      **LLAMA8B)
+    cfg = ModelConfig(remat=True, remat_policy="selective", scan_layers=True,
+                      attention="reference", **LLAMA8B)
     model = Transformer(cfg)
     mesh = mesh_lib.create_mesh({"fsdp": 8})
     opt = optax.adamw(3e-4, weight_decay=0.01, mu_dtype=jnp.bfloat16)

@@ -13,14 +13,20 @@ from ray_tpu.parallel.spmd import build_train_step, init_state
 
 
 def run(tag, batch=8, seq=1024, fused=None, chunk=None, attention="flash",
-        remat=False, iters=10, **cfg_over):
+        remat=False, iters=10, grad_norm=False, env=None, **cfg_over):
+    import os
     t_start = time.time()
+    saved = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = str(v)
     try:
         cfg = get_config("gpt2-125m", remat=remat, max_seq=seq,
                          attention=attention, **cfg_over)
         model = Transformer(cfg)
         mesh = mesh_lib.create_mesh({"dp": 1})
-        opt = optax.adamw(3e-4, weight_decay=0.01)
+        # Match bench.py exactly: bf16 first moment, no grad-norm pass.
+        opt = optax.adamw(3e-4, weight_decay=0.01, mu_dtype=jnp.bfloat16)
         state, _ = init_state(model, cfg, opt, mesh, sample_shape=(batch, seq))
         kwargs = {}
         if fused is not None:
@@ -34,7 +40,8 @@ def run(tag, batch=8, seq=1024, fused=None, chunk=None, attention="flash",
                 return orig(h, t, tg, m, **kw)
 
             tmod.fused_cross_entropy_loss = patched
-        step_fn, shard = build_train_step(model, opt, mesh, **kwargs)
+        step_fn, shard = build_train_step(model, opt, mesh,
+                                          with_grad_norm=grad_norm, **kwargs)
         if chunk is not None:
             tmod.fused_cross_entropy_loss = orig
         tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
@@ -53,6 +60,12 @@ def run(tag, batch=8, seq=1024, fused=None, chunk=None, attention="flash",
                f"(compile+run {time.time()-t_start:.0f}s)")
     except Exception as e:  # noqa: BLE001
         msg = f"{tag}: FAILED {type(e).__name__}: {str(e)[:160]}"
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     print(msg, flush=True)
 
 
@@ -66,3 +79,84 @@ if __name__ == "__main__":
         run("plain-b8-refattn", fused=False, attention="reference")
         run("fused-c1024-b16", fused=True, chunk=1024, batch=16)
         run("plain-b4", fused=False, batch=4)
+    if which == "r5a":
+        run("plain-b8", fused=False)
+        run("fused-c1024-b8", fused=True, chunk=1024)
+        run("plain-b16", fused=False, batch=16)
+        run("fused-c1024-b16", fused=True, chunk=1024, batch=16)
+        run("fused-c1024-b32", fused=True, chunk=1024, batch=32)
+    if which == "r5b":
+        run("fused-c2048-b16", fused=True, chunk=2048, batch=16)
+        run("fused-c512-b16", fused=True, chunk=512, batch=16)
+        run("plain-b32", fused=False, batch=32)
+        run("fused-c1024-b64", fused=True, chunk=1024, batch=64)
+    if which == "r5c":
+        run("unrolled-b8", fused=False, scan_layers=False)
+        run("refattn-b8", fused=False, attention="reference")
+        run("flash-bq256-bk512", fused=False,
+            env={"RAY_TPU_FLASH_BQ": 256, "RAY_TPU_FLASH_BK": 512,
+                 "RAY_TPU_FLASH_BWD_BQ": 256, "RAY_TPU_FLASH_BWD_BK": 512})
+        run("flash-bq1024-bk512", fused=False,
+            env={"RAY_TPU_FLASH_BQ": 1024, "RAY_TPU_FLASH_BK": 512,
+                 "RAY_TPU_FLASH_BWD_BQ": 1024, "RAY_TPU_FLASH_BWD_BK": 512})
+        run("xla-bwd-b8", fused=False, env={"RAY_TPU_FLASH_BWD": "xla"})
+    if which == "r5e":
+        # In-graph ablations: replace one component with a near-free stand-in
+        # and diff against baseline — locates where the full value_and_grad's
+        # time actually goes (isolated microbenches under-count fusion costs).
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        import ray_tpu.models.transformer as _tmod
+
+        run("ablate-none", fused=False)
+        orig_flash = _tmod.flash_attention
+        _tmod.flash_attention = lambda q, k, v, causal=True, scale=None: v
+        run("ablate-attn", fused=False)
+        _tmod.flash_attention = orig_flash
+        # The rotation is applied via _rope_apply (angles are hoisted out of
+        # the layers); patching _rope alone would ablate nothing.
+        orig_rope_apply = _tmod._rope_apply
+        _tmod._rope_apply = lambda x, cos, sin: x
+        run("ablate-rope", fused=False)
+        _tmod._rope_apply = orig_rope_apply
+
+        class _CheapNorm(_tmod.RMSNorm):
+            @_tmod.nn.compact
+            def __call__(self, x):
+                scale = self.param(
+                    "scale",
+                    _tmod.nn.with_logical_partitioning(
+                        _tmod.nn.initializers.ones_init(), ("embed",)),
+                    (x.shape[-1],), self.param_dtype)
+                return x * scale.astype(x.dtype)
+
+        orig_norm = _tmod.RMSNorm
+        _tmod.RMSNorm = _CheapNorm
+        run("ablate-norm", fused=False)
+        _tmod.RMSNorm = orig_norm
+    if which == "r5f":
+        import jax.numpy as _jnp
+
+        import ray_tpu.models.transformer as _tmod
+
+        run("ablate-none2", fused=False)
+        # Head+CE ablation: fused=True makes apply() return hidden (the head
+        # matmul never runs); patching fused_cross_entropy_loss to a cheap
+        # mean removes the entire head+CE cost from the graph.
+        orig_fce = _tmod.fused_cross_entropy_loss
+        _tmod.fused_cross_entropy_loss = (
+            lambda hidden, table, targets, mask=None, **kw:
+            _jnp.mean(hidden.astype(_jnp.float32)) + 0.0 * _jnp.sum(table[0, 0])
+        )
+        run("ablate-head", fused=True)
+        _tmod.fused_cross_entropy_loss = orig_fce
+        run("best-blocks", fused=False,
+            env={"RAY_TPU_FLASH_BQ": 256, "RAY_TPU_FLASH_BK": 1024})
+    if which == "r5d":
+        run("unrolled-refattn-b8", fused=False, scan_layers=False,
+            attention="reference")
+        run("unrolled-xla-bwd-b8", fused=False, scan_layers=False,
+            env={"RAY_TPU_FLASH_BWD": "xla"})
+        run("remat-b8", fused=False, remat=True)
+        run("fusedqkv-b8", fused=False, fused_qkv=True)

@@ -245,13 +245,15 @@ class GcsService:
         One JSONL file per source type under CONFIG.export_events_dir; each
         record is {source_type, event_id, timestamp, event_data} with ids
         rendered as hex. A whole batch lands in ONE append so a task-event
-        flush doesn't stall the GCS loop on thousands of file opens. Disabled
-        (the default) costs one string compare."""
+        flush doesn't stall the GCS loop on thousands of file opens, and the
+        append itself runs on a dedicated writer thread behind a bounded
+        queue — a slow or network-mounted export dir can't stall control-plane
+        RPCs sharing the GCS event loop (events drop, oldest-first pressure,
+        rather than block). Disabled (the default) costs one string compare."""
         dirpath = CONFIG.export_events_dir
         if not dirpath or not batch:
             return
         import json
-        import os as _os
         import uuid
 
         now = time.time()
@@ -263,13 +265,46 @@ class GcsService:
                 "timestamp": now,
                 "event_data": _export_clean(data),
             }))
+        self._export_writer_put(dirpath, source_type, lines)
+
+    def _export_writer_put(self, dirpath: str, source_type: str, lines: list):
+        import queue as _queue
+        import threading
+
+        q = getattr(self, "_export_queue", None)
+        if q is None:
+            q = self._export_queue = _queue.Queue(maxsize=1024)
+
+            def drain():
+                import os as _os
+
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    dp, st, ls = item
+                    try:
+                        _os.makedirs(dp, exist_ok=True)
+                        with open(_os.path.join(dp, f"export_{st}.jsonl"),
+                                  "a") as f:
+                            f.write("\n".join(ls) + "\n")
+                    except OSError:
+                        pass  # export is observability, never a control-plane failure
+
+            self._export_thread = threading.Thread(
+                target=drain, name="gcs-export-writer", daemon=True
+            )
+            self._export_thread.start()
         try:
-            _os.makedirs(dirpath, exist_ok=True)
-            with open(_os.path.join(dirpath, f"export_{source_type}.jsonl"),
-                      "a") as f:
-                f.write("\n".join(lines) + "\n")
-        except OSError:
-            pass  # export is observability, never a control-plane failure
+            q.put_nowait((dirpath, source_type, lines))
+        except _queue.Full:
+            # Shed OLDEST-first: an operator debugging a live incident needs
+            # the most recent events in the export files.
+            try:
+                q.get_nowait()
+                q.put_nowait((dirpath, source_type, lines))
+            except (_queue.Empty, _queue.Full):
+                pass  # racing the writer; never stall the control plane
 
     def _node_of_conn(self, conn) -> NodeInfo | None:
         for node in self.nodes.values():

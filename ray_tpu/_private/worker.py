@@ -1438,7 +1438,11 @@ class CoreWorker:
         while self._connected:
             await asyncio.sleep(CONFIG.borrow_audit_interval_s)
             snapshot = self.reference_counter.borrower_snapshot()
-            stale = {k: v for k, v in stale.items() if k[0] in snapshot}
+            # Prune strikes whose borrower left entirely AND strikes whose oid
+            # is no longer borrowed by that borrower (normal release between
+            # audits) — otherwise (borrower, oid) keys accrete forever.
+            stale = {k: v for k, v in stale.items()
+                     if k[0] in snapshot and k[1] in snapshot[k[0]]}
             for key in snapshot:
                 node_hex, worker_hex = key
                 if node_hex == "?":
@@ -1455,10 +1459,10 @@ class CoreWorker:
                     failures.pop(key, None)
                     # Liveness is not enough: a borrower that released into a
                     # crashed parent's void still has a count here (the -1
-                    # never arrived). Ask what it actually still holds; two
-                    # consecutive not-held verdicts reconcile the entry
-                    # (one-shot would race an in-flight handoff the holder
-                    # hasn't learned about yet).
+                    # never arrived). Ask what it actually still holds; three
+                    # consecutive not-held verdicts (plus a wall-clock floor,
+                    # below) reconcile the entry — fewer would race an
+                    # in-flight handoff the holder hasn't learned about yet.
                     try:
                         resp = await self.raylet.call(
                             "check_borrows", node_hex, worker_hex,

@@ -7,7 +7,11 @@ writes/reads between pinned per-actor loops.
 """
 
 from ray_tpu.dag import collective
-from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.compiled_dag import (
+    CompiledDAG,
+    CompiledDAGFuture,
+    CompiledDAGRef,
+)
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     CollectiveOutputNode,
@@ -21,6 +25,7 @@ __all__ = [
     "ClassMethodNode",
     "CollectiveOutputNode",
     "CompiledDAG",
+    "CompiledDAGFuture",
     "CompiledDAGRef",
     "DAGNode",
     "InputAttributeNode",

@@ -318,8 +318,45 @@ class CompiledDAGRef:
     def get(self, timeout: Optional[float] = 60):
         if not self._ready:
             self._dag._resolve_until(self._idx, timeout)
-            self._value = self._dag._pending.pop(self._idx)
-            self._ready = True
+            with self._dag._state_lock:
+                consume = not self._ready
+                if consume:
+                    self._value = self._dag._pending.pop(self._idx)
+                    self._ready = True
+            if consume:
+                self._dag._note_consumed(self._idx)
+        if isinstance(self._value, _WrappedError):
+            raise self._value.error
+        return self._value
+
+
+class CompiledDAGFuture:
+    """Awaitable result of one execute_async() call (reference:
+    compiled_dag_node.py CompiledDAGFuture :2627). Channel reads run in a
+    thread-pool executor so an asyncio Serve replica can drive a compiled DAG
+    without blocking its event loop."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._value: Any = None
+        self._ready = False
+
+    def __await__(self):
+        return self.get_async().__await__()
+
+    async def get_async(self, timeout: Optional[float] = 60):
+        if not self._ready:
+            await self._dag._resolve_until_async(self._idx, timeout)
+            # Another coroutine awaiting this SAME future may have consumed it
+            # while we were suspended; the state lock also covers sync gets.
+            with self._dag._state_lock:
+                consume = not self._ready
+                if consume:
+                    self._value = self._dag._pending.pop(self._idx)
+                    self._ready = True
+            if consume:
+                self._dag._note_consumed(self._idx)
         if isinstance(self._value, _WrappedError):
             raise self._value.error
         return self._value
@@ -332,7 +369,8 @@ class _WrappedError:
 
 class CompiledDAG:
     def __init__(self, leaf: DAGNode, *, buffer_size_bytes: int = 8 << 20,
-                 _timeout_s: float = 60.0):
+                 max_inflight_executions: int = 10, _timeout_s: float = 60.0):
+        import threading
         import uuid as _uuid
 
         self._buffer = buffer_size_bytes
@@ -341,10 +379,29 @@ class CompiledDAG:
         self._token = _uuid.uuid4().hex[:12]  # op-profile event namespace
         self._exec_count = 0
         self._pending: Dict[int, Any] = {}
+        # In-flight pipelining (reference compiled_dag_node.py:837): channels
+        # get max_inflight_executions ring slots so that many executions can
+        # genuinely be in flight; execute() raises RayCgraphCapacityExceeded
+        # past the bound instead of deadlocking on a full ring.
+        self._max_inflight = max(1, int(max_inflight_executions))
+        # Reference parity: num_shm_buffers = max_inflight_executions
+        # (compiled_dag_node.py:961) — the ring can hold every in-flight value,
+        # so a driver that respects the bound never wedges a writer.
+        self._num_slots = max(2, self._max_inflight)
+        self._consumed_rounds = 0  # rounds with EVERY output consumed by get()
+        self._consumed: Dict[int, int] = {}  # round -> outputs consumed so far
+        # Input channel is single-writer: concurrent execute/execute_async
+        # submissions must serialize their capacity-check + ring write or two
+        # writers race the same slot and a round is silently lost.
+        self._submit_lock = threading.Lock()
+        # Consumption bookkeeping (capacity accounting + pending pops) shared
+        # by sync gets and async futures.
+        self._state_lock = threading.Lock()
         self._build(leaf)
         # Per-output-reader progress: how many rounds each has consumed. Kept per
         # reader so a timeout on one output can't shift another reader's stream.
         self._reader_round = [0] * self._num_outputs
+        self._stream_locks = [threading.Lock() for _ in range(self._num_outputs)]
 
     # -- compilation -------------------------------------------------------
     def _build(self, leaf: DAGNode):
@@ -409,14 +466,16 @@ class CompiledDAG:
 
         def make_channel(writer_node, reader_nodes, n_readers, owner):
             if all(rn == writer_node for rn in reader_nodes):
-                return Channel(self._buffer, n_readers)
+                return Channel(self._buffer, n_readers,
+                               num_slots=self._num_slots)
             if owner is None:
                 raise RuntimeError(
                     "compiled DAGs with cross-node edges need a local data "
                     "plane: this driver has no direct server (thin-client "
                     "mode), so actors on other nodes cannot pull its channels"
                 )
-            return RpcChannel(self._buffer, n_readers, owner=owner)
+            return RpcChannel(self._buffer, n_readers, num_slots=self._num_slots,
+                              owner=owner)
 
         # Input channel read by every arg occurrence that consumes the input
         # (directly or through attribute nodes).
@@ -523,25 +582,103 @@ class CompiledDAG:
             )
 
     # -- execution ---------------------------------------------------------
-    def execute(self, input_value: Any = None) -> List[CompiledDAGRef] | CompiledDAGRef:
+    def _check_capacity(self):
         if self._torn_down:
             raise RuntimeError("this compiled DAG was torn down")
-        idx = self._exec_count
-        self._exec_count += 1
-        self._input_channel.write(input_value, timeout=self._timeout)
+        if self._exec_count - self._consumed_rounds >= self._max_inflight:
+            from ray_tpu.exceptions import RayCgraphCapacityExceeded
+
+            raise RayCgraphCapacityExceeded(
+                f"{self._exec_count - self._consumed_rounds} executions in "
+                f"flight >= max_inflight_executions="
+                f"{self._max_inflight}: get()/await results before "
+                "submitting more"
+            )
+
+    def _note_consumed(self, idx: int):
+        with self._state_lock:
+            rnd = idx // self._num_outputs
+            n = self._consumed.get(rnd, 0) + 1
+            if n >= self._num_outputs:
+                self._consumed.pop(rnd, None)
+                self._consumed_rounds += 1
+            else:
+                self._consumed[rnd] = n
+
+    def _submit(self, input_value) -> int:
+        """Capacity check + count + single-writer ring write, atomically."""
+        with self._submit_lock:
+            self._check_capacity()
+            idx = self._exec_count
+            self._exec_count += 1
+            self._input_channel.write(input_value, timeout=self._timeout)
+            return idx
+
+    def execute(self, input_value: Any = None) -> List[CompiledDAGRef] | CompiledDAGRef:
+        idx = self._submit(input_value)
         refs = [CompiledDAGRef(self, idx * self._num_outputs + k)
                 for k in range(self._num_outputs)]
         return refs if self._num_outputs > 1 else refs[0]
+
+    async def execute_async(
+        self, input_value: Any = None
+    ) -> List[CompiledDAGFuture] | CompiledDAGFuture:
+        """Submit without blocking the event loop; returns awaitable futures
+        (reference compiled_dag_node.py execute_async :2627). Up to
+        max_inflight_executions submissions can overlap; results may be
+        awaited out of submission order (per-output streams stay ordered)."""
+        import asyncio
+
+        # The submit (capacity check + ring write) runs in the executor: the
+        # write blocks only while a slow consumer drains, and the submit lock
+        # serializes concurrent submissions off the event loop.
+        idx = await asyncio.get_running_loop().run_in_executor(
+            None, self._submit, input_value
+        )
+        futs = [CompiledDAGFuture(self, idx * self._num_outputs + k)
+                for k in range(self._num_outputs)]
+        return futs if self._num_outputs > 1 else futs[0]
 
     def _resolve_until(self, target_idx: int, timeout: Optional[float]):
         round_needed, j = divmod(target_idx, self._num_outputs)
         reader = self._output_readers[j]
         deadline = None if timeout is None else time.monotonic() + timeout
         while self._reader_round[j] <= round_needed:
-            remaining = None if deadline is None else deadline - time.monotonic()
-            value = reader.read(remaining)
-            self._pending[self._reader_round[j] * self._num_outputs + j] = value
-            self._reader_round[j] += 1
+            # Per-STREAM lock: readers of output j serialize with each other
+            # (sync gets and async futures alike) without head-of-line
+            # blocking reads of other outputs whose values may already be
+            # sitting in their channels.
+            with self._stream_locks[j]:
+                if self._reader_round[j] > round_needed:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                value = reader.read(remaining)
+                self._pending[self._reader_round[j] * self._num_outputs + j] = value
+                self._reader_round[j] += 1
+
+    async def _resolve_until_async(self, target_idx: int,
+                                   timeout: Optional[float]):
+        """Async mirror of _resolve_until: the blocking channel read runs in
+        the default executor, serialized per output stream."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        round_needed, j = divmod(target_idx, self._num_outputs)
+        reader = self._output_readers[j]
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def read_one():
+            # Lock is taken in the worker thread: sync gets contend fairly.
+            with self._stream_locks[j]:
+                if self._reader_round[j] > round_needed:
+                    return
+                remaining = None if deadline is None else deadline - time.monotonic()
+                value = reader.read(remaining)
+                self._pending[self._reader_round[j] * self._num_outputs + j] = value
+                self._reader_round[j] += 1
+
+        while self._reader_round[j] <= round_needed:
+            await loop.run_in_executor(None, read_one)
 
     def __getattr__(self, name):
         raise AttributeError(name)

@@ -141,3 +141,9 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class TaskUnschedulableError(RayTpuError):
     pass
+
+
+class RayCgraphCapacityExceeded(RayTpuError):
+    """A compiled DAG has max_inflight_executions results outstanding; the
+    caller must consume (get/await) results before submitting more
+    (reference: ray.exceptions.RayCgraphCapacityExceeded)."""

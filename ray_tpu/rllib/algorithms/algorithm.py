@@ -30,8 +30,9 @@ class Algorithm:
         self._total_timesteps = 0
         env_fn = config.env_creator()
         probe = env_fn()
+        e2m_blob, m2e_blob, module_obs_space = self._build_env_pipelines(probe)
         self._module = self._build_module(
-            probe.observation_space, probe.action_space,
+            module_obs_space, probe.action_space,
             tuple(config.model.get("hiddens", (64, 64))),
         )
         if hasattr(probe, "close"):
@@ -42,6 +43,8 @@ class Algorithm:
             num_env_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_env_runner,
             seed=config.seed,
+            env_to_module_blob=e2m_blob,
+            module_to_env_blob=m2e_blob,
         )
         self.learner_group = LearnerGroup(
             module_blob, cloudpickle.dumps(self.loss_fn()),
@@ -55,6 +58,55 @@ class Algorithm:
         self._ret_history: list = []
 
     # -- SPI ---------------------------------------------------------------
+    def _build_env_pipelines(self, probe_env):
+        """Build env↔module connector pipelines from the config's hooks
+        (reference: env_to_module_pipeline.py built per EnvRunner). Returns
+        (env_to_module_blob, module_to_env_blob, module_obs_space) — the
+        module's input space reflects the TRANSFORMED observation (frame
+        stacking / prev-action appends change the dim)."""
+        import cloudpickle
+        import gymnasium as gym
+        import numpy as np
+
+        from ray_tpu.rllib.env_connectors import (
+            EnvToModulePipeline,
+            ModuleToEnvPipeline,
+            default_module_to_env_pipeline,
+        )
+
+        obs_space = probe_env.observation_space
+        act_space = probe_env.action_space
+
+        def build(hook, default, kind):
+            if hook is None:
+                return default
+            out = hook(obs_space, act_space)
+            if isinstance(out, (list, tuple)):
+                out = kind(list(out))
+            return out
+
+        e2m = build(self.config.env_to_module_connector, None,
+                    EnvToModulePipeline)
+        m2e = build(self.config.module_to_env_connector,
+                    default_module_to_env_pipeline(act_space),
+                    ModuleToEnvPipeline)
+
+        module_obs_space = obs_space
+        if e2m is not None and e2m.connectors:
+            # Probe the transformed obs dim with a throwaway pipeline replica
+            # (the real pipelines live in the runners; this one's state dies).
+            replica = cloudpickle.loads(cloudpickle.dumps(e2m))
+            replica.setup(obs_space, act_space, 1)
+            sample = np.asarray(obs_space.sample(), np.float32)[None]
+            out = np.asarray(replica(sample, {"no_update": True}))
+            module_obs_space = gym.spaces.Box(
+                -np.inf, np.inf, out.shape[1:], np.float32
+            )
+        e2m_blob = cloudpickle.dumps(e2m) if e2m is not None else None
+        m2e_blob = (cloudpickle.dumps(m2e)
+                    if m2e is not None and m2e.connectors else None)
+        return e2m_blob, m2e_blob, module_obs_space
+
     def _build_module(self, observation_space, action_space, hiddens):
         """Build the RLModule for this algorithm (default: MLP actor-critic;
         algorithms with bespoke architectures — e.g. SAC's twin critics —
@@ -92,6 +144,9 @@ class Algorithm:
             1, self.config.train_batch_size // max(1, len(self.env_runner_group))
         )
         runner_batches = self.env_runner_group.sample(per_runner)
+        # Merge + rebroadcast connector running stats every iteration
+        # (reference: Algorithm.training_step -> sync_env_runner_states).
+        self.env_runner_group.sync_connector_states()
         returns = np.concatenate(
             [b.get("episode_returns", np.zeros(0)) for b in runner_batches]
         ) if runner_batches else np.zeros(0)
@@ -156,6 +211,9 @@ class Algorithm:
             "params": self.learner_group.get_params(),
             "iteration": self.iteration,
             "total_timesteps": self._total_timesteps,
+            # Env-connector running stats (MeanStdFilter): without these a
+            # restored policy would see differently-scaled observations.
+            "connector_state": self.env_runner_group.get_connector_state(),
         }
         if self.target_spec():
             state["target"] = self.learner_group.get_target()
@@ -177,6 +235,7 @@ class Algorithm:
                 self.learner_group.sync_target()
         self.iteration = state["iteration"]
         self._total_timesteps = state["total_timesteps"]
+        self.env_runner_group.set_connector_state(state.get("connector_state"))
 
     def get_weights(self):
         return self.learner_group.get_params()

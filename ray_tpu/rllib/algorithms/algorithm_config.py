@@ -21,6 +21,13 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        # Env-side connector hooks (reference: AlgorithmConfig
+        # env_to_module_connector / module_to_env_connector): callables
+        # (obs_space, act_space) -> EnvToModulePipeline / ModuleToEnvPipeline
+        # (or a list of pieces). module_to_env defaults to clipping Box
+        # actions into bounds.
+        self.env_to_module_connector: Optional[Callable] = None
+        self.module_to_env_connector: Optional[Callable] = None
         # training
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -59,13 +66,19 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None):
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None,
+                    module_to_env_connector: Optional[Callable] = None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,

@@ -133,8 +133,16 @@ class FragmentsToBatch(ConnectorV2):
         ]
         batch = {}
         for k in columns:
-            parts = [np.asarray(f[k]) for f in fragments if k in f]
-            arr = np.concatenate(parts)
+            missing = [i for i, f in enumerate(fragments) if k not in f]
+            if missing:
+                # Silently skipping would misalign rows ACROSS columns (other
+                # columns still include those fragments' rows) — fail loudly.
+                raise KeyError(
+                    f"column {k!r} missing from fragment(s) {missing[:5]} "
+                    f"(of {len(fragments)}); every batched column must be "
+                    "present in every fragment"
+                )
+            arr = np.concatenate([np.asarray(f[k]) for f in fragments])
             if arr.dtype == np.float64:
                 arr = arr.astype(np.float32)
             batch[k] = arr

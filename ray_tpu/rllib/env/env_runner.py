@@ -45,7 +45,9 @@ class _DuckEnvAdapter:
 
 class SingleAgentEnvRunner:
     def __init__(self, env_spec, module_blob: bytes, num_envs: int = 1,
-                 seed: Optional[int] = None, worker_index: int = 0):
+                 seed: Optional[int] = None, worker_index: int = 0,
+                 env_to_module_blob: Optional[bytes] = None,
+                 module_to_env_blob: Optional[bytes] = None):
         import os
 
         # Env runners are CPU samplers by design (the learner owns the TPU — same
@@ -80,10 +82,31 @@ class SingleAgentEnvRunner:
         self._obs, _ = self._envs.reset(
             seed=None if seed is None else seed + worker_index
         )
+        # Env↔module connector pipelines (reference: env_to_module_pipeline.py
+        # built and run BY the EnvRunner; module_to_env transforms actions).
+        # Observations recorded into episodes are the TRANSFORMED ones — the
+        # learner must train on exactly what the module acted on.
+        from ray_tpu.rllib.env_connectors import (
+            EnvToModulePipeline,
+            ModuleToEnvPipeline,
+        )
+
+        self._e2m = (cloudpickle.loads(env_to_module_blob)
+                     if env_to_module_blob else EnvToModulePipeline([]))
+        self._m2e = (cloudpickle.loads(module_to_env_blob)
+                     if module_to_env_blob else ModuleToEnvPipeline([]))
+        one_env = self._envs.envs[0]
+        self._e2m.setup(one_env.observation_space, one_env.action_space,
+                        num_envs)
+        self._m2e.setup(one_env.observation_space, one_env.action_space,
+                        num_envs)
         # gymnasium >=1.0 next-step autoreset: the step after a termination ignores
         # the action and returns (reset_obs, 0, False, False) — that transition is
         # bookkeeping, not experience, and must not be recorded.
         self._pending_reset = np.zeros(num_envs, dtype=bool)
+        # Connector per-env state resets apply right before the NEW episode's
+        # first obs is transformed (one step after the autoreset step).
+        self._pending_connector_reset = np.zeros(num_envs, dtype=bool)
         # per-env running episode buffers
         self._episodes: List[Dict[str, list]] = [self._new_ep() for _ in range(num_envs)]
         self._ep_returns: List[float] = []
@@ -119,48 +142,68 @@ class SingleAgentEnvRunner:
 
     def sample(self, num_timesteps: int) -> Dict[str, Any]:
         """Roll the vector env for ~num_timesteps; return concatenated episode
-        fragments with bootstrap values, ready for GAE."""
+        fragments with bootstrap values, ready for GAE. Observations flow raw →
+        env_to_module pipeline → module; module actions flow → module_to_env
+        pipeline → env.step; episodes record the transformed obs and the
+        module's raw actions."""
         import jax
 
         assert self._params is not None, "set_weights() before sample()"
         frags: List[Dict[str, np.ndarray]] = []
         steps = 0
         while steps < num_timesteps:
+            for i in np.flatnonzero(self._pending_connector_reset):
+                self._e2m.reset(int(i))
+                self._pending_connector_reset[i] = False
+            obs_t = np.asarray(self._e2m(self._obs))
             self._rng, sub = jax.random.split(self._rng)
-            action, logp, vf = self._policy_step(self._params, self._obs, sub)
+            action, logp, vf = self._policy_step(self._params, obs_t, sub)
             action = np.asarray(action)
             logp = np.asarray(logp)
             vf = np.asarray(vf)
-            next_obs, rewards, terms, truncs, _infos = self._envs.step(action)
+            env_action = np.asarray(self._m2e(action))
+            next_obs, rewards, terms, truncs, _infos = self._envs.step(env_action)
+            self._e2m.observe(action, rewards)
+            peek_t = None  # transformed successor obs, computed lazily
             for i in range(self._num_envs):
                 if self._pending_reset[i]:
-                    # Autoreset step: next_obs[i] is the fresh episode's first obs.
+                    # Autoreset step: next_obs[i] is the fresh episode's first
+                    # obs; per-env connector state resets before it transforms.
                     self._pending_reset[i] = False
+                    self._pending_connector_reset[i] = True
                     continue
                 ep = self._episodes[i]
-                ep[Columns.OBS].append(self._obs[i])
+                ep[Columns.OBS].append(obs_t[i])
                 ep[Columns.ACTIONS].append(action[i])
                 ep[Columns.REWARDS].append(float(rewards[i]))
                 ep[Columns.ACTION_LOGP].append(float(logp[i]))
                 ep[Columns.VF_PREDS].append(float(vf[i]))
                 if terms[i] or truncs[i]:
+                    if peek_t is None:
+                        peek_t = np.asarray(
+                            self._e2m(next_obs, {"no_update": True})
+                        )
                     frags.append(self._finish_ep(i, terminated=bool(terms[i]),
-                                                 next_obs=next_obs[i], env_done=True))
+                                                 next_obs_t=peek_t[i],
+                                                 env_done=True))
                     self._pending_reset[i] = True
             self._obs = next_obs
             steps += self._num_envs
         # Flush in-progress episodes as truncated fragments (bootstrap with vf).
-        for i in range(self._num_envs):
-            if self._episodes[i][Columns.OBS]:
-                frags.append(self._finish_ep(i, terminated=False, next_obs=self._obs[i],
-                                             env_done=False))
+        if any(self._episodes[i][Columns.OBS] for i in range(self._num_envs)):
+            peek_t = np.asarray(self._e2m(self._obs, {"no_update": True}))
+            for i in range(self._num_envs):
+                if self._episodes[i][Columns.OBS]:
+                    frags.append(self._finish_ep(i, terminated=False,
+                                                 next_obs_t=peek_t[i],
+                                                 env_done=False))
         batch = self._concat(frags)
         batch["episode_returns"] = np.array(self._ep_returns, np.float32)
         batch["episode_lens"] = np.array(self._ep_lens, np.float32)
         self._ep_returns, self._ep_lens = [], []
         return batch
 
-    def _finish_ep(self, i: int, terminated: bool, next_obs,
+    def _finish_ep(self, i: int, terminated: bool, next_obs_t,
                    env_done: bool = True) -> Dict[str, np.ndarray]:
         import jax
 
@@ -171,7 +214,7 @@ class SingleAgentEnvRunner:
         else:
             self._rng, sub = jax.random.split(self._rng)
             _a, _lp, vf = self._policy_step(
-                self._params, np.asarray(next_obs)[None, :], sub
+                self._params, np.asarray(next_obs_t)[None, :], sub
             )
             bootstrap = float(np.asarray(vf)[0])
         out = {
@@ -183,7 +226,7 @@ class SingleAgentEnvRunner:
             "bootstrap_value": np.float32(bootstrap),
             # Off-policy consumers (DQN) need the true successor of the last
             # transition; without it they'd self-bootstrap at fragment edges.
-            "final_next_obs": np.asarray(next_obs, np.float32),
+            "final_next_obs": np.asarray(next_obs_t, np.float32),
             "terminated": terminated,
         }
         if env_done:
@@ -197,6 +240,17 @@ class SingleAgentEnvRunner:
     @staticmethod
     def _concat(frags: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
         return {"fragments": frags}
+
+    # -- connector state (cross-runner sync + checkpoint) -------------------
+    def get_connector_delta(self) -> dict:
+        """Stats accumulated since the last set_connector_state."""
+        return self._e2m.get_delta()
+
+    def get_connector_state(self) -> dict:
+        return self._e2m.get_state()
+
+    def set_connector_state(self, state: dict):
+        self._e2m.set_state(state)
 
     def ping(self) -> bool:
         return True

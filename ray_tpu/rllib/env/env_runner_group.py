@@ -21,13 +21,25 @@ import ray_tpu
 class EnvRunnerGroup:
     def __init__(self, env_spec: bytes, module_blob: bytes, *, num_env_runners: int,
                  num_envs_per_runner: int = 1, seed: Optional[int] = None,
-                 runner_cpus: float = 1):
+                 runner_cpus: float = 1,
+                 env_to_module_blob: Optional[bytes] = None,
+                 module_to_env_blob: Optional[bytes] = None):
         from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
         self._env_spec = env_spec
         self._module_blob = module_blob
         self._num_envs_per_runner = num_envs_per_runner
         self._seed = seed
+        self._e2m_blob = env_to_module_blob
+        self._m2e_blob = module_to_env_blob
+        # The group's own pipeline replica: merge target for cross-runner
+        # connector-state sync and the checkpointable source of truth.
+        self._connector_state: Optional[dict] = None
+        self._merge_pipeline = None
+        if env_to_module_blob:
+            import cloudpickle
+
+            self._merge_pipeline = cloudpickle.loads(env_to_module_blob)
         self._cls = ray_tpu.remote(num_cpus=runner_cpus)(SingleAgentEnvRunner)
         self._runners = [
             self._make_runner(i) for i in range(max(1, num_env_runners))
@@ -40,10 +52,13 @@ class EnvRunnerGroup:
         self._runner_version = [0] * len(self._runners)
 
     def _make_runner(self, index: int):
-        return self._cls.remote(
+        runner = self._cls.remote(
             self._env_spec, self._module_blob, self._num_envs_per_runner,
-            self._seed, index,
+            self._seed, index, self._e2m_blob, self._m2e_blob,
         )
+        if self._connector_state is not None:
+            runner.set_connector_state.remote(self._connector_state)
+        return runner
 
     def __len__(self):
         return len(self._runners)
@@ -131,6 +146,39 @@ class EnvRunnerGroup:
     def sample_async_stop(self) -> None:
         """Disarm the stream: drop in-flight refs (results are discarded)."""
         self._inflight = {}
+
+    # -- connector-state sync (reference: EnvRunnerGroup.sync_env_runner_states
+    # merging MeanStdFilter stats across runners each iteration) -------------
+    def sync_connector_states(self) -> Optional[dict]:
+        """Gather each runner's accumulated stats delta, merge into the group
+        state, broadcast the merged state back. Returns the merged state (the
+        Algorithm checkpoints it)."""
+        if self._merge_pipeline is None:
+            return None
+        refs = [r.get_connector_delta.remote() for r in self._runners]
+        deltas = []
+        for ref in refs:
+            try:
+                deltas.append(ray_tpu.get(ref, timeout=60))
+            except Exception:
+                deltas.append(None)
+        self._connector_state = self._merge_pipeline.merge_states(
+            self._connector_state, [d for d in deltas if d is not None]
+        )
+        for r in self._runners:
+            r.set_connector_state.remote(self._connector_state)
+        return self._connector_state
+
+    def get_connector_state(self) -> Optional[dict]:
+        return self._connector_state
+
+    def set_connector_state(self, state: Optional[dict]):
+        if state is None:
+            return
+        self._connector_state = state
+        ray_tpu.get([
+            r.set_connector_state.remote(state) for r in self._runners
+        ])
 
     def stop(self):
         self.sample_async_stop()

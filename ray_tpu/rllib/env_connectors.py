@@ -1,0 +1,401 @@
+"""Env↔module connector pipelines: observation/action transforms on the
+sampling path.
+
+Design parity: reference `rllib/connectors/env_to_module/` (pipeline
+`env_to_module_pipeline.py`, `frame_stacking.py`, `mean_std_filter.py`,
+`prev_actions_prev_rewards.py`, `flatten_observations.py`) and
+`rllib/connectors/module_to_env/` (action un-squashing/clipping). The learner
+half lives in `ray_tpu/rllib/connectors.py`; this module is the env half:
+every EnvRunner builds these pipelines, runs observations through the
+env→module pipeline BEFORE the module sees them (and records the transformed
+observations, so training and acting agree), and runs module actions through
+the module→env pipeline before env.step().
+
+Statefulness: pieces may keep per-env-slot buffers (frame stacks, prev
+actions) — reset at episode boundaries — and cross-episode running statistics
+(MeanStdFilter). Running stats follow the reference's distributed-filter
+contract: each runner accumulates a LOCAL delta since the last sync; the
+EnvRunnerGroup merges base+deltas (Welford combine is associative) and
+broadcasts the merged state back, so every runner normalizes with near-global
+statistics and the merged state checkpoints/restores with the Algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class RunningStat:
+    """Parallel-mergeable running mean/variance (Chan et al. combine)."""
+
+    def __init__(self, shape=()):
+        self.count = 0.0
+        self.mean = np.zeros(shape, np.float64)
+        self.m2 = np.zeros(shape, np.float64)
+
+    def push_batch(self, x: np.ndarray):
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        if n == 0:
+            return
+        b_mean = x.mean(axis=0)
+        b_m2 = ((x - b_mean) ** 2).sum(axis=0)
+        self._combine(n, b_mean, b_m2)
+
+    def _combine(self, n2, mean2, m2_2):
+        n1 = self.count
+        n = n1 + n2
+        delta = mean2 - self.mean
+        self.mean = self.mean + delta * (n2 / n)
+        self.m2 = self.m2 + m2_2 + delta * delta * (n1 * n2 / n)
+        self.count = n
+
+    def merge(self, other: "RunningStat"):
+        if other.count:
+            self._combine(other.count, other.mean, other.m2)
+        return self
+
+    @property
+    def std(self) -> np.ndarray:
+        var = self.m2 / max(self.count - 1, 1.0)
+        return np.sqrt(np.maximum(var, 1e-8))
+
+    def copy(self) -> "RunningStat":
+        out = RunningStat(self.mean.shape)
+        out.count, out.mean, out.m2 = self.count, self.mean.copy(), self.m2.copy()
+        return out
+
+    def to_state(self) -> dict:
+        return {"count": self.count, "mean": self.mean.copy(),
+                "m2": self.m2.copy()}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "RunningStat":
+        out = cls(np.asarray(state["mean"]).shape)
+        out.count = float(state["count"])
+        out.mean = np.asarray(state["mean"], np.float64).copy()
+        out.m2 = np.asarray(state["m2"], np.float64).copy()
+        return out
+
+
+class EnvConnector:
+    """One env-side piece. Called once per vector-env step with the batched
+    observation [num_envs, ...]; `ctx` carries per-step extras
+    (prev_actions, prev_rewards, update=False for stat-free peeks such as
+    bootstrap-value observations)."""
+
+    def setup(self, observation_space, action_space, num_envs: int):
+        self._obs_space = observation_space
+        self._act_space = action_space
+        self._num_envs = num_envs
+
+    def __call__(self, obs: np.ndarray, ctx: Optional[dict] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def reset(self, env_index: int):
+        """Episode boundary for one env slot."""
+
+    # -- state (checkpoint + cross-runner sync); default: stateless ---------
+    def get_state(self) -> Optional[dict]:
+        return None
+
+    def set_state(self, state: dict):
+        pass
+
+    def get_delta(self) -> Optional[dict]:
+        """Accumulated since the last set_state (cross-runner merge)."""
+        return None
+
+    @classmethod
+    def merge(cls, base: Optional[dict], deltas: List[Optional[dict]]):
+        return base
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class FlattenObservations(EnvConnector):
+    """Flatten [num_envs, *obs_shape] to [num_envs, prod(obs_shape)]
+    (reference: env_to_module/flatten_observations.py)."""
+
+    def __call__(self, obs, ctx=None):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class MeanStdFilter(EnvConnector):
+    """Running mean/std observation normalization (reference:
+    env_to_module/mean_std_filter.py). Normalizes with the base⊕local
+    combined stats; only the local part ships in get_delta()."""
+
+    def __init__(self, clip: float = 10.0, update: bool = True):
+        self._clip = float(clip)
+        self._update = update
+        self._base: Optional[RunningStat] = None
+        self._local: Optional[RunningStat] = None
+
+    def setup(self, observation_space, action_space, num_envs):
+        super().setup(observation_space, action_space, num_envs)
+        shape = np.asarray(observation_space.sample()).reshape(-1).shape
+        if self._base is None:
+            self._base = RunningStat(shape)
+            self._local = RunningStat(shape)
+
+    def __call__(self, obs, ctx=None):
+        obs = np.asarray(obs, np.float32)
+        flat = obs.reshape(obs.shape[0], -1)
+        if self._update and not (ctx or {}).get("no_update"):
+            self._local.push_batch(flat)
+        stat = self._base.copy().merge(self._local)
+        if stat.count < 2:
+            return obs
+        normed = (flat - stat.mean) / stat.std
+        return np.clip(normed, -self._clip, self._clip).astype(
+            np.float32).reshape(obs.shape)
+
+    def get_state(self):
+        return {"base": self._base.copy().merge(self._local).to_state()}
+
+    def set_state(self, state):
+        self._base = RunningStat.from_state(state["base"])
+        self._local = RunningStat(self._base.mean.shape)
+
+    def get_delta(self):
+        return {"local": self._local.to_state()}
+
+    @classmethod
+    def merge(cls, base, deltas):
+        stat = (RunningStat.from_state(base["base"]) if base
+                else None)
+        for d in deltas:
+            if d is None:
+                continue
+            local = RunningStat.from_state(d["local"])
+            if stat is None:
+                stat = RunningStat(local.mean.shape)
+            stat.merge(local)
+        return {"base": (stat or RunningStat()).to_state()}
+
+
+class FrameStacking(EnvConnector):
+    """Stack the last N observations along the last axis (reference:
+    env_to_module/frame_stacking.py). Per-env buffers reset to zeros at
+    episode boundaries; transient — nothing to checkpoint."""
+
+    def __init__(self, num_frames: int = 4):
+        self._n = int(num_frames)
+        self._buffers: Optional[np.ndarray] = None  # [num_envs, n, flat]
+
+    def __call__(self, obs, ctx=None):
+        obs = np.asarray(obs, np.float32)
+        flat = obs.reshape(obs.shape[0], -1)
+        if self._buffers is None:
+            self._buffers = np.zeros(
+                (flat.shape[0], self._n, flat.shape[1]), np.float32
+            )
+        if (ctx or {}).get("no_update"):
+            # Peek (bootstrap obs): stack against the current buffers without
+            # advancing them.
+            stacked = np.concatenate(
+                [self._buffers[:, 1:], flat[:, None]], axis=1
+            )
+            return stacked.reshape(flat.shape[0], -1)
+        self._buffers = np.concatenate(
+            [self._buffers[:, 1:], flat[:, None]], axis=1
+        )
+        return self._buffers.reshape(flat.shape[0], -1)
+
+    def reset(self, env_index: int):
+        if self._buffers is not None:
+            self._buffers[env_index] = 0.0
+
+
+class PrevActionsPrevRewards(EnvConnector):
+    """Append the previous action (one-hot for Discrete) and previous reward
+    to the observation (reference: env_to_module/prev_actions_prev_rewards.py).
+    Zeroed at episode starts."""
+
+    def __init__(self):
+        self._prev_act: Optional[np.ndarray] = None
+        self._prev_rew: Optional[np.ndarray] = None
+
+    def setup(self, observation_space, action_space, num_envs):
+        super().setup(observation_space, action_space, num_envs)
+        self._act_dim = self._action_feature_dim(action_space)
+        self._prev_act = np.zeros((num_envs, self._act_dim), np.float32)
+        self._prev_rew = np.zeros((num_envs, 1), np.float32)
+
+    @staticmethod
+    def _action_feature_dim(space) -> int:
+        import gymnasium as gym
+
+        if isinstance(space, gym.spaces.Discrete):
+            return int(space.n)
+        return int(np.prod(space.shape))
+
+    def observe(self, actions: np.ndarray, rewards: np.ndarray):
+        """Record the step's actions/rewards for the NEXT observation."""
+        import gymnasium as gym
+
+        actions = np.asarray(actions)
+        if isinstance(self._act_space, gym.spaces.Discrete):
+            onehot = np.zeros((actions.shape[0], self._act_dim), np.float32)
+            onehot[np.arange(actions.shape[0]), actions.astype(int)] = 1.0
+            self._prev_act = onehot
+        else:
+            self._prev_act = actions.reshape(
+                actions.shape[0], -1).astype(np.float32)
+        self._prev_rew = np.asarray(
+            rewards, np.float32).reshape(-1, 1)
+
+    def __call__(self, obs, ctx=None):
+        obs = np.asarray(obs, np.float32)
+        flat = obs.reshape(obs.shape[0], -1)
+        if self._prev_act is None or self._prev_act.shape[0] != flat.shape[0]:
+            self._prev_act = np.zeros((flat.shape[0], self._act_dim), np.float32)
+            self._prev_rew = np.zeros((flat.shape[0], 1), np.float32)
+        return np.concatenate([flat, self._prev_act, self._prev_rew], axis=1)
+
+    def reset(self, env_index: int):
+        if self._prev_act is not None:
+            self._prev_act[env_index] = 0.0
+            self._prev_rew[env_index] = 0.0
+
+
+class EnvToModulePipeline:
+    """Ordered env→module pieces (reference:
+    env_to_module/env_to_module_pipeline.py)."""
+
+    def __init__(self, connectors: Optional[List[EnvConnector]] = None):
+        self.connectors = list(connectors or [])
+
+    def setup(self, observation_space, action_space, num_envs: int):
+        for c in self.connectors:
+            c.setup(observation_space, action_space, num_envs)
+
+    def __call__(self, obs, ctx=None):
+        for c in self.connectors:
+            obs = c(obs, ctx)
+        return obs
+
+    def observe(self, actions, rewards):
+        for c in self.connectors:
+            if hasattr(c, "observe"):
+                c.observe(actions, rewards)
+
+    def reset(self, env_index: int):
+        for c in self.connectors:
+            c.reset(env_index)
+
+    def get_state(self) -> dict:
+        return {i: s for i, c in enumerate(self.connectors)
+                if (s := c.get_state()) is not None}
+
+    def set_state(self, state: dict):
+        for i, c in enumerate(self.connectors):
+            if i in state or str(i) in state:
+                c.set_state(state.get(i, state.get(str(i))))
+
+    def get_delta(self) -> dict:
+        return {i: d for i, c in enumerate(self.connectors)
+                if (d := c.get_delta()) is not None}
+
+    def merge_states(self, base: Optional[dict], deltas: List[dict]) -> dict:
+        """Piecewise merge: every stateful piece merges its base with all
+        runners' deltas (associative — order across runners is irrelevant)."""
+        out = {}
+        for i, c in enumerate(self.connectors):
+            piece_base = (base or {}).get(i)
+            piece_deltas = [d.get(i) for d in deltas if d and i in d]
+            if piece_base is not None or piece_deltas:
+                out[i] = type(c).merge(piece_base, piece_deltas)
+        return out
+
+
+class ModuleToEnvConnector:
+    def setup(self, observation_space, action_space, num_envs: int):
+        self._act_space = action_space
+
+    def __call__(self, actions: np.ndarray, ctx=None) -> np.ndarray:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class UnsquashActions(ModuleToEnvConnector):
+    """Map module actions from [-1, 1] to the Box action space's [low, high]
+    (reference: module_to_env normalize/unsquash). No-op for Discrete."""
+
+    def __call__(self, actions, ctx=None):
+        import gymnasium as gym
+
+        if not isinstance(self._act_space, gym.spaces.Box):
+            return actions
+        low = np.asarray(self._act_space.low, np.float32)
+        high = np.asarray(self._act_space.high, np.float32)
+        squashed = np.tanh(np.asarray(actions, np.float32))
+        return low + (squashed + 1.0) * 0.5 * (high - low)
+
+
+class ClipActions(ModuleToEnvConnector):
+    """Clip module actions into the Box action space's bounds (reference:
+    module_to_env clip_actions=True). No-op for Discrete."""
+
+    def __call__(self, actions, ctx=None):
+        import gymnasium as gym
+
+        if not isinstance(self._act_space, gym.spaces.Box):
+            return actions
+        return np.clip(
+            np.asarray(actions, np.float32),
+            self._act_space.low, self._act_space.high,
+        )
+
+
+class ModuleToEnvPipeline:
+    """Ordered module→env pieces applied to actions before env.step()
+    (reference: module_to_env/module_to_env_pipeline.py). The MODULE's raw
+    actions are what training sees (logp consistency); the transformed
+    actions are what the env executes."""
+
+    def __init__(self, connectors: Optional[List[ModuleToEnvConnector]] = None):
+        self.connectors = list(connectors or [])
+
+    def setup(self, observation_space, action_space, num_envs: int):
+        for c in self.connectors:
+            c.setup(observation_space, action_space, num_envs)
+
+    def __call__(self, actions, ctx=None):
+        for c in self.connectors:
+            actions = c(actions, ctx)
+        return actions
+
+
+def default_module_to_env_pipeline(action_space) -> ModuleToEnvPipeline:
+    """Reference default: clip Box actions into bounds."""
+    import gymnasium as gym
+
+    if isinstance(action_space, gym.spaces.Box):
+        return ModuleToEnvPipeline([ClipActions()])
+    return ModuleToEnvPipeline([])
+
+
+__all__ = [
+    "ClipActions",
+    "EnvConnector",
+    "EnvToModulePipeline",
+    "FlattenObservations",
+    "FrameStacking",
+    "MeanStdFilter",
+    "ModuleToEnvConnector",
+    "ModuleToEnvPipeline",
+    "PrevActionsPrevRewards",
+    "RunningStat",
+    "UnsquashActions",
+    "default_module_to_env_pipeline",
+]

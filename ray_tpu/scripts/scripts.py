@@ -348,6 +348,80 @@ def cmd_memory(_args):
     ray_tpu.shutdown()
 
 
+def cmd_serve_deploy(args):
+    """Apply a declarative serve config file (reference: `serve deploy`,
+    python/ray/serve/scripts.py:333). PUT semantics: the file is the whole
+    desired state."""
+    import yaml
+
+    import ray_tpu
+    from ray_tpu.serve import schema as serve_schema
+
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f)
+    _connect_from_file()
+    try:
+        outcomes = serve_schema.apply_config(config, wait_ready=args.wait)
+    except serve_schema.ServeConfigError as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        sys.exit(1)
+    for app, outcome in sorted(outcomes.items()):
+        print(f"{app}: {outcome}")
+    print(f"applied {args.config_file!r}; check progress with: "
+          "ray_tpu serve status")
+    ray_tpu.shutdown()
+
+
+def cmd_serve_status(_args):
+    """Live per-app/deployment status (reference: `serve status`,
+    python/ray/serve/scripts.py:696)."""
+    import yaml
+
+    import ray_tpu
+    from ray_tpu.serve import schema as serve_schema
+
+    _connect_from_file()
+    print(yaml.safe_dump(serve_schema.status_report(), sort_keys=False).rstrip())
+    ray_tpu.shutdown()
+
+
+def cmd_serve_build(args):
+    """Scaffold a deployable config from bound applications (reference:
+    `serve build`, python/ray/serve/scripts.py:814). Needs no cluster."""
+    import yaml
+
+    from ray_tpu.serve import schema as serve_schema
+
+    config = serve_schema.build_config(args.import_paths)
+    text = yaml.safe_dump(config, sort_keys=False)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {args.output}")
+    else:
+        print(text.rstrip())
+
+
+def cmd_serve_shutdown(_args):
+    import ray_tpu
+    from ray_tpu import serve
+
+    _connect_from_file()
+    serve.shutdown()
+    print("serve shut down")
+    ray_tpu.shutdown()
+
+
+def cmd_serve_delete(args):
+    import ray_tpu
+    from ray_tpu import serve
+
+    _connect_from_file()
+    serve.delete(args.name)
+    print(f"deleted application {args.name!r}")
+    ray_tpu.shutdown()
+
+
 def cmd_list(args):
     import ray_tpu
     from ray_tpu.util import state
@@ -521,6 +595,26 @@ def main(argv=None):
     pl = jsub.add_parser("logs")
     pl.add_argument("job_id")
     pl.set_defaults(fn=cmd_job_logs)
+
+    p = sub.add_parser("serve", help="declarative serving commands")
+    ssub = p.add_subparsers(dest="serve_command", required=True)
+    pd = ssub.add_parser("deploy", help="apply a serve config YAML")
+    pd.add_argument("config_file")
+    pd.add_argument("--wait", action="store_true",
+                    help="block until every application is ready")
+    pd.set_defaults(fn=cmd_serve_deploy)
+    ssub.add_parser("status", help="per-app deployment status").set_defaults(
+        fn=cmd_serve_status)
+    pb = ssub.add_parser("build", help="scaffold a config from applications")
+    pb.add_argument("import_paths", nargs="+",
+                    help="module:attr of bound Applications or builders")
+    pb.add_argument("-o", "--output", default=None)
+    pb.set_defaults(fn=cmd_serve_build)
+    ssub.add_parser("shutdown", help="tear down serve").set_defaults(
+        fn=cmd_serve_shutdown)
+    pdel = ssub.add_parser("delete", help="delete one application")
+    pdel.add_argument("name")
+    pdel.set_defaults(fn=cmd_serve_delete)
 
     p = sub.add_parser("client-proxy",
                        help="proxy ray_tpu+proxy:// clients into the cluster")

@@ -142,7 +142,16 @@ class ServeController:
         live = self._replicas.setdefault(app, {})
         # Redeploy: replicas built from changed code/args/config are stale — kill
         # them so reconcile rebuilds from the new blobs (a count-only reconcile
-        # would happily keep serving the old code).
+        # would happily keep serving the old code). SCALE fields (num_replicas /
+        # autoscaling_config) are explicitly not staleness: a declarative
+        # re-apply that only edits replica counts scales the live replica set
+        # in place via reconcile (reference: lightweight config updates,
+        # serve/_private/deployment_state.py).
+        import dataclasses as _dc
+
+        def _code_cfg(cfg):
+            return _dc.replace(cfg, num_replicas=1, autoscaling_config=None)
+
         for name, spec in deployments.items():
             if name == "__meta__":
                 continue
@@ -150,7 +159,7 @@ class ServeController:
             if prev is not None and (
                 prev["target_blob"] != spec["target_blob"]
                 or prev["init_blob"] != spec["init_blob"]
-                or prev["config"] != spec["config"]
+                or _code_cfg(prev["config"]) != _code_cfg(spec["config"])
             ):
                 for r in live.pop(name, []):
                     self._kill(r)

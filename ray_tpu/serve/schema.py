@@ -1,0 +1,320 @@
+"""Declarative Serve config: schema, validation, apply, build.
+
+Parity: reference `python/ray/serve/schema.py` (ServeDeploySchema →
+ServeApplicationSchema → DeploymentSchema) plus the operational halves of
+`python/ray/serve/scripts.py` `serve deploy` (:333), `serve status` (:696) and
+`serve build` (:814). The config file is the declarative source of truth:
+`apply_config` has PUT semantics — applications present in the live cluster
+but absent from the config are deleted, present ones are reconciled to the
+config's replica/autoscaling targets (idempotent re-apply), and new ones are
+imported and deployed.
+
+A config file looks like:
+
+```yaml
+applications:
+- name: default
+  route_prefix: /
+  import_path: my_module:app        # an Application or a builder callable
+  args: {model: gpt2}               # passed to a builder callable
+  deployments:                      # per-deployment overrides by name
+  - name: Model
+    num_replicas: 2
+    max_ongoing_requests: 32
+  - name: Tokenizer
+    autoscaling_config: {min_replicas: 1, max_replicas: 4}
+```
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class ServeConfigError(ValueError):
+    """Invalid declarative serve config."""
+
+
+@dataclass
+class DeploymentSchema:
+    """Per-deployment overrides (reference schema.py DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[Any] = None  # int | "auto"
+    max_ongoing_requests: Optional[int] = None
+    autoscaling_config: Optional[dict] = None
+    user_config: Optional[dict] = None
+    ray_actor_options: Optional[dict] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentSchema":
+        if not isinstance(d, dict) or "name" not in d:
+            raise ServeConfigError(f"deployment entry needs a name: {d!r}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ServeConfigError(
+                f"unknown deployment option(s) {sorted(unknown)} for "
+                f"{d['name']!r}; known: {sorted(known - {'name'})}"
+            )
+        return cls(**d)
+
+
+@dataclass
+class ServeApplicationSchema:
+    """One application (reference schema.py ServeApplicationSchema)."""
+
+    import_path: str
+    name: str = "default"
+    route_prefix: Optional[str] = "/"
+    args: Dict[str, Any] = field(default_factory=dict)
+    deployments: List[DeploymentSchema] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeApplicationSchema":
+        if not isinstance(d, dict) or "import_path" not in d:
+            raise ServeConfigError(
+                f"application entry needs an import_path: {d!r}"
+            )
+        if ":" not in d["import_path"]:
+            raise ServeConfigError(
+                f"import_path must be 'module:attribute', got "
+                f"{d['import_path']!r}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ServeConfigError(
+                f"unknown application option(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        deps = [DeploymentSchema.from_dict(x) for x in d.get("deployments", [])]
+        return cls(
+            import_path=d["import_path"],
+            name=d.get("name", "default"),
+            route_prefix=d.get("route_prefix", "/"),
+            args=d.get("args") or {},
+            deployments=deps,
+        )
+
+
+@dataclass
+class ServeDeploySchema:
+    """The whole declarative state (reference schema.py ServeDeploySchema)."""
+
+    applications: List[ServeApplicationSchema]
+    http_options: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeDeploySchema":
+        if not isinstance(d, dict) or "applications" not in d:
+            raise ServeConfigError("config needs a top-level 'applications' list")
+        apps = [ServeApplicationSchema.from_dict(a) for a in d["applications"]]
+        names = [a.name for a in apps]
+        if len(set(names)) != len(names):
+            raise ServeConfigError(f"duplicate application names in {names}")
+        prefixes = [a.route_prefix for a in apps if a.route_prefix is not None]
+        if len(set(prefixes)) != len(prefixes):
+            raise ServeConfigError(f"duplicate route_prefix in {prefixes}")
+        return cls(applications=apps, http_options=d.get("http_options") or {})
+
+
+def _import_target(import_path: str, args: dict):
+    """Resolve module:attr to an Application (calling a builder if needed)."""
+    mod_name, _, attr = import_path.partition(":")
+    if "" not in sys.path and "." not in sys.path:
+        sys.path.insert(0, ".")  # match the reference CLI's cwd import rule
+    mod = importlib.import_module(mod_name)
+    try:
+        target = getattr(mod, attr)
+    except AttributeError:
+        raise ServeConfigError(
+            f"{mod_name!r} has no attribute {attr!r}"
+        ) from None
+    from ray_tpu.serve import Application
+
+    if isinstance(target, Application):
+        if args:
+            raise ServeConfigError(
+                f"{import_path} is a bound Application; 'args' requires a "
+                "builder function"
+            )
+        return target
+    if callable(target):
+        app = target(args) if args else target()
+        if not isinstance(app, Application):
+            raise ServeConfigError(
+                f"builder {import_path} returned {type(app).__name__}, "
+                "expected an Application (did you forget .bind()?)"
+            )
+        return app
+    raise ServeConfigError(
+        f"{import_path} is neither an Application nor a builder callable"
+    )
+
+
+def _apply_overrides(acc: Dict[str, dict], overrides: List[DeploymentSchema],
+                     app_name: str):
+    """Mutate collected deployment specs with the schema's per-deployment
+    overrides; unknown deployment names are config errors (catching typos is
+    the point of a declarative file)."""
+    from ray_tpu.serve import AutoscalingConfig
+
+    for ov in overrides:
+        spec = acc.get(ov.name)
+        if spec is None:
+            raise ServeConfigError(
+                f"app {app_name!r} has no deployment {ov.name!r}; "
+                f"bound deployments: {sorted(acc)}"
+            )
+        cfg = spec["config"]
+        if ov.num_replicas is not None:
+            if ov.num_replicas == "auto":
+                cfg.autoscaling_config = (
+                    cfg.autoscaling_config or AutoscalingConfig()
+                )
+            elif isinstance(ov.num_replicas, int) and ov.num_replicas >= 1:
+                cfg.num_replicas = ov.num_replicas
+            else:
+                raise ServeConfigError(
+                    f"num_replicas must be a positive int or 'auto', got "
+                    f"{ov.num_replicas!r} for {ov.name!r}"
+                )
+        if ov.max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = int(ov.max_ongoing_requests)
+        if ov.autoscaling_config is not None:
+            cfg.autoscaling_config = AutoscalingConfig(**ov.autoscaling_config)
+        if ov.user_config is not None:
+            cfg.user_config = ov.user_config
+        if ov.ray_actor_options is not None:
+            cfg.ray_actor_options = ov.ray_actor_options
+
+
+def apply_config(config: dict, *, wait_ready: bool = False,
+                 timeout_s: float = 120.0) -> Dict[str, str]:
+    """Deploy a declarative config (PUT semantics). Returns {app: outcome}.
+
+    Outcomes: "deployed" (new or changed), "deleted" (live but absent from
+    the config). Re-applying an unchanged config is a no-op reconcile: the
+    controller sees the same specs and keeps its replicas.
+    """
+    import inspect as _inspect
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import _collect_deployments
+
+    schema = ServeDeploySchema.from_dict(config)
+    controller = serve.start(schema.http_options or None)
+    outcomes: Dict[str, str] = {}
+
+    live = set(ray_tpu.get(controller.list_apps.remote()))
+    wanted = {a.name for a in schema.applications}
+    for gone in sorted(live - wanted):
+        ray_tpu.get(controller.delete_app.remote(gone))
+        outcomes[gone] = "deleted"
+
+    for app_schema in schema.applications:
+        app = _import_target(app_schema.import_path, app_schema.args)
+        acc: Dict[str, dict] = {}
+        _collect_deployments(app, app_schema.name, acc)
+        _apply_overrides(acc, app_schema.deployments, app_schema.name)
+        ingress_name = app.deployment.name
+        target = app.deployment.target
+        call = (target if not _inspect.isclass(target)
+                else getattr(target, "__call__", None))
+        ingress_streaming = bool(
+            call is not None
+            and (_inspect.isgeneratorfunction(call)
+                 or _inspect.isasyncgenfunction(call))
+        )
+        ray_tpu.get(controller.deploy_app.remote(
+            app_schema.name, acc, app_schema.route_prefix, ingress_name,
+            ingress_streaming,
+        ))
+        outcomes[app_schema.name] = "deployed"
+
+    if wait_ready:
+        deadline = _time.monotonic() + timeout_s
+        pending = [a.name for a in schema.applications]
+        while pending and _time.monotonic() < deadline:
+            pending = [
+                n for n in pending
+                if not ray_tpu.get(controller.ready.remote(n))
+            ]
+            if pending:
+                _time.sleep(0.2)
+        if pending:
+            raise TimeoutError(f"applications not ready: {pending}")
+    return outcomes
+
+
+def status_report() -> dict:
+    """Declarative-shaped status: per app, per deployment, replica counts and
+    a coarse state (reference `serve status` output shape)."""
+    from ray_tpu import serve
+
+    apps = serve.status()
+    report: Dict[str, Any] = {"applications": {}}
+    for name, info in apps.items():
+        deps = {}
+        all_ready = True
+        for dname, d in info.get("deployments", {}).items():
+            target = d.get("target")
+            running = d.get("num_replicas", 0)
+            # Autoscaled deployments have target=None: running count is truth.
+            ready = target is None or running >= target
+            all_ready = all_ready and ready
+            deps[dname] = {
+                "status": "HEALTHY" if ready else "UPDATING",
+                "replica_states": {"RUNNING": running},
+                "target_num_replicas": target,
+            }
+        report["applications"][name] = {
+            "status": "RUNNING" if all_ready else "DEPLOYING",
+            "route_prefix": info.get("route_prefix"),
+            "deployments": deps,
+        }
+    return report
+
+
+def build_config(import_paths: List[str]) -> dict:
+    """Scaffold a config dict from bound applications (reference `serve
+    build`): imports each target and emits its deployment names with their
+    CURRENT config values, ready to edit and `serve deploy`."""
+    from ray_tpu.serve import _collect_deployments
+
+    apps_out = []
+    for i, path in enumerate(import_paths):
+        app = _import_target(path, {})
+        acc: Dict[str, dict] = {}
+        name = "default" if len(import_paths) == 1 else f"app{i + 1}"
+        _collect_deployments(app, name, acc)
+        deployments = []
+        for dname, spec in acc.items():
+            cfg = spec["config"]
+            entry: Dict[str, Any] = {"name": dname}
+            if cfg.num_replicas != 1:
+                entry["num_replicas"] = cfg.num_replicas
+            entry["max_ongoing_requests"] = cfg.max_ongoing_requests
+            if cfg.autoscaling_config is not None:
+                entry["autoscaling_config"] = dataclasses.asdict(
+                    cfg.autoscaling_config
+                )
+            if cfg.user_config:
+                entry["user_config"] = cfg.user_config
+            if cfg.ray_actor_options:
+                entry["ray_actor_options"] = cfg.ray_actor_options
+            deployments.append(entry)
+        apps_out.append({
+            "name": name,
+            "route_prefix": "/" if i == 0 else f"/app{i + 1}",
+            "import_path": path,
+            "deployments": deployments,
+        })
+    return {"applications": apps_out}

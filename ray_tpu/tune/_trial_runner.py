@@ -324,6 +324,19 @@ class TuneController:
         self._stop_trial(trial, PAUSED)
         trial.restore_checkpoint = trial.latest_checkpoint
         trial.start_iteration = _checkpoint_iteration(trial.latest_checkpoint)
+        # The resumed actor replays iterations PAST the checkpoint (from 1 if
+        # the trainable never checkpointed): drop recorded results the replay
+        # will re-report so trial.results holds each iteration exactly once.
+        k = trial.start_iteration
+        if trial.restore_checkpoint is None:
+            logger.warning(
+                "Pausing trial %s which has no checkpoint; it will rerun "
+                "from iteration 1 on resume.", trial.trial_id,
+            )
+        trial.results = [
+            r for r in trial.results if r.get("training_iteration", 0) <= k
+        ]
+        trial.last_result = dict(trial.results[-1]) if trial.results else {}
 
     def unpause_trial(self, trial: Trial):
         if trial.status == PAUSED:

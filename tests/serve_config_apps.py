@@ -1,0 +1,48 @@
+"""Importable applications for the declarative serve-config tests.
+
+`serve deploy` resolves `import_path: "tests.serve_config_apps:<attr>"` against
+this module — a bound Application (`app`) and a builder callable
+(`build_app`), matching the two target kinds the reference CLI accepts.
+"""
+
+import os
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x: int) -> int:
+        return x * 2
+
+    def pid(self) -> int:
+        return os.getpid()
+
+
+@serve.deployment
+class Gateway:
+    def __init__(self, doubler):
+        self._doubler = doubler
+
+    def __call__(self, x: int) -> int:
+        return self._doubler.remote(x).result() + 1
+
+    def pids(self) -> int:
+        return os.getpid()
+
+
+app = Gateway.bind(Doubler.bind())
+
+
+@serve.deployment
+class Echo:
+    def __init__(self, prefix: str = "echo"):
+        self._prefix = prefix
+
+    def __call__(self, x) -> str:
+        return f"{self._prefix}:{x}"
+
+
+def build_app(args=None):
+    args = args or {}
+    return Echo.bind(args.get("prefix", "echo"))

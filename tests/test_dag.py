@@ -190,18 +190,119 @@ def test_input_passed_twice():
         compiled.teardown()
 
 
-def test_teardown_with_blocked_writer():
+def test_teardown_with_inflight_executions():
+    @ray_tpu.remote
+    class Slow:
+        def work(self, x):
+            time.sleep(3.0)
+            return x
+
+    w = Slow.remote()
+    with InputNode() as inp:
+        dag = w.work.bind(inp)
+    # Rings are sized to max_inflight (reference: num_shm_buffers =
+    # max_inflight_executions), so a bound-respecting driver can't wedge a
+    # writer; teardown safety is exercised with the loop mid-compute and
+    # unconsumed results in flight.
+    compiled = dag.experimental_compile(max_inflight_executions=4)
+    try:
+        for i in range(4):
+            compiled.execute(i)
+        time.sleep(0.2)  # loop is inside work() with 3 more queued
+    finally:
+        compiled.teardown()  # must not hang or leave the actor wedged
+
+
+
+def test_max_inflight_capacity_raises():
+    """Past max_inflight_executions, execute() raises instead of wedging
+    (reference compiled_dag_node.py:2223 RayCgraphCapacityExceeded)."""
+    from ray_tpu.exceptions import RayCgraphCapacityExceeded
+
     w = Worker.remote()
     with InputNode() as inp:
         dag = w.inc.bind(inp)
-    compiled = dag.experimental_compile()
+    compiled = dag.experimental_compile(max_inflight_executions=2)
     try:
-        # Fill the output ring without consuming: the pinned loop ends up blocked
-        # in a channel write; teardown must still stop it.
-        for i in range(8):
-            compiled.execute(i)
+        r0 = compiled.execute(0)
+        compiled.execute(1)
+        with pytest.raises(RayCgraphCapacityExceeded):
+            compiled.execute(2)
+        assert r0.get(timeout=60) == 1  # consuming a result frees a slot
+        r2 = compiled.execute(2)
+        assert r2.get(timeout=60) == 3
     finally:
-        compiled.teardown()  # must not hang or leave the actor wedged
+        compiled.teardown()
+
+
+def test_execute_async_overlaps_inflight():
+    """execute_async pipelines: the second submission lands while the first
+    result is still unread, and awaiting runs off the event loop — a
+    concurrent ticker task keeps ticking while results are pending
+    (reference compiled_dag_node.py execute_async :2627)."""
+    import asyncio
+
+    @ray_tpu.remote
+    class Paced:
+        def work(self, x):
+            time.sleep(0.4)
+            return x * 10
+
+    w = Paced.remote()
+    with InputNode() as inp:
+        dag = w.work.bind(inp)
+    compiled = dag.experimental_compile(max_inflight_executions=4)
+
+    async def drive():
+        ticks = 0
+        stop = asyncio.Event()
+
+        async def ticker():
+            nonlocal ticks
+            while not stop.is_set():
+                ticks += 1
+                await asyncio.sleep(0.02)
+
+        t = asyncio.create_task(ticker())
+        t0 = time.monotonic()
+        f1 = await compiled.execute_async(1)
+        f2 = await compiled.execute_async(2)  # in flight before f1 is read
+        submit_time = time.monotonic() - t0
+        v1 = await f1
+        v2 = await f2
+        stop.set()
+        await t
+        return submit_time, v1, v2, ticks
+
+    try:
+        submit_time, v1, v2, ticks = asyncio.run(drive())
+        assert (v1, v2) == (10, 20)
+        # Submissions don't wait for results (two 0.4s computes pending).
+        assert submit_time < 0.3, f"submit blocked: {submit_time:.2f}s"
+        # The event loop stayed live while ~0.8s of compute drained.
+        assert ticks >= 10, f"event loop starved: {ticks} ticks"
+    finally:
+        compiled.teardown()
+
+
+def test_execute_async_error_propagates():
+    import asyncio
+
+    w = Worker.remote()
+    with InputNode() as inp:
+        dag = w.boom.bind(inp)
+    compiled = dag.experimental_compile()
+
+    async def drive():
+        fut = await compiled.execute_async(1)
+        with pytest.raises(ValueError, match="dag boom"):
+            await fut
+
+    try:
+        asyncio.run(drive())
+    finally:
+        compiled.teardown()
+
 
 
 def test_collective_allreduce_node():
@@ -357,7 +458,7 @@ def test_compiled_dag_overlap_and_profiling():
         a, b = Producer.remote(), Consumer.remote()
         with InputNode() as inp:
             out = b.work.bind(a.slow.bind(inp))
-        dag = out.experimental_compile()
+        dag = out.experimental_compile(max_inflight_executions=16)
         try:
             assert dag.execute(0).get(timeout=120) == 1  # warm both loops
             K = 12
