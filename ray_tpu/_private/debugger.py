@@ -1,0 +1,185 @@
+"""Post-mortem task debugging over a socket.
+
+Design parity: reference `python/ray/util/rpdb.py` (RemotePdb: a pdb bound to
+a TCP socket, sessions advertised through the GCS, `ray debug` attaches) +
+the `RAY_DEBUG_POST_MORTEM` trigger. Here: when a task raises and
+RAY_TPU_POST_MORTEM=1, the worker PARKS the failing frame — it opens a
+listening socket, registers {task, host, port, error} in the GCS KV under the
+"debug_sessions" namespace, and blocks the failing task until a debugger
+attaches (or a wait budget expires), then lets the error propagate normally.
+`ray_tpu debug` lists the advertised sessions and bridges the operator's
+terminal to the worker's pdb.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import time
+
+KV_NS = "debug_sessions"
+ENV_FLAG = "RAY_TPU_POST_MORTEM"
+ENV_WAIT = "RAY_TPU_POST_MORTEM_WAIT_S"
+
+# At most ONE parked session per worker process: each park blocks a
+# task-executor thread, and a correlated failure wave (bad batch, missing
+# module) parking every executor thread would stall HEALTHY tasks for the
+# whole wait budget. Further failures while parked propagate immediately.
+import threading as _threading
+
+_park_slot = _threading.Semaphore(1)
+
+
+def post_mortem_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "no")
+
+
+def park_post_mortem(worker, spec, exc: BaseException) -> bool:
+    """Advertise a debug session for the failing task and block until a
+    debugger drives pdb over the socket (returns True) or the wait budget
+    expires (returns False). Runs on the task-executor thread, so the task's
+    reply — and its error — are delayed exactly as long as the operator
+    debugs; every other worker thread keeps serving."""
+    tb = exc.__traceback__
+    if tb is None:
+        return False
+    if not _park_slot.acquire(blocking=False):
+        return False  # another task is already parked on this worker
+    try:
+        return _park_locked(worker, spec, exc, tb)
+    finally:
+        _park_slot.release()
+
+
+def _park_locked(worker, spec, exc, tb) -> bool:
+    task_hex = spec["task_id"].hex()
+    srv = socket.create_server(("", 0))
+    port = srv.getsockname()[1]
+    info = {
+        "task_id": task_hex,
+        "name": spec.get("name"),
+        "ip": getattr(worker, "node_ip", None) or "127.0.0.1",
+        "port": port,
+        "pid": os.getpid(),
+        "error": repr(exc),
+        "time": time.time(),
+    }
+    try:
+        worker.gcs_kv_put(KV_NS, task_hex.encode(), json.dumps(info).encode())
+    except Exception:
+        srv.close()
+        return False
+    srv.settimeout(float(os.environ.get(ENV_WAIT, "120")))
+    attached = False
+    try:
+        try:
+            conn, _addr = srv.accept()
+        except (socket.timeout, OSError):
+            return False
+        fh = conn.makefile("rw")
+        try:
+            fh.write(
+                f"*** ray_tpu post-mortem: task {spec.get('name')!r} "
+                f"({task_hex}) raised {exc!r}\n"
+                "*** you are at the raising frame; `up`/`p`/`pp` to inspect, "
+                "`c` or `q` to release the task error\n"
+            )
+            fh.flush()
+            dbg = pdb.Pdb(stdin=fh, stdout=fh)
+            dbg.use_rawinput = False
+            dbg.prompt = "(ray_tpu-pdb) "
+            dbg.reset()
+            dbg.interaction(None, tb)
+            attached = True
+        except Exception:
+            pass  # a dropped connection must never mask the task's own error
+        finally:
+            try:
+                fh.close()
+                conn.close()
+            except Exception:
+                pass
+        return attached
+    finally:
+        try:
+            worker.gcs_call("kv_del", KV_NS, task_hex.encode())
+        except Exception:
+            pass
+        srv.close()
+
+
+def list_sessions(worker) -> list[dict]:
+    """Advertised parked sessions, newest first. A SIGKILLed worker never
+    runs its kv_del, so entries can be stale — attach() raises
+    ConnectionError for those and drop_session() cleans them up (the CLI
+    does both); listings are advertisements, not liveness proofs."""
+    out = []
+    try:
+        keys = worker.gcs_call("kv_keys", KV_NS, b"")
+    except Exception:
+        return out
+    for key in keys:
+        try:
+            raw = worker.gcs_kv_get(KV_NS, bytes(key))
+            if raw:
+                out.append(json.loads(bytes(raw).decode()))
+        except Exception:
+            continue
+    out.sort(key=lambda s: -s.get("time", 0.0))
+    return out
+
+
+def drop_session(worker, session: dict) -> None:
+    """Remove a (stale) session advertisement."""
+    try:
+        worker.gcs_call("kv_del", KV_NS, session["task_id"].encode())
+    except Exception:
+        pass
+
+
+def attach(session: dict, stdin=None, stdout=None) -> None:
+    """Bridge a terminal (or test harness streams) to a parked session's pdb."""
+    import sys
+
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    with socket.create_connection((session["ip"], session["port"]),
+                                  timeout=30) as conn:
+        conn_f = conn.makefile("rw")
+        try:
+            # Reader thread: worker pdb output -> stdout; main thread:
+            # stdin -> worker. EOF on either side ends the bridge.
+            import threading
+
+            done = threading.Event()
+
+            def pump_out():
+                try:
+                    while True:
+                        chunk = conn_f.readline()
+                        if not chunk:
+                            break
+                        stdout.write(chunk)
+                        stdout.flush()
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=pump_out, daemon=True)
+            t.start()
+            while not done.is_set():
+                line = stdin.readline()
+                if not line:
+                    break
+                try:
+                    conn_f.write(line)
+                    conn_f.flush()
+                except (OSError, ValueError):
+                    break
+            done.wait(timeout=5)
+        finally:
+            try:
+                conn_f.close()
+            except Exception:
+                pass

@@ -1026,6 +1026,7 @@ class GcsService:
         Trimming drops whole chunks from memory AND the store, so the log
         cannot grow unboundedly."""
         self.task_events.extend(events)
+        self._index_task_events(events)
         self._export_events("task", events)
         self._task_events_total += len(events)
         self._task_event_seq += 1
@@ -1040,12 +1041,83 @@ class GcsService:
                 break  # only whole chunks are dropped; a little slack is fine
             self._task_event_chunks.popleft()
             self.store.delete("task_events", old_seq)
+            for e in self.task_events[:count]:
+                self._unindex_task_event(e)
             del self.task_events[:count]
             excess -= count
         return True
 
-    async def rpc_list_task_events(self, conn, limit: int = 1000):
-        return self.task_events[-limit:]
+    def _index_task_events(self, events: list):
+        """Per-task index (references into the retained log): get_task and
+        task_id-filtered listings serve straight from it instead of scanning
+        the retention window."""
+        idx = getattr(self, "_task_event_index", None)
+        if idx is None:
+            idx = self._task_event_index = {}
+            for e in self.task_events[:-len(events) or None]:
+                tid = e.get("task_id")
+                if tid is not None:
+                    idx.setdefault(tid, []).append(e)
+        for e in events:
+            tid = e.get("task_id")
+            if tid is not None:
+                idx.setdefault(tid, []).append(e)
+
+    def _unindex_task_event(self, e: dict):
+        idx = getattr(self, "_task_event_index", None)
+        tid = e.get("task_id")
+        if idx is None or tid is None:
+            return
+        lst = idx.get(tid)
+        if lst:
+            # Trims drop the oldest events log-wide; within one task's list
+            # that is always the head.
+            if lst[0] is e:
+                lst.pop(0)
+            else:  # restored-from-store objects: fall back to equality
+                try:
+                    lst.remove(e)
+                except ValueError:
+                    pass
+            if not lst:
+                del idx[tid]
+
+    @staticmethod
+    def _event_pred(filters):
+        """The state API's filter predicates, evaluated server-side
+        (reference: GcsTaskManager filters, gcs_task_manager.h — the query
+        is pushed down so `ray_tpu list tasks -f k=v` never ships the whole
+        retention window). Shared with the client via state_filters so both
+        sides always compare identically."""
+        from ray_tpu._private.state_filters import build_predicate
+
+        return build_predicate(filters)
+
+    async def rpc_list_task_events(self, conn, limit: int = 1000,
+                                   filters=None, offset: int = 0,
+                                   task_id=None):
+        if task_id is not None:
+            if getattr(self, "_task_event_index", None) is None:
+                self._index_task_events([])
+            rows = list(self._task_event_index.get(task_id, ()))
+            if filters:
+                match = self._event_pred(filters)
+                rows = [e for e in rows if match(e)]
+            return rows[offset:offset + limit] if limit else rows[offset:]
+        if not filters and not offset:
+            return self.task_events[-limit:] if limit else list(self.task_events)
+        # Streamed filter scan with early exit: collect offset+limit matches
+        # in log order and stop — matching pages never require materializing
+        # (or shipping) the whole retention window.
+        match = self._event_pred(filters or ())
+        out = []
+        want = offset + limit if limit else None
+        for e in self.task_events:
+            if match(e):
+                out.append(e)
+                if want is not None and len(out) >= want:
+                    break
+        return out[offset:]
 
     async def rpc_task_event_stats(self, conn):
         """Cheap counters for samplers (no event payloads cross the wire)."""

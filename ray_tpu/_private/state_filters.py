@@ -1,0 +1,49 @@
+"""Shared state-API filter predicates.
+
+One implementation serves both sides: the client (`ray_tpu.util.state`
+filtering nodes/actors it already fetched) and the GCS (pushing task-event
+filters down to the server) — so tasks vs actors/nodes can never drift to
+different comparison semantics. Parity: reference
+python/ray/util/state/common.py predicate set (=/!= plus comparisons).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+OPS = {
+    "=": lambda a, b: a == b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def coerce_pair(a: Any, b: Any):
+    """Compare numerically when both sides parse as numbers, else as strings
+    (entity fields arrive as heterogeneous python values)."""
+    try:
+        return float(a), float(b)
+    except (TypeError, ValueError):
+        return str(a), str(b)
+
+
+def build_predicate(filters: Iterable) -> Callable[[dict], bool]:
+    """Compile (key, op, value) triples into one row predicate; raises
+    ValueError on an unknown operator."""
+    compiled = []
+    for key, op, value in filters:
+        if op not in OPS:
+            raise ValueError(
+                f"unsupported filter op {op!r}; one of {sorted(OPS)}"
+            )
+        compiled.append((key, OPS[op], value))
+
+    def match(row: dict) -> bool:
+        return all(pred(*coerce_pair(row.get(key), value))
+                   for key, pred, value in compiled)
+
+    return match

@@ -2797,6 +2797,18 @@ class CoreWorker:
                 results = self._package_results(spec, result)
             state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - report any user failure to the owner
+            from ray_tpu._private import debugger
+
+            if debugger.post_mortem_enabled():
+                # Park the failing frame: advertise a debug session and block
+                # this task (only this task) until an operator's `ray_tpu
+                # debug` drives pdb over the socket, or the wait expires;
+                # the error then propagates exactly as it would have
+                # (reference: RAY_DEBUG_POST_MORTEM + util/rpdb.py).
+                try:
+                    debugger.park_post_mortem(self, spec, e)
+                except Exception:
+                    pass
             if spec.get("num_returns") == "streaming":
                 # Pre-iteration failure (fn load / arg materialization): the
                 # stream must still terminate with an error ref, not hang.

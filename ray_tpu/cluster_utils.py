@@ -13,6 +13,33 @@ import ray_tpu
 from ray_tpu._private import node as node_mod
 
 
+def _descendant_pids(root_pid: int) -> list[int]:
+    """All live descendant pids of root_pid (linux /proc scan): a raylet's
+    workers (and their children) die WITH the node under kill_node."""
+    import os
+
+    children: dict[int, list[int]] = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                stat = f.read()
+            # Field 4 (ppid) follows the parenthesized comm, which may itself
+            # contain spaces/parens: split after the LAST ')'.
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        children.setdefault(ppid, []).append(int(entry))
+    out: list[int] = []
+    stack = [root_pid]
+    while stack:
+        for kid in children.get(stack.pop(), ()):
+            out.append(kid)
+            stack.append(kid)
+    return out
+
+
 class Cluster:
     def __init__(
         self,
@@ -80,6 +107,30 @@ class Cluster:
         if node in self.worker_nodes:
             self.worker_nodes.remove(node)
         node.terminate()
+
+    def kill_node(self, node: node_mod.NodeProcess):
+        """SIGKILL a worker NODE — the raylet and every worker process it
+        spawned, no graceful shutdown. The GCS must detect the death through
+        missed health checks and the cluster must recover (reference:
+        python/ray/_private/test_utils.py:1479 RayletKiller /
+        python/ray/tests/chaos/). remove_node() is the polite path; this is
+        the chaos path."""
+        import os
+        import signal
+
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+        raylet_pid = node.proc.pid
+        victims = _descendant_pids(raylet_pid)
+        for pid in [raylet_pid] + victims:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            node.proc.wait(timeout=5)
+        except Exception:
+            pass
 
     def wait_for_nodes(self, timeout: float = 30.0):
         expect = 1 + len(self.worker_nodes)

@@ -348,6 +348,55 @@ def cmd_memory(_args):
     ray_tpu.shutdown()
 
 
+def cmd_debug(args):
+    """Attach to a parked post-mortem session (reference: `ray debug`,
+    python/ray/scripts/scripts.py:239 + util/rpdb.py). Workers park failing
+    tasks when RAY_TPU_POST_MORTEM=1; this lists the advertised sessions and
+    bridges this terminal to the chosen worker's pdb."""
+    import ray_tpu
+    from ray_tpu._private import debugger
+    from ray_tpu._private.worker import global_worker
+
+    _connect_from_file()
+    try:
+        sessions = debugger.list_sessions(global_worker())
+        if not sessions:
+            print("no active post-mortem sessions (set RAY_TPU_POST_MORTEM=1 "
+                  "on workers to park failing tasks)")
+            return
+        if args.task_id:
+            chosen = next(
+                (s for s in sessions if s["task_id"].startswith(args.task_id)),
+                None,
+            )
+            if chosen is None:
+                print(f"no session matching task id {args.task_id!r}",
+                      file=sys.stderr)
+                sys.exit(1)
+        else:
+            for i, s in enumerate(sessions):
+                print(f"[{i}] task {s['task_id'][:16]} {s.get('name')!r} "
+                      f"pid={s.get('pid')} error={s.get('error')}")
+            if len(sessions) == 1:
+                chosen = sessions[0]
+            else:
+                idx = int(input("attach to which session? "))
+                chosen = sessions[idx]
+        print(f"attaching to task {chosen['task_id'][:16]} at "
+              f"{chosen['ip']}:{chosen['port']} (q or c to detach)")
+        try:
+            debugger.attach(chosen)
+        except OSError as e:
+            # SIGKILLed (or already-released) workers never deregister their
+            # advertisement: clean the ghost up instead of tracebacking.
+            debugger.drop_session(global_worker(), chosen)
+            print(f"session is gone ({e}); removed the stale advertisement",
+                  file=sys.stderr)
+            sys.exit(1)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_serve_deploy(args):
     """Apply a declarative serve config file (reference: `serve deploy`,
     python/ray/serve/scripts.py:333). PUT semantics: the file is the whole
@@ -595,6 +644,12 @@ def main(argv=None):
     pl = jsub.add_parser("logs")
     pl.add_argument("job_id")
     pl.set_defaults(fn=cmd_job_logs)
+
+    p = sub.add_parser("debug",
+                       help="attach pdb to a parked post-mortem task")
+    p.add_argument("task_id", nargs="?", default=None,
+                   help="task id (prefix) to attach to")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("serve", help="declarative serving commands")
     ssub = p.add_subparsers(dest="serve_command", required=True)

@@ -17,38 +17,18 @@ def _gcs(*args):
     return ray_tpu.global_worker().gcs_call(*args)
 
 
-def _coerce_pair(a: Any, b: Any):
-    """Compare numerically when both sides parse as numbers, else as strings
-    (entity fields arrive as heterogeneous python values)."""
-    try:
-        return float(a), float(b)
-    except (TypeError, ValueError):
-        return str(a), str(b)
-
-
-_OPS = {
-    "=": lambda a, b: a == b,
-    "==": lambda a, b: a == b,
-    "!=": lambda a, b: a != b,
-    "<": lambda a, b: a < b,
-    "<=": lambda a, b: a <= b,
-    ">": lambda a, b: a > b,
-    ">=": lambda a, b: a >= b,
-}
+from ray_tpu._private.state_filters import build_predicate
 
 
 def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
     """Filter triples (key, op, value) with the reference's predicate set
-    (python/ray/util/state/common.py supports =/!= plus comparisons)."""
-    for key, op, value in filters or ():
-        try:
-            pred = _OPS[op]
-        except KeyError:
-            raise ValueError(
-                f"unsupported filter op {op!r}; one of {sorted(_OPS)}"
-            ) from None
-        rows = [r for r in rows if pred(*_coerce_pair(r.get(key), value))]
-    return rows
+    (python/ray/util/state/common.py supports =/!= plus comparisons). The
+    predicate implementation is shared with the GCS's pushed-down task-event
+    query (ray_tpu/_private/state_filters.py)."""
+    if not filters:
+        return rows
+    match = build_predicate(filters)
+    return [r for r in rows if match(r)]
 
 
 def _paginate(rows: List[Dict[str, Any]], limit: Optional[int], offset: int):
@@ -82,15 +62,19 @@ def get_actor(actor_id_hex: str) -> Optional[Dict[str, Any]]:
 
 def list_tasks(*, limit: Optional[int] = 1000, filters=None,
                offset: int = 0) -> List[Dict[str, Any]]:
-    fetch = 100_000 if (filters or offset) else (limit or 100_000)
-    events = _apply_filters(_gcs("list_task_events", fetch), filters)
-    return _paginate(events, limit, offset)
+    """Filters and pagination are PUSHED DOWN to the GCS (round 5): a
+    filtered `ray_tpu list tasks` scans server-side with early exit and
+    ships only the matching page, instead of fetching the whole retention
+    window into the client (reference: GcsTaskManager query filters)."""
+    if limit is not None and limit <= 0:
+        return []  # the wire encodes "no limit" as 0; an explicit 0 is empty
+    return _gcs("list_task_events", limit or 0, list(filters or ()), offset)
 
 
 def get_task(task_id_hex: str) -> List[Dict[str, Any]]:
-    """Per-entity drill-down: every recorded event of one task, time-ordered."""
-    events = [e for e in _gcs("list_task_events", 100_000)
-              if e.get("task_id") == task_id_hex]
+    """Per-entity drill-down: every recorded event of one task, time-ordered,
+    served from the GCS's per-task index."""
+    events = _gcs("list_task_events", 0, None, 0, task_id_hex)
     return sorted(events, key=lambda e: e.get("time", 0.0))
 
 

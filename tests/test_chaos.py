@@ -153,3 +153,172 @@ def test_restartable_actor_pipeline_survives_kills(chaos_cluster):
         killer.join(timeout=5)
     assert killer.kills >= 2
     assert len(pids) >= 2, "actor was never actually restarted"
+
+
+# ---------------------------------------------------------------- node chaos
+
+_NODE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+def test_tasks_survive_node_kill():
+    """SIGKILL a whole worker NODE (raylet + its workers) mid-wave: retriable
+    tasks that were running there re-execute elsewhere and every result is
+    still correct (reference: RayletKiller chaos,
+    python/ray/_private/test_utils.py:1479)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    n2 = cluster.add_node(num_cpus=2, env_vars=_NODE_ENV)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    try:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        @ray_tpu.remote(max_retries=10)
+        def work(i):
+            time.sleep(0.3)
+            return i * i, ray_tpu.get_runtime_context().get_node_id()
+
+        n2_id = next(n["node_id"] for n in ray_tpu.nodes()
+                     if n["node_id"].hex() == n2.node_id_hex)
+        # SOFT affinity to node 2: tasks start there, and their retries may
+        # reschedule anywhere once the node is gone.
+        on_n2 = work.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(n2_id, soft=True)
+        )
+
+        # Warm a first wave and require node 2 to actually execute work —
+        # otherwise killing it proves nothing.
+        first = ray_tpu.get([on_n2.remote(i) for i in range(4)], timeout=300)
+        nodes_seen = {n.hex() for _v, n in first}
+        assert n2.node_id_hex in nodes_seen, f"work never ran on node 2: {nodes_seen}"
+
+        # Launch a big wave biased onto node 2, then kill the node while much
+        # of it is in flight.
+        refs = [(on_n2 if i % 2 else work).remote(i) for i in range(40)]
+        time.sleep(0.8)  # several tasks are mid-sleep on n2 right now
+        cluster.kill_node(n2)
+        out = ray_tpu.get(refs, timeout=300)
+        assert sorted(v for v, _n in out) == sorted(i * i for i in range(40))
+        # Everything after the kill ran on the surviving node(s).
+        alive = {n["node_id"].hex() for n in ray_tpu.nodes() if n["alive"]}
+        assert n2.node_id_hex not in alive
+    finally:
+        cluster.shutdown()
+
+
+def test_elastic_trainer_survives_node_kill_and_reexpands(tmp_path):
+    """An elastic JaxTrainer run loses a NODE to SIGKILL mid-attempt, resumes
+    at N-1, then re-expands to full size IN THE SAME RUN once capacity
+    returns (reference: chaos suite + elastic scaling policy)."""
+    import os
+    import threading
+
+    from ray_tpu import train
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    cluster.add_node(num_cpus=1, resources={"trainslot": 1.0},
+                     env_vars=_NODE_ENV)
+    n2 = cluster.add_node(num_cpus=1, resources={"trainslot": 1.0},
+                          env_vars=_NODE_ENV)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    marker_dir = str(tmp_path)
+    try:
+        def loop(config):
+            import os as _os
+
+            ctx = train.get_context()
+            world = ctx.get_world_size()
+            rank = ctx.get_world_rank()
+            mk = config["markers"]
+            open(_os.path.join(mk, f"started_{world}_{rank}"), "w").write("x")
+            if world == 2 and not _os.path.exists(
+                _os.path.join(mk, "expanded")
+            ):
+                # First full-size attempt: park until the driver SIGKILLs a
+                # node out from under one of us.
+                time.sleep(600)
+            if world == 1:
+                # Shrunk attempt: wait for the driver to restore capacity,
+                # then fail ONCE so the elastic policy re-evaluates and
+                # re-expands the SAME run.
+                deadline = time.monotonic() + 240
+                while not _os.path.exists(_os.path.join(mk, "capacity_back")):
+                    if time.monotonic() > deadline:
+                        break
+                    time.sleep(0.5)
+                open(_os.path.join(mk, "expanded"), "w").write("x")
+                raise RuntimeError("chaos: trigger elastic re-expansion")
+            train.report({"world": world, "rank": rank})
+
+        trainer = JaxTrainer(
+            loop,
+            train_loop_config={"markers": marker_dir},
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1, use_tpu=False,
+                resources_per_worker={"trainslot": 1.0},
+            ),
+            run_config=RunConfig(
+                name="node-chaos", storage_path=str(tmp_path / "storage"),
+                failure_config=FailureConfig(max_failures=4),
+            ),
+        )
+
+        result_box = {}
+
+        def fit():
+            result_box["result"] = trainer.fit()
+
+        t = threading.Thread(target=fit)
+        t.start()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if len([f for f in os.listdir(marker_dir)
+                    if f.startswith("started_2_")]) >= 2:
+                break
+            time.sleep(0.2)
+        assert len([f for f in os.listdir(marker_dir)
+                    if f.startswith("started_2_")]) >= 2
+
+        cluster.kill_node(n2)  # SIGKILL raylet + its workers, mid-attempt
+
+        # The run shrinks to world 1; then we restore capacity.
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if any(f.startswith("started_1_") for f in os.listdir(marker_dir)):
+                break
+            time.sleep(0.5)
+        assert any(f.startswith("started_1_") for f in os.listdir(marker_dir)), (
+            "run never resumed at N-1 after the node kill"
+        )
+        cluster.add_node(num_cpus=1, resources={"trainslot": 1.0},
+                         env_vars=_NODE_ENV)
+        cluster.wait_for_nodes()
+        open(os.path.join(marker_dir, "capacity_back"), "w").write("x")
+
+        t.join(timeout=420)
+        assert not t.is_alive(), "trainer did not finish after node chaos"
+        result = result_box["result"]
+        assert result.error is None, result.error
+        # The final attempt re-expanded to the full world size.
+        assert result.metrics["world"] == 2
+    finally:
+        cluster.shutdown()
