@@ -208,6 +208,32 @@ def main():
             t = scan_time(attnbwd_body, q, iters=16)
             res["attn_fwdbwd_ms_x12"] = 12e3 * t
 
+        if "attnbhsd" in want:
+            # Transpose-free layout: same kernel, operands already [B,H,S,D].
+            from ray_tpu.ops.attention import flash_attention_bhsd
+
+            k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+            qh = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+            kh = jax.random.normal(k2, (B, H, S, D), jnp.bfloat16)
+            vh = jax.random.normal(k3, (B, H, S, D), jnp.bfloat16)
+
+            def bhsd_body(qh):
+                return flash_attention_bhsd(qh, kh, vh, True)
+
+            t = scan_time(bhsd_body, qh, iters=24)
+            res["attnbhsd_fwd_ms_x12"] = 12e3 * t
+
+            def bhsd_loss(qh):
+                return jnp.sum(flash_attention_bhsd(qh, kh, vh, True)
+                               .astype(jnp.float32))
+
+            def bhsd_bwd_body(qh):
+                g = jax.grad(bhsd_loss)(qh)
+                return qh + 0.0 * g.astype(qh.dtype)
+
+            t = scan_time(bhsd_bwd_body, qh, iters=16)
+            res["attnbhsd_fwdbwd_ms_x12"] = 12e3 * t
+
         if "attnlib" in want:
             # The jax-shipped tuned TPU flash kernel (public pallas ops), as a
             # candidate replacement for ops/attention.py's custom kernel.

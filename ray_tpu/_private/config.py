@@ -49,6 +49,8 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "lease_worker_slots": (int, 32, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker); deep pipelines coalesce submit bursts into few large frames"),
     "lease_pipeline_min_depth": (int, 2, "starting per-worker pipeline depth for the lease fast path; lease denials ramp it toward lease_worker_slots"),
     "borrow_audit_interval_s": (float, 30.0, "how often owners audit registered borrowers for liveness (crashed borrowers are reconciled)"),
+    "borrow_audit_strikes": (int, 3, "consecutive not-held audit verdicts before a live borrower's lost-release entry is reconciled away"),
+    "borrow_audit_min_age_s": (float, 2.0, "minimum wall-clock age of a not-held entry before reconciliation (protects slow in-flight handoffs)"),
     "test_delay_borrow_report_ms": (int, 0, "fault injection: delay legacy borrow-report notifies by this long (stress the sequenced protocol)"),
     # --- logging / observability ---
     "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
@@ -56,9 +58,19 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "metrics_report_interval_s": (float, 5.0, "metrics push interval"),
     "gcs_max_task_events": (int, 100000, "task events retained by the GCS before the oldest half is dropped (reference: task_events_max_num_task_in_gcs)"),
     "export_events_dir": (str, "", "when set, the GCS appends structured JSONL export events (tasks/actors/nodes/placement groups) under this directory (reference: export_*.proto + ray_event_recorder)"),
+    "gcs_export_queue_size": (int, 1024, "bounded queue between the GCS loop and the export-event writer thread; overflow sheds oldest batches"),
+    "gcs_store_fsync_window_s": (float, 0.01, "group-commit window: one fsync covers every GCS store append in the window (RAY_TPU_GCS_STORE_FSYNC picks the mode: always|group|off)"),
+    "gcs_store_compact_threshold": (int, 50000, "rewrite the GCS append log once it holds this many records"),
+    "log_dedup_window_s": (float, 5.0, "repeat window for driver-side worker-log deduplication summaries"),
+    "post_mortem": (bool, False, "park failing tasks at the raising frame for `ray_tpu debug` (reference: RAY_DEBUG_POST_MORTEM)"),
+    "post_mortem_wait_s": (float, 120.0, "how long a parked task waits for a debugger before its error propagates"),
     # --- channels / client ---
     "channel_poll_min_s": (float, 0.0005, "cross-node channel long-poll floor: a hot pipeline sees sub-ms latency"),
     "channel_poll_max_s": (float, 0.01, "cross-node channel long-poll backoff ceiling for idle rings"),
+    "channel_default_slots": (int, 4, "in-flight values a compiled-graph channel ring holds by default"),
+    "dag_buffer_size_bytes": (int, 8 << 20, "per-edge channel slot capacity for compiled DAGs (reference: buffer_size_bytes)"),
+    "dag_max_inflight_executions": (int, 10, "default bound on in-flight compiled-DAG executions (reference: RAY_CGRAPH_max_inflight_executions)"),
+    "dag_execute_timeout_s": (float, 60.0, "compiled-DAG submission/read timeout"),
     "client_proxy_node_cache_s": (float, 5.0, "client proxy's cache TTL for the cluster's registered-endpoint allowlist"),
     # --- train / libraries ---
     "train_health_check_interval_s": (float, 1.0, "train controller worker poll interval"),
@@ -66,10 +78,14 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "serve_http_port": (int, 8000, "default HTTP port each node's serve proxy binds (reference: serve DEFAULT_HTTP_PORT)"),
     "serve_handle_max_retries": (int, 3, "deployment-handle resubmissions after replica death before the call fails"),
     "serve_control_loop_interval_s": (float, 0.25, "serve controller reconcile interval"),
+    "serve_router_cache_ttl_s": (float, 2.0, "deployment-handle routing-table refresh TTL (scale-ups become visible to existing handles within this window)"),
     "llm_multi_step": (int, 8, "decode tokens per engine dispatch when every active slot is greedy (on-device argmax chunks; 1 disables)"),
     "llm_prefill_bucket_min": (int, 16, "smallest prompt padding bucket for compiled prefill programs"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
+    "data_output_queue_size": (int, 8, "blocks buffered between the streaming executor and the consuming iterator (backpressure depth)"),
+    "data_max_inflight_factor": (int, 2, "per-operator in-flight task cap as a multiple of its actor/worker pool size"),
+    "tune_trial_poll_timeout_s": (float, 60.0, "driver-side timeout for polling a trial actor's buffered results"),
 }
 
 

@@ -20,8 +20,8 @@ import socket
 import time
 
 KV_NS = "debug_sessions"
-ENV_FLAG = "RAY_TPU_POST_MORTEM"
-ENV_WAIT = "RAY_TPU_POST_MORTEM_WAIT_S"
+# RAY_TPU_POST_MORTEM / RAY_TPU_POST_MORTEM_WAIT_S ride the standard flag
+# table (config.py "post_mortem"/"post_mortem_wait_s").
 
 # At most ONE parked session per worker process: each park blocks a
 # task-executor thread, and a correlated failure wave (bad batch, missing
@@ -33,7 +33,11 @@ _park_slot = _threading.Semaphore(1)
 
 
 def post_mortem_enabled() -> bool:
-    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "no")
+    # RAY_TPU_POST_MORTEM rides the standard flag table (config.py
+    # "post_mortem"); the env spelling is unchanged.
+    from ray_tpu._private.config import CONFIG
+
+    return bool(CONFIG.post_mortem)
 
 
 def park_post_mortem(worker, spec, exc: BaseException) -> bool:
@@ -71,7 +75,9 @@ def _park_locked(worker, spec, exc, tb) -> bool:
     except Exception:
         srv.close()
         return False
-    srv.settimeout(float(os.environ.get(ENV_WAIT, "120")))
+    from ray_tpu._private.config import CONFIG
+
+    srv.settimeout(float(CONFIG.post_mortem_wait_s))
     attached = False
     try:
         try:

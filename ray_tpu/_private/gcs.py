@@ -273,7 +273,9 @@ class GcsService:
 
         q = getattr(self, "_export_queue", None)
         if q is None:
-            q = self._export_queue = _queue.Queue(maxsize=1024)
+            q = self._export_queue = _queue.Queue(
+                maxsize=CONFIG.gcs_export_queue_size
+            )
 
             def drain():
                 import os as _os

@@ -62,7 +62,11 @@ class FileStoreClient(InMemoryStoreClient):
     crash can lose the OS-buffered tail), unset/"group" = group commit.
     """
 
-    _COMPACT_THRESHOLD = 50_000
+    @property
+    def _COMPACT_THRESHOLD(self) -> int:
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.gcs_store_compact_threshold
 
     def __init__(self, store_dir: str):
         super().__init__()
@@ -89,7 +93,11 @@ class FileStoreClient(InMemoryStoreClient):
             )
             self._syncer.start()
 
-    def _group_sync_loop(self, interval_s: float = 0.01):
+    def _group_sync_loop(self, interval_s: float | None = None):
+        if interval_s is None:
+            from ray_tpu._private.config import CONFIG
+
+            interval_s = CONFIG.gcs_store_fsync_window_s
         while not self._closing:
             self._dirty.wait()
             if self._closing:

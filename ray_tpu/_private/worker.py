@@ -502,7 +502,9 @@ class _LogDeduplicator:
     ones within the window are counted and summarized when the window expires.
     Disabled via RAY_TPU_LOG_DEDUP=0 (every line passes through verbatim)."""
 
-    WINDOW_S = 5.0
+    @property
+    def WINDOW_S(self) -> float:
+        return CONFIG.log_dedup_window_s
 
     def __init__(self):
         import re
@@ -1481,11 +1483,12 @@ class CoreWorker:
                             continue
                         strikes, first_t = stale.get(sk, (0, now))
                         strikes += 1
-                        # Three consecutive not-held rounds AND a minimum
+                        # N consecutive not-held rounds AND a minimum
                         # wall-clock age: a sequenced handoff still in flight
                         # (reply not yet processed by the holder) must never
                         # be reconciled away on a fast audit interval.
-                        if strikes >= 3 and now - first_t >= 2.0:
+                        if (strikes >= CONFIG.borrow_audit_strikes
+                                and now - first_t >= CONFIG.borrow_audit_min_age_s):
                             stale.pop(sk, None)
                             self.reference_counter.drop_borrow_entry(oid, key)
                         else:

@@ -368,11 +368,20 @@ class _WrappedError:
 
 
 class CompiledDAG:
-    def __init__(self, leaf: DAGNode, *, buffer_size_bytes: int = 8 << 20,
-                 max_inflight_executions: int = 10, _timeout_s: float = 60.0):
+    def __init__(self, leaf: DAGNode, *, buffer_size_bytes: Optional[int] = None,
+                 max_inflight_executions: Optional[int] = None,
+                 _timeout_s: Optional[float] = None):
         import threading
         import uuid as _uuid
 
+        from ray_tpu._private.config import CONFIG
+
+        if buffer_size_bytes is None:
+            buffer_size_bytes = CONFIG.dag_buffer_size_bytes
+        if max_inflight_executions is None:
+            max_inflight_executions = CONFIG.dag_max_inflight_executions
+        if _timeout_s is None:
+            _timeout_s = CONFIG.dag_execute_timeout_s
         self._buffer = buffer_size_bytes
         self._timeout = _timeout_s
         self._torn_down = False

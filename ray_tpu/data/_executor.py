@@ -270,7 +270,9 @@ class ActorMapOperator(PhysicalOperator):
         self._ensure_pool()
         started = 0
         # Allow a small queue per actor so actors stay busy between polls.
-        max_inflight = self._strategy.size * 2
+        from ray_tpu._private.config import CONFIG
+
+        max_inflight = self._strategy.size * CONFIG.data_max_inflight_factor
         while started < budget and self.inqueue and len(self._pending) < max_inflight:
             actor = min(self._actors, key=lambda a: self._load[a._actor_id])
             bundle = self.inqueue.popleft()

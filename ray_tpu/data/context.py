@@ -9,16 +9,26 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
+def _flag(name):
+    from ray_tpu._private.config import CONFIG
+
+    return getattr(CONFIG, name)  # typo'd keys fail loudly
+
+
 @dataclass
 class DataContext:
-    target_max_block_size: int = 128 * 1024 * 1024
+    target_max_block_size: int = field(
+        default_factory=lambda: _flag("data_block_target_bytes")
+    )
     target_min_block_size: int = 1 * 1024 * 1024
     # Rows per block produced by reads when the source can't estimate sizes.
     default_batch_size: int = 1024
     # Executor limits (backpressure).
     max_tasks_in_flight: int = 16
     max_queued_bundles: int = 32
-    output_queue_size: int = 8
+    output_queue_size: int = field(
+        default_factory=lambda: _flag("data_output_queue_size")
+    )
     # Default parallelism for reads when not specified (-1 = auto).
     read_parallelism: int = -1
     # Verbose per-op stats collection.

@@ -47,8 +47,12 @@ class Channel:
     Picklable by segment name; `reader(slot)` binds a reader view."""
 
     def __init__(self, capacity: int = 4 << 20, num_readers: int = 1,
-                 num_slots: int = 4, _name: Optional[str] = None,
+                 num_slots: Optional[int] = None, _name: Optional[str] = None,
                  _reader_slot: Optional[int] = None):
+        if num_slots is None:
+            from ray_tpu._private.config import CONFIG
+
+            num_slots = CONFIG.channel_default_slots
         self._capacity = capacity
         self._num_readers = num_readers
         self._num_slots = num_slots
@@ -263,8 +267,12 @@ class RpcChannel:
     reader(slot), close/destroy, picklable by name)."""
 
     def __init__(self, capacity: int = 4 << 20, num_readers: int = 1,
-                 num_slots: int = 4, owner=None, _name: Optional[str] = None,
+                 num_slots: Optional[int] = None, owner=None, _name: Optional[str] = None,
                  _reader_slot: Optional[int] = None):
+        if num_slots is None:
+            from ray_tpu._private.config import CONFIG
+
+            num_slots = CONFIG.channel_default_slots
         self._capacity = capacity  # advisory only (no fixed slot size)
         self._num_readers = num_readers
         self._num_slots = num_slots

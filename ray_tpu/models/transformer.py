@@ -129,10 +129,74 @@ def _rope_apply(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def _rope_apply_bhsd(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply the rotation in the kernel-native layout. x: [B,H,S,D]."""
+    cos = cos[:, None, :, :].astype(x.dtype)
+    sin = sin[:, None, :, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary position embedding. x: [B, S, H, D]; positions: [B, S] or [S]."""
     cos, sin = _rope_angles(positions, x.shape[-1], theta)
     return _rope_apply(x, cos, sin)
+
+
+class _HeadProj(nn.Module):
+    """[B,S,E] -> [B,H,S,D] projection: the head/seq transpose folds into the
+    matmul itself instead of materializing in HBM (the flash kernel consumes
+    [B,H,S,D] natively). Param tree identical to the DenseGeneral it replaces
+    (kernel [E,H,D] under the same name) — checkpoints are interchangeable."""
+
+    heads: int
+    head_dim: int
+    dtype: Any
+    param_dtype: Any
+    axis_names: tuple
+
+    @nn.compact
+    def __call__(self, x):
+        # DenseGeneral initializes multi-dim kernels on the FLATTENED 2-D
+        # shape (fan-in = E) and reshapes; replicate exactly so this param is
+        # bit-identical to the DenseGeneral it replaces under the same rng.
+        def init(key, shape, dtype):
+            flat = (shape[0], shape[1] * shape[2])
+            return nn.initializers.lecun_normal()(key, flat, dtype).reshape(shape)
+
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(init, self.axis_names),
+            (x.shape[-1], self.heads, self.head_dim),
+            self.param_dtype,
+        )
+        return jnp.einsum(
+            "bse,ehd->bhsd", x.astype(self.dtype), kernel.astype(self.dtype)
+        )
+
+
+class _OutProjBhsd(nn.Module):
+    """[B,H,S,D] -> [B,S,E]; kernel [H,D,E] matches DenseGeneral axis=(-2,-1)."""
+
+    features: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        def init(key, shape, dtype):
+            flat = (shape[0] * shape[1], shape[2])
+            return nn.initializers.lecun_normal()(key, flat, dtype).reshape(shape)
+
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(init, ("heads", "head_dim", "embed")),
+            (x.shape[1], x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        return jnp.einsum(
+            "bhsd,hde->bse", x.astype(self.dtype), kernel.astype(self.dtype)
+        )
 
 
 class RMSNorm(nn.Module):
@@ -164,6 +228,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, rope=None, kv_cache=None):
         cfg = self.cfg
+        if cfg.attention == "flash" and kv_cache is None and not cfg.fused_qkv:
+            return self._flash_bhsd(x, positions, rope), None
         dense = lambda features, names, name: nn.DenseGeneral(  # noqa: E731
             features,
             axis=-1,
@@ -244,6 +310,42 @@ class Attention(nn.Module):
             name="o",
         )(out)
         return proj, new_cache
+
+    def _flash_bhsd(self, x, positions, rope):
+        """Transpose-free train path: projections emit [B,H,S,D] directly,
+        the flash kernel runs in its native layout, and the output projection
+        contracts straight back to [B,S,E] — the 11 per-layer HBM transposes
+        of the [B,S,H,D] route never materialize. Same param tree."""
+        from ray_tpu.ops.attention import flash_attention_bhsd
+
+        cfg = self.cfg
+        q = _HeadProj(cfg.n_heads, cfg.head_dim, cfg.dtype, cfg.param_dtype,
+                      ("embed", "heads", "head_dim"), name="q")(x)
+        k = _HeadProj(cfg.n_kv_heads, cfg.head_dim, cfg.dtype, cfg.param_dtype,
+                      ("embed", "kv_heads", "head_dim"), name="k")(x)
+        v = _HeadProj(cfg.n_kv_heads, cfg.head_dim, cfg.dtype, cfg.param_dtype,
+                      ("embed", "kv_heads", "head_dim"), name="v")(x)
+        if rope is None:
+            rope = _rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+        q = _rope_apply_bhsd(q, *rope)
+        k = _rope_apply_bhsd(k, *rope)
+        if cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            q = checkpoint_name(q, "save")
+            k = checkpoint_name(k, "save")
+            v = checkpoint_name(v, "save")
+        out = flash_attention_bhsd(q, k, v, True, None)
+        if cfg.remat and cfg.remat_policy == "attn":
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "attn_out")
+        elif cfg.remat and cfg.remat_policy == "selective":
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "save")
+        return _OutProjBhsd(cfg.hidden, cfg.dtype, cfg.param_dtype,
+                            name="o")(out)
 
 
 class MLP(nn.Module):

@@ -120,18 +120,29 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref
 
 
 def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int,
-                   interpret: bool):
-    """q:[B,S,H,D] k/v:[B,T,H,D] (kv heads already expanded) -> (out, lse [B,H,S])."""
+                   interpret: bool, layout: str = "bshd"):
+    """q:[B,S,H,D] k/v:[B,T,H,D] (kv heads already expanded) -> (out, lse [B,H,S]).
+
+    layout="bhsd": operands arrive [B,H,S,D] (the kernel's native layout) and
+    the output returns [B,H,S,D] — no transposes touch HBM. The model's train
+    path produces this layout straight out of its projection einsums."""
     from jax.experimental import pallas as pl
 
     from jax.experimental.pallas import tpu as pltpu
 
-    B, S, H, D = q.shape
-    T = k.shape[1]
-    # Flatten (batch, head) into the leading grid dim; blocks squeeze it away.
-    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
-    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
-    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
+    if layout == "bhsd":
+        B, H, S, D = q.shape
+        T = k.shape[2]
+        qt = q.reshape(B * H, S, D)
+        kt = k.reshape(B * H, T, D)
+        vt = v.reshape(B * H, T, D)
+    else:
+        B, S, H, D = q.shape
+        T = k.shape[1]
+        # Flatten (batch, head) into the leading grid dim; blocks squeeze it away.
+        qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+        kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
+        vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
     block_q = min(block_q, S)
     block_k = min(block_k, T)
     grid = (B * H, pl.cdiv(S, block_q), pl.cdiv(T, block_k))  # nk innermost
@@ -162,6 +173,8 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int, block_k
         ],
         interpret=interpret,
     )(qt, kt, vt)
+    if layout == "bhsd":
+        return out.reshape(B, H, S, D), lse.reshape(B, H, S)
     out = jnp.transpose(out.reshape(B, H, S, D), (0, 2, 1, 3))
     return out, lse.reshape(B, H, S)
 
@@ -239,26 +252,40 @@ def _flash_bwd_fused_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    layout: str = "bshd"):
     """Pallas flash backward: no [S,T] tensor ever touches HBM, one pass.
 
     q/g:[B,S,H,D], k/v:[B,T,H,D] (kv already expanded), lse:[B,H,S] f32.
-    Returns (dq, dk, dv) in the inputs' dtypes.
+    layout="bhsd": q/g/k/v/out arrive (and dq/dk/dv return) as [B,H,*,D] —
+    zero transposes. Returns (dq, dk, dv) in the inputs' dtypes.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    B, S, H, D = q.shape
-    T = k.shape[1]
-    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
-    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
-    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
-    gt = jnp.transpose(g, (0, 2, 1, 3)).reshape(B * H, S, D).astype(q.dtype)
-    # delta = sum(g * out, -1): cheap rowwise reduction, precomputed in XLA.
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [B,S,H]
-    deltat = jnp.transpose(delta, (0, 2, 1)).reshape(B * H, S, 1)
+    if layout == "bhsd":
+        B, H, S, D = q.shape
+        T = k.shape[2]
+        qt = q.reshape(B * H, S, D)
+        kt = k.reshape(B * H, T, D)
+        vt = v.reshape(B * H, T, D)
+        gt = g.reshape(B * H, S, D).astype(q.dtype)
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        )  # [B,H,S]
+        deltat = delta.reshape(B * H, S, 1)
+    else:
+        B, S, H, D = q.shape
+        T = k.shape[1]
+        qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+        kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
+        vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
+        gt = jnp.transpose(g, (0, 2, 1, 3)).reshape(B * H, S, D).astype(q.dtype)
+        # delta = sum(g * out, -1): cheap rowwise reduction, precomputed in XLA.
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        )  # [B,S,H]
+        deltat = jnp.transpose(delta, (0, 2, 1)).reshape(B * H, S, 1)
     lset = lse.reshape(B * H, S, 1)
     block_q = min(block_q, S)
     block_k = min(block_k, T)
@@ -295,6 +322,9 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
         interpret=interpret,
     )(qt, gt, lset, deltat, kt, vt)
 
+    if layout == "bhsd":
+        return (dq.reshape(B, H, S, D).astype(q.dtype),
+                dk.reshape(B, H, T, D), dv.reshape(B, H, T, D))
     dq = jnp.transpose(dq.reshape(B, H, S, D), (0, 2, 1, 3)).astype(q.dtype)
     dk = jnp.transpose(dk.reshape(B, H, T, D), (0, 2, 1, 3))
     dv = jnp.transpose(dv.reshape(B, H, T, D), (0, 2, 1, 3))
@@ -368,12 +398,24 @@ def _flash_bwd_rule(causal, scale, residuals, g):
             dv = dv.reshape(B, T, Hkv, rep, D).sum(axis=3).astype(v.dtype)
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
-    # MXU path: the big einsums run in the inputs' compute dtype with f32
-    # accumulation (an f32 matmul costs ~8x MXU throughput on v5e) and the
-    # [B,H,S,T] intermediates are held in that dtype, halving the dominant HBM
-    # traffic of this backward for bf16 models. Softmax math (exp, lse
-    # subtraction, ds recentering) stays f32. Full-precision inputs (CPU tests,
-    # f32 models) keep f32 end to end.
+    return _xla_flash_bwd(q, k_full, v_full, out, lse, g, causal, eff_scale,
+                          rep, Hkv, k.dtype, v.dtype)
+
+
+def _xla_flash_bwd(q, k_full, v_full, out, lse, g, causal, eff_scale, rep,
+                   Hkv, k_dtype, v_dtype):
+    """Recompute-based XLA flash backward in bshd layout — the SINGLE
+    implementation behind both layout entry points (the bhsd rule transposes
+    into here on its non-pallas path; those transposes only run on CPU/test
+    backends where they're free of consequence).
+
+    The big einsums run in the inputs' compute dtype with f32 accumulation
+    (an f32 matmul costs ~8x MXU throughput on v5e) and the [B,H,S,T]
+    intermediates are held in that dtype, halving the dominant HBM traffic of
+    this backward for bf16 models. Softmax math (exp, lse subtraction, ds
+    recentering) stays f32. Full-precision inputs keep f32 end to end."""
+    B, S, _H, D = q.shape
+    T = k_full.shape[1]
     bf = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16) else jnp.float32
     logits = jnp.einsum(
         "bshd,bthd->bhst", q.astype(bf), k_full.astype(bf),
@@ -398,7 +440,91 @@ def _flash_bwd_rule(causal, scale, residuals, g):
     if rep > 1:
         dk = dk.reshape(B, T, Hkv, rep, D).sum(axis=3)
         dv = dv.reshape(B, T, Hkv, rep, D).sum(axis=3)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq.astype(q.dtype), dk.astype(k_dtype), dv.astype(v_dtype)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ------------------------------------------------------- bhsd (transpose-free)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_bhsd(q, k, v, causal: bool = True, scale: float | None = None):
+    """Flash attention in the kernel's NATIVE layout: q:[B,H,S,D],
+    k/v:[B,Hkv,T,D] -> [B,H,S,D].
+
+    The bshd entry point pays 4 HBM transposes in forward and 7 in backward
+    per call (measured ~1/3 of the in-graph attention cost at the flagship
+    shape); a model whose projections emit [B,H,S,D] directly (einsum
+    'bse,ehd->bhsd' — the transpose folds into the matmul) skips all of them.
+    """
+    out, _ = _flash_bhsd_fwd_impl(q, k, v, causal, scale)
+    return out
+
+
+def _expand_kv_bhsd(k, v, H):
+    Hkv = k.shape[1]
+    if Hkv == H:
+        return k, v
+    rep = H // Hkv
+    return jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
+
+def _flash_bhsd_fwd_impl(q, k, v, causal, scale):
+    D = q.shape[-1]
+    eff_scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    k_full, v_full = _expand_kv_bhsd(k, v, q.shape[1])
+    if _use_pallas():
+        return _flash_forward(
+            q, k_full, v_full, causal=causal, scale=eff_scale,
+            block_q=int(os.environ.get("RAY_TPU_FLASH_BQ", "256")),
+            block_k=int(os.environ.get("RAY_TPU_FLASH_BK", "1024")),
+            interpret=False, layout="bhsd",
+        )
+    out, lse = _attention_with_lse(
+        jnp.transpose(q, (0, 2, 1, 3)), jnp.transpose(k_full, (0, 2, 1, 3)),
+        jnp.transpose(v_full, (0, 2, 1, 3)), causal=causal, scale=eff_scale,
+    )
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _flash_bhsd_fwd_rule(q, k, v, causal, scale):
+    out, lse = _flash_bhsd_fwd_impl(q, k, v, causal, scale)
+    from jax.ad_checkpoint import checkpoint_name
+
+    return out, (q, k, v, checkpoint_name(out, "flash_residuals"),
+                 checkpoint_name(lse, "flash_residuals"))
+
+
+def _flash_bhsd_bwd_rule(causal, scale, residuals, g):
+    q, k, v, out, lse = residuals
+    B, H, S, D = q.shape
+    Hkv, T = k.shape[1], k.shape[2]
+    eff_scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    rep = H // Hkv
+    k_full, v_full = _expand_kv_bhsd(k, v, H)
+
+    if _use_pallas() and os.environ.get("RAY_TPU_FLASH_BWD", "pallas") == "pallas":
+        dq, dk, dv = _flash_backward(
+            q, k_full, v_full, out, lse, g, causal=causal, scale=eff_scale,
+            block_q=int(os.environ.get("RAY_TPU_FLASH_BWD_BQ", "512")),
+            block_k=int(os.environ.get("RAY_TPU_FLASH_BWD_BK", "1024")),
+            interpret=False, layout="bhsd",
+        )
+        if rep > 1:
+            dk = dk.reshape(B, Hkv, rep, T, D).sum(axis=2).astype(k.dtype)
+            dv = dv.reshape(B, Hkv, rep, T, D).sum(axis=2).astype(v.dtype)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    # XLA fallback (CPU tests / f32): normalize into the shared bshd backward
+    # — the extra transposes only exist on backends where they cost nothing,
+    # and the numerically sensitive math stays in ONE place.
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+    dq, dk, dv = _xla_flash_bwd(
+        tr(q), tr(k_full), tr(v_full), tr(out), lse, tr(g), causal, eff_scale,
+        rep, Hkv, k.dtype, v.dtype,
+    )
+    return tr(dq), tr(dk), tr(dv)
+
+
+flash_attention_bhsd.defvjp(_flash_bhsd_fwd_rule, _flash_bhsd_bwd_rule)

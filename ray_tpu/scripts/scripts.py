@@ -380,8 +380,13 @@ def cmd_debug(args):
             if len(sessions) == 1:
                 chosen = sessions[0]
             else:
-                idx = int(input("attach to which session? "))
-                chosen = sessions[idx]
+                try:
+                    idx = int(input("attach to which session? "))
+                    chosen = sessions[idx]
+                except (ValueError, IndexError, EOFError):
+                    print("pass a session number from the list above (or the "
+                          "task id as an argument)", file=sys.stderr)
+                    sys.exit(1)
         print(f"attaching to task {chosen['task_id'][:16]} at "
               f"{chosen['ip']}:{chosen['port']} (q or c to detach)")
         try:

@@ -129,7 +129,11 @@ class DeploymentResponseGenerator:
 class _Router:
     """Replica set cache + power-of-two-choices pick. One per handle per process."""
 
-    _CACHE_TTL_S = 2.0
+    @property
+    def _CACHE_TTL_S(self) -> float:
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.serve_router_cache_ttl_s
 
     def __init__(self, app: str, deployment: str):
         self._app = app

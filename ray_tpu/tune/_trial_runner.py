@@ -432,9 +432,14 @@ class TuneController:
         for trial in pending[: max(0, self._max_concurrent - len(running))]:
             self._start_trial(trial)
 
+        from ray_tpu._private.config import CONFIG
+
         for trial in [t for t in self.trials if t.status == RUNNING]:
             try:
-                poll = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
+                poll = ray_tpu.get(
+                    trial.actor.poll.remote(),
+                    timeout=CONFIG.tune_trial_poll_timeout_s,
+                )
             except Exception as e:
                 trial.error = f"poll failed: {e}"
                 self.finalize_trial(trial, ERROR)

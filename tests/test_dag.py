@@ -143,23 +143,32 @@ def test_input_attribute_access():
 
 def test_compiled_faster_than_actor_calls():
     w = Worker.remote()
-    # warm up the regular path
-    ray_tpu.get(w.inc.remote(0))
     n = 200
-    t0 = time.monotonic()
-    for i in range(n):
-        ray_tpu.get(w.inc.remote(i))
-    actor_time = time.monotonic() - t0
+
+    def time_actor():
+        t0 = time.monotonic()
+        for i in range(n):
+            ray_tpu.get(w.inc.remote(i))
+        return time.monotonic() - t0
+
+    ray_tpu.get(w.inc.remote(0))  # warm up the regular path
+    actor_time = min(time_actor(), time_actor())
 
     with InputNode() as inp:
         dag = w.inc.bind(inp)
     compiled = dag.experimental_compile()
-    try:
-        compiled.execute(0).get()  # warm up
+
+    def time_dag():
         t0 = time.monotonic()
         for i in range(n):
             compiled.execute(i).get()
-        dag_time = time.monotonic() - t0
+        return time.monotonic() - t0
+
+    try:
+        compiled.execute(0).get()  # warm up
+        # Best-of-two on BOTH paths: a single load spike (shared CI host)
+        # must not flip a 5x structural gap into a flake.
+        dag_time = min(time_dag(), time_dag())
     finally:
         compiled.teardown()
     # The pinned-loop path must beat the submit-per-call path comfortably.
