@@ -28,6 +28,17 @@ _LEN_SIZE = 8
 _REQUEST = 0
 _RESPONSE = 1
 _ONEWAY = 2
+_HELLO = 3
+
+# Wire-protocol version (reference role: the protobuf schema version baked
+# into src/ray/protobuf — cross-version clusters fail there by schema
+# incompatibility; here both peers announce a version in their FIRST frame
+# and a mismatch fails every call on the connection with a crisp error
+# instead of a pickle decode crash deep in a handler). Unknown frame kinds
+# are skipped by the receive loop, so future minor additions (new frame
+# types) pass through old readers; bump this number for changes old code
+# cannot safely ignore.
+PROTOCOL_VERSION = 1
 
 
 class RpcError(Exception):
@@ -61,20 +72,35 @@ class Connection:
     """A symmetric RPC peer. `handler` is an object whose `rpc_<method>` coroutines serve
     inbound requests; outbound requests go through `call`/`notify`."""
 
-    def __init__(self, reader, writer, handler: Any = None, name: str = "?"):
+    def __init__(self, reader, writer, handler: Any = None, name: str = "?",
+                 _protocol_version: int | None = None):
         self._reader = reader
         self._writer = writer
         self.handler = handler
         self.name = name
+        # Instance-scoped so tests can impersonate another version; real
+        # processes always announce the module constant.
+        self._protocol_version = (
+            PROTOCOL_VERSION if _protocol_version is None else _protocol_version
+        )
+        self._protocol_error_msg: str | None = None
         self._mid = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
         self._close_callbacks: list[Callable] = []
         self._writer_lock = asyncio.Lock()
         self._recv_task: asyncio.Task | None = None
+        self.peer_protocol: int | None = None  # set by the peer's HELLO
 
     def start(self):
-        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        loop = asyncio.get_running_loop()
+        # Announce our wire version SYNCHRONOUSLY before any other frame can
+        # be written: writer.write appends to an ordered buffer, so this is
+        # guaranteed to be the first frame on the wire (a fire-and-forget
+        # task could lose the race to an immediate call(), be GC'd before
+        # running, or leak an unretrieved exception).
+        self._writer.write(_frame((_HELLO, self._protocol_version, {})))
+        self._recv_task = loop.create_task(self._recv_loop())
         return self
 
     def on_close(self, cb: Callable):
@@ -89,9 +115,16 @@ class Connection:
             self._writer.write(_frame(msg))
             await self._writer.drain()
 
+    def _closed_error(self) -> RpcError:
+        """Fresh instance per raise: a shared exception object accumulates
+        tracebacks across unrelated callers."""
+        if self._protocol_error_msg:
+            return RpcError(self._protocol_error_msg)
+        return ConnectionLost(f"connection {self.name} is closed")
+
     async def call(self, method: str, *args, timeout: float | None = None, **kwargs):
         if self._closed:
-            raise ConnectionLost(f"connection {self.name} is closed")
+            raise self._closed_error()
         mid = next(self._mid)
         fut = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
@@ -103,7 +136,7 @@ class Connection:
 
     async def notify(self, method: str, *args, **kwargs):
         if self._closed:
-            raise ConnectionLost(f"connection {self.name} is closed")
+            raise self._closed_error()
         await self._send((_ONEWAY, 0, method, args, kwargs))
 
     async def _recv_loop(self):
@@ -127,6 +160,24 @@ class Connection:
                     asyncio.get_running_loop().create_task(self._dispatch(msg))
                 elif kind == _ONEWAY:
                     asyncio.get_running_loop().create_task(self._dispatch(msg, oneway=True))
+                elif kind == _HELLO:
+                    self.peer_protocol = msg[1]
+                    if msg[1] != self._protocol_version:
+                        self._protocol_error_msg = (
+                            f"wire-protocol mismatch on {self.name}: peer "
+                            f"speaks v{msg[1]}, this process v"
+                            f"{self._protocol_version} — every ray_tpu "
+                            "process in a cluster must run the same version"
+                        )
+                        # Best-effort flush of our own (already-buffered)
+                        # HELLO so the peer can derive the same diagnosis.
+                        try:
+                            await self._writer.drain()
+                        except Exception:
+                            pass
+                        break  # -> _shutdown fails pending calls with it
+                # Unknown kinds: skipped (forward compatibility within a
+                # protocol version).
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
             pass
         except asyncio.CancelledError:
@@ -165,7 +216,14 @@ class Connection:
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+                # Fresh instance per future (shared exception objects chain
+                # tracebacks across unrelated awaiters).
+                if self._protocol_error_msg:
+                    fut.set_exception(RpcError(self._protocol_error_msg))
+                else:
+                    fut.set_exception(
+                        ConnectionLost(f"connection {self.name} lost")
+                    )
         self._pending.clear()
         try:
             self._writer.close()
@@ -216,6 +274,7 @@ class RpcServer:
 async def connect(
     host: str, port: int, handler: Any = None, name: str = "client",
     timeout: float = 10.0, via: tuple | None = None,
+    _protocol_version: int | None = None,
 ) -> Connection:
     """Open a peer connection. `via=(proxy_host, proxy_port, client_id)` tunnels
     through a client proxy (util/client/proxier.py): the first frame on the wire
@@ -241,7 +300,8 @@ async def connect(
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), timeout
         )
-    return Connection(reader, writer, handler, name=name).start()
+    return Connection(reader, writer, handler, name=name,
+                      _protocol_version=_protocol_version).start()
 
 
 class IoLoop:
