@@ -64,6 +64,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "log_dedup_window_s": (float, 5.0, "repeat window for driver-side worker-log deduplication summaries"),
     "post_mortem": (bool, False, "park failing tasks at the raising frame for `ray_tpu debug` (reference: RAY_DEBUG_POST_MORTEM)"),
     "post_mortem_wait_s": (float, 120.0, "how long a parked task waits for a debugger before its error propagates"),
+    "post_mortem_external": (bool, False, "bind the post-mortem pdb server on all interfaces instead of loopback; the socket is an UNAUTHENTICATED interactive interpreter — only enable inside a trusted network boundary (reference: ray debugger_external)"),
     # --- channels / client ---
     "channel_poll_min_s": (float, 0.0005, "cross-node channel long-poll floor: a hot pipeline sees sub-ms latency"),
     "channel_poll_max_s": (float, 0.01, "cross-node channel long-poll backoff ceiling for idle rings"),

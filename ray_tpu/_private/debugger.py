@@ -58,13 +58,23 @@ def park_post_mortem(worker, spec, exc: BaseException) -> bool:
 
 
 def _park_locked(worker, spec, exc, tb) -> bool:
+    from ray_tpu._private.config import CONFIG as _CFG
+
     task_hex = spec["task_id"].hex()
-    srv = socket.create_server(("", 0))
+    # The pdb socket is an unauthenticated interactive interpreter: bind
+    # loopback unless the operator explicitly opted into external exposure
+    # with RAY_TPU_POST_MORTEM_EXTERNAL=1 (reference: util/rpdb.py binds
+    # localhost unless ray debugger_external was requested).
+    external = bool(_CFG.post_mortem_external)
+    srv = socket.create_server(("" if external else "127.0.0.1", 0))
     port = srv.getsockname()[1]
     info = {
         "task_id": task_hex,
         "name": spec.get("name"),
-        "ip": getattr(worker, "node_ip", None) or "127.0.0.1",
+        # Advertise an address `ray_tpu debug` can actually reach: the node
+        # IP only when the server listens beyond loopback.
+        "ip": (getattr(worker, "node_ip", None) or "127.0.0.1")
+        if external else "127.0.0.1",
         "port": port,
         "pid": os.getpid(),
         "error": repr(exc),

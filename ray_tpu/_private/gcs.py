@@ -218,7 +218,7 @@ class GcsService:
                         try:
                             await node.conn.call("cancel_bundle", pg.pg_id, idx)
                         except Exception:
-                            pass
+                            pass  # node died mid-cancel; its bundles die with it
                     pg.allocations[idx] = None
                 asyncio.get_running_loop().create_task(self._schedule_pg(pg))
 
@@ -576,7 +576,7 @@ class GcsService:
                 try:
                     await node.conn.notify("evict_object", object_id)
                 except Exception:
-                    pass
+                    pass  # best-effort evict; a dead node has no copy to evict
         return True
 
     # ---------------- actors ----------------
@@ -721,7 +721,7 @@ class GcsService:
                     try:
                         await node.conn.call("kill_actor_worker", actor.actor_id)
                     except Exception:
-                        pass
+                        pass  # raylet gone: the worker is dying with its node anyway
                     if actor.state != DEAD:
                         await self._mark_actor_dead(
                             actor, "killed via ray_tpu.kill (during creation)"
@@ -750,7 +750,7 @@ class GcsService:
             try:
                 stats = await asyncio.wait_for(n.conn.call("node_stats"), 5)
             except Exception:
-                return None
+                return None  # unreachable node: reported as no stats, not an error
             hs = stats.get("resource_holders") or []
             for h in hs:
                 prefix = h.get("actor_id") or ""
@@ -831,7 +831,7 @@ class GcsService:
                 try:
                     await node.conn.call("kill_actor_worker", actor.actor_id)
                 except Exception:
-                    pass
+                    pass  # raylet gone: node death reaps the actor's worker
         if actor.state == DEAD:
             return True
         if actor.restarts_left != 0:
@@ -904,7 +904,7 @@ class GcsService:
                 try:
                     await node.conn.call("cancel_bundle", pg.pg_id, bundle_index)
                 except Exception:
-                    pass
+                    pass  # rollback to a dead node is moot; retry loop continues
             await asyncio.sleep(0.25)
         pg.state = DEAD
         pg.ready_event.set()
@@ -994,7 +994,7 @@ class GcsService:
                 try:
                     await node.conn.call("cancel_bundle", pg.pg_id, bundle_index)
                 except Exception:
-                    pass
+                    pass  # node died: its bundles are already released
         return True
 
     async def rpc_list_objects(self, conn, limit: int = 1000):

@@ -409,7 +409,7 @@ class Raylet:
                 try:
                     srv.reap_stale_allocated(60_000)
                 except Exception:
-                    pass
+                    pass  # reaping is advisory; the next sweep retries
             now = time.monotonic()
             idle = [
                 w
@@ -741,7 +741,7 @@ class Raylet:
                             2.0,
                         )
                     except Exception:
-                        pass
+                        pass  # event publish is advisory; the kill proceeds regardless
             await self._kill_worker(victim)
 
     async def _log_monitor_loop(self):
@@ -797,7 +797,7 @@ class Raylet:
                 try:
                     await self.gcs.notify("publish_worker_logs", msg)
                 except Exception:
-                    pass
+                    pass  # GCS briefly unreachable: lines ship on the next poll
             # Drop offsets of files whose workers are gone (bounded memory).
             live = {h.log_path for h in self.workers.values() if h.log_path}
             for path in list(offsets):
@@ -1476,7 +1476,7 @@ class Raylet:
                             timeout=10.0,
                         )
                     except Exception:
-                        return None
+                        return None  # worker unreachable != borrow released; audit treats as unknown
             return None
         target = None
         for nid in self.node_view:
@@ -1492,7 +1492,7 @@ class Raylet:
             return await peer.call("check_borrows", node_hex, worker_hex,
                                    object_ids, timeout=15.0)
         except Exception:
-            return None
+            return None  # peer raylet unreachable: verdict unknown, not not-held
 
     async def rpc_check_worker_alive(self, conn, node_hex: str, worker_hex: str):
         """Borrow-audit probe: True = alive, False = CONFIRMED dead (its own
@@ -1521,7 +1521,7 @@ class Raylet:
             return await peer.call("check_worker_alive", node_hex, worker_hex,
                                    timeout=5.0)
         except Exception:
-            return None
+            return None  # dial/call failure != death; only a definite answer counts
 
     # ------------------------------------------------------------------ RPC: object store
 
@@ -2040,7 +2040,7 @@ class Raylet:
             try:
                 await self.gcs.notify("object_ops_batch", ops)
             except Exception:
-                pass
+                pass  # GCS down: ops re-drain after the reconnect path replays
         for handle in list(self.workers.values()):
             if handle.kind != "driver":
                 await self._kill_worker(handle)

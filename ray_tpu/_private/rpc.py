@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import pickle
 import struct
 import threading
@@ -38,7 +39,16 @@ _HELLO = 3
 # are skipped by the receive loop, so future minor additions (new frame
 # types) pass through old readers; bump this number for changes old code
 # cannot safely ignore.
+#
+# Detection starts at v1: builds that PREDATE the handshake never send a
+# HELLO and silently skip ours (their recv loop drops unknown frame kinds),
+# so against such a peer the mismatch cannot be proven — the first
+# _REQUEST/_RESPONSE arriving before any HELLO is the tell, and the receive
+# loop logs a "legacy peer" warning naming the likely cause so the ensuing
+# pickle/handler errors aren't a dead end.
 PROTOCOL_VERSION = 1
+
+logger = logging.getLogger(__name__)
 
 
 class RpcError(Exception):
@@ -91,6 +101,7 @@ class Connection:
         self._writer_lock = asyncio.Lock()
         self._recv_task: asyncio.Task | None = None
         self.peer_protocol: int | None = None  # set by the peer's HELLO
+        self._legacy_warned = False
 
     def start(self):
         loop = asyncio.get_running_loop()
@@ -144,6 +155,23 @@ class Connection:
             while True:
                 msg = await _read_frame(self._reader)
                 kind = msg[0]
+                if (
+                    kind in (_REQUEST, _RESPONSE, _ONEWAY)
+                    and self.peer_protocol is None
+                    and not self._legacy_warned
+                ):
+                    # Pre-handshake builds never send a HELLO (their recv
+                    # loop silently skips ours), so a request/response
+                    # arriving first is the only cross-version tell we get.
+                    self._legacy_warned = True
+                    logger.warning(
+                        "peer on %s sent traffic before any HELLO frame: "
+                        "likely a legacy ray_tpu build that predates the "
+                        "wire-protocol handshake (this process speaks v%s). "
+                        "If calls fail with pickle/handler errors, upgrade "
+                        "the peer — mixed-version clusters are unsupported.",
+                        self.name, self._protocol_version,
+                    )
                 if kind == _RESPONSE:
                     _, mid, ok, value = msg
                     fut = self._pending.get(mid)
@@ -174,7 +202,7 @@ class Connection:
                         try:
                             await self._writer.drain()
                         except Exception:
-                            pass
+                            pass  # peer hung up first; it already has our HELLO or never will
                         break  # -> _shutdown fails pending calls with it
                 # Unknown kinds: skipped (forward compatibility within a
                 # protocol version).
@@ -208,7 +236,7 @@ class Connection:
             try:
                 await self._send((_RESPONSE, mid, False, payload))
             except Exception:
-                pass
+                pass  # connection died before the error reply; caller sees ConnectionLost
 
     async def _shutdown(self):
         if self._closed:

@@ -938,7 +938,7 @@ class CoreWorker:
                     sys.stderr.write(pending)
                     sys.stderr.flush()
             except Exception:
-                pass
+                pass  # stderr may be closed at interpreter teardown; drop the summary
             with self._events_lock:
                 batch, self._task_events = self._task_events, []
             if batch:
@@ -1081,7 +1081,7 @@ class CoreWorker:
             try:
                 await self.raylet.notify("store_ops_batch", ops)
             except Exception:
-                pass
+                pass  # raylet restart: unacked ops re-enter _store_ops via retry paths
 
     def _drain_store_ops_sync(self):
         """Flush pending store ops before disconnect so frees/seals aren't lost."""
@@ -2348,7 +2348,7 @@ class CoreWorker:
             elif info is not None and info["state"] == "RESTARTING":
                 reason = "actor died during method call (restarting)"
         except Exception:
-            pass
+            pass  # GCS unreachable: fall through to the generic death reason
         exc = ActorDiedError(actor_id, reason)
         err = serialization.dumps(exc)
         for spec in inflight:
@@ -2537,7 +2537,7 @@ class CoreWorker:
                     sys.stderr.write(out)
                     sys.stderr.flush()
             except Exception:
-                pass
+                pass  # stderr may be closed at interpreter teardown; drop the lines
         return True
 
     async def rpc_push_task(self, conn, spec):

@@ -307,15 +307,24 @@ _LOOP_STOP = object()
 
 
 class CompiledDAGRef:
-    """The driver-side result future of one execute() call."""
+    """The driver-side result future of one execute() call.
+
+    A ref dropped without get() does NOT strand its ring slot: __del__ (or an
+    explicit release()) marks the index abandoned and the DAG consumes the
+    value lazily — the reference CompiledDAGRef likewise consumes unread
+    results in its destructor so fire-and-forget drivers can't wedge the
+    graph with RayCgraphCapacityExceeded."""
 
     def __init__(self, dag: "CompiledDAG", idx: int):
         self._dag = dag
         self._idx = idx
         self._value: Any = None
         self._ready = False
+        self._released = False
 
     def get(self, timeout: Optional[float] = 60):
+        if self._released:
+            raise ValueError("this CompiledDAGRef was released")
         if not self._ready:
             self._dag._resolve_until(self._idx, timeout)
             with self._dag._state_lock:
@@ -329,6 +338,22 @@ class CompiledDAGRef:
             raise self._value.error
         return self._value
 
+    def release(self):
+        """Give up on this result: its capacity slot is reclaimed (lazily, at
+        the next capacity-bound submit) and get() becomes an error."""
+        if self._ready or self._released:
+            self._released = True
+            return
+        self._released = True
+        self._dag._abandon(self._idx)
+
+    def __del__(self):
+        try:
+            if not self._dag._torn_down:
+                self.release()
+        except Exception:
+            pass  # interpreter teardown: the DAG is going away anyway
+
 
 class CompiledDAGFuture:
     """Awaitable result of one execute_async() call (reference:
@@ -341,11 +366,14 @@ class CompiledDAGFuture:
         self._idx = idx
         self._value: Any = None
         self._ready = False
+        self._released = False
 
     def __await__(self):
         return self.get_async().__await__()
 
     async def get_async(self, timeout: Optional[float] = 60):
+        if self._released:
+            raise ValueError("this CompiledDAGFuture was released")
         if not self._ready:
             await self._dag._resolve_until_async(self._idx, timeout)
             # Another coroutine awaiting this SAME future may have consumed it
@@ -360,6 +388,21 @@ class CompiledDAGFuture:
         if isinstance(self._value, _WrappedError):
             raise self._value.error
         return self._value
+
+    def release(self):
+        """Non-async mirror of CompiledDAGRef.release (safe from __del__)."""
+        if self._ready or self._released:
+            self._released = True
+            return
+        self._released = True
+        self._dag._abandon(self._idx)
+
+    def __del__(self):
+        try:
+            if not self._dag._torn_down:
+                self.release()
+        except Exception:
+            pass  # interpreter teardown: the DAG is going away anyway
 
 
 class _WrappedError:
@@ -399,6 +442,10 @@ class CompiledDAG:
         self._num_slots = max(2, self._max_inflight)
         self._consumed_rounds = 0  # rounds with EVERY output consumed by get()
         self._consumed: Dict[int, int] = {}  # round -> outputs consumed so far
+        # Output indices whose refs were dropped/released unread: their values
+        # are consumed lazily (stream order) so abandoned refs free capacity
+        # instead of wedging the ring.
+        self._abandoned: set = set()
         # Input channel is single-writer: concurrent execute/execute_async
         # submissions must serialize their capacity-check + ring write or two
         # writers race the same slot and a round is silently lost.
@@ -614,9 +661,71 @@ class CompiledDAG:
             else:
                 self._consumed[rnd] = n
 
+    def _abandon(self, idx: int):
+        """A ref for `idx` was dropped/released unread. If its value already
+        sits in _pending (a later get() on the same stream read past it),
+        consume it now; otherwise remember the index for a lazy drain."""
+        with self._state_lock:
+            if self._torn_down:
+                return
+            if idx in self._pending:
+                self._pending.pop(idx)
+                claimed = True
+            else:
+                self._abandoned.add(idx)
+                claimed = False
+        if claimed:
+            self._note_consumed(idx)
+
+    def _store_round(self, j: int, value):
+        """Record the value just read for output stream j's current round —
+        or drop it on the floor if its ref was abandoned. Caller holds
+        stream lock j."""
+        with self._state_lock:
+            idx = self._reader_round[j] * self._num_outputs + j
+            self._reader_round[j] += 1
+            abandoned = idx in self._abandoned
+            if abandoned:
+                self._abandoned.discard(idx)
+            else:
+                self._pending[idx] = value
+        if abandoned:
+            self._note_consumed(idx)
+
+    def _drain_abandoned(self):
+        """Consume abandoned results that are next in their stream (channel
+        reads are strictly ordered per reader, so only stream-heads can be
+        drained; the rest unblock as earlier rounds are read)."""
+        while True:
+            with self._state_lock:
+                heads = [
+                    (idx, divmod(idx, self._num_outputs))
+                    for idx in sorted(self._abandoned)
+                ]
+                heads = [
+                    (idx, rnd, j) for idx, (rnd, j) in heads
+                    if self._reader_round[j] == rnd
+                ]
+            if not heads:
+                return
+            for idx, rnd, j in heads:
+                with self._stream_locks[j]:
+                    with self._state_lock:
+                        runnable = (
+                            idx in self._abandoned
+                            and self._reader_round[j] == rnd
+                        )
+                    if runnable:
+                        value = self._output_readers[j].read(self._timeout)
+                        self._store_round(j, value)
+
     def _submit(self, input_value) -> int:
         """Capacity check + count + single-writer ring write, atomically."""
         with self._submit_lock:
+            if self._exec_count - self._consumed_rounds >= self._max_inflight:
+                # At the bound: reclaim capacity from refs that were dropped
+                # unread before failing the submit.
+                self._drain_abandoned()
             self._check_capacity()
             idx = self._exec_count
             self._exec_count += 1
@@ -662,8 +771,7 @@ class CompiledDAG:
                     break
                 remaining = None if deadline is None else deadline - time.monotonic()
                 value = reader.read(remaining)
-                self._pending[self._reader_round[j] * self._num_outputs + j] = value
-                self._reader_round[j] += 1
+                self._store_round(j, value)
 
     async def _resolve_until_async(self, target_idx: int,
                                    timeout: Optional[float]):
@@ -683,8 +791,7 @@ class CompiledDAG:
                     return
                 remaining = None if deadline is None else deadline - time.monotonic()
                 value = reader.read(remaining)
-                self._pending[self._reader_round[j] * self._num_outputs + j] = value
-                self._reader_round[j] += 1
+                self._store_round(j, value)
 
         while self._reader_round[j] <= round_needed:
             await loop.run_in_executor(None, read_one)

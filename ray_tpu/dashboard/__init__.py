@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 from typing import Optional
 
 import ray_tpu
@@ -410,6 +411,10 @@ def _data_view() -> list:
 
 
 _state: dict = {}
+# Two threads racing start_dashboard would both miss the cache and one
+# would overwrite the other's {actor, port} (get_if_exists dedups the actor,
+# but the loser's port write could land after a concurrent stop_dashboard).
+_state_lock = threading.Lock()
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
@@ -419,30 +424,32 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
     # The cache is per cluster SESSION: after shutdown()+init() the old actor is
     # gone and a cached port would point at nothing.
     session = ray_tpu.global_worker().session_token
-    if _state.get("session") != session:
-        _state.clear()
-        _state["session"] = session
-    if _state.get("actor") is None:
-        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+    with _state_lock:
+        if _state.get("session") != session:
+            _state.clear()
+            _state["session"] = session
+        if _state.get("actor") is None:
+            from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
-        cls = ray_tpu.remote(num_cpus=0)(DashboardActor)
-        actor = cls.options(
-            name="RTPU_DASHBOARD", namespace="dashboard", get_if_exists=True,
-            max_concurrency=100,
-            # Pin to the CALLER's node: the server binds loopback, so the returned
-            # port must be reachable from where start_dashboard was invoked.
-            scheduling_strategy=NodeAffinitySchedulingStrategy(
-                node_id=ray_tpu.global_worker().node_id, soft=False
-            ),
-        ).remote(host, port)
-        _state["actor"] = actor
-        _state["port"] = ray_tpu.get(actor.start.remote())
-    return _state["port"]
+            cls = ray_tpu.remote(num_cpus=0)(DashboardActor)
+            actor = cls.options(
+                name="RTPU_DASHBOARD", namespace="dashboard", get_if_exists=True,
+                max_concurrency=100,
+                # Pin to the CALLER's node: the server binds loopback, so the returned
+                # port must be reachable from where start_dashboard was invoked.
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=ray_tpu.global_worker().node_id, soft=False
+                ),
+            ).remote(host, port)
+            _state["actor"] = actor
+            _state["port"] = ray_tpu.get(actor.start.remote())
+        return _state["port"]
 
 
 def stop_dashboard():
-    actor = _state.pop("actor", None)
-    _state.pop("port", None)
+    with _state_lock:
+        actor = _state.pop("actor", None)
+        _state.pop("port", None)
     if actor is not None:
         try:
             ray_tpu.kill(actor)

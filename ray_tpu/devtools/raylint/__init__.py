@@ -1,0 +1,40 @@
+"""raylint: framework-aware static analysis for the ray_tpu control plane.
+
+The runtime is a mix of asyncio control loops (raylet, GCS, serve) and
+threaded data paths (worker, channels) — the bug classes that slip through
+review here are concurrency and aliasing bugs that generic linters don't
+model. raylint is an AST pass purpose-built for this codebase's invariants
+(reference role: `python/ray/util/check_serialize.py` and the reference's CI
+lint gates). Run it as::
+
+    python -m ray_tpu.devtools.raylint ray_tpu/
+
+Checker families (see docs/raylint.md for the full contract):
+
+- RL101 await-under-lock      `await` inside a `with <lock>:` body
+- RL102 blocking-in-async     blocking call inside an `async def` body
+- RL201 lock-order-cycle      cycle in the static lock acquisition-order graph
+- RL301 aliased-mutation      in-place mutation of an object reached through a
+                              caller-owned container (shared-config aliasing)
+- RL302 mutable-default       dataclass `field(default=<mutable>)` shared
+                              across instances
+- RL401 swallowed-exception   broad `except` that silently discards the error
+- RL501 unreleased-ref        `.remote()`/`execute()` result discarded unread
+
+Suppress a finding with a trailing (or immediately preceding) comment::
+
+    ref = actor.ping.remote()  # raylint: disable=RL501
+
+or grandfather it in the checked-in baseline (`baseline.json`) with a one-line
+justification; `tests/test_raylint.py` gates tier-1 on zero non-baselined
+findings.
+"""
+
+from ray_tpu.devtools.raylint.core import (  # noqa: F401
+    CODES,
+    Finding,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    partition_baselined,
+)

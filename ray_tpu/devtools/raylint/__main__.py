@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu.devtools.raylint.cli import main
+
+sys.exit(main())

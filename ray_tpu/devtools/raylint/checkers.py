@@ -1,0 +1,622 @@
+"""The raylint checkers: one AST pass per file + a cross-file lock graph.
+
+Identity conventions shared by all checkers:
+
+- A "lock-ish" expression is a Name/Attribute/Subscript whose final
+  identifier contains a lock word (lock, mutex, semaphore, cond, ...) when
+  split on snake/camel boundaries: `self._state_lock`, `_global_lock`,
+  `self.cond`, `self._stream_locks[j]`.
+- Lock identity is class-qualified (`Worker._state_lock`) for `self`
+  attributes and module-qualified (`worker._global_lock`) for globals, so the
+  acquisition-order graph composes across files.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ray_tpu.devtools.raylint.core import FileContext, Finding
+
+_LOCK_WORDS = {
+    "lock", "locks", "rlock", "mutex", "sem", "semaphore", "semaphores",
+    "cond", "condition",
+}
+
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "clear", "add", "discard",
+    "update", "setdefault", "popitem", "sort", "reverse",
+}
+
+_COPY_CALLS = {"copy", "deepcopy", "replace", "dict", "list", "set", "tuple",
+               "frozenset", "asdict", "astuple"}
+
+_DISCARDED_CALL_ATTRS = {"remote", "execute", "execute_async"}
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _ident_parts(name: str) -> set[str]:
+    name = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", name)
+    return {p for p in name.lower().split("_") if p}
+
+
+def _base_ident(expr: ast.expr) -> Optional[str]:
+    """The identifier a call/attribute hangs off: `self._q.get` -> "_q";
+    `time.sleep` -> "time"; `locks[i].acquire` -> "locks"."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Subscript):
+        return _base_ident(expr.value)
+    return None
+
+
+def _is_lockish(expr: ast.expr) -> bool:
+    ident = _base_ident(expr)
+    return bool(ident and _ident_parts(ident) & _LOCK_WORDS)
+
+
+def _root_name(expr: ast.expr) -> Optional[str]:
+    """Walk `a.b[c].d` down to the root Name ("a")."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _call_is_nonblocking(node: ast.Call) -> bool:
+    """acquire(False) / get(block=False) / acquire(blocking=False) /
+    timeout=0 forms that poll instead of blocking."""
+    for arg in node.args[:1]:
+        if isinstance(arg, ast.Constant) and arg.value is False:
+            return True
+    for kw in node.keywords:
+        if kw.arg in ("block", "blocking") and isinstance(
+            kw.value, ast.Constant
+        ) and kw.value.value is False:
+            return True
+        if kw.arg == "timeout" and isinstance(
+            kw.value, ast.Constant
+        ) and kw.value.value == 0:
+            return True
+    return False
+
+
+class LockEdge:
+    """One statically observed 'outer held while inner acquired' fact."""
+
+    __slots__ = ("src", "dst", "relpath", "line", "symbol", "suppressed")
+
+    def __init__(self, src: str, dst: str, relpath: str, line: int,
+                 symbol: str, suppressed: bool):
+        self.src = src
+        self.dst = dst
+        self.relpath = relpath
+        self.line = line
+        self.symbol = symbol
+        self.suppressed = suppressed
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self.lock_edges: list[LockEdge] = []
+        self._scope: list[str] = []          # class/function names, outermost first
+        self._func_kinds: list[str] = []     # "sync" | "async"
+        self._class_stack: list[str] = []
+        self._held_locks: list[tuple[str, bool]] = []  # (lock id, is_async_with)
+        # Module-level mutable bindings (dict/list/set/ctor) by name.
+        self._module_mutables: set[str] = set()
+        # Per-function: local name -> root param it aliases into.
+        self._derived: dict[str, str] = {}
+        self._locals: set[str] = set()
+        self._params: set[str] = set()
+        self._awaited_calls: set[int] = set()
+        self._module_name = (ctx.relpath.rsplit("/", 1)[-1]).removesuffix(".py")
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _symbol(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _emit(self, node: ast.AST, code: str, message: str):
+        self.findings.append(Finding(
+            self.ctx.relpath, getattr(node, "lineno", 0), code, message,
+            self._symbol(),
+        ))
+
+    def _lock_id(self, expr: ast.expr) -> str:
+        ident = _base_ident(expr) or "?"
+        suffix = "[]" if isinstance(expr, ast.Subscript) or (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Subscript)
+        ) else ""
+        root = _root_name(expr)
+        if root in ("self", "cls") and self._class_stack:
+            return f"{self._class_stack[-1]}.{ident}{suffix}"
+        return f"{self._module_name}.{ident}{suffix}"
+
+    # -- module / class structure -------------------------------------------
+
+    def check_module(self):
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                if isinstance(value, ast.Call) and _base_ident(
+                    value.func
+                ) in ("local", "ContextVar", "Lock", "RLock", "Event",
+                      "Semaphore", "BoundedSemaphore", "Condition", "count"):
+                    # Per-thread / per-context / synchronization objects are
+                    # designed to be mutated without external locking.
+                    continue
+                if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Call)):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self._module_mutables.add(t.id)
+        self.visit(self.ctx.tree)
+        return self
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope.append(node.name)
+        self._class_stack.append(node.name)
+        self._check_mutable_defaults(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+        self._scope.pop()
+
+    def _check_mutable_defaults(self, node: ast.ClassDef):
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign) and stmt.value is not None):
+                continue
+            call = stmt.value
+            if not (isinstance(call, ast.Call)
+                    and _base_ident(call.func) == "field"):
+                continue
+            for kw in call.keywords:
+                if kw.arg == "default" and isinstance(
+                    kw.value, (ast.Dict, ast.List, ast.Set, ast.Call)
+                ):
+                    self._scope.append(getattr(stmt.target, "id", "?"))
+                    self._emit(
+                        stmt, "RL302",
+                        "dataclass field(default=...) with a mutable value "
+                        "is one object shared by every instance; use "
+                        "default_factory",
+                    )
+                    self._scope.pop()
+
+    def _visit_function(self, node, kind: str):
+        self._scope.append(node.name)
+        self._func_kinds.append(kind)
+        saved_held = self._held_locks
+        saved_derived, saved_locals = self._derived, self._locals
+        saved_params = getattr(self, "_params", set())
+        self._held_locks = []
+        self._derived = {}
+        args = node.args
+        params = [
+            a.arg
+            for a in (args.posonlyargs + args.args + args.kwonlyargs)
+        ] + [a.arg for a in (args.vararg, args.kwarg) if a is not None]
+        self._locals = {p for p in params}
+        self._params = {p for p in params if p not in ("self", "cls")}
+        self.generic_visit(node)
+        self._held_locks = saved_held
+        self._derived, self._locals = saved_derived, saved_locals
+        self._params = saved_params
+        self._func_kinds.pop()
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_function(node, "sync")
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_function(node, "async")
+
+    def visit_Lambda(self, node):
+        # A lambda body is a deferred callback: neither its blocking calls nor
+        # its lock use belong to the enclosing (possibly async) frame.
+        self._func_kinds.append("sync")
+        self.generic_visit(node)
+        self._func_kinds.pop()
+
+    def _in_async(self) -> bool:
+        return bool(self._func_kinds) and self._func_kinds[-1] == "async"
+
+    # -- RL101 / RL201: with-statement lock tracking -------------------------
+
+    def _visit_with(self, node, is_async: bool):
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            if _is_lockish(expr):
+                lock = self._lock_id(expr)
+                suppressed = "RL201" in self.ctx.line_disables.get(
+                    node.lineno, set()
+                )
+                for held, _a in self._held_locks:
+                    self.lock_edges.append(LockEdge(
+                        held, lock, self.ctx.relpath, node.lineno,
+                        self._symbol(), suppressed,
+                    ))
+                self._held_locks.append((lock, is_async))
+                acquired.append(lock)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held_locks.pop()
+
+    def visit_With(self, node):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node, is_async=True)
+
+    def _held_sync_locks(self) -> list[str]:
+        return [lock for lock, is_async in self._held_locks if not is_async]
+
+    def visit_Await(self, node):
+        held = self._held_sync_locks()
+        if self._in_async() and held:
+            self._emit(
+                node, "RL101",
+                f"await while holding sync lock {held[-1]!r}: every thread "
+                "and task contending for the lock stalls until this "
+                "coroutine resumes",
+            )
+        # The awaited call produced an awaitable — by definition not a
+        # blocking call (asyncio.Event.wait, asyncio.Queue.get, ...).
+        if isinstance(node.value, ast.Call):
+            self._awaited_calls.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- RL102: blocking calls in async frames -------------------------------
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "sleep":
+                return "time.sleep"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        base = _base_ident(func.value)
+        base_parts = _ident_parts(base) if base else set()
+        if attr == "sleep" and base == "time":
+            return "time.sleep"
+        if base == "ray_tpu" and attr in ("get", "wait"):
+            return f"blocking ray_tpu.{attr}"
+        if attr == "acquire" and _is_lockish(func.value):
+            if not _call_is_nonblocking(node):
+                return "blocking lock.acquire"
+            return None
+        if attr in ("get", "put") and base_parts & {"queue", "q"}:
+            if not _call_is_nonblocking(node):
+                return f"blocking queue.{attr}"
+            return None
+        if base == "subprocess" and attr in (
+            "run", "call", "check_call", "check_output"
+        ):
+            return f"subprocess.{attr}"
+        if base == "os" and attr in ("system", "waitpid"):
+            return f"os.{attr}"
+        if attr == "result" and (
+            isinstance(func.value, ast.Call) or base_parts & {"fut", "future"}
+        ):
+            return "Future.result"
+        if attr == "join" and base_parts & {"thread", "threads", "proc",
+                                            "process"}:
+            return "thread/process join"
+        if attr == "wait" and (
+            _is_lockish(func.value)
+            or base_parts & {"event", "ev", "evt", "done", "started", "cond"}
+        ):
+            return "blocking wait"
+        if attr in ("recv", "recvfrom", "accept"):
+            return f"blocking socket.{attr}"
+        return None
+
+    _ASYNC_HELPERS = {
+        "wait_for", "gather", "shield", "create_task", "ensure_future",
+        "run_coroutine_threadsafe", "as_completed", "wait",
+    }
+
+    def visit_Call(self, node: ast.Call):
+        # Calls handed to asyncio combinators are coroutine factories, not
+        # blocking calls: asyncio.wait_for(ev.wait(), t), gather(q.get(), ...).
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in self._ASYNC_HELPERS
+            and _base_ident(func.value) in ("asyncio", "aio")
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Call):
+                    self._awaited_calls.add(id(arg))
+        if self._in_async() and id(node) not in self._awaited_calls:
+            reason = self._blocking_reason(node)
+            if reason is not None:
+                self._emit(
+                    node, "RL102",
+                    f"{reason} inside an async frame blocks the whole event "
+                    "loop; await an async equivalent or push it through "
+                    "run_in_executor",
+                )
+        self._check_mutator_call(node)
+        self.generic_visit(node)
+
+    # -- RL301: aliased mutation ---------------------------------------------
+
+    def _container_root(self, expr: ast.expr) -> Optional[str]:
+        """The parameter a container expression is rooted at, if any: for
+        `acc`, `acc[k]`, `spec["config"]` (spec already derived) -> the
+        original parameter name."""
+        root = _root_name(expr)
+        if root is None or root in ("self", "cls"):
+            return None
+        if root in getattr(self, "_params", set()):
+            return root
+        return self._derived.get(root)
+
+    def _derivation_root(self, expr: ast.expr) -> Optional[str]:
+        """If `expr` reaches INTO a parameter-owned object (subscript /
+        .get() / attribute off a param or an existing alias), the root
+        parameter name. A bare `x = param` alias is NOT a derivation — direct
+        parameter mutation is the function's business."""
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Attribute) and expr.func.attr == "get":
+                return self._container_root(expr.func.value)
+            return None
+        if isinstance(expr, ast.Subscript):
+            return self._container_root(expr)
+        # NOTE: a pure attribute path (`param.attr`) does NOT taint — mutating
+        # a parameter's own sub-structure is the function's business; the bug
+        # class is objects pulled OUT of caller-owned containers.
+        if isinstance(expr, ast.Name):
+            return self._derived.get(expr.id)
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        # Track aliases first, then look for stores through existing aliases.
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                root = self._derivation_root(node.value)
+                if root is not None:
+                    self._derived[target.id] = root
+                else:
+                    self._derived.pop(target.id, None)
+                self._locals.add(target.id)
+            elif isinstance(target, (ast.Attribute, ast.Subscript)):
+                self._check_store(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, (ast.Attribute, ast.Subscript)):
+            self._check_store(node.target, node)
+        self.generic_visit(node)
+
+    def _check_store(self, target, node):
+        """`x.attr = v` / `x[k] = v` where x aliases caller-owned state."""
+        base = target.value if isinstance(
+            target, (ast.Attribute, ast.Subscript)
+        ) else None
+        if base is None:
+            return
+        if isinstance(base, ast.Name):
+            name = base.id
+            if name in self._derived:
+                self._emit(
+                    node, "RL301",
+                    f"in-place mutation of {name!r}, an alias into "
+                    f"caller-owned state (via parameter "
+                    f"{self._derived[name]!r}); copy before overriding "
+                    "(dataclasses.replace / copy.deepcopy)",
+                )
+            elif (
+                name in self._module_mutables
+                and name not in self._locals
+                and self._func_kinds
+                and not self._held_locks
+            ):
+                self._emit(
+                    node, "RL301",
+                    f"in-place mutation of module-level {name!r} outside any "
+                    "lock: shared across threads and callers",
+                )
+            return
+        # x[k].attr = v / param[k].attr = v  — mutation through a deep path
+        # rooted at a parameter.
+        root = self._derivation_root(target.value)
+        if root is not None:
+            self._emit(
+                node, "RL301",
+                f"in-place mutation through caller-owned state (parameter "
+                f"{root!r}); copy the object before overriding",
+            )
+
+    def _check_mutator_call(self, node: ast.Call):
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _MUTATOR_METHODS):
+            return
+        base = func.value
+        if isinstance(base, ast.Name):
+            name = base.id
+            if name in self._derived:
+                self._emit(
+                    node, "RL301",
+                    f".{func.attr}() mutates {name!r}, an alias into "
+                    f"caller-owned state (via parameter "
+                    f"{self._derived[name]!r}); copy before mutating",
+                )
+            elif (
+                name in self._module_mutables
+                and name not in self._locals
+                and self._func_kinds
+                and not self._held_locks
+            ):
+                self._emit(
+                    node, "RL301",
+                    f".{func.attr}() mutates module-level {name!r} outside "
+                    "any lock: shared across threads and callers",
+                )
+
+    # -- RL401: swallowed exceptions -----------------------------------------
+    # Scope (framework-aware): RPC handlers (`rpc_*` methods) and async
+    # control-plane frames — the places where a silently dropped error turns
+    # into a hung call or a stuck reconcile loop. Best-effort teardown
+    # (`try: x.close() except Exception: pass`) is exempt: failing to close a
+    # dying resource is not an error worth surfacing.
+
+    _TEARDOWN_CALLS = {
+        "close", "cancel", "shutdown", "kill", "terminate", "unlink",
+        "release", "join", "stop", "disconnect", "destroy", "flush",
+        "print_exc", "remove", "rmtree",
+    }
+
+    def visit_Try(self, node: ast.Try):
+        teardown = self._is_teardown_try(node)
+        for handler in node.handlers:
+            if (
+                not teardown
+                and self._in_handler_scope()
+                and self._is_broad(handler.type)
+                and self._swallows(handler)
+            ):
+                self._scope_emit_handler(handler)
+        self.generic_visit(node)
+
+    def _scope_emit_handler(self, handler: ast.ExceptHandler):
+        self._emit(
+            handler, "RL401",
+            "broad except in an RPC/control-plane handler silently swallows "
+            "the error: re-raise, fail the call, log, or leave a comment "
+            "saying why dropping it is safe",
+        )
+
+    def _in_handler_scope(self) -> bool:
+        if not self._func_kinds:
+            return False
+        if self._func_kinds[-1] == "async":
+            return True
+        func_names = [s for s in self._scope if s not in self._class_stack]
+        return bool(func_names) and func_names[-1].startswith("rpc_")
+
+    def _is_teardown_try(self, node: ast.Try) -> bool:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Pass):
+                continue
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)):
+                return False
+            func = stmt.value.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if name not in self._TEARDOWN_CALLS:
+                return False
+        return True
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Name):
+            return type_node.id in _BROAD_EXC
+        if isinstance(type_node, ast.Tuple):
+            return any(
+                isinstance(e, ast.Name) and e.id in _BROAD_EXC
+                for e in type_node.elts
+            )
+        return False
+
+    def _swallows(self, node: ast.ExceptHandler) -> bool:
+        for stmt in node.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)
+            ):
+                continue
+            return False  # any real statement counts as handling
+        # An explanatory comment anywhere in the handler is documentation.
+        end = node.body[-1].end_lineno or node.body[-1].lineno
+        for line in range(node.lineno, end + 1):
+            if line in self.ctx.comment_lines:
+                return False
+        return True
+
+    # -- RL501: discarded remote/execute results -----------------------------
+
+    def visit_Expr(self, node: ast.Expr):
+        call = node.value
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in _DISCARDED_CALL_ATTRS
+        ):
+            self._emit(
+                node, "RL501",
+                f".{call.func.attr}() result discarded: unread refs leak "
+                "capacity (compiled DAGs wedge at max_inflight) and hide "
+                "failures; get/await it, keep it for later, or release() it",
+            )
+        self.generic_visit(node)
+
+
+def check_file(ctx: FileContext) -> tuple[list[Finding], list[LockEdge]]:
+    checker = _Checker(ctx).check_module()
+    return checker.findings, checker.lock_edges
+
+
+def lock_cycle_findings(edges: list[LockEdge]) -> list[Finding]:
+    """RL201 over the union of every file's acquisition-order edges.
+
+    Suppressing an edge's `with` line (`# raylint: disable=RL201`) removes
+    the edge from the graph — the suppression is a claim that this nesting
+    cannot run concurrently with the reverse order."""
+    graph: dict[str, set[str]] = {}
+    witness: dict[tuple[str, str], LockEdge] = {}
+    for e in edges:
+        if e.suppressed:
+            continue
+        graph.setdefault(e.src, set()).add(e.dst)
+        witness.setdefault((e.src, e.dst), e)
+
+    findings: list[Finding] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cycle = tuple(sorted(path))
+                    if cycle in seen_cycles:
+                        continue
+                    seen_cycles.add(cycle)
+                    e = witness[(path[-1], start)]
+                    order = " -> ".join(path + [start])
+                    findings.append(Finding(
+                        e.relpath, e.line, "RL201",
+                        f"lock acquisition-order cycle: {order} — two "
+                        "threads taking these locks in opposite orders "
+                        "deadlock",
+                        "|".join(cycle),
+                    ))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    for start in sorted(graph):
+        dfs(start)
+    return findings

@@ -1,0 +1,92 @@
+"""raylint command line: `python -m ray_tpu.devtools.raylint <paths...>`.
+
+Exit status: 0 when every finding is suppressed or baselined, 1 otherwise
+(2 for usage errors). Output is one `file:line CODE message` per violation —
+the format the tier-1 gate and editors both consume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ray_tpu.devtools.raylint.core import (
+    CODES,
+    emit_baseline,
+    lint_paths,
+    load_baseline,
+    partition_baselined,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="raylint",
+        description="framework-aware static analysis for the ray_tpu "
+                    "control plane",
+    )
+    parser.add_argument("paths", nargs="*", default=["ray_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: the checked-in "
+                             "ray_tpu/devtools/raylint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report grandfathered findings too")
+    parser.add_argument("--emit-baseline", action="store_true",
+                        help="print a baseline JSON scaffold for the current "
+                             "findings and exit 0 (justifications must be "
+                             "filled in by hand)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated codes to run (default: all)")
+    parser.add_argument("--codes", action="store_true",
+                        help="list checker codes and exit")
+    parser.add_argument("--show-stale", action="store_true",
+                        help="also report baseline entries that no longer "
+                             "match any finding")
+    args = parser.parse_args(argv)
+
+    if args.codes:
+        for code in sorted(CODES):
+            print(f"{code}  {CODES[code]}")
+        return 0
+
+    codes = None
+    if args.select:
+        codes = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = codes - set(CODES)
+        if unknown:
+            print(f"unknown code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(args.paths, codes=codes)
+
+    if args.emit_baseline:
+        json.dump(emit_baseline(findings), sys.stdout, indent=2)
+        print()
+        return 0
+
+    entries = [] if args.no_baseline else load_baseline(args.baseline)
+    violations, grandfathered, stale = partition_baselined(findings, entries)
+
+    for f in violations:
+        print(f.render())
+    if args.show_stale:
+        for e in stale:
+            print(
+                f"stale baseline entry: {e.get('file')} {e.get('code')} "
+                f"{e.get('symbol')} ({e.get('reason')})",
+                file=sys.stderr,
+            )
+    if violations:
+        print(
+            f"raylint: {len(violations)} violation(s) "
+            f"({len(grandfathered)} baselined)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

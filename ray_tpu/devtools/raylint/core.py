@@ -1,0 +1,260 @@
+"""raylint engine: file walking, suppression comments, baseline matching.
+
+The checkers themselves live in `checkers.py`; this module owns everything
+that makes their findings actionable as a CI gate: stable finding identity,
+`# raylint: disable=` comments, and the checked-in baseline of grandfathered
+findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable
+
+#: code -> one-line contract (the CLI prints this for --codes).
+CODES: dict[str, str] = {
+    "RL101": "await-under-lock: `await` inside a sync `with <lock>:` body "
+             "stalls every other task contending for the lock",
+    "RL102": "blocking-in-async: blocking call (time.sleep, queue.get, "
+             "lock.acquire, ray_tpu.get, subprocess, fut.result) inside an "
+             "`async def` body stalls the whole event loop",
+    "RL201": "lock-order-cycle: cycle in the static lock acquisition-order "
+             "graph (nested `with` acquisitions) — a deadlock waiting for "
+             "the right interleaving",
+    "RL301": "aliased-mutation: in-place mutation of an object reached "
+             "through a caller-owned container/parameter without copying it "
+             "first — overrides leak into the caller's shared state",
+    "RL302": "mutable-default: dataclass field(default=<mutable>) is one "
+             "object shared by every instance",
+    "RL401": "swallowed-exception: broad `except` whose body neither "
+             "re-raises, logs, returns a value, nor explains itself",
+    "RL501": "unreleased-ref: `.remote()`/`execute()` result discarded "
+             "without get/await/release — leaks capacity or hides failures",
+}
+
+_DISABLE_MARK = "raylint:"
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # normalized, package-relative posix path
+    line: int
+    code: str
+    message: str
+    symbol: str        # enclosing "Class.func" / "func" / "<module>"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.code} {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Everything a checker needs about one source file."""
+
+    abspath: str
+    relpath: str                       # package-relative posix path
+    source: str
+    tree: ast.AST
+    # line -> set of disabled codes ("*" disables all) for that line.
+    line_disables: dict[int, set[str]] = field(default_factory=dict)
+    file_disables: set[str] = field(default_factory=set)
+    # lines (1-based) that contain any comment text — RL401 treats an
+    # explanatory comment inside a handler as documentation.
+    comment_lines: set[int] = field(default_factory=set)
+
+
+def normalize_path(abspath: str) -> str:
+    """Path relative to the directory holding the top-level package, so
+    baseline entries survive checkouts at different roots. Files outside any
+    package (no __init__.py chain) normalize to their basename."""
+    abspath = os.path.abspath(abspath)
+    d = os.path.dirname(abspath)
+    root = None
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        root = d
+        d = os.path.dirname(d)
+        if d == root:  # filesystem root guard
+            break
+    if root is None:
+        return os.path.basename(abspath)
+    return os.path.relpath(abspath, os.path.dirname(root)).replace(os.sep, "/")
+
+
+def _parse_suppressions(ctx: FileContext) -> None:
+    """Collect `# raylint: disable=RLxxx[,RLyyy]` comments.
+
+    A trailing comment suppresses its own line; a standalone comment line
+    suppresses the next non-comment line. `# raylint: disable-file=RLxxx`
+    anywhere suppresses the code for the whole file."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(ctx.source).readline)
+        comments = []
+        code_lines = set()
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.line, tok.string))
+                ctx.comment_lines.add(tok.start[0])
+            elif tok.type not in (
+                tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                tokenize.DEDENT, tokenize.ENCODING, tokenize.ENDMARKER,
+            ):
+                code_lines.add(tok.start[0])
+    except tokenize.TokenError:
+        return
+    for lineno, line, text in comments:
+        body = text.lstrip("#").strip()
+        if not body.startswith(_DISABLE_MARK):
+            continue
+        directive = body[len(_DISABLE_MARK):].strip()
+        # Anything after the code list is a justification, e.g.
+        # `# raylint: disable=RL501 (idempotent fire-and-forget)`.
+        for kind, target in (("disable-file=", ctx.file_disables), ):
+            if directive.startswith(kind):
+                codes = directive[len(kind):].split(None, 1)[0]
+                target.update(c.strip() for c in codes.split(",") if c.strip())
+                break
+        else:
+            if directive.startswith("disable="):
+                raw_codes = directive[len("disable="):].split(None, 1)[0]
+                codes = {
+                    c.strip() for c in raw_codes.split(",") if c.strip()
+                }
+                # Standalone comment -> applies to the next code line; trailing
+                # comment -> applies to its own line.
+                target_line = lineno
+                if lineno not in code_lines:
+                    nxt = [ln for ln in code_lines if ln > lineno]
+                    target_line = min(nxt) if nxt else lineno
+                ctx.line_disables.setdefault(target_line, set()).update(codes)
+
+
+def _is_suppressed(ctx: FileContext, f: Finding) -> bool:
+    if f.code in ctx.file_disables or "*" in ctx.file_disables:
+        return True
+    disabled = ctx.line_disables.get(f.line, set())
+    return f.code in disabled or "*" in disabled
+
+
+def _lint_one(abspath: str):
+    """-> (findings, lock_edges) for one file, suppressions applied.
+
+    RL201 is cross-file: edges are returned for the caller to aggregate into
+    one acquisition-order graph per run."""
+    with open(abspath, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=abspath)
+    except SyntaxError as e:
+        return [Finding(normalize_path(abspath), e.lineno or 0, "RL000",
+                        f"syntax error: {e.msg}", "<module>")], []
+    ctx = FileContext(abspath=abspath, relpath=normalize_path(abspath),
+                      source=source, tree=tree)
+    _parse_suppressions(ctx)
+    from ray_tpu.devtools.raylint import checkers
+
+    findings, edges = checkers.check_file(ctx)
+    return [f for f in findings if not _is_suppressed(ctx, f)], edges
+
+
+def lint_file(abspath: str, codes: set[str] | None = None) -> list[Finding]:
+    """Lint one file (including its own lock graph)."""
+    return lint_paths([abspath], codes=codes)
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.abspath(os.path.join(dirpath, name)))
+    return out
+
+
+def lint_paths(paths: Iterable[str],
+               codes: set[str] | None = None) -> list[Finding]:
+    from ray_tpu.devtools.raylint import checkers
+
+    findings: list[Finding] = []
+    all_edges = []
+    for abspath in iter_python_files(paths):
+        file_findings, edges = _lint_one(abspath)
+        findings.extend(file_findings)
+        all_edges.extend(edges)
+    findings.extend(checkers.lock_cycle_findings(all_edges))
+    if codes:
+        findings = [f for f in findings if f.code in codes]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+# -- baseline -----------------------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    """Baseline entries: {"file", "code", "symbol", "reason"}. `symbol` may be
+    "*" to cover a whole file+code pair. One entry grandfathers every finding
+    it matches — line numbers are deliberately not part of the identity so
+    unrelated edits don't churn the baseline."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("entries", []))
+
+
+def _matches(entry: dict, f: Finding) -> bool:
+    return (
+        entry.get("code") == f.code
+        and entry.get("file") == f.path
+        and entry.get("symbol") in ("*", f.symbol)
+    )
+
+
+def partition_baselined(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """-> (violations, grandfathered, stale_entries)."""
+    violations, grandfathered = [], []
+    used = [False] * len(entries)
+    for f in findings:
+        hit = False
+        for i, entry in enumerate(entries):
+            if _matches(entry, f):
+                used[i] = True
+                hit = True
+                break
+        (grandfathered if hit else violations).append(f)
+    stale = [e for i, e in enumerate(entries) if not used[i]]
+    return violations, grandfathered, stale
+
+
+def emit_baseline(findings: list[Finding]) -> dict:
+    """Scaffold a baseline document from current findings (reasons must be
+    filled in by hand — an unjustified entry defeats the point)."""
+    seen = set()
+    entries = []
+    for f in findings:
+        key = (f.path, f.code, f.symbol)
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append({
+            "file": f.path, "code": f.code, "symbol": f.symbol,
+            "reason": "TODO: justify",
+        })
+    return {"entries": entries}

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 import time
 import uuid
 from multiprocessing import shared_memory
@@ -65,7 +66,8 @@ class Channel:
             )
             self._owner = True
             self._shm.buf[: self._ctrl] = bytes(self._ctrl)
-            _created_here.add(self._shm.name)
+            with _registry_lock:
+                _created_here.add(self._shm.name)
         else:
             self._shm = shared_memory.SharedMemory(name=_name)
             self._owner = False
@@ -220,6 +222,11 @@ class _RingState:
 
 _rpc_rings: dict = {}  # channel name -> _RingState (writer process only)
 _conn_cache: dict = {}  # (host, port) -> rpc.Connection (reader process)
+# Guards get-or-create on the registries above: channels are touched from the
+# driver thread, DAG reader/writer threads, and the RPC io thread at once — a
+# lost _RingState race would strand a writer's acks, a lost conn race leaks a
+# socket per edge.
+_registry_lock = threading.Lock()
 
 
 def _ring_pull(name: str, reader: int, index: int):
@@ -297,12 +304,13 @@ class RpcChannel:
 
     # -- writer (runs in the owner process) --------------------------------
     def _ring(self) -> _RingState:
-        ring = _rpc_rings.get(self._name)
-        if ring is None:
-            ring = _rpc_rings[self._name] = _RingState(
-                self._num_readers, self._num_slots
-            )
-        return ring
+        with _registry_lock:
+            ring = _rpc_rings.get(self._name)
+            if ring is None:
+                ring = _rpc_rings[self._name] = _RingState(
+                    self._num_readers, self._num_slots
+                )
+            return ring
 
     def write(self, value: Any, timeout: Optional[float] = None):
         self.write_bytes(
@@ -349,15 +357,19 @@ class RpcChannel:
                 raise ChannelClosed()
         # One socket per (process, writer address), shared by every channel
         # view into that writer — k edges into one stage must not open k conns.
-        cached = _conn_cache.get(addr)
-        if cached is not None and not cached.closed:
-            self._conn = cached
-            return cached
-        self._conn = w.io.run(
-            rpc.connect(*addr, handler=w, name=f"chan->{addr[1]}")
-        )
-        _conn_cache[addr] = self._conn
-        return self._conn
+        with _registry_lock:
+            cached = _conn_cache.get(addr)
+            if cached is not None and not cached.closed:
+                self._conn = cached
+                return cached
+            # Connect under the lock: a losing racer must share this socket,
+            # not dial its own (the connect runs on the io thread; this
+            # caller thread just blocks on the handshake).
+            self._conn = w.io.run(
+                rpc.connect(*addr, handler=w, name=f"chan->{addr[1]}")
+            )
+            _conn_cache[addr] = self._conn
+            return self._conn
 
     def read(self, timeout: Optional[float] = None) -> Any:
         return cloudpickle.loads(self.read_bytes(timeout))

@@ -142,7 +142,7 @@ class JobSubmissionClient:
                 "entrypoint": entrypoint, "message": "", "updated_at": time.time(),
             }).encode(), True,
         )
-        supervisor.run.remote()  # fire and forget; status lands in KV
+        supervisor.run.remote()  # raylint: disable=RL501 (fire-and-forget; status lands in KV)
         return job_id
 
     def _info(self, job_id: str) -> Optional[dict]:

@@ -87,7 +87,7 @@ class DPLLMServer(LLMServer):
 
     def __del__(self):
         try:
-            self._assigner.release.remote(self._replica_token)
+            self._assigner.release.remote(self._replica_token)  # raylint: disable=RL501 (__del__ cannot block; assigner audits stale tokens)
         except Exception:
             pass
 

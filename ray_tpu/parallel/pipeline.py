@@ -10,9 +10,13 @@ differentiable, so jax.grad produces the backward pipeline (reversed ppermutes) 
 gradients accumulated across microbatches automatically.
 
 Schedule: plain GPipe fill-drain. The bubble fraction is (S-1)/(M+S-1); pick
-num_microbatches >= ~4x the stage count. The head/loss computation is SKIPPED
-(lax.cond) on every stage but the last and on fill ticks — only real collect
-ticks pay the head matmul.
+num_microbatches >= ~4x the stage count. The head/loss pass runs ONCE after
+the tick scan, as a sequential lax.map over the M collected microbatches with
+non-final stages masked out: every stage executes the identical collective
+sequence (a per-stage lax.cond skip would deadlock — the replicated head
+params' gradient psum would run inside a branch only the last stage takes),
+and the sequential map keeps exactly one microbatch's [b, T, V] logits live
+at a time instead of materializing all M at once.
 
 Composition (round 5): pp (and dp) are MANUAL shard_map axes — the ppermute
 schedule needs them — while every other mesh axis (tp, sp, ...) stays AUTO
@@ -37,9 +41,23 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8 top-level; fall back to the experimental location
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names):
+    """shard_map with a manual-axes subset, across jax versions: newer jax
+    spells it `axis_names={...}`; 0.4.x spells the complement `auto={...}`
+    (and type-checks replication of the manually-psummed outputs too eagerly,
+    hence check_rep=False)."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, axis_names=axis_names)
+    except TypeError:
+        auto = frozenset(mesh.axis_names) - set(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, auto=auto)
 
 
 class PipelineState(struct.PyTreeNode):
@@ -132,6 +150,8 @@ def build_pipeline_loss(
         vary = tuple(a for a in manual if mesh.shape.get(a, 1) > 1)
 
         def ensure_vary(x):
+            if not hasattr(jax, "typeof"):
+                return x  # pre-vma jax: scan carries carry no varying manner
             have = getattr(jax.typeof(x), "vma", frozenset())
             missing = tuple(a for a in vary if a not in have)
             if not missing:
@@ -143,13 +163,17 @@ def build_pipeline_loss(
         x0 = ensure_vary(jnp.zeros_like(embeds[0]))
         outs0 = ensure_vary(jnp.zeros_like(embeds))  # [M, b, T, E]
         (_, outs), _ = lax.scan(tick, (x0, outs0), jnp.arange(M + S - 1))
-        # One vmapped head pass over the M collected microbatches; only the
-        # last stage's buffer holds real pipeline outputs, so mask the rest
+        # One head pass over the M collected microbatches; only the last
+        # stage's buffer holds real pipeline outputs, so mask the rest
         # (uniform compute + collectives across stages; the gradient wrt the
-        # replicated head params psums at the shard_map boundary).
-        per_mb = jax.vmap(
-            lambda o, tgt: head_loss_fn(params["head"], o, tgt)
-        )(outs, mb_targets)
+        # replicated head params psums at the shard_map boundary). lax.map —
+        # not vmap — so a single microbatch's [b, T, V] logits are live at a
+        # time: a vmapped head materializes all M logit tensors at once
+        # (M=8, T=2048, V=128k bf16 ~ 4 GB per stage).
+        per_mb = lax.map(
+            lambda ot: head_loss_fn(params["head"], ot[0], ot[1]),
+            (outs, mb_targets),
+        )
         loss_sum = jnp.where(stage == S - 1, jnp.sum(per_mb), 0.0)
         # Share the last stage's loss with every pp rank, then average the
         # per-dp-shard means into the global mean.

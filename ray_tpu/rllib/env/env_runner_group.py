@@ -57,7 +57,7 @@ class EnvRunnerGroup:
             self._seed, index, self._e2m_blob, self._m2e_blob,
         )
         if self._connector_state is not None:
-            runner.set_connector_state.remote(self._connector_state)
+            runner.set_connector_state.remote(self._connector_state)  # raylint: disable=RL501 (ordered before first sample; sample surfaces errors)
         return runner
 
     def __len__(self):
@@ -114,7 +114,7 @@ class EnvRunnerGroup:
     def _resubmit(self, i: int) -> None:
         r = self._runners[i]
         if self._weights_ref is not None and self._runner_version[i] != self._weights_version:
-            r.set_weights.remote(self._weights_ref)  # ordered before the sample
+            r.set_weights.remote(self._weights_ref)  # raylint: disable=RL501 (ordered before the sample, which surfaces errors)
             self._runner_version[i] = self._weights_version
         self._inflight[r.sample.remote(self._async_timesteps)] = i
 
@@ -166,7 +166,7 @@ class EnvRunnerGroup:
             self._connector_state, [d for d in deltas if d is not None]
         )
         for r in self._runners:
-            r.set_connector_state.remote(self._connector_state)
+            r.set_connector_state.remote(self._connector_state)  # raylint: disable=RL501 (ordered before next sample, which surfaces errors)
         return self._connector_state
 
     def get_connector_state(self) -> Optional[dict]:

@@ -123,7 +123,7 @@ def _get_or_create_controller():
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, get_if_exists=True,
         max_concurrency=1000,
     ).remote()
-    controller.run_control_loop.remote()  # idempotent fire-and-forget
+    controller.run_control_loop.remote()  # raylint: disable=RL501 (idempotent fire-and-forget loop start)
     return controller
 
 

@@ -96,7 +96,7 @@ class HTTPProxy:
                 self._routes = routes
                 self._streaming = streaming
             except Exception:
-                pass
+                pass  # controller briefly unreachable: serve the last-known routes
             await asyncio.sleep(0.5)
 
     async def _handle_conn(self, reader: asyncio.StreamReader,

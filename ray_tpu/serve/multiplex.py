@@ -76,7 +76,7 @@ class _ModelCache:
                         if inspect.isawaitable(out):
                             await out
                     except Exception:
-                        pass
+                        pass  # a failing user close() hook must not wedge eviction
                     break
 
     async def get(self, model_id: str):

@@ -158,20 +158,29 @@ def _import_target(import_path: str, args: dict):
 
 
 def _apply_overrides(acc: Dict[str, dict], overrides: List[DeploymentSchema],
-                     app_name: str):
-    """Mutate collected deployment specs with the schema's per-deployment
-    overrides; unknown deployment names are config errors (catching typos is
-    the point of a declarative file)."""
+                     app_name: str) -> Dict[str, dict]:
+    """Return a copy of the collected specs with the schema's per-deployment
+    overrides applied; unknown deployment names are config errors (catching
+    typos is the point of a declarative file).
+
+    The input specs alias the imported module's `Deployment.config` dataclass
+    instances, so overridden configs are deep-copied first: a long-lived
+    driver re-applying configs (or later calling plain serve.run on the same
+    app) must never see one apply's overrides leak into the module's state."""
+    import copy
+
     from ray_tpu.serve import AutoscalingConfig
 
+    out = {name: dict(spec) for name, spec in acc.items()}
     for ov in overrides:
-        spec = acc.get(ov.name)
+        spec = out.get(ov.name)
         if spec is None:
             raise ServeConfigError(
                 f"app {app_name!r} has no deployment {ov.name!r}; "
-                f"bound deployments: {sorted(acc)}"
+                f"bound deployments: {sorted(out)}"
             )
-        cfg = spec["config"]
+        cfg = copy.deepcopy(spec["config"])
+        spec["config"] = cfg
         if ov.num_replicas is not None:
             if ov.num_replicas == "auto":
                 cfg.autoscaling_config = (
@@ -192,6 +201,7 @@ def _apply_overrides(acc: Dict[str, dict], overrides: List[DeploymentSchema],
             cfg.user_config = ov.user_config
         if ov.ray_actor_options is not None:
             cfg.ray_actor_options = ov.ray_actor_options
+    return out
 
 
 def apply_config(config: dict, *, wait_ready: bool = False,
@@ -223,7 +233,7 @@ def apply_config(config: dict, *, wait_ready: bool = False,
         app = _import_target(app_schema.import_path, app_schema.args)
         acc: Dict[str, dict] = {}
         _collect_deployments(app, app_schema.name, acc)
-        _apply_overrides(acc, app_schema.deployments, app_schema.name)
+        acc = _apply_overrides(acc, app_schema.deployments, app_schema.name)
         ingress_name = app.deployment.name
         target = app.deployment.target
         call = (target if not _inspect.isclass(target)

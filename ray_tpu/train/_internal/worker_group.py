@@ -248,7 +248,7 @@ class WorkerGroup:
         try:
             for w in self._workers:
                 try:
-                    w.shutdown.remote()
+                    w.shutdown.remote()  # raylint: disable=RL501 (best-effort graceful stop; kill() follows)
                 except Exception:
                     pass
             for w in self._workers:

@@ -210,7 +210,7 @@ class ClientProxy:
                     else:
                         sess.bytes_down += len(chunk)
             except Exception:
-                pass
+                pass  # either side hung up: the finally below closes the tunnel
             finally:
                 try:
                     dst.close()
@@ -250,7 +250,7 @@ class ClientProxy:
                 writer.write(_json_frame(resp))
                 await writer.drain()
         except Exception:
-            pass
+            pass  # malformed/aborted ops connection: just drop it
         finally:
             try:
                 writer.close()

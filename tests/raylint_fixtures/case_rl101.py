@@ -1,0 +1,41 @@
+"""Seeded RL101 violations (await-under-lock). Never imported — lint fodder."""
+
+import asyncio
+import threading
+
+_lock = threading.Lock()
+
+
+class Plane:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._aio_lock = asyncio.Lock()
+
+    async def bad_await_under_lock(self):          # line 14
+        with self._state_lock:
+            await asyncio.sleep(0)                 # RL101 (line 16)
+
+    async def bad_await_under_global_lock(self):
+        with _lock:
+            await asyncio.sleep(0)                 # RL101 (line 20)
+
+    async def suppressed_await_under_lock(self):
+        with self._state_lock:
+            await asyncio.sleep(0)  # raylint: disable=RL101
+
+    async def ok_async_lock(self):
+        async with self._aio_lock:
+            await asyncio.sleep(0)                 # asyncio lock: fine
+
+    async def ok_lock_released_before_await(self):
+        with self._state_lock:
+            x = 1
+        await asyncio.sleep(x)
+
+    def ok_sync_closure_under_async(self):
+        async def outer():
+            def read_one():
+                with self._state_lock:
+                    return 1
+            return read_one
+        return outer

@@ -1,0 +1,57 @@
+"""Seeded RL102 violations (blocking calls in async frames)."""
+
+import queue
+import subprocess
+import threading
+import time
+
+_q: queue.Queue = queue.Queue()
+_lock = threading.Lock()
+
+
+async def bad_sleep():
+    time.sleep(1)                                  # RL102
+
+
+async def bad_queue_get():
+    return _q.get()                                # RL102
+
+
+async def bad_lock_acquire():
+    _lock.acquire()                                # RL102
+
+
+async def bad_subprocess():
+    subprocess.run(["true"])                       # RL102
+
+
+async def bad_ray_get(ray_tpu, ref):
+    return ray_tpu.get(ref)                        # RL102
+
+
+async def suppressed_sleep():
+    time.sleep(1)  # raylint: disable=RL102
+
+
+async def ok_awaited_get(aq):
+    return await aq.get()                          # awaitable, not blocking
+
+
+async def ok_wait_for(ev):
+    import asyncio
+
+    await asyncio.wait_for(ev.wait(), 1)           # coroutine factory arg
+
+
+async def ok_nonblocking():
+    _lock.acquire(blocking=False)
+    return _q.get(block=False)
+
+
+async def ok_executor(loop):
+    return await loop.run_in_executor(None, _q.get)
+
+
+def ok_sync_code():
+    time.sleep(0)
+    return _q.get()

@@ -1,0 +1,35 @@
+"""Seeded RL201 violation: two functions take the same locks in opposite
+orders — the classic deadlock-by-interleaving."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:                  # edge alpha -> beta
+                return 1
+
+    def backward(self):
+        with self._beta_lock:
+            with self._alpha_lock:                 # edge beta -> alpha: cycle
+                return 2
+
+
+class Clean:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock, self._b_lock:
+            return 1
+
+    def two(self):
+        with self._a_lock:
+            with self._b_lock:                     # same order: no cycle
+                return 2

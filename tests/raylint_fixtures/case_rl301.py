@@ -1,0 +1,61 @@
+"""Seeded RL301/RL302 violations (shared-state aliasing, mutable defaults)."""
+
+from dataclasses import dataclass, field
+
+_registry: dict = {}
+
+
+def bad_override(acc):
+    spec = acc.get("model")
+    cfg = spec["config"]
+    cfg.num_replicas = 2                           # RL301: alias into acc
+
+
+def bad_deep_store(acc, value):
+    acc["model"].max_ongoing = value               # RL301: deep path
+
+
+def bad_module_mutation(key, value):
+    _registry[key] = value                         # RL301: no lock held
+
+
+def suppressed_override(acc):
+    spec = acc.get("model")
+    cfg = spec["config"]
+    cfg.num_replicas = 2  # raylint: disable=RL301 (caller passes a copy)
+
+
+def ok_copied_override(acc):
+    import dataclasses
+
+    cfg = dataclasses.replace(acc.get("model")["config"])
+    cfg.num_replicas = 2
+    return cfg
+
+
+def ok_param_own_attr(pg):
+    pg.allocations[0] = None                       # param's own structure
+
+
+def ok_locked_module_mutation(key, value):
+    import threading
+
+    _reg_lock = threading.Lock()
+    with _reg_lock:
+        _registry[key] = value
+
+
+class _Overrides(dict):
+    pass
+
+
+@dataclass
+class BadSchema:
+    name: str = "x"
+    overrides: dict = field(default=_Overrides())  # RL302: shared instance
+
+
+@dataclass
+class OkSchema:
+    name: str = "x"
+    overrides: dict = field(default_factory=dict)

@@ -1,0 +1,55 @@
+"""Seeded RL401 violations (silently swallowed exceptions in handlers)."""
+
+
+class Handlers:
+    # control_loop and rpc_submit below each swallow silently: RL401.
+    async def control_loop(self, conn):
+        try:
+            await conn.call("reconcile")
+        except Exception:
+            pass
+
+    def rpc_submit(self, conn, spec):
+        try:
+            self._run(spec)
+        except Exception:
+            pass
+
+    async def suppressed(self, conn):
+        try:
+            await conn.call("reconcile")
+        except Exception:  # raylint: disable=RL401
+            pass
+
+    async def ok_documented(self, conn):
+        try:
+            await conn.call("reconcile")
+        except Exception:
+            pass  # peer may be mid-restart; next tick retries
+
+    async def ok_logged(self, conn, logger):
+        try:
+            await conn.call("reconcile")
+        except Exception as e:
+            logger.warning("reconcile failed: %s", e)
+
+    async def ok_failure_value(self, conn):
+        try:
+            return await conn.call("probe")
+        except Exception:
+            return False
+
+    async def ok_teardown(self, conn):
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def ok_plain_sync(self):
+        try:
+            self._run(None)
+        except Exception:
+            pass                                   # not handler-scoped
+
+    def _run(self, spec):
+        raise NotImplementedError

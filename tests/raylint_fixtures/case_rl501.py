@@ -1,0 +1,26 @@
+"""Seeded RL501 violations (discarded remote/execute results)."""
+
+
+def bad_fire_and_forget(actor):
+    actor.ping.remote()                            # RL501
+
+
+def bad_dropped_execute(dag, batch):
+    dag.execute(batch)                             # RL501
+
+
+async def bad_dropped_execute_async(dag, batch):
+    dag.execute_async(batch)                       # RL501
+
+
+def suppressed_fire_and_forget(actor):
+    actor.ping.remote()  # raylint: disable=RL501 (liveness probe, errors via next call)
+
+
+def ok_kept_ref(actor):
+    ref = actor.ping.remote()
+    return ref
+
+
+def ok_gotten(ray_tpu, actor):
+    return ray_tpu.get(actor.ping.remote())

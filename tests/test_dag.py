@@ -386,6 +386,66 @@ def test_collective_mean_and_validation():
             )
 
 
+def test_dropped_refs_release_capacity():
+    """Fire-and-forget execute() past max_inflight must NOT wedge the DAG:
+    refs dropped unread mark their slot consumable and the next capacity-bound
+    submit drains them (reference: CompiledDAGRef.__del__ consumes unread
+    results)."""
+    w = Worker.remote()
+    with InputNode() as inp:
+        dag = w.inc.bind(inp)
+    compiled = dag.experimental_compile(max_inflight_executions=3)
+    try:
+        # 3x the bound, every ref dropped on the floor.
+        for i in range(9):
+            compiled.execute(i)  # raylint: disable=RL501 (the wedge under test)
+        # The graph still works and the next read sees the newest round.
+        ref = compiled.execute(100)
+        assert ref.get(timeout=60) == 101
+    finally:
+        compiled.teardown()
+
+
+def test_released_ref_cannot_be_read():
+    w = Worker.remote()
+    with InputNode() as inp:
+        dag = w.inc.bind(inp)
+    compiled = dag.experimental_compile(max_inflight_executions=2)
+    try:
+        ref = compiled.execute(1)
+        ref.release()
+        with pytest.raises(ValueError):
+            ref.get(timeout=5)
+        # The released round's capacity comes back.
+        for i in range(4):
+            r = compiled.execute(i)
+            r.release()
+        ref2 = compiled.execute(7)
+        assert ref2.get(timeout=60) == 8
+    finally:
+        compiled.teardown()
+
+
+def test_dropped_multi_output_refs_release_capacity():
+    """Abandoning only ONE of a round's outputs must also free the round once
+    the other output is read (per-output consumption accounting)."""
+    a, b = Worker.remote(), Worker.remote(bias=10)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.inc.bind(inp), b.inc.bind(inp)])
+    compiled = dag.experimental_compile(max_inflight_executions=2)
+    try:
+        for i in range(5):
+            r1, _r2 = compiled.execute(i)  # _r2 dropped every round
+            assert r1.get(timeout=60) == i + 1
+            del _r2
+        r1, r2 = compiled.execute(50)
+        assert r2.get(timeout=60) == 61
+        r1.release()
+    finally:
+        compiled.teardown()
+
+
+
 def test_compiled_dag_across_two_nodes():
     """A compiled DAG pins loops on actors on TWO nodes: cross-node edges ride
     RpcChannel (ring in the writer, readers pull over direct worker conns) and

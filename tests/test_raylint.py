@@ -1,0 +1,189 @@
+"""raylint: each checker fires on its seeded fixture, honors suppressions,
+respects the baseline — and the shipped tree is clean (the tier-1 gate)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.devtools.raylint import (
+    Finding,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    partition_baselined,
+)
+from ray_tpu.devtools.raylint.cli import main as raylint_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "raylint_fixtures")
+PKG_DIR = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+def _codes_by_symbol(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.symbol.rsplit(".", 1)[-1], set()).add(f.code)
+    return out
+
+
+def _fixture(name):
+    return lint_file(os.path.join(FIXTURES, name))
+
+
+# ---- each checker fires on seeded violations, and only there ---------------
+
+def test_rl101_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl101.py"))
+    assert found.get("bad_await_under_lock") == {"RL101"}
+    assert found.get("bad_await_under_global_lock") == {"RL101"}
+    for sym in ("suppressed_await_under_lock", "ok_async_lock",
+                "ok_lock_released_before_await", "ok_sync_closure_under_async"):
+        assert sym not in found
+
+
+def test_rl102_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl102.py"))
+    for sym in ("bad_sleep", "bad_queue_get", "bad_lock_acquire",
+                "bad_subprocess", "bad_ray_get"):
+        assert found.get(sym) == {"RL102"}, sym
+    for sym in ("suppressed_sleep", "ok_awaited_get", "ok_wait_for",
+                "ok_nonblocking", "ok_executor", "ok_sync_code"):
+        assert sym not in found, sym
+
+
+def test_rl201_fires_on_opposite_order_only():
+    findings = _fixture("case_rl201.py")
+    cycles = [f for f in findings if f.code == "RL201"]
+    assert len(cycles) == 1
+    assert "Store._alpha_lock" in cycles[0].message
+    assert "Store._beta_lock" in cycles[0].message
+    assert "Clean" not in cycles[0].symbol
+
+
+def test_rl201_cross_file_graph(tmp_path):
+    # Opposite acquisition orders living in DIFFERENT files still form a
+    # cycle: the graph is per run, not per file.
+    a = tmp_path / "mod_a.py"
+    b = tmp_path / "mod_b.py"
+    # Lock identity is class-qualified, so a class whose methods live in two
+    # files (mixins, _impl splits) still composes into one graph.
+    a.write_text(
+        "class Pool:\n"
+        "    def fwd(self):\n"
+        "        with self._x_lock:\n"
+        "            with self._y_lock:\n"
+        "                return 1\n"
+    )
+    b.write_text(
+        "class Pool:\n"
+        "    def bwd(self):\n"
+        "        with self._y_lock:\n"
+        "            with self._x_lock:\n"
+        "                return 2\n"
+    )
+    per_file = lint_file(str(a)) + lint_file(str(b))
+    assert not [f for f in per_file if f.code == "RL201"]
+    both = lint_paths([str(tmp_path)])
+    assert [f for f in both if f.code == "RL201"]
+
+
+def test_rl301_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl301.py"))
+    assert found.get("bad_override") == {"RL301"}
+    assert found.get("bad_deep_store") == {"RL301"}
+    assert found.get("bad_module_mutation") == {"RL301"}
+    assert found.get("overrides") == {"RL302"}  # BadSchema.overrides
+    for sym in ("suppressed_override", "ok_copied_override",
+                "ok_param_own_attr", "ok_locked_module_mutation", "OkSchema"):
+        assert sym not in found, sym
+
+
+def test_rl401_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl401.py"))
+    assert found.get("control_loop") == {"RL401"}
+    assert found.get("rpc_submit") == {"RL401"}
+    for sym in ("suppressed", "ok_documented", "ok_logged",
+                "ok_failure_value", "ok_teardown", "ok_plain_sync"):
+        assert sym not in found, sym
+
+
+def test_rl501_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl501.py"))
+    for sym in ("bad_fire_and_forget", "bad_dropped_execute",
+                "bad_dropped_execute_async"):
+        assert found.get(sym) == {"RL501"}, sym
+    for sym in ("suppressed_fire_and_forget", "ok_kept_ref", "ok_gotten"):
+        assert sym not in found, sym
+
+
+# ---- baseline ---------------------------------------------------------------
+
+def test_baseline_grandfathers_by_symbol():
+    findings = _fixture("case_rl501.py")
+    entries = [{"file": "case_rl501.py", "code": "RL501",
+                "symbol": "bad_fire_and_forget", "reason": "test"}]
+    violations, grandfathered, stale = partition_baselined(findings, entries)
+    assert {f.symbol for f in grandfathered} == {"bad_fire_and_forget"}
+    assert all(f.symbol != "bad_fire_and_forget" for f in violations)
+    assert not stale
+
+
+def test_baseline_reports_stale_entries():
+    entries = [{"file": "case_rl501.py", "code": "RL999",
+                "symbol": "nope", "reason": "obsolete"}]
+    _v, _g, stale = partition_baselined(_fixture("case_rl501.py"), entries)
+    assert stale == entries
+
+
+def test_checked_in_baseline_entries_are_justified():
+    for entry in load_baseline():
+        assert entry.get("reason"), f"baseline entry missing reason: {entry}"
+        assert "TODO" not in entry["reason"], entry
+
+
+# ---- the gate: the shipped tree is clean ------------------------------------
+
+def test_shipped_tree_has_zero_nonbaselined_findings():
+    findings = lint_paths([PKG_DIR])
+    violations, _grandfathered, stale = partition_baselined(
+        findings, load_baseline()
+    )
+    assert not violations, "\n" + "\n".join(f.render() for f in violations)
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(actor):\n    actor.ping.remote()\n")
+    assert raylint_main([str(bad)]) == 1
+    good = tmp_path / "good.py"
+    good.write_text("def f(actor):\n    return actor.ping.remote()\n")
+    assert raylint_main([str(good)]) == 0
+
+
+def test_cli_module_entrypoint_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG_DIR],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_emit_baseline(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(actor):\n    actor.ping.remote()\n")
+    assert raylint_main(["--emit-baseline", str(bad)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["entries"] and doc["entries"][0]["code"] == "RL501"
+
+
+def test_disable_file_directive(tmp_path):
+    f = tmp_path / "all_off.py"
+    f.write_text(
+        "# raylint: disable-file=RL501\n"
+        "def f(actor):\n    actor.ping.remote()\n"
+    )
+    assert not lint_file(str(f))

@@ -70,3 +70,36 @@ def test_version_mismatch_fails_calls_crisply():
         await server.close()
 
     asyncio.run(main())
+
+
+def test_legacy_peer_without_hello_warns(caplog):
+    """A pre-handshake peer never sends HELLO — its first _REQUEST must
+    surface a 'legacy peer' warning (detection starts at v1; older builds
+    can't be failed crisply, only diagnosed)."""
+    import logging
+    import pickle
+    import struct
+
+    async def main():
+        server = rpc.RpcServer(lambda conn: _Handler())
+        await server.start()
+        # Hand-rolled pre-v1 client: speaks frames but no HELLO.
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        payload = pickle.dumps((0, 1, "echo", (42,), {}))  # _REQUEST frame
+        writer.write(struct.pack("<Q", len(payload)) + payload)
+        await writer.drain()
+        # The v1 server still answers (payloads happen to be compatible)...
+        header = await asyncio.wait_for(reader.readexactly(8), 10)
+        (length,) = struct.unpack("<Q", header)
+        frames = pickle.loads(await reader.readexactly(length))
+        if frames[0] == 3:  # the server's own HELLO arrives first
+            header = await asyncio.wait_for(reader.readexactly(8), 10)
+            (length,) = struct.unpack("<Q", header)
+            frames = pickle.loads(await reader.readexactly(length))
+        assert frames[:3] == (1, 1, True) and frames[3] == 42
+        writer.close()
+        await server.close()
+
+    with caplog.at_level(logging.WARNING, logger="ray_tpu._private.rpc"):
+        asyncio.run(main())
+    assert any("before any HELLO" in r.message for r in caplog.records)

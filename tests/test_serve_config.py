@@ -172,3 +172,56 @@ def test_build_config_scaffold_roundtrip(tmp_path):
     out.write_text(yaml.safe_dump(config, sort_keys=False))
     serve_schema.apply_config(yaml.safe_load(out.read_text()), wait_ready=True)
     assert serve.get_app_handle("default").remote(2).result() == 5
+
+
+def test_double_apply_does_not_leak_overrides_into_module():
+    """Regression (raylint RL301 / ADVICE round 5): _apply_overrides used to
+    mutate the imported module's Deployment.config in place, so a second
+    apply_config() (or a later plain serve.run) inherited the first apply's
+    overrides. Configs are now copied per apply."""
+    import tests.serve_config_apps as apps_mod
+
+    before_replicas = apps_mod.Doubler.config.num_replicas
+    before_moq = apps_mod.Doubler.config.max_ongoing_requests
+    config = {
+        "applications": [{
+            "name": "main",
+            "route_prefix": "/",
+            "import_path": "tests.serve_config_apps:app",
+            "deployments": [
+                {"name": "Doubler", "num_replicas": 2,
+                 "max_ongoing_requests": 7},
+            ],
+        }]
+    }
+    serve_schema.apply_config(config, wait_ready=True)
+    # The module's Deployment object is untouched by the apply...
+    assert apps_mod.Doubler.config.num_replicas == before_replicas
+    assert apps_mod.Doubler.config.max_ongoing_requests == before_moq
+    # ...and a re-apply starts from the pristine config, not the overridden
+    # one (same outcome, no accumulated state).
+    serve_schema.apply_config(config, wait_ready=True)
+    assert apps_mod.Doubler.config.num_replicas == before_replicas
+    status = serve_schema.status_report()["applications"]["main"]
+    assert status["deployments"]["Doubler"]["target_num_replicas"] == 2
+
+
+def test_apply_overrides_returns_copies():
+    """_apply_overrides is pure: the input spec dict and its config objects
+    are never mutated."""
+    import dataclasses
+
+    import tests.serve_config_apps as apps_mod
+
+    cfg = apps_mod.Doubler.config
+    acc = {"Doubler": {"config": cfg, "name": "Doubler"}}
+    out = serve_schema._apply_overrides(
+        acc,
+        [serve_schema.DeploymentSchema(name="Doubler", num_replicas=5)],
+        "main",
+    )
+    assert acc["Doubler"]["config"] is cfg
+    assert cfg.num_replicas != 5
+    assert out["Doubler"]["config"] is not cfg
+    assert out["Doubler"]["config"].num_replicas == 5
+    assert dataclasses.replace(cfg)  # still a plain dataclass
