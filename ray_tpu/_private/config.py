@@ -82,6 +82,10 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "serve_router_cache_ttl_s": (float, 2.0, "deployment-handle routing-table refresh TTL (scale-ups become visible to existing handles within this window)"),
     "llm_multi_step": (int, 8, "decode tokens per engine dispatch when every active slot is greedy (on-device argmax chunks; 1 disables)"),
     "llm_prefill_bucket_min": (int, 16, "smallest prompt padding bucket for compiled prefill programs"),
+    "llm_kv_block_size": (int, 16, "token rows per paged KV prefix-cache block; prefixes are reused at whole-block granularity (docs/kvcache.md)"),
+    "llm_prefix_cache_bytes": (int, 32 << 20, "host bytes for the per-engine paged KV prefix cache; repeated prompt prefixes attach cached KV and prefill suffix-only (0 disables)"),
+    "llm_max_queue_depth": (int, 256, "engine admission queue cap; submits beyond it raise EngineOverloadedError instead of growing memory unboundedly (0 = unbounded)"),
+    "llm_router_fingerprint_blocks": (int, 8, "prefix blocks hashed into the DP router's per-replica fingerprints for cache-aware routing"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
     "data_output_queue_size": (int, 8, "blocks buffered between the streaming executor and the consuming iterator (backpressure depth)"),

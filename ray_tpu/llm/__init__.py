@@ -18,7 +18,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Union
 
 from ray_tpu import serve
-from ray_tpu.llm._engine import DecodeEngine, SamplingParams
+from ray_tpu.llm._engine import DecodeEngine, EngineOverloadedError, SamplingParams
 
 
 class ByteTokenizer:
@@ -222,6 +222,11 @@ class LLMServer:
     async def model_id(self) -> str:
         return self._config.model_id
 
+    async def cache_stats(self) -> Optional[dict]:
+        """Paged KV prefix-cache counters for this replica's engine (None when
+        the cache is disabled). See docs/kvcache.md."""
+        return self._engine.prefix_cache_stats()
+
     def __del__(self):
         try:
             self._engine.shutdown()
@@ -372,6 +377,7 @@ def build_openai_app(llm_configs: List[LLMConfig]) -> "serve.Application":
 __all__ = [
     "ByteTokenizer",
     "DecodeEngine",
+    "EngineOverloadedError",
     "HFTokenizer",
     "LLMConfig",
     "LLMServer",

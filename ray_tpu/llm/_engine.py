@@ -27,6 +27,12 @@ from ray_tpu.models.transformer import ModelConfig, _rope
 _NEG_INF = -1e30
 
 
+class EngineOverloadedError(RuntimeError):
+    """The engine's admission queue is at its configured depth cap
+    (`llm_max_queue_depth`); the submit was rejected without enqueueing.
+    Callers should shed load or retry with backoff."""
+
+
 @dataclasses.dataclass
 class SamplingParams:
     max_tokens: int = 64
@@ -187,7 +193,9 @@ class DecodeEngine:
                  max_seq: Optional[int] = None, seed: int = 0,
                  lora_config: Optional[dict] = None, decode_loop: bool = True,
                  spec_config: Optional[dict] = None,
-                 multi_step: Optional[int] = None):
+                 multi_step: Optional[int] = None,
+                 prefix_cache=None,
+                 max_queue_depth: Optional[int] = None):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -244,6 +252,34 @@ class DecodeEngine:
         if multi_step is None:
             multi_step = CONFIG.llm_multi_step
         self._multi_step = max(1, int(multi_step))
+        # Paged KV prefix cache (docs/kvcache.md): host-side ref-counted block
+        # pool + radix prefix index. A repeated prompt prefix attaches its
+        # cached KV through the padded-bucket attach path and prefills only
+        # the suffix. prefix_cache=None builds one from the config flags;
+        # False disables; a PrefixCacheManager instance is used as-is.
+        if prefix_cache is None and CONFIG.llm_prefix_cache_bytes > 0:
+            from ray_tpu.llm.kvcache import PrefixCacheManager
+
+            prefix_cache = PrefixCacheManager(
+                CONFIG.llm_kv_block_size, CONFIG.llm_prefix_cache_bytes,
+                name=f"engine-{id(self):x}",
+            )
+        self._prefix_cache = prefix_cache or None
+        # Admission control: submits beyond the depth cap raise
+        # EngineOverloadedError instead of growing _queue unboundedly.
+        if max_queue_depth is None:
+            max_queue_depth = CONFIG.llm_max_queue_depth
+        self._max_queue_depth = max(0, int(max_queue_depth))  # 0 = unbounded
+        from ray_tpu.util.metrics import Gauge
+
+        self._queue_gauge = Gauge(
+            "llm_engine_queue_depth",
+            "requests waiting in the engine admission queue",
+            tag_keys=("engine",),
+        ).set_default_tags({"engine": f"{id(self):x}"})
+        # Diagnostics for benches/tests: shape of the most recent prefill
+        # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only).
+        self.last_prefill: Optional[dict] = None
         self._jit_decode_multi = jax.jit(
             self._decode_multi, static_argnames=("n",)
         )  # jax caches one program per distinct static n
@@ -329,25 +365,30 @@ class DecodeEngine:
         return self._lora_names[lora]
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_one(self, params, lora, tokens, caches, lens, slot, prompt_len,
-                     adapter_id):
-        """tokens: [1, Sbucket] right-padded. Writes slot `slot`'s cache."""
+    def _prefill_at(self, params, lora, tokens, caches, lens, slot, offset,
+                    total_len, adapter_id):
+        """tokens: [1, Sbucket] right-padded, starting at row/position `offset`
+        (0 = whole-prompt prefill; >0 = suffix-only prefill behind a prefix
+        cache hit whose KV was attached to rows [0, offset)). Writes slot
+        `slot`'s cache rows [offset, offset+S). One program per bucket: offset
+        and total_len are traced scalars."""
         S = tokens.shape[1]
-        positions = jnp.arange(S)[None, :]
+        positions = offset + jnp.arange(S)[None, :]
         # one-slot caches view
         slot_caches = [
             (c[0][slot][None], c[1][slot][None]) for c in caches
         ]
-        # visibility: key j <= query i; cache rows beyond the bucket stay invisible
-        mask = (jnp.arange(S)[:, None] >= jnp.arange(self.T)[None, :])[None]
+        # visibility: key row j <= global query position offset+i; attached
+        # prefix rows [0, offset) are all visible, pad rows beyond stay hidden
+        mask = (positions[0][:, None] >= jnp.arange(self.T)[None, :])[None]
         logits, new_slot_caches = _forward_cached(
             params, self.cfg, tokens, positions, slot_caches,
-            jnp.zeros((1,), jnp.int32), mask,
+            offset[None], mask,
             lora=lora, adapter_ids=adapter_id[None],
         )
         out_caches = self._scatter_slot(caches, new_slot_caches, slot)
-        last = logits[0, prompt_len - 1]
-        lens = lens.at[slot].set(prompt_len)
+        last = logits[0, total_len - 1 - offset]
+        lens = lens.at[slot].set(total_len)
         return last, out_caches, lens
 
     def _decode_step(self, params, lora, adapter_ids, last_token, caches, lens):
@@ -542,6 +583,30 @@ class DecodeEngine:
             s.tokens.append(token)
             self._emit(slot, token)
 
+    def _insert_prompt_kv(self, slot: int, prompt: List[int], adapter: int,
+                          cached_offset: int):
+        """Populate the prefix cache from the slot's freshly prefilled rows.
+        Skips when the prompt has no full block beyond what the cache already
+        held (cached_offset tokens)."""
+        bs = self._prefix_cache.block_size
+        n = (len(prompt) // bs) * bs
+        if n == 0 or n <= cached_offset:
+            return
+        # Host readback of rows [0, n): [L, 2, n, Hkv, D]. The already-cached
+        # prefix rides along (the radix walk dedups it without copying).
+        kv = np.stack([
+            np.stack([np.asarray(ck[slot, :n]), np.asarray(cv[slot, :n])])
+            for ck, cv in self._caches
+        ])
+        self._prefix_cache.insert(prompt[:n], kv, namespace=adapter)
+
+    def prefix_cache_stats(self) -> Optional[dict]:
+        """Hit/eviction/residency counters of the paged KV prefix cache
+        (None when the cache is disabled). See docs/kvcache.md."""
+        if self._prefix_cache is None:
+            return None
+        return self._prefix_cache.stats()
+
     def _attach_kv(self, caches, kv, slot):
         """Write a transferred KV prefix into slot's cache rows [0, P).
         kv: [L, 2, P, Hkv, D] (P = padded prefix bucket)."""
@@ -557,19 +622,48 @@ class DecodeEngine:
         return out
 
     # -- public API --------------------------------------------------------
+    def _enqueue(self, item):
+        """Bounded admission: reject at the depth cap instead of growing the
+        queue (and resident prompt copies) without limit under overload."""
+        with self._lock:
+            if self._max_queue_depth and len(self._queue) >= self._max_queue_depth:
+                depth = len(self._queue)
+                raise EngineOverloadedError(
+                    f"engine admission queue is full ({depth} >= "
+                    f"llm_max_queue_depth={self._max_queue_depth}); shed load "
+                    f"or retry with backoff"
+                )
+            self._queue.append(item)
+            depth = len(self._queue)
+        self._queue_gauge.set(float(depth))
+
     def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
                lora: str = ""):
-        """callback(token_id: int, finished: bool) per generated token."""
+        """callback(token_id: int, finished: bool) per generated token.
+
+        Raises ValueError when the prompt cannot fit the engine's sequence
+        budget (it is never silently truncated), and EngineOverloadedError
+        when the admission queue is at its depth cap."""
+        token_ids = list(token_ids)
+        if len(token_ids) > self.T - 1:
+            raise ValueError(
+                f"prompt of {len(token_ids)} tokens exceeds this engine's "
+                f"max_seq={self.T} budget (prompt_len <= max_seq - 1 so at "
+                f"least one token can be generated); truncate the prompt "
+                f"client-side or raise max_seq"
+            )
         adapter = self._adapter_index(lora)
-        with self._lock:
-            self._queue.append(("prompt", list(token_ids), sampling, callback, adapter))
+        self._enqueue(("prompt", token_ids, sampling, callback, adapter))
 
     def submit_prefilled(self, kv: np.ndarray, prompt_len: int,
                          first_logits: np.ndarray, sampling: SamplingParams,
-                         callback, lora: str = ""):
+                         callback, lora: str = "",
+                         token_ids: Optional[List[int]] = None):
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
-        transferred cache prefix, first_logits the last-position logits."""
+        transferred cache prefix, first_logits the last-position logits.
+        token_ids (optional, the prompt behind kv) lets the transferred
+        prefix be inserted into this engine's KV prefix cache."""
         if prompt_len >= self.T:
             raise ValueError(
                 f"transferred KV prefix of {prompt_len} tokens does not fit this "
@@ -577,55 +671,146 @@ class DecodeEngine:
                 f"max_seq (build_pd_openai_app shares one config)"
             )
         adapter = self._adapter_index(lora)
-        with self._lock:
-            self._queue.append(
-                ("prefilled", kv, int(prompt_len), first_logits, sampling, callback,
-                 adapter)
-            )
+        self._enqueue(
+            ("prefilled", kv, int(prompt_len), first_logits, sampling, callback,
+             adapter, None if token_ids is None else list(token_ids))
+        )
 
     def prefill_detached(self, token_ids: List[int], lora: str = ""):
         """Prefill WITHOUT occupying a decode slot: returns
         (first_logits [V], kv [L, 2, P, Hkv, D], prompt_len) for transfer to a
-        decode engine. P is the padded bucket length >= prompt_len."""
+        decode engine. P is a padded length >= prompt_len. Prompts that do not
+        fit raise ValueError (never silently truncated). A prefix-cache hit
+        prefills only the suffix and splices the cached rows host-side."""
+        prompt = list(token_ids)
+        if len(prompt) > self.T - 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds this prefill engine's "
+                f"max_seq={self.T} budget (prompt_len <= max_seq - 1); "
+                f"truncate the prompt client-side or raise max_seq"
+            )
         adapter = self._adapter_index(lora)
-        prompt = list(token_ids)[: self.T - 1]
-        bucket = self._bucket(len(prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prompt)] = prompt
-        key = ("detached", bucket)
+        lease = None
+        if self._prefix_cache is not None:
+            lease = self._prefix_cache.lookup(prompt, namespace=adapter)
+        if lease is not None:
+            m = lease.matched_tokens
+            prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
+            lease.release()
+            first_logits, kv = self._detached_suffix(
+                prompt, m, prefix_kv, adapter
+            )
+        else:
+            m = 0
+            bucket = self._bucket(len(prompt))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(prompt)] = prompt
+            key = ("detached", bucket)
+            if key not in self._jit_prefill:
+                cfg = self.cfg
+
+                def detached(params, lora_p, tokens, adapter_id):
+                    S = tokens.shape[1]
+                    positions = jnp.arange(S)[None, :]
+                    caches = [
+                        (
+                            jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                            jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                        )
+                        for _ in range(cfg.n_layers)
+                    ]
+                    mask = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None]
+                    logits, new_caches = _forward_cached(
+                        params, cfg, tokens, positions, caches,
+                        jnp.zeros((1,), jnp.int32), mask,
+                        lora=lora_p, adapter_ids=adapter_id[None],
+                    )
+                    kv = jnp.stack(
+                        [jnp.stack([ck[0], cv[0]]) for ck, cv in new_caches]
+                    )  # [L, 2, S, Hkv, D]
+                    return logits[0], kv
+
+                self._jit_prefill[key] = jax.jit(detached)
+            logits, kv_dev = self._jit_prefill[key](
+                self.params, self._lora, jnp.asarray(padded), jnp.int32(adapter)
+            )
+            first_logits = np.asarray(logits[len(prompt) - 1])
+            kv = np.asarray(kv_dev)
+        self.last_prefill = {
+            "offset": m, "prompt_len": len(prompt), "detached": True,
+        }
+        if self._prefix_cache is not None:
+            bs = self._prefix_cache.block_size
+            n = (len(prompt) // bs) * bs
+            if n > m:  # nothing new to insert when the hit covered every block
+                self._prefix_cache.insert(prompt[:n], kv, namespace=adapter)
+        return first_logits, kv, len(prompt)
+
+    def _detached_suffix(self, prompt: List[int], m: int,
+                         prefix_kv: np.ndarray, adapter: int):
+        """Detached prefill of prompt[m:] against a cached m-token KV prefix.
+        Returns (first_logits [V], kv [L, 2, P, Hkv, D]) with P >= prompt_len,
+        rows [0, prompt_len) valid — same contract as the cold detached path.
+        The prefix rides in padded to its own bucket so programs are keyed by
+        (prefix_bucket, suffix_bucket), not by raw lengths."""
+        suffix = prompt[m:]
+        mb = self._bucket(m)
+        sb = self._bucket(len(suffix))
+        if prefix_kv.shape[2] < mb:
+            pad = np.zeros(
+                (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
+                + prefix_kv.shape[3:], prefix_kv.dtype,
+            )
+            prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
+        padded = np.zeros((1, sb), np.int32)
+        padded[0, : len(suffix)] = suffix
+        key = ("detached_suffix", mb, sb)
         if key not in self._jit_prefill:
             cfg = self.cfg
 
-            def detached(params, lora_p, tokens, adapter_id):
-                S = tokens.shape[1]
-                positions = jnp.arange(S)[None, :]
-                caches = [
-                    (
-                        jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
-                        jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+            def detached_suffix(params, lora_p, prefix, tokens, off, adapter_id):
+                # cache layout: rows [0, mb) = attached prefix (valid [0, off)),
+                # rows [mb, mb+sb) = this pass's suffix writes.
+                caches = []
+                for i in range(cfg.n_layers):
+                    zeros = jnp.zeros(
+                        (1, sb, cfg.n_kv_heads, cfg.head_dim), cfg.dtype
                     )
-                    for _ in range(cfg.n_layers)
-                ]
-                mask = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None]
+                    caches.append((
+                        jnp.concatenate(
+                            [prefix[i, 0][None].astype(cfg.dtype), zeros], axis=1
+                        ),
+                        jnp.concatenate(
+                            [prefix[i, 1][None].astype(cfg.dtype), zeros], axis=1
+                        ),
+                    ))
+                positions = off + jnp.arange(sb)[None, :]
+                rows = jnp.arange(mb + sb)[None, :]
+                # visible: real prefix rows, plus suffix rows written so far
+                mask = (
+                    (rows < off)
+                    | ((rows >= mb) & (rows - mb <= jnp.arange(sb)[:, None]))
+                )[None]
                 logits, new_caches = _forward_cached(
                     params, cfg, tokens, positions, caches,
-                    jnp.zeros((1,), jnp.int32), mask,
+                    jnp.full((1,), mb, jnp.int32), mask,
                     lora=lora_p, adapter_ids=adapter_id[None],
                 )
-                kv = jnp.stack(
-                    [jnp.stack([ck[0], cv[0]]) for ck, cv in new_caches]
-                )  # [L, 2, S, Hkv, D]
-                return logits[0], kv
+                suffix_kv = jnp.stack([
+                    jnp.stack([ck[0, mb:], cv[0, mb:]]) for ck, cv in new_caches
+                ])  # [L, 2, sb, Hkv, D]
+                return logits[0], suffix_kv
 
-            self._jit_prefill[key] = jax.jit(detached)
-        logits, kv = self._jit_prefill[key](
-            self.params, self._lora, jnp.asarray(padded), jnp.int32(adapter)
+            self._jit_prefill[key] = jax.jit(detached_suffix)
+        logits, suffix_kv = self._jit_prefill[key](
+            self.params, self._lora, jnp.asarray(prefix_kv),
+            jnp.asarray(padded), jnp.int32(m), jnp.int32(adapter),
         )
-        return (
-            np.asarray(logits[len(prompt) - 1]),
-            np.asarray(kv),
-            len(prompt),
-        )
+        first_logits = np.asarray(logits[len(suffix) - 1])
+        kv = np.concatenate(
+            [prefix_kv[:, :, :m], np.asarray(suffix_kv)], axis=2
+        )  # [L, 2, m + sb, Hkv, D]; rows [0, prompt_len) valid
+        return first_logits, kv
 
     def shutdown(self):
         self._stop = True
@@ -649,12 +834,15 @@ class DecodeEngine:
             if not free:
                 return False
             item = self._queue.pop(0)
+            depth = len(self._queue)
             slot = free[0]
+        self._queue_gauge.set(float(depth))
         if self._spec is not None:
             self._sync_device_state()  # prefill reads/writes device lens
 
         if item[0] == "prefilled":
-            _tag, kv, prompt_len, first_logits, sampling, callback, adapter = item
+            (_tag, kv, prompt_len, first_logits, sampling, callback, adapter,
+             prompt_tokens) = item
             # Same KV headroom contract as the prompt path: the cache must hold
             # prompt_len + max_tokens rows, so a long transferred prefix shrinks
             # the generation budget rather than silently wrapping the cache.
@@ -684,32 +872,86 @@ class DecodeEngine:
             if self._spec is not None:
                 # Transferred prefixes carry no draft KV: plain decode here.
                 self._spec["ready"][slot] = False
+            # PD-disagg transferred prefixes feed the prefix cache too: the
+            # host-side kv is already in pool layout, so insertion is free of
+            # device readbacks.
+            if (self._prefix_cache is not None and prompt_tokens
+                    and len(prompt_tokens) >= prompt_len):
+                bs = self._prefix_cache.block_size
+                n = (prompt_len // bs) * bs
+                if n:
+                    self._prefix_cache.insert(
+                        prompt_tokens[:n], kv, namespace=adapter
+                    )
         else:
             _tag, prompt, sampling, callback, adapter = item
-            prompt = prompt[: self.T - sampling.max_tokens - 1]
-            bucket = self._bucket(len(prompt))
+            # The prompt is never truncated (submit validated it fits); a
+            # generation budget that would overflow the KV rows shrinks
+            # max_tokens instead, mirroring the transferred-prefix path.
+            headroom = self.T - 1 - len(prompt)
+            if sampling.max_tokens > headroom:
+                sampling = dataclasses.replace(
+                    sampling, max_tokens=max(1, headroom)
+                )
+            prompt_len = len(prompt)
+            offset = 0
+            lease = None
+            if self._prefix_cache is not None:
+                lease = self._prefix_cache.lookup(prompt, namespace=adapter)
+            if lease is not None:
+                # Attach the cached prefix through the padded-bucket attach
+                # path, then prefill only the suffix. The lease pins the
+                # blocks until the host->device copy is staged.
+                offset = lease.matched_tokens
+                prefix_kv = lease.kv()
+                mb = self._bucket(offset)
+                if prefix_kv.shape[2] < mb:
+                    pad = np.zeros(
+                        (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
+                        + prefix_kv.shape[3:], prefix_kv.dtype,
+                    )
+                    prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
+                akey = ("attach", mb)
+                if akey not in self._jit_prefill:
+                    self._jit_prefill[akey] = jax.jit(self._attach_kv)
+                self._caches = self._jit_prefill[akey](
+                    self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
+                )
+                lease.release()
+            suffix = prompt[offset:]
+            bucket = self._bucket(len(suffix))
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(prompt)] = prompt
+            padded[0, : len(suffix)] = suffix
             if bucket not in self._jit_prefill:
-                self._jit_prefill[bucket] = jax.jit(self._prefill_one)
+                self._jit_prefill[bucket] = jax.jit(self._prefill_at)
             last_logits, self._caches, self._lens = self._jit_prefill[bucket](
                 self.params, self._lora, jnp.asarray(padded), self._caches,
-                self._lens, jnp.int32(slot), jnp.int32(len(prompt)),
-                jnp.int32(adapter),
+                self._lens, jnp.int32(slot), jnp.int32(offset),
+                jnp.int32(prompt_len), jnp.int32(adapter),
             )
-            prompt_len = len(prompt)
+            self.last_prefill = {
+                "bucket": bucket, "offset": offset, "prompt_len": prompt_len,
+            }
             first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)
+            if self._prefix_cache is not None:
+                self._insert_prompt_kv(slot, prompt, adapter, offset)
             if self._spec is not None:
-                dkey = ("dprefill", bucket)
-                if dkey not in self._jit_spec_prefill:
-                    self._jit_spec_prefill[dkey] = jax.jit(self._draft_prefill)
-                self._spec["caches"] = self._jit_spec_prefill[dkey](
-                    self._spec["params"], jnp.asarray(padded), self._spec["caches"],
-                    jnp.int32(slot),
-                )
-                self._spec["host_lens"][slot] = len(prompt)
-                self._spec["ready"][slot] = True
-                self._spec["pending"][slot] = None
+                if offset:
+                    # A cache hit leaves the draft cache without the prefix
+                    # rows; plain decode for this slot (same contract as
+                    # transferred prefixes).
+                    self._spec["ready"][slot] = False
+                else:
+                    dkey = ("dprefill", bucket)
+                    if dkey not in self._jit_spec_prefill:
+                        self._jit_spec_prefill[dkey] = jax.jit(self._draft_prefill)
+                    self._spec["caches"] = self._jit_spec_prefill[dkey](
+                        self._spec["params"], jnp.asarray(padded),
+                        self._spec["caches"], jnp.int32(slot),
+                    )
+                    self._spec["host_lens"][slot] = len(prompt)
+                    self._spec["ready"][slot] = True
+                    self._spec["pending"][slot] = None
         s = self._slots[slot]
         s.active = True
         s.generated = 1

@@ -12,10 +12,12 @@ router pinning conversations to a rank).
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional, Union
+import inspect
+from collections import OrderedDict
+from typing import Dict, List, Optional, Union
 
 import ray_tpu
-from ray_tpu.llm import LLMConfig, LLMServer
+from ray_tpu.llm import LLMConfig, LLMServer, resolve_tokenizer
 
 
 class DPRankAssigner:
@@ -85,6 +87,12 @@ class DPLLMServer(LLMServer):
         out["dp_rank"] = self.dp_rank
         return out
 
+    async def cache_stats(self) -> dict:
+        """Engine prefix-cache counters, rank-tagged for the DP router's
+        aggregate view (docs/kvcache.md)."""
+        stats = await super().cache_stats()
+        return {"dp_rank": self.dp_rank, **(stats or {})}
+
     def __del__(self):
         try:
             self._assigner.release.remote(self._replica_token)  # raylint: disable=RL501 (__del__ cannot block; assigner audits stale tokens)
@@ -93,34 +101,165 @@ class DPLLMServer(LLMServer):
 
 
 class DPRouter:
-    """Front door over the DP rank set: requests ride the serve handle's
-    power-of-two-choices balancing across replicas (parity: dp_server's
-    request fanout); `ranks()` exposes the live rank map for diagnostics."""
+    """Front door over the DP rank set, cache-aware (SGLang's cache-aware
+    scheduler shape): the router fingerprints each prompt as a hash chain
+    over its first `llm_router_fingerprint_blocks` KV blocks, remembers which
+    replica last served every chain prefix, and routes a new request to the
+    replica with the LONGEST expected prefix-cache match — that replica's
+    paged KV pool (docs/kvcache.md) then prefills suffix-only. Requests with
+    no trackable prefix (or when the preferred replica is overloaded) fall
+    back to the serve handle's power-of-two-choices balancing (parity:
+    dp_server's request fanout); `ranks()` exposes the live rank map."""
 
-    def __init__(self, server_handle, assigner):
+    # Don't chase a prefix hit onto a replica carrying this many more
+    # in-flight requests than the least-loaded one: recomputing a prefix is
+    # cheaper than queueing behind a hot spot (SGLang's balanced fallback).
+    IMBALANCE_TOLERANCE = 8
+    # Per-replica LRU cap on remembered chain hashes (ints; memory is tiny,
+    # the cap bounds staleness relative to the replica's real pool).
+    FINGERPRINT_CAP = 4096
+
+    def __init__(self, server_handle, assigner, config: Optional[LLMConfig] = None):
+        from ray_tpu._private.config import CONFIG
+
         self._server = server_handle
         self._assigner = assigner
+        self._tokenizer = (
+            resolve_tokenizer(config.tokenizer) if config is not None else None
+        )
+        self._block = max(1, CONFIG.llm_kv_block_size)
+        self._fp_blocks = max(1, CONFIG.llm_router_fingerprint_blocks)
+        # replica actor_id -> LRU of chain hashes it has (probably) cached
+        self._fingerprints: Dict[object, OrderedDict] = {}
+        self._routing = {"cache_routed": 0, "balanced": 0, "untracked": 0}
 
+    # -- prefix fingerprints -----------------------------------------------
+    def _chain(self, token_ids: List[int]) -> List[int]:
+        """Hash chain over the first N whole blocks: chain[i] identifies the
+        (i+1)-block prefix, so set membership of chain[i] implies the replica
+        has seen (and likely still holds) that whole prefix."""
+        bs = self._block
+        h = 0
+        out: List[int] = []
+        for i in range(min(len(token_ids) // bs, self._fp_blocks)):
+            h = hash((h, tuple(token_ids[i * bs : (i + 1) * bs])))
+            out.append(h)
+        return out
+
+    def _record(self, actor_id, chain: List[int]):
+        fps = self._fingerprints.setdefault(actor_id, OrderedDict())
+        for h in chain:
+            fps.pop(h, None)
+            fps[h] = None
+        while len(fps) > self.FINGERPRINT_CAP:
+            fps.popitem(last=False)
+
+    def _pick(self, chain: List[int]):
+        """(replica, router, mode): the longest-expected-match replica, or the
+        balanced pow-2 pick when nothing matches / the match is overloaded."""
+        router = self._server.generate._get_router()
+        replicas = router.replicas()
+        live = {r._actor_id for r in replicas}
+        for aid in [a for a in self._fingerprints if a not in live]:
+            del self._fingerprints[aid]  # replica died or was redeployed
+        best, best_len = None, 0
+        for r in replicas:
+            fps = self._fingerprints.get(r._actor_id)
+            if not fps:
+                continue
+            m = 0
+            for h in chain:
+                if h not in fps:
+                    break
+                m += 1
+            if m > best_len:
+                best, best_len = r, m
+        if best is not None and len(replicas) > 1:
+            loads = router.loads()
+            least = min(loads.get(r._actor_id, 0) for r in replicas)
+            if loads.get(best._actor_id, 0) - least > self.IMBALANCE_TOLERANCE:
+                best = None
+        if best is not None:
+            return router.pick_replica(best), router, "cache_routed"
+        return router.pick(""), router, "balanced"
+
+    def _submit(self, router, replica, args: tuple, kwargs: dict):
+        """Dispatch to the chosen replica with the handle's exact in-flight
+        bookkeeping and dead-replica failover (resubmits rebalance)."""
+        from ray_tpu.serve.handle import DeploymentResponse
+
+        def submit_to(r):
+            ref = r.handle_request.remote("generate", args, kwargs)
+            ray_tpu.global_worker().memory_store.add_done_callback(
+                ref.id, lambda *_a, _r=r: router.done(_r)
+            ) or router.done(r)
+            return ref
+
+        def resubmit():
+            router.evict()  # stale table: the picked replica was dead
+            return submit_to(router.pick(""))
+
+        return DeploymentResponse(submit_to(replica), resubmit)
+
+    # -- request path ------------------------------------------------------
     async def generate(self, prompt: Union[str, List[int]], **kw) -> dict:
-        return await self._server.generate.remote(prompt, **kw)
+        token_ids: Optional[List[int]] = None
+        if isinstance(prompt, (list, tuple)):
+            token_ids = list(prompt)
+        elif self._tokenizer is not None:
+            token_ids = self._tokenizer.encode(prompt)
+        chain = self._chain(token_ids) if token_ids else []
+        if not chain:
+            # No whole-block prefix to track: plain balanced fanout.
+            self._routing["untracked"] += 1
+            return await self._server.generate.remote(prompt, **kw)
+        replica, router, mode = self._pick(chain)
+        self._routing[mode] += 1
+        self._record(replica._actor_id, chain)
+        # Router-side tokenization rides along: replicas accept token lists.
+        return await self._submit(router, replica, (token_ids,), dict(kw))
 
     async def ranks(self) -> dict:
         return await asyncio.get_running_loop().run_in_executor(
             None, lambda: ray_tpu.get(self._assigner.ranks.remote())
         )
 
+    async def routing_stats(self) -> dict:
+        """Cache-aware routing counters + fingerprint residency."""
+        return {
+            **self._routing,
+            "tracked_replicas": len(self._fingerprints),
+            "fingerprints": sum(len(v) for v in self._fingerprints.values()),
+        }
+
+    async def cache_stats(self) -> List[dict]:
+        """Rank-tagged engine prefix-cache stats from EVERY replica (the
+        router-level view of where prefixes actually live)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._server.cache_stats.broadcast()
+        )
+
     async def __call__(self, request) -> dict:
         body = request.json() if hasattr(request, "json") else dict(request)
+        if inspect.isawaitable(body):  # ASGI-style request objects
+            body = await body
+        model = body.get("model", "")
+        lora = model.split(":", 1)[1] if ":" in model else ""
+        stop = body.get("stop_token_id")
         return await self.generate(
             body.get("prompt", ""),
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token_id=None if stop is None else int(stop),
+            lora=lora,
         )
 
 
 def build_dp_openai_app(config: LLMConfig, *, dp_size: int = 2):
     """A data-parallel serving app: dp_size engine replicas + rank assigner
-    behind one router (parity: build_dp_openai_app / DPServer)."""
+    behind one cache-aware router (parity: build_dp_openai_app / DPServer)."""
     from ray_tpu import serve
 
     assigner = ray_tpu.remote(num_cpus=0)(DPRankAssigner).options(
@@ -135,7 +274,7 @@ def build_dp_openai_app(config: LLMConfig, *, dp_size: int = 2):
         max_ongoing_requests=config.num_slots * 4,
     )(DPLLMServer).bind(config, assigner)
     router = serve.deployment(name=f"DPRouter-{config.model_id}")(DPRouter)
-    return router.bind(server, assigner)
+    return router.bind(server, assigner, config)
 
 
 __all__ = ["DPRankAssigner", "DPLLMServer", "DPRouter", "build_dp_openai_app"]

@@ -1,0 +1,24 @@
+"""Paged KV prefix cache for the LLM serve plane.
+
+Design parity: vLLM's PagedAttention block tables (Kwon et al., SOSP '23) and
+SGLang's RadixAttention prefix tree (Zheng et al., 2024), reshaped for this
+engine's static-bucket TPU layout: KV blocks live HOST-side in a ref-counted
+pool (`block_pool.py`), a radix/trie index over token-id chunks maps prefixes
+to block chains (`radix.py`), and `PrefixCacheManager` (`manager.py`) leases
+the longest cached prefix to the engine's padded-bucket attach path so only
+the prompt suffix pays prefill FLOPs. See docs/kvcache.md for the design and
+docs/divergences.md for where the block layout deliberately differs from the
+GPU references.
+"""
+
+from ray_tpu.llm.kvcache.block_pool import KVBlockPool
+from ray_tpu.llm.kvcache.manager import PrefixCacheManager, PrefixLease
+from ray_tpu.llm.kvcache.radix import RadixIndex, RadixNode
+
+__all__ = [
+    "KVBlockPool",
+    "PrefixCacheManager",
+    "PrefixLease",
+    "RadixIndex",
+    "RadixNode",
+]

@@ -1,0 +1,105 @@
+"""Ref-counted host-side KV block pool with LRU accounting.
+
+One block = the KV rows of `block_size` consecutive tokens for every layer:
+a numpy array of shape [L, 2, block_size, Hkv, D] (the same [layer, k/v, row,
+head, dim] layout `DecodeEngine.prefill_detached` emits, so attach/extract
+are pure concatenations). Blocks are position-dependent (RoPE is applied
+before cache writes), which is exactly why they are only ever reused for
+true token-id *prefixes* — the radix index guarantees that.
+
+Synchronization contract: the pool is a passive structure with NO internal
+lock. Every caller goes through `PrefixCacheManager`, which serializes pool
+and radix mutations under one manager lock (coarse-grained, the SGLang
+radix-cache discipline). Keeping the data structures lock-free avoids any
+lock-order edge for raylint RL201 to reason about.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class KVBlock:
+    __slots__ = ("block_id", "kv", "refs", "last_used")
+
+    def __init__(self, block_id: int, kv: np.ndarray):
+        self.block_id = block_id
+        self.kv = kv
+        self.refs = 0        # active request leases; >0 pins against eviction
+        self.last_used = 0   # logical LRU clock tick, set by the pool
+
+
+class KVBlockPool:
+    """Fixed-token-size KV blocks with refcounts and byte accounting.
+
+    Eviction policy lives in the manager (it needs the radix structure to
+    evict whole unreferenced chains leaf-first); the pool enforces the
+    mechanics: a ref-held block can never be freed.
+    """
+
+    def __init__(self, capacity_bytes: int, block_size: int):
+        self.capacity_bytes = int(capacity_bytes)
+        self.block_size = int(block_size)
+        self.bytes_resident = 0
+        self._blocks: Dict[int, KVBlock] = {}
+        self._ids = itertools.count()
+        self._clock = itertools.count(1)
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def put(self, kv: np.ndarray) -> int:
+        """Store one block (copied: callers pass views of readback buffers)."""
+        if kv.shape[2] != self.block_size:
+            raise ValueError(
+                f"block rows {kv.shape[2]} != pool block_size {self.block_size}"
+            )
+        block = KVBlock(next(self._ids), np.ascontiguousarray(kv))
+        block.last_used = next(self._clock)
+        self._blocks[block.block_id] = block
+        self.bytes_resident += block.kv.nbytes
+        return block.block_id
+
+    def get(self, block_id: int) -> np.ndarray:
+        return self._blocks[block_id].kv
+
+    def incref(self, block_ids: List[int]):
+        for bid in block_ids:
+            self._blocks[bid].refs += 1
+
+    def decref(self, block_ids: List[int]):
+        for bid in block_ids:
+            block = self._blocks[bid]
+            if block.refs <= 0:
+                raise RuntimeError(f"kv block {bid} released more than leased")
+            block.refs -= 1
+
+    def refs(self, block_id: int) -> int:
+        return self._blocks[block_id].refs
+
+    def touch(self, block_ids: List[int]):
+        tick = next(self._clock)
+        for bid in block_ids:
+            self._blocks[bid].last_used = tick
+
+    def last_used(self, block_id: int) -> int:
+        return self._blocks[block_id].last_used
+
+    def evictable(self, block_id: int) -> bool:
+        block = self._blocks.get(block_id)
+        return block is not None and block.refs == 0
+
+    def free(self, block_id: int) -> int:
+        """Drop an unreferenced block; returns the bytes reclaimed."""
+        block = self._blocks[block_id]
+        if block.refs > 0:
+            raise RuntimeError(f"kv block {block_id} is ref-held; cannot free")
+        del self._blocks[block_id]
+        self.bytes_resident -= block.kv.nbytes
+        return block.kv.nbytes
+
+    def over_capacity(self, incoming_bytes: int = 0) -> bool:
+        return self.bytes_resident + incoming_bytes > self.capacity_bytes

@@ -1,0 +1,250 @@
+"""PrefixCacheManager: the lock-owning front of the paged KV prefix cache.
+
+Combines the ref-counted block pool and the radix prefix index under ONE
+manager lock (coarse-grained, the discipline SGLang's radix cache uses under
+its scheduler lock): every pool/radix mutation happens inside `self._lock`,
+and neither structure carries a lock of its own, so there is no lock-order
+graph to get wrong. Nothing under the lock blocks, awaits, or dispatches to
+a device — lookups and inserts are pure host bookkeeping plus numpy copies.
+
+Leases: `lookup()` pins the matched chain (refcounts) and hands back a
+`PrefixLease`; the engine attaches the lease's KV, prefills only the suffix,
+and releases the lease once the attach landed. Eviction (LRU, leaf-first,
+whole unreferenced chain tails) can therefore never free rows a request is
+about to attach.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.llm.kvcache.block_pool import KVBlockPool
+from ray_tpu.llm.kvcache.radix import RadixIndex
+
+# Shared metric instances (one set per process; per-cache series ride the
+# "cache" tag). Lazily built so bare-engine tests without a cluster stay
+# import-light; flush failures are already swallowed by util.metrics.
+_METRICS: Dict[str, object] = {}
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> Dict[str, object]:
+    with _METRICS_LOCK:
+        if not _METRICS:
+            from ray_tpu.util import metrics
+
+            _METRICS.update(
+                hits=metrics.Counter(
+                    "llm_prefix_cache_hits",
+                    "prefix-cache lookups that matched at least one block",
+                    tag_keys=("cache",),
+                ),
+                misses=metrics.Counter(
+                    "llm_prefix_cache_misses",
+                    "prefix-cache lookups that matched nothing",
+                    tag_keys=("cache",),
+                ),
+                hit_tokens=metrics.Counter(
+                    "llm_prefix_cache_hit_tokens",
+                    "prompt tokens served from cached KV instead of prefill",
+                    tag_keys=("cache",),
+                ),
+                inserted=metrics.Counter(
+                    "llm_prefix_cache_inserted_blocks",
+                    "KV blocks inserted into the pool",
+                    tag_keys=("cache",),
+                ),
+                evictions=metrics.Counter(
+                    "llm_prefix_cache_evictions",
+                    "KV blocks evicted (LRU, unreferenced chains only)",
+                    tag_keys=("cache",),
+                ),
+                bytes=metrics.Gauge(
+                    "llm_prefix_cache_bytes",
+                    "host bytes resident in the KV block pool",
+                    tag_keys=("cache",),
+                ),
+            )
+        return dict(_METRICS)
+
+
+class PrefixLease:
+    """A pinned cached prefix: block chain + token count, released after attach."""
+
+    __slots__ = ("_manager", "block_ids", "matched_tokens", "namespace", "_released")
+
+    def __init__(self, manager: "PrefixCacheManager", block_ids: List[int],
+                 matched_tokens: int, namespace: int):
+        self._manager = manager
+        self.block_ids = block_ids
+        self.matched_tokens = matched_tokens
+        self.namespace = namespace
+        self._released = False
+
+    def kv(self) -> np.ndarray:
+        """[L, 2, matched_tokens, Hkv, D] — concatenation of the leased blocks.
+        Safe outside the manager lock: the lease's refcounts pin every block."""
+        blocks = [self._manager._pool.get(bid) for bid in self.block_ids]
+        return np.concatenate(blocks, axis=2)
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._manager._release(self.block_ids)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class PrefixCacheManager:
+    """Block-granular KV prefix reuse for one engine (one model + layout)."""
+
+    def __init__(self, block_size: int, capacity_bytes: int, name: str = ""):
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        if capacity_bytes <= 0:
+            raise ValueError("capacity_bytes must be positive (0 disables the "
+                             "cache at the engine level; don't build a manager)")
+        self.block_size = int(block_size)
+        self.name = name or f"pool-{id(self):x}"
+        self._pool = KVBlockPool(capacity_bytes, block_size)
+        self._radix = RadixIndex(block_size)
+        self._lock = threading.Lock()
+        self._counters = {
+            "lookups": 0, "hits": 0, "misses": 0, "hit_tokens": 0,
+            "inserted_blocks": 0, "evicted_blocks": 0, "rejected_blocks": 0,
+        }
+
+    # -- lookup / lease ----------------------------------------------------
+    def lookup(self, token_ids: Sequence[int], namespace: int = 0
+               ) -> Optional[PrefixLease]:
+        """Lease the longest cached whole-block prefix of token_ids, capped at
+        len(token_ids) - 1 tokens: the engine must prefill at least one real
+        token to produce last-position logits for sampling."""
+        token_ids = list(token_ids)
+        with self._lock:
+            self._counters["lookups"] += 1
+            nodes = self._radix.match(token_ids, namespace)
+            while nodes and len(nodes) * self.block_size > len(token_ids) - 1:
+                nodes.pop()
+            if not nodes:
+                self._counters["misses"] += 1
+                self._emit("misses", 1)
+                return None
+            block_ids = [n.block_id for n in nodes]
+            self._pool.incref(block_ids)
+            self._pool.touch(block_ids)
+            matched = len(block_ids) * self.block_size
+            self._counters["hits"] += 1
+            self._counters["hit_tokens"] += matched
+        self._emit("hits", 1)
+        self._emit("hit_tokens", matched)
+        return PrefixLease(self, block_ids, matched, namespace)
+
+    def _release(self, block_ids: List[int]):
+        with self._lock:
+            self._pool.decref(block_ids)
+
+    # -- insert ------------------------------------------------------------
+    def insert(self, token_ids: Sequence[int], kv: np.ndarray,
+               namespace: int = 0) -> int:
+        """Insert the KV rows of token_ids' whole blocks. kv is
+        [L, 2, P, Hkv, D] with P >= the whole-block token count; rows beyond
+        it are ignored (padded buckets pass through unsliced). Existing chain
+        prefixes dedup against the tree; new blocks are copied into the pool,
+        evicting LRU unreferenced chain tails to fit. Returns blocks added."""
+        token_ids = list(token_ids)
+        chunks = self._radix.chunks(token_ids)
+        if not chunks:
+            return 0
+        if kv.shape[2] < len(chunks) * self.block_size:
+            raise ValueError(
+                f"kv has {kv.shape[2]} rows < {len(chunks)} blocks of "
+                f"{self.block_size}"
+            )
+        bs = self.block_size
+        with self._lock:
+            existing = self._radix.match(token_ids, namespace)
+            # match() is uncapped here; it can cover every chunk (full dedup).
+            n_existing = len(existing)
+            prot = [n.block_id for n in existing]
+            # Pin the dedup'd prefix for the duration of the insert: eviction
+            # freeing an ancestor mid-insert would orphan the new tail blocks
+            # (their chain could never be attached to the tree).
+            self._pool.incref(prot)
+            self._pool.touch(prot)
+            new_ids: List[Optional[int]] = []
+            try:
+                for i in range(n_existing, len(chunks)):
+                    block = kv[:, :, i * bs : (i + 1) * bs]
+                    if not self._evict_to_fit(block.nbytes):
+                        # Everything evictable is gone and ref-held blocks fill
+                        # the budget: drop the chain tail rather than overshoot.
+                        self._counters["rejected_blocks"] += len(chunks) - i
+                        break
+                    new_ids.append(self._pool.put(block))
+                if new_ids:
+                    self._radix.insert(
+                        chunks, [None] * n_existing + new_ids, namespace
+                    )
+                    self._counters["inserted_blocks"] += len(new_ids)
+            finally:
+                self._pool.decref(prot)
+        if new_ids:
+            self._emit("inserted", len(new_ids))
+        self._emit_bytes()
+        return len(new_ids)
+
+    # -- eviction ----------------------------------------------------------
+    def _evict_to_fit(self, incoming_bytes: int) -> bool:
+        """LRU leaf-first eviction until incoming_bytes fits. Caller holds the
+        lock. Interior blocks free once their subtree is gone, so an
+        unreferenced chain unwinds tail-to-head across iterations."""
+        evicted = 0
+        while self._pool.over_capacity(incoming_bytes):
+            victims = [
+                leaf for leaf in self._radix.leaves()
+                if self._pool.evictable(leaf.block_id)
+            ]
+            if not victims:
+                break
+            victim = min(victims, key=lambda n: self._pool.last_used(n.block_id))
+            self._radix.remove_leaf(victim)
+            self._pool.free(victim.block_id)
+            evicted += 1
+        if evicted:
+            self._counters["evicted_blocks"] += evicted
+            self._emit("evictions", evicted)
+        return not self._pool.over_capacity(incoming_bytes)
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["blocks_resident"] = len(self._pool)
+            out["bytes_resident"] = self._pool.bytes_resident
+            out["capacity_bytes"] = self._pool.capacity_bytes
+            out["block_size"] = self.block_size
+            lookups = max(1, out["lookups"])
+            out["hit_rate"] = out["hits"] / lookups
+        return out
+
+    def _emit(self, key: str, value: float):
+        try:
+            _metrics()[key].inc(value, tags={"cache": self.name})
+        except Exception:
+            pass  # metrics must never break the serving path
+
+    def _emit_bytes(self):
+        try:
+            _metrics()["bytes"].set(
+                float(self._pool.bytes_resident), tags={"cache": self.name}
+            )
+        except Exception:
+            pass  # metrics must never break the serving path

@@ -49,6 +49,9 @@ class PrefillServer:
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
 
+    async def cache_stats(self) -> Optional[dict]:
+        return self._engine.prefix_cache_stats()
+
     def __del__(self):
         try:
             self._engine.shutdown()
@@ -71,7 +74,8 @@ class DecodeServer:
     async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
                                  max_tokens: int = 64, temperature: float = 0.0,
                                  top_k: int = 0, stop_token_id: Optional[int] = None,
-                                 lora: str = "") -> dict:
+                                 lora: str = "",
+                                 token_ids: Optional[List[int]] = None) -> dict:
         loop = asyncio.get_running_loop()
         from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
 
@@ -95,7 +99,7 @@ class DecodeServer:
             kv, prompt_len, first_logits,
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
-            cb, lora=lora,
+            cb, lora=lora, token_ids=token_ids,
         )
         await done
         gen = list(out)
@@ -105,6 +109,9 @@ class DecodeServer:
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
+
+    async def cache_stats(self) -> Optional[dict]:
+        return self._engine.prefix_cache_stats()
 
     def __del__(self):
         try:
@@ -136,6 +143,9 @@ class PDRouter:
             pre["kv"], pre["prompt_len"], pre["first_logits"],
             max_tokens=max_tokens, temperature=temperature, top_k=top_k,
             stop_token_id=stop_token_id, lora=lora,
+            # The prompt rides along so the decode engine can feed its prefix
+            # cache with the transferred rows (docs/kvcache.md).
+            token_ids=token_ids,
         )
         return {
             **result,

@@ -217,6 +217,29 @@ class _Router:
                 self._model_affinity[model_id] = pick._actor_id
             return pick
 
+    def replicas(self) -> List:
+        """Current replica actor handles (refreshing the cached table). For
+        affinity-aware callers (e.g. the DP LLM router's prefix-cache
+        routing) that pick a replica themselves via pick_replica()."""
+        self._refresh()
+        with self._lock:
+            return list(self._replicas)
+
+    def loads(self) -> Dict[Any, int]:
+        """actor_id -> locally tracked in-flight requests (the pow-2 metric)."""
+        with self._lock:
+            return dict(self._inflight)
+
+    def pick_replica(self, replica):
+        """Route to a SPECIFIC replica, with the same in-flight bookkeeping
+        pick() applies — the caller must pair it with done() (directly or via
+        a done-callback) exactly like pick()."""
+        with self._lock:
+            self._inflight[replica._actor_id] = (
+                self._inflight.get(replica._actor_id, 0) + 1
+            )
+        return replica
+
     def done(self, replica):
         with self._lock:
             if replica._actor_id in self._inflight:
