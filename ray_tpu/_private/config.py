@@ -85,6 +85,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_kv_block_size": (int, 16, "token rows per paged KV prefix-cache block; prefixes are reused at whole-block granularity (docs/kvcache.md)"),
     "llm_prefix_cache_bytes": (int, 32 << 20, "host bytes for the per-engine paged KV prefix cache; repeated prompt prefixes attach cached KV and prefill suffix-only (0 disables)"),
     "llm_max_queue_depth": (int, 256, "engine admission queue cap; submits beyond it raise EngineOverloadedError instead of growing memory unboundedly (0 = unbounded)"),
+    "llm_max_jit_programs": (int, 64, "per-engine cap on cached jitted programs (prefill/attach/spec bucket variants); past it the oldest program is evicted so an adversarial prompt-length mix can't grow compilation memory unboundedly (0 = unbounded)"),
     "llm_router_fingerprint_blocks": (int, 8, "prefix blocks hashed into the DP router's per-replica fingerprints for cache-aware routing"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),

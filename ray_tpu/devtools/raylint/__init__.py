@@ -21,6 +21,15 @@ Checker families (see docs/raylint.md for the full contract):
 - RL401 swallowed-exception   broad `except` that silently discards the error
 - RL501 unreleased-ref        `.remote()`/`execute()` result discarded unread
 
+jaxlint family (compute plane; files that import jax only):
+
+- RL601 jit-in-hot-path       `jax.jit` constructed in a loop / per-call frame
+- RL602 unbounded-program-cache  jitted programs cached with no cap/eviction
+- RL603 host-sync-in-loop     device->host readback in a step loop/async frame
+- RL604 retrace-hazard        list / raw-len()-shaped array into a jitted call
+- RL605 donation-misuse       donated argument read after the call
+- RL701 side-effect-under-jit traced fn mutates self/globals/closures
+
 Suppress a finding with a trailing (or immediately preceding) comment::
 
     ref = actor.ping.remote()  # raylint: disable=RL501

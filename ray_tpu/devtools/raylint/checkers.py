@@ -575,7 +575,14 @@ class _Checker(ast.NodeVisitor):
 
 def check_file(ctx: FileContext) -> tuple[list[Finding], list[LockEdge]]:
     checker = _Checker(ctx).check_module()
-    return checker.findings, checker.lock_edges
+    findings = checker.findings
+    # jaxlint (RL6xx/RL7xx) only has something to say about files that
+    # touch jax; the import gate keeps control-plane float()/np.asarray
+    # idioms out of its sight.
+    from ray_tpu.devtools.raylint import jaxlint
+
+    findings = findings + jaxlint.check_jax_file(ctx)
+    return findings, checker.lock_edges
 
 
 def lock_cycle_findings(edges: list[LockEdge]) -> list[Finding]:

@@ -1,8 +1,21 @@
 """raylint command line: `python -m ray_tpu.devtools.raylint <paths...>`.
 
-Exit status: 0 when every finding is suppressed or baselined, 1 otherwise
-(2 for usage errors). Output is one `file:line CODE message` per violation —
-the format the tier-1 gate and editors both consume.
+Exit-status contract (stable; CI consumers key off it):
+
+- 0 — clean: every finding is suppressed inline or grandfathered in the
+  baseline (a run with ONLY baselined findings exits 0, with or without
+  `--no-baseline` — that flag widens what is *reported*, never what fails).
+- 1 — at least one non-baselined violation (or, with `--fail-stale`,
+  a stale baseline entry).
+- 2 — usage error (unknown code in --select, bad flag value).
+
+Output formats:
+
+- text (default): one `file:line CODE message` per violation — what editors
+  and humans consume. `--no-baseline` additionally prints grandfathered
+  findings with a trailing `[baselined]` marker.
+- `--format json`: a single JSON document with `violations`, `baselined`,
+  `stale_baseline_entries`, `summary`, and `exit` keys — what CI consumes.
 """
 
 from __future__ import annotations
@@ -13,6 +26,7 @@ import sys
 
 from ray_tpu.devtools.raylint.core import (
     CODES,
+    Finding,
     emit_baseline,
     lint_paths,
     load_baseline,
@@ -20,11 +34,17 @@ from ray_tpu.devtools.raylint.core import (
 )
 
 
+def _finding_dict(f: Finding) -> dict:
+    return {"file": f.path, "line": f.line, "code": f.code,
+            "symbol": f.symbol, "message": f.message}
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="raylint",
         description="framework-aware static analysis for the ray_tpu "
-                    "control plane",
+                    "control plane (RL1xx-RL5xx) and JAX compute plane "
+                    "(RL6xx/RL7xx)",
     )
     parser.add_argument("paths", nargs="*", default=["ray_tpu"],
                         help="files or directories to lint")
@@ -32,7 +52,8 @@ def main(argv: list[str] | None = None) -> int:
                         help="baseline JSON path (default: the checked-in "
                              "ray_tpu/devtools/raylint/baseline.json)")
     parser.add_argument("--no-baseline", action="store_true",
-                        help="report grandfathered findings too")
+                        help="also REPORT grandfathered findings (marked "
+                             "[baselined]); does not change the exit status")
     parser.add_argument("--emit-baseline", action="store_true",
                         help="print a baseline JSON scaffold for the current "
                              "findings and exit 0 (justifications must be "
@@ -41,9 +62,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated codes to run (default: all)")
     parser.add_argument("--codes", action="store_true",
                         help="list checker codes and exit")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="output format (json emits one document with "
+                             "violations/baselined/stale/summary/exit)")
     parser.add_argument("--show-stale", action="store_true",
                         help="also report baseline entries that no longer "
                              "match any finding")
+    parser.add_argument("--fail-stale", action="store_true",
+                        help="exit 1 when stale baseline entries exist even "
+                             "if there are no violations")
     args = parser.parse_args(argv)
 
     if args.codes:
@@ -66,12 +93,37 @@ def main(argv: list[str] | None = None) -> int:
         print()
         return 0
 
-    entries = [] if args.no_baseline else load_baseline(args.baseline)
+    entries = load_baseline(args.baseline)
     violations, grandfathered, stale = partition_baselined(findings, entries)
+    # A --select run only sees a slice of the findings, so entries covering
+    # unselected codes are not "stale" in any actionable sense.
+    if codes:
+        stale = [e for e in stale if e.get("code") in codes]
+
+    rc = 1 if violations or (args.fail_stale and stale) else 0
+
+    if args.format == "json":
+        doc = {
+            "violations": [_finding_dict(f) for f in violations],
+            "baselined": [_finding_dict(f) for f in grandfathered],
+            "stale_baseline_entries": stale,
+            "summary": {
+                "violations": len(violations),
+                "baselined": len(grandfathered),
+                "stale": len(stale),
+            },
+            "exit": rc,
+        }
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return rc
 
     for f in violations:
         print(f.render())
-    if args.show_stale:
+    if args.no_baseline:
+        for f in grandfathered:
+            print(f"{f.render()} [baselined]")
+    if args.show_stale or args.fail_stale:
         for e in stale:
             print(
                 f"stale baseline entry: {e.get('file')} {e.get('code')} "
@@ -84,8 +136,7 @@ def main(argv: list[str] | None = None) -> int:
             f"({len(grandfathered)} baselined)",
             file=sys.stderr,
         )
-        return 1
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -35,6 +35,27 @@ CODES: dict[str, str] = {
              "re-raises, logs, returns a value, nor explains itself",
     "RL501": "unreleased-ref: `.remote()`/`execute()` result discarded "
              "without get/await/release — leaks capacity or hides failures",
+    # -- jaxlint family (compute plane; only runs in files importing jax) ----
+    "RL601": "jit-in-hot-path: `jax.jit(...)` constructed inside a loop or "
+             "invoked in the same expression inside a function — the wrapper "
+             "(and its compiled program) dies with the frame, re-tracing "
+             "every call",
+    "RL602": "unbounded-program-cache: jitted program stored into a dict "
+             "with no cap/eviction — request-derived keys compile programs "
+             "unboundedly under an adversarial input mix",
+    "RL603": "host-sync-in-loop: device->host readback (np.asarray, "
+             "float/int, .item, .tolist, block_until_ready, device_get) on "
+             "a device value inside a decode/train loop, loop-called helper, "
+             "or async frame — stalls the dispatch pipeline per step",
+    "RL604": "retrace-hazard: Python list or raw len()-shaped array passed "
+             "to a jitted callable — every distinct length compiles a new "
+             "program; bucket shapes or mark arguments static",
+    "RL605": "donation-misuse: an argument donated to a jitted call "
+             "(donate_argnums) is read after the call — the buffer was "
+             "handed to XLA and no longer holds the value",
+    "RL701": "side-effect-under-jit: a function handed to jax.jit/lax.scan/"
+             "shard_map mutates self/globals/closures — the effect runs at "
+             "trace time only and captured tracers escape the trace",
 }
 
 _DISABLE_MARK = "raylint:"

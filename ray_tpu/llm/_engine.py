@@ -223,14 +223,19 @@ class DecodeEngine:
                 }
                 for _ in range(cfg.n_layers)
             ]
-        self._adapter_ids = jnp.zeros((num_slots,), jnp.int32)
+        self._adapter_ids = np.zeros((num_slots,), np.int32)
         kv_shape = (self.B, self.T, cfg.n_kv_heads, cfg.head_dim)
         self._caches = [
             (jnp.zeros(kv_shape, cfg.dtype), jnp.zeros(kv_shape, cfg.dtype))
             for _ in range(cfg.n_layers)
         ]
-        self._lens = jnp.zeros((self.B,), jnp.int32)
-        self._last_token = jnp.zeros((self.B,), jnp.int32)
+        # Per-slot lengths and last tokens are HOST-native (numpy): the
+        # stepper reads and writes them every step, and a device-canonical
+        # copy would force a blocking device->host pull per step just to do
+        # slot bookkeeping. The decode/prefill dispatches ship them
+        # host->device per call (a few async bytes, off the critical path).
+        self._lens = np.zeros((self.B,), np.int32)
+        self._last_token = np.zeros((self.B,), np.int32)
         self._slots = [Slot() for _ in range(self.B)]
         self._queue: List = []
         self._lock = threading.Lock()
@@ -252,6 +257,20 @@ class DecodeEngine:
         if multi_step is None:
             multi_step = CONFIG.llm_multi_step
         self._multi_step = max(1, int(multi_step))
+        # Explicit prefill bucket table: every compiled prefill/attach
+        # program is keyed by a value from this (log-sized) set, never by a
+        # raw prompt length — the structural guarantee that the program
+        # caches stay small. llm_max_jit_programs is the backstop cap for
+        # the cross products ((prefix, suffix) suffix programs, spec k's):
+        # past it the oldest program is dropped (insertion order).
+        buckets = []
+        b = max(1, CONFIG.llm_prefill_bucket_min)
+        while b < self.T:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.T)
+        self._prefill_buckets = tuple(buckets)
+        self._max_jit_programs = max(0, int(CONFIG.llm_max_jit_programs))
         # Paged KV prefix cache (docs/kvcache.md): host-side ref-counted block
         # pool + radix prefix index. A repeated prompt prefix attaches its
         # cached KV through the padded-bucket attach path and prefills only
@@ -311,7 +330,6 @@ class DecodeEngine:
                 # draft cache; it catches up at the next round's scan head.
                 "pending": [None] * self.B,
             }
-            self._spec_dirty: set = set()
             self._jit_spec_propose = jax.jit(
                 self._spec_propose, static_argnames=("k", "catchup")
             )
@@ -365,13 +383,14 @@ class DecodeEngine:
         return self._lora_names[lora]
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_at(self, params, lora, tokens, caches, lens, slot, offset,
+    def _prefill_at(self, params, lora, tokens, caches, slot, offset,
                     total_len, adapter_id):
         """tokens: [1, Sbucket] right-padded, starting at row/position `offset`
         (0 = whole-prompt prefill; >0 = suffix-only prefill behind a prefix
         cache hit whose KV was attached to rows [0, offset)). Writes slot
         `slot`'s cache rows [offset, offset+S). One program per bucket: offset
-        and total_len are traced scalars."""
+        and total_len are traced scalars. Slot lengths are host-side state
+        (the dispatcher records total_len itself — no device lens write)."""
         S = tokens.shape[1]
         positions = offset + jnp.arange(S)[None, :]
         # one-slot caches view
@@ -388,8 +407,7 @@ class DecodeEngine:
         )
         out_caches = self._scatter_slot(caches, new_slot_caches, slot)
         last = logits[0, total_len - 1 - offset]
-        lens = lens.at[slot].set(total_len)
-        return last, out_caches, lens
+        return last, out_caches
 
     def _decode_step(self, params, lora, adapter_ids, last_token, caches, lens):
         """One token for every slot. last_token: [B]; lens: [B] current lengths."""
@@ -494,22 +512,6 @@ class DecodeEngine:
         )
         return self._scatter_slot(caches, new_slot, slot)
 
-    def _sync_device_state(self):
-        """Push host-side slot state (lens, last token) back to device after a
-        run of spec rounds, before plain decode or admission reads it."""
-        if not self._spec_dirty:
-            return
-        lens = np.asarray(self._lens).copy()
-        last = np.asarray(self._last_token).copy()
-        for slot in self._spec_dirty:
-            s = self._slots[slot]
-            lens[slot] = s.host_len
-            if s.tokens:
-                last[slot] = s.tokens[-1]
-        self._lens = jnp.asarray(lens)
-        self._last_token = jnp.asarray(last)
-        self._spec_dirty.clear()
-
     def _spec_eligible(self, slot: int) -> bool:
         s = self._slots[slot]
         return (
@@ -546,15 +548,19 @@ class DecodeEngine:
         # Verify takes the proposals as a DEVICE array (concat happens inside
         # the program): the host readback of `proposed` then overlaps the
         # verify dispatch instead of gating it.
-        key = ("verify", k + 1)
-        if key not in self._jit_spec_verify:
-            self._jit_spec_verify[key] = jax.jit(self._spec_verify)
-        greedy_dev, self._caches = self._jit_spec_verify[key](
+        verify = self._program(
+            self._jit_spec_verify, ("verify", k + 1),
+            lambda: jax.jit(self._spec_verify),
+        )
+        greedy_dev, self._caches = verify(
             self.params, self._lora, jnp.int32(s.adapter), jnp.int32(t0),
             proposed, self._caches, jnp.int32(l), jnp.int32(slot),
         )
-        proposed = [int(x) for x in np.asarray(proposed)]
-        greedy = np.asarray(greedy_dev)  # [k+1] ints
+        # The two readbacks below are the round's one acceptance sync: k+1
+        # tokens arrive per pull, and the proposal pull overlaps the verify
+        # dispatch (see above) — there is no per-token host round trip.
+        proposed = [int(x) for x in np.asarray(proposed)]  # raylint: disable=RL603 (per-round acceptance sync, overlaps verify)
+        greedy = np.asarray(greedy_dev)  # raylint: disable=RL603 (per-round acceptance sync: k+1 tokens per pull)
         emitted: List[int] = []
         m = 0
         while m < k and int(greedy[m]) == proposed[m]:
@@ -572,16 +578,18 @@ class DecodeEngine:
         else:
             d["host_lens"][slot] = new_len
             d["pending"][slot] = None
-        # Device lens/last_token sync is DEFERRED (two extra dispatches per
-        # round otherwise): _sync_device_state() runs before any plain decode
-        # or admission touches them.
-        self._spec_dirty.add(slot)
         for token in emitted:
             if not s.active:
                 break
             s.generated += 1
             s.tokens.append(token)
             self._emit(slot, token)
+        # lens/last_token are host-native numpy: keeping them current after a
+        # spec round is a pure host write (the old device-canonical design
+        # needed a deferred device sync here).
+        self._lens[slot] = s.host_len
+        if s.tokens:
+            self._last_token[slot] = s.tokens[-1]
 
     def _insert_prompt_kv(self, slot: int, prompt: List[int], adapter: int,
                           cached_offset: int):
@@ -593,9 +601,11 @@ class DecodeEngine:
         if n == 0 or n <= cached_offset:
             return
         # Host readback of rows [0, n): [L, 2, n, Hkv, D]. The already-cached
-        # prefix rides along (the radix walk dedups it without copying).
+        # prefix rides along (the radix walk dedups it without copying). One
+        # bulk pull per INSERT (per admitted prompt), amortized by every
+        # future hit skipping the prefix's prefill FLOPs entirely.
         kv = np.stack([
-            np.stack([np.asarray(ck[slot, :n]), np.asarray(cv[slot, :n])])
+            np.stack([np.asarray(ck[slot, :n]), np.asarray(cv[slot, :n])])  # raylint: disable=RL603 (bulk per-insert readback, not per-step)
             for ck, cv in self._caches
         ])
         self._prefix_cache.insert(prompt[:n], kv, namespace=adapter)
@@ -705,8 +715,8 @@ class DecodeEngine:
             bucket = self._bucket(len(prompt))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(prompt)] = prompt
-            key = ("detached", bucket)
-            if key not in self._jit_prefill:
+
+            def make_detached():
                 cfg = self.cfg
 
                 def detached(params, lora_p, tokens, adapter_id):
@@ -730,8 +740,12 @@ class DecodeEngine:
                     )  # [L, 2, S, Hkv, D]
                     return logits[0], kv
 
-                self._jit_prefill[key] = jax.jit(detached)
-            logits, kv_dev = self._jit_prefill[key](
+                return jax.jit(detached)
+
+            prog = self._program(
+                self._jit_prefill, ("detached", bucket), make_detached
+            )
+            logits, kv_dev = prog(
                 self.params, self._lora, jnp.asarray(padded), jnp.int32(adapter)
             )
             first_logits = np.asarray(logits[len(prompt) - 1])
@@ -764,8 +778,8 @@ class DecodeEngine:
             prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
         padded = np.zeros((1, sb), np.int32)
         padded[0, : len(suffix)] = suffix
-        key = ("detached_suffix", mb, sb)
-        if key not in self._jit_prefill:
+
+        def make_detached_suffix():
             cfg = self.cfg
 
             def detached_suffix(params, lora_p, prefix, tokens, off, adapter_id):
@@ -801,8 +815,12 @@ class DecodeEngine:
                 ])  # [L, 2, sb, Hkv, D]
                 return logits[0], suffix_kv
 
-            self._jit_prefill[key] = jax.jit(detached_suffix)
-        logits, suffix_kv = self._jit_prefill[key](
+            return jax.jit(detached_suffix)
+
+        prog = self._program(
+            self._jit_prefill, ("detached_suffix", mb, sb), make_detached_suffix
+        )
+        logits, suffix_kv = prog(
             self.params, self._lora, jnp.asarray(prefix_kv),
             jnp.asarray(padded), jnp.int32(m), jnp.int32(adapter),
         )
@@ -819,12 +837,27 @@ class DecodeEngine:
 
     # -- stepper -----------------------------------------------------------
     def _bucket(self, n: int) -> int:
-        from ray_tpu._private.config import CONFIG
+        """Smallest entry of the engine's fixed bucket table that fits n
+        (power-of-two multiples of llm_prefill_bucket_min, capped at T)."""
+        for b in self._prefill_buckets:
+            if n <= b:
+                return b
+        return self.T
 
-        b = max(1, CONFIG.llm_prefill_bucket_min)
-        while b < n:
-            b *= 2
-        return min(b, self.T)
+    def _program(self, cache: dict, key, make):
+        """Get-or-build a jitted program under the engine-wide cap.
+
+        Keys are drawn from the bucket table, so growth is log-shaped by
+        construction; llm_max_jit_programs bounds the cross products
+        ((prefix, suffix) pairs, spec-k variants) that remain. Past the cap
+        the oldest-inserted program is dropped — re-requesting it later
+        re-jits (XLA's own compilation cache may still serve the binary)."""
+        prog = cache.get(key)
+        if prog is None:
+            if self._max_jit_programs and len(cache) >= self._max_jit_programs:
+                cache.pop(next(iter(cache)))
+            prog = cache[key] = make()
+        return prog
 
     def _admit(self):
         with self._lock:
@@ -837,8 +870,6 @@ class DecodeEngine:
             depth = len(self._queue)
             slot = free[0]
         self._queue_gauge.set(float(depth))
-        if self._spec is not None:
-            self._sync_device_state()  # prefill reads/writes device lens
 
         if item[0] == "prefilled":
             (_tag, kv, prompt_len, first_logits, sampling, callback, adapter,
@@ -861,13 +892,14 @@ class DecodeEngine:
                 kv = np.concatenate([kv, pad], axis=2)
             elif P > bucket:
                 kv = kv[:, :, :bucket]
-            key = ("attach", bucket)
-            if key not in self._jit_prefill:
-                self._jit_prefill[key] = jax.jit(self._attach_kv)
-            self._caches = self._jit_prefill[key](
+            attach = self._program(
+                self._jit_prefill, ("attach", bucket),
+                lambda: jax.jit(self._attach_kv),
+            )
+            self._caches = attach(
                 self._caches, jnp.asarray(kv), jnp.int32(slot)
             )
-            self._lens = self._lens.at[slot].set(prompt_len)
+            self._lens[slot] = prompt_len
             first = _sample_host(np.asarray(first_logits), sampling, self._np_rng)
             if self._spec is not None:
                 # Transferred prefixes carry no draft KV: plain decode here.
@@ -911,10 +943,11 @@ class DecodeEngine:
                         + prefix_kv.shape[3:], prefix_kv.dtype,
                     )
                     prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
-                akey = ("attach", mb)
-                if akey not in self._jit_prefill:
-                    self._jit_prefill[akey] = jax.jit(self._attach_kv)
-                self._caches = self._jit_prefill[akey](
+                attach = self._program(
+                    self._jit_prefill, ("attach", mb),
+                    lambda: jax.jit(self._attach_kv),
+                )
+                self._caches = attach(
                     self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
                 )
                 lease.release()
@@ -922,17 +955,22 @@ class DecodeEngine:
             bucket = self._bucket(len(suffix))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(suffix)] = suffix
-            if bucket not in self._jit_prefill:
-                self._jit_prefill[bucket] = jax.jit(self._prefill_at)
-            last_logits, self._caches, self._lens = self._jit_prefill[bucket](
+            prefill = self._program(
+                self._jit_prefill, bucket, lambda: jax.jit(self._prefill_at)
+            )
+            last_logits, self._caches = prefill(
                 self.params, self._lora, jnp.asarray(padded), self._caches,
-                self._lens, jnp.int32(slot), jnp.int32(offset),
+                jnp.int32(slot), jnp.int32(offset),
                 jnp.int32(prompt_len), jnp.int32(adapter),
             )
+            self._lens[slot] = prompt_len
             self.last_prefill = {
                 "bucket": bucket, "offset": offset, "prompt_len": prompt_len,
             }
-            first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)
+            # The admission sync: the request's FIRST token must be sampled
+            # host-side before the slot can join the decode batch — one
+            # [V]-row pull per admitted request, not per step.
+            first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)  # raylint: disable=RL603 (one per-admission pull)
             if self._prefix_cache is not None:
                 self._insert_prompt_kv(slot, prompt, adapter, offset)
             if self._spec is not None:
@@ -942,10 +980,11 @@ class DecodeEngine:
                     # transferred prefixes).
                     self._spec["ready"][slot] = False
                 else:
-                    dkey = ("dprefill", bucket)
-                    if dkey not in self._jit_spec_prefill:
-                        self._jit_spec_prefill[dkey] = jax.jit(self._draft_prefill)
-                    self._spec["caches"] = self._jit_spec_prefill[dkey](
+                    dprefill = self._program(
+                        self._jit_spec_prefill, ("dprefill", bucket),
+                        lambda: jax.jit(self._draft_prefill),
+                    )
+                    self._spec["caches"] = dprefill(
                         self._spec["params"], jnp.asarray(padded),
                         self._spec["caches"], jnp.int32(slot),
                     )
@@ -961,8 +1000,8 @@ class DecodeEngine:
         s.host_len = prompt_len
         s.adapter = adapter
         s.tokens = [first]
-        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
-        self._last_token = self._last_token.at[slot].set(first)
+        self._adapter_ids[slot] = adapter
+        self._last_token[slot] = first
         self._emit(slot, first)
         return True
 
@@ -1017,7 +1056,6 @@ class DecodeEngine:
                 self._spec_round(active[0])
                 continue
             if self._spec is not None:
-                self._sync_device_state()
                 for i in active:
                     # A plain step advances the target but not the draft: the
                     # draft cache is now behind and its proposals would be
@@ -1030,21 +1068,27 @@ class DecodeEngine:
             if n > 1:
                 self._multi_round(active, n)
                 continue
-            logits, self._caches, self._lens = self._jit_decode(
-                self.params, self._lora, self._adapter_ids, self._last_token,
-                self._caches, self._lens,
+            # lens/last_token/adapter_ids ride host->device per dispatch (an
+            # async copy of a few int32s); the returned device lens is
+            # discarded — the host mirrors below are canonical.
+            logits, self._caches, _ = self._jit_decode(
+                self.params, self._lora, jnp.asarray(self._adapter_ids),
+                jnp.asarray(self._last_token), self._caches,
+                jnp.asarray(self._lens),
             )
-            logits_np = np.asarray(logits)
-            new_last = np.array(self._last_token)  # writable copy
+            # The step's ONE device->host pull: every active slot's next-token
+            # logits arrive in a single [B, V] readback (sampling params can
+            # differ per slot, so sampling itself is host-side).
+            logits_np = np.asarray(logits)  # raylint: disable=RL603 (the per-dispatch batched readback)
+            self._lens += 1  # every slot's kv row advanced on device
             for i in active:
                 s = self._slots[i]
                 token = _sample_host(logits_np[i], s.params, self._np_rng)
                 s.generated += 1
                 s.host_len += 1  # the decode step wrote last_token's kv row
                 s.tokens.append(token)
-                new_last[i] = token
+                self._last_token[i] = token
                 self._emit(i, token)
-            self._last_token = jnp.asarray(new_last)
 
     def _choose_multi_step(self, active) -> int:
         """Tokens to decode in the next dispatch: >1 only when every active
@@ -1073,13 +1117,15 @@ class DecodeEngine:
         """One multi-token dispatch + host-side emission with rollback for
         slots that stop early (stop_token): their device lens/last_token are
         corrected back to what was actually consumed."""
-        toks_dev, self._caches, lens = self._jit_decode_multi(
-            self.params, self._lora, self._adapter_ids, self._last_token,
-            self._caches, self._lens, n=n,
+        toks_dev, self._caches, _ = self._jit_decode_multi(
+            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            jnp.asarray(self._last_token), self._caches,
+            jnp.asarray(self._lens), n=n,
         )
-        toks = np.asarray(toks_dev)  # [n, B]
-        new_last = np.array(self._last_token)
-        new_lens = np.asarray(lens).copy()
+        # The chunk's ONE device->host pull: n tokens x B slots per readback
+        # (the whole point of multi-step decode).
+        toks = np.asarray(toks_dev)  # raylint: disable=RL603 (the per-chunk batched readback)
+        self._lens += n  # device wrote n kv rows per slot
         for i in active:
             s = self._slots[i]
             consumed = 0
@@ -1091,12 +1137,10 @@ class DecodeEngine:
                 s.generated += 1
                 s.host_len += 1
                 s.tokens.append(token)
-                new_last[i] = token
+                self._last_token[i] = token
                 self._emit(i, token)
             if consumed < n:
                 # Early stop: rows past the last consumed token are invisible
                 # once lens rolls back (kv_mask <= lens) and get overwritten
                 # by the slot's next occupant.
-                new_lens[i] = s.host_len
-        self._lens = jnp.asarray(new_lens)
-        self._last_token = jnp.asarray(new_last)
+                self._lens[i] = s.host_len

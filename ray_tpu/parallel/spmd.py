@@ -96,7 +96,10 @@ def init_state(model, cfg, optimizer, mesh: Mesh, rules=None, rng=None,
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
     with mesh:
-        state = jax.jit(make, out_shardings=shardings)(rng)
+        # One-shot by design: sharded init runs once per training run, and
+        # out_shardings is what prevents the host-memory spike — caching the
+        # wrapper would only pin a program that is never called again.
+        state = jax.jit(make, out_shardings=shardings)(rng)  # raylint: disable=RL601 (one-shot sharded-init program)
     return state, shardings
 
 

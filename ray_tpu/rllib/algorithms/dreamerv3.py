@@ -484,6 +484,10 @@ class DreamerV3:
                 self.params, self._h, self._z, self._prev_action, self._obs,
                 float(self._is_first), sub,
             )
+            # The env boundary is host-side by nature: acting requires the
+            # action (and the recurrent h/z carry) on host every step. ONE
+            # batched transfer, not three.
+            h, z, action = jax.device_get((h, z, action))  # raylint: disable=RL603 (inherent env-step sync, batched)
             action = int(action)
             next_obs, reward, term, trunc, _ = self._env.step(action)
             self._replay.add(self._obs, action, self._arrival_reward,
@@ -492,7 +496,7 @@ class DreamerV3:
             self._arrival_cont = 0.0 if term else 1.0
             self._episode_return += float(reward)
             self._total_timesteps += 1
-            self._h, self._z = np.asarray(h), np.asarray(z)
+            self._h, self._z = h, z  # already host (batched pull above)
             self._prev_action = action
             self._is_first = False
             if term or trunc:
@@ -534,7 +538,11 @@ class DreamerV3:
                         batch, sub,
                     )
                 )
-                metrics_out = {k: float(v) for k, v in m.items()}
+                # one host transfer for the scalar metrics, not one per key
+                metrics_out = {
+                    k: float(v)
+                    for k, v in jax.device_get(m).items()  # raylint: disable=RL603 (per-update metrics pull, single batched transfer)
+                }
         if returns:
             self._ret_history.extend(returns)
             self._ret_history = self._ret_history[-100:]

@@ -47,7 +47,13 @@ class Learner:
         self._target_spec = target_spec
         self._target_tau = target_polyak_tau
         self._target = self._target_subset(self._params) if target_spec else None
-        self._jit_cache: Dict[tuple, Any] = {}  # batch signature -> compiled update
+        # batch signature -> compiled update. Signatures are key-sets (plus
+        # per-leaf shardability under a mesh): stable for a fixed workload,
+        # but nothing upstream bounds them — an adversarial/buggy caller
+        # rotating batch key-sets would compile without limit, so the cache
+        # evicts oldest-first past a small cap.
+        self._jit_cache: Dict[tuple, Any] = {}
+        self._max_jit_cache = 8
         self._mesh = None
         if use_mesh:
             from ray_tpu.parallel import mesh as mesh_lib
@@ -143,15 +149,22 @@ class Learner:
                                    if self._leaf_shardable(v))))
 
     def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        import jax
+
         # Keyed cache, not a single slot: workloads that alternate signatures
         # (epoch tail batches under a mesh) must not recompile on every flip.
         sig = self._batch_signature(batch)
         jit_update = self._jit_cache.get(sig)
         if jit_update is None:
+            if len(self._jit_cache) >= self._max_jit_cache:
+                self._jit_cache.pop(next(iter(self._jit_cache)))
             jit_update = self._jit_cache[sig] = self._build_update(batch)
         self._params, self._opt_state, self._target, loss, metrics = jit_update(
             self._params, self._opt_state, self._target, batch
         )
+        # One host transfer for all scalar metrics — float() per metric would
+        # block on a separate device->host pull each.
+        loss, metrics = jax.device_get((loss, metrics))
         out = {k: float(v) for k, v in metrics.items()}
         out["total_loss"] = float(loss)
         return out

@@ -158,9 +158,10 @@ class SingleAgentEnvRunner:
             obs_t = np.asarray(self._e2m(self._obs))
             self._rng, sub = jax.random.split(self._rng)
             action, logp, vf = self._policy_step(self._params, obs_t, sub)
-            action = np.asarray(action)
-            logp = np.asarray(logp)
-            vf = np.asarray(vf)
+            # Inherent env-boundary sync: env.step needs host actions every
+            # step, and logp/vf feed the host episode buffers. ONE batched
+            # transfer instead of three sequential np.asarray pulls.
+            action, logp, vf = jax.device_get((action, logp, vf))  # raylint: disable=RL603 (inherent env-step sync, batched)
             env_action = np.asarray(self._m2e(action))
             next_obs, rewards, terms, truncs, _infos = self._envs.step(env_action)
             self._e2m.observe(action, rewards)
@@ -216,7 +217,7 @@ class SingleAgentEnvRunner:
             _a, _lp, vf = self._policy_step(
                 self._params, np.asarray(next_obs_t)[None, :], sub
             )
-            bootstrap = float(np.asarray(vf)[0])
+            bootstrap = float(np.asarray(vf)[0])  # raylint: disable=RL603 (one pull per finished episode, not per step)
         out = {
             Columns.OBS: np.asarray(ep[Columns.OBS], np.float32),
             Columns.ACTIONS: np.asarray(ep[Columns.ACTIONS]),

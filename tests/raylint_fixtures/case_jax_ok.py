@@ -1,0 +1,50 @@
+"""No-false-positive fixture: the legitimate bucketed-jit engine pattern.
+
+Mirrors DecodeEngine's discipline — bounded bucket table, capped program
+cache with oldest-first eviction, host-native counters, and exactly one
+device->host readback per dispatch (outside any loop). jaxlint must stay
+silent on every line of this file.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BUCKETS = (16, 32, 64, 128)
+
+
+class BucketedEngine:
+    def __init__(self, f, max_programs=8):
+        self._f = f
+        self._progs = {}
+        self._max_programs = max_programs
+        self._jit_decode = jax.jit(f)
+        self._lens = np.zeros((4,), np.int32)      # host-native mirror
+
+    def _bucket(self, n):
+        for b in _BUCKETS:
+            if n <= b:
+                return b
+        return _BUCKETS[-1]
+
+    def _program(self, key):
+        prog = self._progs.get(key)
+        if prog is None:
+            if len(self._progs) >= self._max_programs:
+                self._progs.pop(next(iter(self._progs)))
+            prog = self._progs[key] = jax.jit(self._f)
+        return prog
+
+    def prefill(self, prompt):
+        bucket = self._bucket(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        logits = self._program(("prefill", bucket))(jnp.asarray(padded))
+        self._lens[0] = len(prompt)                # host write, no device sync
+        return np.asarray(logits)                  # one readback per dispatch
+
+    def decode(self, steps):
+        state = jnp.zeros((4,), jnp.float32)
+        for _ in range(steps):
+            state = self._jit_decode(state)
+        return np.asarray(state)                   # sync once, after the loop

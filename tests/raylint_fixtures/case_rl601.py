@@ -1,0 +1,30 @@
+"""Seeded RL601 violations (jit constructed in hot paths)."""
+
+import jax
+
+
+def bad_jit_in_loop(fns, xs):
+    out = []
+    for f, x in zip(fns, xs):
+        step = jax.jit(f)                          # RL601
+        out.append(step(x))
+    return out
+
+
+def bad_inline_jit(f, x):
+    return jax.jit(f)(x)                           # RL601
+
+
+def suppressed_inline(f, x):
+    return jax.jit(f)(x)  # raylint: disable=RL601 (one-shot init program)
+
+
+_module_step = jax.jit(lambda x: x + 1)            # ok: module-level, built once
+
+
+class OkEngine:
+    def __init__(self, f):
+        self._jit_step = jax.jit(f)                # ok: cached at init
+
+    def ok_cached_call(self, x):
+        return self._jit_step(x)

@@ -1,0 +1,38 @@
+"""Seeded RL602 violations (unbounded jitted-program caches)."""
+
+import jax
+
+
+class BadCache:
+    def __init__(self):
+        self._progs = {}
+
+    def bad_unbounded(self, f, n):
+        if n not in self._progs:
+            self._progs[n] = jax.jit(f)            # RL602
+        return self._progs[n]
+
+
+class SuppressedCache:
+    def __init__(self):
+        self._progs = {}
+
+    def suppressed_store(self, f, n):
+        if n not in self._progs:
+            self._progs[n] = jax.jit(f)  # raylint: disable=RL602 (n drawn from a fixed enum)
+        return self._progs[n]
+
+
+class OkBoundedCache:
+    """The legitimate pattern: explicit cap + oldest-first eviction."""
+
+    def __init__(self, cap=8):
+        self._progs = {}
+        self._cap = cap
+
+    def ok_bounded(self, f, n):
+        if n not in self._progs:
+            if len(self._progs) >= self._cap:
+                self._progs.pop(next(iter(self._progs)))
+            self._progs[n] = jax.jit(f)
+        return self._progs[n]

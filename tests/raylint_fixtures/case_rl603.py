@@ -1,0 +1,52 @@
+"""Seeded RL603 violations (host syncs in decode/train hot paths)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BadEngine:
+    def __init__(self, f):
+        self._jit_step = jax.jit(f)
+        self._lens = jnp.zeros((4,), jnp.int32)
+
+    def bad_sync_in_loop(self, state, steps):
+        lens = None
+        for _ in range(steps):
+            state = self._jit_step(state)
+            lens = np.asarray(self._lens)          # RL603
+        return state, lens
+
+    def bad_item_in_loop(self, state, steps):
+        out = []
+        for _ in range(steps):
+            state = self._jit_step(state)
+            out.append(state.item())               # RL603
+        return out
+
+    def _helper_pull(self, x):
+        return float(self._jit_step(x))            # RL603 (loop-called helper)
+
+    def bad_loop_called_helper(self, xs):
+        return [self._helper_pull(x) for x in xs]
+
+    async def bad_async_sync(self, x):
+        return np.asarray(self._jit_step(x))       # RL603 (async frame)
+
+    def suppressed_sync(self, state, steps):
+        lens = None
+        for _ in range(steps):
+            state = self._jit_step(state)
+            lens = np.asarray(self._lens)  # raylint: disable=RL603 (one batched readback per chunk)
+        return state, lens
+
+    def ok_sync_after_loop(self, state, steps):
+        for _ in range(steps):
+            state = self._jit_step(state)
+        return np.asarray(state)                   # one readback per chunk
+
+    def ok_host_values(self, rows):
+        out = []
+        for r in rows:
+            out.append(float(r))                   # host floats, not device
+        return out

@@ -1,0 +1,35 @@
+"""Seeded RL604 violations (retrace hazards at jitted-call boundaries)."""
+
+import jax
+import numpy as np
+
+_step = jax.jit(lambda tokens: tokens)
+
+
+def bad_list_arg(prompt):
+    toks = list(prompt)
+    return _step(toks)                             # RL604
+
+
+def bad_list_display(a, b):
+    return _step([a, b])                           # RL604
+
+
+def bad_unbucketed_shape(prompt):
+    padded = np.zeros((1, len(prompt)), np.int32)
+    return _step(padded)                           # RL604
+
+
+def suppressed_list(prompt):
+    toks = list(prompt)
+    return _step(toks)  # raylint: disable=RL604 (callers pass fixed-length tuples)
+
+
+def ok_bucketed(prompt, bucket):
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, : len(prompt)] = prompt
+    return _step(padded)
+
+
+def ok_array(arr):
+    return _step(np.asarray(arr))

@@ -1,0 +1,27 @@
+"""Seeded RL605 violations (donated buffers read after the call)."""
+
+import jax
+
+
+def bad_read_after_donate(f, state, batch):
+    step = jax.jit(f, donate_argnums=(0,))
+    new_state, loss = step(state, batch)
+    return state, loss                             # RL605
+
+
+def suppressed_read(f, state, batch):
+    step = jax.jit(f, donate_argnums=(0,))
+    new_state, loss = step(state, batch)
+    return state, loss  # raylint: disable=RL605 (aliasing proven safe in test)
+
+
+def ok_rebound(f, state, batch):
+    step = jax.jit(f, donate_argnums=(0,))
+    state, loss = step(state, batch)
+    return state, loss
+
+
+def ok_undonated(f, state, batch):
+    step = jax.jit(f)
+    out, loss = step(state, batch)
+    return state, out, loss

@@ -1,0 +1,51 @@
+"""Seeded RL701 violations (side effects inside traced functions)."""
+
+import jax
+
+
+class BadModule:
+    def __init__(self):
+        self._trace_log = []
+        self._jit_fwd = jax.jit(self._forward)
+
+    def _forward(self, params, x):
+        y = x @ params["w"]
+        self._last = y                             # RL701: write to self
+        self._trace_log.append("fwd")              # RL701: mutator on self
+        return y
+
+
+def bad_closure_append(xs):
+    seen = []
+
+    def bad_scan_body(carry, x):
+        seen.append(x)                             # RL701: closed-over list
+        return carry + x, carry
+
+    return jax.lax.scan(bad_scan_body, 0.0, xs)
+
+
+class SuppressedModule:
+    def __init__(self):
+        self._jit_fwd = jax.jit(self._forward)
+
+    def _forward(self, params, x):
+        self._trace_count = 1  # raylint: disable=RL701 (trace-time counter, test-only)
+        return x @ params["w"]
+
+
+def ok_local_state(xs):
+    def ok_scan_body(carry, x):
+        acc = []
+        acc.append(x)                              # local list: fine
+        return carry + x, carry
+
+    return jax.lax.scan(ok_scan_body, 0.0, xs)
+
+
+class OkSameName:
+    """A method named like a traced nested fn elsewhere must NOT be checked."""
+
+    def bad_scan_body(self, item):
+        self._cache = item                         # plain method, not traced
+        return item

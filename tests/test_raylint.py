@@ -119,6 +119,78 @@ def test_rl501_fires_and_suppresses():
         assert sym not in found, sym
 
 
+# ---- jaxlint family (RL6xx/RL7xx) -------------------------------------------
+
+def test_rl601_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl601.py"))
+    assert found.get("bad_jit_in_loop") == {"RL601"}
+    assert found.get("bad_inline_jit") == {"RL601"}
+    for sym in ("suppressed_inline", "ok_cached_call", "__init__",
+                "<module>"):
+        assert sym not in found, sym
+
+
+def test_rl602_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl602.py"))
+    assert found.get("bad_unbounded") == {"RL602"}
+    for sym in ("suppressed_store", "ok_bounded"):
+        assert sym not in found, sym
+
+
+def test_rl603_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl603.py"))
+    for sym in ("bad_sync_in_loop", "bad_item_in_loop", "_helper_pull",
+                "bad_async_sync"):
+        assert found.get(sym) == {"RL603"}, sym
+    for sym in ("suppressed_sync", "ok_sync_after_loop", "ok_host_values"):
+        assert sym not in found, sym
+
+
+def test_rl604_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl604.py"))
+    for sym in ("bad_list_arg", "bad_list_display", "bad_unbucketed_shape"):
+        assert found.get(sym) == {"RL604"}, sym
+    for sym in ("suppressed_list", "ok_bucketed", "ok_array"):
+        assert sym not in found, sym
+
+
+def test_rl605_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl605.py"))
+    assert found.get("bad_read_after_donate") == {"RL605"}
+    for sym in ("suppressed_read", "ok_rebound", "ok_undonated"):
+        assert sym not in found, sym
+
+
+def test_rl701_fires_and_suppresses():
+    findings = _fixture("case_rl701.py")
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, set()).add(f.code)
+    assert by_symbol.get("BadModule._forward") == {"RL701"}
+    assert by_symbol.get("bad_closure_append.bad_scan_body") == {"RL701"}
+    # a traced-fn check must not leak onto same-named plain methods
+    assert "OkSameName.bad_scan_body" not in by_symbol
+    assert "SuppressedModule._forward" not in by_symbol
+    assert "ok_local_state.ok_scan_body" not in by_symbol
+
+
+def test_jaxlint_silent_on_bucketed_jit_pattern():
+    # The legitimate engine shape (bucket table + capped program cache +
+    # host-native counters + one readback per dispatch) must be finding-free.
+    assert _fixture("case_jax_ok.py") == []
+
+
+def test_jaxlint_skips_files_without_jax(tmp_path):
+    # control-plane float()/asarray idioms are out of jaxlint's scope
+    f = tmp_path / "hostcode.py"
+    f.write_text(
+        "import numpy as np\n"
+        "def tally(rows):\n"
+        "    return [float(r) for r in np.asarray(rows)]\n"
+    )
+    assert not [x for x in lint_file(str(f)) if x.code.startswith("RL6")]
+
+
 # ---- baseline ---------------------------------------------------------------
 
 def test_baseline_grandfathers_by_symbol():
@@ -162,6 +234,81 @@ def test_cli_exit_codes(tmp_path):
     good = tmp_path / "good.py"
     good.write_text("def f(actor):\n    return actor.ping.remote()\n")
     assert raylint_main([str(good)]) == 0
+
+
+def test_cli_baselined_only_exits_zero_even_when_reported(tmp_path):
+    """The CI contract: exit reflects UNBASELINED findings only.
+    --no-baseline widens what is reported, never what fails."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(actor):\n    actor.ping.remote()\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"entries": [
+        {"file": "bad.py", "code": "RL501", "symbol": "f", "reason": "test"}
+    ]}))
+    assert raylint_main([str(bad), "--baseline", str(base)]) == 0
+    assert raylint_main(
+        [str(bad), "--baseline", str(base), "--no-baseline"]
+    ) == 0
+
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(actor):\n    actor.ping.remote()\n")
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"entries": []}))
+    assert raylint_main(
+        [str(bad), "--baseline", str(empty), "--format", "json"]
+    ) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit"] == 1
+    assert doc["summary"] == {"violations": 1, "baselined": 0, "stale": 0}
+    (v,) = doc["violations"]
+    assert v["code"] == "RL501" and v["file"] == "bad.py" and v["line"] == 2
+    assert v["symbol"] == "f" and v["message"]
+
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"entries": [
+        {"file": "bad.py", "code": "RL501", "symbol": "f", "reason": "test"}
+    ]}))
+    assert raylint_main(
+        [str(bad), "--baseline", str(base), "--format", "json"]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit"] == 0 and doc["summary"]["baselined"] == 1
+    assert doc["baselined"][0]["code"] == "RL501"
+
+
+def test_cli_fail_stale(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"entries": [
+        {"file": "gone.py", "code": "RL501", "symbol": "f", "reason": "old"}
+    ]}))
+    assert raylint_main([str(good), "--baseline", str(base)]) == 0
+    assert raylint_main(
+        [str(good), "--baseline", str(base), "--fail-stale"]
+    ) == 1
+
+
+def test_shipped_tree_clean_per_family():
+    """The tier-1 gate, per family: the concurrency checkers (RL1xx-RL5xx)
+    and the jaxlint compute-plane checkers (RL6xx/RL7xx) must EACH report
+    zero unbaselined findings over the shipped package."""
+    from ray_tpu.devtools.raylint import CODES
+
+    families = {
+        "concurrency": {c for c in CODES if c[2] in "12345"},
+        "jax": {c for c in CODES if c[2] in "67"},
+    }
+    findings = lint_paths([PKG_DIR])
+    entries = load_baseline()
+    for name, codes in families.items():
+        fam = [f for f in findings if f.code in codes]
+        violations, _g, _s = partition_baselined(fam, entries)
+        assert not violations, (
+            name + ":\n" + "\n".join(f.render() for f in violations)
+        )
 
 
 def test_cli_module_entrypoint_clean_tree():
