@@ -1,0 +1,162 @@
+"""Benchmark: sharded checkpoint save/restore (docs/checkpoint.md).
+
+Emits BENCH_CKPT.json in the BENCH_* shape: the step-loop BLOCKED time per
+save for the sync vs async paths (the number the CheckFreq split is supposed
+to shrink), end-to-end persist time, and restore time both onto the saved
+layout and resharded onto a transposed mesh.
+
+Methodology: the "train step" is a jitted matmul chain long enough to dwarf
+dispatch noise; blocked time is (step+save loop wall) - (step-only loop wall)
+over the same number of iterations, so fixed per-call dispatch cost cancels
+(see docs/perf.md on why single-shot timings lie on this backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+
+def _tree(mesh, dtype, n_layers: int, width: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("a", None))
+    key = jax.random.PRNGKey(0)
+    tree = {}
+    for i in range(n_layers):
+        key, sub = jax.random.split(key)
+        tree[f"layer_{i}"] = {
+            "kernel": jax.device_put(
+                jax.random.normal(sub, (width, width), dtype), sh),
+            "bias": jax.device_put(jnp.zeros((width,), dtype),
+                                   NamedSharding(mesh, P("a"))),
+        }
+    return tree
+
+
+def _step_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ x)
+        return x
+
+    return step
+
+
+def _timed_loop(step, x, iters, save=None):
+    import jax
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        x = step(x)
+        if save is not None:
+            save(i)
+    jax.block_until_ready(x)
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu import checkpoint as ckpt
+
+    n_dev = len(jax.devices())
+    mesh_axis = n_dev if n_dev in (2, 4, 8) else 1
+    mesh = Mesh(np.array(jax.devices()[:mesh_axis]).reshape(mesh_axis), ("a",))
+    on_tpu = jax.default_backend() == "tpu"
+    n_layers, width = (8, 2048) if on_tpu else (8, 512)
+    tree = _tree(mesh, jnp.float32, n_layers, width)
+    tree_bytes = sum(int(np.prod(v.shape)) * 4
+                     for layer in tree.values() for v in layer.values())
+    step = _step_fn()
+    x0 = jnp.ones((width, width), jnp.float32)
+    jax.block_until_ready(step(x0))  # compile + warm
+    iters = 10
+    base = tempfile.mkdtemp(prefix="bench_ckpt_")
+    results = []
+    try:
+        base_wall = _timed_loop(step, x0, iters)
+
+        # Sync save every step: the loop eats snapshot + IO + commit.
+        w = ckpt.AsyncCheckpointWriter(inflight=2)
+        sync_wall = _timed_loop(
+            step, x0, iters,
+            save=lambda i: w.save_sync(os.path.join(base, f"s{i}"), tree))
+        # Async save every step: the loop eats snapshot + enqueue only.
+        async_wall = _timed_loop(
+            step, x0, iters,
+            save=lambda i: w.save(os.path.join(base, f"a{i}"), tree))
+        drain_t0 = time.perf_counter()
+        w.wait_until_finished()
+        drain_s = time.perf_counter() - drain_t0
+        w.shutdown()
+
+        sync_blocked = (sync_wall - base_wall) / iters
+        async_blocked = (async_wall - base_wall) / iters
+        results.append({
+            "metric": "ckpt_step_blocked_ms_sync",
+            "value": round(sync_blocked * 1e3, 2),
+            "tree_mb": round(tree_bytes / 1e6, 1), "iters": iters,
+        })
+        results.append({
+            "metric": "ckpt_step_blocked_ms_async",
+            "value": round(async_blocked * 1e3, 2),
+            "tree_mb": round(tree_bytes / 1e6, 1), "iters": iters,
+            "speedup_vs_sync": round(sync_blocked / max(async_blocked, 1e-9), 2),
+            "drain_s_after_loop": round(drain_s, 3),
+        })
+
+        path = os.path.join(base, "s0")
+        t0 = time.perf_counter()
+        host = ckpt.restore(path)
+        restore_host_s = time.perf_counter() - t0
+        del host
+        # Transposed layout for the matrices, replicated vectors — a genuine
+        # reshard of every 2-D leaf relative to the saved P("a", None).
+        reshard = {
+            key: NamedSharding(mesh,
+                               P(None, "a") if key.endswith("kernel") else P())
+            for key in ckpt.load_manifest(path)["leaves"]
+        }
+        t0 = time.perf_counter()
+        out = ckpt.restore(path, shardings=reshard)
+        jax.block_until_ready(out)
+        restore_reshard_s = time.perf_counter() - t0
+        results.append({
+            "metric": "ckpt_restore_host_s", "value": round(restore_host_s, 3),
+            "tree_mb": round(tree_bytes / 1e6, 1),
+        })
+        results.append({
+            "metric": "ckpt_restore_reshard_s",
+            "value": round(restore_reshard_s, 3),
+            "tree_mb": round(tree_bytes / 1e6, 1),
+            "note": "axis transposed vs saved layout",
+        })
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    out = {
+        "bench": "checkpoint",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0].device_kind),
+        "results": results,
+    }
+    with open("BENCH_CKPT.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,218 @@
+"""AsyncCheckpointWriter: decouple the device->host snapshot from persistence.
+
+CheckFreq's split (Mohan et al., FAST'21): the step loop pays only for a
+snapshot — ONE batched ``jax.device_get`` of this process's owned shards at the
+step boundary — while serialization, fsync, and the manifest commit run on a
+background thread. A bounded in-flight queue (``train_ckpt_inflight``)
+backpressures the step loop instead of letting host memory grow with
+unpersisted snapshots.
+
+Commit coordination is filesystem-based and non-blocking: every process's
+background writer persists shards + its ``process_<i>.json`` spec; the
+committing process (rank 0) then waits — on its WRITER thread, not the step
+loop — for all specs before writing ``MANIFEST.json``. A rank that dies
+mid-save simply never produces its spec, the commit times out, and the
+directory stays manifest-less (garbage by definition).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.checkpoint import _format
+from ray_tpu.util import tracing
+
+_metrics = None
+_metrics_lock = threading.Lock()
+
+
+def _get_metrics():
+    """Lazy singletons: Counter/Gauge/Histogram no-op their flush outside a
+    cluster, so the writer works in plain scripts and benches too."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+                _metrics = {
+                    "snapshot_s": Histogram(
+                        "ckpt_snapshot_seconds",
+                        "step-loop blocked time per save (device->host "
+                        "snapshot + enqueue)",
+                        boundaries=[0.001, 0.01, 0.1, 1, 10],
+                    ),
+                    "write_s": Histogram(
+                        "ckpt_write_seconds",
+                        "background shard write + spec persist time",
+                        boundaries=[0.01, 0.1, 1, 10, 100],
+                    ),
+                    "bytes": Counter(
+                        "ckpt_saved_bytes", "shard bytes persisted"
+                    ),
+                    "commits": Counter(
+                        "ckpt_commits", "manifests committed"
+                    ),
+                    "failures": Counter(
+                        "ckpt_save_failures", "background save jobs that errored"
+                    ),
+                    "queue_depth": Gauge(
+                        "ckpt_queue_depth", "in-flight async save jobs"
+                    ),
+                }
+    return _metrics
+
+
+class AsyncCheckpointWriter:
+    """Background sharded-checkpoint writer with a bounded in-flight queue.
+
+    ``save()`` blocks only for the snapshot (and, when the queue is full, for
+    backpressure); ``wait_until_finished()`` is the barrier before shutdown or
+    before trusting the latest directory to be committed.
+    """
+
+    def __init__(self, *, inflight: Optional[int] = None,
+                 commit_timeout_s: Optional[float] = None):
+        from ray_tpu._private.config import CONFIG
+
+        if inflight is None:
+            inflight = CONFIG.train_ckpt_inflight
+        if commit_timeout_s is None:
+            commit_timeout_s = CONFIG.train_ckpt_commit_timeout_s
+        self._commit_timeout_s = commit_timeout_s
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=max(1, inflight)
+        )
+        self._idle = threading.Event()
+        self._idle.set()
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, path: str, tree, *, process_index: Optional[int] = None,
+             process_count: Optional[int] = None, commit: Optional[bool] = None):
+        """Snapshot ``tree`` (one batched device_get) and enqueue persistence.
+
+        ``commit=None`` commits iff this process is the committer (rank 0 /
+        single-process). Raises any error a PREVIOUS background job hit, so
+        failures surface at the next step boundary instead of silently.
+        """
+        if self.error is not None:
+            raise RuntimeError(
+                f"previous async checkpoint save failed: {self.error!r}"
+            ) from self.error
+        t0 = time.perf_counter()
+        encoded, plan = _format.snapshot(
+            tree, process_index=process_index, process_count=process_count
+        )
+        job = {
+            "path": path,
+            "encoded": encoded,
+            "plan": plan,
+            "process_index": process_index,
+            "commit": (process_index in (None, 0)) if commit is None else commit,
+            "process_count": 1 if process_count is None else process_count,
+        }
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
+        self._ensure_thread()
+        self._queue.put(job)  # blocks when the in-flight budget is exhausted
+        m = _get_metrics()
+        m["snapshot_s"].observe(time.perf_counter() - t0)
+        m["queue_depth"].set(float(self._pending))
+
+    def save_sync(self, path: str, tree, *, process_index: Optional[int] = None,
+                  process_count: Optional[int] = None,
+                  commit: Optional[bool] = None):
+        """The synchronous path (``train_ckpt_async=0``): snapshot, persist,
+        and (when committer) commit inline — the step loop pays for all of it."""
+        do_commit = (process_index in (None, 0)) if commit is None else commit
+        t0 = time.perf_counter()
+        spec = _format.write_process_shards(
+            path, tree, process_index=process_index, process_count=process_count
+        )
+        m = _get_metrics()
+        m["bytes"].inc(float(spec.get("bytes", 0)))
+        if do_commit:
+            _format.commit(
+                path,
+                process_count=1 if process_count is None else process_count,
+                timeout_s=self._commit_timeout_s,
+            )
+            m["commits"].inc()
+        m["write_s"].observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------ background
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="ckpt-writer"
+            )
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._run_job(job)
+            except BaseException as e:  # surfaced on the next save()/wait
+                self.error = e
+                _get_metrics()["failures"].inc()
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+                _get_metrics()["queue_depth"].set(float(self._pending))
+
+    def _run_job(self, job: dict):
+        t0 = time.perf_counter()
+        with tracing.trace(f"ckpt.write:{job['path']}"):
+            spec = _format.write_snapshot(
+                job["path"], job["encoded"], job["plan"],
+                process_index=job["process_index"],
+            )
+            m = _get_metrics()
+            m["bytes"].inc(float(spec.get("bytes", 0)))
+            if job["commit"]:
+                _format.commit(
+                    job["path"],
+                    process_count=job["process_count"],
+                    timeout_s=self._commit_timeout_s,
+                )
+                m["commits"].inc()
+            m["write_s"].observe(time.perf_counter() - t0)
+
+    # --------------------------------------------------------------- barrier
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued save has been persisted (and committed,
+        for committer jobs). Returns False on timeout. Raises if any
+        background job failed."""
+        done = self._idle.wait(timeout)
+        if self.error is not None:
+            raise RuntimeError(
+                f"async checkpoint save failed: {self.error!r}"
+            ) from self.error
+        return done
+
+    def shutdown(self, wait: bool = True):
+        if wait:
+            try:
+                self.wait_until_finished()
+            except RuntimeError:
+                pass  # error already recorded on self.error
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=5.0)
+        self._thread = None

@@ -69,7 +69,11 @@ class LLMConfig:
 
     model_id: str = "test-tiny"
     model_config: Optional[Any] = None  # ModelConfig; defaults to get_config(model_id)
-    checkpoint_path: Optional[str] = None  # dir with params.pkl (else random init)
+    # Weight source: a dir with params.pkl, OR a committed sharded checkpoint
+    # (ray_tpu.checkpoint manifest) — the warm-start path for DP replica
+    # scale-up: every new replica reads only slice files, no pickle of the
+    # whole tree through the object store. None -> random init.
+    checkpoint_path: Optional[str] = None
     num_replicas: int = 1
     num_slots: int = 4            # continuous-batching slots per replica
     max_seq: Optional[int] = None
@@ -96,8 +100,18 @@ def load_model(config: "LLMConfig"):
     cfg = dataclasses.replace(cfg, scan_layers=False, remat=False)
     model = Transformer(cfg)
     if config.checkpoint_path:
-        with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
-            params = pickle.load(f)
+        from ray_tpu import checkpoint as ckpt_lib
+
+        if ckpt_lib.is_sharded(config.checkpoint_path):
+            # Sharded warm start (docs/checkpoint.md): slice files are read
+            # directly (mmap) and only a committed manifest is accepted. A
+            # train-plane save of {"params": ...} and a bare params save both
+            # restore.
+            tree = ckpt_lib.restore(config.checkpoint_path)
+            params = tree.get("params", tree) if isinstance(tree, dict) else tree
+        else:
+            with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
+                params = pickle.load(f)
     else:
         params = model.init(
             jax.random.PRNGKey(config.seed), jnp.zeros((1, 8), jnp.int32)

@@ -340,6 +340,23 @@ class DecodeEngine:
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
+    # -- warm start --------------------------------------------------------
+    @classmethod
+    def from_sharded_checkpoint(cls, cfg: ModelConfig, path: str, **kwargs
+                                ) -> "DecodeEngine":
+        """Build an engine whose weights come from a committed sharded
+        checkpoint (ray_tpu.checkpoint) — the fast DP replica warm-start:
+        slice files are memory-mapped straight off the shared filesystem, so
+        a scale-up replica never pulls a whole pickled tree through the
+        object store. Accepts either a bare params save or a train-state
+        save holding a "params" subtree. Refuses uncommitted (manifest-less)
+        directories."""
+        from ray_tpu.checkpoint import restore
+
+        tree = restore(path)
+        params = tree.get("params", tree) if isinstance(tree, dict) else tree
+        return cls(cfg, params, **kwargs)
+
     # -- lora registry -----------------------------------------------------
     def add_lora(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
                  alpha: float = 1.0) -> int:

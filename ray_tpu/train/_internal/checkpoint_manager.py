@@ -3,6 +3,11 @@
 Design parity: reference `python/ray/train/v2/_internal/execution/checkpoint/
 checkpoint_manager.py` — dedupes per report (all ranks persist into the same directory),
 enforces CheckpointConfig.num_to_keep scored by checkpoint_score_attribute.
+
+Committed-vs-partial: sharded saves (ray_tpu.checkpoint) commit atomically via
+their manifest. The manager tracks every reported checkpoint, but resume flows
+through `latest_committed` — a tracked directory whose async commit never
+landed (worker died mid-save) is never handed back to a restarted attempt.
 """
 
 from __future__ import annotations
@@ -54,9 +59,13 @@ class CheckpointManager:
         if keep is None or len(self._tracked) <= keep:
             return
         entries = sorted(self._tracked.values(), key=self._score, reverse=True)
-        latest = self.latest  # never delete the resume point
+        # Never delete a resume point: the latest (it may still be committing
+        # asynchronously) and the latest COMMITTED one both survive scoring.
+        protected = {
+            c.path for c in (self.latest, self.latest_committed) if c is not None
+        }
         for victim in entries[keep:]:
-            if latest is not None and victim.checkpoint.path == latest.path:
+            if victim.checkpoint.path in protected:
                 continue
             self._tracked.pop(victim.index, None)
             shutil.rmtree(victim.checkpoint.path, ignore_errors=True)
@@ -67,10 +76,47 @@ class CheckpointManager:
         return max(self._tracked, default=0)
 
     @property
+    def highest_tracked_index(self) -> int:
+        """Highest report index actually TRACKED, or -1 when nothing is.
+
+        Distinct from `max_index` (which floors at 0 for the numbering offset):
+        orphan cleanup compares against this, so a dead first attempt's
+        `checkpoint_0` dir — index 0, nothing tracked — is reaped rather than
+        surviving the `0 > 0` comparison."""
+        return max(self._tracked, default=-1)
+
+    @property
     def latest(self) -> Checkpoint | None:
         if not self._tracked:
             return None
         return self._tracked[max(self._tracked)].checkpoint
+
+    @property
+    def latest_committed(self) -> Checkpoint | None:
+        """Newest checkpoint that is safe to resume from: committed sharded
+        save, or a plain directory checkpoint. Partial (manifest-less sharded)
+        dirs are garbage by definition and never returned."""
+        from ray_tpu.checkpoint import is_partial
+
+        for index in sorted(self._tracked, reverse=True):
+            ckpt = self._tracked[index].checkpoint
+            if not is_partial(ckpt.path):
+                return ckpt
+        return None
+
+    def drop_partials(self) -> list[str]:
+        """Untrack and delete tracked-but-uncommitted sharded dirs (a crash
+        beat their async commit). Returns the reaped paths."""
+        from ray_tpu.checkpoint import is_partial
+
+        reaped = []
+        for index in list(self._tracked):
+            path = self._tracked[index].checkpoint.path
+            if is_partial(path):
+                self._tracked.pop(index, None)
+                shutil.rmtree(path, ignore_errors=True)
+                reaped.append(path)
+        return reaped
 
     @property
     def best(self) -> Checkpoint | None:

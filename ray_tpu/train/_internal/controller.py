@@ -122,7 +122,9 @@ class TrainController:
             group.init_sessions(
                 experiment_name=self._experiment_name,
                 storage_path=self._storage_path,
-                latest_checkpoint=self._checkpoints.latest,
+                # Resume only from a COMMITTED checkpoint: a partial sharded
+                # dir (crash mid-async-save) is never handed to a new attempt.
+                latest_checkpoint=self._checkpoints.latest_committed,
                 dataset_shards_per_worker=self._split_datasets(len(group)),
                 trial_info=self._trial_info,
                 report_index_offset=self._checkpoints.max_index,
@@ -136,19 +138,33 @@ class TrainController:
         return group
 
     def _remove_orphan_checkpoints(self):
-        """Delete checkpoint_<n> dirs persisted by a dead attempt but never registered
-        (worker wrote files, group died before the controller polled the report) — the
-        new attempt reuses those indices and must not merge into stale contents."""
+        """Delete checkpoint_<n> dirs a dead attempt left behind.
+
+        Two kinds of garbage: (1) dirs never registered (worker wrote files,
+        group died before the controller polled the report) — the new attempt
+        reuses those indices and must not merge into stale contents; compared
+        against `highest_tracked_index` (-1 when nothing is tracked) so a dead
+        FIRST attempt's checkpoint_0 is reaped too. (2) partial sharded saves —
+        a sentinel but no MANIFEST.json means the commit never landed; those
+        are garbage by definition even when tracked (the crash beat the
+        async commit), so they are dropped from tracking and reaped."""
         import re
         import shutil
 
+        from ray_tpu.checkpoint import is_partial
+
+        self._checkpoints.drop_partials()
         exp_dir = os.path.join(self._storage_path, self._experiment_name)
         if not os.path.isdir(exp_dir):
             return
+        highest = self._checkpoints.highest_tracked_index
         for entry in os.listdir(exp_dir):
             m = re.fullmatch(r"checkpoint_(\d+)", entry)
-            if m and int(m.group(1)) > self._checkpoints.max_index:
-                shutil.rmtree(os.path.join(exp_dir, entry), ignore_errors=True)
+            if m is None:
+                continue
+            full = os.path.join(exp_dir, entry)
+            if int(m.group(1)) > highest or is_partial(full):
+                shutil.rmtree(full, ignore_errors=True)
 
     def _split_datasets(self, world_size: int) -> list[dict] | None:
         if not self._datasets:
@@ -189,7 +205,7 @@ class TrainController:
     def _build_result(self, error) -> Result:
         return Result(
             metrics=self._latest_metrics,
-            checkpoint=self._checkpoints.latest,
+            checkpoint=self._checkpoints.latest_committed,
             path=os.path.join(self._storage_path, self._experiment_name),
             error=error,
             best_checkpoints=self._checkpoints.best_checkpoints,

@@ -39,12 +39,21 @@ class RayTrainWorker:
         train_ctx.init_session(**kwargs)
         return True
 
+    def _drain_checkpoints(self):
+        """On clean train-fn exit, block until every async sharded save this
+        worker enqueued is persisted (rank 0: committed). A failed background
+        save fails the run — a FINISHED run's last checkpoint is committed."""
+        session = train_ctx.get_session()
+        if session is not None:
+            session.wait_for_checkpoints()
+
     def execute(self, fn: Callable, *args, **kwargs):
         """Run an arbitrary function in the worker process (backend hooks etc.)."""
         return fn(*args, **kwargs)
 
     def start_train_fn(self, train_fn: Callable, config: dict | None):
         def run():
+            clean = False
             try:
                 import inspect
 
@@ -53,12 +62,19 @@ class RayTrainWorker:
                     train_fn()
                 else:
                     train_fn(config or {})
+                clean = True
             except SystemExit:
-                pass
+                clean = True
             except BaseException:
                 self._error = traceback.format_exc()
-            finally:
-                self._finished = True
+            if clean:
+                # Errored exits skip the drain: their partial saves stay
+                # uncommitted on purpose (restore ignores them, cleanup reaps).
+                try:
+                    self._drain_checkpoints()
+                except BaseException:
+                    self._error = traceback.format_exc()
+            self._finished = True
 
         self._finished = False
         self._error = None

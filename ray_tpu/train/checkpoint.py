@@ -1,9 +1,10 @@
 """Checkpoint: a directory-of-files abstraction.
 
 Design parity: reference `python/ray/train/_checkpoint.py` — Checkpoint.from_directory /
-to_directory / as_directory over a filesystem path. Orbax/msgpack-friendly: the directory
-contents are opaque to the framework; JAX users typically put an orbax or
-`flax.serialization` blob inside.
+to_directory / as_directory over a filesystem path. The directory contents are
+opaque to the framework EXCEPT for the sharded format (`ray_tpu.checkpoint`,
+marked by its sentinel/manifest files): those directories are committed
+atomically and restore through `to_pytree` with elastic resharding.
 """
 
 from __future__ import annotations
@@ -26,12 +27,19 @@ class Checkpoint:
         return cls(path)
 
     def to_directory(self, path: str | None = None) -> str:
-        """Copy checkpoint contents into `path` (or a fresh temp dir) and return it."""
+        """Copy checkpoint contents into `path` (or a fresh temp dir) and return it.
+
+        The target is CLEARED first: restoring over a non-empty directory must
+        not let stale files from a previous restore survive into the "restored"
+        tree (they would silently mix two checkpoints' state).
+        """
         target = path or os.path.join(
             tempfile.gettempdir(), f"rtpu_ckpt_{uuid.uuid4().hex[:8]}"
         )
         if os.path.abspath(target) != self.path:
-            shutil.copytree(self.path, target, dirs_exist_ok=True)
+            if os.path.isdir(target):
+                shutil.rmtree(target)
+            shutil.copytree(self.path, target)
         return target
 
     @contextlib.contextmanager
@@ -41,6 +49,32 @@ class Checkpoint:
         Local-filesystem storage means no copy is needed; yield the path directly.
         """
         yield self.path
+
+    # ---------------------------------------------------------------- sharded
+
+    @property
+    def is_sharded(self) -> bool:
+        """True when this directory holds (or was targeted by) a sharded save."""
+        from ray_tpu.checkpoint import is_sharded
+
+        return is_sharded(self.path)
+
+    @property
+    def is_committed(self) -> bool:
+        """True when this checkpoint is safe to restore from: a committed
+        sharded save, or a plain (non-sharded) directory checkpoint."""
+        from ray_tpu.checkpoint import is_partial
+
+        return not is_partial(self.path)
+
+    def to_pytree(self, *, shardings=None, mesh=None):
+        """Restore a sharded checkpoint as a pytree — host numpy by default,
+        or redistributed onto the current mesh via ``shardings``/``mesh``
+        (see ray_tpu.checkpoint.restore). Raises for non-sharded or
+        uncommitted directories."""
+        from ray_tpu.checkpoint import restore
+
+        return restore(self.path, shardings=shardings, mesh=mesh)
 
     def __repr__(self):
         return f"Checkpoint(path={self.path!r})"

@@ -57,14 +57,19 @@ class _TrainSession:
         self.report_count = report_index_offset
         self.stop_event = threading.Event()
         self.collective_counters: dict[str, int] = {}  # user barrier/broadcast rounds
+        self._ckpt_writer = None  # lazy AsyncCheckpointWriter (sharded saves)
 
     # ------------------------------------------------------------------ report
 
-    def report(self, metrics: dict, checkpoint: Checkpoint | None = None,
+    def report(self, metrics: dict, checkpoint=None,
                checkpoint_dir_name: str | None = None):
+        from ray_tpu.checkpoint import ShardedState
+
         self.report_count += 1
         persisted = None
-        if checkpoint is not None:
+        if isinstance(checkpoint, ShardedState):
+            persisted = self._persist_sharded(checkpoint, checkpoint_dir_name)
+        elif checkpoint is not None:
             persisted = self._persist_checkpoint(checkpoint, checkpoint_dir_name)
         if self.sync_actor is not None:
             # Lockstep across the gang: report is a barrier (reference semantics).
@@ -98,6 +103,44 @@ class _TrainSession:
         if os.path.abspath(checkpoint.path) != os.path.abspath(target):
             shutil.copytree(checkpoint.path, target, dirs_exist_ok=True)
         return Checkpoint(target)
+
+    # ------------------------------------------------------------ sharded path
+
+    def _checkpoint_writer(self):
+        if self._ckpt_writer is None:
+            from ray_tpu.checkpoint import AsyncCheckpointWriter
+
+            self._ckpt_writer = AsyncCheckpointWriter()
+        return self._ckpt_writer
+
+    def _persist_sharded(self, state, dir_name: str | None) -> Checkpoint:
+        """Sharded save: this rank persists only its owned shards of the pytree
+        into the shared checkpoint_<n> dir; rank 0 commits the manifest once
+        every rank's shards (their process specs) are durable — a filesystem
+        commit barrier, so the async path never blocks the step loop on peers.
+        """
+        from ray_tpu._private.config import CONFIG
+
+        name = dir_name or f"checkpoint_{self.report_count:06d}"
+        target = os.path.join(self.storage_path, self.experiment_name, name)
+        if self.world_size > 1:
+            pi, pc = self.world_rank, self.world_size
+        else:
+            pi = pc = None
+        writer = self._checkpoint_writer()
+        if CONFIG.train_ckpt_async:
+            writer.save(target, state.tree, process_index=pi, process_count=pc)
+        else:
+            writer.save_sync(target, state.tree, process_index=pi,
+                             process_count=pc)
+        return Checkpoint(target)
+
+    def wait_for_checkpoints(self):
+        """Barrier for in-flight async sharded saves; raises if any failed.
+        Called by the worker on clean train-fn exit so a run never FINISHES
+        with its last checkpoint uncommitted."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait_until_finished()
 
 
 def init_session(**kwargs) -> _TrainSession:
@@ -163,10 +206,16 @@ def get_context() -> TrainContext:
     return TrainContext(s)
 
 
-def report(metrics: dict, checkpoint: Checkpoint | None = None, *,
+def report(metrics: dict, checkpoint=None, *,
            checkpoint_dir_name: str | None = None):
     """Parity: ray.train.report — report metrics (+ optional checkpoint); acts as a
-    barrier across the worker gang."""
+    barrier across the worker gang.
+
+    ``checkpoint`` is either a :class:`Checkpoint` (directory copy, every rank
+    writes its own files) or a :class:`ray_tpu.checkpoint.ShardedState` pytree
+    wrapper — the sharded path, where each rank persists only its addressable
+    shards (asynchronously under the ``train_ckpt_async`` flag) and rank 0
+    atomically commits the manifest (docs/checkpoint.md)."""
     s = get_session()
     if s is None:
         raise RuntimeError("ray_tpu.train.report() called outside a training worker")

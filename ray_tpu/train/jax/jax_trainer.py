@@ -97,12 +97,24 @@ class _JaxBackend(Backend):
 class JaxTrainer(DataParallelTrainer):
     """SPMD training over a TPU slice (or CPU gang in tests).
 
-    Example::
+    Example (with elastic sharded checkpointing — docs/checkpoint.md)::
 
         def loop(config):
+            from ray_tpu import checkpoint as ckpt
+
             mesh = mesh_lib.create_mesh({"dp": -1})
-            ...pjit train steps...
-            ray_tpu.train.report({"loss": ...}, checkpoint=...)
+            state = ...init...
+            prev = ray_tpu.train.get_checkpoint()
+            if prev is not None and prev.is_sharded:
+                # Elastic resume: redistributes the saved shards onto THIS
+                # attempt's mesh, whatever world size it came up at.
+                state = prev.to_pytree(shardings=my_shardings(mesh))
+            for step in ...:
+                ...pjit train steps...
+                # Each host persists only its addressable shards; the write
+                # runs async behind one batched device->host snapshot.
+                ray_tpu.train.report({"loss": ...},
+                                     checkpoint=ckpt.ShardedState(state))
 
         JaxTrainer(loop, scaling_config=ScalingConfig(topology="v4-16")).fit()
     """

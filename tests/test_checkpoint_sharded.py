@@ -1,0 +1,391 @@
+"""Sharded async checkpointing plane (ray_tpu.checkpoint + train wiring).
+
+The contract under test (docs/checkpoint.md): shards + specs first, manifest
+last and atomic — a manifest-less dir is garbage (never resumed from, always
+reaped); restore reassembles the global tree from slice offsets and
+redistributes onto whatever mesh exists NOW (elastic N->M); the async writer
+charges the step loop one batched snapshot, not the IO.
+"""
+
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu import checkpoint as ckpt
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train._internal.controller import TrainController
+
+
+def _mesh(shape):
+    return Mesh(np.array(jax.devices()).reshape(shape), ("a", "b"))
+
+
+def _sample_tree(mesh):
+    """Mixed dtypes, mixed shardings, nested containers, host leaves."""
+    return {
+        "params": {
+            "dense": {
+                "kernel": jax.device_put(
+                    jnp.arange(64 * 32, dtype=jnp.float32).reshape(64, 32),
+                    NamedSharding(mesh, P("a", "b"))),
+                "bias": jax.device_put(
+                    jnp.arange(32, dtype=jnp.bfloat16),
+                    NamedSharding(mesh, P("b"))),
+            },
+            "emb": jax.device_put(
+                jnp.arange(128, dtype=jnp.int32).reshape(16, 8),
+                NamedSharding(mesh, P("a", None))),
+        },
+        "step": np.int64(7),
+        "opt": [np.ones((3, 3), np.float32),
+                jax.device_put(jnp.full((8,), 2.0), NamedSharding(mesh, P()))],
+    }
+
+
+def _assert_tree_equal(got, want):
+    jax.tree.map(
+        lambda g, w: np.testing.assert_array_equal(np.asarray(g), np.asarray(w)),
+        got, want,
+    )
+
+
+# ---- format: N-process save -> M-layout restore, bitwise ---------------------
+
+def test_roundtrip_reshard_n_to_m(tmp_path):
+    """The elastic property: save on a simulated 4-process (4,2) mesh, restore
+    onto a (2,4) mesh with DIFFERENT partition specs — bitwise identical."""
+    path = str(tmp_path / "c1")
+    tree = _sample_tree(_mesh((4, 2)))
+    for p in range(4):  # each simulated process writes only its owned slices
+        ckpt.write_process_shards(path, tree, process_index=p, process_count=4)
+    ckpt.commit(path, process_count=4)
+    assert ckpt.is_committed(path) and not ckpt.is_partial(path)
+
+    # Host restore preserves structure, dtypes, and bits.
+    host = ckpt.restore(path)
+    _assert_tree_equal(host, tree)
+    assert isinstance(host["opt"], list)
+    assert np.asarray(host["params"]["dense"]["bias"]).dtype == jnp.bfloat16
+
+    # Reshard restore: new mesh shape AND transposed/changed specs.
+    mesh_m = _mesh((2, 4))
+    out = ckpt.restore(path, shardings={
+        "params/dense/kernel": NamedSharding(mesh_m, P("b", "a")),
+        "params/dense/bias": NamedSharding(mesh_m, P("a")),
+        "params/emb": NamedSharding(mesh_m, P(("a", "b"))),
+    })
+    _assert_tree_equal(out, tree)
+    k = out["params"]["dense"]["kernel"]
+    assert k.sharding.spec == P("b", "a")  # actually resharded, not replicated
+
+    # Replicated restore onto the current mesh.
+    _assert_tree_equal(ckpt.restore(path, mesh=mesh_m), tree)
+
+
+def test_single_process_save_is_one_call(tmp_path):
+    path = str(tmp_path / "c2")
+    tree = {"w": jnp.arange(12.0).reshape(3, 4), "n": np.int32(3)}
+    ckpt.save(path, tree)
+    assert ckpt.is_committed(path)
+    _assert_tree_equal(ckpt.restore(path), tree)
+
+
+def test_commit_refuses_missing_coverage(tmp_path):
+    """A writer's shards missing -> commit times out (spec never appears) or,
+    with a lying process_count, fails coverage — never a silent half-commit."""
+    path = str(tmp_path / "c3")
+    tree = _sample_tree(_mesh((4, 2)))
+    ckpt.write_process_shards(path, tree, process_index=0, process_count=2)
+    with pytest.raises(ckpt.CommitTimeout):
+        ckpt.commit(path, process_count=2, timeout_s=0.2)
+    with pytest.raises(ValueError, match="covers"):
+        ckpt.commit(path, process_count=1)  # process 0's shards alone: gaps
+    assert ckpt.is_partial(path)  # still garbage after both failed commits
+
+
+# ---- kill-mid-save: partial dirs are never resumed, always reaped ------------
+
+def _make_controller(storage, name, **run_kw):
+    return TrainController(
+        train_fn=lambda cfg: None, train_fn_config=None,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name=name, storage_path=storage, **run_kw),
+    )
+
+
+def test_partial_dir_ignored_on_resume_and_reaped(tmp_path):
+    storage = str(tmp_path)
+    exp = os.path.join(storage, "killed")
+    committed = os.path.join(exp, "checkpoint_000001")
+    partial = os.path.join(exp, "checkpoint_000002")
+    tree = {"w": jnp.arange(8.0)}
+    ckpt.save(committed, tree)
+    # Simulated kill mid-save: shards of one writer landed, manifest never did.
+    ckpt.write_process_shards(partial, tree, process_index=0, process_count=2)
+    assert ckpt.is_partial(partial)
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(partial)
+
+    c = _make_controller(storage, "killed")
+    c._checkpoints.register(1, Checkpoint(committed), {"step": 1})
+    c._checkpoints.register(2, Checkpoint(partial), {"step": 2})
+    # Resume point skips the tracked-but-uncommitted dir.
+    assert c._checkpoints.latest.path == partial
+    assert c._checkpoints.latest_committed.path == committed
+    # Restart-time cleanup reaps the partial (tracked or not) and keeps the
+    # committed resume point.
+    c._remove_orphan_checkpoints()
+    assert not os.path.exists(partial)
+    assert os.path.exists(committed)
+    assert c._checkpoints.latest_committed.path == committed
+
+
+def test_orphan_checkpoint_zero_reaped_when_nothing_tracked(tmp_path):
+    """Regression: max_index defaults to 0 when nothing is tracked, so a dead
+    first attempt's checkpoint_0 survived `0 > 0`. highest_tracked_index (-1)
+    subsumes it: with no tracked checkpoints, EVERY leftover dir is garbage."""
+    storage = str(tmp_path)
+    exp = os.path.join(storage, "dead_first")
+    os.makedirs(os.path.join(exp, "checkpoint_0"))
+    with open(os.path.join(exp, "checkpoint_0", "model.bin"), "w") as f:
+        f.write("stale")
+    c = _make_controller(storage, "dead_first")
+    assert c._checkpoints.max_index == 0  # the numbering offset keeps its floor
+    assert c._checkpoints.highest_tracked_index == -1
+    c._remove_orphan_checkpoints()
+    assert not os.path.exists(os.path.join(exp, "checkpoint_0"))
+
+
+def test_orphan_cleanup_keeps_tracked_and_reaps_above(tmp_path):
+    storage = str(tmp_path)
+    exp = os.path.join(storage, "mixed")
+    for n in (1, 2, 3):
+        d = os.path.join(exp, f"checkpoint_{n}")
+        os.makedirs(d)
+        with open(os.path.join(d, "x"), "w") as f:
+            f.write("x")
+    c = _make_controller(storage, "mixed")
+    c._checkpoints.register(1, Checkpoint(os.path.join(exp, "checkpoint_1")), {})
+    c._remove_orphan_checkpoints()
+    assert os.path.exists(os.path.join(exp, "checkpoint_1"))
+    assert not os.path.exists(os.path.join(exp, "checkpoint_2"))
+    assert not os.path.exists(os.path.join(exp, "checkpoint_3"))
+
+
+# ---- Checkpoint.to_directory: stale files must not survive -------------------
+
+def test_to_directory_clears_stale_target(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "model.txt").write_text("new")
+    target = tmp_path / "restore"
+    target.mkdir()
+    (target / "leftover.txt").write_text("stale")  # from a previous restore
+    out = Checkpoint(str(src)).to_directory(str(target))
+    assert out == str(target)
+    assert (target / "model.txt").read_text() == "new"
+    assert not (target / "leftover.txt").exists()  # stale file did NOT survive
+
+
+# ---- CheckpointManager retention ---------------------------------------------
+
+def _mgr_register(mgr, tmp_path, index, metrics):
+    d = tmp_path / f"checkpoint_{index:06d}"
+    d.mkdir(exist_ok=True)
+    (d / "data").write_text(str(index))
+    mgr.register(index, Checkpoint(str(d)), metrics)
+    return str(d)
+
+
+def test_retention_missing_score_ranks_worst(tmp_path):
+    """A report without the score attribute ranks -inf: it is the eviction
+    victim, not accidentally the best."""
+    mgr = CheckpointManager(CheckpointConfig(
+        num_to_keep=2, checkpoint_score_attribute="score"))
+    d1 = _mgr_register(mgr, tmp_path, 1, {"score": 5.0})
+    d2 = _mgr_register(mgr, tmp_path, 2, {})          # score missing -> -inf
+    d3 = _mgr_register(mgr, tmp_path, 3, {"score": 1.0})
+    assert not os.path.exists(d2)
+    assert os.path.exists(d1) and os.path.exists(d3)
+    assert mgr.best.path == d1
+
+
+def test_retention_never_deletes_resume_point(tmp_path):
+    """The LATEST checkpoint is the resume point: it survives retention even
+    when it scores worst (here: missing metric on the newest report)."""
+    mgr = CheckpointManager(CheckpointConfig(
+        num_to_keep=1, checkpoint_score_attribute="score",
+        checkpoint_score_order="max"))
+    d1 = _mgr_register(mgr, tmp_path, 1, {"score": 100.0})
+    d2 = _mgr_register(mgr, tmp_path, 2, {})  # newest, scoreless -> worst
+    assert os.path.exists(d2), "resume point was deleted"
+    assert mgr.latest.path == d2
+    # Score order min: same invariant.
+    mgr2 = CheckpointManager(CheckpointConfig(
+        num_to_keep=1, checkpoint_score_attribute="loss",
+        checkpoint_score_order="min"))
+    e1 = _mgr_register(mgr2, tmp_path, 11, {"loss": 0.001})
+    e2 = _mgr_register(mgr2, tmp_path, 12, {"loss": 999.0})
+    # e2 (latest, worst loss) is the only over-budget victim but is protected:
+    # retention backs off rather than deleting the resume point.
+    assert os.path.exists(e2) and mgr2.latest.path == e2
+    assert os.path.exists(e1) and mgr2.best.path == e1
+
+
+# ---- async writer ------------------------------------------------------------
+
+def test_async_writer_overlaps_write_with_step_loop(tmp_path, monkeypatch):
+    """save() must return while persistence is still running: gate the
+    background write on an event the 'step loop' only sets afterwards."""
+    from ray_tpu.checkpoint import _format as fmt
+
+    gate = threading.Event()
+    real_write = fmt.write_snapshot
+
+    def slow_write(*a, **kw):
+        assert gate.wait(10.0)
+        return real_write(*a, **kw)
+
+    monkeypatch.setattr(fmt, "write_snapshot", slow_write)
+    w = ckpt.AsyncCheckpointWriter(inflight=2)
+    path = str(tmp_path / "async1")
+    w.save(path, {"w": jnp.arange(16.0)})   # returns pre-persistence
+    assert not ckpt.is_committed(path)      # nothing durable yet...
+    gate.set()
+    assert w.wait_until_finished(timeout=30.0)
+    assert ckpt.is_committed(path)          # ...but committed after the barrier
+    w.shutdown()
+
+
+def test_async_writer_surfaces_background_errors(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where the checkpoint dir must go")
+    w = ckpt.AsyncCheckpointWriter(inflight=1)
+    w.save(str(blocker), {"w": jnp.arange(4.0)})  # job will fail in background
+    with pytest.raises(RuntimeError, match="checkpoint save failed"):
+        w.wait_until_finished(timeout=30.0)
+    with pytest.raises(RuntimeError, match="previous async checkpoint"):
+        w.save(str(tmp_path / "next"), {"w": jnp.arange(4.0)})
+    w.shutdown()
+
+
+# ---- train integration -------------------------------------------------------
+
+@pytest.fixture
+def storage(tmp_path):
+    return str(tmp_path / "results")
+
+
+def test_report_sharded_state_multirank(ray_start_regular, storage):
+    """Both ranks persist only their owned shards; rank 0 commits after every
+    rank's spec is durable; the Result checkpoint restores bitwise."""
+
+    def loop(config):
+        ctx = train.get_context()
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("a",))
+        state = {
+            "w": jax.device_put(
+                jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                NamedSharding(mesh, P("a"))),
+            "step": np.int64(ctx.get_world_size()),
+        }
+        train.report({"rank": ctx.get_world_rank()},
+                     checkpoint=ckpt.ShardedState(state))
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="sharded", storage_path=storage),
+    ).fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    path = result.checkpoint.path
+    assert ckpt.is_committed(path)
+    # Both ranks wrote their process specs (the commit barrier's inputs).
+    assert os.path.exists(os.path.join(path, "process_0.json"))
+    assert os.path.exists(os.path.join(path, "process_1.json"))
+    manifest = ckpt.load_manifest(path)
+    assert manifest["process_count"] == 2
+    tree = result.checkpoint.to_pytree()
+    np.testing.assert_array_equal(
+        tree["w"], np.arange(64, dtype=np.float32).reshape(8, 8))
+    assert tree["step"] == 2
+
+
+def test_failure_restart_resumes_from_sharded(ray_start_regular, storage, tmp_path):
+    marker = tmp_path / "fail_once"
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        prev = train.get_checkpoint()
+        if prev is not None:
+            assert prev.is_sharded and prev.is_committed
+            start = int(prev.to_pytree()["step"]) + 1
+        for step in range(start, 4):
+            train.report(
+                {"step": step, "resumed_from": start},
+                checkpoint=ckpt.ShardedState(
+                    {"step": np.int64(step),
+                     "w": jnp.full((4,), float(step))}),
+            )
+            if step == 1 and ctx.get_world_rank() == 0 and not marker.exists():
+                marker.write_text("x")
+                raise RuntimeError("injected failure")
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="restart_sharded", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed_from"] >= 1  # really resumed from a commit
+    tree = result.checkpoint.to_pytree()
+    np.testing.assert_array_equal(tree["w"], np.full((4,), 3.0))
+
+
+# ---- llm warm start ----------------------------------------------------------
+
+def test_llm_engine_warm_start_from_sharded(tmp_path):
+    from ray_tpu.llm import LLMConfig, load_model
+    from ray_tpu.llm._engine import DecodeEngine
+    from ray_tpu.parallel.mesh import unbox
+
+    cfg, boxed = load_model(LLMConfig(model_id="test-tiny", seed=3))
+    params = unbox(boxed)  # flax partitioning boxes are stripped on save
+    path = str(tmp_path / "weights")
+    ckpt.save(path, {"params": boxed})
+
+    cfg2, params2 = load_model(
+        LLMConfig(model_id="test-tiny", checkpoint_path=path))
+    _assert_tree_equal(params2, params)
+
+    engine = DecodeEngine.from_sharded_checkpoint(
+        cfg, path, num_slots=2, max_seq=64, decode_loop=False)
+    _assert_tree_equal(engine.params, params)
+    engine.shutdown()
+
+    # A partial dir must be refused, not half-loaded.
+    shutil.rmtree(path)
+    ckpt.write_process_shards(path, {"params": params},
+                              process_index=0, process_count=2)
+    with pytest.raises(FileNotFoundError):
+        load_model(LLMConfig(model_id="test-tiny", checkpoint_path=path))

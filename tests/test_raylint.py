@@ -312,8 +312,12 @@ def test_shipped_tree_clean_per_family():
 
 
 def test_cli_module_entrypoint_clean_tree():
+    """The tier-1 gate as CI invokes it: zero unbaselined findings AND zero
+    stale baseline entries — a fixed-but-still-baselined finding fails loudly
+    instead of lingering as a grandfather clause nobody re-earns."""
     proc = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.devtools.raylint", PKG_DIR],
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", "--fail-stale",
+         PKG_DIR],
         capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
