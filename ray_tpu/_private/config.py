@@ -90,6 +90,9 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_max_queue_depth": (int, 256, "engine admission queue cap; submits beyond it raise EngineOverloadedError instead of growing memory unboundedly (0 = unbounded)"),
     "llm_max_jit_programs": (int, 64, "per-engine cap on cached jitted programs (prefill/attach/spec bucket variants); past it the oldest program is evicted so an adversarial prompt-length mix can't grow compilation memory unboundedly (0 = unbounded)"),
     "llm_router_fingerprint_blocks": (int, 8, "prefix blocks hashed into the DP router's per-replica fingerprints for cache-aware routing"),
+    "llm_sched_token_budget": (int, 256, "per-iteration scheduler token budget (docs/scheduler.md): decode and spec-verify tokens are reserved first, the remainder is granted to bucketed prefill chunks, so a long prefill cannot stall in-flight decodes for more than one budget of compute (0 = unbudgeted whole-prompt prefill)"),
+    "llm_spec_ngram": (int, 3, "trailing n-gram length the ngram/REST speculative draft matches against the slot history and the cross-request continuation store"),
+    "llm_spec_store_entries": (int, 4096, "bounded LRU entries in the ngram draft's cross-request continuation store; repeated greedy traffic re-proposes earlier completions from it (0 disables the shared store, leaving prompt-lookup only)"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
     "data_output_queue_size": (int, 8, "blocks buffered between the streaming executor and the consuming iterator (backpressure depth)"),

@@ -84,6 +84,11 @@ class LLMConfig:
     # multi-LoRA): {"max_loras": N, "rank": r}. Adapters register at runtime via
     # LLMServer.load_lora and are selected per request with model="<id>:<adapter>".
     lora_config: Optional[dict] = None
+    # Speculative decoding (docs/scheduler.md): e.g. {"method": "ngram",
+    # "num_spec_tokens": 8} for the zero-FLOP retrieval draft, or
+    # {"draft_layers": j} / {"draft_cfg": ..., "draft_params": ...} for a
+    # cheap draft model sharing the target's embeddings. None disables.
+    spec_config: Optional[dict] = None
 
 
 def load_model(config: "LLMConfig"):
@@ -131,6 +136,7 @@ class LLMServer:
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
             lora_config=config.lora_config,
+            spec_config=config.spec_config,
         )
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0) -> int:
@@ -240,6 +246,11 @@ class LLMServer:
         """Paged KV prefix-cache counters for this replica's engine (None when
         the cache is disabled). See docs/kvcache.md."""
         return self._engine.prefix_cache_stats()
+
+    async def scheduler_stats(self) -> dict:
+        """Iteration-level scheduler occupancy + spec-decode acceptance for
+        this replica's engine. See docs/scheduler.md."""
+        return self._engine.scheduler_stats()
 
     def __del__(self):
         try:

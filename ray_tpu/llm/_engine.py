@@ -5,9 +5,18 @@ the role vLLM's AsyncLLM plays behind Ray Serve (slot-based continuous batching,
 prefill + steady-state decode). Rebuilt TPU-first instead of wrapping a CUDA
 engine: static-shaped jitted prefill (per length bucket) and a single jitted
 decode step over B fixed slots with per-slot KV caches and length masks — no
-dynamic shapes anywhere, so XLA compiles exactly two programs and the MXU stays
-on the batched matmul path. Weights are the flax Transformer's param tree
+dynamic shapes anywhere, so XLA compiles exactly two core programs and the MXU
+stays on the batched matmul path. Weights are the flax Transformer's param tree
 (`ray_tpu/models/transformer.py`, scan_layers=False layout).
+
+Control plane: the engine no longer schedules itself. An iteration-level
+`Scheduler` (`ray_tpu/llm/scheduler/`, docs/scheduler.md) owns the
+waiting/running queues and assembles every stepper iteration — bucketed
+prefill CHUNKS interleaved with batched decode and speculative-verify phases
+under a token budget — while this module owns the compiled programs and
+device state the plans execute against. Every chunk shape is drawn from the
+same static `_prefill_buckets` table whole-prompt prefill uses, so chunked
+prefill adds ZERO new compiled programs.
 """
 
 from __future__ import annotations
@@ -22,15 +31,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.llm.scheduler.scheduler import (
+    EngineOverloadedError,
+    Plan,
+    Request,
+    ScheduledChunk,
+    Scheduler,
+)
 from ray_tpu.models.transformer import ModelConfig, _rope
 
 _NEG_INF = -1e30
-
-
-class EngineOverloadedError(RuntimeError):
-    """The engine's admission queue is at its configured depth cap
-    (`llm_max_queue_depth`); the submit was rejected without enqueueing.
-    Callers should shed load or retry with backoff."""
 
 
 @dataclasses.dataclass
@@ -66,7 +76,7 @@ def _lora_delta(x, A, B_, scale):
 
 
 def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg,
-                 lora_layer=None, adapter_ids=None):
+                 lora_layer=None, adapter_ids=None, write_gate=None):
     """One attention layer against the KV cache.
 
     x: [B, S, M]; positions: [B, S]; cache_k/v: [B, T, Hkv, D];
@@ -75,6 +85,9 @@ def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg,
     "v_A", "v_B", "scale": [A]} gathered per slot by adapter_ids [B] — the
     multi-LoRA batching role of the reference's punica path, as plain gathers +
     batched matmuls so one jitted program serves any adapter mix.
+    write_gate (optional): [B] bool — slots with a False gate leave their
+    cache rows untouched (the batched speculative-verify program runs every
+    slot through the forward but must only land KV for participants).
     """
     B, S, _ = x.shape
     q = _dense(x, layer["q"]["kernel"].reshape(cfg.hidden, -1)).reshape(
@@ -99,11 +112,27 @@ def _attn_cached(layer, x, positions, cache_k, cache_v, write_at, kv_mask, cfg,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    def put(slot_cache, slot_new, at):
-        return jax.lax.dynamic_update_slice(slot_cache, slot_new, (at, 0, 0))
+    if write_gate is None:
+        def put(slot_cache, slot_new, at):
+            return jax.lax.dynamic_update_slice(slot_cache, slot_new, (at, 0, 0))
 
-    cache_k = jax.vmap(put)(cache_k, k.astype(cache_k.dtype), write_at)
-    cache_v = jax.vmap(put)(cache_v, v.astype(cache_v.dtype), write_at)
+        cache_k = jax.vmap(put)(cache_k, k.astype(cache_k.dtype), write_at)
+        cache_v = jax.vmap(put)(cache_v, v.astype(cache_v.dtype), write_at)
+    else:
+        # Gated write: read the current rows and write them back unchanged
+        # when the gate is off. The read and write clamp identically at the
+        # cache end, so an off-gate slot is a no-op even at the boundary.
+        def put_gated(slot_cache, slot_new, at, gate):
+            cur = jax.lax.dynamic_slice(slot_cache, (at, 0, 0), slot_new.shape)
+            new = jnp.where(gate, slot_new, cur)
+            return jax.lax.dynamic_update_slice(slot_cache, new, (at, 0, 0))
+
+        cache_k = jax.vmap(put_gated)(
+            cache_k, k.astype(cache_k.dtype), write_at, write_gate
+        )
+        cache_v = jax.vmap(put_gated)(
+            cache_v, v.astype(cache_v.dtype), write_at, write_gate
+        )
 
     kk, vv = cache_k, cache_v
     if cfg.n_kv_heads != cfg.n_heads:
@@ -127,7 +156,7 @@ def _mlp(layer, x):
 
 
 def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_at,
-                    kv_mask, lora=None, adapter_ids=None):
+                    kv_mask, lora=None, adapter_ids=None, write_gate=None):
     """tokens: [B,S] -> logits [B,S,V]; updates caches in place (returned)."""
     embed = params["embedding"]
     x = embed[tokens].astype(cfg.dtype)
@@ -140,6 +169,7 @@ def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_a
             write_at, kv_mask, cfg,
             lora_layer=None if lora is None else lora[i],
             adapter_ids=adapter_ids,
+            write_gate=write_gate,
         )
         new_caches.append((ck, cv))
         x = x + attn_out
@@ -153,6 +183,19 @@ def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_a
     else:
         logits = _dense(x, params["lm_head"]["kernel"]).astype(jnp.float32)
     return logits.astype(jnp.float32), new_caches
+
+
+def _scatter_slot_caches(caches, new_slot, slot):
+    """Write a [1, T, ...] slot view back into the full [B, T, ...] caches."""
+    out = []
+    for (ck_full, cv_full), (ck, cv) in zip(caches, new_slot):
+        out.append((
+            jax.lax.dynamic_update_slice(ck_full, ck.astype(ck_full.dtype),
+                                         (slot, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(cv_full, cv.astype(cv_full.dtype),
+                                         (slot, 0, 0, 0)),
+        ))
+    return out
 
 
 def _sample_host(logits_row: np.ndarray, sampling: SamplingParams,
@@ -170,24 +213,9 @@ def _sample_host(logits_row: np.ndarray, sampling: SamplingParams,
     return int(rng.choice(len(probs), p=probs))
 
 
-class Slot:
-    __slots__ = ("active", "generated", "params", "callback", "prompt_len",
-                 "tokens", "host_len", "adapter")
-
-    def __init__(self):
-        self.active = False
-        self.generated = 0
-        self.params: Optional[SamplingParams] = None
-        self.callback = None
-        self.prompt_len = 0
-        self.tokens: List[int] = []
-        self.host_len = 0  # kv rows present for this slot (host mirror of lens)
-        self.adapter = 0
-
-
 class DecodeEngine:
     """B-slot continuous-batching engine. Thread-safe submit(); a background
-    stepper thread drives prefill + decode."""
+    stepper thread executes the scheduler's per-iteration plans."""
 
     def __init__(self, cfg: ModelConfig, params, *, num_slots: int = 4,
                  max_seq: Optional[int] = None, seed: int = 0,
@@ -195,7 +223,8 @@ class DecodeEngine:
                  spec_config: Optional[dict] = None,
                  multi_step: Optional[int] = None,
                  prefix_cache=None,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 token_budget: Optional[int] = None):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu.parallel.mesh import unbox
 
@@ -236,9 +265,6 @@ class DecodeEngine:
         # host->device per call (a few async bytes, off the critical path).
         self._lens = np.zeros((self.B,), np.int32)
         self._last_token = np.zeros((self.B,), np.int32)
-        self._slots = [Slot() for _ in range(self.B)]
-        self._queue: List = []
-        self._lock = threading.Lock()
         self._stop = False
         # Set when the stepper thread dies on an exception; submitters check it
         # instead of waiting forever on callbacks that will never fire.
@@ -284,61 +310,107 @@ class DecodeEngine:
                 name=f"engine-{id(self):x}",
             )
         self._prefix_cache = prefix_cache or None
-        # Admission control: submits beyond the depth cap raise
-        # EngineOverloadedError instead of growing _queue unboundedly.
         if max_queue_depth is None:
             max_queue_depth = CONFIG.llm_max_queue_depth
-        self._max_queue_depth = max(0, int(max_queue_depth))  # 0 = unbounded
-        from ray_tpu.util.metrics import Gauge
+        if token_budget is None:
+            token_budget = CONFIG.llm_sched_token_budget
+        # Iteration-level scheduler (docs/scheduler.md): owns the
+        # waiting/running queues, slot states, the per-iteration token
+        # budget, and the chunked-prefill policy. The prefix-cache lookup is
+        # injected so admission plans chunks over the uncached suffix only.
+        lookup = None
+        if self._prefix_cache is not None:
+            cache = self._prefix_cache
 
-        self._queue_gauge = Gauge(
-            "llm_engine_queue_depth",
-            "requests waiting in the engine admission queue",
-            tag_keys=("engine",),
-        ).set_default_tags({"engine": f"{id(self):x}"})
+            def lookup(prompt, adapter):
+                return cache.lookup(prompt, namespace=adapter)
+
+        self._sched = Scheduler(
+            num_slots=self.B, buckets=self._prefill_buckets, max_seq=self.T,
+            token_budget=token_budget, max_queue_depth=max_queue_depth,
+            multi_step=self._multi_step, lookup=lookup, name=f"{id(self):x}",
+        )
         # Diagnostics for benches/tests: shape of the most recent prefill
         # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only).
         self.last_prefill: Optional[dict] = None
         self._jit_decode_multi = jax.jit(
             self._decode_multi, static_argnames=("n",)
         )  # jax caches one program per distinct static n
-        # Speculative decoding (reference: vLLM speculative decoding /
-        # spec_decode workers): a cheap DRAFT model proposes k tokens in ONE
-        # jitted lax.scan program; the target verifies all k in one forward.
-        # Greedy-only; engaged at batch==1 (the latency-bound regime).
-        self._spec = None
+        # Speculative decoding as a scheduler-scheduled phase (docs/
+        # scheduler.md): a DraftProvider proposes up to k tokens per eligible
+        # slot, and ONE batched gated verify forward scores every
+        # participating slot. Greedy output is token-identical to plain
+        # decode by construction; acceptance only affects speed.
+        self._draft = None
+        self._jit_spec_verify = {}
+        self._spec_counters = {
+            "rounds": 0, "proposed_tokens": 0, "accepted_tokens": 0,
+            "emitted_tokens": 0,
+        }
+        self._spec_metrics = None
         if spec_config:
-            d_cfg = spec_config.get("draft_cfg") or cfg
-            d_params = unbox(spec_config.get("draft_params", self.params))
-            assert not d_cfg.scan_layers
-            k = int(spec_config.get("num_spec_tokens", 6))
-            self._spec = {
-                "cfg": d_cfg,
-                "params": d_params,
-                "k": max(1, k),
-                "caches": [
-                    (jnp.zeros((self.B, self.T, d_cfg.n_kv_heads, d_cfg.head_dim),
-                               d_cfg.dtype),
-                     jnp.zeros((self.B, self.T, d_cfg.n_kv_heads, d_cfg.head_dim),
-                               d_cfg.dtype))
-                    for _ in range(d_cfg.n_layers)
-                ],
-                "host_lens": [0] * self.B,  # draft kv rows per slot (host-side)
-                # slots with draft KV in sync (prompt-prefilled here, not PD)
-                "ready": [False] * self.B,
-                # all-k-accepted leaves one proposed token's kv missing from the
-                # draft cache; it catches up at the next round's scan head.
-                "pending": [None] * self.B,
+            self._draft = self._build_draft(dict(spec_config), unbox)
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            tag = {"engine": f"{id(self):x}"}
+            self._spec_metrics = {
+                "proposed": Counter(
+                    "llm_spec_proposed_tokens",
+                    "draft tokens proposed to the verify phase",
+                    tag_keys=("engine",),
+                ).set_default_tags(tag),
+                "accepted": Counter(
+                    "llm_spec_accepted_tokens",
+                    "proposed tokens accepted by the target model",
+                    tag_keys=("engine",),
+                ).set_default_tags(tag),
+                "accept_rate": Gauge(
+                    "llm_spec_accept_rate",
+                    "running acceptance rate of speculative proposals",
+                    tag_keys=("engine",),
+                ).set_default_tags(tag),
             }
-            self._jit_spec_propose = jax.jit(
-                self._spec_propose, static_argnames=("k", "catchup")
-            )
-            self._jit_spec_verify = {}
-            self._jit_spec_prefill = {}
         self._thread = None
         if decode_loop:  # prefill-only servers skip the stepper thread
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
+
+    def _build_draft(self, spec_config: dict, unbox):
+        """spec_config -> DraftProvider. method="ngram" builds the zero-FLOP
+        retrieval draft; otherwise a draft MODEL: `draft_layers=j` shares the
+        target's first j layers + embeddings (EAGLE-style early exit),
+        `draft_cfg`/`draft_params` plug an external tiny model, and the
+        default (no keys) is the self-draft used as an all-accept test rig."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.llm.scheduler.spec import (
+            ModelDraft, NGramDraft, early_exit_draft,
+        )
+
+        k = max(1, int(spec_config.get("num_spec_tokens", 6)))
+        if spec_config.get("method") == "ngram":
+            return NGramDraft(
+                k=k,
+                n=int(spec_config.get("ngram", CONFIG.llm_spec_ngram)),
+                store_entries=int(spec_config.get(
+                    "store_entries", CONFIG.llm_spec_store_entries)),
+            )
+        if spec_config.get("draft_layers"):
+            d_cfg, d_params = early_exit_draft(
+                self.cfg, self.params, int(spec_config["draft_layers"])
+            )
+        else:
+            d_cfg = spec_config.get("draft_cfg") or self.cfg
+            d_params = unbox(spec_config.get("draft_params", self.params))
+            assert not d_cfg.scan_layers
+        return ModelDraft(
+            d_cfg, d_params, k=k, num_slots=self.B, max_seq=self.T,
+            program=self._program, bucket=self._bucket,
+        )
+
+    @property
+    def _slots(self):
+        """Back-compat view: slot state lives in the scheduler now."""
+        return self._sched.slots
 
     # -- warm start --------------------------------------------------------
     @classmethod
@@ -403,11 +475,13 @@ class DecodeEngine:
     def _prefill_at(self, params, lora, tokens, caches, slot, offset,
                     total_len, adapter_id):
         """tokens: [1, Sbucket] right-padded, starting at row/position `offset`
-        (0 = whole-prompt prefill; >0 = suffix-only prefill behind a prefix
-        cache hit whose KV was attached to rows [0, offset)). Writes slot
-        `slot`'s cache rows [offset, offset+S). One program per bucket: offset
-        and total_len are traced scalars. Slot lengths are host-side state
-        (the dispatcher records total_len itself — no device lens write)."""
+        (0 = whole-prompt prefill; >0 = a later CHUNK, or suffix-only prefill
+        behind a prefix cache hit whose KV was attached to rows [0, offset)).
+        Writes slot `slot`'s cache rows [offset, offset+S). One program per
+        bucket: offset and total_len are traced scalars — a chunked prefill
+        of any length mix reuses exactly these bucket programs. Slot lengths
+        are host-side state (the dispatcher records total_len itself — no
+        device lens write)."""
         S = tokens.shape[1]
         positions = offset + jnp.arange(S)[None, :]
         # one-slot caches view
@@ -422,7 +496,7 @@ class DecodeEngine:
             offset[None], mask,
             lora=lora, adapter_ids=adapter_id[None],
         )
-        out_caches = self._scatter_slot(caches, new_slot_caches, slot)
+        out_caches = _scatter_slot_caches(caches, new_slot_caches, slot)
         last = logits[0, total_len - 1 - offset]
         return last, out_caches
 
@@ -453,160 +527,97 @@ class DecodeEngine:
         )
         return toks, caches, lens
 
-    def _scatter_slot(self, caches, new_slot, slot):
-        """Write a [1, T, ...] slot view back into the full [B, T, ...] caches."""
-        out = []
-        for (ck_full, cv_full), (ck, cv) in zip(caches, new_slot):
-            out.append((
-                jax.lax.dynamic_update_slice(ck_full, ck.astype(ck_full.dtype),
-                                             (slot, 0, 0, 0)),
-                jax.lax.dynamic_update_slice(cv_full, cv.astype(cv_full.dtype),
-                                             (slot, 0, 0, 0)),
-            ))
-        return out
-
-    # -- speculative decoding ---------------------------------------------
-    def _spec_propose(self, params_d, first_tok, t0, caches, l, slot, *, k,
-                      catchup):
-        """Draft k greedy tokens in ONE program (lax.scan): the whole proposal
-        costs one dispatch instead of k. With catchup=True the scan's first
-        step ingests `first_tok` (the previous round's fully-accepted final
-        proposal, whose kv never landed) and the chain restarts from t0 —
-        the catch-up costs zero extra dispatches. Returns ([k] proposed
-        tokens, updated full draft caches)."""
-        dcfg = self._spec["cfg"]
-        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
-        steps = k + 1 if catchup else k
-
-        def step(carry, idx):
-            tok, sc, pos = carry
-            kv_mask = (jnp.arange(self.T)[None, :] <= pos)[None]
-            logits, new_sc = _forward_cached(
-                params_d, dcfg, tok[None, None], pos[None, None], sc,
-                pos[None], kv_mask, lora=None, adapter_ids=None,
-            )
-            nxt = jnp.argmax(logits[0, 0]).astype(jnp.int32)
-            if catchup:
-                nxt = jnp.where(idx == 0, t0, nxt)  # restart the chain at t0
-            return (nxt, new_sc, pos + 1), nxt
-
-        (_tok, out_slot, _pos), toks = jax.lax.scan(
-            step, (first_tok, slot_caches, l), jnp.arange(steps)
+    def _spec_verify_batched(self, params, lora, adapter_ids, tokens, caches,
+                             lens, gate):
+        """Target forward over [t0, d1..dk] for EVERY slot in one dispatch:
+        tokens [B, k+1] at positions lens..lens+k. Non-participating slots
+        (gate False) flow through the forward for batching but leave their
+        KV rows untouched — the canonical row for a plainly-decoding slot is
+        written by the decode dispatch that follows the verify phase.
+        Returns on-device argmax [B, k+1] (the host needs k+1 ints per slot,
+        not logits)."""
+        B, S = tokens.shape
+        positions = lens[:, None] + jnp.arange(S)[None, :]
+        kv_mask = jnp.arange(self.T)[None, None, :] <= positions[:, :, None]
+        logits, new_caches = _forward_cached(
+            params, self.cfg, tokens, positions, caches, lens, kv_mask,
+            lora=lora, adapter_ids=adapter_ids, write_gate=gate,
         )
-        if catchup:
-            toks = toks[1:]
-        return toks, self._scatter_slot(caches, out_slot, slot)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_caches
 
-    def _spec_verify(self, params, lora, adapter_id, t0, proposed, caches, l, slot):
-        """Target forward over [t0, d1..dk] at positions l..l+k (one dispatch).
-        logits[i] scores position l+i+1; rows beyond the accepted prefix stay
-        invisible behind lens."""
-        tokens = jnp.concatenate([t0[None], proposed])[None]
-        S = tokens.shape[1]
-        positions = (l + jnp.arange(S))[None]
-        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
-        mask = (jnp.arange(self.T)[None, :] <= positions[0][:, None])[None]
-        logits, new_slot = _forward_cached(
-            params, self.cfg, tokens, positions, slot_caches, l[None], mask,
-            lora=lora, adapter_ids=adapter_id[None],
-        )
-        # device-side argmax: the host needs k+1 ints, not [k+1, V] logits
-        return (
-            jnp.argmax(logits[0], axis=-1).astype(jnp.int32),
-            self._scatter_slot(caches, new_slot, slot),
-        )
-
-    def _draft_prefill(self, params_d, tokens, caches, slot):
-        """Prefill the DRAFT cache on the prompt (spec decode needs the draft's
-        kv history in lockstep with the target's)."""
-        S = tokens.shape[1]
-        positions = jnp.arange(S)[None, :]
-        slot_caches = [(c[0][slot][None], c[1][slot][None]) for c in caches]
-        mask = (jnp.arange(S)[:, None] >= jnp.arange(self.T)[None, :])[None]
-        _logits, new_slot = _forward_cached(
-            params_d, self._spec["cfg"], tokens, positions, slot_caches,
-            jnp.zeros((1,), jnp.int32), mask, lora=None, adapter_ids=None,
-        )
-        return self._scatter_slot(caches, new_slot, slot)
-
-    def _spec_eligible(self, slot: int) -> bool:
-        s = self._slots[slot]
-        return (
-            self._spec is not None
-            and self._spec["ready"][slot]
-            and s.params.temperature == 0.0
-            and s.params.top_k in (0, 1)
-            # verify writes k+1 rows at host_len; past the cache end XLA would
-            # CLAMP the dynamic_update_slice start and corrupt valid history —
-            # the final rounds near the cap fall back to plain decode.
-            and s.host_len + self._spec["k"] + 1 <= self.T
-        )
-
-    def _spec_round(self, slot: int):
-        """One speculative round: draft-k (catch-up fused) + verify — exactly
-        TWO dispatches emitting 1..k+1 tokens (plain decode pays one each).
-        Lengths and last-token ride host-side slot state; only caches live on
-        device between rounds."""
-        d = self._spec
-        k = d["k"]
-        s = self._slots[slot]
-        t0 = s.tokens[-1]
-        l = s.host_len
-        dlens = d["host_lens"][slot]
-        pend = d["pending"][slot]
-        catchup = pend is not None
-        proposed, d["caches"] = self._jit_spec_propose(
-            d["params"], jnp.int32(pend if catchup else t0), jnp.int32(t0),
-            d["caches"], jnp.int32(dlens), jnp.int32(slot), k=k, catchup=catchup,
-        )
-        if catchup:
-            dlens += 1
-            d["pending"][slot] = None
-        # Verify takes the proposals as a DEVICE array (concat happens inside
-        # the program): the host readback of `proposed` then overlaps the
-        # verify dispatch instead of gating it.
+    # -- speculative phase --------------------------------------------------
+    def _spec_round(self, plan: Plan):
+        """One scheduler-scheduled speculative phase: the draft provider's
+        proposals (gathered at plan time) verify for every participating
+        slot in ONE batched dispatch, and each slot emits its longest
+        accepted prefix plus the target's correction token — exactly the
+        greedy chain. Runs BEFORE the decode phase so plainly-decoding
+        slots' canonical rows land last."""
+        draft = self._draft
+        k = draft.k
+        S = k + 1
+        tokens = np.zeros((self.B, S), np.int32)
+        gate = np.zeros((self.B,), bool)
+        base_lens: Dict[int, int] = {}
+        for i in plan.spec_slots:
+            s = self._sched.slots[i]
+            p = plan.proposals[i]
+            tokens[i, 0] = s.tokens[-1]
+            tokens[i, 1:1 + len(p)] = p
+            gate[i] = True
+            base_lens[i] = s.host_len
         verify = self._program(
-            self._jit_spec_verify, ("verify", k + 1),
-            lambda: jax.jit(self._spec_verify),
+            self._jit_spec_verify, ("verify", S),
+            lambda: jax.jit(self._spec_verify_batched),
         )
         greedy_dev, self._caches = verify(
-            self.params, self._lora, jnp.int32(s.adapter), jnp.int32(t0),
-            proposed, self._caches, jnp.int32(l), jnp.int32(slot),
+            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            jnp.asarray(tokens), self._caches, jnp.asarray(self._lens),
+            jnp.asarray(gate),
         )
-        # The two readbacks below are the round's one acceptance sync: k+1
-        # tokens arrive per pull, and the proposal pull overlaps the verify
-        # dispatch (see above) — there is no per-token host round trip.
-        proposed = [int(x) for x in np.asarray(proposed)]  # raylint: disable=RL603 (per-round acceptance sync, overlaps verify)
-        greedy = np.asarray(greedy_dev)  # raylint: disable=RL603 (per-round acceptance sync: k+1 tokens per pull)
-        emitted: List[int] = []
-        m = 0
-        while m < k and int(greedy[m]) == proposed[m]:
-            emitted.append(proposed[m])
-            m += 1
-        emitted.append(int(greedy[m]))  # correction (or extension when m == k)
-        # Bookkeeping: lens covers t0..d_m (m+1 new rows); the draft holds
-        # t0..d_{m-1} after the scan — d_m's kv is present for m<k, missing
-        # when every proposal was accepted (catch-up next round).
-        new_len = l + m + 1
-        s.host_len = new_len
-        if m == k:
-            d["host_lens"][slot] = dlens + k
-            d["pending"][slot] = proposed[-1]
-        else:
-            d["host_lens"][slot] = new_len
-            d["pending"][slot] = None
-        for token in emitted:
-            if not s.active:
-                break
-            s.generated += 1
-            s.tokens.append(token)
-            self._emit(slot, token)
-        # lens/last_token are host-native numpy: keeping them current after a
-        # spec round is a pure host write (the old device-canonical design
-        # needed a deferred device sync here).
-        self._lens[slot] = s.host_len
-        if s.tokens:
-            self._last_token[slot] = s.tokens[-1]
+        # The round's ONE acceptance sync: k+1 tokens per participating slot
+        # arrive in a single batched pull — no per-token host round trip.
+        greedy = np.asarray(greedy_dev)  # raylint: disable=RL603 (per-round batched acceptance sync)
+        c = self._spec_counters
+        c["rounds"] += 1
+        round_proposed = round_accepted = 0
+        for i in plan.spec_slots:
+            s = self._sched.slots[i]
+            p = plan.proposals[i]
+            l = base_lens[i]
+            m = 0
+            while m < len(p) and int(greedy[i, m]) == int(p[m]):
+                m += 1
+            emitted = [int(x) for x in p[:m]] + [int(greedy[i, m])]
+            # Bookkeeping: rows [l, l+m] now hold [t0, accepted...]; rows
+            # beyond hold rejected proposals' kv, invisible behind lens and
+            # overwritten write-before-read by the next dispatch.
+            s.host_len = l + m + 1
+            draft.on_accept(i, s, l, p, m)
+            round_proposed += len(p)
+            round_accepted += m
+            for token in emitted:
+                if not s.active:
+                    break
+                s.generated += 1
+                s.tokens.append(token)
+                s.history.append(token)
+                self._emit(i, token)
+            self._lens[i] = s.host_len
+            if s.tokens:
+                self._last_token[i] = s.tokens[-1]
+            c["emitted_tokens"] += len(emitted)
+        c["proposed_tokens"] += round_proposed
+        c["accepted_tokens"] += round_accepted
+        if self._spec_metrics is not None:
+            try:
+                self._spec_metrics["proposed"].inc(round_proposed)
+                self._spec_metrics["accepted"].inc(round_accepted)
+                self._spec_metrics["accept_rate"].set(
+                    c["accepted_tokens"] / max(1, c["proposed_tokens"])
+                )
+            except Exception:
+                pass  # metrics must never break the serving path
 
     def _insert_prompt_kv(self, slot: int, prompt: List[int], adapter: int,
                           cached_offset: int):
@@ -634,6 +645,20 @@ class DecodeEngine:
             return None
         return self._prefix_cache.stats()
 
+    def scheduler_stats(self) -> dict:
+        """Iteration-level scheduler occupancy (per-phase token counters,
+        interleaving, queue depths) plus speculative-decoding acceptance.
+        See docs/scheduler.md."""
+        out = self._sched.stats()
+        if self._draft is not None:
+            spec = dict(self._spec_counters)
+            spec["accept_rate"] = (
+                spec["accepted_tokens"] / max(1, spec["proposed_tokens"])
+            )
+            spec["draft"] = self._draft.stats()
+            out["spec"] = spec
+        return out
+
     def _attach_kv(self, caches, kv, slot):
         """Write a transferred KV prefix into slot's cache rows [0, P).
         kv: [L, 2, P, Hkv, D] (P = padded prefix bucket)."""
@@ -649,21 +674,6 @@ class DecodeEngine:
         return out
 
     # -- public API --------------------------------------------------------
-    def _enqueue(self, item):
-        """Bounded admission: reject at the depth cap instead of growing the
-        queue (and resident prompt copies) without limit under overload."""
-        with self._lock:
-            if self._max_queue_depth and len(self._queue) >= self._max_queue_depth:
-                depth = len(self._queue)
-                raise EngineOverloadedError(
-                    f"engine admission queue is full ({depth} >= "
-                    f"llm_max_queue_depth={self._max_queue_depth}); shed load "
-                    f"or retry with backoff"
-                )
-            self._queue.append(item)
-            depth = len(self._queue)
-        self._queue_gauge.set(float(depth))
-
     def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
                lora: str = ""):
         """callback(token_id: int, finished: bool) per generated token.
@@ -671,7 +681,7 @@ class DecodeEngine:
         Raises ValueError when the prompt cannot fit the engine's sequence
         budget (it is never silently truncated), and EngineOverloadedError
         when the admission queue is at its depth cap."""
-        token_ids = list(token_ids)
+        token_ids = list(token_ids) or [0]  # empty prompt decodes from token 0
         if len(token_ids) > self.T - 1:
             raise ValueError(
                 f"prompt of {len(token_ids)} tokens exceeds this engine's "
@@ -680,7 +690,15 @@ class DecodeEngine:
                 f"client-side or raise max_seq"
             )
         adapter = self._adapter_index(lora)
-        self._enqueue(("prompt", token_ids, sampling, callback, adapter))
+        # The prompt is never truncated; a generation budget that would
+        # overflow the KV rows shrinks max_tokens instead.
+        headroom = self.T - 1 - len(token_ids)
+        if sampling.max_tokens > headroom:
+            sampling = dataclasses.replace(sampling, max_tokens=max(1, headroom))
+        self._sched.submit(Request(
+            "prompt", prompt=token_ids, sampling=sampling, callback=callback,
+            adapter=adapter,
+        ))
 
     def submit_prefilled(self, kv: np.ndarray, prompt_len: int,
                          first_logits: np.ndarray, sampling: SamplingParams,
@@ -690,7 +708,8 @@ class DecodeEngine:
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
         transferred cache prefix, first_logits the last-position logits.
         token_ids (optional, the prompt behind kv) lets the transferred
-        prefix be inserted into this engine's KV prefix cache."""
+        prefix feed this engine's KV prefix cache AND keeps the slot
+        spec-eligible (the draft catches up on the token history)."""
         if prompt_len >= self.T:
             raise ValueError(
                 f"transferred KV prefix of {prompt_len} tokens does not fit this "
@@ -698,10 +717,18 @@ class DecodeEngine:
                 f"max_seq (build_pd_openai_app shares one config)"
             )
         adapter = self._adapter_index(lora)
-        self._enqueue(
-            ("prefilled", kv, int(prompt_len), first_logits, sampling, callback,
-             adapter, None if token_ids is None else list(token_ids))
-        )
+        # Same KV headroom contract as the prompt path: the cache must hold
+        # prompt_len + max_tokens rows, so a long transferred prefix shrinks
+        # the generation budget rather than silently wrapping the cache.
+        headroom = self.T - 1 - prompt_len
+        if sampling.max_tokens > headroom:
+            sampling = dataclasses.replace(sampling, max_tokens=max(1, headroom))
+        self._sched.submit(Request(
+            "prefilled",
+            prompt=None if token_ids is None else list(token_ids),
+            prompt_len=int(prompt_len), sampling=sampling, callback=callback,
+            adapter=adapter, kv=kv, first_logits=first_logits,
+        ))
 
     def prefill_detached(self, token_ids: List[int], lora: str = ""):
         """Prefill WITHOUT occupying a decode slot: returns
@@ -876,154 +903,130 @@ class DecodeEngine:
             prog = cache[key] = make()
         return prog
 
-    def _admit(self):
-        with self._lock:
-            if not self._queue:
-                return False
-            free = [i for i, s in enumerate(self._slots) if not s.active]
-            if not free:
-                return False
-            item = self._queue.pop(0)
-            depth = len(self._queue)
-            slot = free[0]
-        self._queue_gauge.set(float(depth))
-
-        if item[0] == "prefilled":
-            (_tag, kv, prompt_len, first_logits, sampling, callback, adapter,
-             prompt_tokens) = item
-            # Same KV headroom contract as the prompt path: the cache must hold
-            # prompt_len + max_tokens rows, so a long transferred prefix shrinks
-            # the generation budget rather than silently wrapping the cache.
-            headroom = self.T - 1 - prompt_len
-            if sampling.max_tokens > headroom:
-                sampling = dataclasses.replace(
-                    sampling, max_tokens=max(1, headroom)
-                )
-            # Pad the transferred prefix to a bucket so attach programs are reused.
-            P = kv.shape[2]
-            bucket = self._bucket(max(P, prompt_len))
-            if P < bucket:
+    # -- plan execution ----------------------------------------------------
+    def _exec_chunk(self, chunk: ScheduledChunk):
+        """Dispatch one scheduled prefill chunk (or transferred-prefix
+        attach). The FIRST chunk of a cache-hit request attaches the leased
+        prefix rows; the LAST chunk samples the request's first token (the
+        one per-admission host pull) and activates the slot."""
+        req = chunk.request
+        if req.kind == "prefilled":
+            self._exec_attach(req)
+            return
+        slot = req.slot
+        offset = req.prefilled
+        if chunk.is_first and req.lease is not None:
+            # Attach the cached prefix through the padded-bucket attach
+            # path, then prefill only the suffix (in chunks). The lease
+            # pins the blocks until the host->device copy is staged.
+            prefix_kv = req.lease.kv()
+            mb = self._bucket(req.cached_offset)
+            if prefix_kv.shape[2] < mb:
                 pad = np.zeros(
-                    (kv.shape[0], 2, bucket - P) + kv.shape[3:], kv.dtype
+                    (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
+                    + prefix_kv.shape[3:], prefix_kv.dtype,
                 )
-                kv = np.concatenate([kv, pad], axis=2)
-            elif P > bucket:
-                kv = kv[:, :, :bucket]
+                prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
             attach = self._program(
-                self._jit_prefill, ("attach", bucket),
+                self._jit_prefill, ("attach", mb),
                 lambda: jax.jit(self._attach_kv),
             )
             self._caches = attach(
-                self._caches, jnp.asarray(kv), jnp.int32(slot)
+                self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
             )
-            self._lens[slot] = prompt_len
-            first = _sample_host(np.asarray(first_logits), sampling, self._np_rng)
-            if self._spec is not None:
-                # Transferred prefixes carry no draft KV: plain decode here.
-                self._spec["ready"][slot] = False
-            # PD-disagg transferred prefixes feed the prefix cache too: the
-            # host-side kv is already in pool layout, so insertion is free of
-            # device readbacks.
-            if (self._prefix_cache is not None and prompt_tokens
-                    and len(prompt_tokens) >= prompt_len):
-                bs = self._prefix_cache.block_size
-                n = (prompt_len // bs) * bs
-                if n:
-                    self._prefix_cache.insert(
-                        prompt_tokens[:n], kv, namespace=adapter
-                    )
-        else:
-            _tag, prompt, sampling, callback, adapter = item
-            # The prompt is never truncated (submit validated it fits); a
-            # generation budget that would overflow the KV rows shrinks
-            # max_tokens instead, mirroring the transferred-prefix path.
-            headroom = self.T - 1 - len(prompt)
-            if sampling.max_tokens > headroom:
-                sampling = dataclasses.replace(
-                    sampling, max_tokens=max(1, headroom)
-                )
-            prompt_len = len(prompt)
-            offset = 0
-            lease = None
-            if self._prefix_cache is not None:
-                lease = self._prefix_cache.lookup(prompt, namespace=adapter)
-            if lease is not None:
-                # Attach the cached prefix through the padded-bucket attach
-                # path, then prefill only the suffix. The lease pins the
-                # blocks until the host->device copy is staged.
-                offset = lease.matched_tokens
-                prefix_kv = lease.kv()
-                mb = self._bucket(offset)
-                if prefix_kv.shape[2] < mb:
-                    pad = np.zeros(
-                        (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
-                        + prefix_kv.shape[3:], prefix_kv.dtype,
-                    )
-                    prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
-                attach = self._program(
-                    self._jit_prefill, ("attach", mb),
-                    lambda: jax.jit(self._attach_kv),
-                )
-                self._caches = attach(
-                    self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
-                )
-                lease.release()
-            suffix = prompt[offset:]
-            bucket = self._bucket(len(suffix))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(suffix)] = suffix
-            prefill = self._program(
-                self._jit_prefill, bucket, lambda: jax.jit(self._prefill_at)
+            req.lease.release()
+            req.lease = None
+        padded = np.zeros((1, chunk.bucket), np.int32)
+        padded[0, : len(chunk.tokens)] = chunk.tokens
+        prefill = self._program(
+            self._jit_prefill, chunk.bucket, lambda: jax.jit(self._prefill_at)
+        )
+        last_logits, self._caches = prefill(
+            self.params, self._lora, jnp.asarray(padded), self._caches,
+            jnp.int32(slot), jnp.int32(offset),
+            jnp.int32(req.prompt_len), jnp.int32(req.adapter),
+        )
+        self._sched.chunk_done(chunk)
+        if not chunk.is_last:
+            return  # intermediate chunk: logits discarded, no host pull
+        self._lens[slot] = req.prompt_len
+        self.last_prefill = {
+            "bucket": chunk.bucket, "offset": req.cached_offset,
+            "prompt_len": req.prompt_len, "chunks": req.chunks,
+        }
+        # The admission sync: the request's FIRST token must be sampled
+        # host-side before the slot can join the decode batch — one
+        # [V]-row pull per admitted request, not per step or per chunk.
+        first = _sample_host(np.asarray(last_logits), req.sampling, self._np_rng)  # raylint: disable=RL603 (one per-admission pull)
+        if self._prefix_cache is not None:
+            self._insert_prompt_kv(slot, req.prompt, req.adapter,
+                                   req.cached_offset)
+        if self._draft is not None:
+            # Draft catch-up: cache-hit admissions (offset > 0) stay
+            # spec-eligible — the draft sees the full token history (the
+            # model draft re-prefills its own cache; the ngram draft only
+            # needs the ids).
+            self._draft.on_admit(slot, list(req.prompt))
+        self._start_slot(req, first)
+
+    def _exec_attach(self, req: Request):
+        """Transferred-prefix admission (PD disaggregation): attach the KV,
+        sample the first token from the transferred logits, and feed the
+        slot straight into the scheduler's running queue."""
+        slot = req.slot
+        kv = req.kv
+        prompt_len = req.prompt_len
+        # Pad the transferred prefix to a bucket so attach programs are reused.
+        P = kv.shape[2]
+        bucket = self._bucket(max(P, prompt_len))
+        if P < bucket:
+            pad = np.zeros(
+                (kv.shape[0], 2, bucket - P) + kv.shape[3:], kv.dtype
             )
-            last_logits, self._caches = prefill(
-                self.params, self._lora, jnp.asarray(padded), self._caches,
-                jnp.int32(slot), jnp.int32(offset),
-                jnp.int32(prompt_len), jnp.int32(adapter),
-            )
-            self._lens[slot] = prompt_len
-            self.last_prefill = {
-                "bucket": bucket, "offset": offset, "prompt_len": prompt_len,
-            }
-            # The admission sync: the request's FIRST token must be sampled
-            # host-side before the slot can join the decode batch — one
-            # [V]-row pull per admitted request, not per step.
-            first = _sample_host(np.asarray(last_logits), sampling, self._np_rng)  # raylint: disable=RL603 (one per-admission pull)
-            if self._prefix_cache is not None:
-                self._insert_prompt_kv(slot, prompt, adapter, offset)
-            if self._spec is not None:
-                if offset:
-                    # A cache hit leaves the draft cache without the prefix
-                    # rows; plain decode for this slot (same contract as
-                    # transferred prefixes).
-                    self._spec["ready"][slot] = False
-                else:
-                    dprefill = self._program(
-                        self._jit_spec_prefill, ("dprefill", bucket),
-                        lambda: jax.jit(self._draft_prefill),
-                    )
-                    self._spec["caches"] = dprefill(
-                        self._spec["params"], jnp.asarray(padded),
-                        self._spec["caches"], jnp.int32(slot),
-                    )
-                    self._spec["host_lens"][slot] = len(prompt)
-                    self._spec["ready"][slot] = True
-                    self._spec["pending"][slot] = None
-        s = self._slots[slot]
-        s.active = True
-        s.generated = 1
-        s.params = sampling
-        s.callback = callback
-        s.prompt_len = prompt_len
-        s.host_len = prompt_len
-        s.adapter = adapter
-        s.tokens = [first]
-        self._adapter_ids[slot] = adapter
+            kv = np.concatenate([kv, pad], axis=2)
+        elif P > bucket:
+            kv = kv[:, :, :bucket]
+        attach = self._program(
+            self._jit_prefill, ("attach", bucket),
+            lambda: jax.jit(self._attach_kv),
+        )
+        self._caches = attach(
+            self._caches, jnp.asarray(kv), jnp.int32(slot)
+        )
+        self._lens[slot] = prompt_len
+        first = _sample_host(np.asarray(req.first_logits), req.sampling,
+                             self._np_rng)
+        prompt_tokens = req.prompt
+        # PD-disagg transferred prefixes feed the prefix cache too: the
+        # host-side kv is already in pool layout, so insertion is free of
+        # device readbacks.
+        if (self._prefix_cache is not None and prompt_tokens
+                and len(prompt_tokens) >= prompt_len):
+            bs = self._prefix_cache.block_size
+            n = (prompt_len // bs) * bs
+            if n:
+                self._prefix_cache.insert(
+                    prompt_tokens[:n], kv, namespace=req.adapter
+                )
+        if self._draft is not None:
+            if prompt_tokens and len(prompt_tokens) >= prompt_len:
+                # The transferred prefix carries its token ids: the draft
+                # catches up and the slot stays spec-eligible.
+                self._draft.on_admit(slot, list(prompt_tokens[:prompt_len]))
+            else:
+                # No ids, no draft history: plain decode for this slot.
+                self._draft.on_plain_decode(slot)
+        self._start_slot(req, first)
+
+    def _start_slot(self, req: Request, first: int):
+        self._sched.start_decode(req, first)
+        slot = req.slot
+        self._adapter_ids[slot] = req.adapter
         self._last_token[slot] = first
         self._emit(slot, first)
-        return True
 
     def _emit(self, slot: int, token: int):
-        s = self._slots[slot]
+        s = self._sched.slots[slot]
         done = (
             s.generated >= s.params.max_tokens
             or (s.params.stop_token_id is not None and token == s.params.stop_token_id)
@@ -1034,6 +1037,8 @@ class DecodeEngine:
             done = True
         if done:
             s.active = False
+            if self._draft is not None:
+                self._draft.on_finish(slot, s)
             # slot cache naturally reused on next admit (lens reset at prefill)
 
     def _loop(self):
@@ -1043,94 +1048,72 @@ class DecodeEngine:
             self.error = e
             # Callers blocked on per-request callbacks would otherwise hang
             # forever: fail every active/queued request loudly.
-            with self._lock:
-                queued, self._queue = self._queue, []
-            for slot in self._slots:
+            for slot in self._sched.slots:
                 if slot.active and slot.callback is not None:
                     slot.active = False
                     try:
                         slot.callback(-1, True)
                     except Exception:
                         pass
-            for item in queued:
-                cb = item[3] if item[0] == "prompt" else item[5]
-                try:
-                    cb(-1, True)
-                except Exception:
-                    pass
+            for req in self._sched.drain():
+                if req.callback is not None:
+                    try:
+                        req.callback(-1, True)
+                    except Exception:
+                        pass
 
     def _loop_inner(self):
+        """Execute one scheduler plan per iteration: prefill chunks, then
+        the speculative verify phase, then the batched decode phase (the
+        order is load-bearing — see Plan)."""
         while not self._stop:
-            admitted = True
-            while admitted:
-                admitted = self._admit()
-            active = [i for i, s in enumerate(self._slots) if s.active]
-            if not active:
+            plan = self._sched.next_plan(draft=self._draft)
+            if plan.idle:
                 time.sleep(0.002)
                 continue
-            if len(active) == 1 and self._spec_eligible(active[0]):
-                # batch==1 latency regime: draft-k + verify beats one-token steps
-                self._spec_round(active[0])
-                continue
-            if self._spec is not None:
-                for i in active:
-                    # A plain step advances the target but not the draft: the
-                    # draft cache is now behind and its proposals would be
-                    # garbage (2 dispatches per ~1 token). Disable spec for the
-                    # slot; a fresh request re-enables it at prefill.
-                    if self._spec["ready"][i]:
-                        self._spec["ready"][i] = False
-                        self._spec["pending"][i] = None
-            n = self._choose_multi_step(active)
-            if n > 1:
-                self._multi_round(active, n)
-                continue
-            # lens/last_token/adapter_ids ride host->device per dispatch (an
-            # async copy of a few int32s); the returned device lens is
-            # discarded — the host mirrors below are canonical.
-            logits, self._caches, _ = self._jit_decode(
-                self.params, self._lora, jnp.asarray(self._adapter_ids),
-                jnp.asarray(self._last_token), self._caches,
-                jnp.asarray(self._lens),
-            )
-            # The step's ONE device->host pull: every active slot's next-token
-            # logits arrive in a single [B, V] readback (sampling params can
-            # differ per slot, so sampling itself is host-side).
-            logits_np = np.asarray(logits)  # raylint: disable=RL603 (the per-dispatch batched readback)
-            self._lens += 1  # every slot's kv row advanced on device
-            for i in active:
-                s = self._slots[i]
-                token = _sample_host(logits_np[i], s.params, self._np_rng)
-                s.generated += 1
-                s.host_len += 1  # the decode step wrote last_token's kv row
-                s.tokens.append(token)
-                self._last_token[i] = token
-                self._emit(i, token)
+            for chunk in plan.chunks:
+                self._exec_chunk(chunk)
+            if plan.spec_slots:
+                self._spec_round(plan)
+            if plan.decode_slots:
+                if plan.multi_step > 1:
+                    self._multi_round(plan.decode_slots, plan.multi_step)
+                else:
+                    self._decode_round(plan.decode_slots)
+                if self._draft is not None:
+                    for i in plan.decode_slots:
+                        # A plain step advances the target but not a model
+                        # draft's cache: its proposals would be garbage.
+                        # (The ngram draft is stateless here: no-op.)
+                        self._draft.on_plain_decode(i)
 
-    def _choose_multi_step(self, active) -> int:
-        """Tokens to decode in the next dispatch: >1 only when every active
-        slot is greedy (on-device argmax is exact then), no request is queued
-        (a waiting request needs a slot to free promptly), and capped at the
-        smallest remaining budget (power-of-two bucketed to bound the jit
-        cache)."""
-        if self._multi_step <= 1:
-            return 1
-        with self._lock:
-            if self._queue:
-                return 1
-        if any(self._slots[i].params.temperature > 0 for i in active):
-            return 1
-        remaining = min(
-            self._slots[i].params.max_tokens - self._slots[i].generated
-            for i in active
+    def _decode_round(self, decode_slots: List[int]):
+        # lens/last_token/adapter_ids ride host->device per dispatch (an
+        # async copy of a few int32s); the returned device lens is
+        # discarded — the host mirrors below are canonical.
+        logits, self._caches, _ = self._jit_decode(
+            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            jnp.asarray(self._last_token), self._caches,
+            jnp.asarray(self._lens),
         )
-        n = max(1, min(self._multi_step, remaining))
-        bucket = 1
-        while bucket * 2 <= n:
-            bucket *= 2
-        return bucket
+        # The step's ONE device->host pull: every active slot's next-token
+        # logits arrive in a single [B, V] readback (sampling params can
+        # differ per slot, so sampling itself is host-side).
+        logits_np = np.asarray(logits)  # raylint: disable=RL603 (the per-dispatch batched readback)
+        for i in decode_slots:
+            s = self._sched.slots[i]
+            self._lens[i] += 1  # the decode step wrote this slot's kv row
+            if not s.active:
+                continue
+            token = _sample_host(logits_np[i], s.params, self._np_rng)
+            s.generated += 1
+            s.host_len += 1
+            s.tokens.append(token)
+            s.history.append(token)
+            self._last_token[i] = token
+            self._emit(i, token)
 
-    def _multi_round(self, active, n: int):
+    def _multi_round(self, decode_slots: List[int], n: int):
         """One multi-token dispatch + host-side emission with rollback for
         slots that stop early (stop_token): their device lens/last_token are
         corrected back to what was actually consumed."""
@@ -1142,9 +1125,9 @@ class DecodeEngine:
         # The chunk's ONE device->host pull: n tokens x B slots per readback
         # (the whole point of multi-step decode).
         toks = np.asarray(toks_dev)  # raylint: disable=RL603 (the per-chunk batched readback)
-        self._lens += n  # device wrote n kv rows per slot
-        for i in active:
-            s = self._slots[i]
+        for i in decode_slots:
+            s = self._sched.slots[i]
+            self._lens[i] += n  # device wrote n kv rows for this slot
             consumed = 0
             for j in range(n):
                 if not s.active:
@@ -1154,6 +1137,7 @@ class DecodeEngine:
                 s.generated += 1
                 s.host_len += 1
                 s.tokens.append(token)
+                s.history.append(token)
                 self._last_token[i] = token
                 self._emit(i, token)
             if consumed < n:

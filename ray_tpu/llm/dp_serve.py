@@ -93,6 +93,12 @@ class DPLLMServer(LLMServer):
         stats = await super().cache_stats()
         return {"dp_rank": self.dp_rank, **(stats or {})}
 
+    async def scheduler_stats(self) -> dict:
+        """Iteration-level scheduler occupancy + spec acceptance, rank-tagged
+        (docs/scheduler.md)."""
+        stats = await super().scheduler_stats()
+        return {"dp_rank": self.dp_rank, **stats}
+
     def __del__(self):
         try:
             self._assigner.release.remote(self._replica_token)  # raylint: disable=RL501 (__del__ cannot block; assigner audits stale tokens)
@@ -238,6 +244,15 @@ class DPRouter:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, lambda: self._server.cache_stats.broadcast()
+        )
+
+    async def scheduler_stats(self) -> List[dict]:
+        """Rank-tagged scheduler occupancy + spec acceptance from EVERY
+        replica: the fleet-level view of prefill/decode/verify interleaving
+        (docs/scheduler.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._server.scheduler_stats.broadcast()
         )
 
     async def __call__(self, request) -> dict:

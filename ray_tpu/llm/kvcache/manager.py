@@ -119,6 +119,11 @@ class PrefixCacheManager:
         self._counters = {
             "lookups": 0, "hits": 0, "misses": 0, "hit_tokens": 0,
             "inserted_blocks": 0, "evicted_blocks": 0, "rejected_blocks": 0,
+            # Leases pinned right now. With the iteration-level scheduler a
+            # lease can span plan->attach across an engine iteration, so the
+            # live count is real observability (a stuck lease pins its chain
+            # against eviction).
+            "leases_active": 0,
         }
 
     # -- lookup / lease ----------------------------------------------------
@@ -143,6 +148,7 @@ class PrefixCacheManager:
             matched = len(block_ids) * self.block_size
             self._counters["hits"] += 1
             self._counters["hit_tokens"] += matched
+            self._counters["leases_active"] += 1
         self._emit("hits", 1)
         self._emit("hit_tokens", matched)
         return PrefixLease(self, block_ids, matched, namespace)
@@ -150,6 +156,7 @@ class PrefixCacheManager:
     def _release(self, block_ids: List[int]):
         with self._lock:
             self._pool.decref(block_ids)
+            self._counters["leases_active"] -= 1
 
     # -- insert ------------------------------------------------------------
     def insert(self, token_ids: Sequence[int], kv: np.ndarray,

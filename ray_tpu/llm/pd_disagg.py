@@ -69,6 +69,10 @@ class DecodeServer:
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
             lora_config=config.lora_config,
+            # Transferred prefixes arrive with token_ids, so decode-side spec
+            # decoding stays live: the draft catches up on the token history
+            # instead of downgrading to plain decode (docs/scheduler.md).
+            spec_config=config.spec_config,
         )
 
     async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
@@ -112,6 +116,9 @@ class DecodeServer:
 
     async def cache_stats(self) -> Optional[dict]:
         return self._engine.prefix_cache_stats()
+
+    async def scheduler_stats(self) -> dict:
+        return self._engine.scheduler_stats()
 
     def __del__(self):
         try:

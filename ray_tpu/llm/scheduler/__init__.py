@@ -1,0 +1,35 @@
+"""Iteration-level scheduler for the LLM decode engine (docs/scheduler.md).
+
+The scheduler owns the engine's queues, slots, and per-iteration admission
+policy: each engine iteration it assembles one `Plan` — bucketed prefill
+chunks interleaved with batched decode steps and speculative verify phases
+under a token budget — so one engine sustains mixed prefill/decode traffic
+without TTFT cliffs (Orca-style iteration-level scheduling; the vLLM/SGLang
+chunked-prefill shape adapted to the static-bucket two-program contract).
+"""
+
+from ray_tpu.llm.scheduler.scheduler import (
+    Plan,
+    Request,
+    ScheduledChunk,
+    Scheduler,
+    Slot,
+)
+from ray_tpu.llm.scheduler.spec import (
+    DraftProvider,
+    ModelDraft,
+    NGramDraft,
+    early_exit_draft,
+)
+
+__all__ = [
+    "DraftProvider",
+    "ModelDraft",
+    "NGramDraft",
+    "Plan",
+    "Request",
+    "ScheduledChunk",
+    "Scheduler",
+    "Slot",
+    "early_exit_draft",
+]

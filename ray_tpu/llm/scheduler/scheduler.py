@@ -1,0 +1,408 @@
+"""Scheduler: iteration-level admission + step assembly for the decode engine.
+
+Design parity: Orca's iteration-level scheduling and vLLM's chunked-prefill
+scheduler (`vllm/core/scheduler.py`) — the engine no longer admits work
+request-at-a-time. Every iteration the scheduler assembles ONE step from the
+waiting/running queues: prefills are split into bucketed chunks drawn from
+the engine's fixed `_prefill_buckets` table (so no new traffic shape compiles
+a new program) and interleaved with batched decode / speculative-verify
+phases under a token budget. Decode and verify tokens are reserved FIRST;
+prefill chunks fill the remainder — a long prompt therefore cannot stall
+in-flight decodes for more than one budget's worth of prefill compute, and a
+steady decode load cannot starve prefill because the head-of-line prefill
+request is always granted at least one minimum-bucket chunk per iteration.
+
+The scheduler is pure host bookkeeping: it never touches a device. The
+engine's stepper thread calls `next_plan()` and executes the returned phases
+(chunk dispatch -> spec verify -> batched decode); `submit()` is the only
+cross-thread entry point and is guarded by the admission lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.llm.kvcache.manager import PrefixLease
+
+
+class EngineOverloadedError(RuntimeError):
+    """The engine's admission queue is at its configured depth cap
+    (`llm_max_queue_depth`); the submit was rejected without enqueueing.
+    Callers should shed load or retry with backoff."""
+
+
+class Slot:
+    """One decode slot's host-side state. `active` means the slot is in the
+    decode phase (prompt fully prefilled, emitting tokens); a slot being
+    chunk-prefilled is reserved via its Request and is not yet active."""
+
+    __slots__ = ("active", "generated", "params", "callback", "prompt_len",
+                 "tokens", "host_len", "adapter", "history")
+
+    def __init__(self):
+        self.active = False
+        self.generated = 0
+        self.params = None          # SamplingParams
+        self.callback = None
+        self.prompt_len = 0
+        self.tokens: List[int] = []       # generated tokens
+        self.host_len = 0  # kv rows present for this slot (host mirror of lens)
+        self.adapter = 0
+        # prompt + generated tokens: the draft providers' lookup corpus
+        self.history: List[int] = []
+
+
+class Request:
+    """One admitted unit of work, from submit() to slot activation.
+
+    kind "prompt": a prompt to prefill (possibly in several chunks, possibly
+    behind a prefix-cache lease). kind "prefilled": a PD-disagg transfer —
+    the KV prefix rides in and the request feeds the running queue directly
+    (attach + first sample, no prefill chunks).
+    """
+
+    __slots__ = ("kind", "prompt", "sampling", "callback", "adapter",
+                 "prompt_len", "prefilled", "slot", "lease", "cached_offset",
+                 "kv", "first_logits", "chunks")
+
+    def __init__(self, kind: str, *, prompt: Optional[List[int]] = None,
+                 sampling=None, callback=None, adapter: int = 0,
+                 prompt_len: int = 0, kv: Optional[np.ndarray] = None,
+                 first_logits: Optional[np.ndarray] = None):
+        self.kind = kind
+        self.prompt = prompt or []
+        self.sampling = sampling
+        self.callback = callback
+        self.adapter = adapter
+        self.prompt_len = prompt_len or len(self.prompt)
+        self.prefilled = 0          # prompt tokens whose KV is in the slot
+        self.slot: Optional[int] = None
+        self.lease: Optional[PrefixLease] = None  # pending attach
+        self.cached_offset = 0      # tokens served by the prefix cache
+        self.kv = kv                # transferred KV ("prefilled" kind)
+        self.first_logits = first_logits
+        self.chunks = 0             # prefill chunks dispatched so far
+
+
+class ScheduledChunk:
+    """One prefill chunk (or a transferred-prefix attach) for one request."""
+
+    __slots__ = ("request", "slot", "offset", "tokens", "bucket",
+                 "is_first", "is_last")
+
+    def __init__(self, request: Request, offset: int, tokens: List[int],
+                 bucket: int, is_first: bool, is_last: bool):
+        self.request = request
+        self.slot = request.slot
+        self.offset = offset        # absolute KV row where this chunk lands
+        self.tokens = tokens        # [] for kind "prefilled" (attach-only)
+        self.bucket = bucket
+        self.is_first = is_first
+        self.is_last = is_last
+
+
+class Plan:
+    """One engine iteration: chunks -> spec verify -> batched decode.
+
+    The phase order is load-bearing: speculative verify writes k+1 rows into
+    EVERY slot's cache (non-participants behind a write gate), so plain
+    decode must dispatch after verify to land the canonical row last.
+    """
+
+    __slots__ = ("chunks", "decode_slots", "spec_slots", "proposals",
+                 "multi_step", "prefill_tokens", "decode_tokens",
+                 "verify_tokens", "idle")
+
+    def __init__(self):
+        self.chunks: List[ScheduledChunk] = []
+        self.decode_slots: List[int] = []
+        self.spec_slots: List[int] = []
+        self.proposals: Dict[int, np.ndarray] = {}
+        self.multi_step = 1
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.verify_tokens = 0
+        self.idle = True
+
+
+class Scheduler:
+    """Owns waiting/prefilling/running state and assembles one Plan per
+    engine iteration. Thread contract: `submit`/`queue_depth` may be called
+    from any thread (lock-guarded); everything else runs on the engine's
+    stepper thread only."""
+
+    def __init__(self, *, num_slots: int, buckets, max_seq: int,
+                 token_budget: int, max_queue_depth: int, multi_step: int = 1,
+                 lookup: Optional[Callable] = None, name: str = ""):
+        self.slots = [Slot() for _ in range(num_slots)]
+        self._buckets = tuple(buckets)
+        self._bucket_min = self._buckets[0]
+        self.T = max_seq
+        # 0 = unbudgeted: whole-prompt prefill in one chunk (the legacy
+        # request-at-a-time admission shape, kept for A/B benching).
+        self.token_budget = max(0, int(token_budget))
+        self._max_queue_depth = max(0, int(max_queue_depth))
+        self.multi_step = max(1, int(multi_step))
+        self._lookup = lookup       # prefix-cache lookup(prompt, adapter)
+        self._waiting: deque = deque()
+        self._prefilling: List[Request] = []   # slot-assigned, chunks pending
+        self._lock = threading.Lock()
+        from ray_tpu.util.metrics import Gauge
+
+        tag = {"engine": name or f"{id(self):x}"}
+        self._queue_gauge = Gauge(
+            "llm_engine_queue_depth",
+            "requests waiting in the engine admission queue",
+            tag_keys=("engine",),
+        ).set_default_tags(tag)
+        # Per-phase occupancy: tokens assembled into the most recent
+        # iteration, by phase (prefill-chunk vs decode vs spec-verify).
+        self._occ_gauges = {
+            phase: Gauge(
+                f"llm_sched_{phase}_tokens",
+                f"{phase} tokens assembled into the current engine iteration",
+                tag_keys=("engine",),
+            ).set_default_tags(tag)
+            for phase in ("prefill", "decode", "verify")
+        }
+        self._counters = {
+            "iterations": 0, "interleaved_iterations": 0,
+            "prefill_tokens": 0, "decode_tokens": 0, "verify_tokens": 0,
+            "prefill_chunks": 0, "admitted": 0, "spec_rounds": 0,
+        }
+
+    # -- cross-thread API ---------------------------------------------------
+    def submit(self, request: Request):
+        """Bounded admission: reject at the depth cap instead of growing the
+        queue (and resident prompt copies) without limit under overload."""
+        with self._lock:
+            if self._max_queue_depth and len(self._waiting) >= self._max_queue_depth:
+                depth = len(self._waiting)
+                raise EngineOverloadedError(
+                    f"engine admission queue is full ({depth} >= "
+                    f"llm_max_queue_depth={self._max_queue_depth}); shed load "
+                    f"or retry with backoff"
+                )
+            self._waiting.append(request)
+            depth = len(self._waiting)
+        self._queue_gauge.set(float(depth))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def drain(self) -> List[Request]:
+        """Remove every queued and in-prefill request (stepper death path):
+        the engine fails their callbacks so submitters don't hang."""
+        with self._lock:
+            queued = list(self._waiting)
+            self._waiting.clear()
+        queued.extend(self._prefilling)
+        self._prefilling = []
+        for r in queued:
+            if r.lease is not None:
+                r.lease.release()
+                r.lease = None
+        self._queue_gauge.set(0.0)
+        return queued
+
+    # -- stepper-thread API -------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self.T
+
+    def _admit_waiting(self):
+        """Assign free slots to waiting requests (FIFO). Prefix-cache lookup
+        happens here — once per request, before its first chunk — so chunk
+        plans cover only the uncached suffix."""
+        reserved = {r.slot for r in self._prefilling}
+        free = [i for i, s in enumerate(self.slots)
+                if not s.active and i not in reserved]
+        admitted = 0
+        while free:
+            with self._lock:
+                if not self._waiting:
+                    break
+                req = self._waiting.popleft()
+                depth = len(self._waiting)
+            self._queue_gauge.set(float(depth))
+            req.slot = free.pop(0)
+            if (req.kind == "prompt" and self._lookup is not None):
+                lease = self._lookup(req.prompt, req.adapter)
+                if lease is not None:
+                    req.lease = lease
+                    req.cached_offset = lease.matched_tokens
+                    req.prefilled = lease.matched_tokens
+            self._prefilling.append(req)
+            admitted += 1
+        self._counters["admitted"] += admitted
+
+    def next_plan(self, draft=None) -> Plan:
+        """Assemble one iteration. Budget policy: decode (1 token/slot) and
+        spec verify (k+1 tokens/slot) are reserved first; the remaining
+        budget is granted to prefill chunks head-of-line-first, rounded to
+        the bucket table. The head prefill request always gets at least a
+        minimum-bucket chunk, so neither phase can starve the other."""
+        self._admit_waiting()
+        plan = Plan()
+        active = [i for i, s in enumerate(self.slots) if s.active]
+
+        # -- speculative phase: greedy slots with a live proposal ----------
+        if draft is not None and active:
+            k = draft.k
+            for i in active:
+                s = self.slots[i]
+                if not self._spec_ok(s, k) or not draft.eligible(i, s):
+                    continue
+                proposal = draft.propose(i, s)
+                if proposal is None or len(proposal) == 0:
+                    continue
+                plan.spec_slots.append(i)
+                plan.proposals[i] = np.asarray(proposal, np.int32)
+            plan.verify_tokens = sum(
+                len(plan.proposals[i]) + 1 for i in plan.spec_slots
+            )
+        plan.decode_slots = [i for i in active if i not in plan.spec_slots]
+        plan.decode_tokens = len(plan.decode_slots)
+
+        # -- prefill chunks under the remaining budget ---------------------
+        # FCFS, ONE prompt chunk per iteration (vLLM's chunked-prefill
+        # discipline): the chunk bucket is then a stable function of the
+        # budget, so mixed traffic exercises one or two compiled bucket
+        # programs instead of spraying a different leftover-budget bucket
+        # per queued request. Attach-only admissions (transferred prefixes)
+        # cost no prefill compute and are never serialized behind a chunk.
+        budget = self.token_budget
+        spent = plan.decode_tokens + plan.verify_tokens
+        chunked = False
+        for req in self._prefilling:
+            remaining = req.prompt_len - req.prefilled
+            if req.kind == "prefilled":
+                # Transferred prefix: attach-only, no prefill compute.
+                plan.chunks.append(ScheduledChunk(
+                    req, 0, [], self._bucket(req.prompt_len),
+                    is_first=True, is_last=True,
+                ))
+                continue
+            if remaining <= 0:
+                continue
+            if budget <= 0:                       # unbudgeted: whole suffix,
+                grant = remaining                 # every waiting request
+            elif chunked:
+                continue
+            else:
+                # Head-of-line progress guarantee: at least one min bucket
+                # even when decode reserved the whole budget.
+                left = max(budget - spent, self._bucket_min)
+                grant = min(remaining, self._largest_bucket(left))
+                chunked = True
+            bucket = self._bucket(grant)
+            chunk = ScheduledChunk(
+                req, req.prefilled,
+                req.prompt[req.prefilled:req.prefilled + grant], bucket,
+                is_first=(req.chunks == 0),
+                is_last=(req.prefilled + grant >= req.prompt_len),
+            )
+            plan.chunks.append(chunk)
+            plan.prefill_tokens += bucket
+
+        # -- multi-step decode: only when the engine is otherwise idle -----
+        if (self.multi_step > 1 and plan.decode_slots and not plan.chunks
+                and not plan.spec_slots and not self._prefilling
+                and self.queue_depth() == 0):
+            plan.multi_step = self._choose_multi_step(plan.decode_slots)
+            plan.decode_tokens = len(plan.decode_slots) * plan.multi_step
+
+        plan.idle = not (plan.chunks or plan.decode_slots or plan.spec_slots)
+        if not plan.idle:
+            self._note(plan)
+        return plan
+
+    def _spec_ok(self, s: Slot, k: int) -> bool:
+        return (
+            s.params is not None
+            and s.params.temperature == 0.0
+            and s.params.top_k in (0, 1)
+            # verify writes k+1 rows at host_len; past the cache end XLA
+            # would CLAMP the dynamic_update_slice start and corrupt valid
+            # history — the final rounds near the cap fall back to decode.
+            and s.host_len + k + 1 <= self.T
+        )
+
+    def _largest_bucket(self, budget: int) -> int:
+        """Largest bucket-table entry <= budget (floor at the min bucket)."""
+        best = self._bucket_min
+        for b in self._buckets:
+            if b <= budget:
+                best = b
+        return best
+
+    def _choose_multi_step(self, decode_slots: List[int]) -> int:
+        """Tokens per decode dispatch: >1 only when every active slot is
+        greedy (on-device argmax is exact then), capped at the smallest
+        remaining budget and power-of-two bucketed to bound the jit cache."""
+        if any(self.slots[i].params.temperature > 0 for i in decode_slots):
+            return 1
+        remaining = min(
+            self.slots[i].params.max_tokens - self.slots[i].generated
+            for i in decode_slots
+        )
+        n = max(1, min(self.multi_step, remaining))
+        bucket = 1
+        while bucket * 2 <= n:
+            bucket *= 2
+        return bucket
+
+    # -- state transitions (engine-driven) ----------------------------------
+    def chunk_done(self, chunk: ScheduledChunk):
+        req = chunk.request
+        req.prefilled += len(chunk.tokens)
+        req.chunks += 1
+        self._counters["prefill_chunks"] += 1
+
+    def start_decode(self, req: Request, first_token: int):
+        """Prompt fully in the KV cache and first token sampled: the slot
+        joins the running (decode) set."""
+        s = self.slots[req.slot]
+        s.active = True
+        s.generated = 1
+        s.params = req.sampling
+        s.callback = req.callback
+        s.prompt_len = req.prompt_len
+        s.host_len = req.prompt_len
+        s.adapter = req.adapter
+        s.tokens = [first_token]
+        s.history = list(req.prompt) + [first_token]
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+
+    def stats(self) -> dict:
+        out = dict(self._counters)
+        out["queue_depth"] = self.queue_depth()
+        out["prefilling"] = len(self._prefilling)
+        out["running"] = sum(1 for s in self.slots if s.active)
+        out["token_budget"] = self.token_budget
+        return out
+
+    def _note(self, plan: Plan):
+        c = self._counters
+        c["iterations"] += 1
+        c["prefill_tokens"] += plan.prefill_tokens
+        c["decode_tokens"] += plan.decode_tokens
+        c["verify_tokens"] += plan.verify_tokens
+        if plan.spec_slots:
+            c["spec_rounds"] += 1
+        if plan.prefill_tokens and (plan.decode_slots or plan.spec_slots):
+            c["interleaved_iterations"] += 1
+        try:
+            self._occ_gauges["prefill"].set(float(plan.prefill_tokens))
+            self._occ_gauges["decode"].set(float(plan.decode_tokens))
+            self._occ_gauges["verify"].set(float(plan.verify_tokens))
+        except Exception:
+            pass  # metrics must never break the serving path

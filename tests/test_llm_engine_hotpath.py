@@ -65,6 +65,33 @@ def test_jit_program_cache_bounded_under_adversarial_length_mix(monkeypatch):
         engine.shutdown()
 
 
+def test_jit_program_cache_bounded_under_adversarial_chunk_mix(monkeypatch):
+    """Chunked prefill must add ZERO program-cache growth: an adversarial
+    prompt-length mix driven through the scheduler with a tiny token budget
+    (so every prompt splits into chunks) draws every chunk shape from the
+    bucket table, and the spec plane's verify program is keyed only by k —
+    all through the capped `_program` helper."""
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setitem(CONFIG._cache, "llm_prefill_bucket_min", 4)
+    monkeypatch.setitem(CONFIG._cache, "llm_max_jit_programs", 3)
+    monkeypatch.setitem(CONFIG._cache, "llm_prefix_cache_bytes", 0)
+    engine = _tiny_engine(num_slots=2, max_seq=64, token_budget=4,
+                          prefix_cache=False,
+                          spec_config={"method": "ngram", "num_spec_tokens": 3})
+    try:
+        assert engine._prefill_buckets == (4, 8, 16, 32, 64)
+        for n in (3, 5, 9, 17, 33, 21, 13):   # every bucket, revisited
+            out = _generate(engine, list(range(1, n + 1)), max_tokens=2)
+            assert len(out) == 2
+            assert len(engine._jit_prefill) <= 3, engine._jit_prefill.keys()
+            assert len(engine._jit_spec_verify) <= 1
+        stats = engine.scheduler_stats()
+        assert stats["prefill_chunks"] > 7  # the mix really was chunked
+    finally:
+        engine.shutdown()
+
+
 def test_jit_program_cap_zero_is_unbounded(monkeypatch):
     from ray_tpu._private.config import CONFIG
 
