@@ -500,25 +500,33 @@ class DecodeEngine:
         last = logits[0, total_len - 1 - offset]
         return last, out_caches
 
-    def _decode_step(self, params, lora, adapter_ids, last_token, caches, lens):
-        """One token for every slot. last_token: [B]; lens: [B] current lengths."""
+    def _decode_step(self, params, lora, adapter_ids, last_token, caches, lens,
+                     gate):
+        """One token for every slot. last_token: [B]; lens: [B] current
+        lengths; gate: [B] bool — only slots in the decode phase land their
+        KV row. A slot mid-chunked-prefill rides through the batched forward
+        with a stale lens, and an ungated write there would permanently
+        corrupt rows its covering chunk already wrote (same hazard the
+        spec-verify gate exists for)."""
         positions = lens[:, None]
         # key j visible iff j <= lens (the new token writes at index lens)
         kv_mask = (jnp.arange(self.T)[None, :] <= lens[:, None])[:, None, :]
         logits, new_caches = _forward_cached(
             params, self.cfg, last_token[:, None], positions, caches, lens, kv_mask,
-            lora=lora, adapter_ids=adapter_ids,
+            lora=lora, adapter_ids=adapter_ids, write_gate=gate,
         )
         return logits[:, 0], new_caches, lens + 1
 
     def _decode_multi(self, params, lora, adapter_ids, last_token, caches, lens,
-                      *, n):
+                      gate, *, n):
         """n greedy tokens for every slot in ONE program: lax.scan over decode
         steps with on-device argmax. Returns ([n, B] tokens, final caches/lens)."""
 
         def step(carry, _):
             last, c, l = carry
-            logits, c, l = self._decode_step(params, lora, adapter_ids, last, c, l)
+            logits, c, l = self._decode_step(
+                params, lora, adapter_ids, last, c, l, gate
+            )
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (nxt, c, l), nxt
 
@@ -947,9 +955,14 @@ class DecodeEngine:
             jnp.int32(req.prompt_len), jnp.int32(req.adapter),
         )
         self._sched.chunk_done(chunk)
+        # The host lens mirror advances with EVERY chunk (not just the last):
+        # the decode write gate is the primary guard against interleaved
+        # dispatches touching a mid-prefill slot, and an accurate lens is the
+        # backstop — anything that did write at lens would land at the next
+        # chunk's start offset and be overwritten write-before-read.
+        self._lens[slot] = req.prefilled
         if not chunk.is_last:
             return  # intermediate chunk: logits discarded, no host pull
-        self._lens[slot] = req.prompt_len
         self.last_prefill = {
             "bucket": chunk.bucket, "offset": req.cached_offset,
             "prompt_len": req.prompt_len, "chunks": req.chunks,
@@ -1090,11 +1103,15 @@ class DecodeEngine:
     def _decode_round(self, decode_slots: List[int]):
         # lens/last_token/adapter_ids ride host->device per dispatch (an
         # async copy of a few int32s); the returned device lens is
-        # discarded — the host mirrors below are canonical.
+        # discarded — the host mirrors below are canonical. The write gate
+        # restricts KV writes to exactly the slots whose lens advances
+        # below: idle and mid-prefill slots pass through write-free.
+        gate = np.zeros((self.B,), bool)
+        gate[decode_slots] = True
         logits, self._caches, _ = self._jit_decode(
             self.params, self._lora, jnp.asarray(self._adapter_ids),
             jnp.asarray(self._last_token), self._caches,
-            jnp.asarray(self._lens),
+            jnp.asarray(self._lens), jnp.asarray(gate),
         )
         # The step's ONE device->host pull: every active slot's next-token
         # logits arrive in a single [B, V] readback (sampling params can
@@ -1117,10 +1134,12 @@ class DecodeEngine:
         """One multi-token dispatch + host-side emission with rollback for
         slots that stop early (stop_token): their device lens/last_token are
         corrected back to what was actually consumed."""
+        gate = np.zeros((self.B,), bool)
+        gate[decode_slots] = True
         toks_dev, self._caches, _ = self._jit_decode_multi(
             self.params, self._lora, jnp.asarray(self._adapter_ids),
             jnp.asarray(self._last_token), self._caches,
-            jnp.asarray(self._lens), n=n,
+            jnp.asarray(self._lens), jnp.asarray(gate), n=n,
         )
         # The chunk's ONE device->host pull: n tokens x B slots per readback
         # (the whole point of multi-step decode).

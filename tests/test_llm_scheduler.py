@@ -223,39 +223,78 @@ def test_chunked_prefill_with_cached_prefix_token_identical(tiny_model):
         cached.shutdown()
 
 
+def _prompt_slot_kv(engine, prompt):
+    """Host copy of the prompt's KV rows [0, len(prompt)) on whichever slot
+    served it: [L, 2, len(prompt), Hkv, D]. Call only on an idle engine."""
+    slot = next(i for i, s in enumerate(engine._slots)
+                if s.history[: len(prompt)] == prompt)
+    n = len(prompt)
+    return np.stack([
+        np.stack([np.asarray(ck[slot, :n]), np.asarray(cv[slot, :n])])
+        for ck, cv in engine._caches
+    ])
+
+
 def test_long_prefill_does_not_stall_decode_integration(tiny_model):
-    """Integration starvation bound: tokens keep flowing on a running decode
-    while a long prompt prefills in chunks (the scheduler interleaves both
-    phases in the same iterations)."""
+    """Integration starvation bound AND interleaving correctness: tokens
+    keep flowing on a running decode while a long prompt prefills in chunks,
+    and BOTH streams emit exactly the tokens a whole-prompt (unchunked)
+    reference engine emits. A decode dispatch that writes an ungated KV row
+    into the mid-prefill slot (stale lens) corrupts the long prompt's cache
+    permanently — sequential token-identity tests can never catch that. One
+    corrupted row of ~110 may not flip a tiny model's argmax, so the
+    prompt's KV rows themselves are ALSO compared against the reference
+    (the decisive detector)."""
     from ray_tpu.llm import DecodeEngine, SamplingParams
 
     cfg, model, params = tiny_model
+    stream_prompt = [5, 9, 17]
+    long_prompt = list(map(
+        int, np.random.default_rng(0).integers(0, cfg.vocab_size, 110)))
+
+    ref = DecodeEngine(cfg, params, num_slots=2, max_seq=128,
+                       prefix_cache=False, token_budget=0)
     engine = DecodeEngine(cfg, params, num_slots=2, max_seq=128,
                           prefix_cache=False, token_budget=16, multi_step=1)
     try:
+        # Sequential, whole-prompt prefill: no interleaving anywhere.
+        expect_stream = _generate(ref, stream_prompt, 60)
+        expect_long = _generate(ref, long_prompt, 4)
+
         stream_done = threading.Event()
-        stream_count = [0]
+        stream_out = []
 
         def stream_cb(tok, fin):
-            stream_count[0] += 1
+            stream_out.append(tok)
             if fin:
                 stream_done.set()
 
-        engine.submit([5, 9, 17], SamplingParams(max_tokens=60), stream_cb)
-        while stream_count[0] < 5:          # the stream is decoding
+        engine.submit(stream_prompt, SamplingParams(max_tokens=60), stream_cb)
+        while len(stream_out) < 5:          # the stream is decoding
             assert engine.error is None
             threading.Event().wait(0.01)
-        long_prompt = list(map(
-            int, np.random.default_rng(0).integers(0, cfg.vocab_size, 110)))
         got = _generate(engine, long_prompt, 4)   # ~7 chunks at budget 16
-        assert len(got) == 4
+        assert got == expect_long, (
+            "interleaved decode corrupted the chunk-prefilling slot's KV"
+        )
         assert stream_done.wait(180)
-        assert stream_count[0] == 60
+        assert stream_out == expect_stream
         stats = engine.scheduler_stats()
         # the long prefill's chunks shared iterations with the live decode
         assert stats["interleaved_iterations"] >= 3, stats
         assert stats["prefill_chunks"] >= 7, stats
+        # Row-level corruption check: the interleaved engine's prompt KV
+        # must match the whole-prompt reference row for row (tolerance for
+        # the different prefill program shapes, decisive against a stray
+        # decode write replacing a row outright).
+        np.testing.assert_allclose(
+            _prompt_slot_kv(engine, long_prompt),
+            _prompt_slot_kv(ref, long_prompt),
+            atol=5e-2, rtol=0,
+            err_msg="interleaved decode dispatch wrote into prompt KV rows",
+        )
     finally:
+        ref.shutdown()
         engine.shutdown()
 
 
