@@ -138,15 +138,29 @@ class _ArenaHandle:
         return self._lib.shmstore_free_obj(self._handle(), object_id, 1 if eager else 0) == 0
 
     def read_pinned(self, object_id: bytes, offset: int, size: int) -> memoryview:
-        """A zero-copy view that PINS the object: the arena will not recycle the
-        payload while this view (or any memoryview/ndarray sliced from it) is
-        alive. The pin releases when the region object is garbage collected.
-        Raises KeyError if the object vanished (evicted/spilled) since the caller
-        resolved its location — callers re-resolve."""
+        """A view that PINS the object while it is being read. Zero-copy on
+        Python >= 3.12: the arena will not recycle the payload while the view
+        (or any memoryview/ndarray sliced from it) is alive, releasing when the
+        region object is garbage collected. On older Pythons memoryview() does
+        not honor a pure-Python __buffer__ (PEP 688 landed in 3.12), so a
+        zero-copy view cannot tie the pin to alias lifetime — fall back to
+        pin -> copy -> release, which is correct (no use-after-recycle) at the
+        cost of one copy. Raises KeyError if the object vanished
+        (evicted/spilled) since the caller resolved its location — callers
+        re-resolve."""
+        import sys
+
         if not self.pin(object_id):
             raise KeyError(object_id.hex())
-        region = _PinnedRegion(self, object_id, self._view.view[offset : offset + size])
-        return memoryview(region)
+        view = self._view.view[offset : offset + size]
+        if sys.version_info >= (3, 12):
+            region = _PinnedRegion(self, object_id, view)
+            return memoryview(region)
+        try:
+            data = bytes(view)
+        finally:
+            self.release(object_id)
+        return memoryview(data)
 
 
 class _PinnedRegion:

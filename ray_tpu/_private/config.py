@@ -61,6 +61,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "gcs_export_queue_size": (int, 1024, "bounded queue between the GCS loop and the export-event writer thread; overflow sheds oldest batches"),
     "gcs_store_fsync_window_s": (float, 0.01, "group-commit window: one fsync covers every GCS store append in the window (RAY_TPU_GCS_STORE_FSYNC picks the mode: always|group|off)"),
     "gcs_store_compact_threshold": (int, 50000, "rewrite the GCS append log once it holds this many records"),
+    "gcs_rpc_timeout_s": (float, 30.0, "total deadline for one GCS request across reconnect retries (exponential backoff + jitter); the control plane may restart under live clients, so this bounds how long a call rides through the outage before surfacing ConnectionLost"),
     "log_dedup_window_s": (float, 5.0, "repeat window for driver-side worker-log deduplication summaries"),
     "post_mortem": (bool, False, "park failing tasks at the raising frame for `ray_tpu debug` (reference: RAY_DEBUG_POST_MORTEM)"),
     "post_mortem_wait_s": (float, 120.0, "how long a parked task waits for a debugger before its error propagates"),

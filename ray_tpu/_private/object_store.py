@@ -474,6 +474,24 @@ class LocalObjectReader:
                 self._maps[shm_name] = shm
             return shm.buf[:size]
 
+    def write_view(self, shm_name: str, size: int) -> memoryview:
+        """WRITABLE raw view of a freshly-allocated (unsealed) object, for the
+        put path to fill in place. Distinct from read(): no pin is taken (an
+        unsealed allocation is never recycled under the writer) and no
+        read-copy fallback may substitute — the caller's writes must land in
+        the shared segment itself (read_pinned degrades to a copy on
+        Python < 3.12, which would silently discard writes)."""
+        with self._lock:
+            if shm_name.startswith("@"):
+                arena, off, sz, _key = self._parse(shm_name)
+                return self._arena(arena).read(off, min(size, sz))
+            shm = self._maps.get(shm_name)
+            if shm is None:
+                shm = _QuietSharedMemory(name=shm_name)
+                _untrack(shm)
+                self._maps[shm_name] = shm
+            return shm.buf[:size]
+
     def write(self, shm_name: str, data: bytes):
         with self._lock:
             if shm_name.startswith("@"):

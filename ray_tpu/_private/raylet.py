@@ -1751,7 +1751,11 @@ class Raylet:
                 window = max(1, CONFIG.pull_chunk_window)
                 reader = LocalObjectReader()
                 try:
-                    buf = reader.read(shm_name, size)
+                    # write_view, NOT read(): this buffer receives the pulled
+                    # chunks. read() takes a pinned READ view, which degrades
+                    # to a read-only copy on Python < 3.12 — writes would
+                    # TypeError (and silently vanish if they didn't).
+                    buf = reader.write_view(shm_name, size)
                     sem = asyncio.Semaphore(window)
 
                     async def fetch(off: int):

@@ -791,6 +791,7 @@ class CoreWorker:
         self.actor_runtime: _ActorRuntime | None = None
         self.actor_id: ActorID | None = None
         self._connected = False
+        self._gcs_reconnect_counter = None  # lazy util.metrics Counter
         self._task_events: list[dict] = []
         self._events_lock = threading.Lock()
         self._tls = threading.local()
@@ -895,13 +896,28 @@ class CoreWorker:
     def gcs_kv_get(self, ns: str, key: bytes):
         return self.gcs_call("kv_get", ns, key)
 
-    def gcs_call(self, method: str, *args, timeout: float | None = None):
+    def gcs_call(self, method: str, *args, timeout: float | None = None,
+                 deadline_s: float | None = None):
         """GCS request with transparent reconnect: the control plane may restart
-        under us (reference: GCS clients buffer and retry during GCS downtime)."""
-        deadline = time.monotonic() + 30.0
+        under us (reference: GCS clients buffer and retry during GCS downtime).
+
+        Reconnect attempts back off exponentially with jitter (a restarted GCS
+        sees a spread-out thundering herd, not a synchronized stampede) up to a
+        total deadline (`deadline_s`, default CONFIG.gcs_rpc_timeout_s), after
+        which ConnectionLost surfaces to the caller."""
+        import random as _random
+
+        deadline = time.monotonic() + (
+            deadline_s if deadline_s is not None else CONFIG.gcs_rpc_timeout_s
+        )
+        backoff = 0.05
+        reconnects = 0
         while True:
             try:
-                return self.io.run(self.gcs.call(method, *args), timeout)
+                result = self.io.run(self.gcs.call(method, *args), timeout)
+                if reconnects:
+                    self._note_gcs_reconnects(reconnects)
+                return result
             except rpc.ConnectionLost:
                 if not self._connected or time.monotonic() > deadline:
                     raise
@@ -910,8 +926,30 @@ class CoreWorker:
                         rpc.connect(*self.gcs_addr, handler=self,
                                     name=f"{self.mode}->gcs", via=self.proxy)
                     )
+                    reconnects += 1
                 except OSError:
-                    time.sleep(0.5)
+                    # Full jitter on the exponential step; never sleep past the
+                    # deadline (the final attempt should still get its shot).
+                    pause = backoff * (0.5 + _random.random())
+                    pause = min(pause, max(0.0, deadline - time.monotonic()))
+                    time.sleep(pause)
+                    backoff = min(backoff * 2.0, 2.0)
+
+    def _note_gcs_reconnects(self, n: int):
+        """Count successful GCS reconnections (`gcs_reconnect_total`). Called
+        only after the re-issued request succeeded, so the nested KV flush
+        inside the counter rides a healthy connection, never a retry loop."""
+        try:
+            if self._gcs_reconnect_counter is None:
+                from ray_tpu.util.metrics import Counter
+
+                self._gcs_reconnect_counter = Counter(
+                    "gcs_reconnect_total",
+                    "GCS client reconnections that recovered an in-flight call",
+                )
+            self._gcs_reconnect_counter.inc(n)
+        except Exception:
+            pass  # observability must never break the recovered call
 
     def raylet_call(self, method: str, *args, timeout: float | None = None):
         return self.io.run(self.raylet.call(method, *args), timeout)
@@ -1013,7 +1051,7 @@ class CoreWorker:
         ):
             return
         shm_name = self.raylet_call("store_create", object_id, total)
-        buf = self.reader.read(shm_name, total)
+        buf = self.reader.write_view(shm_name, total)
         serialization.write_parts(buf, pickled, raw_buffers)
         self.raylet_call("store_seal", object_id, total, owner)
 

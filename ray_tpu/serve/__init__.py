@@ -14,11 +14,16 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu
 from ray_tpu.serve._common import (
+    CONTROLLER_KV_NS,
     CONTROLLER_NAME,
     DEFAULT_APP_NAME,
+    REGISTRY_KEY,
     SERVE_NAMESPACE,
+    TARGET_STATE_KEY,
     AutoscalingConfig,
+    ControllerUnavailableError,
     DeploymentConfig,
+    DeploymentNotFoundError,
     Request,
 )
 from ray_tpu.serve.batching import batch
@@ -122,6 +127,11 @@ def _get_or_create_controller():
     controller = controller_cls.options(
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, get_if_exists=True,
         max_concurrency=1000,
+        # The control plane must outlive any single process: unlimited
+        # restarts + durable GCS KV state mean a SIGKILLed controller comes
+        # back, recovers its app table, and re-adopts live replicas
+        # (reference: the serve controller checkpoints to the GCS KV store).
+        max_restarts=-1,
     ).remote()
     controller.run_control_loop.remote()  # raylint: disable=RL501 (idempotent fire-and-forget loop start)
     return controller
@@ -279,6 +289,15 @@ def shutdown():
                 pass
     except Exception:
         pass
+    # Independent durable-state cleanup for the same reason: a wedged/dead
+    # controller must not leave KV state that resurrects the apps into the
+    # NEXT serve instance after an explicit shutdown.
+    try:
+        w = ray_tpu.global_worker()
+        for key in (TARGET_STATE_KEY, REGISTRY_KEY):
+            w.gcs_call("kv_del", CONTROLLER_KV_NS, key)
+    except Exception:
+        pass
     _proxy_state.clear()
 
 
@@ -350,9 +369,11 @@ def proxy_ports() -> Dict[str, int]:
 __all__ = [
     "Application",
     "AutoscalingConfig",
+    "ControllerUnavailableError",
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
+    "DeploymentNotFoundError",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
     "Request",

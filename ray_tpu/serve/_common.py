@@ -15,6 +15,28 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 DEFAULT_APP_NAME = "default"
 
+# GCS KV namespace holding the controller's durable control-plane state
+# (declarative target state + replica/proxy registry). A restarted controller
+# recovers from these keys and re-adopts still-live actors instead of
+# cold-starting (docs/fault_tolerance.md).
+CONTROLLER_KV_NS = "serve_ctrl"
+TARGET_STATE_KEY = b"target_state"
+REGISTRY_KEY = b"registry"
+
+
+class ControllerUnavailableError(ConnectionError):
+    """The serve controller (or the GCS under it) is down or restarting.
+
+    RETRYABLE: target state is durable and live replicas keep serving, so the
+    same call is expected to succeed once the control plane recovers. Handles
+    retry internally up to the recovery deadline before surfacing this."""
+
+
+class DeploymentNotFoundError(RuntimeError):
+    """The controller is reachable and the app/deployment does not exist
+    (deleted or never deployed). NOT retryable — distinguishes a dead route
+    from a controller that is merely restarting (ControllerUnavailableError)."""
+
 
 @dataclass
 class AutoscalingConfig:

@@ -15,9 +15,24 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.serve._common import (
+    CONTROLLER_KV_NS,
+    REGISTRY_KEY,
+    TARGET_STATE_KEY,
+)
+
 
 class ServeController:
-    """Async actor. One per cluster, named SERVE_CONTROLLER in the serve namespace."""
+    """Async actor. One per cluster, named SERVE_CONTROLLER in the serve namespace.
+
+    Durable control plane (docs/fault_tolerance.md): declarative target state
+    (app configs, deployment specs, autoscale targets, http options) and the
+    replica/proxy registry persist to GCS KV on every mutation. The actor runs
+    with max_restarts=-1; a restarted incarnation lazily recovers the persisted
+    state on its first method call, probes the registered actors, and RE-ADOPTS
+    the ones still alive — live replicas keep serving through a controller death
+    or a GCS restart, and reconciliation only replaces what actually died.
+    """
 
     def __init__(self):
         # app -> deployment -> spec dict (blobs + DeploymentConfig)
@@ -27,6 +42,13 @@ class ServeController:
         self._versions: Dict[str, int] = {}
         self._loop_started = False
         self._shutting_down = False
+        # Durable-state bookkeeping: recovery runs at most once per
+        # incarnation (lazily, on the first method call — __init__ runs off
+        # the actor's event loop and must not block on KV I/O).
+        self._recovered = False
+        self._recover_lock = asyncio.Lock()
+        self._state_dirty = False
+        self._registry_snapshot: Optional[tuple] = None
         # autoscale bookkeeping: (app, dep) -> last scale decision time
         self._last_scale: Dict[tuple, float] = {}
         # health bookkeeping OUTSIDE the spec dicts: redeploys must not reset a
@@ -44,6 +66,198 @@ class ServeController:
         self._proxy_lock = asyncio.Lock()
         self._mux_ids: Dict[str, dict] = {}  # "app#dep" -> {actor_id: [model ids]}
 
+    # -- durable control-plane state --------------------------------------
+    #
+    # Two KV records in CONTROLLER_KV_NS:
+    #   TARGET_STATE_KEY — declarative intent (apps/specs/configs/http options):
+    #     what the operator asked for; enough to rebuild everything from cold.
+    #   REGISTRY_KEY — the replica/proxy actor handles the previous incarnation
+    #     created: what exists RIGHT NOW, so recovery adopts live actors
+    #     instead of replacing them (replica processes hold warm compiled
+    #     models; a cold-start would drop every in-flight request).
+
+    @staticmethod
+    def _kv_io(fn):
+        """Run a blocking GCS KV op off the actor's event loop."""
+        loop = asyncio.get_running_loop()
+        return loop.run_in_executor(None, fn)
+
+    async def _ensure_recovered(self):
+        if self._recovered:
+            return
+        async with self._recover_lock:
+            if self._recovered:
+                return
+            await self._recover()
+            self._recovered = True
+        self._arm_control_loop()
+
+    def _arm_control_loop(self):
+        if not self._loop_started:
+            # Restarted incarnations get no run_control_loop call from a
+            # driver; the loop re-arms off whichever method call (proxy route
+            # refresh, handle routing, a redeploy) touched the controller.
+            asyncio.get_running_loop().create_task(self.run_control_loop())
+
+    async def _recover(self):
+        import cloudpickle
+
+        import ray_tpu
+        from ray_tpu.serve._common import async_get
+
+        w = ray_tpu.global_worker()
+        state_blob = await self._kv_io(
+            lambda: w.gcs_kv_get(CONTROLLER_KV_NS, TARGET_STATE_KEY)
+        )
+        if state_blob is None:
+            return  # fresh control plane: nothing persisted
+        state = cloudpickle.loads(state_blob)
+        self._apps = state.get("apps") or {}
+        self._http_options = state.get("http_options")
+        registry_blob = await self._kv_io(
+            lambda: w.gcs_kv_get(CONTROLLER_KV_NS, REGISTRY_KEY)
+        )
+        registry = cloudpickle.loads(registry_blob) if registry_blob else {}
+        self._versions = dict(registry.get("versions") or {})
+
+        # Probe every registered actor CONCURRENTLY; adopt the live ones.
+        async def probe(handle):
+            try:
+                await async_get(handle.ready.remote(), timeout=15)
+                return True
+            except Exception:
+                return False
+
+        candidates: List[tuple] = []  # (kind, app, dep_or_nid, handle, extra)
+        for app, deps in (registry.get("replicas") or {}).items():
+            for dep, handles in deps.items():
+                for h in handles:
+                    candidates.append(("replica", app, dep, h, None))
+        for nid, (h, port) in (registry.get("proxies") or {}).items():
+            candidates.append(("proxy", None, nid, h, port))
+        alive = await asyncio.gather(*(probe(c[3]) for c in candidates))
+        adopted = 0
+        for (kind, app, key, handle, extra), ok in zip(candidates, alive):
+            if not ok:
+                continue
+            adopted += 1
+            if kind == "replica":
+                self._replicas.setdefault(app, {}).setdefault(key, []).append(handle)
+                health = self._health.setdefault((app, key), {
+                    "healthy": set(), "created": {},
+                })
+                # Adopted replicas answered the probe: they are healthy NOW,
+                # so a later silence means death, not a startup grace period.
+                health["healthy"].add(handle._actor_id)
+                health["created"][handle._actor_id] = time.monotonic()
+            else:
+                self._proxies[key] = (handle, extra)
+        # Registry shrank to the adopted survivors: persist the pruned view and
+        # bump versions where the set changed so routers refetch.
+        for app, deps in (registry.get("replicas") or {}).items():
+            for dep, handles in deps.items():
+                if len(self._replicas.get(app, {}).get(dep, [])) != len(handles):
+                    self._bump(app, dep)
+        await self._persist_registry(force=True)
+        try:
+            from ray_tpu.util.metrics import Counter
+
+            Counter(
+                "controller_recoveries_total",
+                "control-plane recoveries from persisted state",
+                tag_keys=("plane",),
+            ).inc(1.0, tags={"plane": "serve"})
+        except Exception:
+            pass  # observability only: a metrics hiccup must not fail recovery
+
+    def _persistable_apps(self) -> dict:
+        """Deep-ish copy of the app table without transient reconcile keys."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for app, deps in self._apps.items():
+            out[app] = {}
+            for name, spec in deps.items():
+                if name == "__meta__":
+                    out[app][name] = dict(spec)
+                else:
+                    out[app][name] = {
+                        k: v for k, v in spec.items() if k != "_dead"
+                    }
+        return out
+
+    async def _persist_state(self):
+        import cloudpickle
+
+        import ray_tpu
+
+        blob = cloudpickle.dumps(
+            {"apps": self._persistable_apps(), "http_options": self._http_options}
+        )
+        w = ray_tpu.global_worker()
+        await self._kv_io(
+            lambda: w.gcs_kv_put(CONTROLLER_KV_NS, TARGET_STATE_KEY, blob)
+        )
+        self._state_dirty = False
+
+    def _registry_fingerprint(self) -> tuple:
+        return (
+            tuple(
+                (app, dep, tuple(sorted(r._actor_id.hex() for r in handles)))
+                for app, deps in sorted(self._replicas.items())
+                for dep, handles in sorted(deps.items())
+            ),
+            tuple(
+                (nid, h._actor_id.hex(), port)
+                for nid, (h, port) in sorted(self._proxies.items())
+            ),
+            tuple(sorted(self._versions.items())),
+        )
+
+    async def _persist_registry(self, force: bool = False):
+        fingerprint = self._registry_fingerprint()
+        if not force and fingerprint == self._registry_snapshot:
+            return
+        import cloudpickle
+
+        import ray_tpu
+
+        blob = cloudpickle.dumps({
+            "replicas": {
+                app: {dep: list(handles) for dep, handles in deps.items()}
+                for app, deps in self._replicas.items()
+            },
+            "proxies": dict(self._proxies),
+            "versions": dict(self._versions),
+        })
+        w = ray_tpu.global_worker()
+        await self._kv_io(lambda: w.gcs_kv_put(CONTROLLER_KV_NS, REGISTRY_KEY, blob))
+        self._registry_snapshot = fingerprint
+
+    async def _clear_persisted_state(self):
+        import ray_tpu
+
+        w = ray_tpu.global_worker()
+        for key in (TARGET_STATE_KEY, REGISTRY_KEY):
+            try:
+                await self._kv_io(
+                    lambda k=key: w.gcs_call("kv_del", CONTROLLER_KV_NS, k)
+                )
+            except Exception:
+                pass  # GCS down during teardown: stale keys are cleared by
+                # the driver-side serve.shutdown() fallback kv_del
+        self._registry_snapshot = None
+
+    async def health(self) -> dict:
+        """Liveness + identity probe (chaos tests SIGKILL the controller by
+        pid and wait for a new incarnation to answer from a different one)."""
+        import os
+
+        await self._ensure_recovered()
+        return {
+            "pid": os.getpid(),
+            "apps": sorted(self._apps),
+            "recovered": self._recovered,
+        }
+
     # -- proxies -----------------------------------------------------------
     async def ensure_proxies(self, http_options: Optional[dict] = None) -> int:
         """Arm per-node proxy management and return the head node's proxy port.
@@ -51,6 +265,7 @@ class ServeController:
         Explicit options always take effect: serve.run()/get_proxy_port() arm the
         defaults with {}, and a later serve.start(http_options={'port': N}) must
         not be silently ignored — a port change restarts the proxies."""
+        await self._ensure_recovered()
         # Option merge + port-change restart must happen under the same lock
         # as reconciliation: an in-flight reconcile may be about to register a
         # proxy started with the OLD port, and a kill/clear outside the lock
@@ -67,9 +282,12 @@ class ServeController:
                     for _nid, (handle, _port) in list(self._proxies.items()):
                         self._kill(handle)
                     self._proxies.clear()
+                await self._persist_state()
             elif self._http_options is None:
                 self._http_options = {}
+                await self._persist_state()
             await self._reconcile_proxies_locked()
+        await self._persist_registry()
         import ray_tpu
 
         head_hex = next(
@@ -80,6 +298,7 @@ class ServeController:
         return next(iter(self._proxies.values()))[1] if self._proxies else 0
 
     async def proxy_ports(self) -> Dict[str, int]:
+        await self._ensure_recovered()
         return {nid: port for nid, (_h, port) in self._proxies.items()}
 
     async def _reconcile_proxies(self):
@@ -130,6 +349,7 @@ class ServeController:
     async def deploy_app(self, app: str, deployments: Dict[str, dict],
                          route_prefix: Optional[str], ingress: str,
                          ingress_streaming: bool = False) -> bool:
+        await self._ensure_recovered()
         if route_prefix is not None:
             for other, deps in self._apps.items():
                 if other != app and deps.get("__meta__", {}).get("route_prefix") == route_prefix:
@@ -175,19 +395,33 @@ class ServeController:
         meta["route_prefix"] = route_prefix
         meta["ingress"] = ingress
         meta["ingress_streaming"] = ingress_streaming
+        # Persist intent BEFORE reconciling: if the controller dies mid-create,
+        # the next incarnation re-reads the full target and reconciles toward
+        # it (the registry then tells it which replicas already exist).
+        await self._persist_state()
         await self._reconcile_app(app)
+        await self._persist_registry()
         return True
 
     async def delete_app(self, app: str) -> bool:
+        await self._ensure_recovered()
         self._apps.pop(app, None)
+        await self._persist_state()
         for key in [k for k in self._mux_ids if k.startswith(f"{app}#")]:
             self._mux_ids.pop(key, None)
         for replicas in self._replicas.pop(app, {}).values():
             for r in replicas:
                 self._kill(r)
+        await self._persist_registry()
         return True
 
     async def shutdown_serve(self) -> bool:
+        # Best-effort recovery first so persisted-but-unloaded apps' replicas
+        # are found and killed too; a failed recovery must not block teardown.
+        try:
+            await self._ensure_recovered()
+        except Exception:
+            pass  # recovery needs the GCS; shutdown proceeds on memory state
         self._shutting_down = True
         for app in list(self._apps):
             await self.delete_app(app)
@@ -195,6 +429,9 @@ class ServeController:
             self._kill(handle)
         self._proxies.clear()
         self._http_options = None
+        # An explicit shutdown is the END of the serve instance: clear the
+        # durable state so the next controller starts cold by design.
+        await self._clear_persisted_state()
         return True
 
     def _kill(self, actor):
@@ -207,14 +444,19 @@ class ServeController:
 
     # -- routing tables ----------------------------------------------------
     async def get_replicas(self, app: str, deployment: str) -> dict:
+        await self._ensure_recovered()
         key = f"{app}#{deployment}"
         return {
             "version": self._versions.get(key, 0),
             "replicas": list(self._replicas.get(app, {}).get(deployment, [])),
             "multiplexed": dict(self._mux_ids.get(key, {})),
+            # Lets handles distinguish "app deleted" (stop retrying) from
+            # "replicas still starting / controller just recovered" (wait).
+            "exists": app in self._apps and deployment in self._apps.get(app, {}),
         }
 
     async def get_app_meta(self, app: str) -> Optional[dict]:
+        await self._ensure_recovered()
         if app not in self._apps:
             return None
         meta = self._apps[app].get("__meta__", {})
@@ -223,6 +465,7 @@ class ServeController:
                 "ingress_streaming": meta.get("ingress_streaming", False)}
 
     async def list_apps(self) -> dict:
+        await self._ensure_recovered()
         out = {}
         for app, deps in self._apps.items():
             meta = deps.get("__meta__", {})
@@ -247,6 +490,7 @@ class ServeController:
         import ray_tpu
         from ray_tpu.serve._common import async_get
 
+        await self._ensure_recovered()
         deps = self._apps.get(app)
         if deps is None:
             return False
@@ -320,7 +564,16 @@ class ServeController:
         self._loop_started = True
         while not self._shutting_down:
             try:
+                # Recovery first (idempotent): the loop may be the only caller
+                # on a restarted controller. A GCS outage makes _step raise
+                # ConnectionLost after the rpc deadline — caught here, retried
+                # next tick; live replicas keep serving off routers' cached
+                # tables in the meantime.
+                await self._ensure_recovered()
                 await self._step()
+                if self._state_dirty:
+                    await self._persist_state()
+                await self._persist_registry()
             except Exception:
                 traceback.print_exc()
             from ray_tpu._private.config import CONFIG
@@ -408,6 +661,8 @@ class ServeController:
         if desired > current and now - last >= cfg.upscale_delay_s:
             spec["_autoscale_target"] = desired
             self._last_scale[key] = now
+            self._state_dirty = True  # autoscale target is declarative state
         elif desired < current and now - last >= cfg.downscale_delay_s:
             spec["_autoscale_target"] = current - 1  # scale down gently
             self._last_scale[key] = now
+            self._state_dirty = True

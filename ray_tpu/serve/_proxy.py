@@ -81,10 +81,17 @@ class HTTPProxy:
         from ray_tpu.serve._common import async_get
         from ray_tpu.serve.handle import DeploymentHandle
 
+        # Cached controller handle: by-name lookup needs the GCS, but calls on
+        # a resolved handle ride direct connections — route updates keep
+        # flowing through a GCS outage. Cleared on failure to re-resolve.
+        controller = None
         while True:
             try:
-                controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
-                apps = await async_get(controller.list_apps.remote())
+                if controller is None:
+                    controller = ray_tpu.get_actor(
+                        CONTROLLER_NAME, namespace=SERVE_NAMESPACE
+                    )
+                apps = await async_get(controller.list_apps.remote(), timeout=15)
                 routes = {}
                 streaming = {}
                 for app, meta in apps.items():
@@ -96,7 +103,8 @@ class HTTPProxy:
                 self._routes = routes
                 self._streaming = streaming
             except Exception:
-                pass  # controller briefly unreachable: serve the last-known routes
+                controller = None  # controller briefly unreachable: serve the
+                pass               # last-known routes, re-resolve next pass
             await asyncio.sleep(0.5)
 
     async def _handle_conn(self, reader: asyncio.StreamReader,

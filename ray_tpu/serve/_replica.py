@@ -194,12 +194,18 @@ class Replica:
             self._ongoing -= 1
 
     async def get_stats(self) -> dict:
+        import os
+
         from ray_tpu.serve.multiplex import loaded_model_ids
 
         return {
             "ongoing": self._ongoing,
             "total": self._total,
             "multiplexed_ids": loaded_model_ids(self._instance),
+            # Process identity: chaos/recovery tests assert a recovered
+            # controller RE-ADOPTED live replicas (same pids) instead of
+            # restarting them.
+            "pid": os.getpid(),
         }
 
     async def ready(self) -> bool:

@@ -16,7 +16,12 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE
+from ray_tpu.serve._common import (
+    CONTROLLER_NAME,
+    SERVE_NAMESPACE,
+    ControllerUnavailableError,
+    DeploymentNotFoundError,
+)
 
 
 class DeploymentResponse:
@@ -135,12 +140,23 @@ class _Router:
 
         return CONFIG.serve_router_cache_ttl_s
 
+    @property
+    def _RECOVERY_DEADLINE_S(self) -> float:
+        # The window a routing call rides through control-plane downtime
+        # (controller SIGKILL + restart, GCS restart) before surfacing a typed
+        # ControllerUnavailableError. Matches the GCS client's own rpc window.
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.gcs_rpc_timeout_s
+
     def __init__(self, app: str, deployment: str):
         self._app = app
         self._deployment = deployment
         self._replicas: List = []
+        self._exists = True  # False only on a DEFINITIVE "app deleted" answer
         self._version = -1
         self._fetched_at = 0.0
+        self._controller_handle = None
         self._inflight: Dict[Any, int] = {}
         # Multiplexing: cluster-wide replica-reported model ids (refreshed with
         # the routing table — reference routes on replica-reported ids) plus a
@@ -150,16 +166,41 @@ class _Router:
         self._lock = threading.Lock()
 
     def _controller(self):
-        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        # Cached handle: the by-name lookup needs the GCS, but calls on a
+        # resolved handle ride direct connections — so a router that has EVER
+        # reached the controller keeps refreshing its table straight through a
+        # GCS outage (and through controller restarts, which keep the actor
+        # id). Cleared on call failure to force re-resolution.
+        if self._controller_handle is None:
+            self._controller_handle = ray_tpu.get_actor(
+                CONTROLLER_NAME, namespace=SERVE_NAMESPACE
+            )
+        return self._controller_handle
 
     def _refresh(self, force: bool = False):
+        """Refresh the routing table, serving STALE on control-plane downtime.
+
+        The controller restarting (or the GCS under it) must not fail calls
+        that live replicas can still serve: a refresh error with a cached
+        replica set keeps the cache (stale-while-error) and retries after one
+        TTL. Only a caller with NO table to fall back on sees the error."""
         now = time.monotonic()
         if not force and self._replicas and now - self._fetched_at < self._CACHE_TTL_S:
             return
-        info = ray_tpu.get(
-            self._controller().get_replicas.remote(self._app, self._deployment)
-        )
+        try:
+            info = ray_tpu.get(
+                self._controller().get_replicas.remote(self._app, self._deployment),
+                timeout=5.0,
+            )
+        except Exception:
+            self._controller_handle = None  # re-resolve by name next attempt
+            if self._replicas:
+                with self._lock:
+                    self._fetched_at = now  # back off one TTL, keep serving stale
+                return
+            raise
         with self._lock:
+            self._exists = bool(info.get("exists", True))
             self._version = info["version"]
             self._replicas = info["replicas"]
             self._mux = info.get("multiplexed") or {}
@@ -169,15 +210,39 @@ class _Router:
             }
 
     def pick(self, model_id: str = ""):
-        self._refresh()
-        deadline = time.monotonic() + 30
-        while not self._replicas:
+        deadline = time.monotonic() + self._RECOVERY_DEADLINE_S
+        delay = 0.05
+        last_err: Optional[Exception] = None
+        force = False
+        while True:
+            try:
+                self._refresh(force=force)
+                last_err = None
+            except Exception as e:  # controller unreachable and no cache
+                last_err = e
+            if last_err is None and not self._exists:
+                raise DeploymentNotFoundError(
+                    f"deployment {self._app}#{self._deployment} does not exist "
+                    f"(app deleted or never deployed)"
+                )
+            if self._replicas:
+                break
             if time.monotonic() > deadline:
+                if last_err is not None:
+                    raise ControllerUnavailableError(
+                        f"serve controller unreachable for "
+                        f"{self._RECOVERY_DEADLINE_S:.0f}s while routing "
+                        f"{self._app}#{self._deployment}; retry once the "
+                        f"control plane recovers"
+                    ) from last_err
                 raise RuntimeError(
                     f"no replicas for deployment {self._app}#{self._deployment}"
                 )
-            time.sleep(0.05)
-            self._refresh(force=True)
+            # Exponential backoff + jitter: a fleet of handles re-resolving a
+            # restarted controller must not stampede it.
+            time.sleep(delay * (0.5 + random.random()))
+            delay = min(delay * 2.0, 1.0)
+            force = True
         with self._lock:
             if model_id:
                 # Cluster-wide affinity first: any replica REPORTING the model

@@ -42,6 +42,7 @@ class TrainController:
         datasets: dict | None = None,
         poll_interval_s: float = 0.2,
         trial_info: dict | None = None,
+        resume_from_storage: bool = False,
     ):
         self._train_fn = train_fn
         self._train_fn_config = train_fn_config
@@ -67,12 +68,19 @@ class TrainController:
         self._latest_metrics: dict | None = None
         self._experiment_name = run_config.name or f"train_{int(time.time())}"
         self._storage_path = os.path.expanduser(run_config.storage_path)
+        # A RESTARTED detached controller (not a fresh fit with a reused name)
+        # resumes from the latest committed checkpoint on storage instead of
+        # restarting the run from scratch.
+        self._resume_from_storage = resume_from_storage
 
     # ------------------------------------------------------------------ run
 
     def run(self) -> Result:
         failure_count = 0
+        transient_restarts = 0
         attempt = 0
+        if self._resume_from_storage:
+            self._recover_committed_checkpoints()
         while True:
             group = None
             try:
@@ -94,8 +102,19 @@ class TrainController:
                     group.shutdown()
             if error is None:
                 return self._build_result(error=None)
-            failure_count += 1
             attempt += 1
+            from ray_tpu.train._internal.failure_policy import (
+                is_transient_infra_error,
+            )
+
+            if is_transient_infra_error(error) and transient_restarts < 3:
+                # Control-plane outage, not a training failure: the workers
+                # may even still be running. Restart from the latest committed
+                # checkpoint WITHOUT burning the user's failure budget
+                # (bounded so a permanently-broken fabric still surfaces).
+                transient_restarts += 1
+                continue
+            failure_count += 1
             decision = self._failure_policy.make_decision(failure_count, error)
             if decision is FailureDecision.RAISE:
                 return self._build_result(
@@ -166,6 +185,48 @@ class TrainController:
             if int(m.group(1)) > highest or is_partial(full):
                 shutil.rmtree(full, ignore_errors=True)
 
+    def _recover_committed_checkpoints(self):
+        """Re-learn COMMITTED checkpoints from storage after a controller
+        restart (the in-memory CheckpointManager died with the old process).
+
+        Only committed dirs are registered — a partial sharded save (the crash
+        beat its async commit) is garbage by definition and stays invisible,
+        so the first attempt resumes from the newest state that actually
+        persisted. Metrics are unknown ({}): retention scoring treats the
+        recovered entries as worst-ranked, but the resume point is index-based
+        and retention never deletes it."""
+        import re
+
+        from ray_tpu.checkpoint import is_partial
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        exp_dir = os.path.join(self._storage_path, self._experiment_name)
+        if not os.path.isdir(exp_dir):
+            return
+        recovered = 0
+        for entry in sorted(os.listdir(exp_dir)):
+            m = re.fullmatch(r"checkpoint_(\d+)", entry)
+            if m is None:
+                continue
+            full = os.path.join(exp_dir, entry)
+            if is_partial(full):
+                continue
+            self._checkpoints.register(
+                int(m.group(1)), Checkpoint(full), {}, rank=0
+            )
+            recovered += 1
+        if recovered:
+            try:
+                from ray_tpu.util.metrics import Counter
+
+                Counter(
+                    "controller_recoveries_total",
+                    "control-plane recoveries from persisted state",
+                    tag_keys=("plane",),
+                ).inc(1.0, tags={"plane": "train"})
+            except Exception:
+                pass
+
     def _split_datasets(self, world_size: int) -> list[dict] | None:
         if not self._datasets:
             return None
@@ -180,9 +241,34 @@ class TrainController:
         return shards
 
     def _monitor(self, group: WorkerGroup) -> str | None:
-        """Poll until every worker finishes or one errors. Returns error text or None."""
+        """Poll until every worker finishes or one errors. Returns error text or None.
+
+        Transient control-plane unavailability (a GCS restart under a live
+        run) must NOT read as worker death: the workers keep training on their
+        raylets regardless. Poll failures that classify as transient are
+        retried inside a grace window; only a window of CONSECUTIVE transient
+        failures — or a definitive ActorDiedError — escapes to the failure
+        policy."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.train._internal.failure_policy import is_transient_infra_error
+
+        transient_deadline: float | None = None
         while True:
-            statuses = group.poll()
+            try:
+                statuses = group.poll()
+            except Exception as e:
+                if not is_transient_infra_error(e):
+                    raise
+                now = time.monotonic()
+                if transient_deadline is None:
+                    transient_deadline = now + 2.0 * CONFIG.gcs_rpc_timeout_s
+                if now > transient_deadline:
+                    import traceback as _tb
+
+                    return "".join(_tb.format_exception(e))
+                time.sleep(self._poll_interval_s)
+                continue
+            transient_deadline = None
             for status in statuses:
                 for result in status.results:
                     self._ingest_result(result)
@@ -224,24 +310,59 @@ class DetachedControllerRunner:
     result harvest, the finished actor persists; the NEXT fit() with the same run
     name harvests that earlier run's Result (and frees the name) instead of
     training — run names identify experiments, reuse them only for re-attach.
+
+    Restart recovery: the actor runs with max_restarts=-1 and writes a
+    run-in-progress marker to GCS KV when the run starts. A restarted
+    incarnation (its __init__ finds the marker) knows it is resuming an
+    interrupted run — it re-learns committed checkpoints from storage and the
+    next attempt continues from the newest one instead of from scratch. The
+    marker is deleted when the driver harvests the Result.
     """
 
-    def __init__(self, kwargs_blob: bytes):
+    KV_NS = "train_ctrl"
+
+    def __init__(self, kwargs_blob: bytes, run_name: str = ""):
         import cloudpickle
         import threading
 
-        self._controller = TrainController(**cloudpickle.loads(kwargs_blob))
+        self._run_name = run_name
+        resume = False
+        if run_name:
+            try:
+                import ray_tpu
+
+                marker = ray_tpu.global_worker().gcs_kv_get(
+                    self.KV_NS, self._marker_key()
+                )
+                resume = marker is not None
+            except Exception:
+                resume = False  # GCS briefly unreachable: treat as fresh
+        self._controller = TrainController(
+            **cloudpickle.loads(kwargs_blob), resume_from_storage=resume
+        )
         self._result: Result | None = None
         self._run_error: str | None = None
         self._started = False
         self._start_lock = threading.Lock()
         self._done = threading.Event()
 
+    def _marker_key(self) -> bytes:
+        return f"run:{self._run_name}".encode()
+
     def start(self) -> bool:
         with self._start_lock:  # concurrent attachers must not double-start
             if self._started:
                 return False  # already running (re-attach)
             self._started = True
+        if self._run_name:
+            try:
+                import ray_tpu
+
+                ray_tpu.global_worker().gcs_kv_put(
+                    self.KV_NS, self._marker_key(), b"1"
+                )
+            except Exception:
+                pass  # marker is best-effort: losing it only costs auto-resume
         import threading
 
         def run():
@@ -257,15 +378,35 @@ class DetachedControllerRunner:
         threading.Thread(target=run, daemon=True, name="train-controller").start()
         return True
 
+    def clear_marker(self) -> bool:
+        if self._run_name:
+            try:
+                import ray_tpu
+
+                ray_tpu.global_worker().gcs_call(
+                    "kv_del", self.KV_NS, self._marker_key()
+                )
+            except Exception:
+                return False
+        return True
+
     def is_done(self) -> bool:
+        # Auto-start on a restarted incarnation: the original driver called
+        # start() once and now only polls — without this, a controller that
+        # died mid-run would sit idle forever after its restart.
+        if not self._started:
+            self.start()
         return self._done.is_set()
 
     def status(self) -> dict:
         """Run summary for the dashboard's train view (reference: the train
         dashboard module reads run state from the controller)."""
+        import os
+
         c = self._controller
         return {
             "experiment_name": c._experiment_name,
+            "pid": os.getpid(),  # chaos tests SIGKILL the controller by pid
             "started": self._started,
             "done": self._done.is_set(),
             "num_workers": getattr(c._scaling, "num_workers", None),
@@ -293,22 +434,46 @@ def run_controller_detached(kwargs: dict, run_name: str, poll_interval_s: float 
         namespace="_train",
         get_if_exists=True,
         max_concurrency=8,
-    ).remote(blob)
+        # The run must survive the controller process: a SIGKILLed controller
+        # restarts, detects its run-in-progress marker, and resumes from the
+        # latest committed checkpoint (docs/fault_tolerance.md).
+        max_restarts=-1,
+    ).remote(blob, run_name)
     ray_tpu.get(actor.start.remote())
+    from ray_tpu._private import rpc as _rpc
+
     while True:
-        # Transient slowness (loaded node, GCS restart) must not abort the poll:
-        # killing a live run over a slow reply would defeat detaching. Only a
-        # dead CONTROLLER (ActorDiedError from the get) escapes the loop.
+        # Transient slowness (loaded node, GCS restart) must not abort the
+        # poll: killing a live run over a slow reply — or over a control-plane
+        # hiccup — would defeat detaching. A restarting controller resolves
+        # through wait_actor_alive; only repeated hard failures escape.
         try:
             if ray_tpu.get(actor.is_done.remote(), timeout=60):
                 break
-        except ray_tpu.exceptions.GetTimeoutError:
+        except (ray_tpu.exceptions.GetTimeoutError, _rpc.ConnectionLost,
+                ray_tpu.exceptions.ActorUnavailableError):
             continue
+        except ray_tpu.exceptions.ActorDiedError:
+            # max_restarts=-1: a died-but-restartable controller surfaces here
+            # only in the narrow window before the restart schedules. Give it
+            # a beat and re-poll; a permanently dead actor (cluster teardown)
+            # keeps raising and eventually surfaces via result_blob below.
+            time.sleep(1.0)
+            try:
+                ray_tpu.get_actor(f"TRAIN_CONTROLLER:{run_name}", namespace="_train")
+                continue
+            except Exception:
+                raise
         time.sleep(poll_interval_s)
     result, run_error = cloudpickle.loads(ray_tpu.get(actor.result_blob.remote()))
-    # The run is complete and its Result is in hand: release the actor so the
-    # name can be reused. A driver killed mid-poll never reaches this, leaving
-    # the controller alive — that is the point of detaching.
+    # The run is complete and its Result is in hand: clear the resume marker
+    # and release the actor so the name can be reused. A driver killed
+    # mid-poll never reaches this, leaving the controller alive — that is the
+    # point of detaching.
+    try:
+        ray_tpu.get(actor.clear_marker.remote(), timeout=15)
+    except Exception:
+        pass
     try:
         ray_tpu.kill(actor)
     except Exception:

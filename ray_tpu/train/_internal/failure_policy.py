@@ -17,6 +17,45 @@ class FailureDecision(enum.Enum):
     RAISE = "RAISE"
 
 
+# Error shapes that mean the CONTROL PLANE (GCS / RPC fabric) hiccuped, not
+# that training failed: worker processes keep running through a GCS restart,
+# so the monitor must ride these out instead of declaring the gang dead and
+# burning the user's failure budget (reference: GCS clients buffer+retry
+# through GCS downtime; workers are only dead when their raylet says so).
+_TRANSIENT_MARKERS = (
+    "ConnectionLost",
+    "GetTimeoutError",
+    "ActorUnavailableError",
+    "gcs unavailable",
+    "connection lost",
+)
+
+
+def is_transient_infra_error(error) -> bool:
+    """True when an exception (or formatted error text) looks like transient
+    control-plane unavailability rather than a real training/worker failure.
+    ActorDiedError is explicitly NOT transient: the raylet confirmed death."""
+    if isinstance(error, BaseException):
+        from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+
+        if isinstance(error, ActorDiedError):
+            return False
+        if isinstance(error, GetTimeoutError):
+            return True
+        try:
+            from ray_tpu._private import rpc
+
+            if isinstance(error, rpc.ConnectionLost):
+                return True
+        except Exception:
+            pass
+        error = f"{type(error).__name__}: {error}"
+    text = str(error)
+    if "ActorDiedError" in text:
+        return False
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
 class FailurePolicy:
     def make_decision(self, failure_count: int, error: str) -> FailureDecision:
         raise NotImplementedError

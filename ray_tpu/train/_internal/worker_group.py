@@ -251,10 +251,18 @@ class WorkerGroup:
             timeout=60.0,
         )
 
-    def poll(self) -> list[WorkerStatus]:
+    def poll(self, timeout_s: float = 60.0) -> list[WorkerStatus]:
+        """One health/result sweep over the gang.
+
+        Error contract for the controller's monitor loop: a raised
+        GetTimeoutError / ConnectionLost here means the CONTROL PLANE is slow
+        or down (workers submit over direct connections and keep training
+        through a GCS restart) and is retried under a grace window; an
+        ActorDiedError means a worker's raylet confirmed its death and routes
+        to the failure policy immediately."""
         out = []
         replies = ray_tpu.get(
-            [w.poll.remote() for w in self.sorted_workers], timeout=60.0
+            [w.poll.remote() for w in self.sorted_workers], timeout=timeout_s
         )
         for rank, r in enumerate(replies):
             out.append(WorkerStatus(rank, r["state"], r["results"], r["error"]))

@@ -21,6 +21,14 @@ import pytest  # noqa: E402
 
 import ray_tpu  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/stress variants excluded from the tier-1 "
+        "wall-clock budget (tier-1 runs -m 'not slow')",
+    )
+
 _WORKER_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",

@@ -322,3 +322,332 @@ def test_elastic_trainer_survives_node_kill_and_reexpands(tmp_path):
         assert result.metrics["world"] == 2
     finally:
         cluster.shutdown()
+
+
+# ------------------------------------------------------- control-plane chaos
+#
+# Reference shape: python/ray/tests/chaos/ also kills the HEAD services under
+# live workloads. The contract here (docs/fault_tolerance.md): the GCS and the
+# serve/train controllers are restartable without dropping live work — data
+# plane traffic rides cached handles and direct connections, control state
+# recovers from the persistent store / GCS KV.
+
+
+def test_serve_traffic_rides_through_gcs_kill():
+    """SIGKILL the GCS under a deployed serve app with live HTTP traffic:
+    zero replica processes die, traffic keeps flowing during the outage
+    (routers and proxies ride cached handles + direct connections), and after
+    the GCS restarts responses are identical to pre-kill responses for the
+    same prompts."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    try:
+        cluster.connect()
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def pid(self):
+                return os.getpid()
+
+            def __call__(self, request):
+                p = request.query_params.get("p", "")
+                return {"out": f"{p}::{len(p)}"}
+
+        serve.run(Echo.bind(), name="gcs-chaos", route_prefix="/")
+        port = serve.get_proxy_port()
+
+        def ask(p, timeout=10):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/?p={p}", timeout=timeout
+            ) as r:
+                return json.loads(r.read())["out"]
+
+        prompts = [f"prompt-{i}" for i in range(4)]
+        baseline = {p: ask(p) for p in prompts}
+        pid_handle = serve.DeploymentHandle("gcs-chaos", "Echo", "pid")
+        pids_before = sorted(pid_handle.broadcast())
+        assert len(pids_before) == 2
+
+        ok_during: list = []
+        errors: list = []
+        halt = threading.Event()
+
+        def traffic():
+            i = 0
+            while not halt.is_set():
+                p = prompts[i % len(prompts)]
+                i += 1
+                try:
+                    ok_during.append((p, ask(p, timeout=5)))
+                except Exception as e:  # noqa: BLE001 - tallied, asserted below
+                    errors.append(repr(e))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)  # warm: routes cached, direct connections established
+        n_before_kill = len(ok_during)
+        cluster.head.kill_gcs()
+        time.sleep(3.0)  # the GCS is DOWN for this whole window
+        n_during_kill = len(ok_during)
+        cluster.head.restart_gcs()
+        # Raylets re-register; the driver's gcs_call reconnects with backoff.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if [n for n in ray_tpu.nodes() if n["alive"]]:
+                    break
+            except Exception:
+                time.sleep(0.5)
+        time.sleep(1.0)
+        halt.set()
+        t.join(timeout=30)
+
+        # Traffic flowed WHILE the GCS was down, not just after recovery.
+        assert n_during_kill - n_before_kill >= 10, (
+            f"only {n_during_kill - n_before_kill} requests succeeded during "
+            f"the outage ({len(errors)} errors: {errors[:3]})"
+        )
+        # Every response that succeeded — before, during, after — is correct.
+        for p, out in ok_during:
+            assert out == baseline[p], f"divergent response for {p!r}"
+        # Post-recovery responses are token-identical to pre-kill responses.
+        post = {p: ask(p, timeout=30) for p in prompts}
+        assert post == baseline
+        # Zero replica processes died across the GCS restart.
+        pids_after = sorted(pid_handle.broadcast())
+        assert pids_after == pids_before
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def test_serve_controller_sigkill_recovers_and_adopts(chaos_cluster):
+    """SIGKILL the serve controller under a deployed app: calls keep serving
+    off cached routing tables, a new incarnation recovers the app table from
+    GCS KV, RE-ADOPTS the live replicas (same pids, same count — no
+    double-create), and a replayed deploy of the same app is a no-op."""
+    from ray_tpu import serve
+    from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    @serve.deployment(num_replicas=2)
+    class Stable:
+        def pid(self):
+            return os.getpid()
+
+        def __call__(self, x):
+            return x * 3
+
+    handle = serve.run(Stable.bind(), name="ctrl-chaos", route_prefix=None)
+    assert handle.remote(7).result(timeout_s=60) == 21
+    pid_handle = serve.DeploymentHandle("ctrl-chaos", "Stable", "pid")
+    pids_before = sorted(pid_handle.broadcast())
+    assert len(pids_before) == 2
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    ctrl_pid = ray_tpu.get(controller.health.remote(), timeout=30)["pid"]
+    os.kill(ctrl_pid, signal.SIGKILL)
+
+    # Live replicas keep serving through the controller outage: the router's
+    # cached table needs no controller round-trip.
+    assert handle.remote(9).result(timeout_s=60) == 27
+
+    # A new incarnation restarts (max_restarts=-1) and answers from a new pid.
+    deadline = time.monotonic() + 90
+    new_pid = None
+    while time.monotonic() < deadline:
+        try:
+            h = ray_tpu.get(controller.health.remote(), timeout=10)
+            if h["pid"] != ctrl_pid:
+                new_pid = h["pid"]
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert new_pid is not None, "controller never restarted"
+
+    # The app table recovered from GCS KV...
+    status = serve.status()
+    assert "ctrl-chaos" in status
+    # ...and the live replicas were ADOPTED, not restarted (same pids) and not
+    # double-created (same count).
+    info = ray_tpu.get(
+        controller.get_replicas.remote("ctrl-chaos", "Stable"), timeout=60
+    )
+    assert len(info["replicas"]) == 2
+    assert info["exists"]
+    pids_after = sorted(pid_handle.broadcast())
+    assert pids_after == pids_before, "recovery restarted live replicas"
+
+    # Replayed deploy_app of the identical app (the checkpoint-idempotency
+    # contract, mirroring the GCS bundle-reservation replay guard): replicas
+    # stay in place.
+    serve.run(Stable.bind(), name="ctrl-chaos", route_prefix=None)
+    assert sorted(pid_handle.broadcast()) == pids_before
+    assert handle.remote(5).result(timeout_s=60) == 15
+    serve.shutdown()
+
+
+def test_train_run_rides_through_gcs_kill(tmp_path):
+    """SIGKILL the GCS mid-train: workers keep stepping on their raylets, the
+    (detached) controller's monitor loop tolerates the control-plane outage
+    instead of declaring workers dead, and the run completes with a result
+    bitwise-equal to an undisturbed run."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    marker = str(tmp_path / "mid_run")
+    try:
+        cluster.connect()
+
+        def loop(config):
+            import os as _os
+
+            from ray_tpu import train as _train
+
+            total = 0.0
+            for step in range(30):
+                total += float((step * 7 + 3) % 11) * 0.5
+                if step == 3:
+                    open(config["marker"], "w").write("x")
+                time.sleep(0.25)
+                _train.report({"step": step, "total": total})
+
+        result_box = {}
+
+        def fit():
+            result_box["result"] = DataParallelTrainer(
+                loop,
+                train_loop_config={"marker": marker},
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(
+                    name="gcs-chaos-train", storage_path=str(tmp_path / "storage")
+                ),
+            ).fit()
+
+        t = threading.Thread(target=fit, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.1)
+        assert os.path.exists(marker), "run never reached mid-flight"
+
+        cluster.head.kill_gcs()
+        time.sleep(2.0)  # several training steps happen with the GCS DOWN
+        cluster.head.restart_gcs()
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "trainer did not finish after GCS chaos"
+        result = result_box["result"]
+        assert result.error is None, result.error
+        expected = 0.0
+        for step in range(30):
+            expected += float((step * 7 + 3) % 11) * 0.5
+        # Bitwise-equal to an undisturbed run: same float accumulation order.
+        assert result.metrics["total"] == expected
+        assert result.metrics["step"] == 29
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_detached_train_controller_sigkill_resumes_from_checkpoint(
+    chaos_cluster, tmp_path
+):
+    """SIGKILL the detached train controller mid-run: a new incarnation
+    detects its run-in-progress marker, recovers COMMITTED sharded
+    checkpoints from storage, and resumes the run from the newest one instead
+    of restarting from scratch."""
+    import numpy as np
+
+    import ray_tpu.checkpoint as ckpt
+    from ray_tpu.train import (
+        DataParallelTrainer,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    storage = str(tmp_path / "storage")
+    attempts = str(tmp_path / "attempts")
+    os.makedirs(attempts, exist_ok=True)
+
+    def loop(config):
+        import os as _os
+
+        import numpy as _np
+
+        from ray_tpu import train as _train
+
+        start = 0
+        prev = _train.get_checkpoint()
+        if prev is not None:
+            start = int(prev.to_pytree()["step"]) + 1
+        open(_os.path.join(config["attempts"], f"start_{start}"), "w").write("x")
+        import jax.numpy as _jnp
+
+        for step in range(start, 6):
+            _train.report(
+                {"step": step, "resumed_from": start},
+                checkpoint=ckpt.ShardedState(
+                    {"step": _np.int64(step), "w": _jnp.full((4,), float(step))}
+                ),
+            )
+            if step == 3 and start == 0:
+                # First attempt parks here until the controller is killed.
+                time.sleep(600)
+
+    result_box = {}
+
+    def fit():
+        result_box["result"] = DataParallelTrainer(
+            loop,
+            train_loop_config={"attempts": attempts},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="ctrl-kill-train", storage_path=storage,
+                failure_config=FailureConfig(max_failures=2),
+            ),
+        ).fit()
+
+    t = threading.Thread(target=fit, daemon=True)
+    t.start()
+
+    # Wait for the first attempt to reach step 3 with checkpoint_3 COMMITTED.
+    manifest = os.path.join(storage, "ctrl-kill-train", "checkpoint_000003",
+                            "MANIFEST.json")
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline and not os.path.exists(manifest):
+        time.sleep(0.2)
+    assert os.path.exists(manifest), "checkpoint_3 never committed"
+
+    runner = ray_tpu.get_actor("TRAIN_CONTROLLER:ctrl-kill-train",
+                               namespace="_train")
+    ctrl_pid = ray_tpu.get(runner.status.remote(), timeout=30)["pid"]
+    os.kill(ctrl_pid, signal.SIGKILL)
+
+    t.join(timeout=300)
+    assert not t.is_alive(), "driver never got a result after controller kill"
+    result = result_box["result"]
+    assert result.error is None, result.error
+    # The resumed attempt started from the latest committed checkpoint, not 0.
+    assert result.metrics["resumed_from"] >= 1
+    assert result.metrics["step"] == 5
+    starts = sorted(os.listdir(attempts))
+    assert "start_0" in starts
+    assert any(s != "start_0" for s in starts), "run never resumed"
+    tree = result.checkpoint.to_pytree()
+    np.testing.assert_array_equal(np.asarray(tree["w"]), np.full((4,), 5.0))

@@ -21,14 +21,13 @@ def _wait_for(pred, timeout=30.0, interval=0.2):
     return False
 
 
-def test_file_store_roundtrip_and_compaction(tmp_path):
+def test_file_store_roundtrip(tmp_path):
     store = FileStoreClient(str(tmp_path / "s"))
     store.load()
     for i in range(100):
         store.put("t", f"k{i}", {"v": i})
     store.delete("t", "k0")
     store.put("kv", ("ns", b"key"), b"value")
-    store._compact_locked = store._compact_locked  # exercised implicitly below
     store.close()
 
     store2 = FileStoreClient(str(tmp_path / "s"))
@@ -38,6 +37,52 @@ def test_file_store_roundtrip_and_compaction(tmp_path):
     assert store2.get("kv", ("ns", b"key")) == b"value"
     assert len(store2.keys("t")) == 99
     store2.close()
+
+
+def test_file_store_compaction_shrinks_log_and_reloads(tmp_path, monkeypatch):
+    """Crossing _COMPACT_THRESHOLD rewrites the append log as one snapshot
+    record per LIVE key: the file actually shrinks (overwrites and deletes
+    drop out), appends keep working afterwards, and a fresh load of the
+    compacted store is identical to the pre-compaction contents."""
+    import os
+
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setenv("RAY_TPU_GCS_STORE_COMPACT_THRESHOLD", "200")
+    CONFIG._reset()
+    try:
+        store = FileStoreClient(str(tmp_path / "s"))
+        store.load()
+        path = store._path
+        # 199 appends over only 10 keys: the log carries ~190 dead records.
+        for i in range(199):
+            store.put("t", f"k{i % 10}", {"v": i})
+        pre_size = os.path.getsize(path)
+        assert store._appends_since_compact == 199
+        store.put("t", "k0", {"v": 999})  # 200th append crosses the threshold
+        assert store._appends_since_compact == 0, "compaction never ran"
+        post_size = os.path.getsize(path)
+        assert post_size < pre_size // 4, (
+            f"log did not shrink: {pre_size} -> {post_size}"
+        )
+        # Appends after compaction land in the fresh log.
+        store.put("t", "k10", {"v": 1000})
+        store.delete("t", "k9")
+        store.close()
+
+        # The compacted store reloads identically.
+        store2 = FileStoreClient(str(tmp_path / "s"))
+        store2.load()
+        assert store2.get("t", "k0") == {"v": 999}
+        for i in range(1, 9):
+            assert store2.get("t", f"k{i}") == {"v": 190 + i}
+        assert store2.get("t", "k9") is None
+        assert store2.get("t", "k10") == {"v": 1000}
+        assert len(store2.keys("t")) == 10
+        store2.close()
+    finally:
+        monkeypatch.delenv("RAY_TPU_GCS_STORE_COMPACT_THRESHOLD")
+        CONFIG._reset()
 
 
 def test_file_store_survives_torn_tail(tmp_path):
@@ -243,4 +288,64 @@ def test_gcs_sigkill_mid_append_recovers():
         assert ray_tpu.get(ping.remote(), timeout=120) == "ok"
     finally:
         ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_gcs_call_backoff_deadline_and_reconnect_metric(monkeypatch):
+    """gcs_call rides a short GCS outage transparently (counting the
+    reconnect in gcs_reconnect_total), and surfaces ConnectionLost only after
+    the configurable gcs_rpc_timeout_s deadline."""
+    import threading
+
+    import pytest
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import metrics as util_metrics
+    from tests.conftest import _WORKER_ENV
+
+    monkeypatch.setenv("RAY_TPU_GCS_RPC_TIMEOUT_S", "4")
+    CONFIG._reset()
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 1, "env_vars": _WORKER_ENV}
+    )
+    try:
+        cluster.connect()
+        w = ray_tpu.global_worker()
+        w.gcs_kv_put("ft", b"k", b"v0")
+
+        # (a) Outage SHORTER than the deadline: the call blocks, reconnects
+        # with backoff, succeeds — and the recovery is observable.
+        cluster.head.kill_gcs()
+        result = {}
+
+        def blocked_put():
+            try:
+                w.gcs_kv_put("ft", b"k", b"v1")
+                result["ok"] = True
+            except Exception as e:  # pragma: no cover - failure path
+                result["err"] = e
+
+        t = threading.Thread(target=blocked_put)
+        t.start()
+        time.sleep(1.0)
+        cluster.head.restart_gcs()
+        t.join(timeout=30)
+        assert result.get("ok"), result
+        assert w.gcs_kv_get("ft", b"k") == b"v1"
+        names = {m["name"] for m in util_metrics.collect_all()}
+        assert "gcs_reconnect_total" in names
+
+        # (b) Outage LONGER than the deadline: typed ConnectionLost after
+        # ~gcs_rpc_timeout_s, not an unbounded hang.
+        cluster.head.kill_gcs()
+        t0 = time.monotonic()
+        with pytest.raises(rpc.ConnectionLost):
+            w.gcs_kv_put("ft", b"k", b"v2")
+        elapsed = time.monotonic() - t0
+        assert 3.0 <= elapsed < 25.0, f"deadline not honored: {elapsed:.1f}s"
+    finally:
+        monkeypatch.delenv("RAY_TPU_GCS_RPC_TIMEOUT_S")
+        CONFIG._reset()
         cluster.shutdown()

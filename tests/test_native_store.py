@@ -6,6 +6,7 @@ eviction of freed objects, allocator coalescing under churn, and cross-process r
 """
 
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -106,6 +107,12 @@ def test_runtime_end_to_end_on_native_store(ray_start_isolated):
     np.testing.assert_array_equal(ray_tpu.get(double.remote(ref)), arr * 2)
 
 
+@pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="zero-copy pinned views need PEP 688 __buffer__ (3.12+); older "
+    "Pythons use the pin->copy->release fallback, so there is no alias "
+    "holding the pin to test",
+)
 def test_pinned_read_survives_eviction():
     srv = NativeStoreServer(f"rtpu_t3_{os.getpid()}", 4 << 20)
     try:
@@ -133,6 +140,24 @@ def test_pinned_read_survives_eviction():
         assert srv.alloc(bytes([11] * 16), 1 << 20) is not None
     finally:
         srv.destroy()
+
+
+def test_write_view_writable_on_all_pythons():
+    """The put/pull WRITE path must get a raw writable view (write_view), never
+    read()'s pinned view: on Python < 3.12 read_pinned degrades to a read-only
+    copy (no PEP 688 __buffer__), which would TypeError on chunk writes — the
+    bug that silently broke every cross-node pull on 3.10."""
+    store = SharedObjectStore(1 << 20)
+    try:
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        name = store.create(oid, 16)
+        reader = LocalObjectReader()
+        view = reader.write_view(name, 16)
+        view[:16] = b"0123456789abcdef"  # must not raise on any Python
+        store.seal(oid)
+        assert bytes(reader.read(name, 16)) == b"0123456789abcdef"
+    finally:
+        store.destroy()
 
 
 def test_reader_write_bounds_checked():
