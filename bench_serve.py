@@ -337,6 +337,48 @@ def bench_prefix_cache(prompt_len: int):
         engine.shutdown()
 
 
+def bench_pd_ttft():
+    """PD-disaggregated TTFT through the real serve app: prefill replica ->
+    KV handoff (descriptor + pull over the round-11 device-channel plane,
+    docs/device_channels.md) -> decode replica's first token. max_tokens=1,
+    so latency_s IS the disaggregated TTFT."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.pd_disagg import build_pd_openai_app
+
+    ray_tpu.init(
+        num_cpus=4, num_tpus=0,
+        worker_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    try:
+        app = build_pd_openai_app(
+            LLMConfig(model_id="test-tiny", num_slots=2, max_seq=128),
+            num_prefill=1, num_decode=1,
+        )
+        handle = serve.run(app, name="bench_pd_app", route_prefix=None)
+        handle.generate.remote("warm up the compiled buckets",
+                               max_tokens=2).result(timeout_s=600)
+        ttfts, prefills = [], []
+        for _ in range(5):
+            r = handle.generate.remote(
+                "hello world benchmark prompt", max_tokens=1
+            ).result(timeout_s=600)
+            ttfts.append(r["latency_s"])
+            prefills.append(r["prefill_s"])
+        serve.delete("bench_pd_app")
+        return {
+            "metric": "pd_ttft_s", "value": round(min(ttfts), 4),
+            "prefill_s": round(min(prefills), 4), "max_tokens": 1,
+            "model": "test-tiny",
+            "note": "prefill replica -> KV descriptor + pull "
+                    "(blob/stream gated by devobj_stream_min_bytes) -> "
+                    "decode first token, across real replica actors",
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
     import jax
 
@@ -381,6 +423,9 @@ def main():
     results.append(bench_spec_decode(on_tpu))
 
     results.extend(bench_prefix_cache(prompt_len))
+
+    # PD disaggregation TTFT across real replica actors (round 11).
+    results.append(bench_pd_ttft())
 
     out = {
         "bench": "serve_engine",

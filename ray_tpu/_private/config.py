@@ -70,6 +70,11 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "channel_poll_min_s": (float, 0.0005, "cross-node channel long-poll floor: a hot pipeline sees sub-ms latency"),
     "channel_poll_max_s": (float, 0.01, "cross-node channel long-poll backoff ceiling for idle rings"),
     "channel_default_slots": (int, 4, "in-flight values a compiled-graph channel ring holds by default"),
+    "channel_tensor_min_bytes": (int, 1024, "array leaves at least this large ride the channel tensor fast path (raw-buffer frame, no cloudpickle of array data; docs/device_channels.md); -1 disables the fast path"),
+    "channel_reconnect_s": (float, 5.0, "RpcChannel readers ride transient writer-connection failures (RpcError/OSError) with backoff+jitter for this long before declaring the writer dead (ChannelClosed); dead sockets are evicted from the per-process conn cache so a restarted writer gets a fresh dial"),
+    "llm_channel_chunk_bytes": (int, 1 << 20, "chunk size for DeviceChannel staged transfers (PD KV handoff, device_objects.get/transfer): device->host, wire, and host->device legs pipeline at this granularity through a small ring instead of one blocking full-tensor copy (docs/device_channels.md)"),
+    "devobj_stream_slots": (int, 4, "ring depth, in chunks, of device-object transfer streams; depth > 1 is what lets the D2H / wire / H2D legs overlap"),
+    "devobj_stream_min_bytes": (int, 8 << 20, "device-object fetches at least this large ride the chunked DeviceChannel stream; smaller payloads take the one-hop object-plane blob, whose fixed cost is lower than a stream setup (docs/device_channels.md)"),
     "dag_buffer_size_bytes": (int, 8 << 20, "per-edge channel slot capacity for compiled DAGs (reference: buffer_size_bytes)"),
     "dag_max_inflight_executions": (int, 10, "default bound on in-flight compiled-DAG executions (reference: RAY_CGRAPH_max_inflight_executions)"),
     "dag_execute_timeout_s": (float, 60.0, "compiled-DAG submission/read timeout"),

@@ -6,6 +6,13 @@ compile time every edge gets ONE mutable shared-memory channel and every actor g
 long-running loop task that reads its inputs, runs its methods in topological order,
 and writes outputs. Steady-state execution does zero task submissions and zero object
 allocations — the TPU-relevant property for pipeline-parallel stage feeding.
+
+Edges carrying array payloads (activations, logits, gradients) ride the channels'
+tensor-native fast path (round 11, docs/device_channels.md): the value's array
+leaves are memcpy'd into the ring slot as raw buffers behind a small pickled
+skeleton — cloudpickle never serializes tensor bytes, on write OR read. Values
+without qualifying arrays pickle exactly as before. Per-process frame accounting
+lives in experimental.tensor_transport.transport_stats().
 """
 
 from __future__ import annotations

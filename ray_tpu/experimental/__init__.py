@@ -1,0 +1,18 @@
+"""Experimental transports: compiled-graph channels + device-object plane.
+
+Round 11 adds the tensor-native layer (docs/device_channels.md): `Channel` /
+`RpcChannel` carry array payloads as raw-buffer frames, and `DeviceChannel`
+streams device arrays in pipelined chunks (local handoff / shm ring /
+chunked RPC)."""
+
+from ray_tpu.experimental.channel import (  # noqa: F401
+    Channel,
+    ChannelClosed,
+    RpcChannel,
+    SlotView,
+)
+from ray_tpu.experimental.device_channel import DeviceChannel  # noqa: F401
+from ray_tpu.experimental.tensor_transport import (  # noqa: F401
+    reset_transport_stats,
+    transport_stats,
+)

@@ -16,6 +16,15 @@ closed word: BOTH sides observe it from their wait loops (a writer blocked on a 
 ring must be stoppable too) and raise ChannelClosed; readers drain buffered values
 first. Synchronization is version-polling over shm words (cross-process, nothing to
 leak); waits back off to 50us sleeps.
+
+Tensor fast path (round 11, docs/device_channels.md): values whose array
+leaves clear `channel_tensor_min_bytes` skip cloudpickle for the payload —
+write() memcpys a tensor frame (tensor_transport.py: small pickled header +
+raw leaf bytes) straight into the ring slot, read() rebuilds the arrays with
+np.frombuffer over the slot, and read_view() hands out a ZERO-COPY lease on
+the slot (the ack publishes at release, so the writer cannot recycle the
+bytes under a live view — holding a lease back-pressures the ring, it never
+corrupts it).
 """
 
 from __future__ import annotations
@@ -30,11 +39,93 @@ from typing import Any, Optional
 
 import cloudpickle
 
+from ray_tpu.experimental import tensor_transport as _tt
+
 _U64 = struct.Struct("<Q")
 
 
 class ChannelClosed(Exception):
     pass
+
+
+def _tensor_min_bytes() -> int:
+    from ray_tpu._private.config import CONFIG
+
+    return CONFIG.channel_tensor_min_bytes
+
+
+_chan_metrics: dict = {}
+_chan_metrics_lock = threading.Lock()
+
+
+def _metric(name: str):
+    """Lazy channel-plane metrics (util.metrics): created on first use so
+    processes that never touch channels pay nothing; flushing is best-effort
+    inside the Metric itself (never breaks the transport)."""
+    with _chan_metrics_lock:
+        m = _chan_metrics.get(name)
+        if m is None:
+            from ray_tpu.util import metrics
+
+            if name == "chan_bytes_written":
+                m = metrics.Counter(
+                    "chan_bytes_written",
+                    "payload bytes written into compiled-graph/device "
+                    "channels",
+                )
+            else:
+                m = metrics.Counter(
+                    "chan_tensor_fastpath_total",
+                    "channel frames that rode the tensor-native raw-buffer "
+                    "path (array payloads not cloudpickled)",
+                )
+            _chan_metrics[name] = m
+        return m
+
+
+def _note_write(nbytes: int, tensor: bool):
+    try:
+        _metric("chan_bytes_written").inc(nbytes)
+        if tensor:
+            _metric("chan_tensor_fastpath_total").inc()
+    except Exception:
+        pass  # observability must never break the transport
+    if tensor:
+        _tt.note("tensor_frames_written")
+        _tt.note("tensor_bytes_written", nbytes)
+    else:
+        _tt.note("pickle_frames_written")
+
+
+class SlotView:
+    """A zero-copy lease on one ring slot's frame bytes.
+
+    The reader's ack is published at release(): until then the writer cannot
+    recycle the slot, so `mv` (and any np.frombuffer alias of it) stays
+    valid. Not releasing a lease blocks the writer on a full ring — the
+    contract is back-pressure, never corruption (docs/device_channels.md)."""
+
+    __slots__ = ("mv", "_release")
+
+    def __init__(self, mv, release):
+        self.mv = mv
+        self._release = release
+
+    def release(self):
+        rel, self._release = self._release, None
+        if rel is not None:
+            try:
+                self.mv.release()
+            except (BufferError, AttributeError):
+                pass  # caller still aliases the slot bytes; their export holds
+            self.mv = None
+            rel()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
 
 
 # Segment names created by THIS process: attach-views of these must not unregister
@@ -126,13 +217,27 @@ class Channel:
 
     # -- writer ------------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None):
-        data = cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
-        self.write_bytes(data, timeout)
+        plan = _tt.plan(value, _tensor_min_bytes())
+        if plan is None:
+            data = cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+            self.write_bytes(data, timeout)
+            return
+        # Tensor fast path: the frame is memcpy'd straight into the ring slot
+        # — array bytes are never cloudpickled and never pass through an
+        # intermediate bytes object.
+        wv = self._acquire_slot(plan.total, timeout)
+        slot = wv % self._num_slots
+        off = self._data_off(slot)
+        plan.write_into(self._shm.buf[off : off + plan.total])
+        self._set_u64(self._len_off(slot), plan.total)
+        self._set_u64(0, wv + 1)
+        _note_write(plan.total, tensor=True)
 
-    def write_bytes(self, data: bytes, timeout: Optional[float] = None):
-        if len(data) > self._capacity:
+    def _acquire_slot(self, need: int, timeout: Optional[float]) -> int:
+        """Wait for a free ring slot; returns the write version to fill."""
+        if need > self._capacity:
             raise ValueError(
-                f"value of {len(data)} bytes exceeds channel slot capacity "
+                f"value of {need} bytes exceeds channel slot capacity "
                 f"{self._capacity}; construct the Channel with a larger capacity"
             )
         if self._closed:
@@ -146,17 +251,21 @@ class Channel:
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("channel write timed out waiting for readers")
             time.sleep(5e-5)
+        return wv
+
+    def write_bytes(self, data, timeout: Optional[float] = None):
+        wv = self._acquire_slot(len(data), timeout)
         slot = wv % self._num_slots
         off = self._data_off(slot)
         self._shm.buf[off : off + len(data)] = data
         self._set_u64(self._len_off(slot), len(data))
         self._set_u64(0, wv + 1)
+        _note_write(len(data), tensor=False)
 
     # -- reader ------------------------------------------------------------
-    def read(self, timeout: Optional[float] = None) -> Any:
-        return cloudpickle.loads(self.read_bytes(timeout))
-
-    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+    def _wait_readable(self, timeout: Optional[float]):
+        """Block until the next unread item exists; returns (reader, my_ack,
+        slot byte offset, item length). The ack is NOT published here."""
         reader = self._reader_slot or 0
         my_ack = self._get_u64(self._ack_off(reader))
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -169,10 +278,52 @@ class Channel:
             time.sleep(5e-5)
         slot = my_ack % self._num_slots
         n = self._get_u64(self._len_off(slot))
-        off = self._data_off(slot)
+        return reader, my_ack, self._data_off(slot), n
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        reader, my_ack, off, n = self._wait_readable(timeout)
+        view = self._shm.buf[off : off + n]
+        if _tt.is_frame(view):
+            # Decode arrays directly off the slot (no intermediate bytes
+            # object); copy=True because the ack below lets the writer
+            # recycle the slot — read_view() is the zero-copy variant.
+            value = _tt.decode(view, copy=True)
+            self._set_u64(self._ack_off(reader), my_ack + 1)
+            _tt.note("tensor_frames_read")
+            return value
+        data = bytes(view)
+        self._set_u64(self._ack_off(reader), my_ack + 1)
+        _tt.note("pickle_frames_read")
+        return cloudpickle.loads(data)
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        reader, my_ack, off, n = self._wait_readable(timeout)
         data = bytes(self._shm.buf[off : off + n])
         self._set_u64(self._ack_off(reader), my_ack + 1)
         return data
+
+    def read_view(self, timeout: Optional[float] = None) -> SlotView:
+        """Zero-copy read: a lease on the slot's frame bytes. The ack
+        publishes at release(), so the writer cannot recycle the slot while
+        the view (or any np.frombuffer alias of it) is in use."""
+        reader, my_ack, off, n = self._wait_readable(timeout)
+        mv = self._shm.buf[off : off + n]
+        return SlotView(
+            mv, lambda: self._set_u64(self._ack_off(reader), my_ack + 1)
+        )
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Writer-side: block until every written item was acked (or the
+        channel closed). Stream writers call this before destroy() so the
+        segment never unlinks under a reader mid-item."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._min_ack() < self._write_version:
+            if self._closed:
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(5e-5)
+        return True
 
     def close(self):
         """Mark closed: wakes blocked readers AND writers (buffered reads drain)."""
@@ -313,11 +464,24 @@ class RpcChannel:
             return ring
 
     def write(self, value: Any, timeout: Optional[float] = None):
-        self.write_bytes(
-            cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL), timeout
-        )
+        plan = _tt.plan(value, _tensor_min_bytes())
+        if plan is None:
+            self.write_bytes(
+                cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL),
+                timeout,
+            )
+            return
+        # Tensor fast path: the ring item is a raw tensor frame (header +
+        # leaf bytes) — array data is never cloudpickled; the reader's pull
+        # response carries it as one opaque buffer.
+        self._write_item(bytes(plan.to_bytes()), timeout)
+        _note_write(plan.total, tensor=True)
 
     def write_bytes(self, data: bytes, timeout: Optional[float] = None):
+        self._write_item(data, timeout)
+        _note_write(len(data), tensor=False)
+
+    def _write_item(self, data: bytes, timeout: Optional[float] = None):
         ring = self._ring()
         deadline = None if timeout is None else time.monotonic() + timeout
         with ring.lock:
@@ -372,15 +536,40 @@ class RpcChannel:
             return self._conn
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        return cloudpickle.loads(self.read_bytes(timeout))
+        data = self.read_bytes(timeout)
+        if _tt.is_frame(data):
+            _tt.note("tensor_frames_read")
+            # copy=True: `data` is an owned bytes object, but aliased arrays
+            # over immutable bytes would be read-only — graph methods may
+            # mutate their inputs, so materialize owning arrays.
+            return _tt.decode(memoryview(data), copy=True)
+        _tt.note("pickle_frames_read")
+        return cloudpickle.loads(data)
+
+    def _drop_conn(self):
+        """Forget the reader's writer connection AND evict dead sockets from
+        the shared cache, so the next attempt (here or on any sibling channel
+        into the same writer) dials fresh instead of reusing a corpse."""
+        conn, self._conn = self._conn, None
+        with _registry_lock:
+            for addr, c in list(_conn_cache.items()):
+                if c is conn or c.closed:
+                    _conn_cache.pop(addr, None)
 
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         from ray_tpu._private import rpc
+        from ray_tpu._private.config import CONFIG
         from ray_tpu._private.worker import global_worker
 
         w = global_worker()
         reader = self._reader_slot or 0
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Transient-failure window (gcs_call-style backoff + full jitter):
+        # a writer process mid-restart or a dropped TCP conn must not
+        # instantly become ChannelClosed — only failures that OUTLAST the
+        # reconnect window (or the read deadline) declare the writer dead.
+        retry_deadline: Optional[float] = None
+        backoff = 0.05
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
@@ -394,15 +583,48 @@ class RpcChannel:
                     conn.call("chan_pull", self._name, reader, self._next, poll),
                     timeout=poll + 10,
                 )
+            except ChannelClosed:
+                raise  # definitive: the GCS says the writer actor is DEAD
             except (rpc.RpcError, TimeoutError, OSError):
-                self._conn = None
-                raise ChannelClosed()  # writer gone: the pinned loop unwinds
+                import random as _random
+
+                self._drop_conn()
+                now = time.monotonic()
+                if retry_deadline is None:
+                    retry_deadline = now + CONFIG.channel_reconnect_s
+                    if deadline is not None:
+                        retry_deadline = min(retry_deadline, deadline)
+                if now >= retry_deadline:
+                    raise ChannelClosed()  # writer gone: the pinned loop unwinds
+                pause = backoff * (0.5 + _random.random())
+                pause = min(pause, max(0.0, retry_deadline - now))
+                time.sleep(pause)
+                backoff = min(backoff * 2.0, 1.0)
+                continue
+            retry_deadline = None  # healthy round-trip: arm a fresh window
+            backoff = 0.05
             if "data" in resp:
                 self._next += 1
                 return resp["data"]
             if resp.get("closed"):
                 raise ChannelClosed()
             # "wait"/"unknown": ring not created yet or nothing new yet.
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Writer-side: block until every ring item was pulled (or closed)."""
+        ring = self._ring()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with ring.lock:
+            while min(ring.acks) < ring.write_version:
+                if ring.closed:
+                    return False
+                wait = 0.05
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                ring.cond.wait(wait)
+            return True
 
     def close(self):
         # Writer-local rings close directly; otherwise tell the writer.

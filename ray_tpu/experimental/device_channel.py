@@ -1,0 +1,350 @@
+"""DeviceChannel: tensor-native streaming transport for device arrays.
+
+Design parity: the reference gives compiled graphs dedicated tensor
+transports next to the shared-memory channel (NCCL channels in
+`python/ray/experimental/channel/`, NIXL for PD KV in
+`prefill_decode_disagg.py`). TPU-first shape (docs/device_channels.md):
+
+  transport decision table
+  ------------------------
+  writer/reader same process   local handoff: `jax.device_put` with the
+                               target sharding (XLA schedules the ICI
+                               collective transfer); zero host staging.
+  same node, different process shm chunk ring: device->host slices memcpy'd
+                               into `Channel` slots; the reader maps each
+                               slot zero-copy (`read_view`) and assembles or
+                               device_puts straight off shared memory.
+  cross node                   chunked RPC frames over the writer-owned
+                               `RpcChannel` ring (the NIXL-role fallback).
+
+Either way the payload moves as raw chunk frames behind one small pickled
+header — never through cloudpickle — and the ring depth
+(`devobj_stream_slots`) is the pipeline: the writer's next D2H slice
+overlaps the reader's copy/H2D of the previous chunk, instead of one
+blocking `device_get` of tens of MB (`llm_channel_chunk_bytes` sets the
+granularity).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ray_tpu.experimental import tensor_transport as _tt
+from ray_tpu.experimental.channel import Channel, ChannelClosed, RpcChannel
+
+STREAM_MAGIC = b"RTS1"
+_U32 = struct.Struct("<I")
+
+# Local-handoff rings (same-process writer/reader), keyed by channel name.
+_local_rings: dict = {}
+_local_lock = threading.Lock()
+
+
+class _LocalRing:
+    def __init__(self):
+        self.items: list = []
+        self.closed = False
+        self.cond = threading.Condition()
+
+
+def _leaf_meta(leaf) -> tuple:
+    """(shape, np.dtype, size_elems) of an array leaf (jax or numpy)."""
+    dtype = np.dtype(leaf.dtype)
+    size = 1
+    for d in leaf.shape:
+        size *= int(d)
+    return tuple(leaf.shape), dtype, size
+
+
+def _chunk_elems(dtype: np.dtype, chunk_bytes: int) -> int:
+    return max(1, chunk_bytes // max(1, dtype.itemsize))
+
+
+def _host_resident(leaf) -> bool:
+    """True when a jax Array's bytes are already host-addressable (CPU
+    backend): streaming then slices ONE host view instead of dispatching a
+    device slice + transfer per chunk."""
+    try:
+        return all(d.platform == "cpu" for d in leaf.devices())
+    except Exception:
+        return False
+
+
+class DeviceChannel:
+    """One-writer one-reader stream of array trees over a chunked transport.
+
+    `transport=None` is the local (same-process) mode; otherwise a `Channel`
+    (same-node shm) or `RpcChannel` (cross-node) carries the frames. The
+    object pickles by transport identity, so a writer can mint a channel and
+    ship the reader end through any control-plane message."""
+
+    def __init__(self, transport=None, chunk_bytes: Optional[int] = None,
+                 name: Optional[str] = None):
+        if chunk_bytes is None:
+            from ray_tpu._private.config import CONFIG
+
+            chunk_bytes = CONFIG.llm_channel_chunk_bytes
+        self._transport = transport
+        self._chunk = int(chunk_bytes)
+        self._name = name or f"rtpudev_{uuid.uuid4().hex[:12]}"
+        if transport is None:
+            with _local_lock:
+                _local_rings.setdefault(self._name, _LocalRing())
+
+    @classmethod
+    def create(cls, *, same_node: bool = True, local: bool = False,
+               chunk_bytes: Optional[int] = None,
+               num_slots: Optional[int] = None,
+               owner=None) -> "DeviceChannel":
+        from ray_tpu._private.config import CONFIG
+
+        chunk = chunk_bytes or CONFIG.llm_channel_chunk_bytes
+        if local:
+            return cls(None, chunk)
+        slots = num_slots or CONFIG.devobj_stream_slots
+        # Headroom past the chunk size: the header frame (pickled skeleton +
+        # leaf descriptors) rides the same ring.
+        capacity = int(chunk) + (64 << 10)
+        if same_node:
+            transport = Channel(capacity, num_readers=1, num_slots=slots)
+        else:
+            transport = RpcChannel(capacity, num_readers=1, num_slots=slots,
+                                   owner=owner)
+        return cls(transport, chunk)
+
+    def __reduce__(self):
+        return (DeviceChannel, (self._transport, self._chunk, self._name))
+
+    # -- local mode --------------------------------------------------------
+    def _local(self) -> _LocalRing:
+        with _local_lock:
+            ring = _local_rings.get(self._name)
+        if ring is None:
+            raise RuntimeError(
+                "local DeviceChannel crossed a process boundary: same-process "
+                "handoff requires writer and reader in one process — use "
+                "create(same_node=...) for cross-process streams"
+            )
+        return ring
+
+    # -- writer ------------------------------------------------------------
+    def send(self, value: Any, *, sharding=None,
+             timeout: Optional[float] = None):
+        """Stream `value`'s array leaves to the reader.
+
+        Local mode: the arrays are handed over by reference — with a
+        `sharding`, via `jax.device_put(x, sharding)` so XLA moves the bytes
+        over ICI to the target devices; no host staging.
+
+        Transport mode: one header frame, then each leaf's bytes as chunk
+        frames. jax leaves are sliced ON DEVICE and fetched chunk-at-a-time,
+        so the D2H leg pipelines with the wire leg through the ring."""
+        if self._transport is None:
+            item = value
+            if sharding is not None:
+                import jax
+
+                item = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sharding), value
+                )
+            ring = self._local()
+            with ring.cond:
+                if ring.closed:
+                    raise ChannelClosed()
+                ring.items.append(item)
+                ring.cond.notify_all()
+            return
+        skeleton_bytes, leaves = _tt.split(value, 0)
+        descs = [_leaf_meta(leaf) for leaf in leaves]
+        meta = pickle.dumps(
+            (skeleton_bytes, descs, self._chunk),
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        self._transport.write_bytes(
+            STREAM_MAGIC + _U32.pack(len(meta)) + meta, timeout
+        )
+        rpc = isinstance(self._transport, RpcChannel)
+        jax = sys.modules.get("jax")
+        for leaf, (_shape, dtype, size) in zip(leaves, descs):
+            ce = _chunk_elems(dtype, self._chunk)
+            if (jax is not None and isinstance(leaf, jax.Array)
+                    and not _host_resident(leaf)):
+                flat = jax.numpy.reshape(leaf, (-1,))
+                for a in range(0, size, ce):
+                    # Chunked D2H: one slice transfer per frame; the ring
+                    # back-pressures, so at most `num_slots` chunks of host
+                    # staging exist at once.
+                    chunk = np.asarray(flat[a : min(size, a + ce)])  # raylint: disable=RL603 (the chunked D2H leg itself — one bounded slice per frame IS the point)
+                    self._transport.write_bytes(
+                        bytes(chunk.view(np.uint8).data) if rpc
+                        else _tt.as_flat_bytes(chunk).data,
+                        timeout,
+                    )
+            else:
+                if not isinstance(leaf, np.ndarray):
+                    # CPU-backed jax array: ONE host view (zero-copy on the
+                    # CPU backend), then plain buffer slices — per-chunk
+                    # device slicing would pay a jax dispatch per frame for
+                    # bytes that are already host-addressable.
+                    leaf = np.asarray(leaf)
+                flatb = _tt.as_flat_bytes(np.ascontiguousarray(leaf))
+                isz = dtype.itemsize
+                for a in range(0, size, ce):
+                    b = min(size, a + ce)
+                    mv = flatb[a * isz : b * isz].data
+                    self._transport.write_bytes(bytes(mv) if rpc else mv,
+                                                timeout)
+        # One logical tensor frame per stream in the fast-path accounting
+        # (the per-chunk byte counts land via the transport's write_bytes).
+        _tt.note("tensor_frames_written")
+        from ray_tpu.experimental.channel import _metric
+
+        try:
+            _metric("chan_tensor_fastpath_total").inc()
+        except Exception:
+            pass  # observability must never break the stream
+
+    # -- reader ------------------------------------------------------------
+    def recv(self, *, on_chunk: Optional[Callable] = None,
+             assemble: bool = True, timeout: Optional[float] = None) -> Any:
+        """Read one streamed value.
+
+        Default: assemble each leaf into a host numpy array and return the
+        joined tree. `on_chunk(leaf_idx, elt_offset, typed_chunk)` is invoked
+        per chunk AS FRAMES ARRIVE — over shm the chunk is a ZERO-COPY view
+        of the ring slot, valid only for the duration of the callback (copy
+        or device_put before returning). With assemble=False only the
+        callback sees the payload and array leaves join as None (pure
+        streaming consumers: PD attach staging, progress tees)."""
+        if self._transport is None:
+            ring = self._local()
+            deadline = None if timeout is None else time.monotonic() + timeout
+            with ring.cond:
+                while not ring.items:
+                    if ring.closed:
+                        raise ChannelClosed()
+                    wait = 0.1
+                    if deadline is not None:
+                        wait = min(wait, deadline - time.monotonic())
+                        if wait <= 0:
+                            raise TimeoutError("device channel recv timed out")
+                    ring.cond.wait(wait)
+                return ring.items.pop(0)
+        header = self._transport.read_bytes(timeout)
+        if bytes(header[:4]) != STREAM_MAGIC:
+            raise ValueError(
+                "device channel stream out of sync: expected a header frame"
+            )
+        (meta_len,) = _U32.unpack_from(header, 4)
+        skeleton_bytes, descs, chunk_bytes = pickle.loads(
+            memoryview(header)[8 : 8 + meta_len]
+        )
+        shm = isinstance(self._transport, Channel)
+        leaves: List[Optional[np.ndarray]] = []
+        for li, (shape, dtype, size) in enumerate(descs):
+            out = np.empty(size, dtype) if assemble else None
+            ce = _chunk_elems(dtype, chunk_bytes)
+            for a in range(0, size, ce):
+                b = min(size, a + ce)
+                if shm:
+                    view = self._transport.read_view(timeout)
+                    try:
+                        typed = np.frombuffer(view.mv, dtype=dtype)
+                        if assemble:
+                            out[a:b] = typed
+                        if on_chunk is not None:
+                            on_chunk(li, a, typed)
+                    finally:
+                        del typed  # drop the slot alias before the ack
+                        view.release()
+                else:
+                    data = self._transport.read_bytes(timeout)
+                    typed = np.frombuffer(data, dtype=dtype)
+                    if assemble:
+                        out[a:b] = typed
+                    if on_chunk is not None:
+                        on_chunk(li, a, typed)
+            leaves.append(out.reshape(shape) if assemble else None)
+        return _tt.join(skeleton_bytes, leaves)
+
+    def recv_device(self, timeout: Optional[float] = None) -> Any:
+        """Read one streamed value with per-chunk DEVICE staging: each chunk
+        is `jax.device_put` as it arrives (H2D overlaps the wire/D2H legs),
+        then leaves assemble on device with one concatenate+reshape — the
+        host never holds a full copy of any leaf.
+
+        Dtypes follow jax's x64 rules on the receiving process (int64/float64
+        chunks downcast unless jax_enable_x64 is on); use recv() when the
+        consumer needs bitwise host fidelity for wide dtypes."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._transport is None:
+            return self.recv(timeout=timeout)
+        header = self._transport.read_bytes(timeout)
+        if bytes(header[:4]) != STREAM_MAGIC:
+            raise ValueError(
+                "device channel stream out of sync: expected a header frame"
+            )
+        (meta_len,) = _U32.unpack_from(header, 4)
+        skeleton_bytes, descs, chunk_bytes = pickle.loads(
+            memoryview(header)[8 : 8 + meta_len]
+        )
+        shm = isinstance(self._transport, Channel)
+        leaves = []
+        for shape, dtype, size in descs:
+            ce = _chunk_elems(dtype, chunk_bytes)
+            chunks = []
+            for a in range(0, size, ce):
+                if shm:
+                    view = self._transport.read_view(timeout)
+                    try:
+                        # Owned host copy before device_put: the CPU backend
+                        # may alias host memory, and the slot recycles at
+                        # release.
+                        host = np.frombuffer(view.mv, dtype=dtype).copy()
+                    finally:
+                        view.release()
+                else:
+                    host = np.frombuffer(
+                        self._transport.read_bytes(timeout), dtype=dtype
+                    )
+                chunks.append(jax.device_put(host))
+            if not chunks:
+                flat = jnp.zeros((0,), dtype)
+            elif len(chunks) == 1:
+                flat = chunks[0]
+            else:
+                flat = jnp.concatenate(chunks)
+            leaves.append(jnp.reshape(flat, shape))
+        return _tt.join(skeleton_bytes, leaves)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        if self._transport is None:
+            ring = self._local()
+            with ring.cond:
+                ring.closed = True
+                ring.cond.notify_all()
+            return
+        self._transport.close()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        if self._transport is None:
+            return True
+        return self._transport.drain(timeout)
+
+    def destroy(self):
+        if self._transport is None:
+            with _local_lock:
+                _local_rings.pop(self._name, None)
+            return
+        self._transport.destroy()

@@ -17,6 +17,7 @@ sampled rollouts) without paying host serialization per call.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
@@ -143,13 +144,45 @@ def _run_on_owner(ref: DeviceObjectRef, local_fn, remote_fn):
     )
 
 
-def get(ref: DeviceObjectRef):
+def get(ref: DeviceObjectRef, *, to_device: bool = False,
+        on_chunk=None, _legacy: bool = False):
     """Resolve a descriptor to its array.
 
-    Same actor: the device array itself, zero transfer. Elsewhere: one fetch
-    through the owning actor (device -> host numpy -> object plane) — the
-    explicit-transport fallback, like RDT's non-collective path."""
-    return _run_on_owner(ref, lambda: _store.get(ref.key), _fetch_host)
+    Same actor: the device array itself, zero transfer. Elsewhere the payload
+    streams over a DeviceChannel (round 11, docs/device_channels.md): the
+    owner writes chunked raw frames — a shm ring on the same node, RPC frames
+    across nodes — and this side assembles as they arrive, so D2H, wire, and
+    assembly pipeline instead of one blocking full-tensor hop through the
+    object plane. `to_device=True` stages each chunk onto the local device as
+    it lands (`jax.device_put` per chunk + one device concatenate), and
+    `on_chunk(leaf_idx, elt_offset, typed_chunk)` tees arriving chunks to the
+    caller.
+
+    Payloads below `devobj_stream_min_bytes` take the one-hop object-plane
+    blob instead: a stream pays a control round-trip plus ring setup, which
+    only amortizes on multi-MB tensors (BENCH_PD.json). `_legacy=True`
+    forces that path explicitly."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    if w.actor_id is not None and w.actor_id == ref.actor_id:
+        return _store.get(ref.key)
+    # on_chunk only has meaning on the stream, so a tee request overrides
+    # the size gate.
+    if (not _legacy
+            and (on_chunk is not None
+                 or _descriptor_nbytes(ref) >= CONFIG.devobj_stream_min_bytes)):
+        try:
+            return _stream_fetch(ref, to_device=to_device, on_chunk=on_chunk)
+        except _StreamUnsupported:
+            pass  # owner predates streams or this process has no data plane
+    value = _run_on_owner(ref, lambda: _store.get(ref.key), _fetch_host)
+    if to_device:
+        import jax
+
+        value = jax.device_put(value)
+    return value
 
 
 def free(ref: DeviceObjectRef) -> bool:
@@ -193,21 +226,230 @@ async def _pull_and_pin(_instance, ref: DeviceObjectRef) -> DeviceObjectRef:
     return put(value)
 
 
+class _StreamUnsupported(Exception):
+    """Streamed fetch cannot run here (no data plane / pre-stream owner)."""
+
+
+def _descriptor_nbytes(ref: DeviceObjectRef) -> int:
+    """Payload size from the descriptor alone (no owner round-trip). Unknown
+    dtypes (extension dtypes not registered here) count as large: streaming
+    is the safe default for anything that might be big."""
+    import numpy as np
+
+    try:
+        itemsize = np.dtype(ref.dtype).itemsize
+    except TypeError:
+        return 1 << 62
+    n = itemsize
+    for d in ref.shape:
+        n *= int(d)
+    return n
+
+
+# -- in-flight host-snapshot dedupe (round 11 satellite) ---------------------
+# Concurrent consumers pulling the SAME key used to materialize the full
+# tensor on the owner's executor once PER CONSUMER. One in-flight snapshot
+# per key is shared by every fetch that arrives while it materializes; the
+# entry clears on completion so memory is bounded by live requests, not a
+# cache.
+_snapshot_lock = threading.Lock()
+_inflight_snapshots: Dict[str, list] = {}  # key -> [Event, value, exc]
+_snapshot_materializations = 0  # introspection/testing
+_TEST_SNAPSHOT_DELAY_S = 0.0  # test hook: widen the dedupe window
+
+
+def _host_snapshot(key: str):
+    """Host numpy view of a pinned device array; concurrent callers share one
+    D2H materialization per key."""
+    import numpy as np
+
+    global _snapshot_materializations
+    with _snapshot_lock:
+        entry = _inflight_snapshots.get(key)
+        if entry is None:
+            entry = [threading.Event(), None, None]
+            _inflight_snapshots[key] = entry
+            owner = True
+            _snapshot_materializations += 1
+        else:
+            owner = False
+    if not owner:
+        entry[0].wait()
+        if entry[2] is not None:
+            raise entry[2]
+        return entry[1]
+    try:
+        arr = _store.get(key)
+        if _TEST_SNAPSHOT_DELAY_S:
+            time.sleep(_TEST_SNAPSHOT_DELAY_S)
+        entry[1] = np.asarray(arr)
+        return entry[1]
+    except BaseException as e:  # noqa: BLE001 - waiters must observe failure
+        entry[2] = e
+        raise
+    finally:
+        with _snapshot_lock:
+            _inflight_snapshots.pop(key, None)
+        entry[0].set()
+
+
 async def _fetch_host(_instance, key: str):
     """Runs on the owning actor: device -> host for the object plane. Async so
     an async-actor owner's event loop never stalls behind the D2H copy of a
     large tensor (KV prefixes are tens of MB) — the copy runs on a thread;
-    sync-actor owners just run the coroutine on their executor thread."""
+    sync-actor owners just run the coroutine on their executor thread.
+    Concurrent fetches of one key share a single in-flight snapshot."""
     import asyncio
 
-    import numpy as np
-
-    arr = _store.get(key)
-    return await asyncio.to_thread(np.asarray, arr)
+    return await asyncio.to_thread(_host_snapshot, key)
 
 
 def _free_local(_instance, key: str) -> bool:
     return _store.pop(key) is not None
+
+
+# -- chunked streaming (round 11 tentpole) -----------------------------------
+
+_active_streams = 0  # writer-side pumps still holding a snapshot/segment
+_streams_lock = threading.Lock()
+
+
+def active_streams() -> int:
+    """Writer-side streams still live in THIS process (introspection: a
+    drained/aborted stream must release its snapshot pin and shm segment)."""
+    with _streams_lock:
+        return _active_streams
+
+
+_devobj_metrics: dict = {}
+_devobj_metrics_lock = threading.Lock()
+
+
+def _metric(name: str):
+    with _devobj_metrics_lock:
+        m = _devobj_metrics.get(name)
+        if m is None:
+            from ray_tpu.util import metrics
+
+            if name == "devobj_transfer_bytes":
+                m = metrics.Counter(
+                    "devobj_transfer_bytes",
+                    "tensor bytes moved by device-object fetches/transfers",
+                )
+            else:
+                m = metrics.Histogram(
+                    "devobj_transfer_seconds",
+                    "wall time of device-object fetches/transfers",
+                    boundaries=[0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10],
+                )
+            _devobj_metrics[name] = m
+        return m
+
+
+def _note_transfer(nbytes: int, seconds: float):
+    try:
+        _metric("devobj_transfer_bytes").inc(nbytes)
+        _metric("devobj_transfer_seconds").observe(seconds)
+    except Exception:
+        pass  # observability must never break the transfer
+
+
+def _open_stream(_instance, key: str, reader_node, chunk_bytes):
+    """Runs on the OWNING actor: mint a DeviceChannel toward `reader_node`
+    and pump the pinned array through it on a background thread. Returns the
+    (picklable) channel for the reader end. The pump holds its own reference
+    to the array, so a concurrent free() cannot unpin bytes mid-stream, and
+    destroys the ring once the reader drained it (or closed early)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.experimental.channel import ChannelClosed
+    from ray_tpu.experimental.device_channel import DeviceChannel
+
+    global _active_streams
+    arr = _store.get(key)  # raises for freed/stale keys BEFORE minting a ring
+    w = global_worker()
+    same_node = reader_node is not None and reader_node == w.node_id
+    ch = DeviceChannel.create(
+        same_node=same_node, chunk_bytes=chunk_bytes,
+        owner=None if same_node else ("actor", w.actor_id),
+    )
+    with _streams_lock:
+        _active_streams += 1
+
+    def pump():
+        global _active_streams
+        try:
+            ch.send(arr, timeout=120.0)
+            ch.drain(timeout=120.0)
+        except (ChannelClosed, TimeoutError):
+            pass  # reader closed early or died: unwind, release the pin
+        except Exception:
+            pass  # never let a pump thread take the actor down
+        finally:
+            try:
+                ch.destroy()
+            finally:
+                with _streams_lock:
+                    _active_streams -= 1
+
+    threading.Thread(target=pump, name="devobj-stream", daemon=True).start()
+    return ch
+
+
+def _stream_fetch(ref: DeviceObjectRef, *, to_device: bool, on_chunk=None):
+    """Reader side of the chunked pull; raises _StreamUnsupported when the
+    topology cannot stream (caller falls back to the object-plane blob)."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.actor import ActorHandle, ActorMethod
+
+    w = global_worker()
+    if CONFIG.llm_channel_chunk_bytes <= 0:
+        raise _StreamUnsupported()
+    handle = ActorHandle(ref.actor_id, [], "DeviceObjectOwner")
+    t0 = time.monotonic()
+    ch = ray_tpu.get(
+        ActorMethod(handle, "__rtpu_apply__").remote(
+            _open_stream, ref.key, w.node_id, CONFIG.llm_channel_chunk_bytes
+        )
+    )
+    try:
+        if to_device:
+            value = ch.recv_device(timeout=120.0)
+            nbytes = sum(
+                int(x.size) * x.dtype.itemsize
+                for x in _leaves_of(value)
+            )
+        else:
+            value = ch.recv(on_chunk=on_chunk, timeout=120.0)
+            nbytes = sum(x.nbytes for x in _leaves_of(value))
+    except BaseException:
+        # Unwind the writer: close wakes its blocked send, so the pinned
+        # snapshot and the ring release instead of leaking.
+        try:
+            ch.close()
+        except Exception:
+            pass
+        raise
+    _note_transfer(nbytes, time.monotonic() - t0)
+    return value
+
+
+def _leaves_of(value):
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        return [value]
+    import sys as _sys
+
+    jax = _sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        return [value]
+    if isinstance(value, dict):
+        return [x for v in value.values() for x in _leaves_of(v)]
+    if isinstance(value, (list, tuple)):
+        return [x for v in value for x in _leaves_of(v)]
+    return []
 
 
 def stored_keys() -> list:

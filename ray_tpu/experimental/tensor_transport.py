@@ -1,0 +1,263 @@
+"""Tensor-native wire framing: pickled skeleton + raw array payload.
+
+Design parity: reference NIXL/RDT transports move tensor payloads as raw
+buffers with a small descriptor (shape/dtype/registration handle) on the
+side — serialization frameworks never touch the bytes. Here the same split
+is applied to the channel plane: a value's array leaves (numpy / jax) are
+lifted out of the object graph, the remaining skeleton is cloudpickled with
+tiny ``_Leaf`` placeholders, and one frame carries
+
+    [4B magic "RTF1"][u32 meta_len][meta pickle][64B-aligned payload]
+
+    meta = (skeleton_bytes, [(shape, dtype, payload_offset, nbytes), ...],
+            payload_off, total)
+
+so a writer memcpys leaf bytes straight into a shared-memory ring slot (or a
+socket) and a reader rebuilds the leaves with ``np.frombuffer`` over the
+frame — zero pickle work proportional to tensor size, and optionally zero
+copies at all (``copy=False`` aliases the frame buffer; the caller owns the
+aliasing lifetime — see docs/device_channels.md for the pin contract).
+
+dtypes travel as ``np.dtype`` objects (not names) so extension dtypes that
+jax emits on the host (ml_dtypes bfloat16/float8) round-trip bitwise.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import threading
+from typing import Any, List, Optional
+
+import cloudpickle
+import numpy as np
+
+MAGIC = b"RTF1"
+_U32 = struct.Struct("<I")
+_ALIGN = 64  # payload alignment: safe for every dtype + vectorized memcpy
+_MAX_DEPTH = 8  # container recursion bound (cycles/pathological nests -> pickle)
+
+
+class _Leaf:
+    """Placeholder for an extracted array leaf inside the pickled skeleton."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_Leaf, (self.index,))
+
+
+def _as_ndarray(value) -> Optional[np.ndarray]:
+    """The host-array view of a tensor leaf, or None if `value` is not one.
+
+    jax arrays are recognized without importing jax (if jax was never
+    imported, no jax array can exist); ``np.asarray`` on one is the D2H
+    materialization — single-frame writers pay it here, the chunked
+    DeviceChannel path slices the transfer instead (device_channel.py)."""
+    if isinstance(value, np.ndarray):
+        return None if value.dtype.hasobject else value
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        return np.asarray(value)
+    return None
+
+
+def _split(value, leaves: List[np.ndarray], min_bytes: int, depth: int = 0):
+    """Skeleton of `value` with array leaves >= min_bytes replaced by _Leaf."""
+    arr = _as_ndarray(value)
+    if arr is not None:
+        if arr.nbytes < min_bytes:
+            return value
+        leaves.append(np.ascontiguousarray(arr))
+        return _Leaf(len(leaves) - 1)
+    if depth >= _MAX_DEPTH:
+        return value
+    if type(value) is dict:
+        return {k: _split(v, leaves, min_bytes, depth + 1)
+                for k, v in value.items()}
+    if type(value) is list:
+        return [_split(v, leaves, min_bytes, depth + 1) for v in value]
+    if type(value) is tuple:
+        return tuple(_split(v, leaves, min_bytes, depth + 1) for v in value)
+    return value
+
+
+def _join(skeleton, leaves: List[np.ndarray], depth: int = 0):
+    if isinstance(skeleton, _Leaf):
+        return leaves[skeleton.index]
+    if depth >= _MAX_DEPTH:
+        return skeleton
+    if type(skeleton) is dict:
+        return {k: _join(v, leaves, depth + 1) for k, v in skeleton.items()}
+    if type(skeleton) is list:
+        return [_join(v, leaves, depth + 1) for v in skeleton]
+    if type(skeleton) is tuple:
+        return tuple(_join(v, leaves, depth + 1) for v in skeleton)
+    return skeleton
+
+
+def as_flat_bytes(arr: np.ndarray) -> np.ndarray:
+    """A 1-D uint8 alias of a C-contiguous array's bytes (no copy)."""
+    return arr.reshape(-1).view(np.uint8)
+
+
+class Plan:
+    """A sized, ready-to-memcpy tensor frame (header built, leaves staged).
+
+    Built once so transports can check the total against their slot capacity
+    BEFORE reserving buffer space, then `write_into` a raw destination."""
+
+    __slots__ = ("meta", "leaves", "descs", "payload_off", "total",
+                 "payload_bytes")
+
+    def __init__(self, skeleton_bytes: bytes, leaves: List[np.ndarray]):
+        self.leaves = leaves
+        self.descs = []
+        off = 0
+        for arr in leaves:
+            self.descs.append((arr.shape, arr.dtype, off, arr.nbytes))
+            off += arr.nbytes
+        self.payload_bytes = off
+        # payload_off is NOT in the meta: both sides derive it from the meta
+        # length (align past the header), so the header stays one pickle.
+        self.meta = pickle.dumps(
+            (skeleton_bytes, self.descs), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        header_len = len(MAGIC) + _U32.size + len(self.meta)
+        self.payload_off = header_len + (-header_len % _ALIGN)
+        self.total = self.payload_off + self.payload_bytes
+
+    def write_into(self, buf) -> int:
+        """memcpy the frame into a writable buffer; returns bytes written."""
+        mv = memoryview(buf)
+        mv[0:4] = MAGIC
+        _U32.pack_into(mv, 4, len(self.meta))
+        mv[8:8 + len(self.meta)] = self.meta
+        for arr, (_shape, _dtype, off, nbytes) in zip(self.leaves, self.descs):
+            if nbytes:
+                dst = self.payload_off + off
+                mv[dst:dst + nbytes] = as_flat_bytes(arr).data
+        return self.total
+
+    def to_bytes(self) -> bytearray:
+        out = bytearray(self.total)
+        self.write_into(out)
+        return out
+
+
+def plan(value: Any, min_bytes: int) -> Optional[Plan]:
+    """Build a tensor frame plan for `value`, or None when the value has no
+    array leaves >= min_bytes (caller falls back to plain pickling).
+    min_bytes < 0 disables the fast path entirely."""
+    if min_bytes < 0:
+        return None
+    leaves: List[np.ndarray] = []
+    skeleton = _split(value, leaves, max(0, min_bytes))
+    if not leaves:
+        return None
+    skeleton_bytes = cloudpickle.dumps(
+        skeleton, protocol=pickle.HIGHEST_PROTOCOL
+    )
+    return Plan(skeleton_bytes, leaves)
+
+
+def split(value: Any, min_bytes: int = 0):
+    """(skeleton_bytes, leaves) without frame layout — for chunked streams
+    (device_channel.py) that frame the payload themselves. Leaves keep their
+    original type: jax arrays stay ON DEVICE so the stream writer can slice
+    the D2H transfer instead of materializing the whole host copy."""
+    leaves: List[Any] = []
+
+    def walk(v, depth=0):
+        if _is_leaf(v, min_bytes):
+            leaves.append(v)
+            return _Leaf(len(leaves) - 1)
+        if depth >= _MAX_DEPTH:
+            return v
+        if type(v) is dict:
+            return {k: walk(x, depth + 1) for k, x in v.items()}
+        if type(v) is list:
+            return [walk(x, depth + 1) for x in v]
+        if type(v) is tuple:
+            return tuple(walk(x, depth + 1) for x in v)
+        return v
+
+    skeleton = walk(value)
+    return (
+        cloudpickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL), leaves
+    )
+
+
+def _is_leaf(value, min_bytes: int) -> bool:
+    if isinstance(value, np.ndarray):
+        return not value.dtype.hasobject and value.nbytes >= min_bytes
+    jax = sys.modules.get("jax")
+    return (
+        jax is not None
+        and isinstance(value, jax.Array)
+        and value.size * value.dtype.itemsize >= min_bytes
+    )
+
+
+def join(skeleton_bytes: bytes, leaves: List[Any]) -> Any:
+    """Inverse of split(): substitute materialized leaves into the skeleton."""
+    return _join(cloudpickle.loads(skeleton_bytes), leaves)
+
+
+def is_frame(buf) -> bool:
+    mv = memoryview(buf)
+    return len(mv) >= 8 and bytes(mv[0:4]) == MAGIC
+
+
+def decode(buf, *, copy: bool = True) -> Any:
+    """Rebuild the value from a tensor frame.
+
+    copy=True materializes owning arrays (safe when `buf` is a reusable ring
+    slot). copy=False aliases `buf` — zero-copy, read-only when `buf` is, and
+    only valid while the caller keeps the underlying buffer pinned."""
+    mv = memoryview(buf)
+    (meta_len,) = _U32.unpack_from(mv, 4)
+    skeleton_bytes, descs = pickle.loads(mv[8:8 + meta_len])
+    header_len = 8 + meta_len
+    payload_off = header_len + (-header_len % _ALIGN)
+    leaves = []
+    for shape, dtype, off, nbytes in descs:
+        src = payload_off + off
+        arr = np.frombuffer(mv[src:src + nbytes], dtype=dtype)
+        arr = arr.reshape(shape)
+        leaves.append(arr.copy() if copy else arr)
+    return _join(cloudpickle.loads(skeleton_bytes), leaves)
+
+
+# -- per-process transport accounting ---------------------------------------
+# Tests and CompiledDAG introspection read these to prove array payloads rode
+# the raw-buffer path (no cloudpickle of tensor bytes); util.metrics export
+# happens at the channel layer, which also feeds these.
+_stats_lock = threading.Lock()
+_stats = {
+    "tensor_frames_written": 0,
+    "tensor_frames_read": 0,
+    "tensor_bytes_written": 0,
+    "pickle_frames_written": 0,
+    "pickle_frames_read": 0,
+}
+
+
+def note(key: str, n: int = 1):
+    with _stats_lock:
+        _stats[key] += n
+
+
+def transport_stats() -> dict:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_transport_stats():
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0

@@ -708,13 +708,15 @@ class DecodeEngine:
             adapter=adapter,
         ))
 
-    def submit_prefilled(self, kv: np.ndarray, prompt_len: int,
+    def submit_prefilled(self, kv, prompt_len: int,
                          first_logits: np.ndarray, sampling: SamplingParams,
                          callback, lora: str = "",
                          token_ids: Optional[List[int]] = None):
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
-        transferred cache prefix, first_logits the last-position logits.
+        transferred cache prefix — host numpy, or a jax Array when the
+        DeviceChannel stream staged it on device (the attach then skips the
+        host round-trip) — and first_logits the last-position logits.
         token_ids (optional, the prompt behind kv) lets the transferred
         prefix feed this engine's KV prefix cache AND keeps the slot
         spec-eligible (the draft catches up on the token history)."""
@@ -985,18 +987,23 @@ class DecodeEngine:
     def _exec_attach(self, req: Request):
         """Transferred-prefix admission (PD disaggregation): attach the KV,
         sample the first token from the transferred logits, and feed the
-        slot straight into the scheduler's running queue."""
+        slot straight into the scheduler's running queue. kv may arrive as a
+        jax Array (the DeviceChannel streamed path device_puts chunks as they
+        land — docs/device_channels.md) — padding then stays on device and
+        the attach program consumes it without a host round-trip."""
         slot = req.slot
         kv = req.kv
+        on_device = isinstance(kv, jax.Array)
+        xp = jnp if on_device else np
         prompt_len = req.prompt_len
         # Pad the transferred prefix to a bucket so attach programs are reused.
         P = kv.shape[2]
         bucket = self._bucket(max(P, prompt_len))
         if P < bucket:
-            pad = np.zeros(
-                (kv.shape[0], 2, bucket - P) + kv.shape[3:], kv.dtype
+            pad = xp.zeros(
+                (kv.shape[0], 2, bucket - P) + tuple(kv.shape[3:]), kv.dtype
             )
-            kv = np.concatenate([kv, pad], axis=2)
+            kv = xp.concatenate([kv, pad], axis=2)
         elif P > bucket:
             kv = kv[:, :, :bucket]
         attach = self._program(
@@ -1004,7 +1011,7 @@ class DecodeEngine:
             lambda: jax.jit(self._attach_kv),
         )
         self._caches = attach(
-            self._caches, jnp.asarray(kv), jnp.int32(slot)
+            self._caches, kv if on_device else jnp.asarray(kv), jnp.int32(slot)
         )
         self._lens[slot] = prompt_len
         first = _sample_host(np.asarray(req.first_logits), req.sampling,
@@ -1018,8 +1025,13 @@ class DecodeEngine:
             bs = self._prefix_cache.block_size
             n = (prompt_len // bs) * bs
             if n:
+                # The pool wants host rows; a device-attached prefix pulls
+                # back once here, off the decode hot loop (host-path
+                # transfers are already numpy and insert for free).
                 self._prefix_cache.insert(
-                    prompt_tokens[:n], kv, namespace=req.adapter
+                    prompt_tokens[:n],
+                    np.asarray(kv) if on_device else kv,  # raylint: disable=RL603 (one per-admission pull feeding the prefix cache)
+                    namespace=req.adapter,
                 )
         if self._draft is not None:
             if prompt_tokens and len(prompt_tokens) >= prompt_len:

@@ -4,9 +4,13 @@ Design parity: reference `python/ray/llm/_internal/serve/deployments/
 prefill_decode_disagg/prefill_decode_disagg.py` — prefill replicas (compute-bound,
 batch-friendly) and decode replicas (latency-bound, slot-limited) scale
 independently; the prefill output KV cache transfers to a decode replica which
-continues generation. The reference moves KV over NIXL/RDMA; here the transfer
-rides the shared-memory object store (zero-copy intra-node, chunked push
-inter-node) — the KV prefix is a numpy array result of the prefill actor call.
+continues generation. The reference moves KV over NIXL/RDMA; here the prefill
+replica pins the prefix as a device object and the decode replica pulls it
+over a chunked DeviceChannel stream (round 11, docs/device_channels.md): a
+shm ring intra-node, chunked RPC frames across nodes — raw buffers behind a
+tiny pickled header, never a monolithic cloudpickled blob — with per-chunk
+device staging on real accelerators so the attach overlaps the tail of the
+transfer.
 """
 
 from __future__ import annotations
@@ -84,11 +88,23 @@ class DecodeServer:
         from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
 
         if isinstance(kv, DeviceObjectRef):
-            # Pull the KV prefix peer-to-peer from the prefill replica. The
-            # pin there releases when the ROUTER drops its reply reference
-            # (the descriptor in `pre`) after generate() returns — this call's
-            # borrowed arg holds it only transiently.
-            kv = await loop.run_in_executor(None, dev_get, kv)
+            # Pull the KV prefix peer-to-peer from the prefill replica over
+            # the chunked DeviceChannel stream. On real accelerators each
+            # chunk is device_put as it arrives, so the H2D leg of the attach
+            # overlaps the tail of the wire transfer and submit_prefilled
+            # receives a device-resident prefix; on the CPU backend the host
+            # assembly IS the attach staging, and the engine's one
+            # jnp.asarray aliases it. The pin on the prefill replica releases
+            # when the ROUTER drops its reply reference (the descriptor in
+            # `pre`) after generate() returns — this call's borrowed arg
+            # holds it only transiently.
+            import jax
+
+            to_device = jax.default_backend() != "cpu"
+            kv_ref = kv
+            kv = await loop.run_in_executor(
+                None, lambda: dev_get(kv_ref, to_device=to_device)
+            )
         done: asyncio.Future = loop.create_future()
         out: List[int] = []
 

@@ -1,0 +1,434 @@
+"""Tensor-native channel plane (round 11, docs/device_channels.md).
+
+Covers the ISSUE-8 acceptance surface: array payloads ride raw-buffer frames
+(no cloudpickle of tensor bytes), chunked DeviceChannel streams are bitwise
+across chunk-size sweeps (incl. non-divisible sizes), rings stay coherent
+after tensor writes, ChannelClosed mid-stream unwinds without leaking pins,
+RpcChannel readers ride transient failures, and PD KV handoff over the new
+transport is token-identical to the pre-change host path.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import tensor_transport as tt
+from ray_tpu.experimental.channel import Channel, ChannelClosed, RpcChannel
+from ray_tpu.experimental.device_channel import DeviceChannel
+
+
+def test_channel_tensor_fastpath_roundtrip():
+    """Array-bearing values cross a shm Channel as tensor frames (small
+    pickled skeleton + raw leaf bytes); scalars still pickle."""
+    tt.reset_transport_stats()
+    ch = Channel(capacity=1 << 20, num_readers=1, num_slots=2)
+    try:
+        r = ch.reader(0)
+        value = {
+            "a": np.arange(5000, dtype=np.float32),
+            "nested": [np.ones((16, 16), np.int16), "tag"],
+            "n": 7,
+        }
+        ch.write(value)
+        out = r.read()
+        np.testing.assert_array_equal(out["a"], value["a"])
+        np.testing.assert_array_equal(out["nested"][0], value["nested"][0])
+        assert out["nested"][1] == "tag" and out["n"] == 7
+        # Decoded arrays OWN their bytes: the ring slot may recycle.
+        assert out["a"].flags.owndata and out["a"].flags.writeable
+
+        ch.write({"just": "pickle"})
+        assert r.read() == {"just": "pickle"}
+
+        s = tt.transport_stats()
+        assert s["tensor_frames_written"] == 1, s
+        assert s["tensor_frames_read"] == 1, s
+        assert s["pickle_frames_written"] == 1, s
+        assert s["tensor_bytes_written"] >= value["a"].nbytes
+    finally:
+        ch.destroy()
+
+
+def test_ring_reuse_after_tensor_writes():
+    """Ring slots cycle through tensor and pickle frames interleaved, well
+    past the slot count, with every payload intact bitwise."""
+    ch = Channel(capacity=256 << 10, num_readers=1, num_slots=3)
+    try:
+        r = ch.reader(0)
+        rng = np.random.default_rng(0)
+        for i in range(20):
+            arr = rng.standard_normal(1 + 997 * i % 4096).astype(np.float32)
+            ch.write({"i": i, "arr": arr})
+            out = r.read()
+            assert out["i"] == i
+            np.testing.assert_array_equal(out["arr"], arr)
+            ch.write(("plain", i))
+            assert r.read() == ("plain", i)
+    finally:
+        ch.destroy()
+
+
+def test_read_view_lease_blocks_writer_not_corrupts():
+    """A zero-copy SlotView defers the ack: the writer back-pressures on the
+    leased slot instead of overwriting the bytes under the alias."""
+    ch = Channel(capacity=64 << 10, num_readers=1, num_slots=2)
+    try:
+        r = ch.reader(0)
+        payload = np.arange(4096, dtype=np.int32)
+        ch.write(payload)
+        view = r.read_view()
+        alias = tt.decode(view.mv, copy=False)
+        assert not alias.flags.owndata  # genuinely aliases the slot
+        np.testing.assert_array_equal(alias, payload)
+
+        ch.write({"fill": 1})  # second slot
+        blocked = threading.Thread(
+            target=lambda: ch.write({"third": 2}, timeout=10)
+        )
+        blocked.start()
+        time.sleep(0.2)
+        assert blocked.is_alive(), "writer must wait for the leased slot"
+        snapshot = alias.copy()
+        del alias
+        view.release()
+        blocked.join(5)
+        assert not blocked.is_alive()
+        np.testing.assert_array_equal(snapshot, payload)
+        assert r.read() == {"fill": 1} and r.read() == {"third": 2}
+    finally:
+        ch.destroy()
+
+
+@pytest.mark.parametrize("chunk_bytes", [1000, 4096, 12345, 1 << 16])
+def test_chunked_stream_numerics_sweep(chunk_bytes):
+    """DeviceChannel streams are bitwise across chunk sizes, including sizes
+    that do not divide the payload and mixed/extension dtypes."""
+    import jax.numpy as jnp
+
+    ch = DeviceChannel.create(same_node=True, chunk_bytes=chunk_bytes)
+    try:
+        rng = np.random.default_rng(1)
+        tree = {
+            "kv": rng.standard_normal((4, 2, 33, 2, 8)).astype(np.float32),
+            "bf16": jnp.arange(777, dtype=jnp.bfloat16),
+            "i8": rng.integers(-100, 100, 100003).astype(np.int8),
+            "empty": np.zeros((0, 3), np.float32),
+            "meta": {"prompt_len": 33},
+        }
+        writer = threading.Thread(target=lambda: ch.send(tree))
+        writer.start()
+        out = ch.recv(timeout=60)
+        writer.join(30)
+        np.testing.assert_array_equal(out["kv"], tree["kv"])
+        np.testing.assert_array_equal(out["bf16"], np.asarray(tree["bf16"]))
+        np.testing.assert_array_equal(out["i8"], tree["i8"])
+        assert out["empty"].shape == (0, 3)
+        assert out["meta"] == {"prompt_len": 33}
+
+        # Device-staged assembly (per-chunk device_put + one concat).
+        writer = threading.Thread(target=lambda: ch.send(tree))
+        writer.start()
+        dev = ch.recv_device(timeout=60)
+        writer.join(30)
+        np.testing.assert_array_equal(np.asarray(dev["kv"]), tree["kv"])
+        np.testing.assert_array_equal(
+            np.asarray(dev["bf16"]), np.asarray(tree["bf16"])
+        )
+        assert ch.drain(10)
+    finally:
+        ch.destroy()
+
+
+def test_device_channel_local_handoff():
+    """Same-process handoff moves device arrays by reference; a target
+    sharding rides jax.device_put (the ICI path on real meshes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ch = DeviceChannel.create(local=True)
+    try:
+        x = jnp.arange(1024.0)
+        ch.send(x)
+        assert ch.recv(timeout=10) is x  # zero transfer, zero staging
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+        sharding = NamedSharding(mesh, PartitionSpec("x"))
+        ch.send(x, sharding=sharding)
+        out = ch.recv(timeout=10)
+        assert out.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    finally:
+        ch.destroy()
+
+
+def test_channel_closed_mid_stream_unwinds_writer():
+    """Reader closing (or dying) mid-stream wakes the blocked writer with
+    ChannelClosed instead of wedging it on a full ring."""
+    ch = DeviceChannel.create(same_node=True, chunk_bytes=4096, num_slots=2)
+    outcome = []
+
+    def writer():
+        try:
+            ch.send({"big": np.arange(1_000_000, dtype=np.float32)},
+                    timeout=30)
+            outcome.append("sent")
+        except ChannelClosed:
+            outcome.append("closed")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.15)  # writer is deep in the chunk loop on a full ring
+    ch.close()
+    t.join(10)
+    assert outcome == ["closed"], outcome
+    ch.destroy()
+
+
+def test_stream_fetch_closed_reader_releases_owner_pins(ray_start_regular):
+    """A consumer that aborts a device-object stream mid-pull must not leak
+    the owner's pump (snapshot reference + shm segment): active_streams()
+    returns to zero and the pinned object survives for later readers."""
+    import jax.numpy as jnp
+
+    from ray_tpu.experimental import device_objects as dev
+
+    @ray_tpu.remote
+    class Owner:
+        def make(self, n):
+            return dev.put(jnp.arange(n, dtype=jnp.float32))
+
+        def open_stream(self, key, node):
+            return dev._open_stream(None, key, node, 4096)
+
+        def streams(self):
+            return dev.active_streams()
+
+        def pinned(self):
+            return len(dev.stored_keys())
+
+    owner = Owner.remote()
+    ref = ray_tpu.get(owner.make.remote(500_000), timeout=120)
+
+    w = ray_tpu.global_worker()
+    ch = ray_tpu.get(
+        owner.open_stream.remote(ref.key, w.node_id), timeout=120
+    )
+    # Read ONLY the header + one chunk, then abandon the stream.
+    header = ch._transport.read_bytes(timeout=30)
+    assert bytes(header[:4]) == b"RTS1"
+    ch._transport.read_bytes(timeout=30)
+    ch.close()
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if ray_tpu.get(owner.streams.remote(), timeout=60) == 0:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.get(owner.streams.remote(), timeout=60) == 0, (
+        "aborted stream leaked its owner-side pump"
+    )
+    # The pin itself is untouched: a fresh full fetch still works.
+    assert ray_tpu.get(owner.pinned.remote(), timeout=60) == 1
+    out = dev.get(ref)
+    np.testing.assert_array_equal(
+        out, np.arange(500_000, dtype=np.float32)
+    )
+
+
+def test_rpc_channel_transient_failures_retry_then_recover(ray_start_regular):
+    """Transient RpcError/OSError during a pull retries with backoff inside
+    the reconnect window (evicting dead conns from the cache) instead of
+    instantly declaring ChannelClosed; a persistent outage still closes."""
+    from ray_tpu._private import rpc
+    from ray_tpu.experimental import channel as chan_mod
+
+    ch = RpcChannel(capacity=1 << 16, num_readers=1, num_slots=2,
+                    owner=("addr", ("127.0.0.1", 1)))
+    ch.write({"v": 41})
+    ch.write({"v": 42})
+
+    fails = {"n": 2}
+
+    class FlakyConn:
+        closed = False
+
+        async def call(self, method, name, reader, index, poll):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise rpc.RpcError("transient blip")
+            return chan_mod._ring_pull(name, reader, index)
+
+    class DeadConn:
+        closed = True
+
+    reader = ch.reader(0)
+    flaky = FlakyConn()
+    reader._writer_conn = lambda: flaky
+    # A dead cached conn for the same writer must be evicted on failure.
+    with chan_mod._registry_lock:
+        chan_mod._conn_cache[("127.0.0.1", 1)] = DeadConn()
+    t0 = time.monotonic()
+    assert reader.read(timeout=30) == {"v": 41}
+    assert fails["n"] == 0
+    assert time.monotonic() - t0 < 10
+    with chan_mod._registry_lock:
+        assert ("127.0.0.1", 1) not in chan_mod._conn_cache
+    # Healthy again: the retry window re-arms, next reads are clean.
+    assert reader.read(timeout=30) == {"v": 42}
+
+    # Persistent failure: ChannelClosed after the reconnect window.
+    class AlwaysDown:
+        closed = False
+
+        async def call(self, *a, **k):
+            raise OSError("writer gone")
+
+    down = AlwaysDown()
+    reader2 = ch.reader(0)
+    reader2._next = 2
+    reader2._writer_conn = lambda: down
+    ch.write({"v": 43})
+    with pytest.raises(ChannelClosed):
+        reader2.read(timeout=30)
+    ch.destroy()
+
+
+def _run_engine(engine, submit):
+    out = []
+    done = threading.Event()
+
+    def cb(tok, fin):
+        out.append(tok)
+        if fin:
+            done.set()
+
+    submit(cb)
+    assert done.wait(300)
+    return out
+
+
+def test_engine_attaches_device_resident_kv():
+    """submit_prefilled accepts a jax-Array KV prefix (the streamed
+    recv_device path) and emits exactly the host-path greedy tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = [5, 9, 17, 3, 42, 8]
+    n = 6
+
+    prefiller = DecodeEngine(cfg, params, num_slots=1, max_seq=128,
+                             decode_loop=False)
+    host_dec = DecodeEngine(cfg, params, num_slots=1, max_seq=128)
+    dev_dec = DecodeEngine(cfg, params, num_slots=1, max_seq=128)
+    try:
+        first_logits, kv, plen = prefiller.prefill_detached(prompt)
+        expect = _run_engine(host_dec, lambda cb: host_dec.submit_prefilled(
+            kv, plen, first_logits, SamplingParams(max_tokens=n), cb,
+            token_ids=prompt))
+        got = _run_engine(dev_dec, lambda cb: dev_dec.submit_prefilled(
+            jnp.asarray(kv), plen, first_logits,
+            SamplingParams(max_tokens=n), cb, token_ids=prompt))
+        assert got == expect
+    finally:
+        prefiller.shutdown()
+        host_dec.shutdown()
+        dev_dec.shutdown()
+
+
+def test_pd_token_identity_stream_vs_host_path(ray_start_regular):
+    """End-to-end PD across real actor processes: the chunked tensor stream
+    must produce byte-equal greedy output to the legacy host-blob path AND to
+    a monolithic single-engine reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    prompt = [7, 21, 3, 9, 54, 11, 2, 30]
+    n = 8
+
+    @ray_tpu.remote
+    class Prefill:
+        def __init__(self):
+            from ray_tpu.experimental import device_objects as dev_mod
+
+            cfg = get_config("test-tiny", scan_layers=False, remat=False)
+            params = Transformer(cfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            self._dev = dev_mod
+            self._engine = DecodeEngine(cfg, params, num_slots=1,
+                                        max_seq=128, decode_loop=False)
+
+        def prefill(self, token_ids):
+            first_logits, kv, plen = self._engine.prefill_detached(token_ids)
+            return {"logits": first_logits, "kv": self._dev.put(kv),
+                    "plen": plen}
+
+    @ray_tpu.remote
+    class Decode:
+        def __init__(self):
+            cfg = get_config("test-tiny", scan_layers=False, remat=False)
+            params = Transformer(cfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            self._engine = DecodeEngine(cfg, params, num_slots=2, max_seq=128)
+
+        def generate(self, pre, token_ids, max_tokens, legacy):
+            from ray_tpu.experimental import device_objects as dev_mod
+
+            if legacy:
+                kv = dev_mod.get(pre["kv"], _legacy=True)
+            else:
+                # Force the chunked stream (tiny test prefixes sit below the
+                # devobj_stream_min_bytes production gate).
+                kv = dev_mod._stream_fetch(pre["kv"], to_device=False)
+            out, done = [], threading.Event()
+
+            def cb(tok, fin):
+                out.append(tok)
+                if fin:
+                    done.set()
+
+            self._engine.submit_prefilled(
+                kv, pre["plen"], pre["logits"],
+                SamplingParams(max_tokens=max_tokens), cb,
+                token_ids=token_ids,
+            )
+            assert done.wait(300)
+            return out
+
+    # Monolithic reference in the driver.
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mono = DecodeEngine(cfg, params, num_slots=1, max_seq=128)
+    try:
+        expect = _run_engine(mono, lambda cb: mono.submit(
+            prompt, SamplingParams(max_tokens=n), cb))
+    finally:
+        mono.shutdown()
+
+    prefill, decode = Prefill.remote(), Decode.remote()
+    pre = ray_tpu.get(prefill.prefill.remote(prompt), timeout=300)
+    streamed = ray_tpu.get(
+        decode.generate.remote(pre, prompt, n, False), timeout=300
+    )
+    host_blob = ray_tpu.get(
+        decode.generate.remote(pre, prompt, n, True), timeout=300
+    )
+    assert streamed == host_blob == expect, (streamed, host_blob, expect)
