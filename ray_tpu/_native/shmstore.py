@@ -10,6 +10,7 @@ import ctypes
 from typing import Optional, Tuple
 
 from ray_tpu._native import ensure_built
+from ray_tpu.devtools import leaksan as _leaksan
 
 _lib = None
 
@@ -109,12 +110,18 @@ class _ArenaHandle:
         h = self._h
         if h is None:
             return False
-        return self._lib.shmstore_pin(h, object_id) == 0
+        ok = self._lib.shmstore_pin(h, object_id) == 0
+        if ok:
+            _leaksan.track("shm_pin", token=(self.name, bytes(object_id)))
+        return ok
 
     def release(self, object_id: bytes) -> bool:
         if self._h is None:
             return False
-        return self._lib.shmstore_release(self._h, object_id) == 0
+        ok = self._lib.shmstore_release(self._h, object_id) == 0
+        if ok:
+            _leaksan.untrack("shm_pin", token=(self.name, bytes(object_id)))
+        return ok
 
     # Allocation/seal/free run directly in shared memory under the arena's
     # process-shared robust mutex, so BOTH the server (raylet) and clients
@@ -180,6 +187,7 @@ class _PinnedRegion:
 
     def __del__(self):
         try:
+            # raylint: disable=RL802 (buffer-protocol lifetime IS the release path: every alias built over memoryview(region) holds this object, and the pin must outlive the last alias — PEP 688)
             self._handle.release(self._object_id)
         except Exception:
             pass

@@ -102,6 +102,9 @@ class Connection:
         self._recv_task: asyncio.Task | None = None
         self.peer_protocol: int | None = None  # set by the peer's HELLO
         self._legacy_warned = False
+        from ray_tpu.devtools import leaksan as _leaksan
+
+        _leaksan.track("rpc_conn", self, detail=f"conn {name}")
 
     def start(self):
         loop = asyncio.get_running_loop()
@@ -242,6 +245,9 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        from ray_tpu.devtools import leaksan as _leaksan
+
+        _leaksan.untrack("rpc_conn", self)
         for fut in self._pending.values():
             if not fut.done():
                 # Fresh instance per future (shared exception objects chain

@@ -1354,7 +1354,7 @@ class CoreWorker:
                         _native_key(object_id), eager=True
                     )
                 except Exception:
-                    pass
+                    pass  # arena gone/object already evicted: the raylet free below is authoritative
             try:
                 self._queue_store_op(("free", object_id))
             except Exception:
@@ -2058,7 +2058,11 @@ class CoreWorker:
             st = self._leases.get(shape)
             if st is None:
                 if conn is not None:
+                    # The lease state vanished while we were connecting: give
+                    # the lease back AND close the socket — nothing will ever
+                    # use this conn, and an unclosed one lingers until GC.
                     self.io.spawn(self.raylet.notify("release_lease", resp["worker_id"]))
+                    self.io.spawn(conn.close())
                 return
             st["requesting"] = False
             if conn is not None:

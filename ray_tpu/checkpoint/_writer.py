@@ -122,6 +122,9 @@ class AsyncCheckpointWriter:
         with self._lock:
             self._pending += 1
             self._idle.clear()
+        from ray_tpu.devtools import leaksan as _leaksan
+
+        _leaksan.track("ckpt_pending", token=f"writer@{id(self):x}")
         self._ensure_thread()
         self._queue.put(job)  # blocks when the in-flight budget is exhausted
         m = _get_metrics()
@@ -173,6 +176,9 @@ class AsyncCheckpointWriter:
                     self._pending -= 1
                     if self._pending == 0:
                         self._idle.set()
+                from ray_tpu.devtools import leaksan as _leaksan
+
+                _leaksan.untrack("ckpt_pending", token=f"writer@{id(self):x}")
                 _get_metrics()["queue_depth"].set(float(self._pending))
 
     def _run_job(self, job: dict):

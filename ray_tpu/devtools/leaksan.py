@@ -1,0 +1,256 @@
+"""leaksan: runtime leak sanitizer for the lease/pin/stream planes.
+
+The LeakSanitizer-style counterpart of `raylint`'s RL8xx static family: the
+resource classes leaklint reasons about statically (SlotView ring-slot
+leases, PrefixLease KV pins, native-arena pins, device-object stream pumps,
+rpc connections, checkpoint writer jobs, dp replica-rank tokens) register
+their live handles here, and a test fixture (tests/conftest.py
+`leaksan_guard`) snapshots the registry around each test and fails on
+growth.
+
+Zero overhead unless enabled: every `track`/`untrack` call starts with one
+enabled() check (an env read / cached bool); nothing is allocated and no
+lock is taken when the sanitizer is off. Enable with `RAY_TPU_LEAKSAN=1` in
+the environment, or programmatically with `enable()` (what the pytest
+fixture does).
+
+Two ways a handle is accounted:
+
+- **object-tracked** (`track(kind, obj)`): a weakref with a death callback.
+  An explicit release untracks it; an object that is garbage-collected
+  WITHOUT having been released moves to the `<kind>:gc` bucket — for a
+  cross-process resource that is a leak the GC hid (a SlotView collected
+  without release never published its ack; a PrefixLease collected without
+  release pins its blocks forever), so the fixture fails on those too.
+- **token-tracked** (`track(kind, token=...)`): a counted key for resources
+  with no dedicated Python handle (arena pins by object id, stream pumps,
+  rank tokens). `untrack` decrements; counts never go negative.
+
+`leak_report()` lists what is live (and what leaked through GC) with the
+detail string each site registered; `live_counts()` is the cheap summary;
+both also export the `leaksan_live_handles{kind}` gauge via util.metrics.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+import weakref
+from typing import Dict, Iterable, List, Optional
+
+# RLock: a weakref death callback can fire on THIS thread mid-track (GC
+# triggered by an allocation inside the critical section) and re-enter.
+_lock = threading.RLock()
+_enabled_override: Optional[bool] = None
+# kind -> {id(obj): (weakref, detail)} for object-tracked handles
+_objects: Dict[str, Dict[int, tuple]] = {}
+# kind -> {token: count} for token-tracked handles
+_tokens: Dict[str, Dict[object, int]] = {}
+# kind -> count of objects GC'd while still tracked (released by nobody)
+_gc_leaked: Dict[str, int] = {}
+
+#: Thread-name prefixes that belong to the resource planes leaksan audits;
+#: the pytest fixture counts only these (worker/executor threads are
+#: process-lifetime by design and would make growth checks meaningless).
+THREAD_PREFIXES = ("devobj-stream", "ckpt-writer", "chan-pump")
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("RAY_TPU_LEAKSAN", "") == "1"
+
+
+def enable() -> None:
+    global _enabled_override
+    _enabled_override = True
+
+
+def disable() -> None:
+    """Stop tracking NEW handles. Untrack keeps working so handles acquired
+    while enabled still balance their books."""
+    global _enabled_override
+    _enabled_override = False
+
+
+def reset() -> None:
+    """Drop every tracked handle and gc-leak tally (test isolation)."""
+    with _lock:
+        _objects.clear()
+        _tokens.clear()
+        _gc_leaked.clear()
+
+
+def track(kind: str, obj: object = None, *, token: object = None,
+          detail: str = "") -> None:
+    """Register a live handle. No-op (and allocation-free) when disabled."""
+    if not enabled():
+        return
+    if obj is not None:
+        oid = id(obj)
+
+        def _on_gc(_ref, _kind=kind, _oid=oid):
+            with _lock:
+                entries = _objects.get(_kind)
+                if entries is not None and entries.pop(_oid, None) is not None:
+                    # died tracked = never released: the GC hid a leak
+                    _gc_leaked[_kind] = _gc_leaked.get(_kind, 0) + 1
+
+        ref = weakref.ref(obj, _on_gc)
+        with _lock:
+            _objects.setdefault(kind, {})[oid] = (ref, detail)
+    elif token is not None:
+        with _lock:
+            bucket = _tokens.setdefault(kind, {})
+            bucket[token] = bucket.get(token, 0) + 1
+
+
+def untrack(kind: str, obj: object = None, *, token: object = None) -> None:
+    """Balance a `track`. Runs even when disabled (consistent books for
+    handles acquired while enabled); never throws, never goes negative.
+    Pure dict work: gauges export from live_counts(), never from data paths
+    (a release can run on an io-loop thread mid-connection-teardown, where a
+    metrics flush — a blocking GCS RPC — would deadlock the loop)."""
+    with _lock:
+        if obj is not None:
+            entries = _objects.get(kind)
+            if entries is not None:
+                entries.pop(id(obj), None)
+        elif token is not None:
+            bucket = _tokens.get(kind)
+            if bucket is not None and token in bucket:
+                bucket[token] -= 1
+                if bucket[token] <= 0:
+                    del bucket[token]
+
+
+def live_counts() -> Dict[str, int]:
+    """{kind: live handles} plus `<kind>:gc` buckets for handles that were
+    garbage-collected without ever being released."""
+    with _lock:
+        out: Dict[str, int] = {}
+        for kind, entries in _objects.items():
+            # drop entries whose referent died but whose callback hasn't run
+            live = {k: v for k, v in entries.items() if v[0]() is not None}
+            if len(live) != len(entries):
+                _gc_leaked[kind] = _gc_leaked.get(kind, 0) + (
+                    len(entries) - len(live)
+                )
+                _objects[kind] = live
+            if live:
+                out[kind] = len(live)
+        for kind, bucket in _tokens.items():
+            n = sum(bucket.values())
+            if n:
+                out[kind] = out.get(kind, 0) + n
+        for kind, n in _gc_leaked.items():
+            if n:
+                out[f"{kind}:gc"] = n
+    _export_gauges(out)
+    return out
+
+
+def leak_report() -> Dict[str, List[str]]:
+    """{kind: [detail, ...]} for every live handle (token kinds render as
+    `token xN`); includes the `<kind>:gc` buckets."""
+    counts = live_counts()  # refreshes dead weakrefs first
+    with _lock:
+        report: Dict[str, List[str]] = {}
+        for kind, entries in _objects.items():
+            details = [
+                d or f"handle@{oid:x}" for oid, (r, d) in entries.items()
+                if r() is not None
+            ]
+            if details:
+                report[kind] = details
+        for kind, bucket in _tokens.items():
+            items = [f"{tok!r} x{n}" for tok, n in bucket.items()]
+            if items:
+                report.setdefault(kind, []).extend(items)
+        for kind, n in counts.items():
+            if kind.endswith(":gc"):
+                report[kind] = [f"{n} handle(s) garbage-collected unreleased"]
+        return report
+
+
+def tracked_threads() -> List[str]:
+    """Live threads belonging to the audited resource planes."""
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(THREAD_PREFIXES)
+    )
+
+
+def snapshot() -> Dict[str, object]:
+    """What the pytest fixture compares across a test: live handle counts
+    (incl. gc-leak buckets) and the audited thread names."""
+    return {"handles": live_counts(), "threads": tracked_threads()}
+
+
+def check_growth(before: Dict[str, object], *, settle_s: float = 3.0,
+                 ignore: Iterable[str] = ("rpc_conn",)) -> Dict[str, object]:
+    """Compare the registry against `before`, giving async teardown (stream
+    pump threads, background release callbacks, GC) up to `settle_s` seconds
+    to drain. Returns {} when clean, else {kind: (before, after)} growth plus
+    a "report" key with per-handle detail.
+
+    `rpc_conn` is ignored by default: connections are deliberately cached
+    per (process, peer address) for the process lifetime, so a test that
+    dials a new peer legitimately grows the cache (docs/raylint.md)."""
+    deadline = time.monotonic() + max(0.0, settle_s)
+    ignore = set(ignore)
+    while True:
+        gc.collect()
+        after = snapshot()
+        growth: Dict[str, object] = {}
+        b_handles: Dict[str, int] = dict(before.get("handles", {}))
+        for kind, n in after["handles"].items():
+            if kind in ignore or kind.split(":", 1)[0] in ignore:
+                continue
+            if n > b_handles.get(kind, 0):
+                growth[kind] = (b_handles.get(kind, 0), n)
+        b_threads = set(before.get("threads", []))
+        new_threads = [t for t in after["threads"] if t not in b_threads]
+        if new_threads:
+            growth["threads"] = (sorted(b_threads), after["threads"])
+        if not growth or time.monotonic() >= deadline:
+            if growth:
+                growth["report"] = leak_report()
+            return growth
+        time.sleep(0.05)
+
+
+_gauge = None
+_gauge_kinds_seen: set = set()
+
+
+def _export_gauges(counts: Dict[str, int]) -> None:
+    """Best-effort `leaksan_live_handles{kind}` export via util.metrics.
+
+    Deliberately runs ONLY from live_counts()/snapshot() (caller threads, on
+    their own schedule): track/untrack fire on data-plane and io-loop threads
+    where a metrics flush — a blocking GCS round-trip — must never run. A
+    kind that drops to zero is still exported (the gauge falls, not
+    disappears)."""
+    global _gauge
+    if not enabled():
+        return
+    try:
+        if _gauge is None:
+            from ray_tpu.util import metrics
+
+            _gauge = metrics.Gauge(
+                "leaksan_live_handles",
+                "live acquire/release-paired resource handles (leaksan)",
+                tag_keys=("kind",),
+            )
+        with _lock:
+            _gauge_kinds_seen.update(counts)
+            kinds = set(_gauge_kinds_seen)
+        # set() outside the lock: a gauge flush is a GCS round-trip
+        for kind in kinds:
+            _gauge.set(float(counts.get(kind, 0)), tags={"kind": kind})
+    except Exception:
+        pass  # observability must never break the workload

@@ -30,6 +30,14 @@ jaxlint family (compute plane; files that import jax only):
 - RL605 donation-misuse       donated argument read after the call
 - RL701 side-effect-under-jit traced fn mutates self/globals/closures
 
+leaklint family (resource-lifetime plane; see also devtools/leaksan.py,
+the runtime live-handle sanitizer these checkers pair with):
+
+- RL801 unreleased-acquire    lease/pin/conn not released on every path
+- RL802 release-via-gc-only   cross-process release reachable only from __del__
+- RL803 use/double-release    handle used or released again after release
+- RL804 fragile-release       swallowed release failure / lock-mismatched release
+
 Suppress a finding with a trailing (or immediately preceding) comment::
 
     ref = actor.ping.remote()  # raylint: disable=RL501

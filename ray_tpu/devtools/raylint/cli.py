@@ -7,7 +7,13 @@ Exit-status contract (stable; CI consumers key off it):
   `--no-baseline` — that flag widens what is *reported*, never what fails).
 - 1 — at least one non-baselined violation (or, with `--fail-stale`,
   a stale baseline entry).
-- 2 — usage error (unknown code in --select, bad flag value).
+- 2 — usage error (unknown code in --select, --only pattern matching no
+  code, bad flag value).
+
+`--select` (exact codes), `--only` (patterns like RL8xx), and `--family`
+(concurrency/jax/leak) narrow which findings and stale entries COUNT; they
+never change how the exit status is derived — each lint plane can therefore
+run and be gated independently under the same contract.
 
 Output formats:
 
@@ -26,12 +32,34 @@ import sys
 
 from ray_tpu.devtools.raylint.core import (
     CODES,
+    FAMILIES,
     Finding,
     emit_baseline,
     lint_paths,
     load_baseline,
     partition_baselined,
 )
+
+
+def _expand_only(patterns: str) -> set[str] | None:
+    """`--only RL8xx,RL101` -> concrete code set. A trailing run of `x`s is a
+    wildcard over the tail (`RL8xx` = every RL8 code); unknown patterns are a
+    usage error (None)."""
+    out: set[str] = set()
+    for raw in patterns.split(","):
+        pat = raw.strip()
+        if not pat:
+            continue
+        stripped = pat.rstrip("xX")
+        matched = {
+            c for c in CODES
+            if c == pat or (len(stripped) < len(pat) and c.startswith(stripped)
+                            and len(c) == len(pat))
+        }
+        if not matched:
+            return None
+        out |= matched
+    return out
 
 
 def _finding_dict(f: Finding) -> dict:
@@ -60,6 +88,18 @@ def main(argv: list[str] | None = None) -> int:
                              "filled in by hand)")
     parser.add_argument("--select", default=None,
                         help="comma-separated codes to run (default: all)")
+    parser.add_argument("--only", default=None,
+                        help="comma-separated code patterns to run; a "
+                             "trailing run of x's wildcards the tail "
+                             "(e.g. RL8xx = the whole leaklint family)")
+    parser.add_argument("--family", default=None,
+                        choices=sorted(FAMILIES),
+                        help="run one checker family (concurrency = RL1xx-"
+                             "RL5xx, jax = RL6xx/RL7xx, leak = RL8xx); "
+                             "composable with --select/--only (union). The "
+                             "exit contract is unchanged: filters narrow "
+                             "which findings (and stale entries) count, "
+                             "never how the exit status is derived")
     parser.add_argument("--codes", action="store_true",
                         help="list checker codes and exit")
     parser.add_argument("--format", choices=("text", "json"), default="text",
@@ -79,12 +119,25 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     codes = None
+    selected: set[str] = set()
     if args.select:
-        codes = {c.strip() for c in args.select.split(",") if c.strip()}
-        unknown = codes - set(CODES)
+        picked = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = picked - set(CODES)
         if unknown:
             print(f"unknown code(s): {sorted(unknown)}", file=sys.stderr)
             return 2
+        selected |= picked
+    if args.only:
+        expanded = _expand_only(args.only)
+        if expanded is None:
+            print(f"--only pattern matches no known code: {args.only}",
+                  file=sys.stderr)
+            return 2
+        selected |= expanded
+    if args.family:
+        selected |= FAMILIES[args.family]
+    if selected:
+        codes = selected
 
     findings = lint_paths(args.paths, codes=codes)
 

@@ -56,6 +56,27 @@ CODES: dict[str, str] = {
     "RL701": "side-effect-under-jit: a function handed to jax.jit/lax.scan/"
              "shard_map mutates self/globals/closures — the effect runs at "
              "trace time only and captured tracers escape the trace",
+    # -- leaklint family (resource-lifetime plane) ---------------------------
+    "RL801": "unreleased-acquire: an acquired resource (slot-view lease, KV "
+             "prefix lease, arena pin, stream channel, rpc conn, rank token) "
+             "is not released on every path — no finally/with, and the "
+             "handle neither returned, stored, nor passed on",
+    "RL802": "release-via-gc-only: a cross-process resource release "
+             "reachable only from __del__ — GC timing (or an uncollected "
+             "cycle) then decides when the peer's pin/slot/rank frees",
+    "RL803": "use-after-release / double-release of a resource handle along "
+             "a straight-line path (no re-acquire in between)",
+    "RL804": "fragile-release: a failing release silently swallowed by an "
+             "undocumented broad except, or a release performed under a "
+             "different lock than its acquire",
+}
+
+#: Checker families, for the CLI's `--family` filter and the per-family
+#: tier-1 gates: each lint plane can run and be gated independently.
+FAMILIES: dict[str, frozenset] = {
+    "concurrency": frozenset(c for c in CODES if c[2] in "12345"),
+    "jax": frozenset(c for c in CODES if c[2] in "67"),
+    "leak": frozenset(c for c in CODES if c[2] == "8"),
 }
 
 _DISABLE_MARK = "raylint:"

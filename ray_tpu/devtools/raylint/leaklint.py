@@ -1,0 +1,799 @@
+"""leaklint: the RL8xx checker family — resource-lifetime hazards.
+
+The runtime is built on acquire/release-paired resources that outlive the
+Python object holding them: shm ring-slot leases whose ack publishes at
+release (`SlotView`), ref-counted KV prefix leases that pin evictable blocks
+(`PrefixLease`), native-arena pins, device-object stream pumps, RPC
+connections, checkpoint writers, DP replica-rank tokens, raylet resource
+leases. One missed release on an error path is silent back-pressure, wedged
+eviction, or unbounded HBM/shm growth — never a crash, which is exactly why
+review misses it. leaklint is the static half (Infer-style per-function
+path reasoning over a declarative resource table); `ray_tpu/devtools/
+leaksan.py` is the runtime half (LeakSanitizer-style live-handle
+accounting).
+
+Shared model:
+
+- **Resource table** (`RESOURCE_TABLE`): maps acquire APIs to their release
+  obligation. Handle-returning acquires (`Channel.read_view` ->
+  `SlotView.release`, `PrefixCacheManager.lookup` -> `PrefixLease.release`,
+  `rpc.connect` -> `Connection.close`, `DeviceChannel.create` -> `destroy`,
+  `AsyncCheckpointWriter()` -> `wait_until_finished`/`close`) bind the
+  obligation to the returned handle; arg-keyed acquires (`shmstore.pin` ->
+  `release`, `KVBlockPool.incref` -> `decref`, raylet `resources.acquire`
+  -> `resources.release`, `DPRankAssigner.assign` -> `release`) bind it to
+  (receiver, first argument).
+- **Ownership escape** discharges the per-function obligation: the handle is
+  returned/yielded, stored onto `self`/a container, passed to another
+  callable, or captured by a nested function — the resource's lifetime is
+  then the owner's problem (and RL802 checks the owner's class).
+- **Class-managed** arg-keyed resources (the enclosing class calls the
+  paired release in some non-`__del__` method) are exempt from the
+  per-function RL801 check: cross-method acquire/release is the normal shape
+  for stateful owners, and RL802 catches the GC-only degenerate case.
+
+Checkers:
+
+- RL801 unreleased-acquire: an acquired resource is, on some path, neither
+  released nor escaped — never released at all, released only under an
+  unrelated condition, or released on the fall-through path with raise-capable
+  statements in between and no `finally`/`with`.
+- RL802 release-via-gc-only: a cross-process release reachable only from
+  `__del__` — GC timing (or a never-collected cycle) then decides when the
+  peer's pin/slot/rank frees.
+- RL803 use-after-release / double-release along a straight-line path.
+- RL804 fragile-release: a release whose failure is silently swallowed by an
+  undocumented broad `except`, or a release performed under a different lock
+  than its acquire.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.raylint.checkers import (
+    _base_ident,
+    _ident_parts,
+    _is_lockish,
+    _root_name,
+)
+from ray_tpu.devtools.raylint.core import FileContext, Finding
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    """One row of the acquire->release contract table."""
+
+    kind: str                      # human name used in messages
+    acquire: str                   # method/ctor name (leading "_" ignored)
+    hints: tuple = ()              # receiver-ident words; () = match anywhere
+    release: tuple = ()            # methods on the returned handle
+    receiver_release: tuple = ()   # methods on the SAME receiver (arg-keyed)
+    arg_keyed: bool = False        # obligation keyed by (receiver, arg0)
+
+
+RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
+    ResourceSpec("shm ring-slot lease (SlotView)", "read_view",
+                 release=("release",)),
+    ResourceSpec("KV prefix lease (PrefixLease)", "lookup",
+                 hints=("cache", "prefix"), release=("release",)),
+    ResourceSpec("native-arena pin", "pin",
+                 receiver_release=("release",), arg_keyed=True),
+    ResourceSpec("KV block refcount", "incref", hints=("pool",),
+                 receiver_release=("decref",), arg_keyed=True),
+    ResourceSpec("device stream channel", "create", hints=("channel",),
+                 release=("destroy", "close")),
+    ResourceSpec("rpc connection", "connect", hints=("rpc",),
+                 release=("close",)),
+    ResourceSpec("async checkpoint writer", "AsyncCheckpointWriter",
+                 release=("wait_until_finished", "close")),
+    ResourceSpec("dp replica-rank token", "assign", hints=("assigner",),
+                 receiver_release=("release",), arg_keyed=True),
+    ResourceSpec("raylet resource lease", "acquire", hints=("resources",),
+                 receiver_release=("release",), arg_keyed=True),
+)
+
+#: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803
+#: key off these (union of the table plus the teardown verbs owners use).
+RELEASE_NAMES: Set[str] = set()
+for _spec in RESOURCE_TABLE:
+    RELEASE_NAMES.update(_spec.release)
+    RELEASE_NAMES.update(_spec.receiver_release)
+RELEASE_NAMES.update({"destroy", "free", "shutdown", "wait_until_finished"})
+
+#: The subset whose silent failure RL804 cares about (a swallowed `close` on
+#: teardown is routine; a swallowed lease/pin release is a wedge).
+_RL804_RELEASE_NAMES = {"release", "decref", "destroy", "free",
+                        "wait_until_finished"}
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _strip_remote(func: ast.expr) -> Tuple[Optional[str], Optional[ast.expr]]:
+    """(method name, receiver expr) of a call func, looking through the
+    actor-call `.remote` hop (`assigner.release.remote(tok)` -> release)."""
+    if isinstance(func, ast.Attribute):
+        name, recv = func.attr, func.value
+        if name == "remote" and isinstance(recv, ast.Attribute):
+            name, recv = recv.attr, recv.value
+        return name, recv
+    if isinstance(func, ast.Name):
+        return func.id, None
+    return None, None
+
+
+def _recv_parts(recv: Optional[ast.expr]) -> Set[str]:
+    """Ident words of the whole receiver chain (`self._prefix_cache` ->
+    {prefix, cache, self})."""
+    parts: Set[str] = set()
+    e = recv
+    while isinstance(e, (ast.Attribute, ast.Subscript)):
+        if isinstance(e, ast.Attribute):
+            parts |= _ident_parts(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts |= _ident_parts(e.id)
+    return parts
+
+
+def _spec_for_call(call: ast.Call) -> Optional[ResourceSpec]:
+    name, recv = _strip_remote(call.func)
+    if name is None:
+        return None
+    stripped = name.lstrip("_") or name
+    for spec in RESOURCE_TABLE:
+        if spec.acquire not in (name, stripped):
+            continue
+        if spec.hints and not (_recv_parts(recv) & set(spec.hints)):
+            continue
+        return spec
+    return None
+
+
+def _contains_call(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) for n in ast.walk(node))
+
+
+def _bare_names(expr: ast.expr) -> Set[str]:
+    """Names appearing as direct values (possibly inside container displays)
+    — NOT as attribute/subscript bases. `lease` in `return lease` or
+    `f(lease)` escapes ownership; `lease.matched_tokens` does not."""
+    out: Set[str] = set()
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            stack.extend(e.elts)
+        elif isinstance(e, ast.Dict):
+            stack.extend(v for v in e.values if v is not None)
+        elif isinstance(e, ast.Starred):
+            stack.append(e.value)
+        elif isinstance(e, (ast.Await, ast.NamedExpr)):
+            stack.append(e.value)
+        elif isinstance(e, ast.IfExp):
+            stack.extend((e.body, e.orelse))
+    return out
+
+
+class _Acquire:
+    __slots__ = ("spec", "handle", "aliases", "token", "recv_parts",
+                 "line", "col", "lock_stack", "call")
+
+    def __init__(self, spec, handle, token, recv_parts, line, col,
+                 lock_stack, call):
+        self.spec = spec
+        self.handle = handle          # local name, or None for arg-keyed
+        self.aliases: Set[str] = {handle} if handle else set()
+        self.token = token            # first-arg dump, for arg-keyed
+        self.recv_parts = recv_parts
+        self.line = line
+        self.col = col
+        self.lock_stack = lock_stack  # innermost-last tuple of lock idents
+        self.call = call
+
+
+class _Release:
+    __slots__ = ("name", "recv", "recv_parts", "base_name", "token", "line",
+                 "in_finally", "in_except", "if_tests", "lock_stack",
+                 "swallowed_line")
+
+    def __init__(self, name, recv, recv_parts, base_name, token, line,
+                 in_finally, in_except, if_tests, lock_stack, swallowed_line):
+        self.name = name              # release method name
+        self.recv = recv
+        self.recv_parts = recv_parts
+        self.base_name = base_name    # root Name of receiver ("lease")
+        self.token = token            # first-arg dump (or None)
+        self.line = line
+        self.in_finally = in_finally
+        self.in_except = in_except
+        self.if_tests: List[ast.expr] = if_tests
+        self.lock_stack = lock_stack
+        # set when the release sits alone in a try whose broad handler
+        # swallows silently (RL804a); value is the handler's lineno
+        self.swallowed_line = swallowed_line
+
+
+class _FunctionScan(ast.NodeVisitor):
+    """One pass over a single function body (nested defs excluded) that
+    collects acquires, releases, calls, loads, assigns, and escapes."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.acquires: List[_Acquire] = []
+        self.releases: List[_Release] = []
+        self.call_lines: List[int] = []       # every Call's lineno
+        self.loads: List[Tuple[str, int, bool]] = []  # (name, line, is_rel_base)
+        self.assign_lines: Dict[str, List[int]] = {}
+        self.escaped: Set[str] = set()
+        self.aliases: Dict[str, str] = {}     # alias -> original
+        self.returns_while: List[int] = []    # linenos of return/raise stmts
+        self._lock_stack: List[str] = []
+        self._finally_depth = 0
+        self._except_depth = 0
+        self._if_tests: List[ast.expr] = []
+        self._with_acquire_calls: Set[int] = set()   # id() of safe with-acquires
+        self._swallow_trys: Dict[int, int] = {}      # id(stmt in try body)->line
+        self._scan()
+
+    # -- structure ----------------------------------------------------------
+
+    def _scan(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+
+    def _skip(self, node):  # nested scopes analyzed on their own
+        # closure capture = ownership escape for anything acquired out here
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self.escaped.add(n.id)
+
+    visit_FunctionDef = _skip
+    visit_AsyncFunctionDef = _skip
+    visit_Lambda = _skip
+    visit_ClassDef = _skip
+
+    def visit_With(self, node):
+        lockish = [item.context_expr for item in node.items
+                   if _is_lockish(item.context_expr)]
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call) and _spec_for_call(ce) is not None:
+                self._with_acquire_calls.add(id(ce))
+        for ce in lockish:
+            self._lock_stack.append(_base_ident(ce) or "<lock>")
+        self.generic_visit(node)
+        for _ in lockish:
+            self._lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Try(self, node):
+        # mark the try-body statements of a silent broad-except swallow
+        swallows = False
+        for h in node.handlers:
+            broad = h.type is None or (
+                isinstance(h.type, ast.Name) and h.type.id in _BROAD_EXC
+            )
+            if not broad:
+                continue
+            body_is_silent = all(
+                isinstance(s, ast.Pass)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))
+                for s in h.body
+            )
+            if body_is_silent:
+                swallows = True
+                handler_line = h.body[0].lineno if h.body else h.lineno
+        if swallows:
+            for s in node.body:
+                self._swallow_trys[id(s)] = handler_line
+        for s in node.body:
+            self.visit(s)
+        self._except_depth += 1
+        for h in node.handlers:
+            for s in h.body:
+                self.visit(s)
+        self._except_depth -= 1
+        for s in node.orelse:
+            self.visit(s)
+        self._finally_depth += 1
+        for s in node.finalbody:
+            self.visit(s)
+        self._finally_depth -= 1
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self._if_tests.append(node.test)
+        for s in node.body:
+            self.visit(s)
+        self._if_tests.pop()
+        self._if_tests.append(ast.UnaryOp(op=ast.Not(), operand=node.test))
+        for s in node.orelse:
+            self.visit(s)
+        self._if_tests.pop()
+
+    # -- events -------------------------------------------------------------
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self.visit(t)
+        value = node.value
+        handled = self._bind_acquires(node)
+        # alias tracking: `v = lease` makes v carry the same obligation
+        if isinstance(value, ast.Name):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases[t.id] = self.aliases.get(value.id, value.id)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.assign_lines.setdefault(t.id, []).append(node.lineno)
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                # stored onto an object/container: ownership escapes
+                self.escaped |= _bare_names(value)
+        if isinstance(value, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+            # packed into a container display: the container (not the bare
+            # name) now carries the handle, and tracking where IT goes is
+            # beyond a per-function pass — treat as ownership escape
+            self.escaped |= _bare_names(value)
+        if handled:
+            return
+
+    def _bind_acquires(self, assign: ast.Assign) -> bool:
+        """Acquire calls anywhere in the RHS of `name = ...` bind the target
+        name as the handle (wrappers like `io.run(rpc.connect(...))` or
+        `await connect(...)` keep the resource behind the outer result)."""
+        hit = False
+        targets = [t for t in assign.targets if isinstance(t, ast.Name)]
+        attr_target = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                          for t in assign.targets)
+        for call in ast.walk(assign.value):
+            if not isinstance(call, ast.Call) or id(call) in self._with_acquire_calls:
+                continue
+            spec = _spec_for_call(call)
+            if spec is None or spec.arg_keyed:
+                continue  # arg-keyed acquires are recorded from _on_call
+            hit = True
+            if attr_target and not targets:
+                continue  # self.x = acquire(): ownership escapes
+            if targets:
+                self._record_acquire(spec, targets[0].id, call)
+            else:
+                self._record_acquire(spec, None, call)
+        return hit
+
+    def _record_acquire(self, spec, handle, call):
+        token = None
+        if spec.arg_keyed and call.args:
+            token = ast.dump(call.args[0])
+        _name, recv = _strip_remote(call.func)
+        self.acquires.append(_Acquire(
+            spec, handle, token, _recv_parts(recv), call.lineno,
+            call.col_offset, tuple(self._lock_stack), call,
+        ))
+
+    def visit_Expr(self, node):
+        # bare-statement acquire: handle (if any) is discarded on the spot
+        swallow_line = self._swallow_trys.get(id(node))
+        call = node.value
+        while isinstance(call, ast.Await):
+            call = call.value
+        if isinstance(call, ast.Call) and id(call) not in self._with_acquire_calls:
+            spec = _spec_for_call(call)
+            if spec is not None and not spec.arg_keyed:
+                self._record_acquire(spec, None, call)
+        self._visit_expr_tree(node.value, swallow_line)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.escaped |= _bare_names(node.value)
+            self.visit(node.value)
+        self.returns_while.append(node.lineno)
+
+    def visit_Raise(self, node):
+        self.generic_visit(node)
+        self.returns_while.append(node.lineno)
+
+    def _visit_expr_tree(self, expr, swallow_line=None):
+        self.visit(expr) if not isinstance(expr, ast.Call) else None
+        if isinstance(expr, ast.Call):
+            self._on_call(expr, swallow_line)
+            for a in expr.args:
+                self.visit(a)
+            for kw in expr.keywords:
+                self.visit(kw.value)
+            self.visit(expr.func)
+
+    def visit_Call(self, node):
+        self._on_call(node, None)
+        self.generic_visit(node)
+
+    def _on_call(self, node: ast.Call, swallow_line):
+        self.call_lines.append(node.lineno)
+        # Arg-keyed acquires (pin/incref/resources.acquire/assign) carry no
+        # handle, so they are tracked from any expression position — an
+        # `if not srv.pin(key):` guard is as much an acquire as a bare call.
+        if id(node) not in self._with_acquire_calls:
+            spec = _spec_for_call(node)
+            if spec is not None and spec.arg_keyed:
+                self._record_acquire(spec, None, node)
+        name, recv = _strip_remote(node.func)
+        if name in RELEASE_NAMES:
+            base = _root_name(recv) if recv is not None else None
+            token = ast.dump(node.args[0]) if node.args else None
+            self.releases.append(_Release(
+                name, recv, _recv_parts(recv), base, token, node.lineno,
+                self._finally_depth > 0, self._except_depth > 0,
+                list(self._if_tests), tuple(self._lock_stack), swallow_line,
+            ))
+        # call-arg escape: f(handle) hands ownership to the callee
+        for a in node.args:
+            self.escaped |= _bare_names(a)
+        for kw in node.keywords:
+            self.escaped |= _bare_names(kw.value)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.loads.append((node.id, node.lineno, False))
+
+    def visit_Attribute(self, node):
+        # record the base name of `<name>.<rel>()` loads separately so the
+        # double-release check can tell them from value uses
+        if isinstance(node.value, ast.Name) and isinstance(
+            node.value.ctx, ast.Load
+        ):
+            self.loads.append(
+                (node.value.id, node.lineno, node.attr in RELEASE_NAMES)
+            )
+            return
+        self.generic_visit(node)
+
+    def visit_Yield(self, node):
+        if node.value is not None:
+            self.escaped |= _bare_names(node.value)
+        self.generic_visit(node)
+
+    def visit_YieldFrom(self, node):
+        self.escaped |= _bare_names(node.value)
+        self.generic_visit(node)
+
+
+def _test_mentions(test: ast.expr, names: Set[str]) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+    return False
+
+
+class _ClassInventory:
+    """Per-class release-call facts for the class-managed exemption and
+    RL802."""
+
+    def __init__(self, tree: ast.AST):
+        # class name -> method name -> list of (base ident, recv parts, rel)
+        self.releases: Dict[str, Dict[str, List[Tuple[str, Set[str], str]]]] = {}
+        self.methods: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            per_method: Dict[str, List[Tuple[str, Set[str], str]]] = {}
+            names: Set[str] = set()
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                names.add(item.name)
+                calls = []
+                for n in ast.walk(item):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    name, recv = _strip_remote(n.func)
+                    if name in RELEASE_NAMES and recv is not None:
+                        calls.append((
+                            _base_ident(recv) or "", _recv_parts(recv), name,
+                        ))
+                per_method[item.name] = calls
+            self.releases[node.name] = per_method
+            self.methods[node.name] = names
+
+    def class_managed(self, cls: Optional[str], recv_parts: Set[str],
+                      rel_names: tuple) -> bool:
+        """Does `cls` release this receiver in any non-__del__ method?"""
+        if cls is None:
+            return False
+        for method, calls in self.releases.get(cls, {}).items():
+            if method == "__del__":
+                continue
+            for _base, parts, rel in calls:
+                if rel in rel_names and parts & recv_parts:
+                    return True
+        return False
+
+
+class _LeakChecker:
+    def __init__(self, ctx: FileContext, inv: _ClassInventory):
+        self.ctx = ctx
+        self.inv = inv
+        self.findings: List[Finding] = []
+
+    def check_module(self) -> "_LeakChecker":
+        self._walk(self.ctx.tree, scope=[], cls=None)
+        return self
+
+    def _walk(self, node, scope: List[str], cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._check_class(child, scope + [child.name])
+                self._walk(child, scope + [child.name], child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(child, scope + [child.name], cls)
+                self._walk(child, scope + [child.name], None)
+            else:
+                self._walk(child, scope, cls)
+
+    def _emit(self, line: int, code: str, message: str, scope: List[str]):
+        self.findings.append(Finding(
+            self.ctx.relpath, line, code, message,
+            ".".join(scope) if scope else "<module>",
+        ))
+
+    # -- RL802 ---------------------------------------------------------------
+
+    def _check_class(self, node: ast.ClassDef, scope: List[str]):
+        per_method = self.inv.releases.get(node.name, {})
+        del_calls = per_method.get("__del__")
+        if not del_calls:
+            return
+        dels = next(
+            (m for m in node.body
+             if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and m.name == "__del__"),
+            None,
+        )
+        if dels is None:
+            return
+        for n in ast.walk(dels):
+            if not isinstance(n, ast.Call):
+                continue
+            name, recv = _strip_remote(n.func)
+            if name not in RELEASE_NAMES or recv is None:
+                continue
+            base = _base_ident(recv) or ""
+            # delegation to the class's own public release path is the fix,
+            # not the bug: `self.release()` in __del__ is a GC backstop
+            if (_root_name(recv) == "self" and isinstance(recv, ast.Name)
+                    and name in self.inv.methods.get(node.name, set())):
+                continue
+            elsewhere = False
+            for method, calls in per_method.items():
+                if method == "__del__":
+                    continue
+                if any(b == base and rel == name for b, _p, rel in calls):
+                    elsewhere = True
+                    break
+            if not elsewhere:
+                self._emit(
+                    n.lineno, "RL802",
+                    f"`{base}.{name}()` is reachable only from __del__: for a "
+                    "cross-process resource (pin/lease/rank/conn) GC timing — "
+                    "or a reference cycle that never collects — decides when "
+                    "the peer frees it; add an explicit release path and keep "
+                    "__del__ as the backstop",
+                    scope + ["__del__"],
+                )
+
+    # -- per-function checks -------------------------------------------------
+
+    def _check_function(self, fn, scope: List[str], cls: Optional[str]):
+        scan = _FunctionScan(fn)
+        canonical_escaped = {
+            scan.aliases.get(n, n) for n in scan.escaped
+        } | scan.escaped
+        for acq in scan.acquires:
+            if acq.handle is not None:
+                acq.aliases = {
+                    a for a, orig in scan.aliases.items()
+                    if orig == acq.handle
+                } | {acq.handle}
+            if acq.spec.arg_keyed:
+                self._check_arg_keyed(acq, scan, scope, cls, fn)
+            else:
+                self._check_handle(acq, scan, scope, canonical_escaped)
+        self._check_rl804_swallow(scan, scope)
+
+    def _releases_for_handle(self, acq: _Acquire, scan: _FunctionScan):
+        return [
+            r for r in scan.releases
+            if r.base_name in acq.aliases and r.name in acq.spec.release
+            and r.line >= acq.line
+        ]
+
+    def _check_handle(self, acq, scan, scope, escaped: Set[str]):
+        if acq.handle is None:
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} acquired by `{acq.spec.acquire}(...)` and "
+                "discarded: the handle (and its release obligation) is lost "
+                "on the spot — bind it and release in a finally, or use "
+                "`with`",
+                scope,
+            )
+            return
+        if acq.aliases & escaped:
+            return  # ownership left this function
+        rels = self._releases_for_handle(acq, scan)
+        if not rels:
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} `{acq.handle}` is never released on any "
+                f"path of this function (and neither returned, stored, nor "
+                f"passed on): release it in a finally or use `with "
+                f"{acq.spec.acquire}(...)`",
+                scope,
+            )
+            return
+        if any(r.in_finally for r in rels):
+            self._check_rl803(acq, rels, scan, scope)
+            self._check_rl804_locks(acq, rels, scope)
+            return
+        # conditional release: guarded by something other than the handle
+        handle_names = set(acq.aliases)
+        conditional = [
+            r for r in rels
+            if r.in_except or any(
+                not _test_mentions(t, handle_names) for t in r.if_tests
+            )
+        ]
+        if len(conditional) == len(rels):
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} `{acq.handle}` is released only on some "
+                "paths (the release sits under a condition/except that does "
+                "not test the handle itself): paths that skip it leak the "
+                "resource — release in a finally",
+                scope,
+            )
+            return
+        first = min(r.line for r in rels if r not in conditional)
+        risky = [
+            ln for ln in scan.call_lines
+            if acq.line < ln < first
+        ]
+        if risky:
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} `{acq.handle}` is released only on the "
+                f"fall-through path: the call(s) between acquire (line "
+                f"{acq.line}) and release (line {first}) can raise and leak "
+                "it — move the release into a finally or use `with`",
+                scope,
+            )
+        self._check_rl803(acq, rels, scan, scope)
+        self._check_rl804_locks(acq, rels, scope)
+
+    def _check_arg_keyed(self, acq, scan, scope, cls, fn):
+        if self.inv.class_managed(cls, acq.recv_parts,
+                                  acq.spec.receiver_release):
+            return
+        rels = [
+            r for r in scan.releases
+            if r.name in acq.spec.receiver_release
+            and r.recv_parts & acq.recv_parts
+            and (acq.token is None or r.token == acq.token)
+            and r.line >= acq.line
+        ]
+        if not rels:
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} acquired here is never released in this "
+                f"function (no matching "
+                f"`.{'/'.join(acq.spec.receiver_release)}(...)` on the same "
+                "receiver and key), and no owning class provides a release "
+                "path: pair it in a finally or give the owner an explicit "
+                "release method",
+                scope,
+            )
+            return
+        if any(r.in_finally for r in rels):
+            self._check_rl804_locks(acq, rels, scope)
+            return
+        first = min(r.line for r in rels)
+        risky = [ln for ln in scan.call_lines if acq.line < ln < first]
+        if risky:
+            self._emit(
+                acq.line, "RL801",
+                f"{acq.spec.kind} acquired on line {acq.line} is released on "
+                f"line {first} with raise-capable calls in between and no "
+                "finally: the error path leaks it",
+                scope,
+            )
+        self._check_rl804_locks(acq, rels, scope)
+
+    def _check_rl803(self, acq, rels, scan, scope):
+        """Straight-line use-after-release / double-release, forgiving
+        rebinds (`v = chan.read_view()` again) between the two sites."""
+        first_rel = min(r.line for r in rels)
+        assigns = []
+        for name in acq.aliases:
+            assigns.extend(scan.assign_lines.get(name, []))
+        reported_double = False
+        for name, line, is_rel_base in scan.loads:
+            if name not in acq.aliases or line <= first_rel:
+                continue
+            if any(first_rel < a <= line for a in assigns):
+                continue
+            if is_rel_base:
+                if any(r.line == line and r.in_finally for r in rels):
+                    continue  # the finally release IS the first release
+                if not reported_double:
+                    self._emit(
+                        line, "RL803",
+                        f"`{name}` is released again on line {line} after the "
+                        f"release on line {first_rel} (no re-acquire in "
+                        "between): double-release — even an idempotent "
+                        "release here usually means two owners disagree",
+                        scope,
+                    )
+                    reported_double = True
+            else:
+                self._emit(
+                    line, "RL803",
+                    f"`{name}` is used on line {line} after its release on "
+                    f"line {first_rel}: the slot/blocks behind it may already "
+                    "be recycled — move the use before the release",
+                    scope,
+                )
+
+    def _check_rl804_locks(self, acq, rels, scope):
+        if not acq.lock_stack:
+            return
+        for r in rels:
+            if r.lock_stack and r.lock_stack[-1] != acq.lock_stack[-1]:
+                self._emit(
+                    r.line, "RL804",
+                    f"release performed under lock `{r.lock_stack[-1]}` but "
+                    f"the acquire on line {acq.line} ran under "
+                    f"`{acq.lock_stack[-1]}`: the two sections do not "
+                    "exclude each other, so release can race the acquire's "
+                    "bookkeeping — use one lock for both sides",
+                    scope,
+                )
+
+    def _check_rl804_swallow(self, scan, scope):
+        for r in scan.releases:
+            if r.swallowed_line is None:
+                continue
+            if r.name not in _RL804_RELEASE_NAMES:
+                continue
+            # an explanatory comment in the handler documents the swallow
+            if any(
+                ln in self.ctx.comment_lines
+                for ln in range(r.line, r.swallowed_line + 2)
+            ):
+                continue
+            self._emit(
+                r.line, "RL804",
+                f"a failing `.{r.name}()` is silently swallowed by the bare "
+                "except below: if the release raises, the resource stays "
+                "held and nothing ever reports it — log, comment, or "
+                "narrow the except",
+                scope,
+            )
+
+
+def check_leak_file(ctx: FileContext) -> List[Finding]:
+    inv = _ClassInventory(ctx.tree)
+    checker = _LeakChecker(ctx, inv).check_module()
+    # __del__ bodies are exempt from the swallow check (a destructor must
+    # never raise; RL802 owns the __del__ plane), so drop those here where
+    # the symbol is known.
+    return [
+        f for f in checker.findings
+        if not (f.code == "RL804" and f.symbol.endswith("__del__"))
+    ]

@@ -39,6 +39,7 @@ from typing import Any, Optional
 
 import cloudpickle
 
+from ray_tpu.devtools import leaksan as _leaksan
 from ray_tpu.experimental import tensor_transport as _tt
 
 _U64 = struct.Struct("<Q")
@@ -105,11 +106,12 @@ class SlotView:
     valid. Not releasing a lease blocks the writer on a full ring — the
     contract is back-pressure, never corruption (docs/device_channels.md)."""
 
-    __slots__ = ("mv", "_release")
+    __slots__ = ("mv", "_release", "__weakref__")
 
     def __init__(self, mv, release):
         self.mv = mv
         self._release = release
+        _leaksan.track("slot_view", self, detail=f"{len(mv)}B ring-slot lease")
 
     def release(self):
         rel, self._release = self._release, None
@@ -120,6 +122,7 @@ class SlotView:
                 pass  # caller still aliases the slot bytes; their export holds
             self.mv = None
             rel()
+            _leaksan.untrack("slot_view", self)
 
     def __enter__(self):
         return self

@@ -374,6 +374,10 @@ def _open_stream(_instance, key: str, reader_node, chunk_bytes):
     )
     with _streams_lock:
         _active_streams += 1
+    from ray_tpu.devtools import leaksan as _leaksan
+
+    stream_token = f"devobj-stream:{key[:8]}@{id(ch):x}"
+    _leaksan.track("devobj_stream", token=stream_token)
 
     def pump():
         global _active_streams
@@ -390,6 +394,7 @@ def _open_stream(_instance, key: str, reader_node, chunk_bytes):
             finally:
                 with _streams_lock:
                     _active_streams -= 1
+                _leaksan.untrack("devobj_stream", token=stream_token)
 
     threading.Thread(target=pump, name="devobj-stream", daemon=True).start()
     return ch

@@ -252,6 +252,12 @@ class LLMServer:
         this replica's engine. See docs/scheduler.md."""
         return self._engine.scheduler_stats()
 
+    async def shutdown(self):
+        """Explicit retirement hook (the serve controller calls it, bounded,
+        before the hard kill): stop the stepper and fail queued requests so
+        blocked submitters unwind NOW instead of when GC notices."""
+        self._engine.shutdown()
+
     def __del__(self):
         try:
             self._engine.shutdown()

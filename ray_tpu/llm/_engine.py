@@ -687,8 +687,12 @@ class DecodeEngine:
         """callback(token_id: int, finished: bool) per generated token.
 
         Raises ValueError when the prompt cannot fit the engine's sequence
-        budget (it is never silently truncated), and EngineOverloadedError
-        when the admission queue is at its depth cap."""
+        budget (it is never silently truncated), EngineOverloadedError when
+        the admission queue is at its depth cap, and RuntimeError when the
+        stepper is dead (shut down or crashed) — a dead engine must reject
+        work loudly, not enqueue it where no loop will ever run it (the
+        caller's callback would otherwise wait forever)."""
+        self._check_alive()
         token_ids = list(token_ids) or [0]  # empty prompt decodes from token 0
         if len(token_ids) > self.T - 1:
             raise ValueError(
@@ -720,6 +724,7 @@ class DecodeEngine:
         token_ids (optional, the prompt behind kv) lets the transferred
         prefix feed this engine's KV prefix cache AND keeps the slot
         spec-eligible (the draft catches up on the token history)."""
+        self._check_alive()
         if prompt_len >= self.T:
             raise ValueError(
                 f"transferred KV prefix of {prompt_len} tokens does not fit this "
@@ -758,9 +763,15 @@ class DecodeEngine:
         if self._prefix_cache is not None:
             lease = self._prefix_cache.lookup(prompt, namespace=adapter)
         if lease is not None:
-            m = lease.matched_tokens
-            prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
-            lease.release()
+            # finally, not straight-line: a raise out of kv() or the suffix
+            # prefill would otherwise pin the leased blocks forever (the
+            # detached path has no scheduler drain to back-stop it), wedging
+            # eviction for the rest of the engine's life.
+            try:
+                m = lease.matched_tokens
+                prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
+            finally:
+                lease.release()
             first_logits, kv = self._detached_suffix(
                 prompt, m, prefix_kv, adapter
             )
@@ -884,10 +895,37 @@ class DecodeEngine:
         )  # [L, 2, m + sb, Hkv, D]; rows [0, prompt_len) valid
         return first_logits, kv
 
+    def _check_alive(self):
+        """Reject submissions to a dead engine instead of enqueueing work no
+        stepper will ever run (the caller's callback would hang forever)."""
+        if self.error is not None:
+            raise RuntimeError(
+                "engine stepper died; no further requests are accepted"
+            ) from self.error
+        if self._stop:
+            raise RuntimeError("engine is shut down")
+
     def shutdown(self):
+        """Idempotent. Stops the stepper, then fails every request that was
+        admitted but never got a slot: their prefix-cache leases release and
+        their callbacks fire (token=-1, finished=True) so submitters blocked
+        on generation unwind instead of hanging."""
         self._stop = True
         if self._thread is not None:
             self._thread.join(timeout=5)
+        for slot in self._sched.slots:
+            if slot.active and slot.callback is not None:
+                slot.active = False
+                try:
+                    slot.callback(-1, True)
+                except Exception:
+                    pass  # shutdown must proceed past a broken callback
+        for req in self._sched.drain():
+            if req.callback is not None:
+                try:
+                    req.callback(-1, True)
+                except Exception:
+                    pass  # shutdown must proceed past a broken callback
 
     # -- stepper -----------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -928,24 +966,30 @@ class DecodeEngine:
         if chunk.is_first and req.lease is not None:
             # Attach the cached prefix through the padded-bucket attach
             # path, then prefill only the suffix (in chunks). The lease
-            # pins the blocks until the host->device copy is staged.
-            prefix_kv = req.lease.kv()
-            mb = self._bucket(req.cached_offset)
-            if prefix_kv.shape[2] < mb:
-                pad = np.zeros(
-                    (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
-                    + prefix_kv.shape[3:], prefix_kv.dtype,
+            # pins the blocks until the host->device copy is staged; it
+            # releases in a finally — on an attach failure the stepper dies
+            # and the scheduler drain would release it too, but only after
+            # req.lease was cleared here, so the release must not depend on
+            # the happy path.
+            try:
+                prefix_kv = req.lease.kv()
+                mb = self._bucket(req.cached_offset)
+                if prefix_kv.shape[2] < mb:
+                    pad = np.zeros(
+                        (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
+                        + prefix_kv.shape[3:], prefix_kv.dtype,
+                    )
+                    prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
+                attach = self._program(
+                    self._jit_prefill, ("attach", mb),
+                    lambda: jax.jit(self._attach_kv),
                 )
-                prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
-            attach = self._program(
-                self._jit_prefill, ("attach", mb),
-                lambda: jax.jit(self._attach_kv),
-            )
-            self._caches = attach(
-                self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
-            )
-            req.lease.release()
-            req.lease = None
+                self._caches = attach(
+                    self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
+                )
+            finally:
+                req.lease.release()
+                req.lease = None
         padded = np.zeros((1, chunk.bucket), np.int32)
         padded[0, : len(chunk.tokens)] = chunk.tokens
         prefill = self._program(

@@ -76,7 +76,11 @@ class DPLLMServer(LLMServer):
             ray_tpu.get_runtime_context().get_actor_id().hex()
         )
         self._assigner = assigner
+        self._rank_released = False
         self.dp_rank = ray_tpu.get(assigner.assign.remote(self._replica_token))
+        from ray_tpu.devtools import leaksan
+
+        leaksan.track("dp_rank_token", token=self._replica_token)
         super().__init__(config)
 
     async def get_dp_rank(self) -> int:
@@ -99,9 +103,32 @@ class DPLLMServer(LLMServer):
         stats = await super().scheduler_stats()
         return {"dp_rank": self.dp_rank, **stats}
 
+    def _release_rank(self):
+        """Idempotent: hand the dp rank back to the assigner exactly once
+        (double release would free a rank a LIVE successor already claimed).
+        Returns the in-flight ref, or None when already released."""
+        if self._rank_released:
+            return None
+        self._rank_released = True
+        from ray_tpu.devtools import leaksan
+
+        leaksan.untrack("dp_rank_token", token=self._replica_token)
+        return self._assigner.release.remote(self._replica_token)
+
+    async def shutdown(self):
+        """Explicit retirement: release the rank NOW (the assigner's lazy
+        dead-actor reclamation is the backstop, not the path) and stop the
+        engine."""
+        ref = self._release_rank()
+        if ref is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: ray_tpu.get(ref, 5)
+            )
+        await super().shutdown()
+
     def __del__(self):
         try:
-            self._assigner.release.remote(self._replica_token)  # raylint: disable=RL501 (__del__ cannot block; assigner audits stale tokens)
+            self._release_rank()  # fire-and-forget: __del__ cannot block; assigner audits stale tokens
         except Exception:
             pass
 

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu.devtools import leaksan as _leaksan
 from ray_tpu.llm.kvcache.block_pool import KVBlockPool
 from ray_tpu.llm.kvcache.radix import RadixIndex
 
@@ -74,7 +75,8 @@ def _metrics() -> Dict[str, object]:
 class PrefixLease:
     """A pinned cached prefix: block chain + token count, released after attach."""
 
-    __slots__ = ("_manager", "block_ids", "matched_tokens", "namespace", "_released")
+    __slots__ = ("_manager", "block_ids", "matched_tokens", "namespace",
+                 "_released", "__weakref__")
 
     def __init__(self, manager: "PrefixCacheManager", block_ids: List[int],
                  matched_tokens: int, namespace: int):
@@ -83,6 +85,11 @@ class PrefixLease:
         self.matched_tokens = matched_tokens
         self.namespace = namespace
         self._released = False
+        _leaksan.track(
+            "kv_lease", self,
+            detail=f"{matched_tokens} tok / {len(block_ids)} blocks "
+                   f"({manager.name})",
+        )
 
     def kv(self) -> np.ndarray:
         """[L, 2, matched_tokens, Hkv, D] — concatenation of the leased blocks.
@@ -94,6 +101,7 @@ class PrefixLease:
         if not self._released:
             self._released = True
             self._manager._release(self.block_ids)
+            _leaksan.untrack("kv_lease", self)
 
     def __enter__(self):
         return self

@@ -56,6 +56,10 @@ class PrefillServer:
     async def cache_stats(self) -> Optional[dict]:
         return self._engine.prefix_cache_stats()
 
+    async def shutdown(self):
+        """Explicit retirement hook for the serve controller's retire path."""
+        self._engine.shutdown()
+
     def __del__(self):
         try:
             self._engine.shutdown()
@@ -135,6 +139,12 @@ class DecodeServer:
 
     async def scheduler_stats(self) -> dict:
         return self._engine.scheduler_stats()
+
+    async def shutdown(self):
+        """Explicit retirement hook: stops the stepper and fails queued
+        requests, so a decode replica retired mid-stream unblocks its
+        in-flight generate_prefilled() callers instead of stranding them."""
+        self._engine.shutdown()
 
     def __del__(self):
         try:

@@ -196,8 +196,11 @@ class Scheduler:
             return len(self._waiting)
 
     def drain(self) -> List[Request]:
-        """Remove every queued and in-prefill request (stepper death path):
-        the engine fails their callbacks so submitters don't hang."""
+        """Remove every queued and in-prefill request (stepper death and
+        engine shutdown path): the engine fails their callbacks so submitters
+        don't hang. Idempotent, and exception-safe per request — one lease
+        whose release raises must not leave the remaining requests leased
+        (and their submitters hung): every request is still returned."""
         with self._lock:
             queued = list(self._waiting)
             self._waiting.clear()
@@ -205,8 +208,11 @@ class Scheduler:
         self._prefilling = []
         for r in queued:
             if r.lease is not None:
-                r.lease.release()
-                r.lease = None
+                lease, r.lease = r.lease, None
+                try:
+                    lease.release()
+                except Exception:
+                    pass  # pool poisoned mid-death; the callbacks must still fail
         self._queue_gauge.set(0.0)
         return queued
 

@@ -411,7 +411,7 @@ class ServeController:
             self._mux_ids.pop(key, None)
         for replicas in self._replicas.pop(app, {}).values():
             for r in replicas:
-                self._kill(r)
+                await self._retire(r)
         await self._persist_registry()
         return True
 
@@ -441,6 +441,21 @@ class ServeController:
             ray_tpu.kill(actor)
         except Exception:
             pass
+
+    async def _retire(self, actor):
+        """Graceful replica retirement (delete/scale-down path): give the
+        wrapped instance's shutdown() hook a bounded chance to release
+        cross-process resources — dp rank tokens, engine steppers, stream
+        pumps — before the hard kill reclaims the process. Dead-replica and
+        stale-redeploy kills stay on the fast `_kill` path: those replicas
+        are gone or about to be replaced wholesale."""
+        from ray_tpu.serve._common import async_get
+
+        try:
+            await async_get(actor.prepare_shutdown.remote(), timeout=2)
+        except Exception:
+            pass  # replica dead or unresponsive: the hard kill reclaims it
+        self._kill(actor)
 
     # -- routing tables ----------------------------------------------------
     async def get_replicas(self, app: str, deployment: str) -> dict:
@@ -550,7 +565,7 @@ class ServeController:
                 self._bump(app, name)
             while len(replicas) > want:
                 victim = replicas.pop()
-                self._kill(victim)
+                await self._retire(victim)
                 self._bump(app, name)
 
     def _bump(self, app: str, name: str):

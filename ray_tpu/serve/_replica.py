@@ -53,6 +53,20 @@ class Replica:
             await out
         return True
 
+    async def prepare_shutdown(self) -> bool:
+        """Graceful pre-kill hook: run the wrapped instance's `shutdown()`
+        (if it defines one) so cross-process resources — dp rank tokens,
+        engine stepper threads, stream pumps — release explicitly instead of
+        relying on actor-death GC. Best-effort by contract: the controller
+        bounds the wait and hard-kills regardless of the outcome."""
+        fn = getattr(self._instance, "shutdown", None)
+        if fn is None or not callable(fn):
+            return False
+        out = fn()
+        if inspect.isawaitable(out):
+            await out
+        return True
+
     async def _resolve_ref_args(self, args: tuple, kwargs: dict):
         """Chained DeploymentResponses arrive as ObjectRefs nested inside the args
         tuple (not top-level task args), so resolve them here — off the event

@@ -29,6 +29,43 @@ def pytest_configure(config):
         "wall-clock budget (tier-1 runs -m 'not slow')",
     )
 
+
+# -- leaksan guard (docs/raylint.md §leaksan) ---------------------------------
+# The suites whose tests exercise the acquire/release-paired resource planes
+# (slot-view leases, KV prefix leases, arena pins, device-object stream
+# pumps): each test in them runs under the runtime leak sanitizer and FAILS
+# if it grows the live-handle registry.
+LEAKSAN_SUITES = {
+    "test_tensor_channel.py",
+    "test_llm_kvcache.py",
+    "test_device_objects.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def leaksan_guard(request):
+    fspath = getattr(request.node, "fspath", None)
+    name = os.path.basename(str(fspath)) if fspath is not None else ""
+    if name not in LEAKSAN_SUITES:
+        yield
+        return
+    from ray_tpu.devtools import leaksan
+
+    leaksan.enable()
+    before = leaksan.snapshot()
+    yield
+    # rpc conns are cached per (process, peer) for the process lifetime by
+    # design, so they are reported but not failed on; pump threads and every
+    # lease/pin/view/stream kind must return to the baseline (gc-collected-
+    # without-release counts as a leak too — see leaksan.check_growth).
+    growth = leaksan.check_growth(before, settle_s=5.0)
+    if growth:
+        report = growth.pop("report", {})
+        pytest.fail(
+            f"leaksan: resource handles leaked by this test: {growth}\n"
+            f"live handles: {report}", pytrace=False,
+        )
+
 _WORKER_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",

@@ -1,0 +1,95 @@
+"""RL801 fixtures: acquire not released on all paths.
+
+The acquire names below come straight from leaklint's RESOURCE_TABLE
+(`prefix_cache.lookup`, `chan.read_view`, `srv.pin`): the fixtures pin the
+fire/suppress behavior of each RL801 sub-shape.
+"""
+
+
+def bad_never_released(prefix_cache, toks):
+    lease = prefix_cache.lookup(toks)
+    if lease is None:
+        return 0
+    return lease.matched_tokens
+
+
+def bad_conditional_release(prefix_cache, toks, flag):
+    lease = prefix_cache.lookup(toks)
+    if flag:
+        lease.release()
+
+
+def bad_risky_gap(prefix_cache, toks, dst):
+    lease = prefix_cache.lookup(toks)
+    dst.attach(lease.kv())
+    lease.release()
+
+
+def bad_discarded(chan):
+    chan.read_view()
+
+
+def bad_pin_no_release(srv, key):
+    if not srv.pin(key):
+        return False
+    return srv.read(0, 10)
+
+
+def ok_with(prefix_cache, toks):
+    with prefix_cache.lookup(toks) as lease:
+        return lease.matched_tokens
+
+
+def ok_try_finally(prefix_cache, toks, dst):
+    lease = prefix_cache.lookup(toks)
+    try:
+        dst.attach(lease.kv())
+    finally:
+        lease.release()
+
+
+def ok_returned(prefix_cache, toks):
+    return prefix_cache.lookup(toks)
+
+
+def ok_stored(owner, prefix_cache, toks):
+    owner.lease = prefix_cache.lookup(toks)
+
+
+def ok_passed_on(registry, prefix_cache, toks):
+    lease = prefix_cache.lookup(toks)
+    registry.adopt(lease)
+
+
+def ok_immediate_release(prefix_cache, toks):
+    lease = prefix_cache.lookup(toks)
+    if lease is None:
+        return None
+    lease.release()
+    return 1
+
+
+def ok_pin_finally(srv, key):
+    if not srv.pin(key):
+        return None
+    try:
+        return bytes(srv.read(0, 10))
+    finally:
+        srv.release(key)
+
+
+class OkClassManagedPin:
+    """Cross-method acquire/release: the owner class releases elsewhere."""
+
+    def grab(self, key):
+        self._srv.pin(key)
+        self._held.add(key)
+
+    def drop(self, key):
+        self._held.discard(key)
+        self._srv.release(key)
+
+
+def suppressed_leak(prefix_cache, toks):
+    lease = prefix_cache.lookup(toks)  # raylint: disable=RL801 (fixture: released by the caller's registry)
+    return lease.matched_tokens

@@ -1,0 +1,44 @@
+"""RL802 fixtures: cross-process release reachable only from __del__."""
+
+
+class BadGcOnly:
+    def __init__(self, assigner, token):
+        self._assigner = assigner
+        self._token = token
+
+    def __del__(self):
+        self._assigner.release(self._token)
+
+
+class BadGcOnlyRemote:
+    """The actor-call hop (`.release.remote`) is still a release."""
+
+    def __del__(self):
+        try:
+            self._assigner.release.remote(self._token)  # raylint: disable=RL501 (fixture: fire-and-forget is the point here)
+        except Exception:
+            pass  # __del__ must never raise; the release above is the point
+
+
+class OkExplicitPath:
+    def close(self):
+        self._assigner.release(self._token)
+
+    def __del__(self):
+        self._assigner.release(self._token)
+
+
+class OkDelegatesToOwnMethod:
+    """`self.release()` in __del__ is the GC backstop for a public path."""
+
+    def release(self):
+        self._ring.free(self._slot)
+
+    def __del__(self):
+        self.release()
+
+
+class SuppressedGcOnly:
+    def __del__(self):
+        # raylint: disable=RL802 (fixture: buffer-protocol lifetime IS the contract)
+        self._arena.release(self._key)

@@ -1,0 +1,38 @@
+"""RL803 fixtures: use-after-release / double-release on a straight line."""
+
+
+def bad_use_after_release(chan):
+    view = chan.read_view()
+    try:
+        data = bytes(view.mv)
+    finally:
+        view.release()
+    return (data, view.mv)
+
+
+def bad_double_release(chan):
+    view = chan.read_view()
+    view.release()
+    view.release()
+
+
+def ok_rebound(chan):
+    view = chan.read_view()
+    view.release()
+    view = chan.read_view()
+    out = view.mv
+    view.release()
+    return out
+
+
+def ok_single_release(chan):
+    view = chan.read_view()
+    out = view.mv
+    view.release()
+    return out
+
+
+def suppressed_use(chan):
+    view = chan.read_view()
+    view.release()
+    return view.mv  # raylint: disable=RL803 (fixture: mv was copied before release in the real code)

@@ -1,0 +1,49 @@
+"""RL804 fixtures: swallowed release failures and lock-mismatched release."""
+
+
+def bad_swallowed_release(chan):
+    view = chan.read_view()
+    try:
+        view.release()
+    except Exception:
+        pass
+
+
+def ok_commented_swallow(chan):
+    view = chan.read_view()
+    try:
+        view.release()
+    except Exception:
+        pass  # slot already recycled by channel close: nothing left to ack
+
+
+def ok_narrow_swallow(chan):
+    view = chan.read_view()
+    try:
+        view.release()
+    except BufferError:
+        raise
+
+
+class LockDiscipline:
+    def bad_cross_lock(self, prefix_cache, toks):
+        with self._intake_lock:
+            lease = prefix_cache.lookup(toks)
+        with self._evict_lock:
+            lease.release()
+
+    def ok_same_lock(self, prefix_cache, toks):
+        with self._state_lock:
+            lease = prefix_cache.lookup(toks)
+            lease.release()
+
+    def ok_unlocked_release(self, prefix_cache, toks):
+        with self._state_lock:
+            lease = prefix_cache.lookup(toks)
+        lease.release()
+
+    def suppressed_cross_lock(self, prefix_cache, toks):
+        with self._intake_lock:
+            lease = prefix_cache.lookup(toks)
+        with self._evict_lock:
+            lease.release()  # raylint: disable=RL804 (fixture: evict lock is taken WITH intake lock held elsewhere)

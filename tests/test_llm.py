@@ -85,7 +85,13 @@ def test_multi_step_decode_stop_rollback_and_slot_reuse():
 
     cfg = get_config("test-tiny", scan_layers=False, remat=False)
     model = Transformer(cfg)
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    # PRNGKey(1), not 0: seed 0's greedy output from this prompt is the
+    # constant 121 121 121..., which makes stop == the FIRST token and the
+    # engine (correctly) halts at one token while the rollback assertion
+    # expects three — the test then "fails" without testing anything. Seed 1
+    # gives a non-degenerate reference (asserted below), so the stop really
+    # fires mid-chunk and the rollback is exercised for real.
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
 
     def greedy_full(prompt, n):
         toks = list(prompt)
@@ -109,6 +115,10 @@ def test_multi_step_decode_stop_rollback_and_slot_reuse():
     prompt = [5, 9, 17, 3]
     ref = greedy_full(prompt, 12)
     stop = ref[2]  # fires mid-chunk for multi_step=8
+    assert stop not in ref[:2], (
+        "degenerate reference: the stop token must not appear before the "
+        "position the rollback assertion depends on"
+    )
     engine = DecodeEngine(cfg, params, num_slots=1, max_seq=128, multi_step=8)
     try:
         out = generate(engine, prompt, max_tokens=12, stop_token_id=stop)

@@ -108,7 +108,8 @@ def test_namespaces_isolate_adapters():
     tokens = [1, 2, 3, 4, 5, 6, 7, 8]
     mgr.insert(tokens, _kv_for(tokens, shape), namespace=0)
     assert mgr.lookup(tokens + [9], namespace=1) is None  # other adapter
-    assert mgr.lookup(tokens + [9], namespace=0).matched_tokens == 8
+    with mgr.lookup(tokens + [9], namespace=0) as lease:  # leaksan: release the pin
+        assert lease.matched_tokens == 8
 
     idx = RadixIndex(4)
     assert idx.chunks([1, 2, 3, 4, 5]) == [(1, 2, 3, 4)]
@@ -428,3 +429,159 @@ def test_dp_cache_aware_routing_end_to_end(ray_start_regular):
         assert stats["untracked"] >= 4, stats
     finally:
         serve.delete("dp-kv")
+    # Graceful retirement (round 12): deleting the app runs each replica's
+    # shutdown() hook, which hands the dp rank back to the assigner
+    # EXPLICITLY — the lazy dead-actor reclamation is the backstop, not the
+    # path — so the rank map empties promptly, not at the next exhaustion.
+    import time as _time
+
+    import ray_tpu
+
+    assigner = ray_tpu.get_actor("DPRankAssigner-test-tiny", namespace="llm_dp")
+    deadline = _time.monotonic() + 30
+    held = None
+    while _time.monotonic() < deadline:
+        held = ray_tpu.get(assigner.ranks.remote())
+        if held == {}:
+            break
+        _time.sleep(0.25)
+    assert held == {}, f"dp ranks not released on app delete: {held}"
+
+
+# -- error-path lease lifetime (leaklint/leaksan round 12) --------------------
+
+def test_detached_prefill_releases_lease_when_attach_raises(tiny_model):
+    """prefill_detached on a cache hit must release its lease even when
+    materializing the cached rows raises: a leaked lease pins its chain
+    against eviction for the engine's whole life (the detached path has no
+    scheduler drain to back-stop it)."""
+    from ray_tpu.llm import DecodeEngine
+    from ray_tpu.llm.kvcache import PrefixCacheManager
+
+    cfg, model, params = tiny_model
+    mgr = PrefixCacheManager(16, 8 << 20, name="detached-leak-test")
+    engine = DecodeEngine(cfg, params, num_slots=1, max_seq=128,
+                          prefix_cache=mgr, decode_loop=False)
+    try:
+        rng = np.random.default_rng(3)
+        prompt = list(map(int, rng.integers(0, cfg.vocab_size, 40)))
+        engine.prefill_detached(prompt)          # warm: inserts 2 blocks
+        assert mgr.stats()["blocks_resident"] > 0
+
+        real_get = mgr._pool.get
+
+        def poisoned_get(bid):
+            raise RuntimeError("injected pool failure")
+
+        mgr._pool.get = poisoned_get
+        try:
+            with pytest.raises(RuntimeError, match="injected pool failure"):
+                engine.prefill_detached(prompt + [1, 2, 3])  # hit -> kv() raises
+        finally:
+            mgr._pool.get = real_get
+        # The decisive assertion: the failed attach released its lease, so
+        # nothing is pinned and the engine keeps serving.
+        assert mgr.stats()["leases_active"] == 0
+        first_logits, kv, n = engine.prefill_detached(prompt + [1, 2, 3])
+        assert n == 43 and kv.shape[2] >= 43
+        assert mgr.stats()["leases_active"] == 0
+    finally:
+        engine.shutdown()
+
+
+def test_chunked_prefill_releases_lease_when_attach_raises(tiny_model):
+    """The scheduler path: a cache-hit request whose leased-row
+    materialization raises mid-attach must still release the lease (finally
+    in _exec_chunk, scheduler drain as the backstop) and fail the caller's
+    callback instead of hanging it."""
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+    from ray_tpu.llm.kvcache import PrefixCacheManager
+
+    cfg, model, params = tiny_model
+    mgr = PrefixCacheManager(16, 8 << 20, name="chunk-leak-test")
+    engine = DecodeEngine(cfg, params, num_slots=2, max_seq=128,
+                          prefix_cache=mgr)
+    rng = np.random.default_rng(5)
+    prompt = list(map(int, rng.integers(0, cfg.vocab_size, 40)))
+    try:
+        assert _generate(engine, prompt, 4)  # warm the cache
+        real_get = mgr._pool.get
+        mgr._pool.get = lambda bid: (_ for _ in ()).throw(
+            RuntimeError("injected pool failure")
+        )
+        done = threading.Event()
+        tokens = []
+
+        def cb(tok, fin):
+            tokens.append(tok)
+            if fin:
+                done.set()
+
+        try:
+            engine.submit(prompt + [7], SamplingParams(max_tokens=4), cb)
+            # stepper dies on the poisoned attach; the caller must be failed
+            # (token=-1, finished=True), never left hanging
+            assert done.wait(60), "callback never fired after attach failure"
+        finally:
+            mgr._pool.get = real_get
+        assert tokens[-1] == -1
+        assert mgr.stats()["leases_active"] == 0
+        # a dead engine rejects new work loudly instead of enqueueing it
+        with pytest.raises(RuntimeError, match="stepper died"):
+            engine.submit([1, 2, 3], SamplingParams(max_tokens=2), cb)
+    finally:
+        engine.shutdown()
+
+
+def test_shutdown_fails_queued_requests_and_releases_leases(tiny_model):
+    """shutdown() must drain: requests admitted but never scheduled get
+    their callbacks failed (no hung submitters) and queued leases release."""
+    from ray_tpu.llm import DecodeEngine, SamplingParams
+
+    cfg, model, params = tiny_model
+    # decode_loop=False: nothing ever drains the queue except shutdown
+    engine = DecodeEngine(cfg, params, num_slots=1, max_seq=64,
+                          prefix_cache=False, decode_loop=False)
+    results = []
+    engine.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                  lambda tok, fin: results.append((tok, fin)))
+    assert results == []
+    engine.shutdown()
+    assert results == [(-1, True)]
+    # idempotent: a second shutdown neither raises nor double-fails
+    engine.shutdown()
+    assert results == [(-1, True)]
+    with pytest.raises(RuntimeError, match="shut down"):
+        engine.submit([4], SamplingParams(max_tokens=1),
+                      lambda tok, fin: None)
+
+
+def test_scheduler_drain_is_exception_safe():
+    """One lease whose release raises must not leave the remaining drained
+    requests leased or unreported."""
+    from ray_tpu.llm.scheduler import Request, Scheduler
+
+    sched = Scheduler(num_slots=1, buckets=(8, 16), max_seq=32,
+                      token_budget=0, max_queue_depth=0)
+
+    class _Lease:
+        def __init__(self, blow_up):
+            self.blow_up = blow_up
+            self.released = False
+
+        def release(self):
+            if self.blow_up:
+                raise RuntimeError("poisoned release")
+            self.released = True
+
+    reqs = [Request("prompt", prompt=[1, 2, 3], callback=lambda t, f: None)
+            for _ in range(3)]
+    leases = [_Lease(False), _Lease(True), _Lease(False)]
+    for r, l in zip(reqs, leases):
+        r.lease = l
+        sched.submit(r)
+    drained = sched.drain()
+    assert len(drained) == 3
+    assert leases[0].released and leases[2].released
+    assert all(r.lease is None for r in drained)
+    assert sched.queue_depth() == 0

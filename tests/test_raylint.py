@@ -174,6 +174,65 @@ def test_rl701_fires_and_suppresses():
     assert "ok_local_state.ok_scan_body" not in by_symbol
 
 
+# ---- leaklint family (RL8xx) ------------------------------------------------
+
+def test_rl801_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl801.py"))
+    for sym in ("bad_never_released", "bad_conditional_release",
+                "bad_risky_gap", "bad_discarded", "bad_pin_no_release"):
+        assert found.get(sym) == {"RL801"}, sym
+    for sym in ("ok_with", "ok_try_finally", "ok_returned", "ok_stored",
+                "ok_passed_on", "ok_immediate_release", "ok_pin_finally",
+                "grab", "drop", "suppressed_leak"):
+        assert sym not in found, sym
+
+
+def test_rl802_fires_and_suppresses():
+    findings = _fixture("case_rl802.py")
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, set()).add(f.code)
+    assert by_symbol.get("BadGcOnly.__del__") == {"RL802"}
+    assert by_symbol.get("BadGcOnlyRemote.__del__") == {"RL802"}
+    for sym in ("OkExplicitPath.__del__", "OkDelegatesToOwnMethod.__del__",
+                "SuppressedGcOnly.__del__"):
+        assert sym not in by_symbol, sym
+
+
+def test_rl803_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl803.py"))
+    assert found.get("bad_use_after_release") == {"RL803"}
+    assert found.get("bad_double_release") == {"RL803"}
+    for sym in ("ok_rebound", "ok_single_release", "suppressed_use"):
+        assert sym not in found, sym
+
+
+def test_rl804_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl804.py"))
+    assert found.get("bad_swallowed_release") == {"RL804"}
+    assert found.get("bad_cross_lock") == {"RL804"}
+    for sym in ("ok_commented_swallow", "ok_narrow_swallow", "ok_same_lock",
+                "ok_unlocked_release", "suppressed_cross_lock"):
+        assert sym not in found, sym
+
+
+def test_leaklint_silent_on_canonical_resource_shapes(tmp_path):
+    # The shipped recv() shape: acquire -> try/finally release, in a loop.
+    f = tmp_path / "canonical.py"
+    f.write_text(
+        "def recv(transport, n):\n"
+        "    out = []\n"
+        "    for _ in range(n):\n"
+        "        view = transport.read_view()\n"
+        "        try:\n"
+        "            out.append(bytes(view.mv))\n"
+        "        finally:\n"
+        "            view.release()\n"
+        "    return out\n"
+    )
+    assert not [x for x in lint_file(str(f)) if x.code.startswith("RL8")]
+
+
 def test_jaxlint_silent_on_bucketed_jit_pattern():
     # The legitimate engine shape (bucket table + capped program cache +
     # host-native counters + one readback per dispatch) must be finding-free.
@@ -292,23 +351,59 @@ def test_cli_fail_stale(tmp_path):
 
 
 def test_shipped_tree_clean_per_family():
-    """The tier-1 gate, per family: the concurrency checkers (RL1xx-RL5xx)
-    and the jaxlint compute-plane checkers (RL6xx/RL7xx) must EACH report
-    zero unbaselined findings over the shipped package."""
-    from ray_tpu.devtools.raylint import CODES
+    """The tier-1 gate, per family: the concurrency checkers (RL1xx-RL5xx),
+    the jaxlint compute-plane checkers (RL6xx/RL7xx), and the leaklint
+    resource-lifetime checkers (RL8xx) must EACH report zero unbaselined
+    findings over the shipped package."""
+    from ray_tpu.devtools.raylint.core import FAMILIES
 
-    families = {
-        "concurrency": {c for c in CODES if c[2] in "12345"},
-        "jax": {c for c in CODES if c[2] in "67"},
-    }
+    assert set(FAMILIES) == {"concurrency", "jax", "leak"}
     findings = lint_paths([PKG_DIR])
     entries = load_baseline()
-    for name, codes in families.items():
+    for name, codes in FAMILIES.items():
         fam = [f for f in findings if f.code in codes]
         violations, _g, _s = partition_baselined(fam, entries)
         assert not violations, (
             name + ":\n" + "\n".join(f.render() for f in violations)
         )
+
+
+def test_cli_only_and_family_filters(tmp_path):
+    """`--only RL8xx` / `--family` run one lint plane in isolation: findings
+    from other planes neither fail the run nor count as stale; the exit
+    contract itself is unchanged."""
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        # RL501 (discarded .remote) AND RL801 (discarded read_view lease)
+        "def f(actor, chan):\n"
+        "    actor.ping.remote()\n"
+        "    chan.read_view()\n"
+    )
+    assert raylint_main([str(mixed)]) == 1
+    # leak plane alone: the RL501 finding does not count
+    base = tmp_path / "leak_base.json"
+    base.write_text(json.dumps({"entries": [
+        {"file": "mixed.py", "code": "RL801", "symbol": "f", "reason": "test"}
+    ]}))
+    assert raylint_main(
+        [str(mixed), "--only", "RL8xx", "--baseline", str(base)]
+    ) == 0
+    assert raylint_main(
+        [str(mixed), "--family", "leak", "--baseline", str(base)]
+    ) == 0
+    # concurrency plane alone: the RL801 baseline entry is not "stale"
+    # for a run that never selected RL8xx
+    base2 = tmp_path / "conc_base.json"
+    base2.write_text(json.dumps({"entries": [
+        {"file": "mixed.py", "code": "RL501", "symbol": "f", "reason": "t"},
+        {"file": "mixed.py", "code": "RL801", "symbol": "f", "reason": "t"},
+    ]}))
+    assert raylint_main(
+        [str(mixed), "--family", "concurrency", "--baseline", str(base2),
+         "--fail-stale"]
+    ) == 0
+    # unknown pattern is a usage error (exit 2), per the documented contract
+    assert raylint_main([str(mixed), "--only", "RL9xx"]) == 2
 
 
 def test_cli_module_entrypoint_clean_tree():
