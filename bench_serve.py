@@ -337,6 +337,180 @@ def bench_prefix_cache(prompt_len: int):
         engine.shutdown()
 
 
+def bench_adapter_churn(on_tpu: bool):
+    """Multi-tenant LoRA paging (docs/multitenancy.md): 32 registered
+    adapters served through an 8-slot HBM budget, with a zipf-ish mix (a hot
+    working set inside the budget + a cold tail beyond it), vs the
+    always-resident upper bound (table holds all 32).
+
+    Reported: cache hit rate, TTFT p50/p99 under churn, TTFT p50 of the
+    WARM subset (adapter resident at submit) — the acceptance bar is
+    warm-adapter TTFT ~= resident-engine TTFT (paging costs the cold tail
+    its page-in, never the warm path)."""
+    import numpy as np
+
+    from ray_tpu.llm import SamplingParams
+
+    n_adapters, n_slots = 32, 8
+    rng = np.random.default_rng(2)
+
+    def build(paged: bool):
+        cfg_extra = {"cache_slots": n_slots} if paged else {}
+        engine, cfg, model_id, _ = build_engine(
+            slots=4, prefix_cache=False,
+            lora_config={"max_loras": n_adapters, "rank": 4, **cfg_extra},
+        )
+        for i in range(n_adapters):
+            r = np.random.default_rng(1000 + i)
+            engine.add_lora(f"a{i}", {0: {
+                "q_A": r.normal(size=(cfg.hidden, 4)).astype(np.float32),
+                "q_B": r.normal(size=(4, cfg.n_heads * cfg.head_dim)).astype(np.float32),
+            }}, alpha=8.0)
+        return engine, cfg, model_id
+
+    # Traffic: 70% on a hot set of 6 adapters (fits the 8-slot budget),
+    # 30% uniform over the cold tail — the shape a real tenant fleet has.
+    hot = [f"a{i}" for i in range(6)]
+    cold = [f"a{i}" for i in range(6, n_adapters)]
+    names = [
+        (hot[rng.integers(len(hot))] if rng.random() < 0.7
+         else cold[rng.integers(len(cold))])
+        for _ in range(120)
+    ]
+
+    def run(engine, cfg, classify=None):
+        prompt = rng.integers(0, cfg.vocab_size, 12).tolist()
+        # warm the compiled programs + the hot set off-clock
+        for name in hot:
+            done = threading.Event()
+            engine.submit(prompt, SamplingParams(max_tokens=2),
+                          lambda t, f: done.set() if f else None, lora=name)
+            assert done.wait(600)
+        ttfts, warm_ttfts = [], []
+        for name in names:
+            resident = classify(name) if classify else True
+            done = threading.Event()
+            ttft = [None]
+            t0 = time.perf_counter()
+
+            def cb(tok, fin):
+                if ttft[0] is None:
+                    ttft[0] = time.perf_counter() - t0
+                if fin:
+                    done.set()
+
+            engine.submit(prompt, SamplingParams(max_tokens=2), cb, lora=name)
+            assert done.wait(600)
+            ttfts.append(ttft[0])
+            if resident:
+                warm_ttfts.append(ttft[0])
+        return ttfts, warm_ttfts
+
+    resident_engine, cfg, model_id = build(paged=False)
+    try:
+        res_ttfts, _ = run(resident_engine, cfg)
+    finally:
+        resident_engine.shutdown()
+    paged_engine, cfg, model_id = build(paged=True)
+    try:
+        adapters = paged_engine._adapters
+        ttfts, warm_ttfts = run(
+            paged_engine, cfg,
+            classify=lambda n: adapters.is_resident(adapters.uid_of(n)),
+        )
+        stats = paged_engine.adapter_stats()
+    finally:
+        paged_engine.shutdown()
+    return {
+        "metric": "adapter_churn_ttft",
+        "adapters": n_adapters, "cache_slots": n_slots,
+        "requests": len(names),
+        "cache_hit_rate": round(stats["hit_rate"], 3),
+        "evictions": stats["evictions"],
+        "page_ins": stats["page_ins"],
+        "ttft_p50_s": round(_pctl(ttfts, 0.5), 4),
+        "ttft_p99_s": round(_pctl(ttfts, 0.99), 4),
+        "ttft_warm_p50_s": round(_pctl(warm_ttfts, 0.5), 4),
+        "ttft_resident_p50_s": round(_pctl(res_ttfts, 0.5), 4),
+        "ttft_resident_p99_s": round(_pctl(res_ttfts, 0.99), 4),
+        "model": model_id,
+        "note": "32 adapters on an 8-slot HBM budget, 70% traffic on a "
+                "6-adapter hot set; warm-adapter TTFT vs the always-resident "
+                "upper bound is the paging-overhead bar",
+    }
+
+
+def bench_wfq_fairness(on_tpu: bool):
+    """Weighted-fair admission under saturation vs the FIFO control
+    (docs/multitenancy.md): three tenants (weights 2:1:1) keep the queue
+    full; the light tenant's flood arrives LAST, so FIFO serves it nothing
+    inside the measurement window while WFQ holds every tenant's
+    decode-token share within 10% of its weight."""
+    import numpy as np
+
+    from ray_tpu.llm import SamplingParams
+
+    weights = {"gold": 2.0, "silver": 1.0, "bronze": 1.0}
+    target = {"gold": 0.5, "silver": 0.25, "bronze": 0.25}
+
+    def run(wfq: bool):
+        engine, cfg, model_id, _ = build_engine(
+            slots=2, prefix_cache=False, wfq=wfq,
+            tenant_weights=weights if wfq else None, tenant_quota=0,
+        )
+        rng = np.random.default_rng(3)
+        counts = {t: 0 for t in weights}
+        finished = []
+        lock = threading.Lock()
+        try:
+            # warm off-clock
+            done = threading.Event()
+            engine.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                          lambda t, f: done.set() if f else None)
+            assert done.wait(600)
+            # gold+silver flood first; bronze arrives behind them (the FIFO
+            # killer ordering)
+            for tenant in ("gold", "silver", "bronze"):
+                for _ in range(25):
+                    def cb(tok, fin, _t=tenant):
+                        with lock:
+                            counts[_t] += 1
+                        if fin:
+                            finished.append(_t)
+
+                    engine.submit(
+                        rng.integers(0, cfg.vocab_size, 8).tolist(),
+                        SamplingParams(max_tokens=4), cb, tenant=tenant,
+                    )
+            deadline = time.perf_counter() + 600
+            while len(finished) < 40 and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            with lock:
+                total = sum(counts.values()) or 1
+                shares = {t: round(c / total, 3) for t, c in counts.items()}
+            return shares, model_id
+        finally:
+            engine.shutdown()
+
+    wfq_shares, model_id = run(True)
+    fifo_shares, _ = run(False)
+    return {
+        "metric": "wfq_fairness",
+        "weights": {t: w for t, w in weights.items()},
+        "target_share": target,
+        "wfq_share": wfq_shares,
+        "fifo_share": fifo_shares,
+        "max_weight_error_wfq": round(
+            max(abs(wfq_shares[t] - target[t]) for t in weights), 3),
+        "light_tenant_share_fifo": fifo_shares["bronze"],
+        "model": model_id,
+        "note": "3 saturated tenants, 2 slots; shares measured over the "
+                "first ~40 completions (queues still full). FIFO serves "
+                "arrival order, starving the late light tenant; WFQ tracks "
+                "the configured weights",
+    }
+
+
 def bench_pd_ttft():
     """PD-disaggregated TTFT through the real serve app: prefill replica ->
     KV handoff (descriptor + pull over the round-11 device-channel plane,
@@ -423,6 +597,11 @@ def main():
     results.append(bench_spec_decode(on_tpu))
 
     results.extend(bench_prefix_cache(prompt_len))
+
+    # Multi-tenant serving plane (round 13, docs/multitenancy.md):
+    # adapter-churn paging overhead + WFQ-vs-FIFO fairness under saturation.
+    results.append(bench_adapter_churn(on_tpu))
+    results.append(bench_wfq_fairness(on_tpu))
 
     # PD disaggregation TTFT across real replica actors (round 11).
     results.append(bench_pd_ttft())

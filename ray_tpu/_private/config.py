@@ -99,6 +99,8 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_sched_token_budget": (int, 256, "per-iteration scheduler token budget (docs/scheduler.md): decode and spec-verify tokens are reserved first, the remainder is granted to bucketed prefill chunks, so a long prefill cannot stall in-flight decodes for more than one budget of compute (0 = unbudgeted whole-prompt prefill)"),
     "llm_spec_ngram": (int, 3, "trailing n-gram length the ngram/REST speculative draft matches against the slot history and the cross-request continuation store"),
     "llm_spec_store_entries": (int, 4096, "bounded LRU entries in the ngram draft's cross-request continuation store; repeated greedy traffic re-proposes earlier completions from it (0 disables the shared store, leaving prompt-lookup only)"),
+    "llm_adapter_cache_bytes": (int, 0, "HBM byte budget for the engine's pageable LoRA adapter table (docs/multitenancy.md): device slots = budget // per-adapter slot bytes, registered-but-evicted adapters stay host-side and page back in on demand (one device_put per page-in, LRU eviction of unpinned adapters); 0 sizes the table to lora_config max_loras (every registered adapter resident, the pre-paging shape)"),
+    "llm_tenant_max_queue_depth": (int, 64, "per-tenant admission quota on the engine's weighted-fair queues: one tenant's overload raises EngineOverloadedError for THAT tenant while other tenants keep flowing (0 disables the per-tenant quota, leaving only the global llm_max_queue_depth cap)"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
     "data_output_queue_size": (int, 8, "blocks buffered between the streaming executor and the consuming iterator (backpressure depth)"),

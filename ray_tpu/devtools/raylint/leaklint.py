@@ -89,6 +89,8 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
                  release=("close",)),
     ResourceSpec("async checkpoint writer", "AsyncCheckpointWriter",
                  release=("wait_until_finished", "close")),
+    ResourceSpec("LoRA adapter pin (AdapterHandle)", "acquire",
+                 hints=("adapter", "adapters"), release=("release",)),
     ResourceSpec("dp replica-rank token", "assign", hints=("assigner",),
                  receiver_release=("release",), arg_keyed=True),
     ResourceSpec("raylet resource lease", "acquire", hints=("resources",),

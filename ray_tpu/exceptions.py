@@ -42,9 +42,17 @@ class RayTpuTaskError(RayTpuError):
         return cls(function_name, tb, picklable)
 
     def as_instanceof_cause(self):
-        """Return an exception that is also an instance of the cause's type."""
+        """Return an exception that is also an instance of the cause's type.
+
+        The cause may itself be a (wrapped) task error when the failure
+        crossed several actor hops — e.g. engine -> DP replica -> DP router
+        -> driver: walk to the innermost non-task-error cause so a typed
+        error (UnknownAdapterError, EngineOverloadedError, ...) stays
+        catchable by type no matter how many hops it rode."""
         cause = self.cause
-        if cause is None or isinstance(cause, RayTpuTaskError):
+        while isinstance(cause, RayTpuTaskError):
+            cause = cause.cause
+        if cause is None:
             return self
 
         class _Wrapped(RayTpuTaskError, type(cause)):

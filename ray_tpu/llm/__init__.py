@@ -19,6 +19,10 @@ from typing import Any, Dict, List, Optional, Union
 
 from ray_tpu import serve
 from ray_tpu.llm._engine import DecodeEngine, EngineOverloadedError, SamplingParams
+from ray_tpu.llm.adapters import (
+    AdapterCacheFullError,
+    UnknownAdapterError,
+)
 
 
 class ByteTokenizer:
@@ -89,6 +93,13 @@ class LLMConfig:
     # {"draft_layers": j} / {"draft_cfg": ..., "draft_params": ...} for a
     # cheap draft model sharing the target's embeddings. None disables.
     spec_config: Optional[dict] = None
+    # Multi-tenant admission (docs/multitenancy.md): tenant -> WFQ weight
+    # (priority classes; unlisted tenants weigh 1.0). wfq=False restores the
+    # single arrival-order FIFO (the A/B control); tenant_quota overrides
+    # llm_tenant_max_queue_depth per engine.
+    tenant_weights: Optional[dict] = None
+    wfq: bool = True
+    tenant_quota: Optional[int] = None
 
 
 def load_model(config: "LLMConfig"):
@@ -137,6 +148,8 @@ class LLMServer:
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
             lora_config=config.lora_config,
             spec_config=config.spec_config,
+            wfq=config.wfq, tenant_weights=config.tenant_weights,
+            tenant_quota=config.tenant_quota,
         )
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0) -> int:
@@ -147,7 +160,7 @@ class LLMServer:
     async def generate(self, prompt: Union[str, List[int]], *,
                        max_tokens: int = 64, temperature: float = 0.0,
                        top_k: int = 0, stop_token_id: Optional[int] = None,
-                       lora: str = "") -> dict:
+                       lora: str = "", tenant: Optional[str] = None) -> dict:
         t0 = time.monotonic()
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
@@ -171,7 +184,7 @@ class LLMServer:
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
             cb,
-            lora=lora,
+            lora=lora, tenant=tenant,
         )
         await done
         gen = list(out)
@@ -248,9 +261,17 @@ class LLMServer:
         return self._engine.prefix_cache_stats()
 
     async def scheduler_stats(self) -> dict:
-        """Iteration-level scheduler occupancy + spec-decode acceptance for
-        this replica's engine. See docs/scheduler.md."""
+        """Iteration-level scheduler occupancy + spec-decode acceptance +
+        per-tenant metering for this replica's engine. See docs/scheduler.md
+        and docs/multitenancy.md."""
         return self._engine.scheduler_stats()
+
+    async def adapter_stats(self) -> Optional[dict]:
+        """AdapterCache residency/paging counters for this replica's engine
+        (None without lora_config) — includes resident_adapters, the list
+        the DP router's residency-affinity path keys on. See
+        docs/multitenancy.md."""
+        return self._engine.adapter_stats()
 
     async def shutdown(self):
         """Explicit retirement hook (the serve controller calls it, bounded,
@@ -354,6 +375,15 @@ class OpenAIRouter:
         response = handle.generate.remote(prompt, **gen_kwargs)
         try:
             result = await response
+        except UnknownAdapterError as e:
+            # Typed, client-visible rejection (docs/multitenancy.md): the
+            # engine raised UnknownAdapterError and it rode the remote hop
+            # intact — surface the registry's own message, not a guess.
+            yield {"__serve_content_type__": "application/json"}
+            yield {"error": {"message": str(e),
+                             "type": "invalid_request_error",
+                             "code": "unknown_adapter"}}
+            return
         except KeyError:
             yield {"__serve_content_type__": "application/json"}
             yield {"error": {"message": f"unknown lora adapter in model {model!r}",
@@ -406,6 +436,7 @@ def build_openai_app(llm_configs: List[LLMConfig]) -> "serve.Application":
 
 
 __all__ = [
+    "AdapterCacheFullError",
     "ByteTokenizer",
     "DecodeEngine",
     "EngineOverloadedError",
@@ -414,6 +445,7 @@ __all__ = [
     "LLMServer",
     "OpenAIRouter",
     "SamplingParams",
+    "UnknownAdapterError",
     "build_llm_deployment",
     "build_openai_app",
 ]

@@ -31,6 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.llm.adapters import (
+    AdapterCache,
+    UnknownAdapterError,
+)
 from ray_tpu.llm.scheduler.scheduler import (
     EngineOverloadedError,
     Plan,
@@ -157,7 +161,11 @@ def _mlp(layer, x):
 
 def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_at,
                     kv_mask, lora=None, adapter_ids=None, write_gate=None):
-    """tokens: [B,S] -> logits [B,S,V]; updates caches in place (returned)."""
+    """tokens: [B,S] -> logits [B,S,V]; updates caches in place (returned).
+
+    lora: the AdapterCache's STACKED tables ({"q_A": [L, S, M, r], ...}) —
+    per-layer views are extracted here inside the trace, so paging swaps the
+    whole table reference without touching program shapes."""
     embed = params["embedding"]
     x = embed[tokens].astype(cfg.dtype)
     new_caches = []
@@ -167,7 +175,7 @@ def _forward_cached(params, cfg: ModelConfig, tokens, positions, caches, write_a
         attn_out, ck, cv = _attn_cached(
             layer["attn"], normed, positions, caches[i][0], caches[i][1],
             write_at, kv_mask, cfg,
-            lora_layer=None if lora is None else lora[i],
+            lora_layer=None if lora is None else {k: v[i] for k, v in lora.items()},
             adapter_ids=adapter_ids,
             write_gate=write_gate,
         )
@@ -224,8 +232,12 @@ class DecodeEngine:
                  multi_step: Optional[int] = None,
                  prefix_cache=None,
                  max_queue_depth: Optional[int] = None,
-                 token_budget: Optional[int] = None):
+                 token_budget: Optional[int] = None,
+                 wfq: bool = True,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tenant_quota: Optional[int] = None):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
+        from ray_tpu._private.config import CONFIG
         from ray_tpu.parallel.mesh import unbox
 
         self.cfg = cfg
@@ -233,25 +245,31 @@ class DecodeEngine:
         self.B = num_slots
         self.T = max_seq or cfg.max_seq
         self._np_rng = np.random.default_rng(seed)
-        # Multi-LoRA: stacked adapter factors, slot -> adapter index. Index 0 is
-        # the base model (zero factors), so one jitted program serves any mix of
-        # adapters in a batch (reference: LoraConfig + vLLM multi-LoRA).
+        # Multi-LoRA: an HBM-budgeted pageable AdapterCache backs the stacked
+        # device table (slot 0 = base model, zero factors), so one jitted
+        # program serves any adapter mix in a batch AND "hundreds of tenants"
+        # are no longer bounded by what fits the table — registered adapters
+        # live host-side and page into a fixed set of device slots on demand
+        # (docs/multitenancy.md; reference: LoraConfig + vLLM multi-LoRA,
+        # S-LoRA unified paging). lora_config keys: max_loras (registry cap),
+        # rank (rank bucket), cache_bytes / cache_slots (HBM budget override;
+        # default from llm_adapter_cache_bytes, 0 = every adapter resident).
         self._lora_cfg = lora_config
-        self._lora = None
-        self._lora_names: Dict[str, int] = {"": 0}
+        self._adapters: Optional[AdapterCache] = None
         if lora_config:
-            A = int(lora_config.get("max_loras", 4)) + 1
-            r = int(lora_config.get("rank", 8))
-            self._lora = [
-                {
-                    "q_A": jnp.zeros((A, cfg.hidden, r), cfg.dtype),
-                    "q_B": jnp.zeros((A, r, cfg.n_heads * cfg.head_dim), cfg.dtype),
-                    "v_A": jnp.zeros((A, cfg.hidden, r), cfg.dtype),
-                    "v_B": jnp.zeros((A, r, cfg.n_kv_heads * cfg.head_dim), cfg.dtype),
-                    "scale": jnp.zeros((A,), jnp.float32),
-                }
-                for _ in range(cfg.n_layers)
-            ]
+            budget = lora_config.get("cache_bytes")
+            if budget is None:
+                budget = CONFIG.llm_adapter_cache_bytes
+            self._adapters = AdapterCache(
+                n_layers=cfg.n_layers, hidden=cfg.hidden,
+                q_out=cfg.n_heads * cfg.head_dim,
+                v_out=cfg.n_kv_heads * cfg.head_dim,
+                rank=int(lora_config.get("rank", 8)), dtype=cfg.dtype,
+                max_adapters=int(lora_config.get("max_loras", 4)),
+                budget_bytes=int(budget),
+                cache_slots=lora_config.get("cache_slots"),
+                name=f"engine-{id(self):x}",
+            )
         self._adapter_ids = np.zeros((num_slots,), np.int32)
         kv_shape = (self.B, self.T, cfg.n_kv_heads, cfg.head_dim)
         self._caches = [
@@ -278,8 +296,6 @@ class DecodeEngine:
         # vLLM's multi-step scheduling (num_scheduler_steps). Engaged only
         # when every active slot samples greedily; host-side stop/max_tokens
         # handling rolls per-slot state back after the readback.
-        from ray_tpu._private.config import CONFIG
-
         if multi_step is None:
             multi_step = CONFIG.llm_multi_step
         self._multi_step = max(1, int(multi_step))
@@ -317,7 +333,11 @@ class DecodeEngine:
         # Iteration-level scheduler (docs/scheduler.md): owns the
         # waiting/running queues, slot states, the per-iteration token
         # budget, and the chunked-prefill policy. The prefix-cache lookup is
-        # injected so admission plans chunks over the uncached suffix only.
+        # injected so admission plans chunks over the uncached suffix only;
+        # the adapter pin callbacks make admission adapter-aware
+        # (docs/multitenancy.md): resident adapters are preferred, cold ones
+        # page in at admission, and a fully-pinned cache back-pressures the
+        # tenant instead of crashing the stepper.
         lookup = None
         if self._prefix_cache is not None:
             cache = self._prefix_cache
@@ -325,10 +345,16 @@ class DecodeEngine:
             def lookup(prompt, adapter):
                 return cache.lookup(prompt, namespace=adapter)
 
+        adapter_acquire = adapter_resident = None
+        if self._adapters is not None:
+            adapter_acquire = self._adapters.try_acquire
+            adapter_resident = self._adapters.is_resident
         self._sched = Scheduler(
             num_slots=self.B, buckets=self._prefill_buckets, max_seq=self.T,
             token_budget=token_budget, max_queue_depth=max_queue_depth,
             multi_step=self._multi_step, lookup=lookup, name=f"{id(self):x}",
+            wfq=wfq, tenant_weights=tenant_weights, tenant_quota=tenant_quota,
+            adapter_acquire=adapter_acquire, adapter_resident=adapter_resident,
         )
         # Diagnostics for benches/tests: shape of the most recent prefill
         # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only).
@@ -432,44 +458,41 @@ class DecodeEngine:
     # -- lora registry -----------------------------------------------------
     def add_lora(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
                  alpha: float = 1.0) -> int:
-        """Register an adapter. layer_weights: layer index -> {"q_A": [M,r],
-        "q_B": [r,H*D], "v_A": [M,r], "v_B": [r,Hkv*D]} (missing projections
-        stay zero). Returns the adapter index."""
-        if self._lora is None:
+        """Register an adapter host-side. layer_weights: layer index ->
+        {"q_A": [M,r], "q_B": [r,H*D], "v_A": [M,r], "v_B": [r,Hkv*D]}
+        (missing projections stay zero). Rank/shape consistency is validated
+        against the bucketed table HERE (ValueError) instead of failing
+        inside jit. Returns the adapter's stable uid; the device slot is
+        paged in on first use (docs/multitenancy.md)."""
+        if self._adapters is None:
             raise ValueError("engine built without lora_config")
-        if name in self._lora_names:
-            return self._lora_names[name]
-        idx = len(self._lora_names)
-        max_a = int(self._lora[0]["scale"].shape[0])
-        if idx >= max_a:
-            raise ValueError(f"lora capacity {max_a - 1} exhausted")
-        rank = self._lora[0]["q_A"].shape[-1]
-        for li, w in layer_weights.items():
-            entry = self._lora[li]
-            upd = dict(entry)
-            for key in ("q_A", "q_B", "v_A", "v_B"):
-                if key in w:
-                    arr = jnp.asarray(w[key], entry[key].dtype)
-                    upd[key] = entry[key].at[idx].set(arr)
-            upd["scale"] = entry["scale"].at[idx].set(alpha / max(1, rank))
-            self._lora[li] = upd
-        # Layers the adapter doesn't touch still need its scale set (zero factors
-        # make the delta zero regardless).
-        for li in range(self.cfg.n_layers):
-            if li not in layer_weights:
-                self._lora[li] = dict(
-                    self._lora[li],
-                    scale=self._lora[li]["scale"].at[idx].set(alpha / max(1, rank)),
-                )
-        self._lora_names[name] = idx
-        return idx
+        return self._adapters.register(name, layer_weights, alpha)
+
+    # Explicit alias: the serve layers call this "register_adapter".
+    register_adapter = add_lora
 
     def _adapter_index(self, lora: str) -> int:
+        """Stable adapter uid for a request ("" = base). Raises the typed,
+        client-visible UnknownAdapterError (a KeyError subclass) instead of
+        a bare KeyError from deep inside the engine."""
         if not lora:
             return 0
-        if self._lora is None or lora not in self._lora_names:
-            raise KeyError(f"unknown lora adapter {lora!r}")
-        return self._lora_names[lora]
+        if self._adapters is None:
+            raise UnknownAdapterError(
+                f"unknown lora adapter {lora!r}: engine built without "
+                f"lora_config"
+            )
+        return self._adapters.uid_of(lora)
+
+    def _lora_tables(self):
+        """The AdapterCache's current stacked device tables (or None): read
+        per dispatch, because a page-in swaps the table reference."""
+        return None if self._adapters is None else self._adapters.tables()
+
+    def adapter_stats(self) -> Optional[dict]:
+        """AdapterCache residency/paging counters (None when the engine has
+        no lora_config). See docs/multitenancy.md."""
+        return None if self._adapters is None else self._adapters.stats()
 
     # -- jitted programs ---------------------------------------------------
     def _prefill_at(self, params, lora, tokens, caches, slot, offset,
@@ -579,7 +602,7 @@ class DecodeEngine:
             lambda: jax.jit(self._spec_verify_batched),
         )
         greedy_dev, self._caches = verify(
-            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            self.params, self._lora_tables(), jnp.asarray(self._adapter_ids),
             jnp.asarray(tokens), self._caches, jnp.asarray(self._lens),
             jnp.asarray(gate),
         )
@@ -658,6 +681,8 @@ class DecodeEngine:
         interleaving, queue depths) plus speculative-decoding acceptance.
         See docs/scheduler.md."""
         out = self._sched.stats()
+        if self._adapters is not None:
+            out["adapters"] = self._adapters.stats()
         if self._draft is not None:
             spec = dict(self._spec_counters)
             spec["accept_rate"] = (
@@ -683,15 +708,19 @@ class DecodeEngine:
 
     # -- public API --------------------------------------------------------
     def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
-               lora: str = ""):
+               lora: str = "", tenant: Optional[str] = None):
         """callback(token_id: int, finished: bool) per generated token.
 
-        Raises ValueError when the prompt cannot fit the engine's sequence
-        budget (it is never silently truncated), EngineOverloadedError when
-        the admission queue is at its depth cap, and RuntimeError when the
-        stepper is dead (shut down or crashed) — a dead engine must reject
-        work loudly, not enqueue it where no loop will ever run it (the
-        caller's callback would otherwise wait forever)."""
+        tenant keys the weighted-fair admission queue (docs/multitenancy.md);
+        it defaults to the adapter name, the natural tenant identity of a
+        LoRA fleet. Raises ValueError when the prompt cannot fit the
+        engine's sequence budget (it is never silently truncated),
+        UnknownAdapterError for an unregistered adapter,
+        EngineOverloadedError when the tenant's quota or the global depth
+        cap is hit, and RuntimeError when the stepper is dead (shut down or
+        crashed) — a dead engine must reject work loudly, not enqueue it
+        where no loop will ever run it (the caller's callback would
+        otherwise wait forever)."""
         self._check_alive()
         token_ids = list(token_ids) or [0]  # empty prompt decodes from token 0
         if len(token_ids) > self.T - 1:
@@ -709,13 +738,14 @@ class DecodeEngine:
             sampling = dataclasses.replace(sampling, max_tokens=max(1, headroom))
         self._sched.submit(Request(
             "prompt", prompt=token_ids, sampling=sampling, callback=callback,
-            adapter=adapter,
+            adapter=adapter, tenant=lora if tenant is None else tenant,
         ))
 
     def submit_prefilled(self, kv, prompt_len: int,
                          first_logits: np.ndarray, sampling: SamplingParams,
                          callback, lora: str = "",
-                         token_ids: Optional[List[int]] = None):
+                         token_ids: Optional[List[int]] = None,
+                         tenant: Optional[str] = None):
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
         transferred cache prefix — host numpy, or a jax Array when the
@@ -743,6 +773,7 @@ class DecodeEngine:
             prompt=None if token_ids is None else list(token_ids),
             prompt_len=int(prompt_len), sampling=sampling, callback=callback,
             adapter=adapter, kv=kv, first_logits=first_logits,
+            tenant=lora if tenant is None else tenant,
         ))
 
     def prefill_detached(self, token_ids: List[int], lora: str = ""):
@@ -750,7 +781,13 @@ class DecodeEngine:
         (first_logits [V], kv [L, 2, P, Hkv, D], prompt_len) for transfer to a
         decode engine. P is a padded length >= prompt_len. Prompts that do not
         fit raise ValueError (never silently truncated). A prefix-cache hit
-        prefills only the suffix and splices the cached rows host-side."""
+        prefills only the suffix and splices the cached rows host-side.
+
+        The adapter pin covers resolve-slot .. dispatch (released in a
+        finally): the device slot the program gathers from must not be
+        evicted-and-reused between resolution and the dispatch capturing the
+        table reference — after that, jax buffer immutability makes the
+        captured table safe regardless."""
         prompt = list(token_ids)
         if len(prompt) > self.T - 1:
             raise ValueError(
@@ -758,63 +795,72 @@ class DecodeEngine:
                 f"max_seq={self.T} budget (prompt_len <= max_seq - 1); "
                 f"truncate the prompt client-side or raise max_seq"
             )
-        adapter = self._adapter_index(lora)
-        lease = None
-        if self._prefix_cache is not None:
-            lease = self._prefix_cache.lookup(prompt, namespace=adapter)
-        if lease is not None:
-            # finally, not straight-line: a raise out of kv() or the suffix
-            # prefill would otherwise pin the leased blocks forever (the
-            # detached path has no scheduler drain to back-stop it), wedging
-            # eviction for the rest of the engine's life.
-            try:
-                m = lease.matched_tokens
-                prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
-            finally:
-                lease.release()
-            first_logits, kv = self._detached_suffix(
-                prompt, m, prefix_kv, adapter
-            )
-        else:
-            m = 0
-            bucket = self._bucket(len(prompt))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(prompt)] = prompt
+        adapter = self._adapter_index(lora)  # stable uid: the cache namespace
+        handle = None
+        if self._adapters is not None and adapter:
+            handle = self._adapters.acquire(adapter)
+        try:
+            adapter_slot = 0 if handle is None else handle.slot
+            lease = None
+            if self._prefix_cache is not None:
+                lease = self._prefix_cache.lookup(prompt, namespace=adapter)
+            if lease is not None:
+                # finally, not straight-line: a raise out of kv() or the suffix
+                # prefill would otherwise pin the leased blocks forever (the
+                # detached path has no scheduler drain to back-stop it), wedging
+                # eviction for the rest of the engine's life.
+                try:
+                    m = lease.matched_tokens
+                    prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
+                finally:
+                    lease.release()
+                first_logits, kv = self._detached_suffix(
+                    prompt, m, prefix_kv, adapter_slot
+                )
+            else:
+                m = 0
+                bucket = self._bucket(len(prompt))
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, : len(prompt)] = prompt
 
-            def make_detached():
-                cfg = self.cfg
+                def make_detached():
+                    cfg = self.cfg
 
-                def detached(params, lora_p, tokens, adapter_id):
-                    S = tokens.shape[1]
-                    positions = jnp.arange(S)[None, :]
-                    caches = [
-                        (
-                            jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
-                            jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                    def detached(params, lora_p, tokens, adapter_id):
+                        S = tokens.shape[1]
+                        positions = jnp.arange(S)[None, :]
+                        caches = [
+                            (
+                                jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                                jnp.zeros((1, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+                            )
+                            for _ in range(cfg.n_layers)
+                        ]
+                        mask = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None]
+                        logits, new_caches = _forward_cached(
+                            params, cfg, tokens, positions, caches,
+                            jnp.zeros((1,), jnp.int32), mask,
+                            lora=lora_p, adapter_ids=adapter_id[None],
                         )
-                        for _ in range(cfg.n_layers)
-                    ]
-                    mask = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None]
-                    logits, new_caches = _forward_cached(
-                        params, cfg, tokens, positions, caches,
-                        jnp.zeros((1,), jnp.int32), mask,
-                        lora=lora_p, adapter_ids=adapter_id[None],
-                    )
-                    kv = jnp.stack(
-                        [jnp.stack([ck[0], cv[0]]) for ck, cv in new_caches]
-                    )  # [L, 2, S, Hkv, D]
-                    return logits[0], kv
+                        kv = jnp.stack(
+                            [jnp.stack([ck[0], cv[0]]) for ck, cv in new_caches]
+                        )  # [L, 2, S, Hkv, D]
+                        return logits[0], kv
 
-                return jax.jit(detached)
+                    return jax.jit(detached)
 
-            prog = self._program(
-                self._jit_prefill, ("detached", bucket), make_detached
-            )
-            logits, kv_dev = prog(
-                self.params, self._lora, jnp.asarray(padded), jnp.int32(adapter)
-            )
-            first_logits = np.asarray(logits[len(prompt) - 1])
-            kv = np.asarray(kv_dev)
+                prog = self._program(
+                    self._jit_prefill, ("detached", bucket), make_detached
+                )
+                logits, kv_dev = prog(
+                    self.params, self._lora_tables(), jnp.asarray(padded),
+                    jnp.int32(adapter_slot)
+                )
+                first_logits = np.asarray(logits[len(prompt) - 1])
+                kv = np.asarray(kv_dev)
+        finally:
+            if handle is not None:
+                handle.release()
         self.last_prefill = {
             "offset": m, "prompt_len": len(prompt), "detached": True,
         }
@@ -826,7 +872,7 @@ class DecodeEngine:
         return first_logits, kv, len(prompt)
 
     def _detached_suffix(self, prompt: List[int], m: int,
-                         prefix_kv: np.ndarray, adapter: int):
+                         prefix_kv: np.ndarray, adapter_slot: int):
         """Detached prefill of prompt[m:] against a cached m-token KV prefix.
         Returns (first_logits [V], kv [L, 2, P, Hkv, D]) with P >= prompt_len,
         rows [0, prompt_len) valid — same contract as the cold detached path.
@@ -886,8 +932,8 @@ class DecodeEngine:
             self._jit_prefill, ("detached_suffix", mb, sb), make_detached_suffix
         )
         logits, suffix_kv = prog(
-            self.params, self._lora, jnp.asarray(prefix_kv),
-            jnp.asarray(padded), jnp.int32(m), jnp.int32(adapter),
+            self.params, self._lora_tables(), jnp.asarray(prefix_kv),
+            jnp.asarray(padded), jnp.int32(m), jnp.int32(adapter_slot),
         )
         first_logits = np.asarray(logits[len(suffix) - 1])
         kv = np.concatenate(
@@ -914,6 +960,7 @@ class DecodeEngine:
         if self._thread is not None:
             self._thread.join(timeout=5)
         for slot in self._sched.slots:
+            self._release_slot_pin(slot)  # adapter pins die with the engine
             if slot.active and slot.callback is not None:
                 slot.active = False
                 try:
@@ -996,9 +1043,9 @@ class DecodeEngine:
             self._jit_prefill, chunk.bucket, lambda: jax.jit(self._prefill_at)
         )
         last_logits, self._caches = prefill(
-            self.params, self._lora, jnp.asarray(padded), self._caches,
+            self.params, self._lora_tables(), jnp.asarray(padded), self._caches,
             jnp.int32(slot), jnp.int32(offset),
-            jnp.int32(req.prompt_len), jnp.int32(req.adapter),
+            jnp.int32(req.prompt_len), jnp.int32(req.adapter_slot),
         )
         self._sched.chunk_done(chunk)
         # The host lens mirror advances with EVERY chunk (not just the last):
@@ -1090,7 +1137,10 @@ class DecodeEngine:
     def _start_slot(self, req: Request, first: int):
         self._sched.start_decode(req, first)
         slot = req.slot
-        self._adapter_ids[slot] = req.adapter
+        # The DEVICE slot (AdapterCache row), not the stable uid: paging can
+        # move an adapter between rows, but the slot's pin (held until the
+        # request finishes) keeps this row valid for the whole generation.
+        self._adapter_ids[slot] = req.adapter_slot
         self._last_token[slot] = first
         self._emit(slot, first)
 
@@ -1100,15 +1150,29 @@ class DecodeEngine:
             s.generated >= s.params.max_tokens
             or (s.params.stop_token_id is not None and token == s.params.stop_token_id)
         )
+        self._sched.note_emitted(slot)  # per-tenant decode-token metering
         try:
             s.callback(token, done)
         except Exception:
             done = True
         if done:
             s.active = False
+            self._release_slot_pin(s)
             if self._draft is not None:
                 self._draft.on_finish(slot, s)
             # slot cache naturally reused on next admit (lens reset at prefill)
+
+    @staticmethod
+    def _release_slot_pin(s):
+        """Unpin the slot's adapter exactly once (the finish, shutdown, and
+        stepper-death paths all funnel here; a double release would free a
+        pin a concurrent admission already re-acquired)."""
+        handle, s.adapter_handle = s.adapter_handle, None
+        if handle is not None:
+            try:
+                handle.release()
+            except Exception:
+                pass  # a poisoned cache must not break finish/teardown
 
     def _loop(self):
         try:
@@ -1118,6 +1182,7 @@ class DecodeEngine:
             # Callers blocked on per-request callbacks would otherwise hang
             # forever: fail every active/queued request loudly.
             for slot in self._sched.slots:
+                self._release_slot_pin(slot)
                 if slot.active and slot.callback is not None:
                     slot.active = False
                     try:
@@ -1165,7 +1230,7 @@ class DecodeEngine:
         gate = np.zeros((self.B,), bool)
         gate[decode_slots] = True
         logits, self._caches, _ = self._jit_decode(
-            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            self.params, self._lora_tables(), jnp.asarray(self._adapter_ids),
             jnp.asarray(self._last_token), self._caches,
             jnp.asarray(self._lens), jnp.asarray(gate),
         )
@@ -1193,7 +1258,7 @@ class DecodeEngine:
         gate = np.zeros((self.B,), bool)
         gate[decode_slots] = True
         toks_dev, self._caches, _ = self._jit_decode_multi(
-            self.params, self._lora, jnp.asarray(self._adapter_ids),
+            self.params, self._lora_tables(), jnp.asarray(self._adapter_ids),
             jnp.asarray(self._last_token), self._caches,
             jnp.asarray(self._lens), jnp.asarray(gate), n=n,
         )

@@ -1,0 +1,449 @@
+"""AdapterCache: HBM-byte-budgeted pageable pool of LoRA adapter slots.
+
+Design parity: S-LoRA's unified paging of adapter weights and vLLM's
+multi-LoRA LRU cache (`vllm/lora/worker_manager.py`), recomposed for the
+static-shape TPU engine (docs/multitenancy.md). The engine's stacked device
+table (`q_A/q_B/v_A/v_B/scale` gathered by `adapter_ids`, `_engine.py`) is
+no longer load-once-and-grow: the table holds a FIXED number of device
+slots sized by `llm_adapter_cache_bytes`, every registered adapter keeps a
+host-side copy (the registry), and a request whose adapter is not resident
+pages it in — one `jax.device_put` of the packed host factors plus one
+always-cached jitted install program whose slot index is a traced scalar,
+so paging any adapter into any slot NEVER retraces (the RL602/RL604
+contract the prefill bucket table established).
+
+Pinning contract: `acquire()` pins an adapter for the lifetime of the
+returned `AdapterHandle`; a pinned adapter is never evicted, so the device
+slot an in-flight request dispatches with stays valid until `release()`.
+Because jax device buffers are immutable (installs are functional updates
+that swap the table reference), a dispatch that already captured the table
+is safe even across a later eviction — the pin only has to cover
+resolve-slot .. dispatch, but holding it for the whole generation keeps the
+invariant trivially true. leaklint enforces the release obligation
+statically (RESOURCE_TABLE "adapter pin") and leaksan tracks live handles
+at runtime (`adapter_pin` kind).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.devtools import leaksan as _leaksan
+
+# Shared metric instances (one set per process; per-cache series ride the
+# "cache" tag) — the lazy pattern kvcache/manager.py uses.
+_METRICS: Dict[str, object] = {}
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> Dict[str, object]:
+    with _METRICS_LOCK:
+        if not _METRICS:
+            from ray_tpu.util import metrics
+
+            _METRICS.update(
+                hits=metrics.Counter(
+                    "llm_adapter_cache_hits",
+                    "adapter acquires served by a resident device slot",
+                    tag_keys=("cache",),
+                ),
+                misses=metrics.Counter(
+                    "llm_adapter_cache_misses",
+                    "adapter acquires that paged the adapter in from host",
+                    tag_keys=("cache",),
+                ),
+                evictions=metrics.Counter(
+                    "llm_adapter_cache_evictions",
+                    "unpinned adapters evicted from device slots (LRU)",
+                    tag_keys=("cache",),
+                ),
+                bytes=metrics.Gauge(
+                    "llm_adapter_cache_bytes",
+                    "HBM bytes resident in the stacked adapter table",
+                    tag_keys=("cache",),
+                ),
+            )
+        return dict(_METRICS)
+
+
+class UnknownAdapterError(KeyError):
+    """The request named a LoRA adapter this engine has never registered.
+
+    Client-visible and typed: submit/prefill paths and the DP/serve layers
+    raise it instead of a bare KeyError from deep inside the engine (it
+    subclasses KeyError so pre-existing handlers keep working)."""
+
+    def __str__(self):  # KeyError wraps its message in quotes; don't.
+        return self.args[0] if self.args else ""
+
+
+class AdapterCacheFullError(RuntimeError):
+    """Every device slot is pinned by an in-flight request: the acquire
+    cannot page in without evicting someone's live adapter. Admission-time
+    callers should leave the request queued and retry next iteration
+    (back-pressure), not crash."""
+
+
+class AdapterHandle:
+    """One pin on a resident adapter: `slot` is the device-table row the
+    holder may dispatch with until `release()`."""
+
+    __slots__ = ("_cache", "name", "uid", "slot", "_released", "__weakref__")
+
+    def __init__(self, cache: "AdapterCache", name: str, uid: int, slot: int):
+        self._cache = cache
+        self.name = name
+        self.uid = uid
+        self.slot = slot
+        self._released = False
+        if uid:
+            _leaksan.track("adapter_pin", self,
+                           detail=f"{name!r} slot {slot} ({cache.name})")
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            if self.uid:
+                self._cache._unpin(self.uid)
+                _leaksan.untrack("adapter_pin", self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class _AdapterEntry:
+    """Host-side registry record: packed factors padded to the rank bucket,
+    ready to ship in ONE device_put."""
+
+    __slots__ = ("name", "uid", "rank", "alpha", "blob")
+
+    def __init__(self, name: str, uid: int, rank: int, alpha: float, blob: dict):
+        self.name = name
+        self.uid = uid
+        self.rank = rank        # the adapter's TRUE rank (scale = alpha/rank)
+        self.alpha = alpha
+        self.blob = blob        # {"q_A": [L,M,rb], "q_B": [L,rb,HD], ...} f32
+
+
+def _rank_bucket(rank: int) -> int:
+    """Smallest power of two >= rank: factors pad with zero columns (a zero
+    rank dim contributes an exactly-zero delta), so every adapter of the
+    bucket shares one table shape and one install program."""
+    b = 1
+    while b < rank:
+        b *= 2
+    return b
+
+
+class AdapterCache:
+    """Fixed-slot stacked adapter table + host registry + LRU paging.
+
+    Thread contract: `register`/`acquire`/`try_acquire`/release run under
+    one cache lock; `tables()` is a bare reference read (the install swaps
+    the table reference atomically, and jax arrays are immutable, so a
+    racing dispatch sees either the old or the new — both internally
+    consistent)."""
+
+    def __init__(self, *, n_layers: int, hidden: int, q_out: int, v_out: int,
+                 rank: int, dtype, max_adapters: int,
+                 budget_bytes: int = 0, cache_slots: Optional[int] = None,
+                 name: str = ""):
+        import jax
+        import jax.numpy as jnp
+
+        self.name = name or f"adapters-{id(self):x}"
+        self.n_layers = int(n_layers)
+        self.hidden = int(hidden)
+        self.q_out = int(q_out)
+        self.v_out = int(v_out)
+        self.rank_bucket = _rank_bucket(max(1, int(rank)))
+        self.max_adapters = max(1, int(max_adapters))
+        rb = self.rank_bucket
+        # Per-adapter HBM footprint of one device slot (factors in the model
+        # dtype + one f32 scale per layer).
+        elt = jnp.dtype(dtype).itemsize
+        self.slot_bytes = (
+            self.n_layers * rb * (2 * self.hidden + q_out + v_out) * elt
+            + self.n_layers * 4
+        )
+        if cache_slots is not None:
+            slots = int(cache_slots)
+        elif budget_bytes and budget_bytes > 0:
+            slots = int(budget_bytes) // self.slot_bytes
+        else:
+            slots = self.max_adapters
+        # At least one pageable slot; never more slots than adapters can use.
+        self.num_slots = max(1, min(self.max_adapters, slots))
+        S = self.num_slots + 1          # row 0 = base model (zero factors)
+        self._tables = {
+            "q_A": jnp.zeros((self.n_layers, S, self.hidden, rb), dtype),
+            "q_B": jnp.zeros((self.n_layers, S, rb, q_out), dtype),
+            "v_A": jnp.zeros((self.n_layers, S, self.hidden, rb), dtype),
+            "v_B": jnp.zeros((self.n_layers, S, rb, v_out), dtype),
+            "scale": jnp.zeros((self.n_layers, S), jnp.float32),
+        }
+
+        # ONE install program for the cache's whole life: blob shapes are
+        # fixed by construction and the slot index is a traced scalar, so
+        # paging never retraces (asserted by the hotpath test via
+        # install_programs in stats()).
+        def _install(tables, blob, slot):
+            out = {}
+            for k in ("q_A", "q_B", "v_A", "v_B"):
+                row = blob[k][:, None].astype(tables[k].dtype)
+                out[k] = jax.lax.dynamic_update_slice(
+                    tables[k], row, (0, slot, 0, 0)
+                )
+            out["scale"] = jax.lax.dynamic_update_slice(
+                tables["scale"], blob["scale"][:, None], (0, slot)
+            )
+            return out
+
+        self._jit_install = jax.jit(_install)
+        self._lock = threading.Lock()
+        self._registry: Dict[str, _AdapterEntry] = {}
+        self._by_uid: Dict[int, _AdapterEntry] = {}
+        self._resident: "OrderedDict[int, int]" = OrderedDict()  # uid -> slot (LRU order)
+        self._free: List[int] = list(range(1, S))
+        self._pins: Dict[int, int] = {}
+        self._counters = {
+            "registered": 0, "hits": 0, "misses": 0, "evictions": 0,
+            "page_ins": 0, "rejected_full": 0,
+        }
+
+    # -- registry ----------------------------------------------------------
+    def register(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
+                 alpha: float = 1.0) -> int:
+        """Validate and record an adapter host-side (NO device upload: a
+        cold adapter costs its first request a page-in, not every register a
+        slot). Returns the adapter's stable uid — the id the prefix cache
+        namespaces by and the metering tags carry; device slots move under
+        it as paging churns. Shape/rank validation happens HERE, against the
+        bucketed table, so a mismatched checkpoint fails loudly at register
+        time instead of inside jit."""
+        rank = None
+        for li, w in layer_weights.items():
+            if not (0 <= int(li) < self.n_layers):
+                raise ValueError(
+                    f"adapter {name!r}: layer index {li} outside the model's "
+                    f"{self.n_layers} layers"
+                )
+            for key, in_dim, out_dim in (
+                ("q_A", self.hidden, None), ("q_B", None, self.q_out),
+                ("v_A", self.hidden, None), ("v_B", None, self.v_out),
+            ):
+                if key not in w:
+                    continue
+                arr = np.asarray(w[key])
+                if arr.ndim != 2:
+                    raise ValueError(
+                        f"adapter {name!r} layer {li} {key}: expected a 2-D "
+                        f"factor, got shape {arr.shape}"
+                    )
+                r = arr.shape[1] if key.endswith("_A") else arr.shape[0]
+                fixed = arr.shape[0] if key.endswith("_A") else arr.shape[1]
+                want = in_dim if key.endswith("_A") else out_dim
+                if fixed != want:
+                    raise ValueError(
+                        f"adapter {name!r} layer {li} {key}: dim {fixed} does "
+                        f"not match the model's {want}"
+                    )
+                if rank is None:
+                    rank = r
+                elif r != rank:
+                    raise ValueError(
+                        f"adapter {name!r}: inconsistent LoRA rank across "
+                        f"factors ({rank} vs {r} at layer {li} {key})"
+                    )
+        rank = rank or 1
+        if rank > self.rank_bucket:
+            raise ValueError(
+                f"adapter {name!r} rank {rank} exceeds this engine's rank "
+                f"bucket {self.rank_bucket} (lora_config rank); re-register "
+                f"the engine with a larger rank"
+            )
+        L, rb = self.n_layers, self.rank_bucket
+        blob = {
+            "q_A": np.zeros((L, self.hidden, rb), np.float32),
+            "q_B": np.zeros((L, rb, self.q_out), np.float32),
+            "v_A": np.zeros((L, self.hidden, rb), np.float32),
+            "v_B": np.zeros((L, rb, self.v_out), np.float32),
+            "scale": np.full((L,), float(alpha) / max(1, rank), np.float32),
+        }
+        for li, w in layer_weights.items():
+            for key in ("q_A", "q_B", "v_A", "v_B"):
+                if key not in w:
+                    continue
+                arr = np.asarray(w[key], np.float32)
+                if key.endswith("_A"):
+                    blob[key][li, :, : arr.shape[1]] = arr
+                else:
+                    blob[key][li, : arr.shape[0], :] = arr
+        with self._lock:
+            if name in self._registry:
+                return self._registry[name].uid
+            if len(self._registry) >= self.max_adapters:
+                raise ValueError(
+                    f"lora capacity {self.max_adapters} exhausted "
+                    f"(registry holds {len(self._registry)} adapters)"
+                )
+            uid = len(self._registry) + 1
+            entry = _AdapterEntry(name, uid, rank, float(alpha), blob)
+            self._registry[name] = entry
+            self._by_uid[uid] = entry
+            self._counters["registered"] += 1
+        return uid
+
+    def uid_of(self, name: str) -> int:
+        """Stable uid of a registered adapter ("" = base, uid 0); raises the
+        typed client-visible UnknownAdapterError otherwise."""
+        if not name:
+            return 0
+        with self._lock:
+            entry = self._registry.get(name)
+        if entry is None:
+            raise UnknownAdapterError(
+                f"unknown lora adapter {name!r}: not registered on this "
+                f"engine (register_adapter/load_lora it first)"
+            )
+        return entry.uid
+
+    def is_resident(self, uid: int) -> bool:
+        if uid == 0:
+            return True
+        with self._lock:
+            return uid in self._resident
+
+    def resident_adapters(self) -> List[str]:
+        """Names currently paged into device slots (router residency view)."""
+        with self._lock:
+            return [self._by_uid[u].name for u in self._resident]
+
+    # -- pin / page --------------------------------------------------------
+    def acquire(self, name_or_uid) -> AdapterHandle:
+        """Pin an adapter (paging it in if evicted) and return the handle
+        whose `slot` the holder dispatches with. Raises UnknownAdapterError
+        for unregistered names and AdapterCacheFullError when every slot is
+        pinned by other in-flight requests."""
+        if isinstance(name_or_uid, str):
+            uid = self.uid_of(name_or_uid)
+        else:
+            uid = int(name_or_uid)
+        if uid == 0:
+            return AdapterHandle(self, "", 0, 0)
+        with self._lock:
+            entry = self._by_uid.get(uid)
+            if entry is None:
+                raise UnknownAdapterError(f"unknown lora adapter uid {uid}")
+            slot = self._resident.get(uid)
+            if slot is None:
+                slot = self._page_in_locked(entry)
+                self._counters["misses"] += 1
+                self._emit("misses")
+            else:
+                self._counters["hits"] += 1
+                self._emit("hits")
+            self._resident.move_to_end(uid)
+            self._pins[uid] = self._pins.get(uid, 0) + 1
+        return AdapterHandle(self, entry.name, uid, slot)
+
+    def try_acquire(self, name_or_uid) -> Optional[AdapterHandle]:
+        """acquire(), but a fully-pinned table returns None instead of
+        raising — the admission loop's leave-it-queued shape."""
+        try:
+            return self.acquire(name_or_uid)
+        except AdapterCacheFullError:
+            return None
+
+    def _page_in_locked(self, entry: _AdapterEntry) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        if self._free:
+            slot = self._free.pop(0)
+        else:
+            victim = next(
+                (u for u in self._resident if not self._pins.get(u)), None
+            )
+            if victim is None:
+                self._counters["rejected_full"] += 1
+                raise AdapterCacheFullError(
+                    f"all {self.num_slots} adapter slots are pinned by "
+                    f"in-flight requests; retry once one finishes"
+                )
+            slot = self._resident.pop(victim)
+            self._counters["evictions"] += 1
+            self._emit("evictions")
+        # ONE host->device staging of the packed factors, then the single
+        # cached install program writes the slot row. Both dispatches are
+        # async: the stepper never blocks here — a cold adapter costs queue
+        # latency while the copy lands, not a decode stall.
+        blob_dev = jax.device_put(entry.blob)
+        self._tables = self._jit_install(
+            self._tables, blob_dev, jnp.int32(slot)
+        )
+        self._resident[entry.uid] = slot
+        self._counters["page_ins"] += 1
+        return slot
+
+    def _unpin(self, uid: int):
+        with self._lock:
+            n = self._pins.get(uid, 0) - 1
+            if n <= 0:
+                self._pins.pop(uid, None)
+            else:
+                self._pins[uid] = n
+
+    # -- device view -------------------------------------------------------
+    def tables(self) -> dict:
+        """The stacked device tables the forward gathers from (per-layer
+        views are extracted INSIDE the traced function)."""
+        return self._tables
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["resident"] = len(self._resident)
+            out["pinned"] = sum(1 for v in self._pins.values() if v)
+            out["slots"] = self.num_slots
+            out["slot_bytes"] = self.slot_bytes
+            out["bytes_resident"] = (self.num_slots + 1) * self.slot_bytes
+            out["rank_bucket"] = self.rank_bucket
+            out["resident_adapters"] = [
+                self._by_uid[u].name for u in self._resident
+            ]
+            lookups = max(1, out["hits"] + out["misses"])
+            out["hit_rate"] = out["hits"] / lookups
+        try:
+            out["install_programs"] = self._jit_install._cache_size()
+        except Exception:
+            out["install_programs"] = None  # older jax: no introspection
+        self._emit_bytes(out["bytes_resident"])
+        return out
+
+    def _emit(self, key: str):
+        try:
+            _metrics()[key].inc(1, tags={"cache": self.name})
+        except Exception:
+            pass  # metrics must never break the serving path
+
+    def _emit_bytes(self, value: float):
+        try:
+            _metrics()["bytes"].set(float(value), tags={"cache": self.name})
+        except Exception:
+            pass  # metrics must never break the serving path
+
+
+__all__ = [
+    "AdapterCache",
+    "AdapterCacheFullError",
+    "AdapterHandle",
+    "UnknownAdapterError",
+]

@@ -103,6 +103,13 @@ class DPLLMServer(LLMServer):
         stats = await super().scheduler_stats()
         return {"dp_rank": self.dp_rank, **stats}
 
+    async def adapter_stats(self) -> dict:
+        """AdapterCache residency/paging counters, rank-tagged — the fleet
+        view of where each adapter is actually paged in
+        (docs/multitenancy.md)."""
+        stats = await super().adapter_stats()
+        return {"dp_rank": self.dp_rank, **(stats or {})}
+
     def _release_rank(self):
         """Idempotent: hand the dp rank back to the assigner exactly once
         (double release would free a rank a LIVE successor already claimed).
@@ -151,6 +158,11 @@ class DPRouter:
     # Per-replica LRU cap on remembered chain hashes (ints; memory is tiny,
     # the cap bounds staleness relative to the replica's real pool).
     FINGERPRINT_CAP = 4096
+    # Per-replica LRU cap on remembered adapter names (residency broadcast):
+    # generously above any engine's device-slot count, so the cap only
+    # bounds staleness, never correctness (a stale entry just means one
+    # page-in on the replica that evicted it).
+    ADAPTER_CAP = 256
 
     def __init__(self, server_handle, assigner, config: Optional[LLMConfig] = None):
         from ray_tpu._private.config import CONFIG
@@ -164,7 +176,13 @@ class DPRouter:
         self._fp_blocks = max(1, CONFIG.llm_router_fingerprint_blocks)
         # replica actor_id -> LRU of chain hashes it has (probably) cached
         self._fingerprints: Dict[object, OrderedDict] = {}
-        self._routing = {"cache_routed": 0, "balanced": 0, "untracked": 0}
+        # replica actor_id -> LRU of adapter names (probably) paged in there:
+        # recorded on every routed request, exactly like the prefix
+        # fingerprints, so tenants land where their adapter (and their
+        # prefix cache, which is namespaced BY adapter) is already hot.
+        self._adapter_res: Dict[object, OrderedDict] = {}
+        self._routing = {"cache_routed": 0, "balanced": 0, "untracked": 0,
+                         "adapter_routed": 0}
 
     # -- prefix fingerprints -----------------------------------------------
     def _chain(self, token_ids: List[int]) -> List[int]:
@@ -179,22 +197,68 @@ class DPRouter:
             out.append(h)
         return out
 
-    def _record(self, actor_id, chain: List[int]):
+    def _record(self, actor_id, chain: List[int], adapter: str = ""):
         fps = self._fingerprints.setdefault(actor_id, OrderedDict())
         for h in chain:
             fps.pop(h, None)
             fps[h] = None
         while len(fps) > self.FINGERPRINT_CAP:
             fps.popitem(last=False)
+        if adapter:
+            res = self._adapter_res.setdefault(actor_id, OrderedDict())
+            res.pop(adapter, None)
+            res[adapter] = None
+            while len(res) > self.ADAPTER_CAP:
+                res.popitem(last=False)
 
-    def _pick(self, chain: List[int]):
-        """(replica, router, mode): the longest-expected-match replica, or the
-        balanced pow-2 pick when nothing matches / the match is overloaded."""
+    def _pick(self, chain: List[int], adapter: str = ""):
+        """(replica, router, mode). Preference order: a replica already
+        holding the request's ADAPTER (longest prefix match among holders as
+        the tie-break, least-loaded otherwise — the shared affinity_pick
+        helper behind serve multiplexing), then the longest-expected-prefix
+        replica, then the balanced pow-2 pick. Every preference is
+        imbalance-guarded: paging an adapter (or recomputing a prefix) is
+        cheaper than queueing behind a hot spot."""
+        from ray_tpu.serve.handle import affinity_pick
+
         router = self._server.generate._get_router()
         replicas = router.replicas()
         live = {r._actor_id for r in replicas}
         for aid in [a for a in self._fingerprints if a not in live]:
             del self._fingerprints[aid]  # replica died or was redeployed
+        for aid in [a for a in self._adapter_res if a not in live]:
+            del self._adapter_res[aid]
+        loads = router.loads() if len(replicas) > 1 else {}
+
+        def overloaded(r):
+            if len(replicas) <= 1:
+                return False
+            least = min(loads.get(x._actor_id, 0) for x in replicas)
+            return loads.get(r._actor_id, 0) - least > self.IMBALANCE_TOLERANCE
+
+        if adapter:
+            holder_ids = {
+                aid for aid, res in self._adapter_res.items() if adapter in res
+            }
+            if holder_ids:
+                # Among adapter holders, a prefix match wins; otherwise the
+                # least-loaded holder (the multiplex affinity primitive).
+                best, best_len = None, 0
+                for r in replicas:
+                    if r._actor_id not in holder_ids:
+                        continue
+                    fps = self._fingerprints.get(r._actor_id) or ()
+                    m = 0
+                    for h in chain:
+                        if h not in fps:
+                            break
+                        m += 1
+                    if best is None or m > best_len:
+                        best, best_len = r, m
+                if best is not None and best_len == 0:
+                    best = affinity_pick(replicas, holder_ids, loads)
+                if best is not None and not overloaded(best):
+                    return router.pick_replica(best), router, "adapter_routed"
         best, best_len = None, 0
         for r in replicas:
             fps = self._fingerprints.get(r._actor_id)
@@ -207,11 +271,8 @@ class DPRouter:
                 m += 1
             if m > best_len:
                 best, best_len = r, m
-        if best is not None and len(replicas) > 1:
-            loads = router.loads()
-            least = min(loads.get(r._actor_id, 0) for r in replicas)
-            if loads.get(best._actor_id, 0) - least > self.IMBALANCE_TOLERANCE:
-                best = None
+        if best is not None and overloaded(best):
+            best = None
         if best is not None:
             return router.pick_replica(best), router, "cache_routed"
         return router.pick(""), router, "balanced"
@@ -242,27 +303,48 @@ class DPRouter:
         elif self._tokenizer is not None:
             token_ids = self._tokenizer.encode(prompt)
         chain = self._chain(token_ids) if token_ids else []
-        if not chain:
-            # No whole-block prefix to track: plain balanced fanout.
+        adapter = kw.get("lora") or ""
+        routable = getattr(self._server.generate, "_get_router", None)
+        if (not chain and not adapter) or routable is None:
+            # No whole-block prefix and no adapter to track (or a handle
+            # without routing machinery, e.g. a plain callable in tests):
+            # balanced fanout.
             self._routing["untracked"] += 1
             return await self._server.generate.remote(prompt, **kw)
-        replica, router, mode = self._pick(chain)
+        replica, router, mode = self._pick(chain, adapter)
         self._routing[mode] += 1
-        self._record(replica._actor_id, chain)
+        self._record(replica._actor_id, chain, adapter)
         # Router-side tokenization rides along: replicas accept token lists.
-        return await self._submit(router, replica, (token_ids,), dict(kw))
+        args = (token_ids,) if token_ids is not None else (prompt,)
+        return await self._submit(router, replica, args, dict(kw))
 
     async def ranks(self) -> dict:
         return await asyncio.get_running_loop().run_in_executor(
             None, lambda: ray_tpu.get(self._assigner.ranks.remote())
         )
 
+    async def load_lora(self, name: str, layer_weights: dict,
+                        alpha: float = 1.0) -> List[int]:
+        """Register an adapter on EVERY replica (the fleet-wide registry:
+        registration is host-side and cheap — docs/multitenancy.md — so
+        broadcasting keeps any replica able to serve any tenant, paging the
+        weights in only where traffic actually lands)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            lambda: self._server.load_lora.broadcast(name, layer_weights, alpha),
+        )
+
     async def routing_stats(self) -> dict:
-        """Cache-aware routing counters + fingerprint residency."""
+        """Cache-aware + adapter-aware routing counters, fingerprint and
+        residency footprints."""
         return {
             **self._routing,
             "tracked_replicas": len(self._fingerprints),
             "fingerprints": sum(len(v) for v in self._fingerprints.values()),
+            "adapter_residency": {
+                str(aid): list(res) for aid, res in self._adapter_res.items()
+            },
         }
 
     async def cache_stats(self) -> List[dict]:
@@ -280,6 +362,15 @@ class DPRouter:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, lambda: self._server.scheduler_stats.broadcast()
+        )
+
+    async def adapter_stats(self) -> List[dict]:
+        """Rank-tagged AdapterCache stats from EVERY replica: the ground
+        truth behind the router's optimistic residency map
+        (docs/multitenancy.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._server.adapter_stats.broadcast()
         )
 
     async def __call__(self, request) -> dict:

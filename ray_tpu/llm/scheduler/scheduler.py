@@ -12,16 +12,31 @@ in-flight decodes for more than one budget's worth of prefill compute, and a
 steady decode load cannot starve prefill because the head-of-line prefill
 request is always granted at least one minimum-bucket chunk per iteration.
 
-The scheduler is pure host bookkeeping: it never touches a device. The
-engine's stepper thread calls `next_plan()` and executes the returned phases
-(chunk dispatch -> spec verify -> batched decode); `submit()` is the only
-cross-thread entry point and is guarded by the admission lock.
+Multi-tenant admission (docs/multitenancy.md): the waiting set is PER-TENANT
+queues drained by stride-weighted fair queueing — each admission charges its
+tenant's virtual pass `(prompt_len + max_tokens) / weight`, and the minimum-
+pass tenant goes next — so under saturation each tenant's token share tracks
+its configured weight instead of its submission rate (`wfq=False` restores
+the single arrival-order FIFO as the A/B control). Per-tenant quotas
+(`llm_tenant_max_queue_depth`) bound each queue independently: one tenant's
+overload raises `EngineOverloadedError` for THAT tenant while the others
+keep flowing. Admission is adapter-aware: a request whose LoRA adapter is
+resident in the engine's AdapterCache is preferred (bounded skip-ahead, the
+skipped tenant is not charged), and cold head-of-line tenants trigger their
+page-ins at admission so uploads batch ahead of the next decode dispatch.
+
+The scheduler is pure host bookkeeping: it never touches a device (the
+injected adapter_acquire callback dispatches async H2D work but never
+blocks). The engine's stepper thread calls `next_plan()` and executes the
+returned phases (chunk dispatch -> spec verify -> batched decode);
+`submit()` is the only cross-thread entry point and is guarded by the
+admission lock.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -31,8 +46,9 @@ from ray_tpu.llm.kvcache.manager import PrefixLease
 
 class EngineOverloadedError(RuntimeError):
     """The engine's admission queue is at its configured depth cap
-    (`llm_max_queue_depth`); the submit was rejected without enqueueing.
-    Callers should shed load or retry with backoff."""
+    (`llm_max_queue_depth`), or the submitting tenant's own queue is at its
+    quota (`llm_tenant_max_queue_depth`); the submit was rejected without
+    enqueueing. Callers should shed load or retry with backoff."""
 
 
 class Slot:
@@ -41,7 +57,8 @@ class Slot:
     chunk-prefilled is reserved via its Request and is not yet active."""
 
     __slots__ = ("active", "generated", "params", "callback", "prompt_len",
-                 "tokens", "host_len", "adapter", "history")
+                 "tokens", "host_len", "adapter", "history", "tenant",
+                 "adapter_handle")
 
     def __init__(self):
         self.active = False
@@ -51,7 +68,9 @@ class Slot:
         self.prompt_len = 0
         self.tokens: List[int] = []       # generated tokens
         self.host_len = 0  # kv rows present for this slot (host mirror of lens)
-        self.adapter = 0
+        self.adapter = 0   # stable adapter uid (kvcache namespace, metering)
+        self.tenant = ""
+        self.adapter_handle = None  # pin released when the slot finishes
         # prompt + generated tokens: the draft providers' lookup corpus
         self.history: List[int] = []
 
@@ -63,21 +82,29 @@ class Request:
     behind a prefix-cache lease). kind "prefilled": a PD-disagg transfer —
     the KV prefix rides in and the request feeds the running queue directly
     (attach + first sample, no prefill chunks).
+
+    `adapter` is the STABLE registry uid (prefix-cache namespace, metering);
+    `adapter_slot` is the device-table row resolved at admission by the
+    AdapterCache pin (`adapter_handle`) — the two diverge once paging moves
+    adapters between slots.
     """
 
     __slots__ = ("kind", "prompt", "sampling", "callback", "adapter",
                  "prompt_len", "prefilled", "slot", "lease", "cached_offset",
-                 "kv", "first_logits", "chunks")
+                 "kv", "first_logits", "chunks", "tenant", "adapter_slot",
+                 "adapter_handle", "seq")
 
     def __init__(self, kind: str, *, prompt: Optional[List[int]] = None,
                  sampling=None, callback=None, adapter: int = 0,
                  prompt_len: int = 0, kv: Optional[np.ndarray] = None,
-                 first_logits: Optional[np.ndarray] = None):
+                 first_logits: Optional[np.ndarray] = None,
+                 tenant: str = ""):
         self.kind = kind
         self.prompt = prompt or []
         self.sampling = sampling
         self.callback = callback
         self.adapter = adapter
+        self.tenant = tenant
         self.prompt_len = prompt_len or len(self.prompt)
         self.prefilled = 0          # prompt tokens whose KV is in the slot
         self.slot: Optional[int] = None
@@ -86,6 +113,9 @@ class Request:
         self.kv = kv                # transferred KV ("prefilled" kind)
         self.first_logits = first_logits
         self.chunks = 0             # prefill chunks dispatched so far
+        self.adapter_slot = 0       # device-table row (pinned at admission)
+        self.adapter_handle = None
+        self.seq = 0                # arrival order (the FIFO control's key)
 
 
 class ScheduledChunk:
@@ -129,15 +159,42 @@ class Plan:
         self.idle = True
 
 
+class _TenantState:
+    """One tenant's queue + WFQ bookkeeping + token meters."""
+
+    __slots__ = ("queue", "weight", "pass_", "resid_skips", "admitted",
+                 "rejected", "prefill_tokens", "decode_tokens")
+
+    def __init__(self, weight: float):
+        self.queue: deque = deque()
+        self.weight = max(1e-6, float(weight))
+        self.pass_ = 0.0            # stride virtual time
+        self.resid_skips = 0        # consecutive residency skip-aheads
+        self.admitted = 0
+        self.rejected = 0
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+
+
 class Scheduler:
     """Owns waiting/prefilling/running state and assembles one Plan per
-    engine iteration. Thread contract: `submit`/`queue_depth` may be called
-    from any thread (lock-guarded); everything else runs on the engine's
-    stepper thread only."""
+    engine iteration. Thread contract: `submit`/`queue_depth`/
+    `set_tenant_weight` may be called from any thread (lock-guarded);
+    everything else runs on the engine's stepper thread only."""
+
+    # A min-pass tenant whose adapter is cold may be skipped for a resident
+    # one at most this many consecutive admissions; then it is force-picked
+    # (its page-in dispatches) so residency preference can't starve anyone.
+    RESIDENT_SKIP_MAX = 2
 
     def __init__(self, *, num_slots: int, buckets, max_seq: int,
                  token_budget: int, max_queue_depth: int, multi_step: int = 1,
-                 lookup: Optional[Callable] = None, name: str = ""):
+                 lookup: Optional[Callable] = None, name: str = "",
+                 wfq: bool = True,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tenant_quota: Optional[int] = None,
+                 adapter_acquire: Optional[Callable] = None,
+                 adapter_resident: Optional[Callable] = None):
         self.slots = [Slot() for _ in range(num_slots)]
         self._buckets = tuple(buckets)
         self._bucket_min = self._buckets[0]
@@ -148,10 +205,24 @@ class Scheduler:
         self._max_queue_depth = max(0, int(max_queue_depth))
         self.multi_step = max(1, int(multi_step))
         self._lookup = lookup       # prefix-cache lookup(prompt, adapter)
-        self._waiting: deque = deque()
+        self.wfq = bool(wfq)
+        if tenant_quota is None:
+            from ray_tpu._private.config import CONFIG
+
+            tenant_quota = CONFIG.llm_tenant_max_queue_depth
+        self._tenant_quota = max(0, int(tenant_quota))
+        self._weights: Dict[str, float] = dict(tenant_weights or {})
+        # adapter uid -> AdapterHandle | None (engine-injected; None = the
+        # cache is fully pinned, leave the request queued)
+        self._adapter_acquire = adapter_acquire
+        self._adapter_resident = adapter_resident
+        self._tenants: Dict[str, _TenantState] = {}
+        self._vtime = 0.0           # global WFQ virtual time
+        self._seq = 0
+        self._depth = 0             # total queued across tenants
         self._prefilling: List[Request] = []   # slot-assigned, chunks pending
         self._lock = threading.Lock()
-        from ray_tpu.util.metrics import Gauge
+        from ray_tpu.util.metrics import Counter, Gauge
 
         tag = {"engine": name or f"{id(self):x}"}
         self._queue_gauge = Gauge(
@@ -159,6 +230,34 @@ class Scheduler:
             "requests waiting in the engine admission queue",
             tag_keys=("engine",),
         ).set_default_tags(tag)
+        # Per-tenant metering (docs/multitenancy.md). Queue depth and
+        # rejects are cold-path (per submit); the token counters flush from
+        # the REPORT path (stats()) via delta tracking — a per-token metrics
+        # inc in the decode loop is exactly the hot-path flush leaksan's
+        # gauge export learned to avoid.
+        self._tenant_metrics = {
+            "queue": Gauge(
+                "llm_tenant_queue_depth",
+                "requests waiting in one tenant's admission queue",
+                tag_keys=("engine", "tenant"),
+            ).set_default_tags(tag),
+            "rejected": Counter(
+                "llm_tenant_rejected_total",
+                "tenant submits rejected at a quota or the global cap",
+                tag_keys=("engine", "tenant"),
+            ).set_default_tags(tag),
+            "prefill": Counter(
+                "llm_tenant_prefill_tokens",
+                "prompt tokens prefilled, by tenant",
+                tag_keys=("engine", "tenant"),
+            ).set_default_tags(tag),
+            "decode": Counter(
+                "llm_tenant_decode_tokens",
+                "completion tokens emitted, by tenant",
+                tag_keys=("engine", "tenant"),
+            ).set_default_tags(tag),
+        }
+        self._flushed_tokens: Dict[str, List[int]] = {}  # tenant -> [pf, dec]
         # Per-phase occupancy: tokens assembled into the most recent
         # iteration, by phase (prefill-chunk vs decode vs spec-verify).
         self._occ_gauges = {
@@ -173,27 +272,69 @@ class Scheduler:
             "iterations": 0, "interleaved_iterations": 0,
             "prefill_tokens": 0, "decode_tokens": 0, "verify_tokens": 0,
             "prefill_chunks": 0, "admitted": 0, "spec_rounds": 0,
+            "rejected": 0, "resident_preferred": 0,
         }
 
     # -- cross-thread API ---------------------------------------------------
-    def submit(self, request: Request):
-        """Bounded admission: reject at the depth cap instead of growing the
-        queue (and resident prompt copies) without limit under overload."""
+    def _tenant(self, name: str) -> _TenantState:
+        """Caller holds the lock."""
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _TenantState(
+                self._weights.get(name, 1.0)
+            )
+        return t
+
+    def set_tenant_weight(self, tenant: str, weight: float):
+        """Priority classes ride on weights: a tenant with weight w gets a
+        w-proportional share of admitted tokens under saturation."""
         with self._lock:
-            if self._max_queue_depth and len(self._waiting) >= self._max_queue_depth:
-                depth = len(self._waiting)
+            self._weights[tenant] = float(weight)
+            if tenant in self._tenants:
+                self._tenants[tenant].weight = max(1e-6, float(weight))
+
+    def submit(self, request: Request):
+        """Bounded admission: reject at the submitting TENANT's quota (other
+        tenants keep flowing) or at the global depth cap, instead of growing
+        the queue (and resident prompt copies) without limit under
+        overload."""
+        with self._lock:
+            t = self._tenant(request.tenant)
+            if self._tenant_quota and len(t.queue) >= self._tenant_quota:
+                t.rejected += 1
+                self._counters["rejected"] += 1
+                self._emit_reject(request.tenant)
                 raise EngineOverloadedError(
-                    f"engine admission queue is full ({depth} >= "
+                    f"tenant {request.tenant!r} admission queue is full "
+                    f"({len(t.queue)} >= llm_tenant_max_queue_depth="
+                    f"{self._tenant_quota}); this tenant should shed load or "
+                    f"retry with backoff (other tenants are unaffected)"
+                )
+            if self._max_queue_depth and self._depth >= self._max_queue_depth:
+                t.rejected += 1
+                self._counters["rejected"] += 1
+                self._emit_reject(request.tenant)
+                raise EngineOverloadedError(
+                    f"engine admission queue is full ({self._depth} >= "
                     f"llm_max_queue_depth={self._max_queue_depth}); shed load "
                     f"or retry with backoff"
                 )
-            self._waiting.append(request)
-            depth = len(self._waiting)
+            request.seq = self._seq
+            self._seq += 1
+            if not t.queue:
+                # A tenant going idle must not bank credit: its pass resumes
+                # at the current virtual time (standard stride re-entry).
+                t.pass_ = max(t.pass_, self._vtime)
+            t.queue.append(request)
+            self._depth += 1
+            depth = self._depth
+            tdepth = len(t.queue)
         self._queue_gauge.set(float(depth))
+        self._emit_tenant_queue(request.tenant, tdepth)
 
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._waiting)
+            return self._depth
 
     def drain(self) -> List[Request]:
         """Remove every queued and in-prefill request (stepper death and
@@ -202,8 +343,12 @@ class Scheduler:
         whose release raises must not leave the remaining requests leased
         (and their submitters hung): every request is still returned."""
         with self._lock:
-            queued = list(self._waiting)
-            self._waiting.clear()
+            queued: List[Request] = []
+            for t in self._tenants.values():
+                queued.extend(t.queue)
+                t.queue.clear()
+            queued.sort(key=lambda r: r.seq)
+            self._depth = 0
         queued.extend(self._prefilling)
         self._prefilling = []
         for r in queued:
@@ -213,6 +358,12 @@ class Scheduler:
                     lease.release()
                 except Exception:
                     pass  # pool poisoned mid-death; the callbacks must still fail
+            if r.adapter_handle is not None:
+                handle, r.adapter_handle = r.adapter_handle, None
+                try:
+                    handle.release()
+                except Exception:
+                    pass  # cache poisoned mid-death; keep failing callbacks
         self._queue_gauge.set(0.0)
         return queued
 
@@ -223,21 +374,88 @@ class Scheduler:
                 return b
         return self.T
 
+    def _pop_candidate_locked(self, skipped) -> Optional[Request]:
+        """Pop the next request under the admission policy (caller holds the
+        lock; the pass charge happens only after the adapter pin succeeds,
+        via _charge). FIFO mode: global arrival order. WFQ mode: min-pass
+        tenant first, with a BOUNDED skip-ahead to the nearest tenant whose
+        head adapter is already resident (the skipped tenant is not charged,
+        stays min-pass, and is force-picked after RESIDENT_SKIP_MAX skips so
+        residency preference cannot starve a cold tenant)."""
+        nonempty = [(name, t) for name, t in self._tenants.items()
+                    if t.queue and name not in skipped]
+        if not nonempty:
+            return None
+        if not self.wfq:
+            name, t = min(nonempty, key=lambda nt: nt[1].queue[0].seq)
+        else:
+            nonempty.sort(key=lambda nt: (nt[1].pass_, nt[1].queue[0].seq))
+            name, t = nonempty[0]
+            if (self._adapter_resident is not None
+                    and t.queue[0].adapter
+                    and not self._adapter_resident(t.queue[0].adapter)
+                    and t.resid_skips < self.RESIDENT_SKIP_MAX):
+                for cand_name, cand in nonempty[1:]:
+                    head = cand.queue[0]
+                    if head.adapter == 0 or self._adapter_resident(head.adapter):
+                        t.resid_skips += 1
+                        self._counters["resident_preferred"] += 1
+                        name, t = cand_name, cand
+                        break
+        t.resid_skips = 0
+        req = t.queue.popleft()
+        self._depth -= 1
+        return req
+
+    def _charge_locked(self, req: Request):
+        """Advance the admitting tenant's pass by its expected service
+        (prompt + generation budget tokens) over its weight — the stride
+        step that makes long-run token share track weights."""
+        t = self._tenant(req.tenant)
+        t.admitted += 1
+        if not self.wfq:
+            return
+        cost = req.prompt_len
+        if req.sampling is not None:
+            cost += max(1, int(req.sampling.max_tokens))
+        self._vtime = t.pass_
+        t.pass_ += max(1, cost) / t.weight
+
+    def _requeue_head_locked(self, req: Request):
+        t = self._tenant(req.tenant)
+        t.queue.appendleft(req)
+        self._depth += 1
+
     def _admit_waiting(self):
-        """Assign free slots to waiting requests (FIFO). Prefix-cache lookup
-        happens here — once per request, before its first chunk — so chunk
-        plans cover only the uncached suffix."""
+        """Assign free slots to waiting requests under the WFQ policy.
+        Prefix-cache lookup happens here — once per request, before its
+        first chunk — so chunk plans cover only the uncached suffix. The
+        adapter pin ALSO happens here: a request whose adapter cannot page
+        in (every slot pinned) goes back to its queue head uncharged and its
+        tenant is skipped for the iteration — back-pressure, not a crash.
+        Cold head-of-line tenants page in at admission, so several uploads
+        batch ahead of the next decode dispatch."""
         reserved = {r.slot for r in self._prefilling}
         free = [i for i, s in enumerate(self.slots)
                 if not s.active and i not in reserved]
         admitted = 0
+        skipped: set = set()
         while free:
             with self._lock:
-                if not self._waiting:
-                    break
-                req = self._waiting.popleft()
-                depth = len(self._waiting)
-            self._queue_gauge.set(float(depth))
+                req = self._pop_candidate_locked(skipped)
+            if req is None:
+                break
+            if req.adapter and self._adapter_acquire is not None:
+                handle = self._adapter_acquire(req.adapter)
+                if handle is None:
+                    with self._lock:
+                        self._requeue_head_locked(req)
+                    skipped.add(req.tenant)
+                    continue
+                req.adapter_handle = handle
+                req.adapter_slot = handle.slot
+            with self._lock:
+                self._charge_locked(req)
             req.slot = free.pop(0)
             if (req.kind == "prompt" and self._lookup is not None):
                 lease = self._lookup(req.prompt, req.adapter)
@@ -247,7 +465,9 @@ class Scheduler:
                     req.prefilled = lease.matched_tokens
             self._prefilling.append(req)
             admitted += 1
-        self._counters["admitted"] += admitted
+        if admitted:
+            self._counters["admitted"] += admitted
+            self._queue_gauge.set(float(self.queue_depth()))
 
     def next_plan(self, draft=None) -> Plan:
         """Assemble one iteration. Budget policy: decode (1 token/slot) and
@@ -371,10 +591,22 @@ class Scheduler:
         req.prefilled += len(chunk.tokens)
         req.chunks += 1
         self._counters["prefill_chunks"] += 1
+        if chunk.tokens:
+            with self._lock:
+                self._tenant(req.tenant).prefill_tokens += len(chunk.tokens)
+
+    def note_emitted(self, slot: int, n: int = 1):
+        """Meter n completion tokens to the slot's tenant (decode, spec-emit,
+        and the admission first-token all flow through the engine's _emit)."""
+        s = self.slots[slot]
+        with self._lock:
+            self._tenant(s.tenant).decode_tokens += n
 
     def start_decode(self, req: Request, first_token: int):
         """Prompt fully in the KV cache and first token sampled: the slot
-        joins the running (decode) set."""
+        joins the running (decode) set. The adapter pin moves from the
+        request to the slot; the engine releases it when the slot
+        finishes."""
         s = self.slots[req.slot]
         s.active = True
         s.generated = 1
@@ -383,6 +615,8 @@ class Scheduler:
         s.prompt_len = req.prompt_len
         s.host_len = req.prompt_len
         s.adapter = req.adapter
+        s.tenant = req.tenant
+        s.adapter_handle, req.adapter_handle = req.adapter_handle, None
         s.tokens = [first_token]
         s.history = list(req.prompt) + [first_token]
         if req in self._prefilling:
@@ -394,7 +628,53 @@ class Scheduler:
         out["prefilling"] = len(self._prefilling)
         out["running"] = sum(1 for s in self.slots if s.active)
         out["token_budget"] = self.token_budget
+        out["wfq"] = self.wfq
+        out["tenant_quota"] = self._tenant_quota
+        tenants = {}
+        with self._lock:
+            for name, t in self._tenants.items():
+                tenants[name] = {
+                    "queued": len(t.queue), "weight": t.weight,
+                    "admitted": t.admitted, "rejected": t.rejected,
+                    "prefill_tokens": t.prefill_tokens,
+                    "decode_tokens": t.decode_tokens,
+                }
+        out["tenants"] = tenants
+        self._flush_tenant_tokens(tenants)
         return out
+
+    def _flush_tenant_tokens(self, tenants: Dict[str, dict]):
+        """Report-path metrics export: push the per-tenant token counter
+        DELTAS since the last flush (never from the decode loop)."""
+        for name, t in tenants.items():
+            seen = self._flushed_tokens.setdefault(name, [0, 0])
+            dp = t["prefill_tokens"] - seen[0]
+            dd = t["decode_tokens"] - seen[1]
+            seen[0], seen[1] = t["prefill_tokens"], t["decode_tokens"]
+            try:
+                if dp:
+                    self._tenant_metrics["prefill"].inc(
+                        dp, tags={"tenant": name})
+                if dd:
+                    self._tenant_metrics["decode"].inc(
+                        dd, tags={"tenant": name})
+                self._tenant_metrics["queue"].set(
+                    float(t["queued"]), tags={"tenant": name})
+            except Exception:
+                pass  # metrics must never break the serving path
+
+    def _emit_reject(self, tenant: str):
+        try:
+            self._tenant_metrics["rejected"].inc(1, tags={"tenant": tenant})
+        except Exception:
+            pass  # metrics must never break the serving path
+
+    def _emit_tenant_queue(self, tenant: str, depth: int):
+        try:
+            self._tenant_metrics["queue"].set(
+                float(depth), tags={"tenant": tenant})
+        except Exception:
+            pass  # metrics must never break the serving path
 
     def _note(self, plan: Plan):
         c = self._counters

@@ -131,6 +131,19 @@ class DeploymentResponseGenerator:
         return self._ref_gen
 
 
+def affinity_pick(replicas, holder_ids, inflight):
+    """Least-loaded replica among the holders of some cached resource — the
+    ONE cache-affinity primitive shared by serve multiplexing (model-id
+    affinity in `_Router.pick`) and the DP LLM router's adapter-residency
+    path (`dp_serve.DPRouter`). `holder_ids` is the actor-id set advertising
+    the resource; returns None when no holder is live (caller falls back to
+    its balanced pick)."""
+    holders = [r for r in replicas if r._actor_id in holder_ids]
+    if not holders:
+        return None
+    return min(holders, key=lambda r: inflight.get(r._actor_id, 0))
+
+
 class _Router:
     """Replica set cache + power-of-two-choices pick. One per handle per process."""
 
@@ -250,15 +263,13 @@ class _Router:
                 # if this caller never routed it before. Least-loaded among
                 # the holders; local last-routed affinity as the fallback for
                 # models loaded since the last poll.
-                holders = [
-                    r for r in self._replicas
-                    if model_id in self._mux.get(r._actor_id, ())
-                ]
-                if holders:
-                    pick = min(
-                        holders,
-                        key=lambda r: self._inflight.get(r._actor_id, 0),
-                    )
+                pick = affinity_pick(
+                    self._replicas,
+                    {r._actor_id for r in self._replicas
+                     if model_id in self._mux.get(r._actor_id, ())},
+                    self._inflight,
+                )
+                if pick is not None:
                     self._inflight[pick._actor_id] = (
                         self._inflight.get(pick._actor_id, 0) + 1
                     )

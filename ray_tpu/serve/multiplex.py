@@ -45,10 +45,12 @@ class _ModelCache:
     multiplexing device-resident models.
     """
 
-    def __init__(self, loader: Callable, owner, max_models: int):
+    def __init__(self, loader: Callable, owner, max_models: int,
+                 on_evict: Optional[Callable] = None):
         self._loader = loader
         self._owner = owner  # the deployment instance (None for bare functions)
         self._max = max_models
+        self._on_evict = on_evict  # decorator-level callback(model_id, model)
         self._models: OrderedDict[str, Any] = OrderedDict()
         self._locks: dict[str, asyncio.Lock] = {}
         self._cap_lock = asyncio.Lock()
@@ -65,10 +67,14 @@ class _ModelCache:
             if victim_id is None:
                 return  # everything is mid-load; momentary overshoot is unavoidable
             evicted = self._models.pop(victim_id)
-            # Prefer an explicit cleanup hook; never call __del__ directly (GC
-            # would invoke it a second time — a double-release for models whose
-            # finalizer frees device memory or shuts down an engine).
-            for hook in ("close", "shutdown", "cleanup"):
+            # Device-resident models must free their HBM on evict: the
+            # dedicated `__model_unload__` hook wins, then the generic
+            # teardown verbs; never call __del__ directly (GC would invoke
+            # it a second time — a double-release for models whose finalizer
+            # frees device memory or shuts down an engine). The decorator's
+            # on_evict callback fires as well (metrics, external registries)
+            # and is not a substitute for the model's own unload.
+            for hook in ("__model_unload__", "close", "shutdown", "cleanup"):
                 fn = getattr(evicted, hook, None)
                 if callable(fn):
                     try:
@@ -76,8 +82,15 @@ class _ModelCache:
                         if inspect.isawaitable(out):
                             await out
                     except Exception:
-                        pass  # a failing user close() hook must not wedge eviction
+                        pass  # a failing user unload hook must not wedge eviction
                     break
+            if self._on_evict is not None:
+                try:
+                    out = self._on_evict(victim_id, evicted)
+                    if inspect.isawaitable(out):
+                        await out
+                except Exception:
+                    pass  # a failing eviction callback must not wedge eviction
 
     async def get(self, model_id: str):
         cached = self._models.get(model_id)
@@ -106,11 +119,16 @@ class _ModelCache:
             return out
 
 
-def multiplexed(func: Optional[Callable] = None, *, max_num_models_per_replica: int = 3):
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3,
+                on_evict: Optional[Callable] = None):
     """Decorate a model-loader method: `async def load(self, model_id) -> model`.
 
     Calls are LRU-cached per replica; the replica advertises its loaded ids so the
-    router can route with cache affinity.
+    router can route with cache affinity. Evicted models get their
+    `__model_unload__` (or close/shutdown/cleanup) hook called — device-resident
+    models must free HBM there — and the optional `on_evict(model_id, model)`
+    callback fires after it (sync or async).
     """
 
     def wrap(loader):
@@ -126,7 +144,8 @@ def multiplexed(func: Optional[Callable] = None, *, max_num_models_per_replica: 
                 holder = owner
             cache = getattr(holder, cache_attr, None)
             if cache is None:
-                cache = _ModelCache(loader, owner, max_num_models_per_replica)
+                cache = _ModelCache(loader, owner, max_num_models_per_replica,
+                                    on_evict=on_evict)
                 try:
                     setattr(holder, cache_attr, cache)
                     caches = getattr(holder, "__serve_mux_caches__", None)

@@ -1,0 +1,47 @@
+"""RL801 fixtures for the LoRA adapter pin (AdapterCache.acquire ->
+AdapterHandle.release), the round-13 RESOURCE_TABLE entry: the fire/suppress
+shapes mirror case_rl801.py's lease shapes so the new obligation rides the
+exact same path analysis."""
+
+
+def bad_adapter_pin_never_released(adapter_cache, name):
+    handle = adapter_cache.acquire(name)
+    return handle.slot
+
+
+def bad_adapter_pin_conditional(adapter_cache, name, flag):
+    handle = adapter_cache.acquire(name)
+    if flag:
+        handle.release()
+
+
+def bad_adapter_pin_risky_gap(adapter_cache, name, engine):
+    handle = adapter_cache.acquire(name)
+    engine.dispatch(handle.slot)
+    handle.release()
+
+
+def ok_adapter_pin_with(adapter_cache, name):
+    with adapter_cache.acquire(name) as handle:
+        return handle.slot
+
+
+def ok_adapter_pin_finally(adapter_cache, name, engine):
+    handle = adapter_cache.acquire(name)
+    try:
+        return engine.dispatch(handle.slot)
+    finally:
+        handle.release()
+
+
+def ok_adapter_pin_stored(req, adapter_cache, name):
+    req.adapter_handle = adapter_cache.acquire(name)
+
+
+def ok_adapter_pin_returned(adapter_cache, name):
+    return adapter_cache.acquire(name)
+
+
+def suppressed_adapter_pin(adapter_cache, name):
+    handle = adapter_cache.acquire(name)  # raylint: disable=RL801 (fixture: scheduler drain releases it)
+    return handle.slot

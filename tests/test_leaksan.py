@@ -116,6 +116,34 @@ def test_fixture_catches_planted_kv_lease_leak():
     assert mgr.stats()["leases_active"] == 0
 
 
+def test_fixture_catches_planted_adapter_pin_leak():
+    """The round-13 adapter plane is leaksan-covered from day one: an
+    AdapterHandle acquired and never released grows the `adapter_pin` kind
+    (and pins its device slot against eviction), releasing clears it."""
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.adapters import AdapterCache
+
+    cache = AdapterCache(
+        n_layers=2, hidden=8, q_out=8, v_out=8, rank=2, dtype=jnp.float32,
+        max_adapters=4, cache_slots=2, name="san-adapters",
+    )
+    cache.register("tuned", {0: {"q_A": np.zeros((8, 2), np.float32)}})
+    before = leaksan.snapshot()
+    handle = cache.acquire("tuned")
+    growth = leaksan.check_growth(before, settle_s=0.2)
+    assert "adapter_pin" in growth, growth
+    assert cache.stats()["pinned"] == 1
+    handle.release()
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+    assert cache.stats()["pinned"] == 0
+    # base-model handles are pin-free by design: nothing to leak or track
+    base = cache.acquire("")
+    assert base.slot == 0 and base.uid == 0
+    base.release()
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+
+
 def test_check_growth_waits_for_async_teardown():
     # growth that resolves within the settle window is not a leak: the
     # devobj stream pump releases on its own thread after the reader drains

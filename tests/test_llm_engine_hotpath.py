@@ -23,7 +23,7 @@ def _tiny_engine(**kwargs):
     return DecodeEngine(cfg, params, **kwargs)
 
 
-def _generate(engine, prompt, **sp):
+def _generate(engine, prompt, lora="", **sp):
     from ray_tpu.llm import SamplingParams
 
     acc, done = [], threading.Event()
@@ -33,7 +33,7 @@ def _generate(engine, prompt, **sp):
         if fin:
             done.set()
 
-    engine.submit(prompt, SamplingParams(**sp), cb)
+    engine.submit(prompt, SamplingParams(**sp), cb, lora=lora)
     assert done.wait(180), engine.error
     return acc
 
@@ -103,6 +103,42 @@ def test_jit_program_cap_zero_is_unbounded(monkeypatch):
         for n in (2, 3, 5, 9, 17):
             engine.prefill_detached(list(range(1, n + 1)))
         assert len(engine._jit_prefill) == 5
+    finally:
+        engine.shutdown()
+
+
+def test_adapter_paging_adds_zero_programs_under_churn(monkeypatch):
+    """Paging adapters through a smaller-than-registry device table must not
+    grow ANY program cache: churn across 6 adapters on 2 slots re-uses the
+    same prefill/decode programs and exactly ONE adapter-install trace (the
+    RL602/RL604 contract: slot index is a traced scalar, blob shapes are
+    fixed at construction). See docs/multitenancy.md."""
+    import numpy as np
+
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setitem(CONFIG._cache, "llm_prefix_cache_bytes", 0)
+    engine = _tiny_engine(
+        num_slots=2, max_seq=64, decode_loop=True, prefix_cache=False,
+        lora_config={"max_loras": 6, "rank": 2, "cache_slots": 2},
+    )
+    try:
+        hidden = engine.cfg.hidden
+        for i in range(6):
+            engine.add_lora(f"a{i}", {0: {"q_A": np.random.default_rng(i).normal(
+                size=(hidden, 2)).astype(np.float32)}}, alpha=4.0)
+        _generate(engine, [5, 9, 17], max_tokens=2)   # warm base programs
+        programs = len(engine._jit_prefill)
+        # churn: every adapter twice through the 2-slot budget
+        for _ in range(2):
+            for i in range(6):
+                _generate(engine, [5, 9, 17], max_tokens=2, lora=f"a{i}")
+        stats = engine.adapter_stats()
+        assert stats["evictions"] > 0, stats       # churn really paged
+        assert stats["install_programs"] in (1, None), stats
+        assert len(engine._jit_prefill) == programs, (
+            "adapter paging grew the prefill program cache"
+        )
     finally:
         engine.shutdown()
 

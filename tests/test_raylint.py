@@ -187,6 +187,20 @@ def test_rl801_fires_and_suppresses():
         assert sym not in found, sym
 
 
+def test_rl801_adapter_pin_fires_and_suppresses():
+    """The round-13 RESOURCE_TABLE entry (AdapterCache.acquire ->
+    AdapterHandle.release) flows through the same RL801 path analysis as the
+    lease/pin obligations."""
+    found = _codes_by_symbol(_fixture("case_rl8_adapter.py"))
+    for sym in ("bad_adapter_pin_never_released", "bad_adapter_pin_conditional",
+                "bad_adapter_pin_risky_gap"):
+        assert found.get(sym) == {"RL801"}, sym
+    for sym in ("ok_adapter_pin_with", "ok_adapter_pin_finally",
+                "ok_adapter_pin_stored", "ok_adapter_pin_returned",
+                "suppressed_adapter_pin"):
+        assert sym not in found, sym
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}

@@ -405,6 +405,64 @@ def test_model_multiplexing():
     serve.delete("mux_app")
 
 
+def test_multiplex_evict_runs_model_unload_hook():
+    """Evicted models free their device memory through __model_unload__
+    (preferred over the generic teardown verbs), exactly once — never via a
+    direct __del__ call (GC would double-release) — and the decorator's
+    on_evict callback observes every eviction. Unit-level: _ModelCache is
+    pure asyncio, no cluster needed."""
+    import asyncio
+
+    from ray_tpu.serve.multiplex import _ModelCache
+
+    unloads, closes, evict_cb = [], [], []
+
+    class _DeviceModel:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def __model_unload__(self):
+            unloads.append(self.mid)
+
+        def close(self):  # must NOT be reached: __model_unload__ wins
+            closes.append(self.mid)
+
+    async def scenario():
+        cache = _ModelCache(
+            lambda mid: _DeviceModel(mid), None, max_models=2,
+            on_evict=lambda mid, model: evict_cb.append((mid, model.mid)),
+        )
+        await cache.get("a")
+        await cache.get("b")
+        await cache.get("c")       # evicts "a" (LRU)
+        assert unloads == ["a"] and closes == []
+        assert evict_cb == [("a", "a")]
+        assert cache.model_ids == ["b", "c"]
+        # an async unload hook (awaitable) works too
+        class _AsyncModel:
+            def __init__(self, mid):
+                self.mid = mid
+
+            async def __model_unload__(self):
+                unloads.append("async-" + self.mid)
+
+        cache2 = _ModelCache(lambda mid: _AsyncModel(mid), None, max_models=1)
+        await cache2.get("x")
+        await cache2.get("y")
+        assert unloads[-1] == "async-x"
+        # a RAISING unload hook must not wedge eviction
+        class _BadModel:
+            def __model_unload__(self):
+                raise RuntimeError("boom")
+
+        cache3 = _ModelCache(lambda mid: _BadModel(), None, max_models=1)
+        await cache3.get("p")
+        await cache3.get("q")      # evicts p; hook raises, eviction proceeds
+        assert cache3.model_ids == ["q"]
+
+    asyncio.run(scenario())
+
+
 # ---------------------------------------------------------------- per-node proxies
 
 def test_proxy_port_and_table():
