@@ -114,7 +114,7 @@ def init(
         )
         _driver_state["head"] = head
         _driver_state["session_dir"] = session_dir
-        gcs_addr = ("127.0.0.1", head.gcs_port)
+        gcs_addr = head.gcs_addrs  # every candidate under a replicated GCS
         raylet_addr = ("127.0.0.1", head.raylet_port)
         from ray_tpu._private import usage_stats
 
@@ -134,21 +134,32 @@ def init(
             via = (host, int(port), os.urandom(8).hex(), token)
             gcs_addr = ("gcs", 0)  # symbolic: the proxy substitutes its GCS
         else:
-            host, port = address[len("ray_tpu://"):].split(":")
-            gcs_addr = (host, int(port))
+            from ray_tpu._private.gcs_replication import parse_addrs
+
+            gcs_addr = parse_addrs(address[len("ray_tpu://"):])
         from ray_tpu._private import rpc as _rpclib
+        from ray_tpu._private.gcs_replication import parse_addrs as _parse
 
         async def _head_raylet():
-            conn = await _rpclib.connect(*gcs_addr, name="client-probe", via=via)
-            try:
-                nodes = await conn.call("get_nodes")
-            finally:
-                await conn.close()
-            alive = [n for n in nodes if n["alive"]]
-            heads = [n for n in alive if n.get("is_head")] or alive
-            if not heads:
-                raise RuntimeError(f"no alive nodes behind {address}")
-            return tuple(heads[0]["address"])
+            # Walk the candidate list: under a replicated GCS only the
+            # primary answers client RPCs; followers redirect (NotPrimary).
+            last_err: Exception | None = None
+            for addr in _parse(gcs_addr):
+                conn = await _rpclib.connect(*addr, name="client-probe", via=via)
+                try:
+                    nodes = await conn.call("get_nodes")
+                except _rpclib.NotPrimaryError as e:
+                    last_err = e
+                    continue
+                finally:
+                    await conn.close()
+                alive = [n for n in nodes if n["alive"]]
+                heads = [n for n in alive if n.get("is_head")] or alive
+                if not heads:
+                    raise RuntimeError(f"no alive nodes behind {address}")
+                return tuple(heads[0]["address"])
+            raise RuntimeError(
+                f"no GCS primary behind {address}: {last_err}")
 
         # Probe on a private IO thread: init() must work from inside a running
         # event loop (notebooks/async apps are the thin client's home turf).
@@ -172,8 +183,9 @@ def init(
         _driver_state["context"] = ctx
         return ctx
     else:
-        host, port = address.split(":")
-        gcs_addr = (host, int(port))
+        from ray_tpu._private.gcs_replication import parse_addrs
+
+        gcs_addr = parse_addrs(address)  # "h:p" or "h:p,h:p,..." candidates
         from ray_tpu._private import usage_stats as _usage
 
         _usage.start_session(_client_usage_dir(), {"mode": "connect"})

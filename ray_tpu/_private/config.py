@@ -62,6 +62,9 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "gcs_store_fsync_window_s": (float, 0.01, "group-commit window: one fsync covers every GCS store append in the window (RAY_TPU_GCS_STORE_FSYNC picks the mode: always|group|off)"),
     "gcs_store_compact_threshold": (int, 50000, "rewrite the GCS append log once it holds this many records"),
     "gcs_rpc_timeout_s": (float, 30.0, "total deadline for one GCS request across reconnect retries (exponential backoff + jitter); the control plane may restart under live clients, so this bounds how long a call rides through the outage before surfacing ConnectionLost"),
+    "gcs_replicas": (int, 1, "GCS head candidates: 1 = the classic single process (restart-recovery only), 3+ = lease-based quorum HA — the primary majority-acks every durable mutation to follower candidates and a follower promotes itself when the primary's lease lapses (docs/fault_tolerance.md)"),
+    "gcs_lease_s": (float, 2.0, "primary lease window: the primary renews through the quorum at a third of this period and stops serving when it cannot confirm a majority within it; followers start an election after this much primary silence, so failover lands within ~2x the window"),
+    "gcs_quorum_timeout_s": (float, 5.0, "how long a primary waits for a majority of candidates to ack a replicated mutation before demoting itself and failing the call back to the client (who retries against the new primary)"),
     "log_dedup_window_s": (float, 5.0, "repeat window for driver-side worker-log deduplication summaries"),
     "post_mortem": (bool, False, "park failing tasks at the raising frame for `ray_tpu debug` (reference: RAY_DEBUG_POST_MORTEM)"),
     "post_mortem_wait_s": (float, 120.0, "how long a parked task waits for a debugger before its error propagates"),

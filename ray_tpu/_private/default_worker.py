@@ -16,11 +16,12 @@ from ray_tpu._private.worker import CoreWorker, set_global_worker
 def main():
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     raylet_port = int(os.environ["RAY_TPU_RAYLET_PORT"])
-    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
     worker = CoreWorker(
         mode="worker",
         raylet_addr=("127.0.0.1", raylet_port),
-        gcs_addr=(gcs_host, int(gcs_port)),
+        # Comma-separated candidate list under a replicated GCS; CoreWorker
+        # normalizes and fails over between them.
+        gcs_addr=os.environ["RAY_TPU_GCS_ADDR"],
         worker_id=worker_id,
     )
     set_global_worker(worker)

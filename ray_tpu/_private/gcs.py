@@ -1135,6 +1135,29 @@ class GcsService:
                 latest[tid] = e  # log order: the last occurrence is newest
         return list(latest.values())
 
+    # ---------------- replication-plane surface (single-candidate answers)
+
+    def _repl_view(self) -> dict:
+        """A lone GCS answers the replicated-mode surface so clients can use
+        ONE probe/redirect path regardless of `gcs_replicas` (with one
+        candidate there is nobody else to be primary)."""
+        return {
+            "role": "primary", "epoch": 0, "seq": 0, "promised": 0,
+            "candidate_id": 0, "replicas": 1, "primary": None,
+            "failovers": 0, "lag": {},
+        }
+
+    async def rpc_repl_status(self, conn):
+        view = self._repl_view()
+        if hasattr(self.store, "stats_view"):
+            view["store"] = self.store.stats_view()
+        return view
+
+    async def rpc_store_stats(self, conn):
+        store = (self.store.stats_view()
+                 if hasattr(self.store, "stats_view") else {})
+        return {"store": store, "repl": self._repl_view()}
+
     async def rpc_cluster_resources(self, conn):
         total: dict[str, float] = {}
         avail: dict[str, float] = {}

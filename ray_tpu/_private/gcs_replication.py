@@ -1,0 +1,755 @@
+"""Replicated GCS: lease-based quorum HA across head candidates.
+
+Design role: the reference Ray outsources GCS durability to an external Redis
+(`redis_store_client.h:126`) and treats head loss as restart-recovery; this
+framework has no Redis, so the `gcs_store.FileStoreClient` append log becomes
+its own replicated store (docs/fault_tolerance.md §replicated GCS):
+
+- `gcs_replicas` head **candidates** each run this module over their own
+  `ReplicatedFileStore`. Exactly one is **primary** at a time; the rest are
+  warm standbys whose stores track the primary's log record-for-record.
+- The primary streams every durable mutation `(op, table, key, value)` to the
+  followers and acks a client mutation only after a **majority** of
+  candidates (itself included) has flushed it. No full Raft: a single
+  epoch-fenced leader lease over a replicated log is enough for a control
+  plane whose live state (nodes, object locations) is re-reported by raylets
+  anyway.
+- The primary holds a time-bounded **lease** renewed through the same quorum
+  (renew every lease_s/3; stop serving when a majority hasn't confirmed
+  within lease_s). On lease expiry a follower elects itself at a higher
+  epoch; grants require the requester to be at least as caught up as the
+  grantor, so only a most-caught-up follower can win.
+- **Epoch fencing**: every replication RPC carries the sender's epoch;
+  candidates reject anything below their highest promised epoch, so a
+  deposed primary's stragglers bounce and the deposed primary demotes. A
+  rejoining candidate is resynced from the new primary's snapshot, which
+  truncates any unacked tail it accumulated while deposed.
+- Clients never see any of this beyond `rpc.NotPrimaryError` (a redirect
+  carrying the current primary's address) and multi-address candidate lists:
+  `gcs_call`/raylet reconnect machinery probes `repl_status` and retries
+  idempotent calls against the new primary exactly like today's
+  restart-reconnect path.
+
+With `gcs_replicas=1` none of this is instantiated — `gcs_main` runs the
+classic single `GcsService` and behavior is byte-for-byte the old one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.gcs_store import FileStoreClient
+
+logger = logging.getLogger(__name__)
+
+#: Internal store table carrying the replication position; rides the same
+#: append log as the data it describes, so compaction (which rewrites every
+#: live key) keeps the (epoch, seq) stamp consistent with the tables — an
+#: epoch-stamped compacted log still knows exactly where it stands.
+_REPL_TABLE = "_repl"
+_STATE_KEY = "state"
+
+#: Records the primary retains in memory for incremental follower catch-up;
+#: a follower further behind than this is resynced from a full snapshot.
+_REPL_RING = 50000
+
+
+def parse_addrs(spec) -> list:
+    """Normalize an address spec — "h:p,h:p", (h, p), or a list of either —
+    into a list of (host, port) tuples."""
+    if spec is None:
+        return []
+    if isinstance(spec, str):
+        out = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, port = part.rsplit(":", 1)
+            out.append((host, int(port)))
+        return out
+    spec = list(spec)
+    if not spec:
+        return []
+    if isinstance(spec[0], (list, tuple)):
+        return [(a[0], int(a[1])) for a in spec]
+    return [(spec[0], int(spec[1]))]
+
+
+def format_addrs(addrs) -> str:
+    return ",".join(f"{h}:{p}" for h, p in parse_addrs(addrs))
+
+
+def probe_status(addr, timeout: float = 2.0) -> Optional[dict]:
+    """Synchronous repl_status probe of one candidate (driver/test helper);
+    None when the candidate is unreachable."""
+
+    async def _probe():
+        conn = await rpc.connect(addr[0], addr[1], name="gcs-probe",
+                                 timeout=timeout)
+        try:
+            return await asyncio.wait_for(conn.call("repl_status"), timeout)
+        finally:
+            await conn.close()
+
+    try:
+        return asyncio.run(_probe())
+    except Exception:
+        return None
+
+
+class ReplicatedFileStore(FileStoreClient):
+    """A FileStoreClient that knows its replication position.
+
+    Every mutation also persists the ("_repl", "state") row carrying
+    (epoch, seq, promised), so crash recovery and compaction restore the
+    coordinates together with the data. Two mutation paths:
+
+    - primary-originated `put`/`delete`: assign the next seq, persist, and
+      hand (seq, record) to the candidate's replication fan-out. When no
+      fan-out callback is installed (this candidate is NOT primary) the write
+      is dropped — that is the local half of epoch fencing: a zombie
+      GcsService task on a deposed candidate cannot diverge the follower log.
+    - follower `apply_replicated`: adopt the primary's (epoch, seq) verbatim.
+    """
+
+    def __init__(self, store_dir: str):
+        super().__init__(store_dir)
+        self.epoch = 0      # epoch of the primary whose records we hold
+        self.seq = 0        # last applied/assigned replicated mutation
+        self.promised = 0   # highest epoch this candidate granted a lease for
+        self._mutation_cb: Optional[Callable] = None  # primary fan-out hook
+
+    def load(self):
+        super().load()
+        st = self.get(_REPL_TABLE, _STATE_KEY)
+        if st:
+            self.epoch = int(st.get("epoch", 0))
+            self.seq = int(st.get("seq", 0))
+            self.promised = int(st.get("promised", 0))
+
+    def _persist_state(self):
+        FileStoreClient.put(self, _REPL_TABLE, _STATE_KEY, {
+            "epoch": self.epoch, "seq": self.seq, "promised": self.promised,
+        })
+
+    def grant(self, epoch: int):
+        """Persist a lease promise BEFORE replying to the requester: a
+        granted-then-forgotten promise could elect two primaries."""
+        if epoch > self.promised:
+            self.promised = epoch
+            self._persist_state()
+
+    # ------------------------------------------------- primary-originated
+    def put(self, table: str, key, value):
+        if table == _REPL_TABLE:
+            FileStoreClient.put(self, table, key, value)
+            return
+        if self._mutation_cb is None:
+            return  # fenced: only the primary image originates mutations
+        self.seq += 1
+        FileStoreClient.put(self, table, key, value)
+        self._persist_state()
+        self._mutation_cb(self.seq, ("put", table, key, value))
+
+    def delete(self, table: str, key):
+        if table == _REPL_TABLE:
+            FileStoreClient.delete(self, table, key)
+            return
+        if self._mutation_cb is None:
+            return
+        self.seq += 1
+        FileStoreClient.delete(self, table, key)
+        self._persist_state()
+        self._mutation_cb(self.seq, ("del", table, key, None))
+
+    # ------------------------------------------------------- follower apply
+    def apply_replicated(self, epoch: int, seq: int, record):
+        op, table, key, value = record
+        if op == "put":
+            FileStoreClient.put(self, table, key, value)
+        else:
+            FileStoreClient.delete(self, table, key)
+        self.epoch = epoch
+        self.seq = seq
+        self._persist_state()
+
+    def snapshot(self) -> dict:
+        """Live-table image for follower resync (the replication coordinates
+        travel beside it, not inside it)."""
+        with self._lock:
+            return {t: dict(kv) for t, kv in self._tables.items()
+                    if t != _REPL_TABLE}
+
+    def reset_from_snapshot(self, tables: dict, epoch: int, seq: int):
+        """Adopt the primary's image wholesale. This is where a deposed
+        primary's unacked tail is truncated away: the snapshot IS the quorum
+        state, and the local log is rewritten (compaction-style) to match."""
+        with self._lock:
+            self._tables = {t: dict(kv) for t, kv in tables.items()}
+            self.epoch = int(epoch)
+            self.seq = int(seq)
+            self._tables[_REPL_TABLE] = {_STATE_KEY: {
+                "epoch": self.epoch, "seq": self.seq,
+                "promised": self.promised,
+            }}
+            if self._log is not None:
+                self._compact_locked()
+
+
+class PeerLink:
+    """A primary->follower replication connection. Explicit acquire/release
+    pair (leaklint: `open_peer` -> `close`): a deposed primary that failed to
+    close its links would keep streaming stale-epoch appends at live
+    followers forever."""
+
+    def __init__(self, addr, conn: rpc.Connection):
+        self.addr = tuple(addr)
+        self.conn = conn
+        from ray_tpu.devtools import leaksan
+
+        leaksan.track("gcs_repl_peer", self, detail=f"peer {self.addr}")
+
+    async def close(self):
+        from ray_tpu.devtools import leaksan
+
+        leaksan.untrack("gcs_repl_peer", self)
+        if self.conn is not None and not self.conn.closed:
+            try:
+                await self.conn.close()
+            except Exception:
+                logger.debug("peer link close failed", exc_info=True)
+
+
+class LeaseToken:
+    """The primary lease as an explicit handle (leaklint: `acquire_lease` ->
+    `release`): promotion acquires it, demotion MUST release it — a candidate
+    that kept serving on a released lease would split-brain the cluster."""
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+        self.released = False
+        from ray_tpu.devtools import leaksan
+
+        leaksan.track("gcs_lease", self, detail=f"epoch {epoch}")
+
+    def release(self):
+        if not self.released:
+            self.released = True
+            from ray_tpu.devtools import leaksan
+
+            leaksan.untrack("gcs_lease", self)
+
+
+class _CandidateFacade:
+    """Per-connection RPC handler: replication RPCs (rpc_repl_*, plus the
+    role-agnostic status/stats endpoints) are served in any role; everything
+    else is a client call, answered by the primary's GcsService or with a
+    NOT_PRIMARY redirect."""
+
+    def __init__(self, cand: "GcsCandidate"):
+        self._cand = cand
+
+    def __getattr__(self, name: str):
+        if not name.startswith("rpc_"):
+            raise AttributeError(name)
+        if getattr(type(self._cand), name, None) is not None:
+            return getattr(self._cand, name)
+        cand = self._cand
+
+        async def _serve(conn, *args, **kwargs):
+            return await cand.serve_client(conn, name[4:], args, kwargs)
+
+        return _serve
+
+
+class GcsCandidate:
+    """One GCS head candidate: follower by default, primary while it holds
+    the quorum lease. See the module docstring for the protocol."""
+
+    def __init__(self, candidate_id: int, peers, store_dir: str,
+                 lease_s: float | None = None,
+                 quorum_timeout_s: float | None = None):
+        self.candidate_id = int(candidate_id)
+        self.peers = parse_addrs(peers)
+        self.addr = self.peers[self.candidate_id]
+        self.lease_s = float(lease_s if lease_s is not None
+                             else CONFIG.gcs_lease_s)
+        self.quorum_timeout_s = float(
+            quorum_timeout_s if quorum_timeout_s is not None
+            else CONFIG.gcs_quorum_timeout_s)
+        self.store = ReplicatedFileStore(store_dir)
+        self.store.load()
+        self.role = "follower"
+        self.gcs = None  # GcsService while primary
+        self.server: rpc.RpcServer | None = None
+        self.failovers = 0  # promotions past the cluster's first election
+        self._lease: LeaseToken | None = None
+        self._primary_hint: Optional[tuple] = None
+        # follower: primary silence past this -> start an election. Staggered
+        # by candidate id so concurrent expiries don't split the vote.
+        self._lease_deadline = (
+            time.monotonic() + 0.25 * self.lease_s * self.candidate_id
+        )
+        # primary: serving allowed while a majority confirmed us this recently
+        self._peer_renewed: dict[int, float] = {}
+        self._lease_ok_until = 0.0
+        self._links: dict[int, PeerLink] = {}
+        self._peer_acked: dict[int, int] = {}
+        self._repl_log: deque = deque(maxlen=_REPL_RING)  # (seq, record)
+        self._send_events: dict[int, asyncio.Event] = {}
+        self._commit_waiters: list = []  # (seq, future)
+        self._sender_tasks: dict[int, asyncio.Task] = {}
+        self._renew_task: asyncio.Task | None = None
+        self._election_task: asyncio.Task | None = None
+        self._demoting = False
+        self._stopping = False
+
+    # ------------------------------------------------------------- helpers
+
+    @property
+    def _majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    def _other_ids(self):
+        return [i for i in range(len(self.peers)) if i != self.candidate_id]
+
+    def facade(self, conn) -> _CandidateFacade:
+        return _CandidateFacade(self)
+
+    def start_background(self):
+        loop = asyncio.get_running_loop()
+        self._election_task = loop.create_task(self._election_loop())
+
+    def repl_lag(self) -> dict:
+        """Per-peer records behind the primary's log head (primary only)."""
+        if self.role != "primary":
+            return {}
+        return {str(i): max(0, self.store.seq - self._peer_acked.get(i, 0))
+                for i in self._other_ids()}
+
+    def status_view(self) -> dict:
+        return {
+            "role": self.role,
+            "epoch": self.store.epoch,
+            "seq": self.store.seq,
+            "promised": self.store.promised,
+            "candidate_id": self.candidate_id,
+            "replicas": len(self.peers),
+            "primary": (tuple(self.addr) if self.role == "primary"
+                        else self._primary_hint),
+            "failovers": self.failovers,
+        }
+
+    # ------------------------------------------------------- client serving
+
+    async def serve_client(self, conn, method: str, args, kwargs):
+        if self.role == "primary" and time.monotonic() > self._lease_ok_until:
+            # Can't prove a majority still honors us: stop serving rather
+            # than hand out possibly-stale reads beside a promoted follower.
+            await self._demote("lease lapsed without quorum confirmation")
+        gcs = self.gcs
+        if self.role != "primary" or gcs is None:
+            raise rpc.NotPrimaryError(self._primary_hint)
+        fn = getattr(gcs, "rpc_" + method, None)
+        if fn is None:
+            raise rpc.RpcError(
+                f"GcsService has no method {method!r}")
+        start_seq = self.store.seq
+        result = fn(conn, *args, **kwargs)
+        if asyncio.iscoroutine(result):
+            result = await result
+        if self.store.seq > start_seq:
+            # Majority-ack before the client sees success: an acked mutation
+            # survives any single candidate's loss.
+            await self._wait_committed(self.store.seq)
+        return result
+
+    def _committed_seq(self) -> int:
+        acked = sorted(
+            [self.store.seq] + [self._peer_acked.get(i, 0)
+                                for i in self._other_ids()],
+            reverse=True,
+        )
+        return acked[self._majority - 1]
+
+    async def _wait_committed(self, seq: int):
+        if self._committed_seq() >= seq:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._commit_waiters.append((seq, fut))
+        try:
+            await asyncio.wait_for(fut, self.quorum_timeout_s)
+        except asyncio.TimeoutError:
+            await self._demote("quorum ack timeout")
+            raise rpc.NotPrimaryError(None)
+
+    def _resolve_commit_waiters(self):
+        if not self._commit_waiters:
+            return
+        committed = self._committed_seq()
+        keep = []
+        for seq, fut in self._commit_waiters:
+            if seq <= committed:
+                if not fut.done():
+                    fut.set_result(None)
+            else:
+                keep.append((seq, fut))
+        self._commit_waiters = keep
+
+    def _note_peer_alive(self, idx: int):
+        """A follower acked traffic at our epoch: it still honors the lease.
+        The lease is valid while the majority-th freshest confirmation is
+        within lease_s."""
+        now = time.monotonic()
+        self._peer_renewed[idx] = now
+        times = sorted(
+            [now] + [self._peer_renewed.get(i, 0.0)
+                     for i in self._other_ids()],
+            reverse=True,
+        )
+        self._lease_ok_until = times[self._majority - 1] + self.lease_s
+
+    def _on_local_mutation(self, seq: int, record):
+        self._repl_log.append((seq, record))
+        for ev in self._send_events.values():
+            ev.set()
+
+    # ------------------------------------------------------- replication RPC
+
+    async def rpc_repl_status(self, conn):
+        view = self.status_view()
+        view["store"] = self.store.stats_view()
+        view["lag"] = self.repl_lag()
+        return view
+
+    async def rpc_store_stats(self, conn):
+        """Report path for observability (docs/raylint.md leaksan lesson:
+        metrics objects live driver-side in control_plane_stats(), never in
+        this process's append/replication paths)."""
+        return {"store": self.store.stats_view(), "repl": {
+            **self.status_view(), "lag": self.repl_lag(),
+        }}
+
+    async def rpc_repl_request_lease(self, conn, epoch: int, last_seq: int,
+                                     candidate_id: int):
+        if epoch <= max(self.store.promised,
+                        self.store.epoch if self.role == "primary" else 0):
+            return {"granted": False, "promised": self.store.promised,
+                    "seq": self.store.seq}
+        if last_seq < self.store.seq:
+            # Most-caught-up rule: never grant to a candidate that would
+            # lose acked records we hold.
+            return {"granted": False, "promised": self.store.promised,
+                    "seq": self.store.seq, "behind": True}
+        if self.role == "primary":
+            # A higher-epoch candidate with our full log asked while we
+            # could not renew: step down before granting.
+            await self._demote(f"deposed by lease request at epoch {epoch}")
+        self.store.grant(epoch)
+        self._primary_hint = tuple(self.peers[candidate_id])
+        self._lease_deadline = time.monotonic() + self.lease_s
+        return {"granted": True, "seq": self.store.seq}
+
+    async def rpc_repl_sync(self, conn, epoch: int, seq: int, tables: dict,
+                            candidate_id: int):
+        if epoch < self.store.promised:
+            return {"ok": False, "promised": self.store.promised}
+        if self.role == "primary":
+            if epoch <= self.store.epoch:
+                return {"ok": False, "promised": self.store.epoch}
+            await self._demote(f"snapshot from higher-epoch primary {epoch}")
+        self.store.grant(epoch)
+        self.store.reset_from_snapshot(tables, epoch, seq)
+        self._primary_hint = tuple(self.peers[candidate_id])
+        self._lease_deadline = time.monotonic() + self.lease_s
+        return {"ok": True, "seq": self.store.seq}
+
+    async def rpc_repl_append(self, conn, epoch: int, batch: list,
+                              candidate_id: int | None = None):
+        if epoch < self.store.promised or (
+                self.role == "primary" and epoch <= self.store.epoch):
+            # Epoch fencing: a deposed primary's straggler lands here.
+            return {"ok": False,
+                    "promised": max(self.store.promised, self.store.epoch)}
+        if self.role == "primary":
+            await self._demote(f"appends from higher-epoch primary {epoch}")
+        self.store.grant(epoch)
+        for seq, record in batch:
+            if seq <= self.store.seq:
+                continue  # duplicate delivery after a sender retry
+            if seq != self.store.seq + 1:
+                return {"ok": False, "resync": True, "seq": self.store.seq}
+            self.store.apply_replicated(epoch, seq, record)
+        if candidate_id is not None:
+            self._primary_hint = tuple(self.peers[candidate_id])
+        self._lease_deadline = time.monotonic() + self.lease_s
+        return {"ok": True, "seq": self.store.seq}
+
+    async def rpc_repl_renew(self, conn, epoch: int, candidate_id: int):
+        if epoch < self.store.promised or (
+                self.role == "primary" and epoch <= self.store.epoch):
+            return {"ok": False,
+                    "promised": max(self.store.promised, self.store.epoch)}
+        if self.role == "primary":
+            await self._demote(f"renewal from higher-epoch primary {epoch}")
+        self.store.grant(epoch)
+        self._primary_hint = tuple(self.peers[candidate_id])
+        self._lease_deadline = time.monotonic() + self.lease_s
+        return {"ok": True, "seq": self.store.seq}
+
+    # ----------------------------------------------------- election / lease
+
+    async def _election_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(min(0.05, self.lease_s / 10))
+            if self.role != "follower" or self._stopping:
+                continue
+            if time.monotonic() < self._lease_deadline:
+                continue
+            try:
+                await self._try_elect()
+            except Exception:
+                logger.exception("gcs candidate %d: election attempt failed",
+                                 self.candidate_id)
+            if self.role != "primary":
+                # Lost (or aborted): back off with jitter + id stagger so
+                # concurrent candidates interleave instead of colliding.
+                self._lease_deadline = time.monotonic() + self.lease_s * (
+                    random.uniform(0.2, 0.5) + 0.15 * self.candidate_id
+                )
+
+    async def _try_elect(self):
+        epoch = max(self.store.promised, self.store.epoch) + 1
+        self.store.grant(epoch)  # our own vote, persisted first
+
+        async def ask(idx):
+            try:
+                conn = await rpc.connect(
+                    *self.peers[idx], timeout=2.0,
+                    name=f"gcs-cand{self.candidate_id}->elect{idx}",
+                )
+                try:
+                    return await asyncio.wait_for(
+                        conn.call("repl_request_lease", epoch,
+                                  self.store.seq, self.candidate_id),
+                        2.0,
+                    )
+                finally:
+                    await conn.close()
+            except Exception:
+                return None  # unreachable peer: no vote either way
+
+        replies = await asyncio.gather(*(ask(i) for i in self._other_ids()))
+        grants = 1 + sum(1 for r in replies if r and r.get("granted"))
+        if (grants >= self._majority and self.role == "follower"
+                and self.store.promised == epoch and not self._stopping):
+            await self._promote(epoch)
+
+    async def _promote(self, epoch: int):
+        logger.warning("gcs candidate %d: promoting to primary at epoch %d "
+                       "(seq %d)", self.candidate_id, epoch, self.store.seq)
+        self.store.epoch = epoch
+        self.store._persist_state()
+        self.role = "primary"
+        self._demoting = False
+        self._primary_hint = tuple(self.addr)
+        self._lease = self.acquire_lease(epoch)
+        if epoch > 1:
+            self.failovers += 1
+        self._peer_acked = {}
+        self._peer_renewed = {}
+        self._repl_log.clear()
+        self._commit_waiters = []
+        self.store._mutation_cb = self._on_local_mutation
+        self._lease_ok_until = time.monotonic() + self.lease_s
+        # Warm standby -> serving image: the store's tables are already
+        # replayed, so building the GcsService is cheap; live state (nodes,
+        # actor addresses, object locations) arrives via raylet
+        # re-registration exactly like the restart-recovery path.
+        from ray_tpu._private.gcs import GcsService
+
+        self.gcs = GcsService(store=self.store)
+        self.gcs.start_background()
+        loop = asyncio.get_running_loop()
+        for idx in self._other_ids():
+            self._send_events[idx] = asyncio.Event()
+            self._sender_tasks[idx] = loop.create_task(self._sender(idx))
+        self._renew_task = loop.create_task(self._renew_loop())
+
+    def acquire_lease(self, epoch: int) -> LeaseToken:
+        return LeaseToken(epoch)
+
+    async def _demote(self, reason: str):
+        if self.role != "primary" or self._demoting:
+            return
+        self._demoting = True
+        logger.warning("gcs candidate %d: demoting (epoch %d): %s",
+                       self.candidate_id, self.store.epoch, reason)
+        self.role = "follower"
+        self.store._mutation_cb = None
+        for task in list(self._sender_tasks.values()):
+            task.cancel()
+        self._sender_tasks.clear()
+        if self._renew_task is not None:
+            self._renew_task.cancel()
+            self._renew_task = None
+        for link in list(self._links.values()):
+            await link.close()
+        self._links.clear()
+        self._send_events.clear()
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+        gcs, self.gcs = self.gcs, None
+        if gcs is not None and gcs._death_task is not None:
+            gcs._death_task.cancel()
+        for seq, fut in self._commit_waiters:
+            if not fut.done():
+                fut.set_exception(rpc.NotPrimaryError(None))
+        self._commit_waiters = []
+        # Full silence window before this candidate may re-elect itself.
+        self._lease_deadline = time.monotonic() + self.lease_s
+        self._demoting = False
+        # Kick clients off the deposed endpoint so every one re-discovers the
+        # primary through its reconnect path. Deferred so an in-flight
+        # replication reply (the very RPC that deposed us) can still go out.
+        if self.server is not None:
+            asyncio.get_running_loop().create_task(self._kick_clients())
+
+    async def _kick_clients(self):
+        await asyncio.sleep(0.05)
+        if self.role == "primary" or self.server is None:
+            return
+        for conn in list(self.server.connections):
+            try:
+                await conn.close()
+            except Exception:
+                logger.debug("client kick failed", exc_info=True)
+
+    async def _renew_loop(self):
+        period = self.lease_s / 3.0
+        while self.role == "primary" and not self._stopping:
+            await asyncio.sleep(period)
+            for idx in self._other_ids():
+                link = self._links.get(idx)
+                if link is None:
+                    continue
+                try:
+                    reply = await asyncio.wait_for(
+                        link.conn.call("repl_renew", self.store.epoch,
+                                       self.candidate_id),
+                        period,
+                    )
+                except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                    continue  # sender loop owns reconnect
+                if reply.get("ok"):
+                    self._note_peer_alive(idx)
+                elif reply.get("promised", 0) > self.store.epoch:
+                    await self._demote(
+                        f"peer {idx} promised epoch {reply['promised']}")
+                    return
+
+    # ------------------------------------------------------------ streaming
+
+    async def _sender(self, idx: int):
+        """Per-follower replication pump: snapshot on (re)connect, then
+        incremental (seq, record) batches; every ack feeds the commit index
+        and the lease."""
+        addr = self.peers[idx]
+        ev = self._send_events[idx]
+        while self.role == "primary" and not self._stopping:
+            link = self._links.get(idx)
+            try:
+                if link is None:
+                    conn = await rpc.connect(
+                        *addr, timeout=2.0,
+                        name=f"gcs-primary{self.candidate_id}->peer{idx}",
+                    )
+                    link = self.open_peer(addr, conn)
+                    self._links[idx] = link
+                    sync_seq = self.store.seq
+                    reply = await asyncio.wait_for(
+                        link.conn.call("repl_sync", self.store.epoch,
+                                       sync_seq, self.store.snapshot(),
+                                       self.candidate_id),
+                        self.quorum_timeout_s,
+                    )
+                    if not reply.get("ok"):
+                        if reply.get("promised", 0) > self.store.epoch:
+                            await self._demote(
+                                f"peer {idx} fenced our epoch "
+                                f"{self.store.epoch}")
+                            return
+                        raise rpc.RpcError("sync rejected")
+                    self._peer_acked[idx] = sync_seq
+                    self._note_peer_alive(idx)
+                    self._resolve_commit_waiters()
+                try:
+                    await asyncio.wait_for(ev.wait(), self.lease_s / 3.0)
+                except asyncio.TimeoutError:
+                    pass
+                ev.clear()
+                acked = self._peer_acked.get(idx, 0)
+                # Walk from the ring's tail only as far as this peer's ack:
+                # batch cost is O(records to send), not O(ring).
+                batch = []
+                for s, r in reversed(self._repl_log):
+                    if s <= acked:
+                        break
+                    batch.append((s, r))
+                batch.reverse()
+                if not batch:
+                    continue
+                if batch[0][0] != acked + 1:
+                    # The ring dropped records this follower still needs:
+                    # fall back to a fresh snapshot.
+                    raise rpc.RpcError("follower behind the repl ring")
+                reply = await asyncio.wait_for(
+                    link.conn.call("repl_append", self.store.epoch, batch,
+                                   self.candidate_id),
+                    self.quorum_timeout_s,
+                )
+                if reply.get("ok"):
+                    if reply["seq"] > self.store.seq:
+                        # The follower is AHEAD of our log: it holds a stale
+                        # tail from an era we never saw — snapshot it back.
+                        raise rpc.RpcError("follower ahead of primary log")
+                    self._peer_acked[idx] = reply["seq"]
+                    self._note_peer_alive(idx)
+                    self._resolve_commit_waiters()
+                elif reply.get("resync"):
+                    raise rpc.RpcError("follower requested resync")
+                elif reply.get("promised", 0) > self.store.epoch:
+                    await self._demote(
+                        f"peer {idx} fenced our epoch {self.store.epoch}")
+                    return
+            except asyncio.CancelledError:
+                return
+            except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                link = self._links.pop(idx, None)
+                if link is not None:
+                    await link.close()
+                await asyncio.sleep(0.2)
+
+    def open_peer(self, addr, conn) -> PeerLink:
+        return PeerLink(addr, conn)
+
+    # ------------------------------------------------------------- teardown
+
+    async def shutdown(self):
+        self._stopping = True
+        if self._election_task is not None:
+            self._election_task.cancel()
+            self._election_task = None
+        await self._demote("shutting down")
+        self.store.close()
+        if self.server is not None:
+            await self.server.close()

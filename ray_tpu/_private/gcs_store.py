@@ -86,6 +86,10 @@ class FileStoreClient(InMemoryStoreClient):
         self._fsync = self._fsync_mode == "always"
         self._dirty = threading.Event()  # appends since last group fsync
         self._closing = False
+        # Plain counters, cheap enough for the append path; exported as
+        # gcs_store_* metrics only from report paths (rpc_store_stats ->
+        # util.state.control_plane_stats) — never flushed from here.
+        self._stats = {"appends": 0, "append_seconds": 0.0, "compactions": 0}
         self._syncer: threading.Thread | None = None
         if self._fsync_mode == "group":
             self._syncer = threading.Thread(
@@ -132,9 +136,14 @@ class FileStoreClient(InMemoryStoreClient):
     def load(self):
         """Replay the log into memory, then open it for appending. A torn tail
         record (crash mid-append) is truncated away so later appends are not
-        stranded behind unreadable bytes on the next load."""
+        stranded behind unreadable bytes on the next load. Idempotent: a
+        second load (e.g. a warm-standby store promoted into a GcsService)
+        keeps the already-replayed tables."""
+        if self._log is not None:
+            return
         good_offset = 0
-        if os.path.exists(self._path):
+        existed = os.path.exists(self._path)
+        if existed:
             with open(self._path, "rb") as f:
                 while True:
                     try:
@@ -152,10 +161,26 @@ class FileStoreClient(InMemoryStoreClient):
                 with open(self._path, "r+b") as f:
                     f.truncate(good_offset)
         self._log = open(self._path, "ab")
+        if not existed and self._fsync_mode != "off":
+            # The file CREATION must be durable too: a host crash right after
+            # cluster start could otherwise strand a directory entry pointing
+            # at nothing, and the first fsynced appends with it (the same
+            # rename-durability rule _compact_locked already follows).
+            self._fsync_dir()
+
+    def _fsync_dir(self):
+        dir_fd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     def _append(self, record):
         if self._log is None:
             return
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._lock:
             pickle.dump(record, self._log, protocol=5)
             self._log.flush()
@@ -164,6 +189,8 @@ class FileStoreClient(InMemoryStoreClient):
             self._appends_since_compact += 1
             if self._appends_since_compact >= self._COMPACT_THRESHOLD:
                 self._compact_locked()
+            self._stats["appends"] += 1
+            self._stats["append_seconds"] += _time.perf_counter() - t0
         if self._fsync_mode == "group":
             self._dirty.set()
 
@@ -181,13 +208,10 @@ class FileStoreClient(InMemoryStoreClient):
             # The rename itself must be durable, or a host crash can strand the
             # directory pointing at the pre-compaction inode — losing the
             # snapshot and every fsynced append after it.
-            dir_fd = os.open(self._dir, os.O_RDONLY)
-            try:
-                os.fsync(dir_fd)
-            finally:
-                os.close(dir_fd)
+            self._fsync_dir()
         self._log = open(self._path, "ab")
         self._appends_since_compact = 0
+        self._stats["compactions"] += 1
 
     def put(self, table: str, key, value):
         super().put(table, key, value)
@@ -197,9 +221,30 @@ class FileStoreClient(InMemoryStoreClient):
         super().delete(table, key)
         self._append(("del", table, key, None))
 
+    def stats_view(self) -> dict:
+        """Cheap snapshot of the append/compaction counters plus the current
+        log size, for the store-stats report path."""
+        try:
+            log_bytes = os.path.getsize(self._path)
+        except OSError:
+            log_bytes = 0
+        with self._lock:
+            view = dict(self._stats)
+        view["log_bytes"] = log_bytes
+        return view
+
     def close(self):
         self._closing = True
         self._dirty.set()  # unblock the group-sync thread
+        if self._syncer is not None:
+            # Join BEFORE closing the log: _group_sync_loop fsyncs a dup'd fd
+            # taken under the lock, but close() racing the window between dup
+            # and fsync could recycle the fd number onto an unrelated file.
+            # Bounded join — a syncer mid-fsync on a loaded disk finishes its
+            # last window; past the bound we proceed (daemon thread, and the
+            # explicit fsync below covers the tail).
+            self._syncer.join(timeout=5.0)
+            self._syncer = None
         with self._lock:
             if self._log is not None:
                 try:

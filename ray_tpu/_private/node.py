@@ -18,15 +18,29 @@ import uuid
 
 class NodeProcess:
     def __init__(self, proc: subprocess.Popen, info: dict, ready_file: str,
-                 gcs_proc: subprocess.Popen | None = None,
-                 gcs_store_dir: str | None = None,
+                 gcs_procs: list | None = None,
+                 gcs_peers: list | None = None,
+                 gcs_store_dirs: list | None = None,
                  session_dir: str | None = None):
         self.proc = proc
         self.info = info
         self.ready_file = ready_file
-        self.gcs_proc = gcs_proc  # head only: the separate GCS server process
-        self.gcs_store_dir = gcs_store_dir
+        # Head only: the separate GCS candidate processes (one with
+        # gcs_replicas=1, the quorum-HA ensemble otherwise), their fixed
+        # (host, port) addresses, and their per-candidate store dirs.
+        self.gcs_procs: list = list(gcs_procs or [])
+        self.gcs_peers: list = list(gcs_peers or [])
+        self.gcs_store_dirs: list = list(gcs_store_dirs or [])
         self.session_dir = session_dir
+
+    @property
+    def gcs_proc(self):
+        """The sole GCS process in single-candidate mode (back-compat)."""
+        return self.gcs_procs[0] if self.gcs_procs else None
+
+    @property
+    def gcs_store_dir(self):
+        return self.gcs_store_dirs[0] if self.gcs_store_dirs else None
 
     @property
     def node_id_hex(self) -> str:
@@ -38,7 +52,21 @@ class NodeProcess:
 
     @property
     def gcs_port(self) -> int | None:
+        if self.gcs_peers:
+            return self.gcs_peers[0][1]
         return self.info.get("gcs_port")
+
+    @property
+    def gcs_ports(self) -> list:
+        if self.gcs_peers:
+            return [p for _h, p in self.gcs_peers]
+        port = self.info.get("gcs_port")
+        return [port] if port else []
+
+    @property
+    def gcs_addrs(self) -> list:
+        return (list(self.gcs_peers)
+                or [("127.0.0.1", p) for p in self.gcs_ports])
 
     def terminate(self):
         try:
@@ -49,32 +77,73 @@ class NodeProcess:
                 self.proc.kill()
             except Exception:
                 pass
-        if self.gcs_proc is not None:
+        for gp in self.gcs_procs:
             try:
-                self.gcs_proc.terminate()
-                self.gcs_proc.wait(timeout=5)
+                gp.terminate()
+                gp.wait(timeout=5)
             except Exception:
                 try:
-                    self.gcs_proc.kill()
+                    gp.kill()
                 except Exception:
                     pass
 
     def kill_gcs(self):
-        """Crash the GCS process (head nodes only); raylets keep running."""
-        if self.gcs_proc is None:
+        """Crash every GCS candidate process (head nodes only) — a full
+        control-plane outage; raylets keep running."""
+        if not self.gcs_procs:
             raise RuntimeError("this node does not host the GCS")
-        self.gcs_proc.kill()
-        self.gcs_proc.wait(timeout=5)
+        for gp in self.gcs_procs:
+            if gp.poll() is None:
+                gp.kill()
+        for gp in self.gcs_procs:
+            try:
+                gp.wait(timeout=5)
+            except Exception:
+                pass
 
     def restart_gcs(self, timeout: float = 90.0):
-        """Start a fresh GCS on the same port over the same persistent store
-        (reference: gcs_server restart with a Redis backend)."""
-        if self.gcs_port is None:
+        """Restart every dead GCS candidate on its original port over its
+        persistent store (reference: gcs_server restart with a Redis
+        backend)."""
+        if not self.gcs_ports:
             raise RuntimeError("this node does not host the GCS")
-        if self.gcs_proc is not None and self.gcs_proc.poll() is None:
-            self.kill_gcs()
-        self.gcs_proc = _start_gcs_process(
-            self.session_dir, self.gcs_store_dir, port=self.gcs_port, timeout=timeout
+        for i in range(len(self.gcs_procs)):
+            if self.gcs_procs[i].poll() is not None:
+                self.restart_gcs_candidate(i, timeout=timeout)
+
+    # ---------------------------------------------- quorum-HA chaos helpers
+
+    def gcs_candidate_status(self, index: int, timeout: float = 2.0):
+        from ray_tpu._private.gcs_replication import probe_status
+
+        return probe_status(self.gcs_addrs[index], timeout=timeout)
+
+    def gcs_primary_index(self, timeout: float = 30.0) -> int:
+        """Index of the candidate currently reporting role=primary."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for i in range(len(self.gcs_addrs)):
+                st = self.gcs_candidate_status(i)
+                if st and st.get("role") == "primary":
+                    return i
+            time.sleep(0.1)
+        raise TimeoutError("no GCS candidate became primary in time")
+
+    def kill_gcs_candidate(self, index: int):
+        """SIGKILL one candidate (the chaos path for primary kills)."""
+        proc = self.gcs_procs[index]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    def restart_gcs_candidate(self, index: int, timeout: float = 90.0):
+        if self.gcs_procs[index].poll() is None:
+            self.kill_gcs_candidate(index)
+        self.gcs_procs[index] = _start_gcs_process(
+            self.session_dir, self.gcs_store_dirs[index],
+            port=self.gcs_ports[index], timeout=timeout,
+            candidate_id=index,
+            peers=self.gcs_peers if len(self.gcs_peers) > 1 else None,
         )
 
 
@@ -106,10 +175,12 @@ def _free_port() -> int:
 
 
 def _start_gcs_process(session_dir: str, store_dir: str, port: int,
-                       timeout: float = 90.0) -> subprocess.Popen:
+                       timeout: float = 90.0, candidate_id: int = 0,
+                       peers: list | None = None) -> subprocess.Popen:
     """Spawn the standalone GCS server (reference: gcs_server binary) and wait for
     it to bind. The fixed port lets raylets and drivers reconnect to a restarted
-    GCS at the same address."""
+    GCS at the same address. `peers` (all candidate addresses, self included)
+    switches the process into quorum-HA candidate mode."""
     ready_file = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:8]}.json")
     cmd = [
         sys.executable, "-m", "ray_tpu._private.gcs_main",
@@ -117,6 +188,11 @@ def _start_gcs_process(session_dir: str, store_dir: str, port: int,
         "--store-dir", store_dir,
         "--ready-file", ready_file,
     ]
+    if peers and len(peers) > 1:
+        from ray_tpu._private.gcs_replication import format_addrs
+
+        cmd += ["--candidate-id", str(candidate_id),
+                "--peers", format_addrs(peers)]
     log_path = os.path.join(session_dir, "logs", f"gcs-{uuid.uuid4().hex[:8]}.log")
     out = open(log_path, "wb")
     env = dict(os.environ)
@@ -147,17 +223,36 @@ def start_node(
     worker_env: dict | None = None,
     timeout: float = 90.0,
 ) -> NodeProcess:
+    from ray_tpu._private.gcs_replication import format_addrs, parse_addrs
+
     ready_file = os.path.join(
         session_dir, f"node_ready_{uuid.uuid4().hex[:8]}.json"
     )
-    gcs_proc = None
-    gcs_store_dir = None
+    gcs_procs: list = []
+    gcs_peers: list = []
+    gcs_store_dirs: list = []
     if head:
         # The GCS runs as its own process (reference: gcs_server binary) so it can
-        # crash and restart independently of the raylet; a pre-picked port lets the
-        # raylet spawn concurrently and retry-connect while the GCS boots.
-        gcs_store_dir = os.path.join(session_dir, "gcs_store")
-        gcs_addr = ("127.0.0.1", _free_port())
+        # crash and restart independently of the raylet; pre-picked ports let the
+        # raylet spawn concurrently and retry-connect while the GCS boots. With
+        # gcs_replicas > 1 the head spawns that many candidate processes, each
+        # over its OWN store dir (a replica sharing a disk with the primary
+        # would defeat the whole point), and every client gets the full
+        # candidate address list.
+        from ray_tpu._private.config import CONFIG
+
+        replicas = max(1, int(CONFIG.gcs_replicas))
+        gcs_peers = [("127.0.0.1", _free_port()) for _ in range(replicas)]
+        if replicas == 1:
+            gcs_store_dirs = [os.path.join(session_dir, "gcs_store")]
+        else:
+            gcs_store_dirs = [
+                os.path.join(session_dir, f"gcs_store_{i}")
+                for i in range(replicas)
+            ]
+        gcs_addr = gcs_peers
+    else:
+        gcs_addr = parse_addrs(gcs_addr)
     cmd = [
         sys.executable,
         "-m",
@@ -174,10 +269,8 @@ def start_node(
         str(object_store_bytes),
         "--ready-file",
         ready_file,
-        "--gcs-host",
-        gcs_addr[0],
-        "--gcs-port",
-        str(gcs_addr[1]),
+        "--gcs-addrs",
+        format_addrs(gcs_addr),
     ]
     if head:
         cmd.append("--head")
@@ -186,29 +279,41 @@ def start_node(
     env = dict(os.environ)
     env["PYTHONPATH"] = _package_pythonpath(env.get("PYTHONPATH"))
     proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
+
+    def _kill_gcs_procs():
+        for gp in gcs_procs:
+            try:
+                gp.terminate()
+            except Exception:
+                pass
+
     if head:
         try:
-            gcs_proc = _start_gcs_process(
-                session_dir, gcs_store_dir, port=gcs_addr[1], timeout=timeout
-            )
+            for i, (_h, port) in enumerate(gcs_peers):
+                gcs_procs.append(_start_gcs_process(
+                    session_dir, gcs_store_dirs[i], port=port,
+                    timeout=timeout, candidate_id=i,
+                    peers=gcs_peers if len(gcs_peers) > 1 else None,
+                ))
         except Exception:
             proc.terminate()
+            _kill_gcs_procs()
             raise
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(ready_file):
             with open(ready_file) as f:
                 info = json.load(f)
-            return NodeProcess(proc, info, ready_file, gcs_proc=gcs_proc,
-                               gcs_store_dir=gcs_store_dir, session_dir=session_dir)
+            return NodeProcess(proc, info, ready_file, gcs_procs=gcs_procs,
+                               gcs_peers=gcs_peers,
+                               gcs_store_dirs=gcs_store_dirs,
+                               session_dir=session_dir)
         if proc.poll() is not None:
             with open(log_path, "rb") as f:
                 tail = f.read()[-4000:].decode(errors="replace")
-            if gcs_proc is not None:
-                gcs_proc.terminate()
+            _kill_gcs_procs()
             raise RuntimeError(f"node process exited during startup:\n{tail}")
         time.sleep(0.05)
     proc.terminate()
-    if gcs_proc is not None:
-        gcs_proc.terminate()
+    _kill_gcs_procs()
     raise TimeoutError("node did not become ready in time")

@@ -182,12 +182,17 @@ class Raylet:
         worker_env: dict | None = None,
         node_ip: str | None = None,
     ):
+        from ray_tpu._private.gcs_replication import parse_addrs
+
         self.node_id = node_id
-        self.gcs_addr = gcs_addr
+        # All GCS candidate addresses; gcs_addr tracks the CURRENT primary
+        # (the one this raylet is registered with).
+        self.gcs_addrs = parse_addrs(gcs_addr)
+        self.gcs_addr = self.gcs_addrs[0]
         # The address peers dial: never advertise loopback on a multi-host
         # cluster (reference: NodeManager registers node_manager_address, not
         # localhost). Direct worker servers advertise this IP too.
-        self.node_ip = node_ip or get_node_ip(gcs_addr[0])
+        self.node_ip = node_ip or get_node_ip(self.gcs_addr[0])
         self.is_head = is_head
         self.labels = labels or {}
         self.session_dir = session_dir
@@ -289,23 +294,73 @@ class Raylet:
         return self
 
     async def _connect_gcs(self, deadline_s: float = 60.0):
-        """Connect (or reconnect) to the GCS, register, and sync hosted state.
+        """Connect (or reconnect) to the GCS PRIMARY, register, and sync
+        hosted state.
 
-        Retries while the GCS is down: the control plane can restart independently
-        of raylets (reference: GCS clients buffer+retry during GCS downtime)."""
+        Retries while the GCS is down: the control plane can restart (or fail
+        over to another candidate) independently of raylets (reference: GCS
+        clients buffer+retry during GCS downtime). With a replicated GCS the
+        probe walks the candidate list, following NOT_PRIMARY redirects until
+        the lease holder answers."""
         deadline = time.monotonic() + deadline_s
+        hint = None
+        i = 0
         while True:
+            addr = tuple(hint) if hint else self.gcs_addrs[i % len(self.gcs_addrs)]
+            hint = None
+            i += 1
             try:
-                self.gcs = await rpc.connect(
-                    *self.gcs_addr, handler=self, name="raylet->gcs"
+                conn = await rpc.connect(
+                    *addr, handler=self, name="raylet->gcs"
                 )
-                break
             except OSError:
                 if self._shutdown or time.monotonic() > deadline:
                     raise
                 await asyncio.sleep(0.5)
+                continue
+            try:
+                st = await conn.call("repl_status", timeout=5.0)
+            except rpc.RpcError:
+                st = None
+            if st is None or st.get("role") != "primary":
+                hint = (st or {}).get("primary")
+                try:
+                    await conn.close()
+                except Exception:
+                    pass  # probe conn teardown; the retry loop owns recovery
+                if self._shutdown or time.monotonic() > deadline:
+                    raise rpc.ConnectionLost(
+                        f"no GCS primary reachable at {self.gcs_addrs}")
+                if not hint:
+                    await asyncio.sleep(0.3)
+                continue
+            try:
+                await self._register_with_gcs(conn)
+            except rpc.ConnectionLost as e:
+                # Role flipped (or the primary died) between the probe and the
+                # registration sequence: follow any redirect hint and retry.
+                hint = getattr(e, "primary", None)
+                try:
+                    await conn.close()
+                except Exception:
+                    pass  # half-registered conn teardown; loop retries anyway
+                if self._shutdown or time.monotonic() > deadline:
+                    raise
+                if not hint:
+                    await asyncio.sleep(0.3)
+                continue
+            self.gcs = conn
+            self.gcs_addr = addr
+            break
+        # Armed only after full registration: a half-registered conn that
+        # dies mid-sequence is retried here, not by a racing reconnect task.
         self.gcs.on_close(self._on_gcs_lost)
-        await self.gcs.call(
+        # Delegation-recovery grace starts now: peers need time to re-register
+        # with a restarted GCS before their absence can be read as death.
+        self._gcs_connected_at = time.monotonic()
+
+    async def _register_with_gcs(self, conn):
+        await conn.call(
             "register_node",
             self.node_id,
             (self.node_ip, self.port),
@@ -314,8 +369,8 @@ class Raylet:
             self.is_head,
         )
         # Actor state changes invalidate the local address cache (restart support).
-        await self.gcs.call("subscribe", "actors")
-        await self.gcs.call("subscribe", "nodes")
+        await conn.call("subscribe", "actors")
+        await conn.call("subscribe", "nodes")
         hosted = {}
         for actor_id, worker_id in self.actors.items():
             h = self.workers.get(worker_id)
@@ -323,16 +378,13 @@ class Raylet:
                 "worker_id": worker_id,
                 "direct_addr": h.direct_addr if h is not None else None,
             }
-        await self.gcs.call(
+        await conn.call(
             "sync_node_state",
             self.node_id,
             hosted,
             [(oid, sz, owner) for oid, (sz, owner) in self._sealed_objects.items()],
             list(self.resources.bundles.keys()),
         )
-        # Delegation-recovery grace starts now: peers need time to re-register
-        # with a restarted GCS before their absence can be read as death.
-        self._gcs_connected_at = time.monotonic()
 
     def _on_gcs_lost(self, conn):
         if self._shutdown:
@@ -368,6 +420,14 @@ class Raylet:
                 self.node_view = {n["node_id"]: n for n in nodes if n["alive"]}
                 self._full_node_view = {n["node_id"]: n for n in nodes}
                 await self._check_delegations()
+            except rpc.NotPrimaryError:
+                # Our candidate was deposed but its socket survived: close it
+                # so the on_close path re-probes the candidate list and
+                # re-registers with the new primary.
+                try:
+                    await self.gcs.close()
+                except Exception:
+                    pass  # already-dead conn; on_close reconnect still fires
             except rpc.RpcError:
                 pass
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
@@ -472,7 +532,12 @@ class Raylet:
         # bound.
         env["RAY_TPU_NODE_IP"] = self.node_ip
         env["RAY_TPU_RAYLET_PORT"] = str(self.port)
-        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
+        # Full candidate list, current primary first: a worker spawned during
+        # a failover window still finds the control plane.
+        _gcs_order = [self.gcs_addr] + [
+            a for a in self.gcs_addrs if a != self.gcs_addr
+        ]
+        env["RAY_TPU_GCS_ADDR"] = ",".join(f"{h}:{p}" for h, p in _gcs_order)
         # Unbuffered so crash tracebacks reach the log file even on abrupt death
         # (reference: worker stdout/stderr files tailed by log_monitor.py).
         env["PYTHONUNBUFFERED"] = "1"

@@ -22,8 +22,12 @@ from ray_tpu._private.raylet import Raylet
 
 
 async def amain(args):
-    gcs_port = args.gcs_port
-    if args.head and not gcs_port:
+    from ray_tpu._private.gcs_replication import parse_addrs
+
+    gcs_addrs = parse_addrs(args.gcs_addrs) if args.gcs_addrs else []
+    if not gcs_addrs and args.gcs_port:
+        gcs_addrs = [(args.gcs_host, args.gcs_port)]
+    if args.head and not gcs_addrs:
         # Fallback for direct invocation: host the GCS in-process. The normal path
         # (node.py) runs the GCS as its own restartable process via gcs_main.
         gcs = GcsService()
@@ -32,12 +36,13 @@ async def amain(args):
             host=bind_host_for(args.node_ip or get_node_ip()), port=0
         )
         gcs.start_background()
-        gcs_port = gcs_server.port
+        gcs_addrs = [(args.gcs_host, gcs_server.port)]
+    gcs_port = gcs_addrs[0][1]
 
     node_id = NodeID.from_hex(args.node_id) if args.node_id else NodeID.from_random()
     raylet = Raylet(
         node_id=node_id,
-        gcs_addr=(args.gcs_host, gcs_port),
+        gcs_addr=gcs_addrs,
         resources=json.loads(args.resources),
         labels=json.loads(args.labels),
         is_head=args.head,
@@ -78,6 +83,9 @@ def main():
     p.add_argument("--head", action="store_true")
     p.add_argument("--gcs-host", default="127.0.0.1")
     p.add_argument("--gcs-port", type=int, default=0)
+    p.add_argument("--gcs-addrs", default="",
+                   help="comma host:port list of GCS candidates (replicated "
+                        "mode lists every head candidate)")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--node-id", default="")
     p.add_argument("--node-ip", default="")

@@ -59,6 +59,24 @@ class ConnectionLost(RpcError):
     pass
 
 
+class NotPrimaryError(ConnectionLost):
+    """Raised by a replicated-GCS candidate that is not the current primary
+    (docs/fault_tolerance.md). Subclasses ConnectionLost deliberately: to a
+    client, "this endpoint cannot serve GCS calls" is the same retryable
+    condition as a dropped connection, so every existing reconnect/backoff
+    path handles it. Carries the current primary's (host, port) when the
+    candidate knows it, letting clients redirect instead of scanning."""
+
+    def __init__(self, primary=None):
+        self.primary = tuple(primary) if primary else None
+        super().__init__(
+            f"not the GCS primary (primary hint: {self.primary})"
+        )
+
+    def __reduce__(self):  # travels pickled inside RPC error replies
+        return (NotPrimaryError, (self.primary,))
+
+
 class RemoteError(RpcError):
     def __init__(self, method: str, tb: str):
         self.method = method

@@ -745,7 +745,12 @@ class CoreWorker:
         self.raylet: rpc.Connection | None = None
         self.gcs: rpc.Connection | None = None
         self.raylet_addr = raylet_addr
-        self.gcs_addr = gcs_addr
+        # All GCS candidate addresses (one entry in the classic single-GCS
+        # shape); gcs_addr tracks the CURRENT primary this worker talks to.
+        from ray_tpu._private.gcs_replication import parse_addrs
+
+        self.gcs_addrs: list[tuple[str, int]] = parse_addrs(gcs_addr)
+        self.gcs_addr = self.gcs_addrs[0]
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self)
         self.functions = FunctionManager(self)
@@ -809,10 +814,7 @@ class CoreWorker:
             rpc.connect(*self.raylet_addr, handler=self, name=f"{self.mode}->raylet",
                         via=self.proxy)
         )
-        self.gcs = self.io.run(
-            rpc.connect(*self.gcs_addr, handler=self, name=f"{self.mode}->gcs",
-                        via=self.proxy)
-        )
+        self.gcs = self._connect_gcs_primary(deadline_s=60.0)
         direct_port = None
         if not self.remote_data_plane:
             # Direct-call server: peers (owners of actor calls / leased tasks,
@@ -896,21 +898,70 @@ class CoreWorker:
     def gcs_kv_get(self, ns: str, key: bytes):
         return self.gcs_call("kv_get", ns, key)
 
-    def gcs_call(self, method: str, *args, timeout: float | None = None,
-                 deadline_s: float | None = None):
-        """GCS request with transparent reconnect: the control plane may restart
-        under us (reference: GCS clients buffer and retry during GCS downtime).
+    def _connect_gcs_primary(self, deadline_s: float,
+                             hint: tuple | None = None) -> rpc.Connection:
+        """Dial GCS candidates until the current PRIMARY answers.
 
-        Reconnect attempts back off exponentially with jitter (a restarted GCS
-        sees a spread-out thundering herd, not a synchronized stampede) up to a
-        total deadline (`deadline_s`, default CONFIG.gcs_rpc_timeout_s), after
-        which ConnectionLost surfaces to the caller."""
+        A non-primary candidate (warm standby under quorum HA,
+        docs/fault_tolerance.md) reports its role via `repl_status` and hints
+        the primary's address; the probe follows hints first and otherwise
+        walks the candidate list with exponential backoff + full jitter (a
+        restarted/promoted GCS sees a spread-out thundering herd, not a
+        synchronized stampede). Raises ConnectionLost past the deadline."""
         import random as _random
 
+        deadline = time.monotonic() + deadline_s
+        backoff = 0.05
+        i = 0
+        while True:
+            addr = tuple(hint) if hint else self.gcs_addrs[i % len(self.gcs_addrs)]
+            hint = None
+            i += 1
+            conn = None
+            try:
+                conn = self.io.run(
+                    rpc.connect(*addr, handler=self,
+                                name=f"{self.mode}->gcs", via=self.proxy)
+                )
+                st = self.io.run(conn.call("repl_status", timeout=5.0))
+                if st.get("role") == "primary":
+                    self.gcs_addr = addr
+                    return conn
+                hint = st.get("primary")
+                self.io.run(conn.close())
+            except (OSError, rpc.RpcError):
+                if conn is not None:
+                    try:
+                        self.io.run(conn.close())
+                    except Exception:
+                        pass
+            if time.monotonic() > deadline:
+                raise rpc.ConnectionLost(
+                    f"no GCS primary reachable at {self.gcs_addrs}"
+                )
+            if not hint:
+                # Full jitter on the exponential step; never sleep past the
+                # deadline (the final attempt should still get its shot).
+                pause = backoff * (0.5 + _random.random())
+                pause = min(pause, max(0.0, deadline - time.monotonic()))
+                time.sleep(pause)
+                backoff = min(backoff * 2.0, 2.0)
+
+    def gcs_call(self, method: str, *args, timeout: float | None = None,
+                 deadline_s: float | None = None):
+        """GCS request with transparent reconnect + failover: the control
+        plane may restart — or fail over to another head candidate — under us
+        (reference: GCS clients buffer and retry during GCS downtime).
+
+        ConnectionLost covers both a dead socket and a NOT_PRIMARY redirect
+        (`rpc.NotPrimaryError` subclasses it, carrying the new primary's
+        address); either way the call re-resolves the primary through
+        `_connect_gcs_primary` and retries, up to a total deadline
+        (`deadline_s`, default CONFIG.gcs_rpc_timeout_s), after which
+        ConnectionLost surfaces to the caller."""
         deadline = time.monotonic() + (
             deadline_s if deadline_s is not None else CONFIG.gcs_rpc_timeout_s
         )
-        backoff = 0.05
         reconnects = 0
         while True:
             try:
@@ -918,22 +969,23 @@ class CoreWorker:
                 if reconnects:
                     self._note_gcs_reconnects(reconnects)
                 return result
-            except rpc.ConnectionLost:
+            except rpc.ConnectionLost as e:
                 if not self._connected or time.monotonic() > deadline:
                     raise
-                try:
-                    self.gcs = self.io.run(
-                        rpc.connect(*self.gcs_addr, handler=self,
-                                    name=f"{self.mode}->gcs", via=self.proxy)
-                    )
-                    reconnects += 1
-                except OSError:
-                    # Full jitter on the exponential step; never sleep past the
-                    # deadline (the final attempt should still get its shot).
-                    pause = backoff * (0.5 + _random.random())
-                    pause = min(pause, max(0.0, deadline - time.monotonic()))
-                    time.sleep(pause)
-                    backoff = min(backoff * 2.0, 2.0)
+                hint = getattr(e, "primary", None)
+                old = self.gcs
+                if old is not None and not old.closed:
+                    # A NOT_PRIMARY answer leaves the socket open; drop it so
+                    # in-flight direct users fail fast onto the new conn.
+                    try:
+                        self.io.run(old.close())
+                    except Exception:
+                        pass
+                self.gcs = self._connect_gcs_primary(
+                    deadline_s=max(0.05, deadline - time.monotonic()),
+                    hint=hint,
+                )
+                reconnects += 1
 
     def _note_gcs_reconnects(self, n: int):
         """Count successful GCS reconnections (`gcs_reconnect_total`). Called

@@ -70,11 +70,13 @@ class Cluster:
 
     @property
     def address(self) -> str:
-        return f"127.0.0.1:{self.head.gcs_port}"
+        # Every GCS candidate, comma-joined: clients fail over between them
+        # under a replicated GCS (one entry in the classic shape).
+        return ",".join(f"127.0.0.1:{p}" for p in self.head.gcs_ports)
 
     @property
     def gcs_addr(self):
-        return ("127.0.0.1", self.head.gcs_port)
+        return self.head.gcs_addrs
 
     def connect(self, namespace: str = ""):
         return ray_tpu.init(

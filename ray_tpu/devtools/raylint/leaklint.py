@@ -95,6 +95,10 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
                  receiver_release=("release",), arg_keyed=True),
     ResourceSpec("raylet resource lease", "acquire", hints=("resources",),
                  receiver_release=("release",), arg_keyed=True),
+    ResourceSpec("GCS replication peer link (PeerLink)", "open_peer",
+                 release=("close",)),
+    ResourceSpec("GCS primary lease (LeaseToken)", "acquire_lease",
+                 release=("release",)),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

@@ -19,10 +19,11 @@ _ADDR_FILE = os.path.join(
 )
 
 
-def _write_addr(gcs_port: int, raylet_port: int):
+def _write_addr(gcs_port: int, raylet_port: int, gcs_ports=None):
     os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
     with open(_ADDR_FILE, "w") as f:
         json.dump({"gcs_port": gcs_port, "raylet_port": raylet_port,
+                   "gcs_ports": list(gcs_ports or [gcs_port]),
                    "pid": os.getpid()}, f)
 
 
@@ -42,7 +43,8 @@ def _connect_from_file():
         print("no running head found (start one with: ... start --head)", file=sys.stderr)
         sys.exit(1)
     os.environ["RAY_TPU_RAYLET_PORT"] = str(addr["raylet_port"])
-    ray_tpu.init(address=f"127.0.0.1:{addr['gcs_port']}")
+    ports = addr.get("gcs_ports") or [addr["gcs_port"]]
+    ray_tpu.init(address=",".join(f"127.0.0.1:{p}" for p in ports))
 
 
 def cmd_start(args):
@@ -62,13 +64,13 @@ def cmd_start(args):
             object_store_bytes=args.object_store_memory or 0,
             worker_env=None,
         )
-        _write_addr(handle.gcs_port, handle.raylet_port)
+        _write_addr(handle.gcs_port, handle.raylet_port,
+                    gcs_ports=handle.gcs_ports)
         print(f"head started: gcs=127.0.0.1:{handle.gcs_port} "
               f"raylet_port={handle.raylet_port}")
     else:
-        host, port = args.address.split(":")
         handle = node_mod.start_node(
-            head=False, gcs_addr=(host, int(port)), resources=resources,
+            head=False, gcs_addr=args.address, resources=resources,
             labels=None, session_dir=session_dir,
             object_store_bytes=args.object_store_memory or 0, worker_env=None,
         )
@@ -193,7 +195,8 @@ def cmd_up(args):
         head=True, gcs_addr=None, resources=resources, labels=None,
         session_dir=session_dir, object_store_bytes=0, worker_env=None,
     )
-    _write_addr(handle.gcs_port, handle.raylet_port)
+    _write_addr(handle.gcs_port, handle.raylet_port,
+                gcs_ports=handle.gcs_ports)
     local_address = f"127.0.0.1:{handle.gcs_port}"
     # Remote workers (TPU slices) must dial a reachable address, not loopback.
     # head.address pins host:port outright; head.host pins the host while the

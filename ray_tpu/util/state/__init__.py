@@ -238,6 +238,59 @@ def list_export_events(directory: Optional[str] = None, *,
     return out
 
 
+import threading as _threading
+
+_CP_METRICS: Dict[str, Any] = {}  # lazy util.metrics handles, report-path only
+_CP_METRICS_LOCK = _threading.Lock()
+
+
+def control_plane_stats() -> Dict[str, Any]:
+    """Store + replication-plane counters of the current GCS primary, and the
+    ONLY place they become util.metrics series (gcs_store_append_seconds,
+    gcs_store_log_bytes, gcs_store_compactions_total,
+    gcs_repl_lag_records{peer}, gcs_failovers_total).
+
+    The GCS process keeps plain counters and never touches metrics objects —
+    a metrics flush is itself a GCS KV RPC, so flushing from the append or
+    replication paths would re-enter the control plane from inside it (the
+    docs/raylint.md leaksan teardown-deadlock lesson). Calling this report
+    path is what surfaces the series."""
+    stats = _gcs("store_stats")
+    try:
+        from ray_tpu.util.metrics import Gauge
+
+        def gauge(name: str, desc: str, tag_keys=None) -> Any:
+            with _CP_METRICS_LOCK:
+                g = _CP_METRICS.get(name)
+                if g is None:
+                    g = _CP_METRICS[name] = Gauge(name, desc,
+                                                  tag_keys=tag_keys)
+            return g
+
+        store = stats.get("store") or {}
+        repl = stats.get("repl") or {}
+        gauge("gcs_store_append_seconds",
+              "cumulative seconds the GCS primary spent appending to its "
+              "durable log").set(float(store.get("append_seconds", 0.0)))
+        gauge("gcs_store_log_bytes",
+              "current size of the GCS primary's append log").set(
+                  float(store.get("log_bytes", 0)))
+        gauge("gcs_store_compactions_total",
+              "append-log snapshot rewrites since the primary started").set(
+                  float(store.get("compactions", 0)))
+        gauge("gcs_failovers_total",
+              "primary promotions past the cluster's first election").set(
+                  float(repl.get("failovers", 0)))
+        lag_gauge = gauge("gcs_repl_lag_records",
+                          "records each follower candidate trails the "
+                          "primary's log head by", tag_keys=("peer",))
+        for peer, lag in (repl.get("lag") or {}).items():
+            lag_gauge.set(float(lag), tags={"peer": str(peer)})
+    except Exception:
+        pass  # observability must never break the stats read itself
+    return stats
+
+
 def cluster_summary() -> Dict[str, Any]:
     nodes = list_nodes()
     return {
@@ -252,6 +305,7 @@ def cluster_summary() -> Dict[str, Any]:
 
 __all__ = [
     "cluster_summary",
+    "control_plane_stats",
     "get_actor",
     "get_log",
     "get_task",

@@ -1,0 +1,53 @@
+"""RL801 fixtures for the replicated-GCS resources: the replication peer
+link (GcsCandidate.open_peer -> PeerLink.close) and the primary lease token
+(acquire_lease -> LeaseToken.release). Fire/suppress shapes mirror
+case_rl801.py so the round-14 RESOURCE_TABLE rows ride the exact same path
+analysis — a deposed primary that strands follower links or keeps a
+released lease is precisely the leak class these rows exist to catch."""
+
+
+def bad_peer_link_never_closed(candidate, addr, conn):
+    link = candidate.open_peer(addr, conn)
+    return link.addr
+
+
+def bad_peer_link_conditional(candidate, addr, conn, flag):
+    link = candidate.open_peer(addr, conn)
+    if flag:
+        link.close()
+
+
+def bad_lease_never_released(candidate, epoch):
+    lease = candidate.acquire_lease(epoch)
+    return lease.epoch
+
+
+def bad_lease_risky_gap(candidate, epoch, gcs):
+    lease = candidate.acquire_lease(epoch)
+    gcs.start_background()
+    lease.release()
+
+
+def ok_peer_link_stored(candidate, addr, conn, links, idx):
+    links[idx] = candidate.open_peer(addr, conn)
+
+
+def ok_peer_link_finally(candidate, addr, conn, batch):
+    link = candidate.open_peer(addr, conn)
+    try:
+        return link.conn.call("repl_append", batch)
+    finally:
+        link.close()
+
+
+def ok_lease_stored_for_demotion(candidate, epoch):
+    candidate._lease = candidate.acquire_lease(epoch)
+
+
+def ok_lease_returned(candidate, epoch):
+    return candidate.acquire_lease(epoch)
+
+
+def suppressed_peer_link(candidate, addr, conn):
+    link = candidate.open_peer(addr, conn)  # raylint: disable=RL801 (fixture: demotion closes it)
+    return link.addr

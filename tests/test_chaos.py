@@ -651,3 +651,313 @@ def test_detached_train_controller_sigkill_resumes_from_checkpoint(
     assert any(s != "start_0" for s in starts), "run never resumed"
     tree = result.checkpoint.to_pytree()
     np.testing.assert_array_equal(np.asarray(tree["w"]), np.full((4,), 5.0))
+
+
+# ------------------------------------------------- replicated-GCS chaos
+#
+# Quorum-HA contract (docs/fault_tolerance.md): with gcs_replicas=3 the GCS
+# primary majority-acks every durable mutation to follower candidates and
+# holds a time-bounded lease; SIGKILLing the PRIMARY promotes the most
+# caught-up follower within ~2x the lease window, every majority-acked
+# record survives, clients fail over transparently inside gcs_call's
+# backoff/deadline machinery, and a deposed primary's stragglers are
+# epoch-fenced. gcs_replicas=1 (the default) is byte-for-byte the classic
+# single-process GCS.
+
+
+def _wait_new_gcs_primary(head, old_primary_idx, old_epoch, timeout=25.0):
+    """(index, status, seconds-to-promotion) of the follower that took over."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        for i in range(len(head.gcs_procs)):
+            if i == old_primary_idx:
+                continue
+            st = head.gcs_candidate_status(i)
+            if st and st.get("role") == "primary" and st["epoch"] > old_epoch:
+                return i, st, time.monotonic() - t0
+        time.sleep(0.1)
+    raise AssertionError("no follower promoted itself in time")
+
+
+def test_serve_traffic_rides_through_gcs_primary_kill(monkeypatch):
+    """SIGKILL the GCS *primary* (of 3 candidates) under a deployed serve app
+    with live HTTP traffic: a follower promotes within ~2x the lease window,
+    every majority-acked KV/actor/serve-target record survives (verified by a
+    known key set written immediately before the kill), HTTP responses stay
+    token-identical, a fenced old-epoch write is provably rejected, and the
+    failover is observable through the control-plane stats report path."""
+    import asyncio
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu._private import rpc as rpclib
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_GCS_REPLICAS", "3")
+    monkeypatch.setenv("RAY_TPU_GCS_LEASE_S", "1.5")
+    CONFIG._reset()
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    try:
+        cluster.connect()
+        w = ray_tpu.global_worker()
+        assert len(cluster.head.gcs_procs) == 3
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def pid(self):
+                return os.getpid()
+
+            def __call__(self, request):
+                p = request.query_params.get("p", "")
+                return {"out": f"{p}::{len(p)}"}
+
+        serve.run(Echo.bind(), name="gcs-ha-chaos", route_prefix="/")
+        port = serve.get_proxy_port()
+
+        def ask(p, timeout=10):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/?p={p}", timeout=timeout
+            ) as r:
+                return json.loads(r.read())["out"]
+
+        prompts = [f"prompt-{i}" for i in range(4)]
+        baseline = {p: ask(p) for p in prompts}
+        pid_handle = serve.DeploymentHandle("gcs-ha-chaos", "Echo", "pid")
+        pids_before = sorted(pid_handle.broadcast())
+        assert len(pids_before) == 2
+
+        @ray_tpu.remote(name="ha-counter")
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        counter = Counter.remote()
+        assert ray_tpu.get(counter.incr.remote(), timeout=60) == 1
+
+        ok_during: list = []
+        errors: list = []
+        halt = threading.Event()
+
+        def traffic():
+            i = 0
+            while not halt.is_set():
+                p = prompts[i % len(prompts)]
+                i += 1
+                try:
+                    ok_during.append((p, ask(p, timeout=5)))
+                except Exception as e:  # noqa: BLE001 - tallied below
+                    errors.append(repr(e))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)  # warm: routes cached, direct connections live
+
+        # The known key set, written (and majority-acked) RIGHT before the
+        # kill: every one of these must survive the primary's death.
+        for i in range(30):
+            w.gcs_kv_put("ha", f"k{i}".encode(), str(i).encode())
+
+        primary_idx = cluster.head.gcs_primary_index()
+        old_st = cluster.head.gcs_candidate_status(primary_idx)
+        n_before_kill = len(ok_during)
+        cluster.head.kill_gcs_candidate(primary_idx)  # SIGKILL the primary
+
+        new_idx, new_st, promote_s = _wait_new_gcs_primary(
+            cluster.head, primary_idx, old_st["epoch"])
+        lease_s = CONFIG.gcs_lease_s
+        # ~2x the lease window: one window of silence detection + the
+        # election round (+ scheduler slack for the subprocess probes).
+        assert promote_s <= 2.0 * lease_s + 2.0, (
+            f"promotion took {promote_s:.2f}s with lease {lease_s}s")
+
+        # Every majority-acked record survives, read through the client's
+        # transparent failover path.
+        for i in range(30):
+            assert w.gcs_kv_get("ha", f"k{i}".encode()) == str(i).encode(), (
+                f"majority-acked key k{i} lost in failover")
+        # Actor table survived (replicated spec + raylet re-report)...
+        h = ray_tpu.get_actor("ha-counter")
+        assert ray_tpu.get(h.incr.remote(), timeout=120) == 2
+        # ...and so did the serve controller's target state.
+        assert "gcs-ha-chaos" in serve.status()
+
+        # A fenced old-primary straggler is provably rejected: an append
+        # stamped with the dead primary's epoch bounces off the quorum.
+        async def fenced_write():
+            conn = await rpclib.connect(
+                *cluster.head.gcs_addrs[new_idx], name="fence-probe")
+            try:
+                return await conn.call(
+                    "repl_append", old_st["epoch"],
+                    [(new_st["seq"] + 1,
+                      ("put", "kv", ("ha", b"fenced"), b"x"))],
+                    primary_idx,
+                )
+            finally:
+                await conn.close()
+
+        reply = asyncio.run(fenced_write())
+        assert reply["ok"] is False and reply["promised"] > old_st["epoch"]
+        assert w.gcs_kv_get("ha", b"fenced") is None
+
+        time.sleep(1.0)
+        halt.set()
+        t.join(timeout=30)
+
+        # Traffic kept flowing across the failover window, token-identical.
+        assert len(ok_during) - n_before_kill >= 5, (
+            f"only {len(ok_during) - n_before_kill} requests succeeded "
+            f"through the failover ({len(errors)} errors: {errors[:3]})"
+        )
+        for p, out in ok_during:
+            assert out == baseline[p], f"divergent response for {p!r}"
+        post = {p: ask(p, timeout=30) for p in prompts}
+        assert post == baseline
+        assert sorted(pid_handle.broadcast()) == pids_before, (
+            "failover restarted live serve replicas")
+
+        # Observability rides the report path ONLY: calling it surfaces the
+        # store/replication series (PR 9 leaksan deadlock lesson).
+        from ray_tpu.util import metrics as util_metrics
+        from ray_tpu.util.state import control_plane_stats
+
+        stats = control_plane_stats()
+        assert stats["repl"]["role"] == "primary"
+        assert stats["repl"]["failovers"] >= 1
+        assert stats["store"]["appends"] > 0
+        names = {m["name"] for m in util_metrics.collect_all()}
+        for name in ("gcs_store_append_seconds", "gcs_store_log_bytes",
+                     "gcs_store_compactions_total", "gcs_repl_lag_records",
+                     "gcs_failovers_total"):
+            assert name in names, name
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        CONFIG._reset()
+
+
+def test_train_run_rides_through_gcs_primary_kill(tmp_path, monkeypatch):
+    """SIGKILL the GCS *primary* mid-train (3 candidates, NO restart): the
+    promoted follower takes over the control plane, workers keep stepping,
+    and the run completes with a result bitwise-equal to an undisturbed
+    run."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    monkeypatch.setenv("RAY_TPU_GCS_REPLICAS", "3")
+    monkeypatch.setenv("RAY_TPU_GCS_LEASE_S", "1.5")
+    CONFIG._reset()
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "env_vars": _NODE_ENV})
+    marker = str(tmp_path / "mid_run")
+    try:
+        cluster.connect()
+
+        def loop(config):
+            from ray_tpu import train as _train
+
+            total = 0.0
+            for step in range(24):
+                total += float((step * 7 + 3) % 11) * 0.5
+                if step == 3:
+                    open(config["marker"], "w").write("x")
+                time.sleep(0.25)
+                _train.report({"step": step, "total": total})
+
+        result_box = {}
+
+        def fit():
+            result_box["result"] = DataParallelTrainer(
+                loop,
+                train_loop_config={"marker": marker},
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(
+                    name="gcs-ha-train", storage_path=str(tmp_path / "storage")
+                ),
+            ).fit()
+
+        t = threading.Thread(target=fit, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.1)
+        assert os.path.exists(marker), "run never reached mid-flight"
+
+        primary_idx = cluster.head.gcs_primary_index()
+        old_st = cluster.head.gcs_candidate_status(primary_idx)
+        cluster.head.kill_gcs_candidate(primary_idx)
+        # The dead candidate is NOT restarted: the promoted follower owns the
+        # control plane for the rest of the run.
+        _wait_new_gcs_primary(cluster.head, primary_idx, old_st["epoch"])
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "trainer did not finish after primary kill"
+        result = result_box["result"]
+        assert result.error is None, result.error
+        expected = 0.0
+        for step in range(24):
+            expected += float((step * 7 + 3) % 11) * 0.5
+        # Bitwise-equal to an undisturbed run: same float accumulation order.
+        assert result.metrics["total"] == expected
+        assert result.metrics["step"] == 23
+    finally:
+        cluster.shutdown()
+        CONFIG._reset()
+
+
+def test_single_candidate_gcs_mode_unchanged(monkeypatch):
+    """gcs_replicas=1 (set explicitly) is today's behavior: ONE GCS process
+    over the classic store dir, reporting itself primary with no quorum
+    machinery, and the restart-recovery path works exactly as before."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_GCS_REPLICAS", "1")
+    CONFIG._reset()
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1, "env_vars": _NODE_ENV})
+    try:
+        cluster.connect()
+        w = ray_tpu.global_worker()
+        assert len(cluster.head.gcs_procs) == 1
+        assert os.path.basename(cluster.head.gcs_store_dir) == "gcs_store"
+        st = cluster.head.gcs_candidate_status(0)
+        assert st["role"] == "primary" and st["replicas"] == 1
+        assert st["epoch"] == 0, "single mode must not run the lease protocol"
+
+        w.gcs_kv_put("solo", b"k", b"v1")
+        cluster.head.kill_gcs()
+        time.sleep(0.5)
+        cluster.head.restart_gcs()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if [n for n in ray_tpu.nodes() if n["alive"]]:
+                    break
+            except Exception:
+                time.sleep(0.5)
+        assert w.gcs_kv_get("solo", b"k") == b"v1"
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41), timeout=120) == 42
+    finally:
+        cluster.shutdown()
+        CONFIG._reset()

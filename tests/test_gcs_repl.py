@@ -1,0 +1,340 @@
+"""Replicated GCS protocol tests: lease-based quorum HA in-process.
+
+Three real GcsCandidate instances (each with its own RpcServer + store dir)
+run on one asyncio loop, which makes the protocol properties directly
+assertable: majority election, majority-ack replication, NOT_PRIMARY
+redirects, epoch fencing of a deposed primary, quorum-loss demotion, and the
+acquire->release books of the lease token and peer links. The full-cluster
+chaos coverage (SIGKILL the primary process under serve/train traffic) lives
+in tests/test_chaos.py.
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import pytest
+
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs_replication import (
+    GcsCandidate,
+    ReplicatedFileStore,
+    parse_addrs,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _boot(n, tmp_path, lease_s=0.8, quorum_timeout_s=2.0):
+    ports = [_free_port() for _ in range(n)]
+    peers = [("127.0.0.1", p) for p in ports]
+    cands = []
+    for i in range(n):
+        c = GcsCandidate(i, peers, os.path.join(str(tmp_path), f"s{i}"),
+                         lease_s=lease_s, quorum_timeout_s=quorum_timeout_s)
+        server = rpc.RpcServer(lambda conn, c=c: c.facade(conn))
+        await server.start(host="127.0.0.1", port=ports[i])
+        c.server = server
+        c.start_background()
+        cands.append(c)
+    return cands
+
+
+async def _wait_primary(cands, timeout=10.0, exclude=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        prim = [c for c in cands
+                if c.role == "primary" and c not in exclude]
+        if prim:
+            return prim[0]
+        await asyncio.sleep(0.02)
+    raise AssertionError("no primary elected in time")
+
+
+async def _shutdown_all(cands):
+    for c in cands:
+        try:
+            await c.shutdown()
+        except Exception:
+            pass
+
+
+def test_parse_addrs_shapes():
+    assert parse_addrs("h:1") == [("h", 1)]
+    assert parse_addrs("a:1, b:2,c:3") == [("a", 1), ("b", 2), ("c", 3)]
+    assert parse_addrs(("h", 1)) == [("h", 1)]
+    assert parse_addrs([("h", 1), ["g", 2]]) == [("h", 1), ("g", 2)]
+    assert parse_addrs(None) == []
+
+
+def test_replicated_store_stamps_position_across_compaction(tmp_path,
+                                                           monkeypatch):
+    """The (epoch, seq, promised) stamp rides the same log as the data:
+    compaction rewrites it with the live keys, and a reload restores the
+    replication coordinates exactly (epoch-stamped compaction)."""
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setenv("RAY_TPU_GCS_STORE_COMPACT_THRESHOLD", "100")
+    CONFIG._reset()
+    try:
+        store = ReplicatedFileStore(str(tmp_path / "s"))
+        store.load()
+        store.epoch = 7
+        for i in range(90):  # 2 appends per apply: crosses the threshold
+            store.apply_replicated(7, i + 1, ("put", "t", f"k{i % 5}", i))
+        assert store._stats["compactions"] >= 1, "compaction never ran"
+        assert store.seq == 90 and store.epoch == 7
+        store.grant(9)
+        store.close()
+
+        store2 = ReplicatedFileStore(str(tmp_path / "s"))
+        store2.load()
+        assert (store2.epoch, store2.seq, store2.promised) == (7, 90, 9)
+        assert store2.get("t", "k4") == 89
+        store2.close()
+    finally:
+        monkeypatch.delenv("RAY_TPU_GCS_STORE_COMPACT_THRESHOLD")
+        CONFIG._reset()
+
+
+def test_non_primary_store_drops_originated_writes(tmp_path):
+    """Local fencing: without the primary's fan-out installed, GcsService-
+    style put/delete calls are dropped — a zombie scheduler task on a deposed
+    candidate cannot diverge the follower's replicated log."""
+    store = ReplicatedFileStore(str(tmp_path / "s"))
+    store.load()
+    store.put("kv", ("ns", b"k"), b"zombie-write")
+    assert store.get("kv", ("ns", b"k")) is None
+    assert store.seq == 0
+    # The replicated apply path still works.
+    store.apply_replicated(1, 1, ("put", "kv", ("ns", b"k"), b"v"))
+    assert store.get("kv", ("ns", b"k")) == b"v"
+    store.close()
+
+
+def test_election_replication_and_redirect(tmp_path):
+    async def run():
+        cands = await _boot(3, tmp_path)
+        try:
+            primary = await _wait_primary(cands)
+            # A follower redirects client calls at the primary.
+            follower = next(c for c in cands if c is not primary)
+            conn = await rpc.connect(*follower.addr, name="cli")
+            with pytest.raises(rpc.NotPrimaryError) as ei:
+                await conn.call("kv_put", "ns", b"k", b"v", True)
+            assert tuple(ei.value.primary) == tuple(primary.addr)
+            await conn.close()
+
+            # Mutations through the primary are majority-acked and reach
+            # every live follower's warm store.
+            pconn = await rpc.connect(*primary.addr, name="cli")
+            for i in range(25):
+                assert await pconn.call(
+                    "kv_put", "ns", f"k{i}".encode(), str(i).encode(), True
+                ) is True
+            assert await pconn.call("kv_get", "ns", b"k3") == b"3"
+            st = await pconn.call("repl_status")
+            assert st["role"] == "primary" and st["replicas"] == 3
+            await pconn.close()
+            for c in cands:
+                if c is primary:
+                    continue
+                deadline = time.monotonic() + 5
+                while (c.store.get("kv", ("ns", b"k24")) != b"24"
+                       and time.monotonic() < deadline):
+                    await asyncio.sleep(0.02)
+                assert c.store.get("kv", ("ns", b"k24")) == b"24"
+                assert c.store.seq == primary.store.seq
+        finally:
+            await _shutdown_all(cands)
+
+    asyncio.run(run())
+
+
+def test_failover_promotes_caught_up_follower_and_fences_old_epoch(tmp_path):
+    """Primary death: a follower promotes within ~2x the lease window at a
+    higher epoch, majority-acked records survive, and a straggler append
+    stamped with the dead primary's epoch is rejected by the quorum."""
+    lease_s = 0.8
+
+    async def run():
+        cands = await _boot(3, tmp_path, lease_s=lease_s)
+        try:
+            primary = await _wait_primary(cands)
+            pconn = await rpc.connect(*primary.addr, name="cli")
+            for i in range(10):
+                await pconn.call("kv_put", "ns", f"k{i}".encode(),
+                                 str(i).encode(), True)
+            await pconn.close()
+            old_epoch = primary.store.epoch
+
+            t0 = time.monotonic()
+            await primary.shutdown()  # the in-process stand-in for SIGKILL
+            new_primary = await _wait_primary(
+                cands, timeout=10.0, exclude=(primary,))
+            promote_s = time.monotonic() - t0
+            assert promote_s <= 2.0 * lease_s + 1.0, (
+                f"promotion took {promote_s:.2f}s (lease {lease_s}s)")
+            assert new_primary.store.epoch > old_epoch
+
+            nconn = await rpc.connect(*new_primary.addr, name="cli")
+            for i in range(10):
+                assert await nconn.call(
+                    "kv_get", "ns", f"k{i}".encode()) == str(i).encode()
+            # Epoch fencing: the deposed primary's straggler bounces off
+            # both the new primary and the remaining follower.
+            straggler = (new_primary.store.seq + 1,
+                         ("put", "kv", ("ns", b"fenced"), b"x"))
+            reply = await nconn.call("repl_append", old_epoch, [straggler],
+                                     primary.candidate_id)
+            assert reply["ok"] is False
+            assert reply["promised"] > old_epoch
+            assert await nconn.call("kv_get", "ns", b"fenced") is None
+            await nconn.close()
+            follower = next(c for c in cands
+                            if c not in (primary, new_primary))
+            fconn = await rpc.connect(*follower.addr, name="cli")
+            reply = await fconn.call("repl_append", old_epoch, [straggler],
+                                     primary.candidate_id)
+            assert reply["ok"] is False
+            await fconn.close()
+            assert follower.store.get("kv", ("ns", b"fenced")) is None
+        finally:
+            await _shutdown_all(cands)
+
+    asyncio.run(run())
+
+
+def test_rejoined_candidate_truncates_unacked_tail(tmp_path):
+    """A candidate that diverged (its log holds records the quorum never
+    acked) is snapshot-resynced when the live primary reconnects to it: the
+    stale tail is truncated and its tables converge to the quorum state."""
+
+    async def run():
+        cands = await _boot(3, tmp_path)
+        try:
+            primary = await _wait_primary(cands)
+            pconn = await rpc.connect(*primary.addr, name="cli")
+            await pconn.call("kv_put", "ns", b"base", b"1", True)
+
+            follower = next(c for c in cands if c is not primary)
+            # Forge a diverged tail directly into the follower's store (the
+            # moral equivalent of a deposed primary's unacked appends), then
+            # break the primary's replication link — a rejoining deposed
+            # candidate always gets a fresh connect, and every fresh connect
+            # starts with a snapshot sync that truncates whatever the quorum
+            # never acked.
+            follower.store.apply_replicated(
+                follower.store.epoch, follower.store.seq + 5,
+                ("put", "kv", ("ns", b"stale"), b"tail"))
+            assert follower.store.get("kv", ("ns", b"stale")) == b"tail"
+            link = primary._links.get(follower.candidate_id)
+            assert link is not None
+            await link.conn.close()
+
+            await pconn.call("kv_put", "ns", b"after", b"2", True)
+            deadline = time.monotonic() + 8
+            while (follower.store.get("kv", ("ns", b"after")) != b"2"
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.05)
+            assert follower.store.get("kv", ("ns", b"after")) == b"2"
+            assert follower.store.get("kv", ("ns", b"stale")) is None, (
+                "unacked tail survived the resync")
+            assert follower.store.seq == primary.store.seq
+            await pconn.close()
+        finally:
+            await _shutdown_all(cands)
+
+    asyncio.run(run())
+
+
+def test_quorum_loss_demotes_primary_and_fails_writes(tmp_path):
+    """Majority loss is unavailability, not divergence: with both followers
+    gone the primary cannot ack a mutation, demotes itself, and the client
+    sees a retryable NotPrimaryError (docs/fault_tolerance.md: what survives
+    primary loss vs majority loss)."""
+
+    async def run():
+        cands = await _boot(3, tmp_path, lease_s=0.6, quorum_timeout_s=1.0)
+        try:
+            primary = await _wait_primary(cands)
+            pconn = await rpc.connect(*primary.addr, name="cli")
+            await pconn.call("kv_put", "ns", b"k", b"v", True)
+            for c in cands:
+                if c is not primary:
+                    await c.shutdown()
+            with pytest.raises(rpc.NotPrimaryError):
+                await pconn.call("kv_put", "ns", b"k2", b"v2", True)
+            assert primary.role == "follower", "primary kept its lease"
+        finally:
+            await _shutdown_all(cands)
+
+    asyncio.run(run())
+
+
+def test_demotion_releases_lease_and_peer_links(tmp_path):
+    """leaksan books: promotion acquires the lease token and per-peer links;
+    demotion releases every one of them — a deposed primary must not strand
+    follower connections or keep a released lease handle alive."""
+    from ray_tpu.devtools import leaksan
+
+    leaksan.reset()
+    leaksan.enable()
+    try:
+        async def run():
+            cands = await _boot(3, tmp_path, lease_s=0.6,
+                                quorum_timeout_s=1.0)
+            try:
+                primary = await _wait_primary(cands)
+                deadline = time.monotonic() + 5
+                while (len(primary._links) < 2
+                       and time.monotonic() < deadline):
+                    await asyncio.sleep(0.05)
+                counts = leaksan.live_counts()
+                assert counts.get("gcs_lease", 0) == 1
+                assert counts.get("gcs_repl_peer", 0) == 2
+                for c in cands:
+                    if c is not primary:
+                        await c.shutdown()
+                pconn = await rpc.connect(*primary.addr, name="cli")
+                with pytest.raises(rpc.NotPrimaryError):
+                    await pconn.call("kv_put", "ns", b"k", b"v", True)
+                await pconn.close()
+                counts = leaksan.live_counts()
+                assert counts.get("gcs_lease", 0) == 0, counts
+                assert counts.get("gcs_repl_peer", 0) == 0, counts
+            finally:
+                await _shutdown_all(cands)
+
+        asyncio.run(run())
+    finally:
+        leaksan.disable()
+        leaksan.reset()
+
+
+def test_single_gcs_answers_replication_surface():
+    """A lone GcsService speaks the same probe surface the failover clients
+    use, reporting itself primary — gcs_replicas=1 keeps one code path."""
+
+    async def run():
+        from ray_tpu._private.gcs import GcsService
+
+        gcs = GcsService()
+        server = rpc.RpcServer(lambda conn: gcs)
+        await server.start(host="127.0.0.1", port=0)
+        conn = await rpc.connect("127.0.0.1", server.port, name="cli")
+        st = await conn.call("repl_status")
+        assert st["role"] == "primary" and st["replicas"] == 1
+        stats = await conn.call("store_stats")
+        assert stats["repl"]["failovers"] == 0
+        await conn.close()
+        await server.close()
+
+    asyncio.run(run())

@@ -144,6 +144,32 @@ def test_fixture_catches_planted_adapter_pin_leak():
     assert leaksan.check_growth(before, settle_s=0.2) == {}
 
 
+def test_fixture_catches_planted_gcs_lease_and_peer_link_leak():
+    """The round-14 replication plane is leaksan-covered: a primary lease
+    token held past demotion grows `gcs_lease`, a replication link never
+    closed grows `gcs_repl_peer`; releasing/closing clears both (the
+    end-to-end demotion balance is asserted in test_gcs_repl.py)."""
+    import asyncio
+
+    from ray_tpu._private.gcs_replication import LeaseToken, PeerLink
+
+    class _FakeConn:
+        closed = False
+
+        async def close(self):
+            self.closed = True
+
+    before = leaksan.snapshot()
+    lease = LeaseToken(epoch=3)
+    link = PeerLink(("127.0.0.1", 1), _FakeConn())
+    growth = leaksan.check_growth(before, settle_s=0.2)
+    assert "gcs_lease" in growth and "gcs_repl_peer" in growth, growth
+    lease.release()
+    lease.release()  # idempotent: double demotion must not underflow
+    asyncio.run(link.close())
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+
+
 def test_check_growth_waits_for_async_teardown():
     # growth that resolves within the settle window is not a leak: the
     # devobj stream pump releases on its own thread after the reader drains

@@ -201,6 +201,21 @@ def test_rl801_adapter_pin_fires_and_suppresses():
         assert sym not in found, sym
 
 
+def test_rl801_gcs_repl_fires_and_suppresses():
+    """The round-14 RESOURCE_TABLE entries (GcsCandidate.open_peer ->
+    PeerLink.close, acquire_lease -> LeaseToken.release) flow through the
+    same RL801 path analysis: a deposed primary stranding follower links or
+    a released-but-held lease is the leak class they encode."""
+    found = _codes_by_symbol(_fixture("case_rl8_gcsrepl.py"))
+    for sym in ("bad_peer_link_never_closed", "bad_peer_link_conditional",
+                "bad_lease_never_released", "bad_lease_risky_gap"):
+        assert found.get(sym) == {"RL801"}, sym
+    for sym in ("ok_peer_link_stored", "ok_peer_link_finally",
+                "ok_lease_stored_for_demotion", "ok_lease_returned",
+                "suppressed_peer_link"):
+        assert sym not in found, sym
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}
