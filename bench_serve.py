@@ -511,6 +511,92 @@ def bench_wfq_fairness(on_tpu: bool):
     }
 
 
+def bench_tp_sweep(on_tpu: bool):
+    """Tensor-parallel decode sweep (docs/serving_tp.md): decode tokens/s and
+    per-chip HBM high-water vs TP degree on the forced multi-device mesh,
+    plus a model-larger-than-one-chip configuration — a parameter+KV
+    footprint exceeding a single device's budget that only the sharded
+    plane can serve, with throughput scaling reported vs TP=1."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm._engine import DecodeEngine
+    from ray_tpu.llm.tp import per_device_bytes
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    if on_tpu:
+        cfg = get_config("gpt2-125m", scan_layers=False, remat=False)
+        max_seq, prompt_len, max_tokens = 1024, 128, 64
+    else:
+        # kv_heads=4 so every sweep degree divides the KV axis; a deeper KV
+        # budget (max_seq) makes the pool a real fraction of the footprint.
+        cfg = get_config("test-tiny", scan_layers=False, remat=False,
+                         n_kv_heads=4)
+        max_seq, prompt_len, max_tokens = 512, 16, 16
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    degrees = [d for d in (1, 2, 4) if d <= len(jax.devices())]
+    rows = []
+    per_chip = {}
+    tps_by_degree = {}
+    for tp in degrees:
+        engine = DecodeEngine(cfg, params, num_slots=8, max_seq=max_seq,
+                              seed=0, tp=tp)
+        try:
+            run_requests(engine, cfg.vocab_size, 4, prompt_len, max_tokens)  # warm
+            _, tps, total = run_requests(
+                engine, cfg.vocab_size, 4, prompt_len, max_tokens
+            )
+            chip = per_device_bytes(engine.params) + per_device_bytes(
+                engine._caches
+            )
+        finally:
+            engine.shutdown()
+        per_chip[tp] = chip
+        tps_by_degree[tp] = tps
+        row = {
+            "metric": "tp_decode_sweep", "tp": tp,
+            "decode_tokens_per_s": round(tps, 1), "tokens": total,
+            "per_chip_bytes": int(chip),
+            "speedup_vs_tp1": round(tps / tps_by_degree[degrees[0]], 2),
+            "model": "gpt2-125m" if on_tpu else "test-tiny-kv4",
+            "max_seq": max_seq,
+        }
+        if not on_tpu and tp > 1:
+            row["note"] = (
+                "CPU artifact: the 'mesh' is 8 virtual host devices on one "
+                "CPU, so GSPMD collectives cost wall-clock they repay only "
+                "on real ICI; the load-bearing columns here are per_chip_"
+                "bytes (the 1/tp footprint) and token-identity (tests)"
+            )
+        rows.append(row)
+    # Model-larger-than-one-chip: a synthetic per-chip budget strictly
+    # between the TP=max per-chip footprint and the TP=1 footprint — the
+    # unsharded engine cannot exist under it, the sharded one serves.
+    tp_hi = degrees[-1]
+    budget = int((per_chip[1] + per_chip[tp_hi]) // 2)
+    rows.append({
+        "metric": "tp_model_exceeds_one_chip",
+        "chip_budget_bytes": budget,
+        "per_chip_bytes_tp1": int(per_chip[1]),
+        f"per_chip_bytes_tp{tp_hi}": int(per_chip[tp_hi]),
+        "fits_one_chip": per_chip[1] <= budget,
+        f"fits_tp{tp_hi}": per_chip[tp_hi] <= budget,
+        f"decode_tokens_per_s_tp{tp_hi}": round(tps_by_degree[tp_hi], 1),
+        "throughput_vs_tp1": round(
+            tps_by_degree[tp_hi] / tps_by_degree[degrees[0]], 2
+        ),
+        "note": "footprint = params + per-slot KV pool per device; the "
+                "budget sits between the sharded and unsharded footprints, "
+                "so only the TP mesh serves this configuration",
+    })
+    return rows
+
+
 def bench_pd_ttft():
     """PD-disaggregated TTFT through the real serve app: prefill replica ->
     KV handoff (descriptor + pull over the round-11 device-channel plane,
@@ -602,6 +688,10 @@ def main():
     # adapter-churn paging overhead + WFQ-vs-FIFO fairness under saturation.
     results.append(bench_adapter_churn(on_tpu))
     results.append(bench_wfq_fairness(on_tpu))
+
+    # Tensor-parallel decode sweep + model-larger-than-one-chip (round 15,
+    # docs/serving_tp.md).
+    results.extend(bench_tp_sweep(on_tpu))
 
     # PD disaggregation TTFT across real replica actors (round 11).
     results.append(bench_pd_ttft())

@@ -40,6 +40,53 @@ def _descendant_pids(root_pid: int) -> list[int]:
     return out
 
 
+def reserve_tp_slice(
+    num_devices: int,
+    *,
+    resource: str = "TPU",
+    replicas: int = 1,
+    strategy: str = "PACK",
+    name: str = "",
+    ready_timeout_s: float | None = 60.0,
+):
+    """Gang-reserve the device set(s) for tensor-parallel serve replicas
+    (docs/serving_tp.md): one bundle of ``num_devices`` units of ``resource``
+    per replica, reserved ATOMICALLY before any engine process starts — a
+    DP x TP fleet either gets every replica's whole mesh or nothing, instead
+    of deadlocking with half-acquired chips (reference: Ray Serve LLM
+    composes vLLM TP workers with exactly this placement-group shape).
+
+    A bundle never spans nodes, so each replica's mesh stays inside one
+    host's ICI domain by construction; ``strategy`` picks how bundles relate
+    (``PACK`` co-locates the fleet where possible, ``STRICT_SPREAD`` forces
+    one replica per host). Schedule each replica into its bundle with
+    ``placement_group=pg, placement_group_bundle_index=i`` actor options.
+    Returns the PlacementGroup; raises TimeoutError when the reservation is
+    not ALIVE within ``ready_timeout_s`` (pass None to skip the wait)."""
+    from ray_tpu.util.placement_group import placement_group
+
+    if num_devices < 1 or replicas < 1:
+        raise ValueError("num_devices and replicas must be >= 1")
+    bundles = [{resource: float(num_devices)} for _ in range(replicas)]
+    pg = placement_group(
+        bundles, strategy=strategy,
+        name=name or f"tp{num_devices}x{replicas}",
+    )
+    if ready_timeout_s is not None and not pg.ready(ready_timeout_s):
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        try:
+            remove_placement_group(pg)  # no half-reserved fleet left behind
+        except Exception:
+            pass  # the raise below is the signal; cleanup is best-effort
+        raise TimeoutError(
+            f"placement group for {replicas} x {num_devices} {resource} "
+            f"not schedulable within {ready_timeout_s}s — the cluster lacks "
+            f"the capacity for this DP x TP fleet"
+        )
+    return pg
+
+
 class Cluster:
     def __init__(
         self,

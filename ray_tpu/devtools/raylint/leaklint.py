@@ -99,6 +99,12 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
                  release=("close",)),
     ResourceSpec("GCS primary lease (LeaseToken)", "acquire_lease",
                  release=("release",)),
+    # Round 15 (docs/serving_tp.md): a TP engine's mesh-resident KV shard
+    # pool. A forgotten free() strands tp * layers * 2 device buffers that
+    # no host object names once the engine drops — the drain-and-retire path
+    # of every TP replica must discharge it.
+    ResourceSpec("mesh-sharded KV pool (ShardedKVPool)", "ShardedKVPool",
+                 release=("free",)),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

@@ -78,6 +78,94 @@ def _host_resident(leaf) -> bool:
         return False
 
 
+def _norm_index(index, shape) -> tuple:
+    """Shard index (tuple of slices) -> ((start, stop), ...) over every dim."""
+    out = []
+    for dim in range(len(shape)):
+        if dim < len(index):
+            sl = index[dim]
+            start = 0 if sl.start is None else int(sl.start)
+            stop = shape[dim] if sl.stop is None else int(sl.stop)
+        else:
+            start, stop = 0, shape[dim]
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _shard_plan(leaf):
+    """Per-shard send plan for a mesh-sharded jax Array, or None for the
+    single-buffer path. Returns [(bounds, shard)] with replicated device
+    copies deduped and a deterministic bounds order, so the reader can
+    reassemble (or re-scatter) without any metadata beyond the header.
+
+    This is the no-gather half of the sharded PD handoff
+    (docs/serving_tp.md): each shard's bytes leave ITS device directly — a
+    flat-reshape slice over the global array would force XLA to gather the
+    whole tensor onto one device first, which may not even fit when the
+    model needs the mesh to exist at all."""
+    jax = sys.modules.get("jax")
+    if jax is None or not isinstance(leaf, jax.Array):
+        return None
+    try:
+        if len(leaf.sharding.device_set) <= 1:
+            return None
+        fully_addressable = leaf.is_fully_addressable
+        shards = leaf.addressable_shards
+    except Exception:
+        return None
+    if not fully_addressable:
+        raise ValueError(
+            "cannot stream a partially-addressable sharded array: a "
+            "DeviceChannel moves one process's shards (multi-host arrays "
+            "stream per host from the process that owns them)"
+        )
+    shape = tuple(leaf.shape)
+    seen = {}
+    for shard in shards:
+        bounds = _norm_index(shard.index, shape)
+        if bounds not in seen:
+            seen[bounds] = shard
+    if len(seen) <= 1:
+        return None  # fully replicated: any one copy IS the array
+    return sorted(seen.items(), key=lambda kv: kv[0])
+
+
+def _assemble_sharded(shape, dtype, bounds_list, shard_hosts, sharding):
+    """Rebuild a streamed sharded leaf on the consumer.
+
+    With a target `sharding` whose device->index map covers exactly the
+    streamed bounds, each shard host buffer is `device_put` onto its OWN
+    target device(s) and the global array assembles zero-gather via
+    `jax.make_array_from_single_device_arrays`. Any mismatch (different TP
+    degree, replicated target, no sharding given) assembles host-side and
+    pays one explicit placement copy — correctness never depends on the
+    layouts agreeing."""
+    import jax
+
+    if sharding is not None:
+        try:
+            imap = sharding.addressable_devices_indices_map(tuple(shape))
+            by_bounds: dict = {}
+            for dev, idx in imap.items():
+                by_bounds.setdefault(_norm_index(idx, shape), []).append(dev)
+            if set(by_bounds) == set(bounds_list):
+                arrays = []
+                for bounds, host in zip(bounds_list, shard_hosts):
+                    for dev in by_bounds[bounds]:
+                        arrays.append(jax.device_put(host, dev))
+                return jax.make_array_from_single_device_arrays(
+                    tuple(shape), sharding, arrays
+                )
+        except Exception:
+            pass  # layout mismatch or older jax: the host path below is exact
+    out = np.empty(shape, dtype)
+    for bounds, host in zip(bounds_list, shard_hosts):
+        out[tuple(slice(lo, hi) for lo, hi in bounds)] = host
+    if sharding is not None:
+        return jax.device_put(out, sharding)
+    return jax.device_put(out)
+
+
 class DeviceChannel:
     """One-writer one-reader stream of array trees over a chunked transport.
 
@@ -163,7 +251,16 @@ class DeviceChannel:
                 ring.cond.notify_all()
             return
         skeleton_bytes, leaves = _tt.split(value, 0)
-        descs = [_leaf_meta(leaf) for leaf in leaves]
+        plans = [_shard_plan(leaf) for leaf in leaves]
+        descs = []
+        for leaf, plan in zip(leaves, plans):
+            shape, dtype, size = _leaf_meta(leaf)
+            if plan is None:
+                descs.append((shape, dtype, size))
+            else:
+                # Sharded leaf: the desc carries the shard bounds, and the
+                # payload frames follow in exactly this shard order.
+                descs.append((shape, dtype, size, [b for b, _ in plan]))
         meta = pickle.dumps(
             (skeleton_bytes, descs, self._chunk),
             protocol=pickle.HIGHEST_PROTOCOL,
@@ -173,8 +270,24 @@ class DeviceChannel:
         )
         rpc = isinstance(self._transport, RpcChannel)
         jax = sys.modules.get("jax")
-        for leaf, (_shape, dtype, size) in zip(leaves, descs):
+        for leaf, desc, plan in zip(leaves, descs, plans):
+            _shape, dtype, size = desc[:3]
             ce = _chunk_elems(dtype, self._chunk)
+            if plan is not None:
+                for _bounds, shard in plan:
+                    # Per-shard D2H: bytes leave each shard's own device —
+                    # never a cross-device gather of the global array.
+                    host = np.ascontiguousarray(np.asarray(shard.data))  # raylint: disable=RL603 (the per-shard D2H leg itself — one local pull per shard IS the point)
+                    flatb = _tt.as_flat_bytes(host)
+                    isz = dtype.itemsize
+                    ssize = host.size
+                    for a in range(0, ssize, ce):
+                        b = min(ssize, a + ce)
+                        mv = flatb[a * isz : b * isz].data
+                        self._transport.write_bytes(
+                            bytes(mv) if rpc else mv, timeout
+                        )
+                continue
             if (jax is not None and isinstance(leaf, jax.Array)
                     and not _host_resident(leaf)):
                 flat = jax.numpy.reshape(leaf, (-1,))
@@ -249,37 +362,70 @@ class DeviceChannel:
         )
         shm = isinstance(self._transport, Channel)
         leaves: List[Optional[np.ndarray]] = []
-        for li, (shape, dtype, size) in enumerate(descs):
-            out = np.empty(size, dtype) if assemble else None
+        for li, desc in enumerate(descs):
+            shape, dtype, size = desc[:3]
             ce = _chunk_elems(dtype, chunk_bytes)
-            for a in range(0, size, ce):
-                b = min(size, a + ce)
-                if shm:
-                    view = self._transport.read_view(timeout)
-                    try:
-                        typed = np.frombuffer(view.mv, dtype=dtype)
-                        if assemble:
-                            out[a:b] = typed
+
+            def read_flat(n_elems, out_buf, li=li):
+                """Drain one flat segment of n_elems from the stream into
+                out_buf (None = discard); on_chunk offsets are segment-local."""
+                for a in range(0, n_elems, ce):
+                    b = min(n_elems, a + ce)
+                    if shm:
+                        view = self._transport.read_view(timeout)
+                        try:
+                            typed = np.frombuffer(view.mv, dtype=dtype)
+                            if out_buf is not None:
+                                out_buf[a:b] = typed
+                            if on_chunk is not None:
+                                on_chunk(li, a, typed)
+                        finally:
+                            del typed  # drop the slot alias before the ack
+                            view.release()
+                    else:
+                        data = self._transport.read_bytes(timeout)
+                        typed = np.frombuffer(data, dtype=dtype)
+                        if out_buf is not None:
+                            out_buf[a:b] = typed
                         if on_chunk is not None:
                             on_chunk(li, a, typed)
-                    finally:
-                        del typed  # drop the slot alias before the ack
-                        view.release()
-                else:
-                    data = self._transport.read_bytes(timeout)
-                    typed = np.frombuffer(data, dtype=dtype)
+
+            if len(desc) == 4:
+                # Sharded leaf (docs/serving_tp.md): one flat segment per
+                # shard, assembled into its bounds of the global array.
+                out = np.empty(shape, dtype) if assemble else None
+                for bounds in desc[3]:
+                    sshape = tuple(hi - lo for lo, hi in bounds)
+                    ssize = 1
+                    for d in sshape:
+                        ssize *= d
+                    buf = np.empty(ssize, dtype) if assemble else None
+                    read_flat(ssize, buf)
                     if assemble:
-                        out[a:b] = typed
-                    if on_chunk is not None:
-                        on_chunk(li, a, typed)
-            leaves.append(out.reshape(shape) if assemble else None)
+                        out[tuple(slice(lo, hi) for lo, hi in bounds)] = (
+                            buf.reshape(sshape)
+                        )
+                leaves.append(out if assemble else None)
+            else:
+                out = np.empty(size, dtype) if assemble else None
+                read_flat(size, out)
+                leaves.append(out.reshape(shape) if assemble else None)
         return _tt.join(skeleton_bytes, leaves)
 
-    def recv_device(self, timeout: Optional[float] = None) -> Any:
+    def recv_device(self, timeout: Optional[float] = None, *,
+                    sharding=None) -> Any:
         """Read one streamed value with per-chunk DEVICE staging: each chunk
         is `jax.device_put` as it arrives (H2D overlaps the wire/D2H legs),
         then leaves assemble on device with one concatenate+reshape — the
         host never holds a full copy of any leaf.
+
+        `sharding` (optional) is the consumer's target mesh layout
+        (docs/serving_tp.md): shard frames whose bounds match the target's
+        device->index map stage each shard straight onto ITS device and
+        assemble with `jax.make_array_from_single_device_arrays` — the
+        no-scatter half of the sharded PD handoff. Mismatched layouts fall
+        back to host assembly + one `jax.device_put(..., sharding)`
+        (correct, one resharding copy).
 
         Dtypes follow jax's x64 rules on the receiving process (int64/float64
         chunks downcast unless jax_enable_x64 is on); use recv() when the
@@ -288,7 +434,12 @@ class DeviceChannel:
         import jax.numpy as jnp
 
         if self._transport is None:
-            return self.recv(timeout=timeout)
+            value = self.recv(timeout=timeout)
+            if sharding is not None:
+                value = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sharding), value
+                )
+            return value
         header = self._transport.read_bytes(timeout)
         if bytes(header[:4]) != STREAM_MAGIC:
             raise ValueError(
@@ -299,9 +450,43 @@ class DeviceChannel:
             memoryview(header)[8 : 8 + meta_len]
         )
         shm = isinstance(self._transport, Channel)
+
+        def read_host_flat(n_elems, dtype, ce):
+            """One flat segment, assembled host-side (owned buffers)."""
+            out = np.empty(n_elems, dtype)
+            for a in range(0, n_elems, ce):
+                b = min(n_elems, a + ce)
+                if shm:
+                    view = self._transport.read_view(timeout)
+                    try:
+                        out[a:b] = np.frombuffer(view.mv, dtype=dtype)
+                    finally:
+                        view.release()
+                else:
+                    out[a:b] = np.frombuffer(
+                        self._transport.read_bytes(timeout), dtype=dtype
+                    )
+            return out
+
         leaves = []
-        for shape, dtype, size in descs:
+        for desc in descs:
+            shape, dtype, size = desc[:3]
             ce = _chunk_elems(dtype, chunk_bytes)
+            if len(desc) == 4:
+                bounds_list = desc[3]
+                shard_hosts = []
+                for bounds in bounds_list:
+                    sshape = tuple(hi - lo for lo, hi in bounds)
+                    ssize = 1
+                    for d in sshape:
+                        ssize *= d
+                    shard_hosts.append(
+                        read_host_flat(ssize, dtype, ce).reshape(sshape)
+                    )
+                leaves.append(_assemble_sharded(
+                    shape, dtype, bounds_list, shard_hosts, sharding
+                ))
+                continue
             chunks = []
             for a in range(0, size, ce):
                 if shm:
@@ -324,7 +509,10 @@ class DeviceChannel:
                 flat = chunks[0]
             else:
                 flat = jnp.concatenate(chunks)
-            leaves.append(jnp.reshape(flat, shape))
+            leaf = jnp.reshape(flat, shape)
+            if sharding is not None:
+                leaf = jax.device_put(leaf, sharding)
+            leaves.append(leaf)
         return _tt.join(skeleton_bytes, leaves)
 
     # -- lifecycle ---------------------------------------------------------

@@ -145,7 +145,7 @@ def _run_on_owner(ref: DeviceObjectRef, local_fn, remote_fn):
 
 
 def get(ref: DeviceObjectRef, *, to_device: bool = False,
-        on_chunk=None, _legacy: bool = False):
+        on_chunk=None, sharding=None, _legacy: bool = False):
     """Resolve a descriptor to its array.
 
     Same actor: the device array itself, zero transfer. Elsewhere the payload
@@ -156,7 +156,10 @@ def get(ref: DeviceObjectRef, *, to_device: bool = False,
     object plane. `to_device=True` stages each chunk onto the local device as
     it lands (`jax.device_put` per chunk + one device concatenate), and
     `on_chunk(leaf_idx, elt_offset, typed_chunk)` tees arriving chunks to the
-    caller.
+    caller. `sharding` (implies to_device) is the consumer's target mesh
+    layout: a mesh-sharded payload whose shard bounds match stages each
+    arriving shard straight onto its own device — the sharded PD handoff
+    path (docs/serving_tp.md).
 
     Payloads below `devobj_stream_min_bytes` take the one-hop object-plane
     blob instead: a stream pays a control round-trip plus ring setup, which
@@ -166,22 +169,33 @@ def get(ref: DeviceObjectRef, *, to_device: bool = False,
     from ray_tpu._private.worker import global_worker
 
     w = global_worker()
+    if sharding is not None:
+        to_device = True
     if w.actor_id is not None and w.actor_id == ref.actor_id:
-        return _store.get(ref.key)
+        value = _store.get(ref.key)
+        if sharding is not None:
+            import jax
+
+            # Same-actor, different layout: one explicit placement (XLA
+            # moves the bytes over ICI; no host staging).
+            value = jax.device_put(value, sharding)
+        return value
     # on_chunk only has meaning on the stream, so a tee request overrides
     # the size gate.
     if (not _legacy
             and (on_chunk is not None
                  or _descriptor_nbytes(ref) >= CONFIG.devobj_stream_min_bytes)):
         try:
-            return _stream_fetch(ref, to_device=to_device, on_chunk=on_chunk)
+            return _stream_fetch(ref, to_device=to_device, on_chunk=on_chunk,
+                                 sharding=sharding)
         except _StreamUnsupported:
             pass  # owner predates streams or this process has no data plane
     value = _run_on_owner(ref, lambda: _store.get(ref.key), _fetch_host)
     if to_device:
         import jax
 
-        value = jax.device_put(value)
+        value = (jax.device_put(value, sharding) if sharding is not None
+                 else jax.device_put(value))
     return value
 
 
@@ -400,7 +414,8 @@ def _open_stream(_instance, key: str, reader_node, chunk_bytes):
     return ch
 
 
-def _stream_fetch(ref: DeviceObjectRef, *, to_device: bool, on_chunk=None):
+def _stream_fetch(ref: DeviceObjectRef, *, to_device: bool, on_chunk=None,
+                  sharding=None):
     """Reader side of the chunked pull; raises _StreamUnsupported when the
     topology cannot stream (caller falls back to the object-plane blob)."""
     import ray_tpu
@@ -420,7 +435,7 @@ def _stream_fetch(ref: DeviceObjectRef, *, to_device: bool, on_chunk=None):
     )
     try:
         if to_device:
-            value = ch.recv_device(timeout=120.0)
+            value = ch.recv_device(timeout=120.0, sharding=sharding)
             nbytes = sum(
                 int(x.size) * x.dtype.itemsize
                 for x in _leaves_of(value)

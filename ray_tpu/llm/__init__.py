@@ -100,6 +100,13 @@ class LLMConfig:
     tenant_weights: Optional[dict] = None
     wfq: bool = True
     tenant_quota: Optional[int] = None
+    # Tensor parallelism (docs/serving_tp.md): each replica's engine shards
+    # params + KV pool + adapter tables over a jax.sharding.Mesh of this
+    # many devices (or a mesh-axes dict, e.g. {"tp": 4}); GSPMD partitions
+    # every compiled program. Composes with num_replicas / dp_size into
+    # DP x TP fleets; accelerator_resources are scaled per replica by the
+    # builders so each replica's device gang is reserved atomically.
+    tp: Any = 1
 
 
 def load_model(config: "LLMConfig"):
@@ -122,8 +129,19 @@ def load_model(config: "LLMConfig"):
             # Sharded warm start (docs/checkpoint.md): slice files are read
             # directly (mmap) and only a committed manifest is accepted. A
             # train-plane save of {"params": ...} and a bare params save both
-            # restore.
-            tree = ckpt_lib.restore(config.checkpoint_path)
+            # restore. TP configs stream every leaf straight to its mesh
+            # layout through the resharding restore (docs/serving_tp.md) —
+            # no host materialization of a tree that may not fit one chip.
+            from ray_tpu.llm.tp import build_tp_mesh, checkpoint_shardings
+
+            mesh = build_tp_mesh(config.tp)
+            if mesh is not None:
+                tree = ckpt_lib.restore(
+                    config.checkpoint_path,
+                    shardings=checkpoint_shardings(config.checkpoint_path, mesh),
+                )
+            else:
+                tree = ckpt_lib.restore(config.checkpoint_path)
             params = tree.get("params", tree) if isinstance(tree, dict) else tree
         else:
             with open(os.path.join(config.checkpoint_path, "params.pkl"), "rb") as f:
@@ -133,6 +151,21 @@ def load_model(config: "LLMConfig"):
             jax.random.PRNGKey(config.seed), jnp.zeros((1, 8), jnp.int32)
         )["params"]
     return cfg, params
+
+
+def replica_resources(config: "LLMConfig") -> dict:
+    """Per-replica actor resource demand: each accelerator unit in
+    `accelerator_resources` scales by the TP device count, so one replica's
+    whole device gang is reserved atomically by the scheduler (DP x TP
+    composition, docs/serving_tp.md). Cross-host gangs go through
+    `cluster_utils.reserve_tp_slice` placement groups instead."""
+    from ray_tpu.llm.tp import tp_device_count
+
+    resources = dict(config.accelerator_resources or {})
+    n_dev = tp_device_count(config.tp)
+    if n_dev > 1 and resources:
+        resources = {k: float(v) * n_dev for k, v in resources.items()}
+    return resources
 
 
 class LLMServer:
@@ -150,6 +183,7 @@ class LLMServer:
             spec_config=config.spec_config,
             wfq=config.wfq, tenant_weights=config.tenant_weights,
             tenant_quota=config.tenant_quota,
+            tp=config.tp,
         )
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0) -> int:
@@ -417,7 +451,7 @@ class OpenAIRouter:
 
 def build_llm_deployment(config: LLMConfig) -> "serve.Application":
     """One LLM server deployment. Parity: serve.llm.build_llm_deployment."""
-    resources = config.accelerator_resources or {}
+    resources = replica_resources(config)
     deployment = serve.deployment(
         name=f"LLMServer-{config.model_id}",
         num_replicas=config.num_replicas,
@@ -448,4 +482,5 @@ __all__ = [
     "UnknownAdapterError",
     "build_llm_deployment",
     "build_openai_app",
+    "replica_resources",
 ]

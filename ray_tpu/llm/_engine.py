@@ -42,6 +42,16 @@ from ray_tpu.llm.scheduler.scheduler import (
     ScheduledChunk,
     Scheduler,
 )
+from ray_tpu.llm.tp import (
+    ShardedKVPool,
+    build_tp_mesh,
+    checkpoint_shardings,
+    kv_prefix_sharding,
+    mesh_signature,
+    shard_decode_params,
+    single_device_shardings,
+    tp_degree,
+)
 from ray_tpu.models.transformer import ModelConfig, _rope
 
 _NEG_INF = -1e30
@@ -235,7 +245,8 @@ class DecodeEngine:
                  token_budget: Optional[int] = None,
                  wfq: bool = True,
                  tenant_weights: Optional[Dict[str, float]] = None,
-                 tenant_quota: Optional[int] = None):
+                 tenant_quota: Optional[int] = None,
+                 tp: Any = 1):
         assert not cfg.scan_layers, "engine expects scan_layers=False param layout"
         from ray_tpu._private.config import CONFIG
         from ray_tpu.parallel.mesh import unbox
@@ -245,6 +256,21 @@ class DecodeEngine:
         self.B = num_slots
         self.T = max_seq or cfg.max_seq
         self._np_rng = np.random.default_rng(seed)
+        # Tensor parallelism (docs/serving_tp.md): tp > 1 (or a mesh-axes
+        # dict) shards the WHOLE decode plane — params, per-slot KV pool,
+        # adapter tables — over a jax.sharding.Mesh; GSPMD partitions every
+        # compiled program from its input shardings. tp=1 keeps the exact
+        # single-device code path (no mesh, no resharding device_puts).
+        self._mesh = build_tp_mesh(tp)
+        self.tp = tp_degree(self._mesh)
+        self._mesh_sig = mesh_signature(self._mesh)
+        self._kv_pool = None
+        if self._mesh is not None:
+            self.params = shard_decode_params(self.params, self._mesh)
+            from ray_tpu.devtools import leaksan as _leaksan
+
+            self._param_shard_token = f"engine-{id(self):x}"
+            _leaksan.track("tp_param_shards", token=self._param_shard_token)
         # Multi-LoRA: an HBM-budgeted pageable AdapterCache backs the stacked
         # device table (slot 0 = base model, zero factors), so one jitted
         # program serves any adapter mix in a batch AND "hundreds of tenants"
@@ -269,13 +295,25 @@ class DecodeEngine:
                 budget_bytes=int(budget),
                 cache_slots=lora_config.get("cache_slots"),
                 name=f"engine-{id(self):x}",
+                mesh=self._mesh,
             )
         self._adapter_ids = np.zeros((num_slots,), np.int32)
         kv_shape = (self.B, self.T, cfg.n_kv_heads, cfg.head_dim)
-        self._caches = [
-            (jnp.zeros(kv_shape, cfg.dtype), jnp.zeros(kv_shape, cfg.dtype))
-            for _ in range(cfg.n_layers)
-        ]
+        if self._mesh is not None:
+            # Mesh-resident per-slot KV pool: shards allocate at their
+            # kv-head-split layout directly (never materialized whole on any
+            # one device); freed by shutdown via the tracked pool handle.
+            self._kv_pool = ShardedKVPool(
+                n_layers=cfg.n_layers, shape=kv_shape, dtype=cfg.dtype,
+                mesh=self._mesh, n_kv_heads=cfg.n_kv_heads,
+                name=f"engine-{id(self):x}",
+            )
+            self._caches = self._kv_pool.take()
+        else:
+            self._caches = [
+                (jnp.zeros(kv_shape, cfg.dtype), jnp.zeros(kv_shape, cfg.dtype))
+                for _ in range(cfg.n_layers)
+            ]
         # Per-slot lengths and last tokens are HOST-native (numpy): the
         # stepper reads and writes them every step, and a device-canonical
         # copy would force a blocking device->host pull per step just to do
@@ -440,20 +478,31 @@ class DecodeEngine:
 
     # -- warm start --------------------------------------------------------
     @classmethod
-    def from_sharded_checkpoint(cls, cfg: ModelConfig, path: str, **kwargs
-                                ) -> "DecodeEngine":
+    def from_sharded_checkpoint(cls, cfg: ModelConfig, path: str, *,
+                                tp: Any = 1, **kwargs) -> "DecodeEngine":
         """Build an engine whose weights come from a committed sharded
         checkpoint (ray_tpu.checkpoint) — the fast DP replica warm-start:
         slice files are memory-mapped straight off the shared filesystem, so
         a scale-up replica never pulls a whole pickled tree through the
         object store. Accepts either a bare params save or a train-state
         save holding a "params" subtree. Refuses uncommitted (manifest-less)
-        directories."""
+        directories.
+
+        The restore always hands LAYOUTS to `checkpoint._restore`: with
+        tp > 1 every leaf streams straight to its TP mesh sharding (each
+        device reads only the file regions its shard overlaps — no host
+        gather of a tree that may not fit one host); at tp=1 leaves stream
+        onto the default device, never materializing an intermediate host
+        pytree that the engine would immediately re-upload."""
         from ray_tpu.checkpoint import restore
 
-        tree = restore(path)
+        mesh = build_tp_mesh(tp)
+        if mesh is not None:
+            tree = restore(path, shardings=checkpoint_shardings(path, mesh))
+        else:
+            tree = restore(path, shardings=single_device_shardings())
         params = tree.get("params", tree) if isinstance(tree, dict) else tree
-        return cls(cfg, params, **kwargs)
+        return cls(cfg, params, tp=tp, **kwargs)
 
     # -- lora registry -----------------------------------------------------
     def add_lora(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
@@ -857,7 +906,15 @@ class DecodeEngine:
                     jnp.int32(adapter_slot)
                 )
                 first_logits = np.asarray(logits[len(prompt) - 1])
-                kv = np.asarray(kv_dev)
+                if self._mesh is None:
+                    kv = np.asarray(kv_dev)
+                else:
+                    # TP prefill: the prefix STAYS mesh-resident (sharded on
+                    # kv heads). The PD handoff streams it per shard over the
+                    # DeviceChannel plane — a host np.asarray here would be
+                    # exactly the gather-then-scatter the sharded plane
+                    # exists to avoid (docs/serving_tp.md).
+                    kv = kv_dev
         finally:
             if handle is not None:
                 handle.release()
@@ -868,7 +925,12 @@ class DecodeEngine:
             bs = self._prefix_cache.block_size
             n = (len(prompt) // bs) * bs
             if n > m:  # nothing new to insert when the hit covered every block
-                self._prefix_cache.insert(prompt[:n], kv, namespace=adapter)
+                # The host-side prefix pool wants host rows; a TP engine pays
+                # one bounded gather per INSERT (off the decode loop, skipped
+                # entirely when the cache is disabled), amortized by every
+                # future hit.
+                host_kv = kv if isinstance(kv, np.ndarray) else np.asarray(kv)  # raylint: disable=RL603 (one per-insert pull feeding the host prefix pool)
+                self._prefix_cache.insert(prompt[:n], host_kv, namespace=adapter)
         return first_logits, kv, len(prompt)
 
     def _detached_suffix(self, prompt: List[int], m: int,
@@ -973,6 +1035,31 @@ class DecodeEngine:
                     req.callback(-1, True)
                 except Exception:
                     pass  # shutdown must proceed past a broken callback
+        self._release_mesh_state()
+
+    def _release_mesh_state(self):
+        """Drop every mesh-resident buffer reference a TP engine holds (the
+        drain-and-retire contract, docs/serving_tp.md): the sharded KV pool
+        frees through its tracked handle and the param-shard token balances
+        its books, so leaksan proves a retired TP replica strands no
+        shards. Idempotent; a no-op for single-device engines."""
+        if self._kv_pool is not None:
+            self._kv_pool.free()
+            self._caches = []
+        if self._mesh is not None and getattr(self, "_param_shard_token", None):
+            from ray_tpu.devtools import leaksan as _leaksan
+
+            _leaksan.untrack("tp_param_shards", token=self._param_shard_token)
+            self._param_shard_token = None
+            self.params = None
+
+    @property
+    def kv_transfer_sharding(self):
+        """Target mesh sharding for a transferred KV prefix [L, 2, P, Hkv, D]
+        (the PD handoff payload); None on single-device engines."""
+        if self._mesh is None:
+            return None
+        return kv_prefix_sharding(self._mesh, self.cfg.n_kv_heads)
 
     # -- stepper -----------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -990,7 +1077,14 @@ class DecodeEngine:
         construction; llm_max_jit_programs bounds the cross products
         ((prefix, suffix) pairs, spec-k variants) that remain. Past the cap
         the oldest-inserted program is dropped — re-requesting it later
-        re-jits (XLA's own compilation cache may still serve the binary)."""
+        re-jits (XLA's own compilation cache may still serve the binary).
+
+        The mesh signature is part of every key (docs/serving_tp.md): a
+        sharding regime is a DIFFERENT program by construction — an engine's
+        mesh is fixed at construction, so nothing can recompile mid-serve,
+        and two engines over different meshes never alias cache entries."""
+        if self._mesh_sig is not None:
+            key = (self._mesh_sig, key)
         prog = cache.get(key)
         if prog is None:
             if self._max_jit_programs and len(cache) >= self._max_jit_programs:
@@ -1085,6 +1179,14 @@ class DecodeEngine:
         slot = req.slot
         kv = req.kv
         on_device = isinstance(kv, jax.Array)
+        if on_device and self._mesh is not None:
+            # Normalize a transferred device prefix onto THIS engine's mesh
+            # (no-op when it already is): a prefix committed to one device
+            # (recv_device staging) or sharded on a peer engine's mesh must
+            # not meet mesh-sharded caches inside one jit un-resharded.
+            kv = jax.device_put(
+                kv, kv_prefix_sharding(self._mesh, self.cfg.n_kv_heads)
+            )
         xp = jnp if on_device else np
         prompt_len = req.prompt_len
         # Pad the transferred prefix to a bucket so attach programs are reused.

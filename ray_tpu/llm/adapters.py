@@ -154,11 +154,24 @@ class AdapterCache:
     def __init__(self, *, n_layers: int, hidden: int, q_out: int, v_out: int,
                  rank: int, dtype, max_adapters: int,
                  budget_bytes: int = 0, cache_slots: Optional[int] = None,
-                 name: str = ""):
+                 name: str = "", mesh=None):
         import jax
         import jax.numpy as jnp
 
         self.name = name or f"adapters-{id(self):x}"
+        # Tensor-parallel engines shard the stacked tables WITH the model
+        # (docs/serving_tp.md): the B factors' output dims split like the
+        # projections they add into, so paging an adapter ships each device
+        # only its shard of the packed factors. mesh=None keeps the exact
+        # single-device layout.
+        self._mesh = mesh
+        self._blob_sharding = None
+        table_shardings = None
+        if mesh is not None:
+            from ray_tpu.llm.tp import adapter_table_shardings, replicated
+
+            table_shardings = adapter_table_shardings(mesh, q_out, v_out)
+            self._blob_sharding = replicated(mesh)
         self.n_layers = int(n_layers)
         self.hidden = int(hidden)
         self.q_out = int(q_out)
@@ -189,6 +202,11 @@ class AdapterCache:
             "v_B": jnp.zeros((self.n_layers, S, rb, v_out), dtype),
             "scale": jnp.zeros((self.n_layers, S), jnp.float32),
         }
+        if table_shardings is not None:
+            self._tables = {
+                k: jax.device_put(v, table_shardings[k])
+                for k, v in self._tables.items()
+            }
 
         # ONE install program for the cache's whole life: blob shapes are
         # fixed by construction and the slot index is a traced scalar, so
@@ -384,8 +402,14 @@ class AdapterCache:
         # ONE host->device staging of the packed factors, then the single
         # cached install program writes the slot row. Both dispatches are
         # async: the stepper never blocks here — a cold adapter costs queue
-        # latency while the copy lands, not a decode stall.
-        blob_dev = jax.device_put(entry.blob)
+        # latency while the copy lands, not a decode stall. On a TP mesh the
+        # blob replicates explicitly (a bare device_put would COMMIT it to
+        # one device, which cannot meet mesh-sharded tables inside the
+        # install program).
+        if self._blob_sharding is not None:
+            blob_dev = jax.device_put(entry.blob, self._blob_sharding)
+        else:
+            blob_dev = jax.device_put(entry.blob)
         self._tables = self._jit_install(
             self._tables, blob_dev, jnp.int32(slot)
         )

@@ -392,14 +392,21 @@ class DPRouter:
 
 def build_dp_openai_app(config: LLMConfig, *, dp_size: int = 2):
     """A data-parallel serving app: dp_size engine replicas + rank assigner
-    behind one cache-aware router (parity: build_dp_openai_app / DPServer)."""
+    behind one cache-aware router (parity: build_dp_openai_app / DPServer).
+
+    DP x TP composition (docs/serving_tp.md): with `config.tp > 1` every
+    replica is itself a mesh-sharded engine, and its per-replica accelerator
+    demand scales by the TP device count so the scheduler reserves each
+    replica's whole device gang atomically (cross-host gangs reserve through
+    `cluster_utils.reserve_tp_slice` placement groups)."""
     from ray_tpu import serve
+    from ray_tpu.llm import replica_resources
 
     assigner = ray_tpu.remote(num_cpus=0)(DPRankAssigner).options(
         name=f"DPRankAssigner-{config.model_id}", get_if_exists=True,
         namespace="llm_dp",
     ).remote(dp_size)
-    resources = config.accelerator_resources or {}
+    resources = replica_resources(config)
     server = serve.deployment(
         name=f"DPLLMServer-{config.model_id}",
         num_replicas=dp_size,

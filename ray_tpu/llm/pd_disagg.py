@@ -32,6 +32,7 @@ class PrefillServer:
             cfg, params, num_slots=1,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
             lora_config=config.lora_config, decode_loop=False,
+            tp=config.tp,
         )
 
     async def prefill(self, token_ids: List[int], lora: str = "") -> dict:
@@ -81,6 +82,7 @@ class DecodeServer:
             # decoding stays live: the draft catches up on the token history
             # instead of downgrading to plain decode (docs/scheduler.md).
             spec_config=config.spec_config,
+            tp=config.tp,
         )
 
     async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
@@ -106,8 +108,15 @@ class DecodeServer:
 
             to_device = jax.default_backend() != "cpu"
             kv_ref = kv
+            # TP decode engines hand the stream their kv-head sharding: each
+            # arriving shard stages straight onto ITS device (per-shard H2D),
+            # so a mesh-sharded prefix is never gathered whole anywhere —
+            # the no-gather-then-scatter half of the sharded PD handoff
+            # (docs/serving_tp.md; the prefill side streams per shard).
+            kv_sharding = self._engine.kv_transfer_sharding if to_device else None
             kv = await loop.run_in_executor(
-                None, lambda: dev_get(kv_ref, to_device=to_device)
+                None, lambda: dev_get(kv_ref, to_device=to_device,
+                                      sharding=kv_sharding)
             )
         done: asyncio.Future = loop.create_future()
         out: List[int] = []
@@ -229,10 +238,13 @@ def build_pd_openai_app(config: LLMConfig, *, num_prefill: int = 1,
                         num_decode: int = 1) -> "Any":
     """Disaggregated serving app (reference: build_pd_openai_app in
     prefill_decode_disagg.py): independent prefill and decode replica pools
-    behind one router."""
+    behind one router. With `config.tp > 1` both pools run mesh-sharded
+    engines and each replica's accelerator demand scales by the TP device
+    count (docs/serving_tp.md)."""
     from ray_tpu import serve
+    from ray_tpu.llm import replica_resources
 
-    resources = config.accelerator_resources or {}
+    resources = replica_resources(config)
     prefill = serve.deployment(
         name=f"Prefill-{config.model_id}",
         num_replicas=num_prefill,

@@ -40,6 +40,7 @@ LEAKSAN_SUITES = {
     "test_llm_kvcache.py",
     "test_llm_multitenant.py",
     "test_device_objects.py",
+    "test_llm_tp.py",
 }
 
 
@@ -97,3 +98,50 @@ def ray_start_cluster():
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1, "env_vars": _WORKER_ENV})
     yield cluster
     cluster.shutdown()
+
+
+# -- multi-device-on-CPU harness (docs/serving_tp.md) -------------------------
+# Mesh/TP tests need several XLA devices, which only exist if XLA_FLAGS was
+# set BEFORE jax initialized. This conftest forces it for in-process tests;
+# the subprocess harness below makes mesh tests robust even when the parent
+# interpreter's jax initialized under different flags (plugin sitecustomize,
+# a bare `pytest tests/test_llm_tp.py -p no:conftest`, an embedding harness),
+# so the tier-1 command exercises real meshes on any CPU-only CI box.
+
+def run_multi_device_subprocess(code: str, *, timeout: float = 600,
+                                env_extra: dict | None = None) -> dict:
+    """Run `code` in a fresh interpreter with the 8-virtual-device CPU env
+    forced. The snippet reports by printing one line `RESULT <json>`;
+    the parsed object is returned. Failure surfaces stdout+stderr."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(_WORKER_ENV)
+    if env_extra:
+        env.update(env_extra)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=repo_root,
+    )
+    assert proc.returncode == 0, (
+        f"multi-device subprocess failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout[-4000:]}\n"
+        f"--- stderr ---\n{proc.stderr[-4000:]}"
+    )
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(
+        f"multi-device subprocess printed no RESULT line:\n{proc.stdout[-2000:]}"
+    )
+
+
+@pytest.fixture(scope="session")
+def multi_device_run():
+    """The subprocess-spawned multi-device test group runner (TP mesh tests
+    ride it so CI without TPUs — or with a parent jax initialized under
+    different XLA flags — still runs them against a real 8-device mesh)."""
+    return run_multi_device_subprocess

@@ -1,0 +1,50 @@
+"""RL801 fixtures for the mesh-sharded KV pool (ShardedKVPool -> free), the
+round-15 RESOURCE_TABLE entry: the fire/suppress shapes mirror
+case_rl8_adapter.py so the new obligation rides the exact same path
+analysis. A TP replica that drops its pool without free() strands every
+shard's device buffer (docs/serving_tp.md)."""
+
+
+def bad_kv_pool_never_freed(cfg, mesh):
+    pool = ShardedKVPool(n_layers=cfg.n_layers, shape=(4, 64, 2, 16),
+                         dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)
+    return pool.take()
+
+
+def bad_kv_pool_conditional(cfg, mesh, flag):
+    pool = ShardedKVPool(n_layers=cfg.n_layers, shape=(4, 64, 2, 16),
+                         dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)
+    if flag:
+        pool.free()
+
+
+def bad_kv_pool_risky_gap(cfg, mesh, engine):
+    pool = ShardedKVPool(n_layers=cfg.n_layers, shape=(4, 64, 2, 16),
+                         dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)
+    engine.run(pool.take())
+    pool.free()
+
+
+def ok_kv_pool_finally(cfg, mesh, engine):
+    pool = ShardedKVPool(n_layers=cfg.n_layers, shape=(4, 64, 2, 16),
+                         dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)
+    try:
+        return engine.run(pool.take())
+    finally:
+        pool.free()
+
+
+def ok_kv_pool_stored(engine, cfg, mesh):
+    engine._kv_pool = ShardedKVPool(n_layers=cfg.n_layers,
+                                    shape=(4, 64, 2, 16), dtype=cfg.dtype,
+                                    mesh=mesh, n_kv_heads=2)
+
+
+def ok_kv_pool_returned(cfg, mesh):
+    return ShardedKVPool(n_layers=cfg.n_layers, shape=(4, 64, 2, 16),
+                         dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)
+
+
+def suppressed_kv_pool(cfg, mesh):
+    pool = ShardedKVPool(n_layers=2, shape=(4, 64, 2, 16), dtype=cfg.dtype, mesh=mesh, n_kv_heads=2)  # raylint: disable=RL801 (fixture: engine shutdown frees it)
+    return pool.take()

@@ -216,6 +216,19 @@ def test_rl801_gcs_repl_fires_and_suppresses():
         assert sym not in found, sym
 
 
+def test_rl801_kv_shard_pool_fires_and_suppresses():
+    """The round-15 RESOURCE_TABLE entry (ShardedKVPool -> free) flows
+    through the same RL801 path analysis: a TP replica retiring without
+    freeing its mesh-resident KV pool strands every shard's buffer."""
+    found = _codes_by_symbol(_fixture("case_rl8_tp.py"))
+    for sym in ("bad_kv_pool_never_freed", "bad_kv_pool_conditional",
+                "bad_kv_pool_risky_gap"):
+        assert found.get(sym) == {"RL801"}, sym
+    for sym in ("ok_kv_pool_finally", "ok_kv_pool_stored",
+                "ok_kv_pool_returned", "suppressed_kv_pool"):
+        assert sym not in found, sym
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}
