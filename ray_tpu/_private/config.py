@@ -104,6 +104,11 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_spec_store_entries": (int, 4096, "bounded LRU entries in the ngram draft's cross-request continuation store; repeated greedy traffic re-proposes earlier completions from it (0 disables the shared store, leaving prompt-lookup only)"),
     "llm_adapter_cache_bytes": (int, 0, "HBM byte budget for the engine's pageable LoRA adapter table (docs/multitenancy.md): device slots = budget // per-adapter slot bytes, registered-but-evicted adapters stay host-side and page back in on demand (one device_put per page-in, LRU eviction of unpinned adapters); 0 sizes the table to lora_config max_loras (every registered adapter resident, the pre-paging shape)"),
     "llm_tenant_max_queue_depth": (int, 64, "per-tenant admission quota on the engine's weighted-fair queues: one tenant's overload raises EngineOverloadedError for THAT tenant while other tenants keep flowing (0 disables the per-tenant quota, leaving only the global llm_max_queue_depth cap)"),
+    "llm_flight_records": (int, 256, "finished request records kept in each engine's flight-recorder ring (docs/observability.md): per-request phase events (queue/prefill-chunk/verify/decode/adapter/PD) recorded host-side off the dispatch path, flushed to metrics and trace spans only from stats()/report paths (0 disables the recorder)"),
+    "llm_slo_ttft_s": (float, 0.5, "time-to-first-token SLO: completions whose TTFT exceeds this count as SLO breaches in the llm_slo_* burn/goodput counters (docs/observability.md)"),
+    "llm_slo_tpot_s": (float, 0.05, "per-request mean inter-token-latency SLO: completions whose mean TPOT exceeds this count as SLO breaches (docs/observability.md)"),
+    "llm_slo_error_budget": (float, 0.01, "allowed SLO breach fraction: llm_slo_burn_rate = windowed breach fraction / this budget, so burn > 1 means the error budget is being exhausted"),
+    "metrics_series_ttl_s": (float, 300.0, "collect-time TTL for cluster metric series: entries whose reporting worker is gone (not the driver, no live actor) AND whose last flush is older than this are pruned from the GCS KV metrics namespace instead of living forever"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),
     "data_output_queue_size": (int, 8, "blocks buffered between the streaming executor and the consuming iterator (backpressure depth)"),

@@ -2792,11 +2792,22 @@ class CoreWorker:
         return getattr(instance, method_name)
 
     async def _execute_async_actor_task(self, spec):
+        from ray_tpu.util import tracing
+
         rt = self.actor_runtime
         group = rt.group_of(spec)
         sem = rt.group_semaphores.get(group, rt.semaphore)
         async with sem:
             method = self._resolve_actor_method(rt.instance, spec["method_name"])
+            # Trace-context parity with the sync executor path: each call runs
+            # inside its own asyncio.Task, so activating the caller's span here
+            # is Task-scoped (contextvars) and nested .remote() calls made by
+            # the async method continue ONE trace across processes — the serve
+            # proxy -> router -> replica chain is async actors end to end.
+            trace_token = tracing.activate(spec.get("trace_ctx"))
+            self._record_event(
+                task_id=spec["task_id"].hex(), name=spec["name"],
+                state="RUNNING", **tracing.event_fields(spec.get("trace_ctx")))
             # The sink outlives the materializer thread: refs the async method
             # keeps past completion ride the reply's sequenced handoff exactly
             # like sync tasks (packaging and handoff are synchronous sections
@@ -2825,6 +2836,7 @@ class CoreWorker:
                     results = []
                 else:
                     results = self._package_results(spec, result)
+                state = "FINISHED"
             except Exception as e:
                 if spec.get("num_returns") == "streaming":
                     await asyncio.get_running_loop().run_in_executor(
@@ -2833,7 +2845,12 @@ class CoreWorker:
                     results = []
                 else:
                     results = self._package_error(spec, e)
+                state = "FAILED"
             args = kwargs = result = None  # noqa: F841 — drop frame refs first
+            tracing.deactivate(trace_token)
+            self._record_event(
+                task_id=spec["task_id"].hex(), name=spec["name"], state=state,
+                **tracing.event_fields(spec.get("trace_ctx")))
             self.reference_counter.drain_deferred()
             self._reply_actor_result(spec, results, self._borrow_handoff(spec, sink))
 

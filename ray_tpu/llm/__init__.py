@@ -194,8 +194,10 @@ class LLMServer:
     async def generate(self, prompt: Union[str, List[int]], *,
                        max_tokens: int = 64, temperature: float = 0.0,
                        top_k: int = 0, stop_token_id: Optional[int] = None,
-                       lora: str = "", tenant: Optional[str] = None) -> dict:
+                       lora: str = "", tenant: Optional[str] = None,
+                       route: Optional[str] = None) -> dict:
         t0 = time.monotonic()
+        rid = uuid.uuid4().hex  # keys the engine's flight-recorder record
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -218,7 +220,7 @@ class LLMServer:
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
             cb,
-            lora=lora, tenant=tenant,
+            lora=lora, tenant=tenant, request_id=rid, route=route,
         )
         await done
         gen = list(out)
@@ -234,6 +236,9 @@ class LLMServer:
             },
             "ttft_s": ttft[0],
             "latency_s": time.monotonic() - t0,
+            # Flight-recorder phase breakdown (docs/observability.md):
+            # queue/prefill/decode seconds, TTFT/TPOT, routing reason.
+            "timing": self._engine.request_timing(rid),
         }
 
     async def generate_stream(self, prompt: Union[str, List[int]], *,
@@ -306,6 +311,12 @@ class LLMServer:
         the DP router's residency-affinity path keys on. See
         docs/multitenancy.md."""
         return self._engine.adapter_stats()
+
+    async def recorder_stats(self) -> dict:
+        """Flight-recorder counters for this replica's engine; the call is
+        the report path that flushes pending SLO metrics and trace spans
+        (docs/observability.md)."""
+        return self._engine.recorder_stats()
 
     async def shutdown(self):
         """Explicit retirement hook (the serve controller calls it, bounded,

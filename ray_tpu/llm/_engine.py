@@ -35,6 +35,7 @@ from ray_tpu.llm.adapters import (
     AdapterCache,
     UnknownAdapterError,
 )
+from ray_tpu.llm.flight_recorder import FlightRecorder, ServeMetrics
 from ray_tpu.llm.scheduler.scheduler import (
     EngineOverloadedError,
     Plan,
@@ -394,6 +395,12 @@ class DecodeEngine:
             wfq=wfq, tenant_weights=tenant_weights, tenant_quota=tenant_quota,
             adapter_acquire=adapter_acquire, adapter_resident=adapter_resident,
         )
+        # Request-lifecycle flight recorder + per-tenant SLO metrics
+        # (docs/observability.md): phase events accrue host-side off the
+        # dispatch path; metric/span export happens ONLY from the
+        # scheduler_stats()/recorder_stats() report paths.
+        self._recorder = FlightRecorder(name=f"engine-{id(self):x}")
+        self._serve_metrics = ServeMetrics(name=f"{id(self):x}")
         # Diagnostics for benches/tests: shape of the most recent prefill
         # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only).
         self.last_prefill: Optional[dict] = None
@@ -646,6 +653,7 @@ class DecodeEngine:
             tokens[i, 1:1 + len(p)] = p
             gate[i] = True
             base_lens[i] = s.host_len
+        t_verify = time.time()
         verify = self._program(
             self._jit_spec_verify, ("verify", S),
             lambda: jax.jit(self._spec_verify_batched),
@@ -676,6 +684,9 @@ class DecodeEngine:
             draft.on_accept(i, s, l, p, m)
             round_proposed += len(p)
             round_accepted += m
+            if s.rec is not None:
+                s.rec.span("spec-verify", t_verify, time.time(),
+                           proposed=len(p), accepted=m)
             for token in emitted:
                 if not s.active:
                     break
@@ -728,7 +739,9 @@ class DecodeEngine:
     def scheduler_stats(self) -> dict:
         """Iteration-level scheduler occupancy (per-phase token counters,
         interleaving, queue depths) plus speculative-decoding acceptance.
-        See docs/scheduler.md."""
+        See docs/scheduler.md. This is a REPORT path: the flight recorder's
+        pending completions flush to the SLO metrics plane and trace export
+        here (never from the dispatch loop)."""
         out = self._sched.stats()
         if self._adapters is not None:
             out["adapters"] = self._adapters.stats()
@@ -739,7 +752,44 @@ class DecodeEngine:
             )
             spec["draft"] = self._draft.stats()
             out["spec"] = spec
+        out["recorder"] = self._flush_observability()
         return out
+
+    def _flush_observability(self) -> dict:
+        """Report-path export: queued completion summaries become
+        Histogram/Counter observations and traced records become synthetic
+        task events for timeline()/OTel (docs/observability.md)."""
+        self._serve_metrics.flush()
+        self._recorder.flush_task_events()
+        return self._recorder.stats()
+
+    def recorder_stats(self) -> dict:
+        """Flight-recorder counters; calling this (or scheduler_stats) is
+        what flushes pending metrics/spans — the report-path contract."""
+        return self._flush_observability()
+
+    def request_timing(self, rid: str) -> Optional[dict]:
+        """Per-request timing breakdown (the response-metadata payload):
+        queue/prefill/decode phase durations, TTFT, mean TPOT, e2e, routing
+        reason — from the flight recorder's ring."""
+        summary = self._recorder.lookup(rid)
+        if summary is None:
+            return None
+        return {
+            "request_id": summary["rid"],
+            "queue_s": summary["queue_s"],
+            "ttft_s": summary["ttft_s"],
+            "tpot_s": summary["tpot_s"],
+            "e2e_s": summary["e2e_s"],
+            "tokens": summary["tokens"],
+            "route": summary["route"],
+            "phases": {
+                name: {"count": p["count"],
+                       "seconds": round(p["seconds"], 6)}
+                for name, p in summary["phases"].items()
+            },
+            "trace_id": summary["trace_id"],
+        }
 
     def _attach_kv(self, caches, kv, slot):
         """Write a transferred KV prefix into slot's cache rows [0, P).
@@ -757,13 +807,17 @@ class DecodeEngine:
 
     # -- public API --------------------------------------------------------
     def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
-               lora: str = "", tenant: Optional[str] = None):
+               lora: str = "", tenant: Optional[str] = None,
+               request_id: Optional[str] = None, route: Optional[str] = None):
         """callback(token_id: int, finished: bool) per generated token.
 
         tenant keys the weighted-fair admission queue (docs/multitenancy.md);
         it defaults to the adapter name, the natural tenant identity of a
-        LoRA fleet. Raises ValueError when the prompt cannot fit the
-        engine's sequence budget (it is never silently truncated),
+        LoRA fleet. request_id keys the flight-recorder record (the serve
+        layers pass theirs so `request_timing()` can surface the breakdown
+        in response metadata); route is the DP router's routing reason,
+        recorded for the trace. Raises ValueError when the prompt cannot fit
+        the engine's sequence budget (it is never silently truncated),
         UnknownAdapterError for an unregistered adapter,
         EngineOverloadedError when the tenant's quota or the global depth
         cap is hit, and RuntimeError when the stepper is dead (shut down or
@@ -785,16 +839,44 @@ class DecodeEngine:
         headroom = self.T - 1 - len(token_ids)
         if sampling.max_tokens > headroom:
             sampling = dataclasses.replace(sampling, max_tokens=max(1, headroom))
-        self._sched.submit(Request(
+        tenant = lora if tenant is None else tenant
+        req = Request(
             "prompt", prompt=token_ids, sampling=sampling, callback=callback,
-            adapter=adapter, tenant=lora if tenant is None else tenant,
-        ))
+            adapter=adapter, tenant=tenant,
+        )
+        req.rec = self._start_record(request_id, tenant, route,
+                                     prompt_len=len(token_ids))
+        try:
+            self._sched.submit(req)
+        except EngineOverloadedError:
+            summary = self._recorder.finish(req.rec, status="rejected")
+            if summary is not None:
+                self._serve_metrics.record(summary)
+            raise
+
+    def _start_record(self, request_id: Optional[str], tenant: str,
+                      route: Optional[str] = None, **mark_attrs):
+        """Open a flight-recorder record for one admission. The trace
+        context is captured from the SUBMITTING thread (the serve task's
+        activated span), because the stepper thread that executes the
+        request has no ambient context of its own."""
+        from ray_tpu.util import tracing
+
+        rec = self._recorder.start(
+            request_id, trace=tracing.current(), tenant=tenant, route=route,
+        )
+        if rec is not None:
+            rec.mark("queued", tenant=tenant,
+                     depth=self._sched.queue_depth(), **mark_attrs)
+        return rec
 
     def submit_prefilled(self, kv, prompt_len: int,
                          first_logits: np.ndarray, sampling: SamplingParams,
                          callback, lora: str = "",
                          token_ids: Optional[List[int]] = None,
-                         tenant: Optional[str] = None):
+                         tenant: Optional[str] = None,
+                         request_id: Optional[str] = None,
+                         transfer_s: Optional[float] = None):
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
         transferred cache prefix — host numpy, or a jax Array when the
@@ -817,15 +899,32 @@ class DecodeEngine:
         headroom = self.T - 1 - prompt_len
         if sampling.max_tokens > headroom:
             sampling = dataclasses.replace(sampling, max_tokens=max(1, headroom))
-        self._sched.submit(Request(
+        tenant = lora if tenant is None else tenant
+        req = Request(
             "prefilled",
             prompt=None if token_ids is None else list(token_ids),
             prompt_len=int(prompt_len), sampling=sampling, callback=callback,
             adapter=adapter, kv=kv, first_logits=first_logits,
-            tenant=lora if tenant is None else tenant,
-        ))
+            tenant=tenant,
+        )
+        req.rec = self._start_record(request_id, tenant,
+                                     prompt_len=int(prompt_len))
+        if req.rec is not None and transfer_s is not None:
+            # The PD KV pull the decode server timed around the stream read.
+            t1 = time.time()
+            req.rec.span("pd-transfer", t1 - transfer_s, t1,
+                         prompt_len=int(prompt_len))
+        try:
+            self._sched.submit(req)
+        except EngineOverloadedError:
+            summary = self._recorder.finish(req.rec, status="rejected")
+            if summary is not None:
+                self._serve_metrics.record(summary)
+            raise
 
-    def prefill_detached(self, token_ids: List[int], lora: str = ""):
+    def prefill_detached(self, token_ids: List[int], lora: str = "",
+                         request_id: Optional[str] = None,
+                         trace_ctx: Optional[dict] = None):
         """Prefill WITHOUT occupying a decode slot: returns
         (first_logits [V], kv [L, 2, P, Hkv, D], prompt_len) for transfer to a
         decode engine. P is a padded length >= prompt_len. Prompts that do not
@@ -845,9 +944,25 @@ class DecodeEngine:
                 f"truncate the prompt client-side or raise max_seq"
             )
         adapter = self._adapter_index(lora)  # stable uid: the cache namespace
+        # Prefill-side flight record: callers dispatching from an executor
+        # thread (PrefillServer) pass trace_ctx explicitly — contextvars do
+        # not cross run_in_executor, so tracing.current() would be None here.
+        from ray_tpu.util import tracing
+
+        rec = self._recorder.start(
+            request_id, trace=trace_ctx or tracing.current(), tenant=lora,
+        )
+        t_pf0 = time.time()
         handle = None
         if self._adapters is not None and adapter:
-            handle = self._adapters.acquire(adapter)
+            resident = self._adapters.is_resident(adapter)
+            try:
+                handle = self._adapters.acquire(adapter)
+            except BaseException:
+                self._recorder.drop(rec)  # fully-pinned cache: books balance
+                raise
+            if rec is not None and not resident:
+                rec.mark("adapter-page-in", adapter=adapter)
         try:
             adapter_slot = 0 if handle is None else handle.slot
             lease = None
@@ -915,12 +1030,23 @@ class DecodeEngine:
                     # exactly the gather-then-scatter the sharded plane
                     # exists to avoid (docs/serving_tp.md).
                     kv = kv_dev
+        except BaseException:
+            # Books balance on the poisoned-pool / failed-dispatch paths too:
+            # the record retires as dropped instead of living forever.
+            self._recorder.drop(rec)
+            raise
         finally:
             if handle is not None:
                 handle.release()
         self.last_prefill = {
             "offset": m, "prompt_len": len(prompt), "detached": True,
         }
+        if rec is not None:
+            rec.span("prefill-detached", t_pf0, time.time(),
+                     prompt_len=len(prompt), cached_tokens=m)
+            # Prefill-only records carry no generated tokens, so they feed
+            # the ring/trace export but NOT the TTFT/TPOT SLO metrics.
+            self._recorder.finish(rec)
         if self._prefix_cache is not None:
             bs = self._prefix_cache.block_size
             n = (len(prompt) // bs) * bs
@@ -1035,6 +1161,10 @@ class DecodeEngine:
                     req.callback(-1, True)
                 except Exception:
                     pass  # shutdown must proceed past a broken callback
+        # Every live flight record retires (status "dropped"): ring buffers
+        # and span handles balance on engine shutdown by construction —
+        # leaksan's flight_record books prove it.
+        self._recorder.close()
         self._release_mesh_state()
 
     def _release_mesh_state(self):
@@ -1102,9 +1232,11 @@ class DecodeEngine:
         if req.kind == "prefilled":
             self._exec_attach(req)
             return
+        rec = req.rec
         slot = req.slot
         offset = req.prefilled
         if chunk.is_first and req.lease is not None:
+            t_attach = time.time()
             # Attach the cached prefix through the padded-bucket attach
             # path, then prefill only the suffix (in chunks). The lease
             # pins the blocks until the host->device copy is staged; it
@@ -1131,6 +1263,12 @@ class DecodeEngine:
             finally:
                 req.lease.release()
                 req.lease = None
+            if rec is not None:
+                # Host-stamped dispatch span (the copy is staged async; a
+                # blocking wait here would be the RL603 sync jaxlint bans).
+                rec.span("cache-attach", t_attach, time.time(),
+                         cached_tokens=req.cached_offset)
+        t_chunk = time.time()
         padded = np.zeros((1, chunk.bucket), np.int32)
         padded[0, : len(chunk.tokens)] = chunk.tokens
         prefill = self._program(
@@ -1142,6 +1280,10 @@ class DecodeEngine:
             jnp.int32(req.prompt_len), jnp.int32(req.adapter_slot),
         )
         self._sched.chunk_done(chunk)
+        if rec is not None:
+            rec.span("prefill-chunk", t_chunk, time.time(),
+                     bucket=chunk.bucket, offset=offset,
+                     tokens=len(chunk.tokens), chunk=req.chunks - 1)
         # The host lens mirror advances with EVERY chunk (not just the last):
         # the decode write gate is the primary guard against interleaved
         # dispatches touching a mid-prefill slot, and an accurate lens is the
@@ -1178,6 +1320,7 @@ class DecodeEngine:
         the attach program consumes it without a host round-trip."""
         slot = req.slot
         kv = req.kv
+        t_attach = time.time()
         on_device = isinstance(kv, jax.Array)
         if on_device and self._mesh is not None:
             # Normalize a transferred device prefix onto THIS engine's mesh
@@ -1207,6 +1350,10 @@ class DecodeEngine:
             self._caches, kv if on_device else jnp.asarray(kv), jnp.int32(slot)
         )
         self._lens[slot] = prompt_len
+        if req.rec is not None:
+            req.rec.span("pd-attach", t_attach, time.time(),
+                         prompt_len=prompt_len, bucket=bucket,
+                         on_device=on_device)
         first = _sample_host(np.asarray(req.first_logits), req.sampling,
                              self._np_rng)
         prompt_tokens = req.prompt
@@ -1246,6 +1393,22 @@ class DecodeEngine:
         self._last_token[slot] = first
         self._emit(slot, first)
 
+    def _finish_record(self, s):
+        """Retire a slot's flight record exactly once: the decode phase
+        aggregates into ONE span (first..last token — the per-token record
+        is the timestamp list, not n events) and the completion summary
+        queues for the report-path metrics flush (a GCS RPC must never ride
+        this loop)."""
+        rec, s.rec = s.rec, None
+        if rec is None:
+            return
+        tt = rec.token_times
+        if tt:
+            rec.span("decode", tt[0], tt[-1], tokens=len(tt))
+        summary = self._recorder.finish(rec)
+        if summary is not None:
+            self._serve_metrics.record(summary)
+
     def _emit(self, slot: int, token: int):
         s = self._sched.slots[slot]
         done = (
@@ -1253,10 +1416,19 @@ class DecodeEngine:
             or (s.params.stop_token_id is not None and token == s.params.stop_token_id)
         )
         self._sched.note_emitted(slot)  # per-tenant decode-token metering
+        if s.rec is not None:
+            s.rec.token()  # host timestamp append; TTFT/TPOT derive from these
+            if done:
+                # Retire the record BEFORE the callback observes
+                # finished=True: a caller reading request_timing() the
+                # moment its future resolves must see the finished summary,
+                # not a mid-flight record missing the decode span.
+                self._finish_record(s)
         try:
             s.callback(token, done)
         except Exception:
             done = True
+            self._finish_record(s)  # callback-abort path: books still balance
         if done:
             s.active = False
             self._release_slot_pin(s)
@@ -1297,6 +1469,7 @@ class DecodeEngine:
                         req.callback(-1, True)
                     except Exception:
                         pass
+            self._recorder.close()  # stepper death strands no live records
 
     def _loop_inner(self):
         """Execute one scheduler plan per iteration: prefill chunks, then

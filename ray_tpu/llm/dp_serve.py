@@ -110,6 +110,13 @@ class DPLLMServer(LLMServer):
         stats = await super().adapter_stats()
         return {"dp_rank": self.dp_rank, **(stats or {})}
 
+    async def recorder_stats(self) -> dict:
+        """Flight-recorder counters, rank-tagged; calling it flushes this
+        rank's pending SLO metrics and trace spans
+        (docs/observability.md)."""
+        stats = await super().recorder_stats()
+        return {"dp_rank": self.dp_rank, **stats}
+
     def _release_rank(self):
         """Idempotent: hand the dp rank back to the assigner exactly once
         (double release would free a rank a LIVE successor already claimed).
@@ -315,8 +322,12 @@ class DPRouter:
         self._routing[mode] += 1
         self._record(replica._actor_id, chain, adapter)
         # Router-side tokenization rides along: replicas accept token lists.
+        # The routing reason rides too — the replica's flight recorder stamps
+        # it into the request's trace and timing breakdown.
+        kw = dict(kw)
+        kw.setdefault("route", mode)
         args = (token_ids,) if token_ids is not None else (prompt,)
-        return await self._submit(router, replica, args, dict(kw))
+        return await self._submit(router, replica, args, kw)
 
     async def ranks(self) -> dict:
         return await asyncio.get_running_loop().run_in_executor(
@@ -371,6 +382,15 @@ class DPRouter:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, lambda: self._server.adapter_stats.broadcast()
+        )
+
+    async def recorder_stats(self) -> List[dict]:
+        """Rank-tagged flight-recorder stats from EVERY replica; the
+        broadcast is the fleet-wide report path that flushes each rank's
+        pending SLO metrics and trace spans (docs/observability.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._server.recorder_stats.broadcast()
         )
 
     async def __call__(self, request) -> dict:

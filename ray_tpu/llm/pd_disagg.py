@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+import uuid
 from typing import Any, List, Optional, Union
 
 from ray_tpu.llm import ByteTokenizer, LLMConfig, SamplingParams, load_model, resolve_tokenizer
@@ -35,10 +36,18 @@ class PrefillServer:
             tp=config.tp,
         )
 
-    async def prefill(self, token_ids: List[int], lora: str = "") -> dict:
+    async def prefill(self, token_ids: List[int], lora: str = "",
+                      request_id: Optional[str] = None) -> dict:
+        # The trace context is captured HERE (the activated task span) and
+        # passed explicitly: prefill_detached runs on an executor thread,
+        # where contextvars from this coroutine do not follow.
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.current()
         loop = asyncio.get_running_loop()
         first_logits, kv, prompt_len = await loop.run_in_executor(
-            None, lambda: self._engine.prefill_detached(token_ids, lora)
+            None, lambda: self._engine.prefill_detached(
+                token_ids, lora, request_id=request_id, trace_ctx=trace_ctx)
         )
         # The KV prefix stays pinned HERE as a refcounted device object; only
         # its tiny descriptor rides through the router. The decode replica
@@ -56,6 +65,11 @@ class PrefillServer:
 
     async def cache_stats(self) -> Optional[dict]:
         return self._engine.prefix_cache_stats()
+
+    async def recorder_stats(self) -> dict:
+        """Prefill-side flight-recorder report path: flushes this engine's
+        pending trace spans (docs/observability.md)."""
+        return self._engine.recorder_stats()
 
     async def shutdown(self):
         """Explicit retirement hook for the serve controller's retire path."""
@@ -89,10 +103,12 @@ class DecodeServer:
                                  max_tokens: int = 64, temperature: float = 0.0,
                                  top_k: int = 0, stop_token_id: Optional[int] = None,
                                  lora: str = "",
-                                 token_ids: Optional[List[int]] = None) -> dict:
+                                 token_ids: Optional[List[int]] = None,
+                                 request_id: Optional[str] = None) -> dict:
         loop = asyncio.get_running_loop()
         from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
 
+        transfer_s = None
         if isinstance(kv, DeviceObjectRef):
             # Pull the KV prefix peer-to-peer from the prefill replica over
             # the chunked DeviceChannel stream. On real accelerators each
@@ -114,10 +130,12 @@ class DecodeServer:
             # the no-gather-then-scatter half of the sharded PD handoff
             # (docs/serving_tp.md; the prefill side streams per shard).
             kv_sharding = self._engine.kv_transfer_sharding if to_device else None
+            t_pull = time.monotonic()
             kv = await loop.run_in_executor(
                 None, lambda: dev_get(kv_ref, to_device=to_device,
                                       sharding=kv_sharding)
             )
+            transfer_s = time.monotonic() - t_pull  # the PD KV handoff leg
         done: asyncio.Future = loop.create_future()
         out: List[int] = []
 
@@ -128,17 +146,20 @@ class DecodeServer:
                     lambda: done.set_result(None) if not done.done() else None
                 )
 
+        rid = request_id or uuid.uuid4().hex
         self._engine.submit_prefilled(
             kv, prompt_len, first_logits,
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
             cb, lora=lora, token_ids=token_ids,
+            request_id=rid, transfer_s=transfer_s,
         )
         await done
         gen = list(out)
         if stop_token_id is not None and gen and gen[-1] == stop_token_id:
             gen = gen[:-1]
-        return {"token_ids": gen, "text": self._tokenizer.decode(gen)}
+        return {"token_ids": gen, "text": self._tokenizer.decode(gen),
+                "timing": self._engine.request_timing(rid)}
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
@@ -148,6 +169,11 @@ class DecodeServer:
 
     async def scheduler_stats(self) -> dict:
         return self._engine.scheduler_stats()
+
+    async def recorder_stats(self) -> dict:
+        """Decode-side flight-recorder report path: flushes pending SLO
+        metrics and trace spans (docs/observability.md)."""
+        return self._engine.recorder_stats()
 
     async def shutdown(self):
         """Explicit retirement hook: stops the stepper and fails queued
@@ -176,10 +202,15 @@ class PDRouter:
                        top_k: int = 0, stop_token_id: Optional[int] = None,
                        lora: str = "") -> dict:
         t0 = time.monotonic()
+        # One request id spans both phases: the prefill-side and decode-side
+        # flight records share it (and the caller's trace), so a PD request
+        # renders as one span tree across the two replica processes.
+        rid = uuid.uuid4().hex
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
-        pre = await self._prefill.prefill.remote(token_ids, lora)
+        pre = await self._prefill.prefill.remote(token_ids, lora,
+                                                 request_id=rid)
         t_prefill = time.monotonic() - t0
         result = await self._decode.generate_prefilled.remote(
             pre["kv"], pre["prompt_len"], pre["first_logits"],
@@ -187,7 +218,7 @@ class PDRouter:
             stop_token_id=stop_token_id, lora=lora,
             # The prompt rides along so the decode engine can feed its prefix
             # cache with the transferred rows (docs/kvcache.md).
-            token_ids=token_ids,
+            token_ids=token_ids, request_id=rid,
         )
         return {
             **result,
@@ -217,6 +248,26 @@ class PDRouter:
         except KeyError as e:
             return {"error": {"message": f"unknown lora adapter {e}",
                               "type": "invalid_request_error"}}
+
+    async def recorder_stats(self) -> dict:
+        """Flight-recorder stats from BOTH phases' replica pools; the
+        broadcast is the report path that flushes each engine's pending
+        trace spans and SLO metrics (docs/observability.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            lambda: {
+                "prefill": self._prefill.recorder_stats.broadcast(),
+                "decode": self._decode.recorder_stats.broadcast(),
+            },
+        )
+
+    async def scheduler_stats(self) -> dict:
+        """Decode-pool scheduler stats (the phase that owns slots/queues)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: {"decode": self._decode.scheduler_stats.broadcast()}
+        )
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         """Install an adapter on EVERY replica of both phases (they must agree on

@@ -58,7 +58,7 @@ class Slot:
 
     __slots__ = ("active", "generated", "params", "callback", "prompt_len",
                  "tokens", "host_len", "adapter", "history", "tenant",
-                 "adapter_handle")
+                 "adapter_handle", "rec")
 
     def __init__(self):
         self.active = False
@@ -71,6 +71,7 @@ class Slot:
         self.adapter = 0   # stable adapter uid (kvcache namespace, metering)
         self.tenant = ""
         self.adapter_handle = None  # pin released when the slot finishes
+        self.rec = None    # flight-recorder RequestRecord (host-side only)
         # prompt + generated tokens: the draft providers' lookup corpus
         self.history: List[int] = []
 
@@ -92,7 +93,7 @@ class Request:
     __slots__ = ("kind", "prompt", "sampling", "callback", "adapter",
                  "prompt_len", "prefilled", "slot", "lease", "cached_offset",
                  "kv", "first_logits", "chunks", "tenant", "adapter_slot",
-                 "adapter_handle", "seq")
+                 "adapter_handle", "seq", "rec")
 
     def __init__(self, kind: str, *, prompt: Optional[List[int]] = None,
                  sampling=None, callback=None, adapter: int = 0,
@@ -116,6 +117,7 @@ class Request:
         self.adapter_slot = 0       # device-table row (pinned at admission)
         self.adapter_handle = None
         self.seq = 0                # arrival order (the FIFO control's key)
+        self.rec = None             # flight-recorder RequestRecord (or None)
 
 
 class ScheduledChunk:
@@ -446,6 +448,8 @@ class Scheduler:
             if req is None:
                 break
             if req.adapter and self._adapter_acquire is not None:
+                resident = (self._adapter_resident is None
+                            or self._adapter_resident(req.adapter))
                 handle = self._adapter_acquire(req.adapter)
                 if handle is None:
                     with self._lock:
@@ -454,6 +458,10 @@ class Scheduler:
                     continue
                 req.adapter_handle = handle
                 req.adapter_slot = handle.slot
+                if req.rec is not None and not resident:
+                    # Cold adapter paged in at admission (docs/multitenancy.md)
+                    req.rec.mark("adapter-page-in", adapter=req.adapter,
+                                 adapter_slot=handle.slot)
             with self._lock:
                 self._charge_locked(req)
             req.slot = free.pop(0)
@@ -463,6 +471,10 @@ class Scheduler:
                     req.lease = lease
                     req.cached_offset = lease.matched_tokens
                     req.prefilled = lease.matched_tokens
+            if req.rec is not None:
+                # Queue phase ends here: slot assigned, cache lease resolved.
+                req.rec.mark("admitted", slot=req.slot,
+                             cached_tokens=req.cached_offset)
             self._prefilling.append(req)
             admitted += 1
         if admitted:
@@ -617,6 +629,7 @@ class Scheduler:
         s.adapter = req.adapter
         s.tenant = req.tenant
         s.adapter_handle, req.adapter_handle = req.adapter_handle, None
+        s.rec = req.rec  # the decode phase records against the slot
         s.tokens = [first_token]
         s.history = list(req.prompt) + [first_token]
         if req in self._prefilling:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 import traceback
 from typing import Dict, Optional
 from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE, Request
@@ -154,7 +155,10 @@ class HTTPProxy:
 
         loop = asyncio.get_running_loop()
         gen = await loop.run_in_executor(
-            None, lambda: self._handles[app].options(stream=True).remote(request)
+            None, lambda: self._traced_call(
+                f"http:{request.path}",
+                lambda: self._handles[app].options(stream=True).remote(request),
+            )
         )
         ctype = "text/plain"
         try:
@@ -189,6 +193,38 @@ class HTTPProxy:
             # status line onto a half-streamed body.
             gen.close()
 
+    @staticmethod
+    def _traced_call(name: str, fn):
+        """Run fn under a fresh root span when tracing is on: the whole
+        downstream serve chain (router -> replica -> engine phases) then
+        shares ONE trace_id, and the HTTP span itself is recorded as a
+        synthetic task-event pair so the proxy process appears in the
+        timeline()/OTel span tree (docs/observability.md)."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return fn()
+        with tracing.trace(name) as root:
+            t0 = time.time()
+            try:
+                return fn()
+            finally:
+                try:
+                    import ray_tpu
+
+                    worker = ray_tpu.global_worker()
+                    base = {
+                        "task_id": f"http-{root['span_id']}", "name": name,
+                        "trace_id": root["trace_id"],
+                        "span_id": root["span_id"],
+                    }
+                    worker._record_event(state="RUNNING", **base)
+                    with worker._events_lock:
+                        worker._task_events[-1]["time"] = t0
+                    worker._record_event(state="FINISHED", **base)
+                except Exception:
+                    pass  # observability must never break the request path
+
     async def _dispatch(self, request: Request):
         app = self._match_app(request.path)
         if app is None:
@@ -199,7 +235,10 @@ class HTTPProxy:
         # RPCs (and can wait for replicas after a redeploy), which must not stall
         # other in-flight HTTP connections.
         result = await loop.run_in_executor(
-            None, lambda: handle.remote(request).result(timeout_s=60)
+            None, lambda: self._traced_call(
+                f"http:{request.path}",
+                lambda: handle.remote(request).result(timeout_s=60),
+            )
         )
         if isinstance(result, bytes):
             return 200, result, "application/octet-stream"

@@ -16,6 +16,15 @@ from typing import Dict, List, Optional, Tuple
 _FLUSH_INTERVAL_S = 2.0
 _NAMESPACE = "metrics"
 
+#: Default Histogram boundaries: a log-spaced latency scale (1 ms to 10 min).
+#: The old default ([0.1, 1, 10, 100, 1000]) put every sub-second serving
+#: latency in the first bucket — useless for TTFT/TPOT SLOs. Explicit
+#: `boundaries=` always overrides.
+LATENCY_BUCKETS_S = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 180.0, 600.0,
+]
+
 
 def _worker():
     import ray_tpu
@@ -91,7 +100,9 @@ class Histogram(Metric):
                  boundaries: Optional[List[float]] = None,
                  tag_keys: Optional[Tuple[str, ...]] = None):
         super().__init__(name, description, tag_keys)
-        self._boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
+        self._boundaries = sorted(
+            LATENCY_BUCKETS_S if boundaries is None else boundaries
+        )
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         base = dict(self._key(tags))
@@ -107,15 +118,62 @@ class Histogram(Metric):
         self._maybe_flush()
 
 
-def collect_all() -> List[dict]:
-    """All flushed metric payloads across the cluster (driver-side)."""
+def _live_worker_hexes() -> set:
+    """Worker ids provably alive right now: this driver plus every actor the
+    GCS does not list as DEAD (PENDING counts as live — a loading replica's
+    metrics must not be reaped). Plain pooled task workers are not in the
+    actor table, so liveness alone never prunes them — the TTL does."""
+    alive = set()
     worker = _worker()
+    alive.add(worker.worker_id.hex())
+    try:
+        for a in worker.gcs_call("list_actors"):
+            if a.get("state") == "DEAD":
+                continue
+            wid = (a.get("address") or {}).get("worker_id")
+            if wid is not None:
+                alive.add(wid.hex() if hasattr(wid, "hex") else str(wid))
+    except Exception:
+        return alive
+    return alive
+
+
+def collect_all(*, prune: bool = True,
+                ttl_s: Optional[float] = None) -> List[dict]:
+    """All flushed metric payloads across the cluster (driver-side).
+
+    Dead-series pruning: a payload whose reporting worker is GONE (not this
+    driver, no live actor holds its worker id) and whose last flush is older
+    than `ttl_s` (default `metrics_series_ttl_s`) is DELETED from the GCS KV
+    namespace — without this, every killed replica's gauges live in the
+    control plane forever. Live workers' series survive regardless of
+    staleness (a quiet counter is not a dead one); `prune=False` restores
+    the raw listing."""
+    worker = _worker()
+    if ttl_s is None:
+        from ray_tpu._private.config import CONFIG
+
+        ttl_s = CONFIG.metrics_series_ttl_s
     keys = worker.gcs_call("kv_keys", _NAMESPACE, b"")
+    alive = _live_worker_hexes() if prune else set()
+    now = time.time()
     out = []
     for key in keys:
         raw = worker.gcs_call("kv_get", _NAMESPACE, key)
-        if raw:
-            out.append(json.loads(raw))
+        if not raw:
+            continue
+        payload = json.loads(raw)
+        if prune:
+            key_str = key.decode() if isinstance(key, bytes) else str(key)
+            worker_hex = key_str.rsplit(":", 1)[-1]
+            stale = now - float(payload.get("ts", 0.0)) > ttl_s
+            if stale and worker_hex not in alive:
+                try:
+                    worker.gcs_call("kv_del", _NAMESPACE, key)
+                except Exception:
+                    pass  # best-effort reaping; the entry stays listed-out
+                continue
+        out.append(payload)
     return out
 
 

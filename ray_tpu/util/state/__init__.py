@@ -291,6 +291,61 @@ def control_plane_stats() -> Dict[str, Any]:
     return stats
 
 
+def serve_stats(timeout_s: float = 30.0) -> Dict[str, Any]:
+    """ONE operator snapshot of the whole serve plane (docs/observability.md).
+
+    Aggregates the stats surfaces that previously required five separate
+    calls — per-app `scheduler_stats()` / `adapter_stats()` /
+    `routing_stats()` / `cache_stats()` / `recorder_stats()` from the
+    ingress deployments, the process-local transport counters
+    (`transport_stats()`), and the GCS `control_plane_stats()` — into one
+    dict keyed by app. Best-effort per surface: an app whose ingress lacks a
+    given stats method simply omits that key (an OpenAI router in front of
+    plain LLMServers exposes fewer surfaces than a DPRouter), and a briefly
+    unreachable surface records its error string instead of failing the
+    snapshot. Calling it is a REPORT path: each engine's pending SLO metrics
+    and trace spans flush as a side effect of `recorder_stats()` /
+    `scheduler_stats()`."""
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    out: Dict[str, Any] = {"apps": {}}
+    try:
+        apps = serve.status()
+    except Exception as e:
+        apps = {}
+        out["error"] = str(e)
+    surfaces = ("scheduler_stats", "adapter_stats", "routing_stats",
+                "cache_stats", "recorder_stats")
+    for app, meta in apps.items():
+        ingress = (meta or {}).get("ingress")
+        if not ingress:
+            continue
+        app_stats: Dict[str, Any] = {"ingress": ingress}
+        try:
+            handle = DeploymentHandle(app, ingress)
+            for surface in surfaces:
+                try:
+                    app_stats[surface] = getattr(handle, surface).remote(
+                    ).result(timeout_s=timeout_s)
+                except Exception:
+                    continue  # ingress doesn't expose this surface
+        except Exception as e:
+            app_stats["error"] = str(e)
+        out["apps"][app] = app_stats
+    try:
+        from ray_tpu.experimental.tensor_transport import transport_stats
+
+        out["transport"] = transport_stats()
+    except Exception as e:
+        out["transport"] = {"error": str(e)}
+    try:
+        out["control_plane"] = control_plane_stats()
+    except Exception as e:
+        out["control_plane"] = {"error": str(e)}
+    return out
+
+
 def cluster_summary() -> Dict[str, Any]:
     nodes = list_nodes()
     return {
@@ -317,6 +372,7 @@ __all__ = [
     "list_placement_groups",
     "list_tasks",
     "memory_summary",
+    "serve_stats",
     "summarize_actors",
     "summarize_tasks",
     "timeline",

@@ -41,6 +41,7 @@ LEAKSAN_SUITES = {
     "test_llm_multitenant.py",
     "test_device_objects.py",
     "test_llm_tp.py",
+    "test_flight_recorder.py",
 }
 
 

@@ -192,6 +192,10 @@ def test_decode_loop_is_host_native_one_pull_per_dispatch(monkeypatch):
         assert isinstance(engine._lens, np.ndarray)
         assert isinstance(engine._last_token, np.ndarray)
         assert isinstance(engine._adapter_ids, np.ndarray)
+        # The flight recorder must be LIVE for this accounting: the bound
+        # being asserted is that per-request observability adds zero
+        # device syncs to the decode loop (docs/observability.md).
+        assert engine._recorder.capacity > 0
         max_tokens = 8
         out = _generate(engine, [5, 9, 17, 3], max_tokens=max_tokens)
         assert len(out) == max_tokens
@@ -199,6 +203,11 @@ def test_decode_loop_is_host_native_one_pull_per_dispatch(monkeypatch):
         # host mirrors advanced without ever pulling device state
         assert int(engine._lens[0]) == 4 + max_tokens - 1
         assert int(engine._last_token[0]) == out[-1]
+        # ...and the recorder really observed the request (phases + every
+        # token timestamped) without a single extra pull showing up above.
+        rec = engine._recorder.records()[-1]
+        assert rec["tokens"] == max_tokens
+        assert "prefill-chunk" in rec["phases"] and "decode" in rec["phases"]
     finally:
         engine.shutdown()
 

@@ -1,0 +1,108 @@
+"""Metrics-plane regressions (docs/observability.md): latency-scale default
+Histogram buckets and dead-worker series pruning in collect_all()."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    collect_all,
+    prometheus_text,
+)
+
+
+def test_histogram_default_is_latency_scale():
+    """The old default ([0.1, 1, 10, 100, 1000]) put every sub-second
+    serving latency in one bucket. The default is now the log-spaced
+    ms-to-minutes scale; explicit boundaries= still wins."""
+    h = Histogram("t_hist_default", "d")
+    assert h._boundaries == sorted(LATENCY_BUCKETS_S)
+    assert h._boundaries[0] == 0.001 and h._boundaries[-1] == 600.0
+    # log-spaced: each boundary grows by a bounded multiplicative step
+    ratios = [b / a for a, b in zip(h._boundaries, h._boundaries[1:])]
+    assert all(1.5 <= r <= 3.5 for r in ratios), ratios
+    explicit = Histogram("t_hist_explicit", "d", boundaries=[1, 10])
+    assert explicit._boundaries == [1, 10]
+
+
+def test_latency_histogram_exposition(ray_start_isolated):
+    """A sub-second observation lands in discriminating buckets and renders
+    proper exposition output (name_bucket{le=...}/_sum/_count)."""
+    h = Histogram("t_ttft_seconds", "ttft")
+    h.observe(0.003)
+    h.observe(0.04)
+    h.observe(2.0)
+    h.flush()
+    text = prometheus_text()
+    # 0.003 is counted from the 0.005 bucket on; 0.04 from 0.05; 2.0 from 2.5
+    assert 't_ttft_seconds_bucket{le="0.005"} 1.0' in text
+    assert 't_ttft_seconds_bucket{le="0.05"} 2.0' in text
+    assert 't_ttft_seconds_bucket{le="2.5"} 3.0' in text
+    assert 't_ttft_seconds_bucket{le="+Inf"} 3.0' in text
+    assert "t_ttft_seconds_count 3.0" in text
+    assert "t_ttft_seconds_sum" in text
+
+
+@ray_tpu.remote
+class _MetricActor:
+    def emit(self):
+        g = Gauge("t_replica_gauge", "per-replica gauge")
+        g.set(42.0)
+        g.flush()
+        return True
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+
+def test_collect_all_prunes_dead_worker_series(ray_start_isolated):
+    """A killed worker's gauge disappears at collect time (and its KV entry
+    is reaped) while a live worker's counter survives even when stale —
+    without pruning, every dead replica's series lives in GCS KV forever."""
+    c = Counter("t_driver_counter", "driver-side counter")
+    c.inc(3.0)
+    c.flush()
+
+    actor = _MetricActor.remote()
+    assert ray_tpu.get(actor.emit.remote(), timeout=120)
+
+    names = {m["name"] for m in collect_all()}
+    assert {"t_driver_counter", "t_replica_gauge"} <= names
+
+    ray_tpu.kill(actor)
+    from ray_tpu.util.state import list_actors
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        actors = list_actors()
+        if all(a.get("state") == "DEAD" for a in actors):
+            break
+        time.sleep(0.2)
+    time.sleep(1.2)  # let the gauge's last flush age past the test TTL
+
+    pruned = collect_all(ttl_s=1.0)
+    names = {m["name"] for m in pruned}
+    assert "t_replica_gauge" not in names, names
+    # the driver's counter is just as stale, but its worker is alive
+    assert "t_driver_counter" in names
+    # the prune deleted the KV entry, not just filtered the listing
+    again = {m["name"] for m in collect_all(prune=False)}
+    assert "t_replica_gauge" not in again
+
+
+def test_collect_all_prune_keeps_live_actor_series(ray_start_isolated):
+    """Liveness beats staleness: a LIVE actor's stale series survives any
+    TTL (a quiet gauge is not a dead one)."""
+    actor = _MetricActor.remote()
+    assert ray_tpu.get(actor.emit.remote(), timeout=120)
+    time.sleep(1.2)
+    names = {m["name"] for m in collect_all(ttl_s=0.5)}
+    assert "t_replica_gauge" in names
+    ray_tpu.kill(actor)

@@ -1,0 +1,198 @@
+"""Serve-plane observability (docs/observability.md): cross-process trace
+propagation through the real serve path, response timing metadata with the
+DP routing reason, and the one-call `serve_stats()` operator snapshot."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from tests.conftest import _WORKER_ENV
+
+# Tracing must be on in EVERY serve process (proxy/router/replica), not just
+# the driver: enabled() reads this env in each worker.
+_TRACED_ENV = {**_WORKER_ENV, "RAY_TPU_TRACING": "1"}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=4, num_tpus=0, worker_env=_TRACED_ENV)
+    tracing.enable()
+    yield
+    tracing.disable()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_apps():
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+def _events_for_trace(trace_id, expect=(), deadline_s=60):
+    """Poll the GCS task-event pipeline until the trace carries every
+    expected span name (worker event buffers flush on independent 5s
+    timers, so different processes' spans land in different batches)."""
+    w = ray_tpu.global_worker()
+
+    def have(events, name):
+        return any(
+            (e.get("name") or "").startswith(name[:-1]) if name.endswith("*")
+            else e.get("name") == name
+            for e in events
+        )
+
+    deadline = time.monotonic() + deadline_s
+    events = []
+    while time.monotonic() < deadline:
+        events = [e for e in w.gcs_call("list_task_events", 100000)
+                  if e.get("trace_id") == trace_id]
+        if events and all(have(events, n) for n in expect):
+            return events
+        time.sleep(1.0)
+    return events
+
+
+def test_http_request_yields_one_cross_process_span_tree():
+    """One traced HTTP request -> ONE trace_id whose span tree covers
+    proxy (http span) -> router -> replica task spans -> the engine's named
+    phases (queue/admit/prefill-chunk/decode), spanning >= 2 processes."""
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+    from ray_tpu.util.tracing_export import spans_from_task_events
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=1
+    )
+    handle = serve.run(app, name="obs-dp", route_prefix="/", _timeout_s=300)
+    port = serve.get_proxy_port()
+
+    body = json.dumps({
+        "prompt": "a traced request with enough bytes to fingerprint blocks",
+        "max_tokens": 4,
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        out = json.loads(resp.read())
+    assert len(out["token_ids"]) == 4
+    # per-request timing breakdown rides the response metadata
+    assert out["timing"]["tokens"] == 4
+    assert out["timing"]["trace_id"], out["timing"]
+    trace_id = out["timing"]["trace_id"]
+
+    # The report path is what flushes recorder spans to the event pipeline.
+    handle.recorder_stats.remote().result(timeout_s=120)
+
+    events = _events_for_trace(
+        trace_id, expect=("http:*", "llm:request", "llm:decode"))
+    names = {e.get("name") for e in events}
+    assert any(n and n.startswith("http:") for n in names), names  # proxy
+    assert "llm:request" in names, names
+    assert {"llm:queued", "llm:admitted", "llm:decode"} <= names, names
+    assert "llm:prefill-chunk" in names, names
+    workers = {e.get("worker_id") for e in events if e.get("worker_id")}
+    assert len(workers) >= 2, f"trace stayed in one process: {workers}"
+
+    # And the tree is connected: pair events into spans, walk parent links.
+    spans = spans_from_task_events(events)
+    by_id = {s["span_id"]: s for s in spans}
+    req_span = next(s for s in spans if s["name"] == "llm:request")
+    # llm:request hangs off the replica's generate/handle_request task span
+    assert req_span["parent_span_id"] in by_id, "request root is an orphan"
+    for s in spans:
+        if s["name"].startswith("llm:") and s["name"] != "llm:request":
+            assert s["parent_span_id"] == req_span["span_id"]
+    assert len({s["trace_id"] for s in spans}) == 1
+
+
+def test_dp_routing_reason_in_timing_metadata():
+    """The DP router's pick reason (balanced/cache_routed/...) rides into
+    the replica's flight record and back out in response metadata."""
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=1
+    )
+    handle = serve.run(app, name="obs-route", route_prefix=None,
+                       _timeout_s=300)
+    prompt = "a shared system prompt long enough to cover whole kv blocks"
+    first = handle.generate.remote(prompt, max_tokens=2).result(timeout_s=300)
+    again = handle.generate.remote(prompt, max_tokens=2).result(timeout_s=300)
+    assert first["timing"]["route"] in ("balanced", "cache_routed",
+                                        "adapter_routed")
+    assert again["timing"]["route"] == "cache_routed", again["timing"]
+    assert "prefill-chunk" in again["timing"]["phases"]
+
+
+def test_pd_prefill_and_decode_spans_share_trace():
+    """A PD-disaggregated request's prefill-side and decode-side flight
+    records share ONE trace: the span set covers both replica processes."""
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.pd_disagg import build_pd_openai_app
+    from ray_tpu.util import tracing
+
+    app = build_pd_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2, max_seq=128),
+        num_prefill=1, num_decode=1,
+    )
+    handle = serve.run(app, name="obs-pd", route_prefix=None, _timeout_s=300)
+    with tracing.trace("pd-request") as root:
+        out = handle.generate.remote(
+            "disaggregated traced request", max_tokens=3
+        ).result(timeout_s=300)
+    assert len(out["token_ids"]) == 3
+    assert out["timing"] is not None and "pd-attach" in out["timing"]["phases"]
+    handle.recorder_stats.remote().result(timeout_s=120)
+
+    events = _events_for_trace(
+        root["trace_id"],
+        expect=("llm:prefill-detached", "llm:pd-attach", "llm:decode"))
+    names = {e.get("name") for e in events}
+    assert "llm:prefill-detached" in names, names   # prefill-side engine
+    assert "llm:pd-attach" in names, names          # decode-side engine
+    assert "llm:decode" in names, names
+    # two llm:request roots (one per phase engine), one shared trace
+    roots = [e for e in events if e.get("name") == "llm:request"]
+    assert len({e["trace_id"] for e in roots}) == 1
+    workers = {e.get("worker_id") for e in events if e.get("worker_id")}
+    assert len(workers) >= 2, workers
+
+
+def test_serve_stats_one_call_snapshot():
+    """ray_tpu.util.state.serve_stats() aggregates the scattered surfaces
+    (scheduler/adapter/routing/cache/recorder + transport + control plane)
+    into one operator snapshot."""
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+    from ray_tpu.util.state import serve_stats
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=1
+    )
+    handle = serve.run(app, name="obs-stats", route_prefix=None,
+                       _timeout_s=300)
+    handle.generate.remote("warm request", max_tokens=2).result(timeout_s=300)
+
+    snap = serve_stats(timeout_s=120)
+    assert "obs-stats" in snap["apps"], snap["apps"].keys()
+    app_stats = snap["apps"]["obs-stats"]
+    assert "scheduler_stats" in app_stats     # replica scheduler occupancy
+    assert "routing_stats" in app_stats       # DP router pick counters
+    assert "recorder_stats" in app_stats      # flight recorder counters
+    rec = app_stats["recorder_stats"][0]
+    assert rec["started"] >= 1
+    sched = app_stats["scheduler_stats"][0]
+    assert sched["iterations"] >= 1 and "recorder" in sched
+    assert isinstance(snap["transport"], dict)
+    assert isinstance(snap["control_plane"], dict)
