@@ -59,6 +59,19 @@ def build_engine(**kw):
     model_id = "gpt2-125m" if on_tpu else "test-tiny"
     cfg, params = load_model(LLMConfig(model_id=model_id))
     max_seq = kw.pop("max_seq", 1024 if on_tpu else 256)
+    if "prefix_cache" not in kw:
+        # Tiered prefix cache (docs/kvcache.md): the shared_prefix mix then
+        # reports its per-tier hit breakdown (device/host/disk).
+        import tempfile
+
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.llm.kvcache import TieredPrefixCacheManager
+
+        kw["prefix_cache"] = TieredPrefixCacheManager(
+            CONFIG.llm_kv_block_size, CONFIG.llm_prefix_cache_bytes,
+            name="bench-load", device_bytes=8 << 20,
+            spill_dir=tempfile.mkdtemp(prefix="bench_load_spill_"),
+        )
     engine = DecodeEngine(cfg, params, num_slots=kw.pop("slots", 8),
                           max_seq=max_seq, seed=0, **kw)
     return engine, cfg, model_id, on_tpu
@@ -200,6 +213,18 @@ def run_load(engine, cfg, *, rate_rps: float, n_requests: int, mix: str,
         stats = engine.prefix_cache_stats()
         if stats:
             row["cache_hit_rate"] = round(stats.get("hit_rate", 0.0), 3)
+            tiers = stats.get("tiers")
+            if tiers:
+                # Tiered cache (docs/kvcache.md): which tier served the
+                # shared-prefix hits, plus spill/promotion traffic.
+                row["tier_hits"] = {
+                    t: tiers[f"hits_{t}"] for t in ("device", "host", "disk")
+                }
+                row["tier_traffic"] = {
+                    "spills": tiers["spills"],
+                    "promotions_host": tiers["promotions_host"],
+                    "promotions_device": tiers["promotions_device"],
+                }
     return row
 
 

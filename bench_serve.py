@@ -337,6 +337,243 @@ def bench_prefix_cache(prompt_len: int):
         engine.shutdown()
 
 
+def bench_tier_sweep():
+    """TTFT by serving tier of the hierarchical KV store (docs/kvcache.md):
+    cold (full prefill) vs host-warm (attach from the host pool) vs
+    device-warm (attach a device-resident hot-tier prefix, zero H2D) vs
+    disk-warm (promote a spilled chain back through the host pool first).
+    The engine's `last_attach` proves which tier actually served each row."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.kvcache import TieredPrefixCacheManager
+
+    import jax
+
+    from ray_tpu.llm import LLMConfig, load_model
+    from ray_tpu.llm._engine import DecodeEngine
+
+    bs = CONFIG.llm_kv_block_size
+    shared_len, tail_len = 5 * bs, 8
+    on_tpu = jax.default_backend() == "tpu"
+    model_id = "gpt2-125m" if on_tpu else "test-tiny"
+    cfg, params = load_model(LLMConfig(model_id=model_id))
+    block_bytes = (cfg.n_layers * 2 * bs * cfg.n_kv_heads * cfg.head_dim
+                   * np.dtype(cfg.dtype).itemsize)
+    # Capacity of exactly one 5-block chain: inserting a second chain
+    # evicts (spills) the first, which is how we stage the disk-warm case.
+    spill_dir = tempfile.mkdtemp(prefix="bench_kv_spill_")
+    mgr = TieredPrefixCacheManager(
+        bs, 5 * block_bytes, name="bench-tier",
+        device_bytes=8 * block_bytes, spill_dir=spill_dir,
+    )
+    engine = DecodeEngine(cfg, params, num_slots=4,
+                          max_seq=1024 if on_tpu else 256, seed=0,
+                          prefix_cache=mgr)
+    rng = np.random.default_rng(1)
+
+    def request(prefix, seed):
+        tail = np.random.default_rng(seed).integers(0, cfg.vocab_size, tail_len)
+        prompt = prefix + tail.tolist()
+        done = threading.Event()
+        ttft = [None]
+        t0 = _time.perf_counter()
+
+        def cb(token, finished):
+            if ttft[0] is None:
+                ttft[0] = _time.perf_counter() - t0
+            if finished:
+                done.set()
+
+        engine.submit(prompt, SamplingParams(max_tokens=2), cb)
+        assert done.wait(timeout=600)
+        return ttft[0]
+
+    def wait_spills(n):
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            if mgr.stats()["tiers"]["spills"] >= n:
+                return
+            _time.sleep(0.05)
+        raise TimeoutError("spill worker never drained")
+
+    try:
+        warm_prefix = rng.integers(0, cfg.vocab_size, shared_len).tolist()
+        request(warm_prefix, 100)  # compile cold bucket
+        request(warm_prefix, 101)  # compile attach + suffix bucket
+        other = rng.integers(0, cfg.vocab_size, shared_len).tolist()
+        request(other, 102)  # evicts warm_prefix; its chain spills
+
+        prefix = rng.integers(0, cfg.vocab_size, shared_len).tolist()
+        ttft_cold = request(prefix, 0)
+        ttft_host = request(prefix, 1)
+        assert engine.last_attach["tier"] == "host", engine.last_attach
+        ttft_device = request(prefix, 2)
+        assert engine.last_attach["tier"] == "device", engine.last_attach
+        request(other, 3)          # evict prefix's chain -> disk
+        wait_spills(5)
+        ttft_disk = request(prefix, 4)
+        assert engine.last_attach["tier"] == "disk", engine.last_attach
+        tiers = mgr.stats()["tiers"]
+        rows = []
+        for tier, value in (("cold", ttft_cold), ("host", ttft_host),
+                            ("device", ttft_device), ("disk", ttft_disk)):
+            rows.append({
+                "metric": f"ttft_tier_{tier}_s", "value": round(value, 4),
+                "prompt_len": shared_len + tail_len, "model": model_id,
+                "cached_blocks": 0 if tier == "cold" else 5,
+            })
+        rows[-1]["note"] = (
+            f"tiered cache (docs/kvcache.md): device attach is zero-H2D, "
+            f"disk promotes through the host pool; "
+            f"spills={tiers['spills']} promotions_host="
+            f"{tiers['promotions_host']} promotions_device="
+            f"{tiers['promotions_device']}"
+        )
+        return rows
+    finally:
+        engine.shutdown()
+
+
+def bench_multicast_fanout():
+    """One prefill feeding N decode readers (docs/device_channels.md):
+    multicast 1->4 over ONE ring (each payload chunk staged once) vs 4
+    point-to-point streams (staged 4x). Reports writer wall time and the
+    staged-chunk counters that prove the single D2H pass."""
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu.experimental import tensor_transport as _tt
+    from ray_tpu.experimental.device_channel import (
+        DeviceChannel, MulticastDeviceChannel,
+    )
+
+    payload = np.random.default_rng(0).standard_normal(
+        (2, 1 << 20)).astype(np.float32)  # 8 MiB, a PD-prefix-sized tensor
+    fanout = 4
+
+    def run_multicast():
+        mc = MulticastDeviceChannel.create(fanout, num_slots=8)
+        threads = []
+        for i in range(fanout):
+            def reader(i=i):
+                with mc.subscribe(i) as sub:
+                    sub.recv(timeout=120)
+            threads.append(threading.Thread(target=reader))
+            threads[-1].start()
+        t0 = _time.perf_counter()
+        mc.send(payload, timeout=120)
+        mc.drain(timeout=120)
+        wall = _time.perf_counter() - t0
+        for t in threads:
+            t.join(120)
+        mc.close()
+        mc.destroy()
+        return wall
+
+    def run_p2p():
+        t_total = 0.0
+        for _ in range(fanout):
+            ch = DeviceChannel.create(same_node=True, num_slots=8)
+            t = threading.Thread(target=lambda: ch.recv(timeout=120))
+            t.start()
+            t0 = _time.perf_counter()
+            ch.send(payload, timeout=120)
+            ch.drain(timeout=120)
+            t_total += _time.perf_counter() - t0
+            t.join(120)
+            ch.close()
+            ch.destroy()
+        return t_total
+
+    before = _tt.transport_stats()["stream_chunks_staged"]
+    mc_wall = min(run_multicast() for _ in range(3))
+    mc_staged = (_tt.transport_stats()["stream_chunks_staged"] - before) // 3
+    before = _tt.transport_stats()["stream_chunks_staged"]
+    p2p_wall = min(run_p2p() for _ in range(3))
+    p2p_staged = (_tt.transport_stats()["stream_chunks_staged"] - before) // 3
+    return {
+        "metric": "multicast_fanout_1_to_4",
+        "payload_mb": round(payload.nbytes / 2**20, 1),
+        "multicast_writer_s": round(mc_wall, 4),
+        "p2p_x4_writer_s": round(p2p_wall, 4),
+        "multicast_chunks_staged": mc_staged,
+        "p2p_chunks_staged": p2p_staged,
+        "speedup_vs_p2p": round(p2p_wall / max(mc_wall, 1e-9), 2),
+        "note": "one staged (D2H) pass fanned to 4 subscribers over one "
+                "ring vs 4 point-to-point streams re-staging the payload",
+    }
+
+
+def bench_remote_fetch_crossover():
+    """Cluster prefix plane (docs/kvcache.md): fetching a peer replica's
+    cached prefix over the DeviceChannel stream vs recomputing it locally.
+    Reports both legs for the standard 5-block prefix; the crossover moves
+    toward fetch as model size grows (prefill FLOPs scale with params, the
+    fetch only with KV bytes)."""
+    import asyncio
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.llm import LLMConfig, LLMServer
+
+    bs = CONFIG.llm_kv_block_size
+    ray_tpu.init(
+        num_cpus=4, num_tpus=0,
+        worker_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    try:
+        cfg_obj = LLMConfig(model_id="test-tiny", num_slots=2, max_seq=128)
+        s1, s2 = LLMServer(cfg_obj), LLMServer(cfg_obj)
+        rng = np.random.default_rng(5)
+        toks = list(map(int, rng.integers(0, 64, 5 * bs + 4)))
+        warmup = list(map(int, rng.integers(0, 64, 5 * bs + 4)))
+
+        async def run():
+            # Warm every compiled program off-clock on BOTH replicas (cold
+            # bucket, then attach + suffix bucket via the repeat).
+            for srv in (s1, s2):
+                await srv.generate(warmup, max_tokens=1)
+                await srv.generate(warmup, max_tokens=1)
+            await s1.generate(toks, max_tokens=2)   # S1 computes + caches
+            # recompute leg: S2 cold TTFT
+            r = await s2.generate(list(reversed(toks)), max_tokens=1)
+            recompute_s = r["ttft_s"]
+            # fetch leg: export S1 -> stream -> import S2 -> warm TTFT
+            t0 = _time.perf_counter()
+            desc = await s1.export_prefix(toks)
+            inserted = await s2.import_prefix(desc, toks)
+            fetch_s = _time.perf_counter() - t0
+            warm = await s2.generate(toks, max_tokens=1)
+            return recompute_s, fetch_s, warm["ttft_s"], inserted
+
+        recompute_s, fetch_s, warm_ttft, inserted = asyncio.run(run())
+        out = {
+            "metric": "remote_fetch_vs_recompute",
+            "prefix_blocks": 5, "blocks_fetched": inserted,
+            "recompute_ttft_s": round(recompute_s, 4),
+            "fetch_s": round(fetch_s, 4),
+            "post_fetch_warm_ttft_s": round(warm_ttft, 4),
+            "model": "test-tiny",
+            "note": "fetch = export lease + DeviceChannel stream + import; "
+                    "crossover favors fetch as prefill FLOPs grow with "
+                    "model size while fetch cost scales only with KV bytes",
+        }
+        asyncio.run(s1.shutdown())
+        asyncio.run(s2.shutdown())
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_adapter_churn(on_tpu: bool):
     """Multi-tenant LoRA paging (docs/multitenancy.md): 32 registered
     adapters served through an 8-slot HBM budget, with a zipf-ish mix (a hot
@@ -684,6 +921,12 @@ def main():
 
     results.extend(bench_prefix_cache(prompt_len))
 
+    # Hierarchical KV store (round 17, docs/kvcache.md): per-tier TTFT,
+    # multicast fanout vs point-to-point, and the cross-replica
+    # fetch-vs-recompute crossover.
+    results.extend(bench_tier_sweep())
+    results.append(bench_multicast_fanout())
+
     # Multi-tenant serving plane (round 13, docs/multitenancy.md):
     # adapter-churn paging overhead + WFQ-vs-FIFO fairness under saturation.
     results.append(bench_adapter_churn(on_tpu))
@@ -695,6 +938,10 @@ def main():
 
     # PD disaggregation TTFT across real replica actors (round 11).
     results.append(bench_pd_ttft())
+
+    # Cluster prefix plane: fetch a peer's cached prefix vs recompute
+    # (round 17; needs its own cluster, so it runs after bench_pd_ttft's).
+    results.append(bench_remote_fetch_crossover())
 
     out = {
         "bench": "serve_engine",

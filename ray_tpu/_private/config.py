@@ -96,6 +96,10 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_prefill_bucket_min": (int, 16, "smallest prompt padding bucket for compiled prefill programs"),
     "llm_kv_block_size": (int, 16, "token rows per paged KV prefix-cache block; prefixes are reused at whole-block granularity (docs/kvcache.md)"),
     "llm_prefix_cache_bytes": (int, 32 << 20, "host bytes for the per-engine paged KV prefix cache; repeated prompt prefixes attach cached KV and prefill suffix-only (0 disables)"),
+    "llm_kv_device_bytes": (int, 0, "device-resident hot-tier byte budget of the tiered prefix cache (docs/kvcache.md): the hottest blocks keep a device copy (mesh-sharded on TP engines) so warm attaches skip the host->device leg entirely; LRU device copies drop back to the host tier past the budget (0 disables the hot tier)"),
+    "llm_kv_spill_dir": (str, "", "local directory for the disk spill tier of the tiered prefix cache (docs/kvcache.md): host-tier eviction spills blocks here (atomic tmp+fsync+rename commits — torn spills are invisible) instead of discarding them, and later lookups promote spilled chains back through the host pool (empty disables spilling)"),
+    "llm_kv_spill_bytes": (int, 256 << 20, "byte cap on the disk spill tier; the oldest committed spill files are unlinked past it (0 = unbounded)"),
+    "llm_kv_remote_fetch": (bool, True, "cluster-wide prefix plane (docs/kvcache.md): when the DP router's fingerprints say another replica computed a request's prefix but the request must route elsewhere, the chosen replica fetches the prefix cross-node over a DeviceChannel stream instead of recomputing it"),
     "llm_max_queue_depth": (int, 256, "engine admission queue cap; submits beyond it raise EngineOverloadedError instead of growing memory unboundedly (0 = unbounded)"),
     "llm_max_jit_programs": (int, 64, "per-engine cap on cached jitted programs (prefill/attach/spec bucket variants); past it the oldest program is evicted so an adversarial prompt-length mix can't grow compilation memory unboundedly (0 = unbounded)"),
     "llm_router_fingerprint_blocks": (int, 8, "prefix blocks hashed into the DP router's per-replica fingerprints for cache-aware routing"),

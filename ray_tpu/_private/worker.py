@@ -2676,6 +2676,13 @@ class CoreWorker:
 
         return _ring_close(name)
 
+    async def rpc_chan_detach(self, conn, name, reader):
+        """Multicast dead-subscriber unwind: stop counting one reader slot
+        toward the named ring's back-pressure (experimental/channel.py)."""
+        from ray_tpu.experimental.channel import _ring_detach
+
+        return _ring_detach(name, reader)
+
     async def rpc_init_actor(self, conn, actor_id: ActorID, spec):
         fut = self._task_executor.submit(self._init_actor, actor_id, spec)
         return await asyncio.wrap_future(fut)

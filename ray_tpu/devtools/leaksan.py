@@ -54,7 +54,7 @@ _gc_leaked: Dict[str, int] = {}
 #: Thread-name prefixes that belong to the resource planes leaksan audits;
 #: the pytest fixture counts only these (worker/executor threads are
 #: process-lifetime by design and would make growth checks meaningless).
-THREAD_PREFIXES = ("devobj-stream", "ckpt-writer", "chan-pump")
+THREAD_PREFIXES = ("devobj-stream", "ckpt-writer", "chan-pump", "kv-spill")
 
 
 def enabled() -> bool:

@@ -105,6 +105,18 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
     # of every TP replica must discharge it.
     ResourceSpec("mesh-sharded KV pool (ShardedKVPool)", "ShardedKVPool",
                  release=("free",)),
+    # Round 17 (docs/kvcache.md): the tiered KV store + multicast plane. A
+    # spill handle closed by nobody leaks an fd AND leaves a tmp orphan; an
+    # unreleased multicast subscription back-pressures the writer's ring
+    # forever; an unreleased prefix-fetch lease pins the exported chain
+    # against eviction for the engine's life.
+    ResourceSpec("disk-spill file handle (SpillFile)", "open_spill",
+                 release=("commit", "close")),
+    ResourceSpec("multicast subscription (Subscription)", "subscribe",
+                 release=("unsubscribe",)),
+    ResourceSpec("cross-replica prefix-fetch lease (PrefixLease)",
+                 "lease_prefix", hints=("cache", "prefix", "engine"),
+                 release=("release",)),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

@@ -213,10 +213,36 @@ class Channel:
     def _data_off(self, slot: int) -> int:
         return self._ctrl + slot * self._capacity
 
+    #: Ack value that marks a reader slot as DETACHED: far above any
+    #: reachable write_version, so _min_ack (and drain) stop waiting on it.
+    _DETACHED_ACK = 1 << 62
+
     def _min_ack(self) -> int:
         return min(
             self._get_u64(self._ack_off(r)) for r in range(self._num_readers)
         )
+
+    def detach_reader(self, reader: int):
+        """Stop counting `reader` toward ring back-pressure (multicast
+        dead-subscriber unwind, docs/device_channels.md): its ack word jumps
+        past every reachable write version, so a blocked writer resumes and
+        the REMAINING readers keep streaming. Callable from any attached
+        process (the ack word lives in the shared segment); irreversible for
+        this stream — a detached subscriber that polls again reads garbage
+        ordering, so callers drop their view after detaching."""
+        if not 0 <= reader < self._num_readers:
+            raise ValueError(f"reader {reader} out of range")
+        self._set_u64(self._ack_off(reader), self._DETACHED_ACK)
+
+    def lagging_readers(self):
+        """Reader slots currently holding the ring back (ack == min ack and
+        not detached) — the writer's dead-subscriber suspects on a stalled
+        multicast write."""
+        m = self._min_ack()
+        return [
+            r for r in range(self._num_readers)
+            if self._get_u64(self._ack_off(r)) == m and m < self._DETACHED_ACK
+        ]
 
     # -- writer ------------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None):
@@ -407,6 +433,19 @@ def _ring_close(name: str):
         with ring.lock:
             ring.closed = True
             ring.cond.notify_all()
+    return True
+
+
+def _ring_detach(name: str, reader: int):
+    """Writer-process detach of one reader slot (multicast dead-subscriber
+    unwind): its ack jumps past every write version so the ring stops
+    back-pressuring on it."""
+    ring = _rpc_rings.get(name)
+    if ring is not None:
+        with ring.lock:
+            if 0 <= reader < ring.num_readers:
+                ring.acks[reader] = Channel._DETACHED_ACK
+                ring.cond.notify_all()
     return True
 
 
@@ -628,6 +667,35 @@ class RpcChannel:
                         return False
                 ring.cond.wait(wait)
             return True
+
+    def lagging_readers(self):
+        """Reader slots currently holding the ring back (writer process
+        only; a reader-side view has no ring state and reports none)."""
+        ring = _rpc_rings.get(self._name)
+        if ring is None:
+            return []
+        with ring.lock:
+            m = min(ring.acks)
+            if m >= Channel._DETACHED_ACK:
+                return []
+            return [r for r, a in enumerate(ring.acks) if a == m]
+
+    def detach_reader(self, reader: int):
+        """Stop counting `reader` toward ring back-pressure (multicast
+        dead-subscriber unwind). Writer-local rings detach directly; a
+        reader-side view notifies the writer process."""
+        if self._name in _rpc_rings:
+            _ring_detach(self._name, reader)
+            return
+        try:
+            conn = self._writer_conn()
+            from ray_tpu._private.worker import global_worker
+
+            global_worker().io.run(
+                conn.notify("chan_detach", self._name, reader)
+            )
+        except Exception:
+            pass  # writer already dead: nothing back-pressures anymore
 
     def close(self):
         # Writer-local rings close directly; otherwise tell the writer.

@@ -225,7 +225,8 @@ class DeviceChannel:
 
     # -- writer ------------------------------------------------------------
     def send(self, value: Any, *, sharding=None,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             on_stall: Optional[Callable] = None):
         """Stream `value`'s array leaves to the reader.
 
         Local mode: the arrays are handed over by reference — with a
@@ -234,7 +235,13 @@ class DeviceChannel:
 
         Transport mode: one header frame, then each leaf's bytes as chunk
         frames. jax leaves are sliced ON DEVICE and fetched chunk-at-a-time,
-        so the D2H leg pipelines with the wire leg through the ring."""
+        so the D2H leg pipelines with the wire leg through the ring.
+
+        `on_stall` (multicast dead-subscriber unwind): when a frame write
+        times out, it is invoked with no args; returning True means "the
+        blocker was removed, RESUME the same frame" (the stream stays
+        consistent for the remaining readers — a restarted send would tear
+        it), anything else re-raises the TimeoutError."""
         if self._transport is None:
             item = value
             if sharding is not None:
@@ -265,9 +272,20 @@ class DeviceChannel:
             (skeleton_bytes, descs, self._chunk),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
-        self._transport.write_bytes(
-            STREAM_MAGIC + _U32.pack(len(meta)) + meta, timeout
-        )
+
+        def write_frame(data):
+            """One frame write, resumable across stall-unwound subscribers:
+            write_bytes never partially commits a slot, so retrying the SAME
+            frame after on_stall() detached the blocker keeps the stream
+            byte-identical for every remaining reader."""
+            while True:
+                try:
+                    return self._transport.write_bytes(data, timeout)
+                except TimeoutError:
+                    if on_stall is None or not on_stall():
+                        raise
+
+        write_frame(STREAM_MAGIC + _U32.pack(len(meta)) + meta)
         rpc = isinstance(self._transport, RpcChannel)
         jax = sys.modules.get("jax")
         for leaf, desc, plan in zip(leaves, descs, plans):
@@ -284,9 +302,8 @@ class DeviceChannel:
                     for a in range(0, ssize, ce):
                         b = min(ssize, a + ce)
                         mv = flatb[a * isz : b * isz].data
-                        self._transport.write_bytes(
-                            bytes(mv) if rpc else mv, timeout
-                        )
+                        _tt.note("stream_chunks_staged")
+                        write_frame(bytes(mv) if rpc else mv)
                 continue
             if (jax is not None and isinstance(leaf, jax.Array)
                     and not _host_resident(leaf)):
@@ -296,10 +313,10 @@ class DeviceChannel:
                     # back-pressures, so at most `num_slots` chunks of host
                     # staging exist at once.
                     chunk = np.asarray(flat[a : min(size, a + ce)])  # raylint: disable=RL603 (the chunked D2H leg itself — one bounded slice per frame IS the point)
-                    self._transport.write_bytes(
+                    _tt.note("stream_chunks_staged")
+                    write_frame(
                         bytes(chunk.view(np.uint8).data) if rpc
-                        else _tt.as_flat_bytes(chunk).data,
-                        timeout,
+                        else _tt.as_flat_bytes(chunk).data
                     )
             else:
                 if not isinstance(leaf, np.ndarray):
@@ -313,8 +330,8 @@ class DeviceChannel:
                 for a in range(0, size, ce):
                     b = min(size, a + ce)
                     mv = flatb[a * isz : b * isz].data
-                    self._transport.write_bytes(bytes(mv) if rpc else mv,
-                                                timeout)
+                    _tt.note("stream_chunks_staged")
+                    write_frame(bytes(mv) if rpc else mv)
         # One logical tensor frame per stream in the fast-path accounting
         # (the per-chunk byte counts land via the transport's write_bytes).
         _tt.note("tensor_frames_written")
@@ -373,6 +390,7 @@ class DeviceChannel:
                     b = min(n_elems, a + ce)
                     if shm:
                         view = self._transport.read_view(timeout)
+                        typed = None
                         try:
                             typed = np.frombuffer(view.mv, dtype=dtype)
                             if out_buf is not None:
@@ -535,4 +553,168 @@ class DeviceChannel:
             with _local_lock:
                 _local_rings.pop(self._name, None)
             return
+        self._transport.destroy()
+
+
+class Subscription:
+    """One subscriber's end of a multicast stream (leaksan-tracked).
+
+    Obtained via `MulticastDeviceChannel.subscribe(i)` in the SUBSCRIBER's
+    process; `unsubscribe()` releases the slot — it detaches the reader from
+    ring back-pressure, so a subscriber that is done (or bailing early) can
+    never wedge the writer or its siblings. An unreleased subscription is a
+    live leaksan handle (`mc_subscription`)."""
+
+    __slots__ = ("_chan", "_transport", "index", "group", "_active",
+                 "__weakref__")
+
+    def __init__(self, group: str, transport, chunk_bytes: int, index: int):
+        self._transport = transport
+        self._chan = DeviceChannel(transport, chunk_bytes)
+        self.index = int(index)
+        self.group = group
+        self._active = True
+        from ray_tpu.devtools import leaksan as _leaksan
+
+        _leaksan.track(
+            "mc_subscription", self,
+            detail=f"subscriber {index} of {group}",
+        )
+
+    def recv(self, **kw):
+        """One streamed value, host-assembled (DeviceChannel.recv)."""
+        return self._chan.recv(**kw)
+
+    def recv_device(self, timeout=None, *, sharding=None):
+        """One streamed value with per-chunk device staging
+        (DeviceChannel.recv_device)."""
+        return self._chan.recv_device(timeout, sharding=sharding)
+
+    def unsubscribe(self):
+        """Idempotent release: detach this reader slot from the ring's
+        back-pressure accounting and drop the stream view."""
+        if not self._active:
+            return
+        self._active = False
+        try:
+            self._transport.detach_reader(self.index)
+        except Exception:
+            pass  # writer already gone: nothing back-pressures anymore
+        self._chan = None
+        from ray_tpu.devtools import leaksan as _leaksan
+
+        _leaksan.untrack("mc_subscription", self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.unsubscribe()
+
+
+class MulticastDeviceChannel:
+    """One-writer N-subscriber fanout over ONE chunked transport ring.
+
+    The point (docs/device_channels.md): `send()` stages each payload chunk
+    out of the source array exactly ONCE (one D2H pass on accelerators —
+    `stream_chunks_staged` in transport_stats() proves it) and the ring's
+    per-reader ack words fan the same slot bytes out to every subscriber. A
+    slow subscriber back-pressures the writer through its own ack (never
+    corrupts siblings); a DEAD subscriber is unwound with `detach(i)` (writer
+    side) or `Subscription.unsubscribe()` (reader side), after which the
+    writer and the remaining subscribers proceed.
+
+    Used by the PD plane so one prefill replica feeds every decode replica in
+    a group with a single D2H pass (pd_disagg.prefill_multicast). The object
+    pickles by transport identity: mint it writer-side, ship it through any
+    control-plane message, and have each subscriber call `subscribe(i)` with
+    its assigned index."""
+
+    def __init__(self, transport, chunk_bytes: int, num_subscribers: int,
+                 name: Optional[str] = None):
+        self._transport = transport
+        self._chunk = int(chunk_bytes)
+        self.num_subscribers = int(num_subscribers)
+        self._name = name or f"rtpumc_{uuid.uuid4().hex[:12]}"
+        self._writer = DeviceChannel(transport, chunk_bytes)
+        self.detached: set = set()  # writer-side record of unwound subscribers
+
+    @classmethod
+    def create(cls, num_subscribers: int, *, same_node: bool = True,
+               chunk_bytes: Optional[int] = None,
+               num_slots: Optional[int] = None,
+               owner=None) -> "MulticastDeviceChannel":
+        from ray_tpu._private.config import CONFIG
+
+        if num_subscribers < 1:
+            raise ValueError("a multicast group needs at least one subscriber")
+        chunk = chunk_bytes or CONFIG.llm_channel_chunk_bytes
+        slots = num_slots or CONFIG.devobj_stream_slots
+        capacity = int(chunk) + (64 << 10)
+        if same_node:
+            transport = Channel(capacity, num_readers=num_subscribers,
+                                num_slots=slots)
+        else:
+            transport = RpcChannel(capacity, num_readers=num_subscribers,
+                                   num_slots=slots, owner=owner)
+        return cls(transport, chunk, num_subscribers)
+
+    def __reduce__(self):
+        return (MulticastDeviceChannel,
+                (self._transport, self._chunk, self.num_subscribers,
+                 self._name))
+
+    # -- writer ------------------------------------------------------------
+    def send(self, value: Any, timeout: Optional[float] = None,
+             stall_timeout: Optional[float] = None):
+        """Stream `value` once; every live subscriber receives it.
+
+        `timeout` bounds each frame write by the SLOWEST live subscriber's
+        ack (plain back-pressure; a TimeoutError aborts the send). With
+        `stall_timeout` set instead, a frame write stalled that long detaches
+        the lagging subscriber(s) — presumed dead — and RESUMES the same
+        frame, so the writer unwinds without wedging (or tearing the stream
+        for) the remaining subscribers; `self.detached` records who was
+        unwound."""
+        if stall_timeout is None:
+            self._writer.send(value, timeout=timeout)
+            return
+
+        def unwind() -> bool:
+            lagging = [
+                r for r in self._transport.lagging_readers()
+                if r not in self.detached
+            ]
+            for r in lagging:
+                self.detach(r)
+            return bool(lagging)
+
+        self._writer.send(value, timeout=stall_timeout, on_stall=unwind)
+
+    def detach(self, index: int):
+        """Writer-side dead-subscriber unwind: stop waiting on subscriber
+        `index` forever. The remaining subscribers are untouched."""
+        self.detached.add(index)
+        self._transport.detach_reader(index)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        return self._transport.drain(timeout)
+
+    # -- subscribers -------------------------------------------------------
+    def subscribe(self, index: int) -> Subscription:
+        """Bind subscriber slot `index` in the CALLING process. Pair with
+        `unsubscribe()` (leaklint RL801 enforces it)."""
+        if not 0 <= index < self.num_subscribers:
+            raise ValueError(
+                f"subscriber index {index} out of range "
+                f"[0, {self.num_subscribers})"
+            )
+        return Subscription(self._name, self._transport.reader(index),
+                            self._chunk, index)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        self._transport.close()
+
+    def destroy(self):
         self._transport.destroy()

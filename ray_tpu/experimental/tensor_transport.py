@@ -244,6 +244,11 @@ _stats = {
     "tensor_bytes_written": 0,
     "pickle_frames_written": 0,
     "pickle_frames_read": 0,
+    # One count per payload chunk STAGED OUT of the source array by a
+    # DeviceChannel writer (the D2H leg on real accelerators). Multicast
+    # fanout writes each staged chunk once for N subscribers, so this is the
+    # counter that proves "one D2H pass" (docs/device_channels.md).
+    "stream_chunks_staged": 0,
 }
 
 

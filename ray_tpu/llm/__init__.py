@@ -13,6 +13,7 @@ import asyncio
 import dataclasses
 import os
 import pickle
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Union
@@ -298,6 +299,70 @@ class LLMServer:
         """Paged KV prefix-cache counters for this replica's engine (None when
         the cache is disabled). See docs/kvcache.md."""
         return self._engine.prefix_cache_stats()
+
+    # -- cluster-wide prefix plane (docs/kvcache.md) -----------------------
+    async def export_prefix(self, token_ids: List[int],
+                            lora: str = "") -> Optional[dict]:
+        """EXPORT side of the cross-replica prefix fetch: lease this
+        engine's longest cached whole-block prefix of token_ids, stream its
+        KV rows through a DeviceChannel on a background thread (raw chunk
+        frames, never a cloudpickled blob), and return the picklable reader
+        end. The lease pins the chain until the send leg finishes (released
+        in the pump's finally; leaksan-proved), so eviction can never free
+        rows mid-transfer. None when nothing is cached."""
+        loop = asyncio.get_running_loop()
+        lease = await loop.run_in_executor(
+            None, lambda: self._engine.lease_prefix(list(token_ids), lora)
+        )
+        if lease is None:
+            return None
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.experimental.device_channel import DeviceChannel
+
+        w = global_worker()
+        owner = (
+            ("actor", w.actor_id) if w.actor_id is not None
+            else ("addr", (getattr(w, "node_ip", "127.0.0.1"),
+                           w._direct_server.port))
+        )
+        ch = DeviceChannel.create(same_node=False, owner=owner)
+        matched = lease.matched_tokens
+
+        def pump():
+            try:
+                ch.send(lease.kv(), timeout=60.0)
+                ch.drain(timeout=60.0)
+            except Exception:
+                pass  # reader died/skipped: the fetch degrades to a recompute
+            finally:
+                lease.release()
+                ch.destroy()
+
+        threading.Thread(
+            target=pump, daemon=True, name="kv-prefix-export",
+        ).start()
+        return {"channel": ch, "matched_tokens": matched}
+
+    async def import_prefix(self, desc: dict, token_ids: List[int],
+                            lora: str = "") -> int:
+        """IMPORT side of the cross-replica prefix fetch: drain the peer's
+        stream and feed the rows into this engine's cache, so the request
+        the router is about to send here prefills suffix-only. Returns
+        blocks inserted (0 on any transfer failure — a failed fetch is a
+        recompute, never an error)."""
+        loop = asyncio.get_running_loop()
+
+        def pull() -> int:
+            try:
+                kv = desc["channel"].recv(timeout=60.0)
+            except Exception:
+                return 0
+            m = int(desc["matched_tokens"])
+            return self._engine.insert_prefix(
+                list(token_ids)[:m], kv, lora
+            )
+
+        return await loop.run_in_executor(None, pull)
 
     async def scheduler_stats(self) -> dict:
         """Iteration-level scheduler occupancy + spec-decode acceptance +

@@ -356,14 +356,30 @@ class DecodeEngine:
         # pool + radix prefix index. A repeated prompt prefix attaches its
         # cached KV through the padded-bucket attach path and prefills only
         # the suffix. prefix_cache=None builds one from the config flags;
-        # False disables; a PrefixCacheManager instance is used as-is.
+        # False disables; a PrefixCacheManager instance is used as-is. With
+        # llm_kv_device_bytes / llm_kv_spill_dir set the cache is the TIERED
+        # hierarchy (kvcache/tiers.py): a device-resident hot tier above the
+        # host pool (mesh-sharded on TP engines, so hot attaches are
+        # zero-H2D) and an async disk spill tier below it.
         if prefix_cache is None and CONFIG.llm_prefix_cache_bytes > 0:
-            from ray_tpu.llm.kvcache import PrefixCacheManager
+            if CONFIG.llm_kv_device_bytes > 0 or CONFIG.llm_kv_spill_dir:
+                from ray_tpu.llm.kvcache import TieredPrefixCacheManager
 
-            prefix_cache = PrefixCacheManager(
-                CONFIG.llm_kv_block_size, CONFIG.llm_prefix_cache_bytes,
-                name=f"engine-{id(self):x}",
-            )
+                prefix_cache = TieredPrefixCacheManager(
+                    CONFIG.llm_kv_block_size, CONFIG.llm_prefix_cache_bytes,
+                    name=f"engine-{id(self):x}",
+                    device_bytes=CONFIG.llm_kv_device_bytes,
+                    to_device=self._kv_block_to_device,
+                    spill_dir=CONFIG.llm_kv_spill_dir,
+                    spill_bytes=CONFIG.llm_kv_spill_bytes,
+                )
+            else:
+                from ray_tpu.llm.kvcache import PrefixCacheManager
+
+                prefix_cache = PrefixCacheManager(
+                    CONFIG.llm_kv_block_size, CONFIG.llm_prefix_cache_bytes,
+                    name=f"engine-{id(self):x}",
+                )
         self._prefix_cache = prefix_cache or None
         if max_queue_depth is None:
             max_queue_depth = CONFIG.llm_max_queue_depth
@@ -402,8 +418,10 @@ class DecodeEngine:
         self._recorder = FlightRecorder(name=f"engine-{id(self):x}")
         self._serve_metrics = ServeMetrics(name=f"{id(self):x}")
         # Diagnostics for benches/tests: shape of the most recent prefill
-        # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only).
+        # dispatch (offset > 0 means a prefix-cache hit prefilled suffix-only)
+        # and of the most recent cache attach (which tier served the rows).
         self.last_prefill: Optional[dict] = None
+        self.last_attach: Optional[dict] = None
         self._jit_decode_multi = jax.jit(
             self._decode_multi, static_argnames=("n",)
         )  # jax caches one program per distinct static n
@@ -729,12 +747,51 @@ class DecodeEngine:
         ])
         self._prefix_cache.insert(prompt[:n], kv, namespace=adapter)
 
+    def _kv_block_to_device(self, host_kv):
+        """Hot-tier promotion copy: one [L, 2, bs, Hkv, D] block onto this
+        engine's device layout — mesh-sharded on kv heads for TP engines, so
+        a hot-tier attach is mesh-resident (docs/serving_tp.md), plain
+        device_put otherwise."""
+        if self._mesh is not None:
+            return jax.device_put(
+                host_kv, kv_prefix_sharding(self._mesh, self.cfg.n_kv_heads)
+            )
+        return jax.device_put(host_kv)
+
     def prefix_cache_stats(self) -> Optional[dict]:
-        """Hit/eviction/residency counters of the paged KV prefix cache
-        (None when the cache is disabled). See docs/kvcache.md."""
+        """Hit/eviction/residency counters of the paged KV prefix cache,
+        incl. the per-tier breakdown for a tiered cache (None when the cache
+        is disabled). This is a REPORT path: the tiered cache's
+        llm_kv_tier_* metric deltas flush here. See docs/kvcache.md."""
         if self._prefix_cache is None:
             return None
         return self._prefix_cache.stats()
+
+    # -- cluster prefix plane (docs/kvcache.md) -----------------------------
+    def lease_prefix(self, token_ids: List[int], lora: str = ""):
+        """Full-coverage lease of this engine's longest cached prefix of
+        token_ids (no len-1 cap: the peer wants every cached row) — the
+        EXPORT side of the cross-replica prefix fetch. None when the cache
+        is disabled or cold. Caller must release() the lease once the
+        transfer's send leg is done."""
+        if self._prefix_cache is None:
+            return None
+        return self._prefix_cache.lease_prefix(
+            token_ids, namespace=self._adapter_index(lora)
+        )
+
+    def insert_prefix(self, token_ids: List[int], kv: np.ndarray,
+                      lora: str = "") -> int:
+        """Feed a prefix fetched from a PEER replica into this engine's
+        cache (the IMPORT side of the cross-replica fetch): the next lookup
+        for these tokens hits locally and prefills suffix-only."""
+        if self._prefix_cache is None:
+            return 0
+        adapter = self._adapter_index(lora)
+        insert = getattr(self._prefix_cache, "insert_remote", None)
+        if insert is None:
+            insert = self._prefix_cache.insert
+        return insert(token_ids, kv, namespace=adapter)
 
     def scheduler_stats(self) -> dict:
         """Iteration-level scheduler occupancy (per-phase token counters,
@@ -745,6 +802,10 @@ class DecodeEngine:
         out = self._sched.stats()
         if self._adapters is not None:
             out["adapters"] = self._adapters.stats()
+        if self._prefix_cache is not None:
+            # Report-path flush of the cache counters incl. the tiered
+            # llm_kv_tier_* metric deltas (never from the decode loop).
+            out["prefix_cache"] = self._prefix_cache.stats()
         if self._draft is not None:
             spec = dict(self._spec_counters)
             spec["accept_rate"] = (
@@ -761,6 +822,10 @@ class DecodeEngine:
         task events for timeline()/OTel (docs/observability.md)."""
         self._serve_metrics.flush()
         self._recorder.flush_task_events()
+        if self._prefix_cache is not None:
+            # The tiered cache's llm_kv_tier_* deltas ride the same
+            # report-path contract (stats() is where they flush).
+            self._prefix_cache.stats()
         return self._recorder.stats()
 
     def recorder_stats(self) -> dict:
@@ -790,6 +855,18 @@ class DecodeEngine:
             },
             "trace_id": summary["trace_id"],
         }
+
+    def _leased_kv(self, lease):
+        """Materialize a lease's prefix rows from the best tier: the tiered
+        cache's device hot tier when every block holds a device copy (a jax
+        array — zero H2D on attach, mesh-sharded on TP engines), else the
+        host blocks (numpy)."""
+        dev_kv = getattr(self._prefix_cache, "device_kv", None)
+        if dev_kv is not None:
+            kv = dev_kv(lease)
+            if kv is not None:
+                return kv
+        return lease.kv()
 
     def _attach_kv(self, caches, kv, slot):
         """Write a transferred KV prefix into slot's cache rows [0, P).
@@ -966,6 +1043,7 @@ class DecodeEngine:
         try:
             adapter_slot = 0 if handle is None else handle.slot
             lease = None
+            tier = "host"
             if self._prefix_cache is not None:
                 lease = self._prefix_cache.lookup(prompt, namespace=adapter)
             if lease is not None:
@@ -975,6 +1053,7 @@ class DecodeEngine:
                 # eviction for the rest of the engine's life.
                 try:
                     m = lease.matched_tokens
+                    tier = getattr(lease, "tier", "host")
                     prefix_kv = lease.kv()  # [L, 2, m, Hkv, D] (copied: safe to release)
                 finally:
                     lease.release()
@@ -1040,10 +1119,11 @@ class DecodeEngine:
                 handle.release()
         self.last_prefill = {
             "offset": m, "prompt_len": len(prompt), "detached": True,
+            "tier": tier,
         }
         if rec is not None:
             rec.span("prefill-detached", t_pf0, time.time(),
-                     prompt_len=len(prompt), cached_tokens=m)
+                     prompt_len=len(prompt), cached_tokens=m, tier=tier)
             # Prefill-only records carry no generated tokens, so they feed
             # the ring/trace export but NOT the TTFT/TPOT SLO metrics.
             self._recorder.finish(rec)
@@ -1165,6 +1245,9 @@ class DecodeEngine:
         # and span handles balance on engine shutdown by construction —
         # leaksan's flight_record books prove it.
         self._recorder.close()
+        close_cache = getattr(self._prefix_cache, "close", None)
+        if close_cache is not None:
+            close_cache()  # tiered cache: flush + stop the kv-spill worker
         self._release_mesh_state()
 
     def _release_mesh_state(self):
@@ -1244,21 +1327,30 @@ class DecodeEngine:
             # and the scheduler drain would release it too, but only after
             # req.lease was cleared here, so the release must not depend on
             # the happy path.
+            tier = getattr(req.lease, "tier", "host")
             try:
-                prefix_kv = req.lease.kv()
+                prefix_kv = self._leased_kv(req.lease)
+                if isinstance(prefix_kv, np.ndarray):
+                    xp = np
+                    if tier == "device":
+                        tier = "host"  # device copies dropped mid-lease
+                else:
+                    xp = jnp  # device hot tier: the attach is zero-H2D
                 mb = self._bucket(req.cached_offset)
                 if prefix_kv.shape[2] < mb:
-                    pad = np.zeros(
+                    pad = xp.zeros(
                         (prefix_kv.shape[0], 2, mb - prefix_kv.shape[2])
-                        + prefix_kv.shape[3:], prefix_kv.dtype,
+                        + tuple(prefix_kv.shape[3:]), prefix_kv.dtype,
                     )
-                    prefix_kv = np.concatenate([prefix_kv, pad], axis=2)
+                    prefix_kv = xp.concatenate([prefix_kv, pad], axis=2)
                 attach = self._program(
                     self._jit_prefill, ("attach", mb),
                     lambda: jax.jit(self._attach_kv),
                 )
                 self._caches = attach(
-                    self._caches, jnp.asarray(prefix_kv), jnp.int32(slot)
+                    self._caches,
+                    prefix_kv if xp is jnp else jnp.asarray(prefix_kv),
+                    jnp.int32(slot),
                 )
             finally:
                 req.lease.release()
@@ -1266,8 +1358,17 @@ class DecodeEngine:
             if rec is not None:
                 # Host-stamped dispatch span (the copy is staged async; a
                 # blocking wait here would be the RL603 sync jaxlint bans).
+                # The tier field says which tier SERVED the rows
+                # (device/host/disk); a prefix the router fetched from a
+                # peer replica's cache reports as "remote" for this first
+                # post-fetch request (docs/observability.md).
+                if rec.route == "remote_fetch":
+                    tier = "remote"
                 rec.span("cache-attach", t_attach, time.time(),
-                         cached_tokens=req.cached_offset)
+                         cached_tokens=req.cached_offset, tier=tier)
+            self.last_attach = {
+                "tier": tier, "cached_tokens": req.cached_offset,
+            }
         t_chunk = time.time()
         padded = np.zeros((1, chunk.bucket), np.int32)
         padded[0, : len(chunk.tokens)] = chunk.tokens

@@ -189,7 +189,8 @@ class DPRouter:
         # prefix cache, which is namespaced BY adapter) is already hot.
         self._adapter_res: Dict[object, OrderedDict] = {}
         self._routing = {"cache_routed": 0, "balanced": 0, "untracked": 0,
-                         "adapter_routed": 0}
+                         "adapter_routed": 0, "remote_fetched": 0,
+                         "remote_fetch_failed": 0}
 
     # -- prefix fingerprints -----------------------------------------------
     def _chain(self, token_ids: List[int]) -> List[int]:
@@ -218,14 +219,29 @@ class DPRouter:
             while len(res) > self.ADAPTER_CAP:
                 res.popitem(last=False)
 
+    def _match_len(self, actor_id, chain: List[int]) -> int:
+        fps = self._fingerprints.get(actor_id) or ()
+        m = 0
+        for h in chain:
+            if h not in fps:
+                break
+            m += 1
+        return m
+
     def _pick(self, chain: List[int], adapter: str = ""):
-        """(replica, router, mode). Preference order: a replica already
-        holding the request's ADAPTER (longest prefix match among holders as
-        the tie-break, least-loaded otherwise — the shared affinity_pick
-        helper behind serve multiplexing), then the longest-expected-prefix
-        replica, then the balanced pow-2 pick. Every preference is
-        imbalance-guarded: paging an adapter (or recomputing a prefix) is
-        cheaper than queueing behind a hot spot."""
+        """(replica, router, mode, holder). Preference order: a replica
+        already holding the request's ADAPTER (longest prefix match among
+        holders as the tie-break, least-loaded otherwise — the shared
+        affinity_pick helper behind serve multiplexing), then the
+        longest-expected-prefix replica, then the balanced pow-2 pick. Every
+        preference is imbalance-guarded: paging an adapter (or recomputing a
+        prefix) is cheaper than queueing behind a hot spot.
+
+        `holder` is the best prefix-holding replica when the CHOSEN replica
+        is a different one (holder overloaded, or adapter routing won) —
+        the cluster prefix plane's fetch source (docs/kvcache.md): instead
+        of recomputing, the chosen replica can pull the prefix from the
+        holder's cache over a DeviceChannel stream."""
         from ray_tpu.serve.handle import affinity_pick
 
         router = self._server.generate._get_router()
@@ -243,6 +259,20 @@ class DPRouter:
             least = min(loads.get(x._actor_id, 0) for x in replicas)
             return loads.get(r._actor_id, 0) - least > self.IMBALANCE_TOLERANCE
 
+        # Best prefix holder fleet-wide (fetch source when the pick differs).
+        best, best_len = None, 0
+        for r in replicas:
+            m = self._match_len(r._actor_id, chain)
+            if m > best_len:
+                best, best_len = r, m
+
+        def result(picked, mode):
+            holder = None
+            if (best is not None
+                    and picked._actor_id != best._actor_id):
+                holder = best
+            return picked, router, mode, holder
+
         if adapter:
             holder_ids = {
                 aid for aid, res in self._adapter_res.items() if adapter in res
@@ -250,39 +280,57 @@ class DPRouter:
             if holder_ids:
                 # Among adapter holders, a prefix match wins; otherwise the
                 # least-loaded holder (the multiplex affinity primitive).
-                best, best_len = None, 0
+                abest, abest_len = None, 0
                 for r in replicas:
                     if r._actor_id not in holder_ids:
                         continue
-                    fps = self._fingerprints.get(r._actor_id) or ()
-                    m = 0
-                    for h in chain:
-                        if h not in fps:
-                            break
-                        m += 1
-                    if best is None or m > best_len:
-                        best, best_len = r, m
-                if best is not None and best_len == 0:
-                    best = affinity_pick(replicas, holder_ids, loads)
-                if best is not None and not overloaded(best):
-                    return router.pick_replica(best), router, "adapter_routed"
-        best, best_len = None, 0
-        for r in replicas:
-            fps = self._fingerprints.get(r._actor_id)
-            if not fps:
-                continue
-            m = 0
-            for h in chain:
-                if h not in fps:
-                    break
-                m += 1
-            if m > best_len:
-                best, best_len = r, m
-        if best is not None and overloaded(best):
-            best = None
-        if best is not None:
-            return router.pick_replica(best), router, "cache_routed"
-        return router.pick(""), router, "balanced"
+                    m = self._match_len(r._actor_id, chain)
+                    if abest is None or m > abest_len:
+                        abest, abest_len = r, m
+                if abest is not None and abest_len == 0:
+                    abest = affinity_pick(replicas, holder_ids, loads)
+                if abest is not None and not overloaded(abest):
+                    return result(router.pick_replica(abest), "adapter_routed")
+        if best is not None and not overloaded(best):
+            return result(router.pick_replica(best), "cache_routed")
+        return result(router.pick(""), "balanced")
+
+    @staticmethod
+    def _remote_fetch_enabled() -> bool:
+        from ray_tpu._private.config import CONFIG
+
+        return bool(CONFIG.llm_kv_remote_fetch)
+
+    async def _remote_fetch(self, holder, replica, token_ids: List[int],
+                            adapter: str) -> bool:
+        """Pull token_ids' prefix from `holder`'s cache into `replica`'s:
+        export on the holder (lease + background DeviceChannel send), import
+        on the destination (stream recv + cache insert). Control calls ride
+        the replicas' ordinary handle_request path; the KV payload rides the
+        stream — it never passes through this router. Best-effort by
+        contract: any failure means the destination just recomputes."""
+        loop = asyncio.get_running_loop()
+
+        def fetch() -> bool:
+            try:
+                desc = ray_tpu.get(
+                    holder.handle_request.remote(
+                        "export_prefix", (list(token_ids),), {"lora": adapter}
+                    ), 30,
+                )
+                if not desc:
+                    return False
+                inserted = ray_tpu.get(
+                    replica.handle_request.remote(
+                        "import_prefix", (desc, list(token_ids)),
+                        {"lora": adapter},
+                    ), 30,
+                )
+                return bool(inserted)
+            except Exception:
+                return False
+
+        return await loop.run_in_executor(None, fetch)
 
     def _submit(self, router, replica, args: tuple, kwargs: dict):
         """Dispatch to the chosen replica with the handle's exact in-flight
@@ -318,8 +366,22 @@ class DPRouter:
             # balanced fanout.
             self._routing["untracked"] += 1
             return await self._server.generate.remote(prompt, **kw)
-        replica, router, mode = self._pick(chain, adapter)
-        self._routing[mode] += 1
+        replica, router, mode, holder = self._pick(chain, adapter)
+        if (holder is not None and token_ids is not None
+                and self._remote_fetch_enabled()):
+            # Cluster prefix plane (docs/kvcache.md): the chosen replica
+            # pulls the prefix from the holder's cache over a DeviceChannel
+            # stream BEFORE the request lands, so its local lookup hits and
+            # prefill is suffix-only. N replicas' memory (plus their disk
+            # tiers) act as one logical prefix store; a failed fetch is a
+            # recompute, never an error.
+            if await self._remote_fetch(holder, replica, token_ids, adapter):
+                mode = "remote_fetch"
+                self._routing["remote_fetched"] += 1
+            else:
+                self._routing["remote_fetch_failed"] += 1
+        if mode != "remote_fetch":
+            self._routing[mode] += 1
         self._record(replica._actor_id, chain, adapter)
         # Router-side tokenization rides along: replicas accept token lists.
         # The routing reason rides too — the replica's flight recorder stamps

@@ -6,7 +6,10 @@ engine's static-bucket TPU layout: KV blocks live HOST-side in a ref-counted
 pool (`block_pool.py`), a radix/trie index over token-id chunks maps prefixes
 to block chains (`radix.py`), and `PrefixCacheManager` (`manager.py`) leases
 the longest cached prefix to the engine's padded-bucket attach path so only
-the prompt suffix pays prefill FLOPs. See docs/kvcache.md for the design and
+the prompt suffix pays prefill FLOPs. `tiers.py` grows the flat pool into a
+device/host/disk hierarchy (`TieredPrefixCacheManager`): device-resident hot
+blocks attach with zero H2D copies, and host eviction spills to local disk
+instead of discarding. See docs/kvcache.md for the design and
 docs/divergences.md for where the block layout deliberately differs from the
 GPU references.
 """
@@ -14,6 +17,11 @@ GPU references.
 from ray_tpu.llm.kvcache.block_pool import KVBlockPool
 from ray_tpu.llm.kvcache.manager import PrefixCacheManager, PrefixLease
 from ray_tpu.llm.kvcache.radix import RadixIndex, RadixNode
+from ray_tpu.llm.kvcache.tiers import (
+    DeviceHotTier,
+    DiskSpillStore,
+    TieredPrefixCacheManager,
+)
 
 __all__ = [
     "KVBlockPool",
@@ -21,4 +29,7 @@ __all__ = [
     "PrefixLease",
     "RadixIndex",
     "RadixNode",
+    "DeviceHotTier",
+    "DiskSpillStore",
+    "TieredPrefixCacheManager",
 ]

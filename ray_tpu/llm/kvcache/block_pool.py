@@ -53,11 +53,18 @@ class KVBlockPool:
 
     def put(self, kv: np.ndarray) -> int:
         """Store one block (copied: callers pass views of readback buffers)."""
+        return self.put_owned(np.ascontiguousarray(kv))
+
+    def put_owned(self, kv: np.ndarray) -> int:
+        """Store one block the caller already copied/owns (no second copy).
+        The manager's insert path stages its copies OUTSIDE the manager lock
+        and hands the owned arrays in here, so the lock never covers a bulk
+        memcpy (the lookup-contention fix, docs/kvcache.md)."""
         if kv.shape[2] != self.block_size:
             raise ValueError(
                 f"block rows {kv.shape[2]} != pool block_size {self.block_size}"
             )
-        block = KVBlock(next(self._ids), np.ascontiguousarray(kv))
+        block = KVBlock(next(self._ids), kv)
         block.last_used = next(self._clock)
         self._blocks[block.block_id] = block
         self.bytes_resident += block.kv.nbytes

@@ -76,7 +76,7 @@ class PrefixLease:
     """A pinned cached prefix: block chain + token count, released after attach."""
 
     __slots__ = ("_manager", "block_ids", "matched_tokens", "namespace",
-                 "_released", "__weakref__")
+                 "tier", "_released", "__weakref__")
 
     def __init__(self, manager: "PrefixCacheManager", block_ids: List[int],
                  matched_tokens: int, namespace: int):
@@ -84,6 +84,10 @@ class PrefixLease:
         self.block_ids = block_ids
         self.matched_tokens = matched_tokens
         self.namespace = namespace
+        # Which tier served this hit: "host" for the flat manager; the
+        # tiered manager (tiers.py) stamps "device" / "disk" so the engine's
+        # cache-attach flight event can carry it (docs/observability.md).
+        self.tier = "host"
         self._released = False
         _leaksan.track(
             "kv_lease", self,
@@ -127,6 +131,9 @@ class PrefixCacheManager:
         self._counters = {
             "lookups": 0, "hits": 0, "misses": 0, "hit_tokens": 0,
             "inserted_blocks": 0, "evicted_blocks": 0, "rejected_blocks": 0,
+            # Full-coverage leases handed to the cluster prefix plane
+            # (lease_prefix): cross-replica exports, not serving hits.
+            "exports": 0,
             # Leases pinned right now. With the iteration-level scheduler a
             # lease can span plan->attach across an engine iteration, so the
             # live count is real observability (a stuck lease pins its chain
@@ -161,19 +168,50 @@ class PrefixCacheManager:
         self._emit("hit_tokens", matched)
         return PrefixLease(self, block_ids, matched, namespace)
 
+    def lease_prefix(self, token_ids: Sequence[int], namespace: int = 0
+                     ) -> Optional[PrefixLease]:
+        """Full-coverage lease of the longest cached whole-block prefix —
+        the EXPORT path of the cluster prefix plane (docs/kvcache.md): no
+        len-1 cap (nothing prefills here; the peer wants every cached row),
+        and the hit/miss counters are untouched (an export is not serving
+        traffic). The lease pins its chain until the transfer's send leg
+        finishes — release it in a finally."""
+        token_ids = list(token_ids)
+        with self._lock:
+            nodes = self._radix.match(token_ids, namespace)
+            if not nodes:
+                return None
+            block_ids = [n.block_id for n in nodes]
+            self._pool.incref(block_ids)
+            self._pool.touch(block_ids)
+            self._counters["exports"] += 1
+            self._counters["leases_active"] += 1
+        return PrefixLease(self, block_ids,
+                           len(block_ids) * self.block_size, namespace)
+
     def _release(self, block_ids: List[int]):
         with self._lock:
             self._pool.decref(block_ids)
             self._counters["leases_active"] -= 1
 
     # -- insert ------------------------------------------------------------
+    def _stage_block(self, kv: np.ndarray, i: int) -> np.ndarray:
+        """Copy chunk i's rows out of the caller's kv into an owned block
+        array. ALWAYS runs with the manager lock NOT held: for a multi-MB
+        prompt this memcpy is the dominant cost of insert, and holding the
+        lock across it stalls every concurrent lookup (the lock-contention
+        fix; tests/test_llm_kvtier.py pins the invariant)."""
+        bs = self.block_size
+        return np.ascontiguousarray(kv[:, :, i * bs : (i + 1) * bs])
+
     def insert(self, token_ids: Sequence[int], kv: np.ndarray,
                namespace: int = 0) -> int:
         """Insert the KV rows of token_ids' whole blocks. kv is
         [L, 2, P, Hkv, D] with P >= the whole-block token count; rows beyond
         it are ignored (padded buckets pass through unsliced). Existing chain
-        prefixes dedup against the tree; new blocks are copied into the pool,
-        evicting LRU unreferenced chain tails to fit. Returns blocks added."""
+        prefixes dedup against the tree; new blocks are copied into the pool
+        (the copies staged OUTSIDE the manager lock), evicting LRU
+        unreferenced chain tails to fit. Returns blocks added."""
         token_ids = list(token_ids)
         chunks = self._radix.chunks(token_ids)
         if not chunks:
@@ -183,7 +221,12 @@ class PrefixCacheManager:
                 f"kv has {kv.shape[2]} rows < {len(chunks)} blocks of "
                 f"{self.block_size}"
             )
-        bs = self.block_size
+        # Peek the dedup point, then stage the new blocks' copies unlocked.
+        with self._lock:
+            n_peek = len(self._radix.match(token_ids, namespace))
+        staged: Dict[int, np.ndarray] = {
+            i: self._stage_block(kv, i) for i in range(n_peek, len(chunks))
+        }
         with self._lock:
             existing = self._radix.match(token_ids, namespace)
             # match() is uncapped here; it can cover every chunk (full dedup).
@@ -197,13 +240,19 @@ class PrefixCacheManager:
             new_ids: List[Optional[int]] = []
             try:
                 for i in range(n_existing, len(chunks)):
-                    block = kv[:, :, i * bs : (i + 1) * bs]
+                    # Rare race: the chain SHRANK between peek and lock
+                    # (eviction freed part of it), so blocks below the peek
+                    # point weren't staged — copy them here; the gap is at
+                    # most the few blocks eviction took, not the whole kv.
+                    block = staged.get(i)
+                    if block is None:
+                        block = self._stage_block(kv, i)
                     if not self._evict_to_fit(block.nbytes):
                         # Everything evictable is gone and ref-held blocks fill
                         # the budget: drop the chain tail rather than overshoot.
                         self._counters["rejected_blocks"] += len(chunks) - i
                         break
-                    new_ids.append(self._pool.put(block))
+                    new_ids.append(self._pool.put_owned(block))
                 if new_ids:
                     self._radix.insert(
                         chunks, [None] * n_existing + new_ids, namespace

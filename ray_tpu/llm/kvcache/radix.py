@@ -20,7 +20,7 @@ Chunk = Tuple[int, ...]
 
 
 class RadixNode:
-    __slots__ = ("key", "block_id", "parent", "children")
+    __slots__ = ("key", "block_id", "parent", "children", "namespace")
 
     def __init__(self, key: Optional[Chunk], block_id: Optional[int],
                  parent: Optional["RadixNode"]):
@@ -28,6 +28,7 @@ class RadixNode:
         self.block_id = block_id  # None only at the root
         self.parent = parent
         self.children: Dict[Chunk, RadixNode] = {}
+        self.namespace = 0        # meaningful only at roots (set by _root)
 
     def is_leaf(self) -> bool:
         return not self.children
@@ -54,7 +55,22 @@ class RadixIndex:
         root = self._roots.get(namespace)
         if root is None:
             root = self._roots[namespace] = RadixNode(None, None, None)
+            root.namespace = namespace
         return root
+
+    def chain_of(self, node: RadixNode) -> Tuple[int, List[int]]:
+        """(namespace, token ids root..node) — the identity of the prefix a
+        node's block caches; the spill tier's content address is derived
+        from exactly this (tiers.py)."""
+        chunks: List[Chunk] = []
+        n = node
+        while n.parent is not None:
+            chunks.append(n.key)
+            n = n.parent
+        tokens: List[int] = []
+        for chunk in reversed(chunks):
+            tokens.extend(chunk)
+        return n.namespace, tokens
 
     def match(self, token_ids: Sequence[int], namespace: int = 0) -> List[RadixNode]:
         """Longest chain of nodes covering a whole-block prefix of token_ids."""

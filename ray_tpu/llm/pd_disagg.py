@@ -16,6 +16,7 @@ transfer.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 import uuid
 from typing import Any, List, Optional, Union
@@ -59,6 +60,55 @@ class PrefillServer:
 
         kv_ref = device_objects.put(kv)
         return {"first_logits": first_logits, "kv": kv_ref, "prompt_len": prompt_len}
+
+    async def prefill_multicast(self, token_ids: List[int],
+                                num_subscribers: int, lora: str = "",
+                                request_id: Optional[str] = None) -> dict:
+        """One prefill feeding a whole DECODE GROUP (docs/device_channels.md
+        multicast): run the prefill once, then pump the KV prefix through a
+        MulticastDeviceChannel on a background thread — ONE D2H pass fanned
+        out to `num_subscribers` readers over the ring's per-subscriber
+        acks, instead of N point-to-point streams re-staging the same bytes
+        N times. A subscriber dead long enough to stall the ring is detached
+        (stall unwind) so it can never wedge the writer or its siblings.
+        Returns the picklable group descriptor; decode replica i passes
+        {"group": ..., "subscriber": i} as generate_prefilled's kv."""
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.current()
+        loop = asyncio.get_running_loop()
+        first_logits, kv, prompt_len = await loop.run_in_executor(
+            None, lambda: self._engine.prefill_detached(
+                token_ids, lora, request_id=request_id, trace_ctx=trace_ctx)
+        )
+        from ray_tpu.experimental.device_channel import MulticastDeviceChannel
+
+        owner = None
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            w = global_worker()
+            if w.actor_id is not None:
+                owner = ("actor", w.actor_id)
+        except RuntimeError:
+            pass  # no cluster (engine-level use): shm ring, same node
+        group = MulticastDeviceChannel.create(
+            num_subscribers, same_node=owner is None, owner=owner,
+        )
+
+        def pump():
+            try:
+                group.send(kv, stall_timeout=30.0)
+                group.drain(timeout=60.0)
+            except Exception:
+                pass  # every subscriber died: their generate calls surface it
+            finally:
+                group.destroy()
+
+        threading.Thread(target=pump, daemon=True,
+                         name="kv-multicast-pump").start()
+        return {"first_logits": first_logits, "prompt_len": prompt_len,
+                "group": group}
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
@@ -109,7 +159,33 @@ class DecodeServer:
         from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
 
         transfer_s = None
-        if isinstance(kv, DeviceObjectRef):
+        if isinstance(kv, dict) and "group" in kv:
+            # Multicast PD handoff (docs/device_channels.md): this replica is
+            # subscriber i of the prefill's one-writer fanout group — it
+            # reads the SAME staged chunk frames as its siblings (the writer
+            # paid one D2H pass for the whole group). The subscription is
+            # released in a finally: an unsubscribed-on-error reader detaches
+            # from ring back-pressure, so a crashing decode replica can't
+            # wedge the writer or the other subscribers.
+            import jax
+
+            to_device = jax.default_backend() != "cpu"
+            kv_sharding = self._engine.kv_transfer_sharding if to_device else None
+            group, index = kv["group"], int(kv["subscriber"])
+            sub = group.subscribe(index)
+            t_pull = time.monotonic()
+            try:
+                kv = await loop.run_in_executor(
+                    None,
+                    lambda: (
+                        sub.recv_device(timeout=120.0, sharding=kv_sharding)
+                        if to_device else sub.recv(timeout=120.0)
+                    ),
+                )
+            finally:
+                sub.unsubscribe()
+            transfer_s = time.monotonic() - t_pull
+        elif isinstance(kv, DeviceObjectRef):
             # Pull the KV prefix peer-to-peer from the prefill replica over
             # the chunked DeviceChannel stream. On real accelerators each
             # chunk is device_put as it arrives, so the H2D leg of the attach
@@ -228,6 +304,56 @@ class PDRouter:
                 "total_tokens": len(token_ids) + len(result["token_ids"]),
             },
             "prefill_s": t_prefill,
+            "latency_s": time.monotonic() - t0,
+        }
+
+    async def generate_multicast(self, prompt: Union[str, List[int]], *,
+                                 max_tokens: int = 64,
+                                 temperature: float = 0.0, top_k: int = 0,
+                                 stop_token_id: Optional[int] = None,
+                                 lora: str = "") -> dict:
+        """One prefill feeding EVERY decode replica (speculative group
+        decode / fanout evaluation): the prefill replica streams the KV
+        prefix through a multicast group — one D2H pass total — and each
+        decode replica continues generation from its own subscription.
+        Returns the per-replica results (token-identical under greedy
+        sampling: every replica attaches bit-identical rows)."""
+        import ray_tpu
+
+        t0 = time.monotonic()
+        rid = uuid.uuid4().hex
+        token_ids = (
+            self._tokenizer.encode(prompt) if isinstance(prompt, str)
+            else list(prompt)
+        )
+        router = self._decode.generate_prefilled._get_router()
+        replicas = router.replicas()
+        if not replicas:
+            raise RuntimeError("no decode replicas to multicast to")
+        pre = await self._prefill.prefill_multicast.remote(
+            token_ids, len(replicas), lora, request_id=rid,
+        )
+        loop = asyncio.get_running_loop()
+        kwargs = dict(
+            max_tokens=max_tokens, temperature=temperature, top_k=top_k,
+            stop_token_id=stop_token_id, lora=lora, token_ids=token_ids,
+        )
+        refs = [
+            r.handle_request.remote(
+                "generate_prefilled",
+                ({"group": pre["group"], "subscriber": i},
+                 pre["prompt_len"], pre["first_logits"]),
+                {**kwargs, "request_id": f"{rid}-{i}"},
+            )
+            for i, r in enumerate(replicas)
+        ]
+        results = await loop.run_in_executor(
+            None, lambda: [ray_tpu.get(ref, 300) for ref in refs]
+        )
+        return {
+            "results": results,
+            "replicas": len(replicas),
+            "prompt_tokens": len(token_ids),
             "latency_s": time.monotonic() - t0,
         }
 

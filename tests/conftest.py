@@ -38,6 +38,7 @@ def pytest_configure(config):
 LEAKSAN_SUITES = {
     "test_tensor_channel.py",
     "test_llm_kvcache.py",
+    "test_llm_kvtier.py",
     "test_llm_multitenant.py",
     "test_device_objects.py",
     "test_llm_tp.py",

@@ -1,0 +1,125 @@
+"""RL801 fixtures for the round-17 tiered-KV / multicast RESOURCE_TABLE
+rows: disk-spill file handles (open_spill -> commit/close), multicast
+subscriptions (subscribe -> unsubscribe), and cross-replica prefix-fetch
+leases (lease_prefix -> release). The fire/suppress shapes mirror
+case_rl8_adapter.py so the new obligations ride the exact same path
+analysis (docs/kvcache.md, docs/device_channels.md)."""
+
+
+# -- disk-spill file handle ---------------------------------------------------
+
+def bad_spill_never_closed(store, key, data):
+    f = store.open_spill(key)
+    f.write(data)
+
+
+def bad_spill_conditional(store, key, data, flag):
+    f = store.open_spill(key)
+    if flag:
+        f.commit()
+
+
+def bad_spill_risky_gap(store, key, encoder, data):
+    f = store.open_spill(key)
+    f.write(encoder.encode(data))
+    f.commit()
+
+
+def ok_spill_finally(store, key, data):
+    f = store.open_spill(key)
+    try:
+        f.write(data)
+        f.commit()
+    finally:
+        f.close()
+
+
+def ok_spill_with(store, key, data):
+    with store.open_spill(key) as f:
+        f.write(data)
+
+
+def ok_spill_returned(store, key):
+    return store.open_spill(key)
+
+
+def suppressed_spill(store, key, data):
+    f = store.open_spill(key)  # raylint: disable=RL801 (fixture: worker thread owns the commit)
+    f.write(data)
+
+
+# -- multicast subscription ---------------------------------------------------
+
+def bad_subscription_never_released(group, i):
+    sub = group.subscribe(i)
+    return sub.recv()
+
+
+def bad_subscription_conditional(group, i, flag):
+    sub = group.subscribe(i)
+    if flag:
+        sub.unsubscribe()
+
+
+def ok_subscription_finally(group, i):
+    sub = group.subscribe(i)
+    try:
+        return sub.recv()
+    finally:
+        sub.unsubscribe()
+
+
+def ok_subscription_with(group, i):
+    with group.subscribe(i) as sub:
+        return sub.recv()
+
+
+def ok_subscription_stored(self, group, i):
+    self._sub = group.subscribe(i)
+
+
+def suppressed_subscription(group, i):
+    sub = group.subscribe(i)  # raylint: disable=RL801 (fixture: the reply handler unsubscribes)
+    return sub.recv()
+
+
+# -- cross-replica prefix-fetch lease ----------------------------------------
+
+def bad_fetch_lease_never_released(cache, tokens):
+    lease = cache.lease_prefix(tokens)
+    return lease.kv()
+
+
+def bad_fetch_lease_risky_gap(cache, tokens, channel):
+    lease = cache.lease_prefix(tokens)
+    channel.send(lease.kv())
+    lease.release()
+
+
+def ok_fetch_lease_finally(cache, tokens, channel):
+    lease = cache.lease_prefix(tokens)
+    try:
+        channel.send(lease.kv())
+    finally:
+        lease.release()
+
+
+def ok_fetch_lease_returned(engine, tokens):
+    return engine.lease_prefix(tokens)
+
+
+def ok_fetch_lease_closure(cache, tokens, channel, spawn):
+    lease = cache.lease_prefix(tokens)
+
+    def pump():
+        try:
+            channel.send(lease.kv())
+        finally:
+            lease.release()
+
+    spawn(pump)
+
+
+def suppressed_fetch_lease(cache, tokens):
+    lease = cache.lease_prefix(tokens)  # raylint: disable=RL801 (fixture: export registry owns it)
+    return lease.kv()

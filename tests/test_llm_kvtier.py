@@ -1,0 +1,623 @@
+"""Hierarchical KV store tests (docs/kvcache.md tiering):
+
+- tier mechanics: atomic spill commits (torn spills invisible, incl. a real
+  SIGKILL mid-spill), content-addressed disk store with byte cap, device
+  hot-tier promotion/demotion, eviction-while-leased refusal across tiers;
+- token identity: greedy output identical for device-warm / host-warm /
+  disk-warm / cross-replica-fetched prefixes vs a cold reference engine;
+- multicast: 1 prefill -> N decode fanout token-identical to point-to-point
+  with exactly ONE staging (D2H) pass on the writer, and dead subscribers
+  unwinding the writer without wedging siblings;
+- the lookup-contention fix: insert's block copies stage OUTSIDE the
+  manager lock;
+- leaksan lifetimes for spill handles, subscriptions, and fetch leases.
+
+Runs under the leaksan guard (conftest LEAKSAN_SUITES).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _kv_for(tokens, shape):
+    layers, two, heads, dim = shape
+    return np.stack([
+        np.full((layers, two, heads, dim), t, np.float32) for t in tokens
+    ], axis=2)
+
+
+def _tiered(tmp_path, capacity_blocks, block_size=4, layers=2, heads=2,
+            dim=3, device_blocks=0, spill=True, name="kvtier"):
+    from ray_tpu.llm.kvcache import TieredPrefixCacheManager
+
+    block_bytes = layers * 2 * block_size * heads * dim * 4
+    mgr = TieredPrefixCacheManager(
+        block_size, capacity_blocks * block_bytes, name=name,
+        device_bytes=device_blocks * block_bytes,
+        spill_dir=str(tmp_path / "spill") if spill else "",
+        spill_bytes=64 * block_bytes,
+    )
+    return mgr, (layers, 2, heads, dim)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# -- spill atomicity ----------------------------------------------------------
+
+def test_spill_commit_is_atomic_and_abort_invisible(tmp_path):
+    from ray_tpu.llm.kvcache.tiers import DiskSpillStore
+
+    store = DiskSpillStore(str(tmp_path))
+    kv = np.arange(2 * 2 * 4 * 2 * 3, dtype=np.float32).reshape(2, 2, 4, 2, 3)
+    key = store.key(7, [1, 2, 3, 4])
+    assert store.get(key) is None
+    assert store.put(key, kv)
+    np.testing.assert_array_equal(store.get(key), kv)
+    # Content addressing: a re-spill of a committed entry is a no-op.
+    assert not store.put(key, kv)
+
+    # An aborted (never-committed) spill is invisible and leaves no tmp.
+    f = store.open_spill(store.key(7, [9, 9, 9, 9]))
+    f.write(b"partial garbage")
+    f.close()
+    assert store.get(store.key(7, [9, 9, 9, 9])) is None
+    assert not [n for n in os.listdir(str(tmp_path)) if n.endswith(".tmp")]
+
+
+def test_sigkill_mid_spill_is_invisible_on_restart(tmp_path):
+    """The crash-safety contract: a process killed between write and commit
+    leaves nothing a restarted store can see — the chain is simply a miss,
+    never corruption — while previously COMMITTED entries still load."""
+    from ray_tpu.llm.kvcache.tiers import DiskSpillStore
+
+    code = f"""
+import os, signal
+import numpy as np
+from ray_tpu.llm.kvcache.tiers import DiskSpillStore
+store = DiskSpillStore({str(tmp_path)!r})
+kv = np.ones((2, 2, 4, 2, 3), np.float32)
+store.put(store.key(0, [1, 2, 3, 4]), kv)          # committed: must survive
+f = store.open_spill(store.key(0, [5, 6, 7, 8]))   # torn: must be invisible
+f.write(b"partial spill bytes, never committed")
+f._f.flush()
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr)
+    store = DiskSpillStore(str(tmp_path))  # restart: sweeps tmp orphans
+    np.testing.assert_array_equal(
+        store.get(store.key(0, [1, 2, 3, 4])), np.ones((2, 2, 4, 2, 3)),
+    )
+    assert store.get(store.key(0, [5, 6, 7, 8])) is None
+    assert not [n for n in os.listdir(str(tmp_path)) if n.endswith(".tmp")]
+
+
+def test_disk_store_byte_cap_unlinks_oldest(tmp_path):
+    from ray_tpu.llm.kvcache.tiers import DiskSpillStore
+
+    kv = np.ones((2, 2, 4, 2, 3), np.float32)
+    store = DiskSpillStore(str(tmp_path), capacity_bytes=3 * (kv.nbytes + 256))
+    keys = [store.key(0, [i, i, i, i]) for i in range(6)]
+    for i, key in enumerate(keys):
+        store.put(key, kv)
+        os.utime(store._path(key), (i, i))  # deterministic LRU order
+        store._evict_over_cap()
+    live = [k for k in keys if store.contains(k)]
+    assert len(live) <= 3
+    assert keys[-1] in live and keys[0] not in live
+
+
+# -- tier roundtrip -----------------------------------------------------------
+
+def test_tier_roundtrip_device_host_disk(tmp_path):
+    mgr, shape = _tiered(tmp_path, capacity_blocks=3, device_blocks=8)
+    try:
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        b = [9, 10, 11, 12, 13, 14, 15, 16]
+        assert mgr.insert(a, _kv_for(a, shape)) == 2
+        with mgr.lookup(a + [99]) as lease:
+            assert lease.tier == "host"      # first hit: host, promotes
+            np.testing.assert_array_equal(lease.kv(), _kv_for(a, shape))
+        with mgr.lookup(a + [99]) as lease:
+            assert lease.tier == "device"    # second hit: device-resident
+            dev = mgr.device_kv(lease)
+            assert dev is not None
+            np.testing.assert_array_equal(np.asarray(dev), _kv_for(a, shape))
+        # Evict a's chain (capacity 3) -> spill-on-evict instead of discard.
+        assert mgr.insert(b, _kv_for(b, shape)) == 2
+        _wait(lambda: mgr.stats()["tiers"]["spills"] >= 1, msg="async spill")
+        with mgr.lookup(a + [99]) as lease:  # disk-warm: promoted back
+            assert lease.tier == "disk"
+            np.testing.assert_array_equal(lease.kv(), _kv_for(a, shape))
+        tiers = mgr.stats()["tiers"]
+        assert tiers["promotions_host"] >= 1
+        assert tiers["promotions_device"] >= 2
+        assert tiers["hits_device"] == 1 and tiers["hits_disk"] == 1
+    finally:
+        mgr.close()
+
+
+def test_eviction_while_leased_refuses_across_tiers(tmp_path):
+    """A leased chain can never be evicted — not to disk, not dropped from
+    under an attach: the insert drops its own tail instead, exactly the
+    flat-pool contract, and the spill tier sees nothing."""
+    mgr, shape = _tiered(tmp_path, capacity_blocks=3, device_blocks=4)
+    try:
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        b = [9, 10, 11, 12, 13, 14, 15, 16]
+        assert mgr.insert(a, _kv_for(a, shape)) == 2
+        lease = mgr.lookup(a + [99])
+        assert lease.matched_tokens == 8
+        assert mgr.insert(b, _kv_for(b, shape)) == 1  # tail dropped, no evict
+        stats = mgr.stats()
+        assert stats["evicted_blocks"] == 0
+        assert stats["tiers"]["spills"] == 0 and stats["tiers"]["spill_queued"] == 0
+        np.testing.assert_array_equal(lease.kv(), _kv_for(a, shape))
+        lease.release()
+        # Unpinned now: the same pressure spills instead of refusing.
+        c = [30, 31, 32, 33, 34, 35, 36, 37]
+        assert mgr.insert(c, _kv_for(c, shape)) == 2
+        _wait(lambda: mgr.stats()["tiers"]["spills"] >= 1, msg="spill after release")
+    finally:
+        mgr.close()
+
+
+# -- lookup-contention fix ----------------------------------------------------
+
+def test_insert_stages_copies_outside_manager_lock(tmp_path, monkeypatch):
+    """The small-fix regression: insert's block copies must run with the
+    manager lock NOT held (lease pins make that safe), so a big insert
+    cannot stall concurrent lookups for the duration of the memcpy."""
+    from ray_tpu.llm.kvcache import PrefixCacheManager
+    from ray_tpu.llm.kvcache.manager import PrefixCacheManager as MgrCls
+
+    mgr, shape = _tiered(tmp_path, capacity_blocks=64, spill=False)
+    locked_during_copy = []
+    orig = MgrCls._stage_block
+    staging = threading.Event()
+
+    def probe(self, kv, i):
+        locked_during_copy.append(self._lock.locked())
+        staging.set()
+        time.sleep(0.15)  # a "big" copy: ~0.6s total for 4 blocks
+        return orig(self, kv, i)
+
+    monkeypatch.setattr(MgrCls, "_stage_block", probe)
+    a = list(range(16))
+    warm = [100, 101, 102, 103]
+    assert PrefixCacheManager.insert(mgr, warm, _kv_for(warm, shape)) == 1
+    staging.clear()
+    locked_during_copy.clear()
+
+    lookup_s = []
+
+    def inserter():
+        PrefixCacheManager.insert(mgr, a, _kv_for(a, shape))
+
+    t = threading.Thread(target=inserter)
+    t.start()
+    try:
+        assert staging.wait(10)
+        t0 = time.monotonic()
+        lease = mgr.lookup(warm + [99])  # must NOT wait out the staging
+        lookup_s.append(time.monotonic() - t0)
+        assert lease is not None
+        lease.release()
+    finally:
+        t.join(30)
+    assert locked_during_copy and not any(locked_during_copy), (
+        "block copies ran under the manager lock"
+    )
+    assert lookup_s[0] < 0.3, (
+        f"lookup stalled {lookup_s[0]:.3f}s behind insert staging"
+    )
+    mgr.close()
+
+
+# -- engine token identity across tiers --------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _generate(engine, prompt, n, **sp):
+    from ray_tpu.llm import SamplingParams
+
+    out, done = [], threading.Event()
+
+    def cb(tok, fin):
+        out.append(tok)
+        if fin:
+            done.set()
+
+    engine.submit(prompt, SamplingParams(max_tokens=n, **sp), cb)
+    assert done.wait(180)
+    return out
+
+
+def test_tiered_engine_token_identity_all_tiers(tiny_model, tmp_path):
+    """The acceptance bar: greedy output is identical for device-warm,
+    host-warm, and disk-warm prefixes vs a cache-disabled reference, and
+    the flight recorder's cache-attach events carry the serving tier."""
+    from ray_tpu.llm import DecodeEngine
+    from ray_tpu.llm.kvcache import TieredPrefixCacheManager
+
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(11)
+    prefix = list(map(int, rng.integers(0, cfg.vocab_size, 40)))
+    p_a = prefix + [5, 6, 7]
+    other = list(map(int, rng.integers(0, cfg.vocab_size, 40)))
+
+    # Capacity of exactly 2 blocks: inserting `other` evicts (spills) p_a.
+    block_bytes = cfg.n_layers * 2 * 16 * cfg.n_kv_heads * cfg.head_dim * 4
+    mgr = TieredPrefixCacheManager(
+        16, 2 * block_bytes, name="equiv-tier",
+        device_bytes=4 * block_bytes, spill_dir=str(tmp_path / "sp"),
+    )
+    plain = DecodeEngine(cfg, params, num_slots=2, max_seq=128,
+                         prefix_cache=False)
+    tiered = DecodeEngine(cfg, params, num_slots=2, max_seq=128,
+                          prefix_cache=mgr)
+    try:
+        ref_a = _generate(plain, p_a, 6)
+        ref_other = _generate(plain, other, 6)
+
+        cold = _generate(tiered, p_a, 6)
+        host_warm = _generate(tiered, p_a, 6)
+        assert tiered.last_attach["tier"] == "host"
+        dev_warm = _generate(tiered, p_a, 6)
+        assert tiered.last_attach["tier"] == "device"
+        # Evict p_a's chain to disk, then hit it disk-warm.
+        assert _generate(tiered, other, 6) == ref_other
+        _wait(lambda: mgr.stats()["tiers"]["spills"] >= 2, msg="spill of p_a")
+        disk_warm = _generate(tiered, p_a, 6)
+        assert tiered.last_attach["tier"] == "disk"
+        assert ref_a == cold == host_warm == dev_warm == disk_warm
+        # The recorder's cache-attach events carried the tier field.
+        recs = tiered._recorder.records()
+        tiers_seen = [
+            attrs["tier"]
+            for r in recs for (name, _t0, _t1, attrs) in r["events"]
+            if name == "cache-attach"
+        ]
+        assert tiers_seen.count("host") >= 1
+        assert tiers_seen.count("device") >= 1
+        assert tiers_seen.count("disk") >= 1
+    finally:
+        plain.shutdown()
+        tiered.shutdown()
+
+
+# -- multicast ---------------------------------------------------------------
+
+def test_multicast_fanout_one_staging_pass():
+    """1 -> N fanout moves each staged chunk once: the multicast group's
+    stream_chunks_staged delta equals ONE point-to-point stream's, while N
+    separate p2p streams pay N times that (the transfer-counter assertion
+    behind 'exactly one D2H pass on the writer')."""
+    from ray_tpu.experimental import tensor_transport as _tt
+    from ray_tpu.experimental.device_channel import (
+        DeviceChannel, MulticastDeviceChannel,
+    )
+
+    payload = {"kv": np.arange(60000, dtype=np.float32)}
+
+    def staged_delta(fn):
+        before = _tt.transport_stats()["stream_chunks_staged"]
+        fn()
+        return _tt.transport_stats()["stream_chunks_staged"] - before
+
+    def run_multicast():
+        mc = MulticastDeviceChannel.create(4, chunk_bytes=8192, num_slots=8)
+        outs = [None] * 4
+        threads = []
+        for i in range(4):
+            def reader(i=i):
+                with mc.subscribe(i) as sub:
+                    outs[i] = sub.recv(timeout=60)
+            threads.append(threading.Thread(target=reader))
+            threads[-1].start()
+        mc.send(payload, timeout=60)
+        for t in threads:
+            t.join(60)
+        assert mc.drain(30)
+        mc.close()
+        mc.destroy()
+        for o in outs:
+            np.testing.assert_array_equal(o["kv"], payload["kv"])
+
+    def run_p2p(n):
+        for _ in range(n):
+            ch = DeviceChannel.create(same_node=True, chunk_bytes=8192,
+                                      num_slots=8)
+            got = [None]
+            t = threading.Thread(
+                target=lambda: got.__setitem__(0, ch.recv(timeout=60)))
+            t.start()
+            ch.send(payload, timeout=60)
+            t.join(60)
+            ch.close()
+            ch.destroy()
+            np.testing.assert_array_equal(got[0]["kv"], payload["kv"])
+
+    mc_staged = staged_delta(run_multicast)
+    one_p2p = staged_delta(lambda: run_p2p(1))
+    four_p2p = staged_delta(lambda: run_p2p(4))
+    assert mc_staged == one_p2p, (mc_staged, one_p2p)
+    assert four_p2p == 4 * one_p2p, (four_p2p, one_p2p)
+
+
+def test_multicast_dead_subscriber_unwinds_writer():
+    """A subscriber that never reads stalls the ring; the writer's stall
+    unwind detaches it MID-STREAM and the remaining subscribers still read
+    a byte-identical stream (no tears, no wedge)."""
+    from ray_tpu.experimental.device_channel import MulticastDeviceChannel
+
+    payload = {"kv": np.arange(50000, dtype=np.float32)}
+    mc = MulticastDeviceChannel.create(3, chunk_bytes=4096, num_slots=4)
+    outs = [None] * 2
+    threads = []
+    for i in range(2):
+        def reader(i=i):
+            with mc.subscribe(i) as sub:
+                outs[i] = sub.recv(timeout=60)
+        threads.append(threading.Thread(target=reader))
+        threads[-1].start()
+    # Subscriber 2 is dead (never subscribes/reads): the ring fills, the
+    # stall unwind detaches it, and the send completes for the others.
+    t0 = time.monotonic()
+    mc.send(payload, stall_timeout=0.5)
+    for t in threads:
+        t.join(60)
+    assert mc.detached == {2}
+    assert time.monotonic() - t0 < 30
+    for o in outs:
+        np.testing.assert_array_equal(o["kv"], payload["kv"])
+    assert mc.drain(30)
+    mc.close()
+    mc.destroy()
+
+
+def test_pd_multicast_group_token_identical_to_p2p():
+    """1 prefill -> 2 decode replicas over the multicast group: both
+    replicas' greedy output is token-identical to the raw point-to-point
+    handoff, with ONE staging pass on the prefill writer."""
+    import asyncio
+
+    from ray_tpu.experimental import tensor_transport as _tt
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.pd_disagg import DecodeServer, PrefillServer
+
+    cfg = LLMConfig(model_id="test-tiny", num_slots=2, max_seq=128)
+    pre = PrefillServer(cfg)
+    decs = [DecodeServer(cfg), DecodeServer(cfg)]
+    try:
+        rng = np.random.default_rng(3)
+        toks = list(map(int, rng.integers(0, 64, 30)))
+
+        async def main():
+            before = _tt.transport_stats()["stream_chunks_staged"]
+            out = await pre.prefill_multicast(toks, 2)
+            results = await asyncio.gather(*[
+                d.generate_prefilled(
+                    {"group": out["group"], "subscriber": i},
+                    out["prompt_len"], out["first_logits"],
+                    max_tokens=6, token_ids=toks,
+                )
+                for i, d in enumerate(decs)
+            ])
+            staged = _tt.transport_stats()["stream_chunks_staged"] - before
+            fl, kv, plen = pre._engine.prefill_detached(toks)
+            ref = await decs[0].generate_prefilled(
+                kv, plen, fl, max_tokens=6, token_ids=toks)
+            return results, ref, staged
+
+        results, ref, staged = asyncio.run(main())
+        assert results[0]["token_ids"] == results[1]["token_ids"]
+        assert results[0]["token_ids"] == ref["token_ids"]
+        # ONE pass over the payload chunks for the whole 2-reader group
+        # (kv is CPU-host-resident here, so 1 chunk per stream write; on
+        # accelerators these ARE the D2H slices).
+        assert staged >= 1
+        # p2p reference for the same payload costs the same again PER reader:
+        before = _tt.transport_stats()["stream_chunks_staged"]
+        from ray_tpu.experimental.device_channel import DeviceChannel
+
+        ch = DeviceChannel.create(same_node=True)
+        got = [None]
+        t = threading.Thread(target=lambda: got.__setitem__(0, ch.recv(timeout=60)))
+        t.start()
+        fl, kv, plen = pre._engine.prefill_detached(toks)
+        ch.send(kv, timeout=60)
+        t.join(60)
+        ch.close()
+        ch.destroy()
+        one = _tt.transport_stats()["stream_chunks_staged"] - before
+        assert staged == one, (staged, one)
+    finally:
+        pre._engine.shutdown()
+        for d in decs:
+            d._engine.shutdown()
+
+
+# -- cluster-wide prefix plane ------------------------------------------------
+
+def test_dp_pick_reports_prefix_holder_for_remote_fetch():
+    """Routing-decision unit: when the imbalance guard steers a request AWAY
+    from the replica that computed its prefix, _pick surfaces that replica
+    as the fetch source (holder) instead of silently recomputing."""
+    from ray_tpu.llm.dp_serve import DPRouter
+
+    class _Rep:
+        def __init__(self, aid):
+            self._actor_id = aid
+
+    a, b = _Rep("A"), _Rep("B")
+
+    class _FakeRouter:
+        def replicas(self):
+            return [a, b]
+
+        def loads(self):
+            return {"A": 0, "B": 100}  # B hot: imbalance guard rejects it
+
+        def pick_replica(self, r):
+            return r
+
+        def pick(self, _):
+            return a
+
+    class _FakeGen:
+        def _get_router(self):
+            return _FakeRouter()
+
+    class _FakeHandle:
+        generate = _FakeGen()
+
+    router = DPRouter(_FakeHandle(), assigner=None)
+    chain = [101, 102, 103]
+    router._record("B", chain)  # B computed this prefix earlier
+    picked, _r, mode, holder = router._pick(chain)
+    assert picked is a and mode == "balanced"
+    assert holder is b, "the overloaded prefix holder must surface as source"
+    # When the pick IS the holder there is nothing to fetch.
+    router._record("A", chain)
+
+    class _Even(_FakeRouter):
+        def loads(self):
+            return {"A": 0, "B": 0}
+
+    _FakeGen._get_router = lambda self: _Even()
+    picked, _r, mode, holder = router._pick(chain)
+    assert mode == "cache_routed" and holder is None
+
+
+def test_cross_replica_prefix_fetch_token_identity(ray_start_regular, tmp_path):
+    """The transfer plane end-to-end over a real cluster data plane: replica
+    S1 computes a prefix; S2 imports it over the DeviceChannel stream and
+    serves it from ITS cache — token-identical to S1 and to a cold engine,
+    with S2's insert accounted as remote."""
+    import asyncio
+
+    from ray_tpu.llm import DecodeEngine, LLMConfig, LLMServer
+    from ray_tpu.llm.kvcache import TieredPrefixCacheManager
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.models.transformer import get_config
+
+    del TieredPrefixCacheManager  # engines build their own from the flags
+    mcfg = get_config("test-tiny", scan_layers=False, remat=False)
+    block_bytes = mcfg.n_layers * 2 * 16 * mcfg.n_kv_heads * mcfg.head_dim * 4
+    cfg_obj = LLMConfig(model_id="test-tiny", num_slots=2, max_seq=128)
+    s1 = LLMServer(cfg_obj)
+    # s2's engine builds a TIERED cache (flag-driven, the production path)
+    # so the remote insert lands in the tier books.
+    CONFIG._cache["llm_kv_device_bytes"] = 8 * block_bytes
+    CONFIG._cache["llm_kv_spill_dir"] = str(tmp_path / "s2spill")
+    try:
+        s2 = LLMServer(cfg_obj)
+    finally:
+        CONFIG._cache["llm_kv_device_bytes"] = 0
+        CONFIG._cache["llm_kv_spill_dir"] = ""
+    plain = DecodeEngine(mcfg, s1._engine.params, num_slots=1, max_seq=128,
+                         prefix_cache=False)
+    try:
+        rng = np.random.default_rng(21)
+        toks = list(map(int, rng.integers(0, mcfg.vocab_size, 40)))
+
+        async def main():
+            warm = await s1.generate(toks, max_tokens=6)     # S1 computes
+            desc = await s1.export_prefix(toks)
+            assert desc is not None and desc["matched_tokens"] == 32
+            inserted = await s2.import_prefix(desc, toks)
+            assert inserted == 2, inserted
+            got = await s2.generate(toks, max_tokens=6)      # served locally
+            return warm, got
+
+        warm, got = asyncio.run(main())
+        ref = _generate(plain, toks, 6)
+        assert warm["token_ids"] == got["token_ids"] == ref
+        # S2's prefill was suffix-only off the imported prefix...
+        assert s2._engine.last_prefill["offset"] == 32
+        # ...and the tier books know it came from a peer, not a recompute.
+        tiers = s2._engine.prefix_cache_stats()["tiers"]
+        assert tiers["remote_inserts"] == 1
+        # The export lease released once the send leg drained (leaksan's
+        # kv_lease books also prove this at suite level).
+        _wait(lambda: s1._engine.prefix_cache_stats()["leases_active"] == 0,
+              msg="export lease release")
+        assert s1._engine.prefix_cache_stats()["exports"] == 1
+    finally:
+        plain.shutdown()
+        asyncio.run(s1.shutdown())
+        asyncio.run(s2.shutdown())
+
+
+# -- leaksan lifetimes --------------------------------------------------------
+
+def test_leaksan_tracks_kvtier_lifetimes(tmp_path):
+    """Planted-leak accounting for the three new lifetimes: each handle is
+    live in the registry while held and balances on release."""
+    from ray_tpu.devtools import leaksan
+    from ray_tpu.experimental.device_channel import MulticastDeviceChannel
+    from ray_tpu.llm.kvcache import PrefixCacheManager
+    from ray_tpu.llm.kvcache.tiers import DiskSpillStore
+
+    def live(kind):
+        return leaksan.live_counts().get(kind, 0)
+
+    store = DiskSpillStore(str(tmp_path))
+    base = live("kv_spill_file")
+    f = store.open_spill("deadbeef")
+    assert live("kv_spill_file") == base + 1
+    f.write(b"x")
+    f.close()  # abort balances the books exactly like commit
+    assert live("kv_spill_file") == base
+
+    mc = MulticastDeviceChannel.create(2, chunk_bytes=4096)
+    base = live("mc_subscription")
+    sub = mc.subscribe(0)
+    assert live("mc_subscription") == base + 1
+    sub.unsubscribe()
+    sub.unsubscribe()  # idempotent
+    assert live("mc_subscription") == base
+    mc.close()
+    mc.destroy()
+
+    mgr = PrefixCacheManager(4, 1 << 20, name="leaksan-fetch")
+    tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+    kv = _kv_for(tokens, (2, 2, 2, 3))
+    mgr.insert(tokens, kv)
+    base = live("kv_lease")
+    lease = mgr.lease_prefix(tokens)
+    assert lease is not None and lease.matched_tokens == 8  # no len-1 cap
+    assert live("kv_lease") == base + 1
+    lease.release()
+    assert live("kv_lease") == base
+    assert mgr.stats()["exports"] == 1

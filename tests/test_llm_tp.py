@@ -472,3 +472,68 @@ def test_reserve_tp_slice_placement_group(tpu_cluster):
 
     with pytest.raises(TimeoutError):
         reserve_tp_slice(8, resource="TPU", replicas=2, ready_timeout_s=3.0)
+
+
+# -- tiered hot tier on a mesh (docs/kvcache.md) -------------------------------
+
+_TIER_SNIPPET = r"""
+import json, threading
+import numpy as np
+import jax, jax.numpy as jnp
+from ray_tpu.models.transformer import Transformer, get_config
+from ray_tpu.llm._engine import DecodeEngine, SamplingParams
+
+cfg = get_config("test-tiny", scan_layers=False, remat=False, n_kv_heads=4)
+model = Transformer(cfg)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+rng = np.random.default_rng(13)
+prompt = list(map(int, rng.integers(0, cfg.vocab_size, 40))) + [3, 1]
+
+def generate(engine, p, n=8):
+    acc, done = [], threading.Event()
+    def cb(tok, fin):
+        acc.append(tok)
+        if fin:
+            done.set()
+    engine.submit(p, SamplingParams(max_tokens=n), cb)
+    assert done.wait(240)
+    return acc
+
+ref_eng = DecodeEngine(cfg, params, num_slots=2, max_seq=128, tp=1,
+                       prefix_cache=False)
+# RAY_TPU_LLM_KV_DEVICE_BYTES (env) makes this engine build the TIERED cache
+# with its hot tier sharded over the tp=2 mesh via kv_prefix_sharding.
+eng = DecodeEngine(cfg, params, num_slots=2, max_seq=128, tp=2)
+ref = generate(ref_eng, prompt)
+cold = generate(eng, prompt)
+warm_host = generate(eng, prompt)   # host tier; promotes to device
+warm_dev = generate(eng, prompt)    # device tier: mesh-resident, zero H2D
+mgr = eng._prefix_cache
+shard_degrees = [
+    len(dev.sharding.device_set) for dev, _nb in mgr._device._blocks.values()
+]
+out = {
+    "ref": ref, "cold": cold, "host": warm_host, "dev": warm_dev,
+    "tier": eng.last_attach["tier"], "shard_degrees": shard_degrees,
+    "tiers": eng.prefix_cache_stats()["tiers"],
+}
+eng.shutdown()
+ref_eng.shutdown()
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_tiered_hot_tier_is_mesh_resident_tp2(multi_device_run):
+    """TP=2 engine with the flag-driven tiered cache: device-warm greedy
+    output is token-identical to a TP=1 cache-disabled reference, the warm
+    attach reports tier=device, and every hot-tier block is SHARDED over
+    the 2-device mesh (kv_prefix_sharding) — mesh-resident, so the attach
+    pays zero host->device copies (docs/kvcache.md)."""
+    out = multi_device_run(
+        _TIER_SNIPPET,
+        env_extra={"RAY_TPU_LLM_KV_DEVICE_BYTES": str(32 << 20)},
+    )
+    assert out["ref"] == out["cold"] == out["host"] == out["dev"], out
+    assert out["tier"] == "device", out["tier"]
+    assert out["shard_degrees"] and all(d == 2 for d in out["shard_degrees"])
+    assert out["tiers"]["hits_device"] >= 1

@@ -229,6 +229,29 @@ def test_rl801_kv_shard_pool_fires_and_suppresses():
         assert sym not in found, sym
 
 
+def test_rl801_kvtier_fires_and_suppresses():
+    """The round-17 RESOURCE_TABLE entries (DiskSpillStore.open_spill ->
+    commit/close, MulticastDeviceChannel.subscribe -> unsubscribe,
+    lease_prefix -> release) flow through the same RL801 path analysis: a
+    dangling spill handle, a subscription that back-pressures the multicast
+    ring forever, and a fetch lease pinning its chain are the leak classes
+    they encode (docs/kvcache.md)."""
+    found = _codes_by_symbol(_fixture("case_rl8_kvtier.py"))
+    for sym in ("bad_spill_never_closed", "bad_spill_conditional",
+                "bad_spill_risky_gap", "bad_subscription_never_released",
+                "bad_subscription_conditional",
+                "bad_fetch_lease_never_released",
+                "bad_fetch_lease_risky_gap"):
+        assert found.get(sym) == {"RL801"}, (sym, found.get(sym))
+    for sym in ("ok_spill_finally", "ok_spill_with", "ok_spill_returned",
+                "suppressed_spill", "ok_subscription_finally",
+                "ok_subscription_with", "ok_subscription_stored",
+                "suppressed_subscription", "ok_fetch_lease_finally",
+                "ok_fetch_lease_returned", "ok_fetch_lease_closure",
+                "suppressed_fetch_lease"):
+        assert sym not in found, (sym, found.get(sym))
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}
