@@ -335,6 +335,38 @@ def main():
             res[k] = round(v, 3)
     print(json.dumps(res, indent=1))
 
+    # Artifact, same convention as BENCH_SERVE.json: environment metadata +
+    # one row per measured component so docs/perf.md can link a committed
+    # snapshot instead of a pasted blob.
+    context_keys = ("batch", "seq", "model_flops_per_step_T", "mxu_floor_ms")
+    rows = [
+        {"component": k, "per_iteration_ms": v}
+        for k, v in res.items()
+        if k not in context_keys and not k.endswith("_tflops")
+    ]
+    for k, v in res.items():
+        if k.endswith("_tflops"):
+            base = k[: -len("_tflops")]
+            for row in rows:
+                if row["component"].startswith(base):
+                    row["tflops"] = v
+    out = {
+        "bench": "train_step_profile",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0].device_kind),
+        "context": {k: res[k] for k in context_keys if k in res},
+        "methodology": (
+            "two-point scan timing: each component repeated inside one "
+            "jitted lax.scan at lengths N and 4N, per-iteration ms = "
+            "(t_long - t_short) / 3N so the fixed per-call cost (dispatch, "
+            "sync round-trip) cancels; median of 3 reps; synced via "
+            "device_get of a scalar folded from every carry leaf"
+        ),
+        "results": rows,
+    }
+    with open("BENCH_PROFILE.json", "w") as f:
+        json.dump(out, f, indent=1)
+
 
 if __name__ == "__main__":
     main()

@@ -87,6 +87,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "train_ckpt_async": (bool, True, "sharded checkpoints persist on a background writer thread; the step loop pays only one batched device->host snapshot per save (0 = write+commit inline, docs/checkpoint.md)"),
     "train_ckpt_inflight": (int, 2, "bounded in-flight async checkpoint saves per process; a save past the budget backpressures the step loop instead of growing host memory with unpersisted snapshots"),
     "train_ckpt_commit_timeout_s": (float, 120.0, "how long the committing rank waits for every process's shard spec before abandoning the commit (the directory stays manifest-less, i.e. garbage)"),
+    "train_flight_records": (int, 64, "per-step flight records kept in each train worker's recorder ring (docs/observability.md): data-wait/step-compute/report-blocked/checkpoint-blocked phase attribution per report(), exported only from train_stats()/Result (0 disables)"),
     "serve_long_poll_timeout_s": (float, 30.0, "serve long-poll timeout"),
     "serve_http_port": (int, 8000, "default HTTP port each node's serve proxy binds (reference: serve DEFAULT_HTTP_PORT)"),
     "serve_handle_max_retries": (int, 3, "deployment-handle resubmissions after replica death before the call fails"),

@@ -12,6 +12,7 @@ partial overlap reads only the pages it touches.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -119,6 +120,7 @@ def restore(path: str, *, shardings=None, mesh=None):
 
     Raises FileNotFoundError when the directory was never committed.
     """
+    t0 = time.perf_counter()
     manifest = load_manifest(path)
     if shardings is None and mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -146,8 +148,18 @@ def restore(path: str, *, shardings=None, mesh=None):
 
     if manifest.get("tree") is None:
         # Flat fallback: a save of a bare leaf list keyed by position.
-        return {key: leaf_fn(key) for key in sorted(readers)}
-    return _decode_tree(manifest["tree"], leaf_fn)
+        out = {key: leaf_fn(key) for key in sorted(readers)}
+    else:
+        out = _decode_tree(manifest["tree"], leaf_fn)
+    # Compute-plane registry: a restore builds fresh arrays/programs by
+    # design, so it records as a SPAN (invocation + wall time), never a
+    # compile — it must not read as a retrace storm.
+    from ray_tpu.util import xprof
+
+    xprof.registry().note_span(
+        "checkpoint", ("restore",), time.perf_counter() - t0
+    )
+    return out
 
 
 def restore_leaf(path: str, key: str, *, sharding=None):

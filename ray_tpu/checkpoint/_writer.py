@@ -90,8 +90,29 @@ class AsyncCheckpointWriter:
         self._idle.set()
         self._lock = threading.Lock()
         self._pending = 0
+        self._pending_bytes = 0
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
+        # Memory-ledger owner (docs/observability.md "compute plane"): the
+        # host bytes of snapshots taken but not yet persisted — exactly the
+        # memory the bounded in-flight queue exists to cap. Weakref'd so an
+        # abandoned writer stays collectable.
+        import weakref
+
+        from ray_tpu.util import xprof
+
+        self._ledger_name = f"ckpt_writer-{id(self):x}"
+        _self_ref = weakref.ref(self)
+
+        def _ledger_row():
+            w = _self_ref()
+            if w is None:
+                return {}
+            with w._lock:
+                return {"bytes": 0, "host_bytes": w._pending_bytes,
+                        "pending_jobs": w._pending}
+
+        xprof.register_memory_owner(self._ledger_name, _ledger_row)
 
     # ------------------------------------------------------------------ save
 
@@ -119,8 +140,12 @@ class AsyncCheckpointWriter:
             "commit": (process_index in (None, 0)) if commit is None else commit,
             "process_count": 1 if process_count is None else process_count,
         }
+        job["bytes"] = sum(
+            int(getattr(v, "nbytes", 0) or 0) for v in encoded.values()
+        ) if hasattr(encoded, "values") else 0
         with self._lock:
             self._pending += 1
+            self._pending_bytes += job["bytes"]
             self._idle.clear()
         from ray_tpu.devtools import leaksan as _leaksan
 
@@ -174,7 +199,9 @@ class AsyncCheckpointWriter:
             finally:
                 with self._lock:
                     self._pending -= 1
+                    self._pending_bytes -= job.get("bytes", 0)
                     if self._pending == 0:
+                        self._pending_bytes = 0  # drift-proof at idle
                         self._idle.set()
                 from ray_tpu.devtools import leaksan as _leaksan
 
@@ -222,3 +249,6 @@ class AsyncCheckpointWriter:
             self._queue.put(None)
             self._thread.join(timeout=5.0)
         self._thread = None
+        from ray_tpu.util import xprof
+
+        xprof.unregister_memory_owner(self._ledger_name)

@@ -117,6 +117,13 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
     ResourceSpec("cross-replica prefix-fetch lease (PrefixLease)",
                  "lease_prefix", hints=("cache", "prefix", "engine"),
                  release=("release",)),
+    # Round 18 (docs/observability.md "compute plane"): an xprof profiler
+    # capture handle. A capture never stopped keeps jax.profiler tracing for
+    # the rest of the process's life — every later dispatch pays the
+    # instrumentation tax and the trace dir grows without bound. `capture()`
+    # wraps the pair; any direct start_capture() must stop_capture()/close().
+    ResourceSpec("profiler capture (ProfilerCapture)", "start_capture",
+                 release=("stop_capture", "close")),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

@@ -335,6 +335,22 @@ def active_streams() -> int:
         return _active_streams
 
 
+def _register_stream_ledger():
+    """Join the device-memory ledger (docs/observability.md "compute
+    plane"): a live stream pins a host snapshot + shm segment; the ledger
+    surfaces the count so an OOM snapshot can implicate a stuck pump even
+    though the pinned bytes are host-side (reported as count, not bytes)."""
+    from ray_tpu.util import xprof
+
+    xprof.register_memory_owner(
+        "device_channel_streams",
+        lambda: {"bytes": 0, "streams": active_streams()},
+    )
+
+
+_register_stream_ledger()
+
+
 _devobj_metrics: dict = {}
 _devobj_metrics_lock = threading.Lock()
 

@@ -383,6 +383,20 @@ class LLMServer:
         (docs/observability.md)."""
         return self._engine.recorder_stats()
 
+    async def capture_profile(self, duration_s: float = 3.0,
+                              log_dir: Optional[str] = None) -> dict:
+        """On-demand profiler capture on this replica (the fleet surface
+        `util.state.capture_profile` fans out to): runs jax.profiler trace
+        capture for duration_s on an executor thread — the engine keeps
+        serving — and returns the trace artifacts inline."""
+        import asyncio
+
+        from ray_tpu.util import xprof
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: xprof.capture(duration_s, log_dir)
+        )
+
     async def shutdown(self):
         """Explicit retirement hook (the serve controller calls it, bounded,
         before the hard kill): stop the stepper and fail queued requests so

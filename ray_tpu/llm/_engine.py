@@ -49,11 +49,13 @@ from ray_tpu.llm.tp import (
     checkpoint_shardings,
     kv_prefix_sharding,
     mesh_signature,
+    per_device_byte_map,
     shard_decode_params,
     single_device_shardings,
     tp_degree,
 )
 from ray_tpu.models.transformer import ModelConfig, _rope
+from ray_tpu.util import xprof
 
 _NEG_INF = -1e30
 
@@ -326,8 +328,28 @@ class DecodeEngine:
         # Set when the stepper thread dies on an exception; submitters check it
         # instead of waiting forever on callbacks that will never fire.
         self.error: Optional[BaseException] = None
+        # Compute-plane observatory hooks (docs/observability.md "compute
+        # plane"): every program this engine builds registers with the
+        # per-process ProgramRegistry (compile wall time, invocations,
+        # warmup-vs-retrace accounting) and the engine reports its device
+        # bytes through one memory-ledger owner. Registry mutation is plain
+        # host-side arithmetic; export happens only from scheduler_stats().
+        # The ledger holds a weakref so a dropped engine is collectable.
+        import weakref
+
+        self._xprof = xprof.registry()
+        self._xprof_owner = f"engine-{id(self):x}"
+        _self_ref = weakref.ref(self)
+
+        def _ledger_row():
+            eng = _self_ref()
+            return eng._memory_owner_report() if eng is not None else {}
+
+        xprof.register_memory_owner(self._xprof_owner, _ledger_row)
         self._jit_prefill = {}
-        self._jit_decode = jax.jit(self._decode_step)
+        self._jit_decode = self._xprof.instrument(
+            self._xprof_owner, ("decode",), jax.jit(self._decode_step)
+        )
         # Multi-step decode: N greedy tokens per dispatch (argmax on device,
         # lax.scan over decode steps) — one host round trip per CHUNK instead
         # of per token. The win is dispatch-latency-bound regimes (remote
@@ -422,9 +444,11 @@ class DecodeEngine:
         # and of the most recent cache attach (which tier served the rows).
         self.last_prefill: Optional[dict] = None
         self.last_attach: Optional[dict] = None
-        self._jit_decode_multi = jax.jit(
-            self._decode_multi, static_argnames=("n",)
-        )  # jax caches one program per distinct static n
+        self._jit_decode_multi = self._xprof.instrument(
+            self._xprof_owner, ("decode_multi",),
+            jax.jit(self._decode_multi, static_argnames=("n",)),
+        )  # jax caches one program per distinct static n (the registry
+        # entry counts the object once; per-n compiles stay internal)
         # Speculative decoding as a scheduler-scheduled phase (docs/
         # scheduler.md): a DraftProvider proposes up to k tokens per eligible
         # slot, and ONE batched gated verify forward scores every
@@ -814,6 +838,10 @@ class DecodeEngine:
             spec["draft"] = self._draft.stats()
             out["spec"] = spec
         out["recorder"] = self._flush_observability()
+        # Compute-plane report (same report-path contract): this engine's
+        # compiled-program rows + the process-wide device-memory ledger.
+        out["programs"] = self._xprof.report(owner=self._xprof_owner)
+        out["memory"] = xprof.device_memory_report()
         return out
 
     def _flush_observability(self) -> dict:
@@ -855,6 +883,37 @@ class DecodeEngine:
             },
             "trace_id": summary["trace_id"],
         }
+
+    def _memory_owner_report(self) -> dict:
+        """Memory-ledger owner callback (report paths only): this engine's
+        device-resident bytes by component, attributed per device where the
+        plane is mesh-sharded. Shape metadata only — never a device pull."""
+        components: Dict[str, int] = {}
+        per_device: Dict[str, int] = {}
+        kv_bytes = 0
+        caches = self._caches
+        if self._kv_pool is not None and caches:
+            kv_bytes = self._kv_pool.total_bytes
+            per_device = per_device_byte_map(caches)
+        elif caches:
+            # .nbytes is shape metadata (rank * dtype arithmetic), not a pull
+            kv_bytes = sum(k.nbytes + v.nbytes for k, v in caches)
+        components["kv_slots"] = kv_bytes
+        if self._adapters is not None:
+            components["adapters"] = int(
+                self._adapters.stats().get("bytes_resident") or 0
+            )
+        if self._prefix_cache is not None:
+            tiers = self._prefix_cache.stats().get("tiers")
+            if tiers:
+                components["prefix_hot_tier"] = int(
+                    tiers.get("device_bytes") or 0
+                )
+        row: dict = {"bytes": sum(components.values()),
+                     "components": components}
+        if per_device:
+            row["per_device"] = per_device
+        return row
 
     def _leased_kv(self, lease):
         """Materialize a lease's prefix rows from the best tier: the tiered
@@ -1109,9 +1168,13 @@ class DecodeEngine:
                     # exactly the gather-then-scatter the sharded plane
                     # exists to avoid (docs/serving_tp.md).
                     kv = kv_dev
-        except BaseException:
+        except BaseException as e:
             # Books balance on the poisoned-pool / failed-dispatch paths too:
-            # the record retires as dropped instead of living forever.
+            # the record retires as dropped instead of living forever. A
+            # RESOURCE_EXHAUSTED escape first pins the ranked memory ledger
+            # to the recorder so the OOM is attributable post-mortem.
+            if xprof.is_resource_exhausted(e):
+                self._recorder.note_oom(xprof.oom_snapshot())
             self._recorder.drop(rec)
             raise
         finally:
@@ -1249,6 +1312,12 @@ class DecodeEngine:
         if close_cache is not None:
             close_cache()  # tiered cache: flush + stop the kv-spill worker
         self._release_mesh_state()
+        # Retire this engine from the compute-plane observatory: its ledger
+        # owner and program rows must not outlive it (both idempotent).
+        xprof.unregister_memory_owner(self._xprof_owner)
+        self._xprof.forget_owner(self._xprof_owner)
+        if self._adapters is not None:
+            self._xprof.forget_owner(f"adapters:{self._adapters.name}")
 
     def _release_mesh_state(self):
         """Drop every mesh-resident buffer reference a TP engine holds (the
@@ -1302,7 +1371,12 @@ class DecodeEngine:
         if prog is None:
             if self._max_jit_programs and len(cache) >= self._max_jit_programs:
                 cache.pop(next(iter(cache)))
-            prog = cache[key] = make()
+            # The registry wrapper times the first call (= the synchronous
+            # trace+lower+compile) and counts the rest; re-instrumenting an
+            # evicted key marks its rebuild as a recompile, not warmup.
+            prog = cache[key] = self._xprof.instrument(
+                self._xprof_owner, key, make()
+            )
         return prog
 
     # -- plan execution ----------------------------------------------------
@@ -1553,6 +1627,12 @@ class DecodeEngine:
         try:
             self._loop_inner()
         except BaseException as e:  # noqa: BLE001 - stepper death must be visible
+            if xprof.is_resource_exhausted(e):
+                # OOM forensics: attach the ranked ledger snapshot to the
+                # flight recorder before the engine poisons itself, so the
+                # operator sees WHO held the bytes at death, not just that
+                # XLA ran out (docs/observability.md "compute plane").
+                self._recorder.note_oom(xprof.oom_snapshot())
             self.error = e
             # Callers blocked on per-request callbacks would otherwise hang
             # forever: fail every active/queued request loudly.

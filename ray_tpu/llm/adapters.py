@@ -224,7 +224,15 @@ class AdapterCache:
             )
             return out
 
-        self._jit_install = jax.jit(_install)
+        # Registered with the compute-plane program registry: exactly ONE
+        # install trace per cache life is the RL602/RL604 contract, and the
+        # registry's recompile counter is the runtime witness. Attribute
+        # access (stats()'s _cache_size probe) falls through the wrapper.
+        from ray_tpu.util import xprof
+
+        self._jit_install = xprof.registry().instrument(
+            f"adapters:{self.name}", ("install",), jax.jit(_install)
+        )
         self._lock = threading.Lock()
         self._registry: Dict[str, _AdapterEntry] = {}
         self._by_uid: Dict[int, _AdapterEntry] = {}

@@ -117,6 +117,12 @@ class DPLLMServer(LLMServer):
         stats = await super().recorder_stats()
         return {"dp_rank": self.dp_rank, **stats}
 
+    async def capture_profile(self, duration_s: float = 3.0,
+                              log_dir: Optional[str] = None) -> dict:
+        """Profiler capture, rank-tagged (docs/observability.md)."""
+        out = await super().capture_profile(duration_s, log_dir)
+        return {"dp_rank": self.dp_rank, **out}
+
     def _release_rank(self):
         """Idempotent: hand the dp rank back to the assigner exactly once
         (double release would free a rank a LIVE successor already claimed).
@@ -453,6 +459,14 @@ class DPRouter:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, lambda: self._server.recorder_stats.broadcast()
+        )
+
+    async def capture_profile(self, duration_s: float = 3.0) -> List[dict]:
+        """Fan a profiler capture out to EVERY replica and gather the
+        rank-tagged trace artifacts (docs/observability.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._server.capture_profile.broadcast(duration_s)
         )
 
     async def __call__(self, request) -> dict:

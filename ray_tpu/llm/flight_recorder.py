@@ -155,6 +155,11 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._counters = {"started": 0, "finished": 0, "dropped": 0,
                           "rejected": 0, "exported_spans": 0}
+        # OOM forensics (docs/observability.md "compute plane"): the ranked
+        # device-memory ledger snapshot a RESOURCE_EXHAUSTED escape pinned
+        # here before the engine re-raised. One slot — the FIRST OOM is the
+        # attributable one; later ones are cascade noise.
+        self._last_oom: Optional[dict] = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, rid: Optional[str] = None, *, trace: Optional[dict] = None,
@@ -203,6 +208,14 @@ class FlightRecorder:
             return None
         return self._retire(rec, "dropped", "dropped")
 
+    def note_oom(self, snapshot: dict):
+        """Pin a device-memory ledger snapshot (xprof.oom_snapshot()) to
+        this recorder. Keeps the first — cascading OOMs repeat the story."""
+        with self._lock:
+            self._counters["oom"] = self._counters.get("oom", 0) + 1
+            if self._last_oom is None:
+                self._last_oom = dict(snapshot)
+
     def close(self):
         """Engine shutdown: retire every live record so leaksan's
         flight_record books balance exactly."""
@@ -233,6 +246,8 @@ class FlightRecorder:
             out["ring"] = len(self._ring)
             out["capacity"] = self.capacity
             out["unexported_spans"] = len(self._unexported)
+            if self._last_oom is not None:
+                out["last_oom"] = dict(self._last_oom)
         return out
 
     # -- report-path export (NEVER called from the dispatch loop) ----------

@@ -269,6 +269,26 @@ def per_device_bytes(tree_or_leaf) -> int:
     return max(totals.values(), default=0)
 
 
+def per_device_byte_map(tree_or_leaf) -> Dict[str, int]:
+    """Per-device byte attribution for a (pytree of) jax arrays — the
+    memory-ledger complement to `per_device_bytes` (which keeps only the
+    max). Keys are device ids as strings ("-1" = host numpy leaves); uses
+    shard shape metadata only, never a device pull."""
+    import jax
+
+    totals: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree_or_leaf):
+        if isinstance(leaf, jax.Array):
+            itemsize = np.dtype(leaf.dtype).itemsize
+            for shard in leaf.addressable_shards:
+                nbytes = int(np.prod(shard.data.shape)) * itemsize
+                key = str(shard.device.id)
+                totals[key] = totals.get(key, 0) + nbytes
+        elif hasattr(leaf, "nbytes"):
+            totals["-1"] = totals.get("-1", 0) + int(leaf.nbytes)
+    return totals
+
+
 class ShardedKVPool:
     """Mesh-resident per-slot KV pool: every layer's (k, v) caches allocated
     at the kv-head-sharded layout, with the per-shard handles accounted as
@@ -335,6 +355,7 @@ __all__ = [
     "mesh_signature",
     "mesh_zeros",
     "param_spec",
+    "per_device_byte_map",
     "per_device_bytes",
     "replicated",
     "shard_decode_params",

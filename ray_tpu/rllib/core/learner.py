@@ -149,22 +149,39 @@ class Learner:
                                    if self._leaf_shardable(v))))
 
     def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        import time
+
         import jax
+
+        from ray_tpu.util import xprof
 
         # Keyed cache, not a single slot: workloads that alternate signatures
         # (epoch tail batches under a mesh) must not recompile on every flip.
+        # Each built program registers with the compute-plane registry — a
+        # signature-churn storm shows up as xla_recompiles_total at runtime,
+        # not just in a jaxlint report.
         sig = self._batch_signature(batch)
+        owner = f"learner-{id(self):x}"
         jit_update = self._jit_cache.get(sig)
         if jit_update is None:
             if len(self._jit_cache) >= self._max_jit_cache:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
-            jit_update = self._jit_cache[sig] = self._build_update(batch)
+            jit_update = self._jit_cache[sig] = xprof.registry().instrument(
+                owner, ("update", sig), self._build_update(batch)
+            )
+        t0 = time.perf_counter()
         self._params, self._opt_state, self._target, loss, metrics = jit_update(
             self._params, self._opt_state, self._target, batch
         )
         # One host transfer for all scalar metrics — float() per metric would
         # block on a separate device->host pull each.
         loss, metrics = jax.device_get((loss, metrics))
+        # The device_get above already synced the step, so this wall time is
+        # a REAL execution measurement, not a dispatch time (free to record:
+        # no extra sync is introduced for observability).
+        xprof.registry().note_exec(
+            owner, ("update", sig), time.perf_counter() - t0
+        )
         out = {k: float(v) for k, v in metrics.items()}
         out["total_loss"] = float(loss)
         return out

@@ -305,14 +305,167 @@ def cmd_client_proxy(args):
         pass
 
 
-def cmd_status(_args):
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _render_programs(lines, report, indent="  "):
+    totals = (report or {}).get("totals") or {}
+    lines.append(f"{indent}programs={totals.get('programs', 0)} "
+                 f"compiles={totals.get('compiles_total', 0)} "
+                 f"recompiles={totals.get('recompiles_total', 0)} "
+                 f"compile_s={totals.get('compile_s_total', 0.0):.2f}")
+    for row in (report or {}).get("programs") or []:
+        lines.append(
+            f"{indent}  {row.get('owner')} {row.get('key')}: "
+            f"compiles={row.get('compiles')} recompiles={row.get('recompiles')} "
+            f"invocations={row.get('invocations')} "
+            f"compile_s={row.get('compile_s', 0.0):.2f}")
+
+
+def _render_memory(lines, report, indent="  "):
+    rep = report or {}
+    lines.append(f"{indent}tracked_total="
+                 f"{_fmt_bytes(rep.get('tracked_bytes_total', 0))}")
+    owners = rep.get("owners") or {}
+    ranked = sorted(owners.items(),
+                    key=lambda kv: -(kv[1].get("bytes", 0)
+                                     if isinstance(kv[1], dict) else 0))
+    for name, row in ranked:
+        if not isinstance(row, dict):
+            continue
+        extra = ""
+        comps = row.get("components")
+        if comps:
+            extra = " (" + ", ".join(
+                f"{k}={_fmt_bytes(v)}" for k, v in comps.items()) + ")"
+        lines.append(f"{indent}  {name}: "
+                     f"{_fmt_bytes(row.get('bytes', 0))}{extra}")
+    for dev in rep.get("devices") or []:
+        ms = dev.get("memory_stats") or {}
+        detail = ""
+        if ms:
+            detail = (f" in_use={_fmt_bytes(ms.get('bytes_in_use', 0))}"
+                      f" peak={_fmt_bytes(ms.get('peak_bytes_in_use', 0))}"
+                      f" limit={_fmt_bytes(ms.get('bytes_limit', 0))}")
+        lines.append(f"{indent}  device {dev.get('id')} "
+                     f"({dev.get('platform')}){detail}")
+
+
+def render_status(status: dict) -> str:
+    """Render a `util.state.cluster_status()` snapshot as sectioned text
+    (the non-`--json` body of `ray_tpu status`)."""
+    lines = []
+    summary = status.get("summary") or {}
+
+    lines.append("== nodes ==")
+    lines.append(f"  {summary.get('alive_nodes', 0)}/{summary.get('nodes', 0)}"
+                 " alive")
+    for node in status.get("nodes") or []:
+        nid = str(node.get("node_id", "?"))[:12]
+        alive = "ALIVE" if node.get("alive", True) else "DEAD"
+        res = node.get("resources_total") or node.get("resources") or {}
+        res_s = " ".join(f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+                         for k, v in sorted(res.items()))
+        lines.append(f"  {nid} {alive} {res_s}")
+
+    lines.append("== resources ==")
+    total = summary.get("resources_total") or {}
+    avail = summary.get("resources_available") or {}
+    for k in sorted(total):
+        lines.append(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+
+    lines.append("== tasks ==")
+    for state_name, n in sorted((summary.get("tasks") or {}).items()):
+        lines.append(f"  {state_name}: {n}")
+
+    lines.append("== actors ==")
+    for state_name, n in sorted((summary.get("actors") or {}).items()):
+        lines.append(f"  {state_name}: {n}")
+    for actor in status.get("actors") or []:
+        if "error" in actor and len(actor) == 1:
+            lines.append(f"  (listing error: {actor['error']})")
+            continue
+        aid = str(actor.get("actor_id", "?"))[:12]
+        lines.append(f"  {aid} {actor.get('class_name', '?')} "
+                     f"{actor.get('state', '?')}")
+
+    serve = status.get("serve") or {}
+    lines.append("== serve ==")
+    apps = serve.get("apps") or {}
+    if not apps:
+        lines.append("  (no serve apps)")
+    for app, stats in apps.items():
+        lines.append(f"  app {app} (ingress={stats.get('ingress')})")
+        sched = stats.get("scheduler_stats")
+        sched_list = sched if isinstance(sched, list) else [sched]
+        for i, s in enumerate(sched_list):
+            if not isinstance(s, dict):
+                continue
+            tag = f" replica {i}" if len(sched_list) > 1 else ""
+            lines.append(f"   {tag} running={s.get('running')} "
+                         f"queued={s.get('queued')} "
+                         f"free_slots={s.get('free_slots')}")
+            if s.get("programs"):
+                lines.append(f"   {tag} programs:")
+                _render_programs(lines, s["programs"], indent="      ")
+            if s.get("memory"):
+                lines.append(f"   {tag} memory:")
+                _render_memory(lines, s["memory"], indent="      ")
+
+    lines.append("== transport ==")
+    transport = serve.get("transport") or {}
+    for k, v in sorted(transport.items()):
+        lines.append(f"  {k}: {v}")
+
+    lines.append("== control plane ==")
+    cp = serve.get("control_plane") or {}
+    for section in ("store", "repl"):
+        row = cp.get(section)
+        if isinstance(row, dict):
+            kv = " ".join(f"{k}={v}" for k, v in sorted(row.items()))
+            lines.append(f"  {section}: {kv}")
+    if "error" in cp:
+        lines.append(f"  (error: {cp['error']})")
+
+    lines.append("== programs (driver) ==")
+    _render_programs(lines, status.get("programs"))
+
+    lines.append("== memory (driver) ==")
+    _render_memory(lines, status.get("memory"))
+    return "\n".join(lines)
+
+
+def cmd_status(args):
+    """One-shot operator snapshot (docs/observability.md "compute plane"):
+    joins the state API (nodes/resources/actors), control-plane and serve
+    stats, transport counters, and the xprof program registry + device-memory
+    ledger into a readable cluster status. Reuses an already-initialized
+    driver connection when present (in-process use / tests) instead of
+    connecting from the address file."""
     import ray_tpu
     from ray_tpu.util import state
 
-    _connect_from_file()
-    summary = state.cluster_summary()
-    print(json.dumps(summary, indent=2, default=str))
-    ray_tpu.shutdown()
+    owned = not ray_tpu.is_initialized()
+    if owned:
+        _connect_from_file()
+    try:
+        status = state.cluster_status()
+        if getattr(args, "json", False):
+            print(json.dumps(status, indent=2, default=str))
+        else:
+            print(render_status(status))
+    finally:
+        if owned:
+            ray_tpu.shutdown()
 
 
 def cmd_timeline(args):
@@ -625,7 +778,11 @@ def main(argv=None):
     p.add_argument("config")
     p.set_defaults(fn=cmd_down)
     sub.add_parser("stop", help="stop the local head").set_defaults(fn=cmd_stop)
-    sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)
+    p = sub.add_parser("status", help="cluster snapshot: nodes, actors, "
+                       "serve plane, XLA programs, device memory")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw cluster_status() dict as JSON")
+    p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("timeline",
                        help="export task events as Chrome trace JSON")

@@ -20,6 +20,7 @@ from ray_tpu.train.context import (
     get_context,
     get_dataset_shard,
     report,
+    train_stats,
 )
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 from ray_tpu.train._internal.controller import TrainingFailedError
@@ -43,4 +44,5 @@ __all__ = [
     "get_context",
     "get_dataset_shard",
     "report",
+    "train_stats",
 ]

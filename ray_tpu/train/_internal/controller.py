@@ -66,6 +66,8 @@ class TrainController:
             self._scaling_policy = ScalingPolicy(scaling_config)
         self._checkpoints = CheckpointManager(run_config.checkpoint_config)
         self._latest_metrics: dict | None = None
+        self._flight_totals: dict[int, dict] = {}  # rank -> phase seconds
+        self._flight_reports = 0
         self._experiment_name = run_config.name or f"train_{int(time.time())}"
         self._storage_path = os.path.expanduser(run_config.storage_path)
         # A RESTARTED detached controller (not a fresh fit with a reused name)
@@ -287,14 +289,32 @@ class TrainController:
                 result["report_index"], result["checkpoint"], result["metrics"],
                 rank=result["rank"],
             )
+        flight = result.get("flight")
+        if flight:
+            # Aggregate each rank's per-step phase attribution so the final
+            # Result can say where the run's wall time went without a live
+            # worker to ask (docs/observability.md "compute plane").
+            per_rank = self._flight_totals.setdefault(result["rank"], {})
+            for key in ("data_wait_s", "step_compute_s",
+                        "report_blocked_s", "checkpoint_blocked_s"):
+                per_rank[key] = per_rank.get(key, 0.0) + flight.get(key, 0.0)
+            self._flight_reports += 1
 
     def _build_result(self, error) -> Result:
+        train_stats = None
+        if self._flight_totals:
+            train_stats = {
+                "reports": self._flight_reports,
+                "phases": {rank: dict(v)
+                           for rank, v in sorted(self._flight_totals.items())},
+            }
         return Result(
             metrics=self._latest_metrics,
             checkpoint=self._checkpoints.latest_committed,
             path=os.path.join(self._storage_path, self._experiment_name),
             error=error,
             best_checkpoints=self._checkpoints.best_checkpoints,
+            train_stats=train_stats,
         )
 
 

@@ -99,6 +99,21 @@ class RayTrainWorker:
             session.stop_event.set()
         return True
 
+    def train_stats(self) -> Optional[dict]:
+        """Report path: this worker's per-step flight totals + recorder
+        ring + program/memory reports (docs/observability.md)."""
+        return train_ctx.train_stats()
+
+    def capture_profile(self, duration_s: float = 3.0,
+                        log_dir: Optional[str] = None) -> dict:
+        """On-demand profiler capture on this worker (the fleet surface
+        `util.state.capture_profile` fans out to): blocks the actor — not
+        the training thread — for duration_s and returns the trace
+        artifacts inline."""
+        from ray_tpu.util import xprof
+
+        return xprof.capture(duration_s, log_dir)
+
     def shutdown(self):
         train_ctx.shutdown_session()
         return True
@@ -267,6 +282,13 @@ class WorkerGroup:
         for rank, r in enumerate(replies):
             out.append(WorkerStatus(rank, r["state"], r["results"], r["error"]))
         return out
+
+    def train_stats(self, timeout_s: float = 60.0) -> list:
+        """Per-worker train_stats() in world-rank order (report path)."""
+        return ray_tpu.get(
+            [w.train_stats.remote() for w in self.sorted_workers],
+            timeout=timeout_s,
+        )
 
     def shutdown(self):
         try:

@@ -167,6 +167,11 @@ class Result:
     error: Optional[BaseException] = None
     metrics_dataframe: Optional[Any] = None
     best_checkpoints: list = field(default_factory=list)
+    # Per-step flight attribution aggregated over the run (docs/
+    # observability.md "compute plane"): {"reports", "phases": {rank:
+    # {data_wait_s, step_compute_s, report_blocked_s,
+    # checkpoint_blocked_s}}} — where a slow run's wall time went.
+    train_stats: Optional[dict] = None
 
     @property
     def config(self) -> Optional[dict]:

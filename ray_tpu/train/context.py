@@ -13,6 +13,7 @@ import os
 import queue
 import shutil
 import threading
+import time
 from typing import Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -58,6 +59,29 @@ class _TrainSession:
         self.stop_event = threading.Event()
         self.collective_counters: dict[str, int] = {}  # user barrier/broadcast rounds
         self._ckpt_writer = None  # lazy AsyncCheckpointWriter (sharded saves)
+        # Per-step flight record (docs/observability.md "compute plane"):
+        # every report() retires one record attributing the step's wall time
+        # to data-wait / step-compute / checkpoint-blocked / report-blocked
+        # phases — always-cheap host arithmetic riding the serve stack's
+        # FlightRecorder ring, exported only from train_stats()/Result.
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.llm.flight_recorder import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            name=f"train-rank{world_rank}",
+            capacity=CONFIG.train_flight_records,
+        )
+        self._step_t0 = time.monotonic()
+        self._data_wait_s = 0.0
+        self._flight_totals = {
+            "data_wait_s": 0.0, "step_compute_s": 0.0,
+            "report_blocked_s": 0.0, "checkpoint_blocked_s": 0.0,
+        }
+
+    def note_data_wait(self, seconds: float):
+        """Accrued by the timed dataset-shard iterator wrapper; folded into
+        the current step's flight record at the next report()."""
+        self._data_wait_s += seconds
 
     # ------------------------------------------------------------------ report
 
@@ -65,12 +89,22 @@ class _TrainSession:
                checkpoint_dir_name: str | None = None):
         from ray_tpu.checkpoint import ShardedState
 
+        # Phase attribution for the step that just ended: everything since
+        # the last report that was NOT data wait is step compute; the
+        # persist and barrier below are measured directly.
+        step_wall = time.monotonic() - self._step_t0
+        data_wait = self._data_wait_s
+        self._data_wait_s = 0.0
+        compute = max(0.0, step_wall - data_wait)
         self.report_count += 1
         persisted = None
+        t_ck = time.monotonic()
         if isinstance(checkpoint, ShardedState):
             persisted = self._persist_sharded(checkpoint, checkpoint_dir_name)
         elif checkpoint is not None:
             persisted = self._persist_checkpoint(checkpoint, checkpoint_dir_name)
+        ckpt_blocked = time.monotonic() - t_ck
+        t_bar = time.monotonic()
         if self.sync_actor is not None:
             # Lockstep across the gang: report is a barrier (reference semantics).
             import ray_tpu
@@ -79,16 +113,66 @@ class _TrainSession:
                 self.sync_actor.barrier.remote(self.world_size, f"report-{self.report_count}"),
                 timeout=600.0,
             )
+        report_blocked = time.monotonic() - t_bar
+        flight = {
+            "data_wait_s": data_wait, "step_compute_s": compute,
+            "checkpoint_blocked_s": ckpt_blocked,
+            "report_blocked_s": report_blocked,
+            "report_index": self.report_count, "rank": self.world_rank,
+        }
+        for k in self._flight_totals:
+            self._flight_totals[k] += flight[k]
+        self._record_flight(flight)
+        self._step_t0 = time.monotonic()
         self.result_queue.put(
             {
                 "metrics": dict(metrics),
                 "checkpoint": persisted,
                 "report_index": self.report_count,
                 "rank": self.world_rank,
+                "flight": flight,
             }
         )
         if self.stop_event.is_set():
             raise SystemExit(0)
+
+    def _record_flight(self, flight: dict):
+        """One ring record per report: the phase spans are laid out end to
+        end against wall-clock so timeline/trace export renders them."""
+        rec = self.recorder.start(
+            f"step-{flight['report_index']}",
+            tenant=f"rank{self.world_rank}", route="train",
+        )
+        if rec is None:
+            return
+        t1 = time.time()
+        spans = [
+            ("report-blocked", flight["report_blocked_s"]),
+            ("checkpoint-blocked", flight["checkpoint_blocked_s"]),
+            ("step-compute", flight["step_compute_s"]),
+            ("data-wait", flight["data_wait_s"]),
+        ]
+        for name, seconds in spans:  # newest phase first, walking backwards
+            rec.span(name, t1 - seconds, t1)
+            t1 -= seconds
+        self.recorder.finish(rec)
+
+    def train_stats(self) -> dict:
+        """Report path (the train analogue of scheduler_stats()): flushes
+        the recorder's pending exports and joins the per-step phase totals
+        with the process's program registry and memory ledger."""
+        from ray_tpu.util import xprof
+
+        self.recorder.flush_task_events()
+        return {
+            "rank": self.world_rank,
+            "reports": self.report_count,
+            "phases": dict(self._flight_totals),
+            "recorder": self.recorder.stats(),
+            "records": self.recorder.records(16),
+            "programs": xprof.registry().report(),
+            "memory": xprof.device_memory_report(),
+        }
 
     def _persist_checkpoint(self, checkpoint: Checkpoint, dir_name: str | None) -> Checkpoint:
         """Move the worker's local checkpoint dir under the experiment storage path.
@@ -153,6 +237,10 @@ def init_session(**kwargs) -> _TrainSession:
 def shutdown_session():
     global _session
     with _session_lock:
+        if _session is not None:
+            # Retire live flight records so leaksan's books balance on
+            # worker shutdown exactly as they do on engine shutdown.
+            _session.recorder.close()
         _session = None
 
 
@@ -230,8 +318,46 @@ def get_checkpoint() -> Optional[Checkpoint]:
     return s.latest_checkpoint
 
 
+class _TimedShard:
+    """Dataset-shard proxy that charges iteration stalls to the session's
+    data-wait phase (per-item `next()` wall time). Everything else falls
+    through to the real shard, so it is substitutable anywhere."""
+
+    def __init__(self, shard, session: _TrainSession):
+        self._shard = shard
+        self._session = session
+
+    def _timed(self, it):
+        while True:
+            t0 = time.monotonic()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self._session.note_data_wait(time.monotonic() - t0)
+            yield item
+
+    def __iter__(self):
+        return self._timed(iter(self._shard))
+
+    def __len__(self):
+        return len(self._shard)
+
+    def __getattr__(self, name):
+        attr = getattr(self._shard, name)
+        if name in ("iter_batches", "iter_rows", "iter_torch_batches"):
+            def wrapped(*args, **kwargs):
+                return self._timed(iter(attr(*args, **kwargs)))
+
+            return wrapped
+        return attr
+
+
 def get_dataset_shard(dataset_name: str = "train"):
-    """Parity: ray.train.get_dataset_shard — this worker's split of a Dataset."""
+    """Parity: ray.train.get_dataset_shard — this worker's split of a Dataset.
+
+    The returned shard is wrapped so time blocked on `next()` accrues to the
+    step's data-wait phase in the flight record (docs/observability.md)."""
     s = get_session()
     if s is None:
         raise RuntimeError("get_dataset_shard() called outside a training worker")
@@ -241,4 +367,11 @@ def get_dataset_shard(dataset_name: str = "train"):
             f"no dataset {dataset_name!r} was passed to the trainer "
             f"(available: {list(s.dataset_shards)})"
         )
-    return shard
+    return _TimedShard(shard, s)
+
+
+def train_stats() -> Optional[dict]:
+    """Worker-side report path: the current session's per-step flight
+    totals + recorder ring + program/memory reports (None off-worker)."""
+    s = get_session()
+    return s.train_stats() if s is not None else None

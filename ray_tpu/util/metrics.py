@@ -177,8 +177,11 @@ def collect_all(*, prune: bool = True,
     return out
 
 
-def prometheus_text() -> str:
-    """Render all metrics in Prometheus exposition format."""
+def render_prometheus() -> str:
+    """Render every flushed series from ``collect_all()`` in Prometheus
+    exposition format — counters/gauges sum/last-write-win across workers,
+    histograms expand to ``_bucket``/``_sum``/``_count`` — so the compute
+    plane's gauges are scrapeable without the dashboard."""
     lines = []
     merged: Dict[Tuple[str, str], Dict[Tuple, float]] = {}
     descs: Dict[str, Tuple[str, str]] = {}
@@ -223,3 +226,7 @@ def prometheus_text() -> str:
             tags = ",".join(f'{k}="{v}"' for k, v in key)
             lines.append(f"{name}{{{tags}}} {value}" if tags else f"{name} {value}")
     return "\n".join(lines) + "\n"
+
+
+#: Back-compat alias; `render_prometheus` is the canonical name.
+prometheus_text = render_prometheus

@@ -358,7 +358,113 @@ def cluster_summary() -> Dict[str, Any]:
     }
 
 
+def capture_profile(targets, duration_s: float = 3.0,
+                    out_dir: Optional[str] = None) -> List[Dict[str, Any]]:
+    """On-demand fleet profiling: start a `jax.profiler` trace capture on each
+    target simultaneously, wait `duration_s`, and gather the trace artifacts
+    back to the driver.
+
+    A target is either a serve APP NAME (string — resolved to its ingress
+    deployment, so a DPRouter app fans the capture out to every replica) or an
+    ACTOR HANDLE exposing ``capture_profile(duration_s)`` (train workers do:
+    `WorkerGroup.sorted_workers`). All captures are launched before any result
+    is awaited so the traces cover the same wall-clock window. Each row is
+    ``{"target", "capture"}`` (capture = the worker's
+    ``ray_tpu.util.xprof.capture`` dict, or a list of them for a fanned-out
+    app) or ``{"target", "error"}``. With ``out_dir`` the gathered trace file
+    bytes are also written under ``out_dir/<target>[/rank]/`` and each row
+    gains a ``"gathered"`` list of the paths written."""
+    import os
+
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    pending: List[tuple] = []  # (label, kind, future-or-error)
+    try:
+        apps = serve.status()
+    except Exception:
+        apps = {}
+    for i, target in enumerate(targets):
+        if isinstance(target, str):
+            ingress = (apps.get(target) or {}).get("ingress")
+            if not ingress:
+                pending.append((target, "error",
+                                f"no serve app named {target!r}"))
+                continue
+            try:
+                handle = DeploymentHandle(target, ingress)
+                fut = handle.capture_profile.remote(duration_s)
+                pending.append((target, "serve", fut))
+            except Exception as e:
+                pending.append((target, "error", str(e)))
+        else:
+            try:
+                ref = target.capture_profile.remote(duration_s)
+                pending.append((f"actor-{i}", "actor", ref))
+            except Exception as e:
+                pending.append((f"actor-{i}", "error", str(e)))
+    gather_timeout = duration_s + 60.0
+    rows: List[Dict[str, Any]] = []
+    for label, kind, obj in pending:
+        row: Dict[str, Any] = {"target": label}
+        try:
+            if kind == "error":
+                row["error"] = obj
+            elif kind == "serve":
+                row["capture"] = obj.result(timeout_s=gather_timeout)
+            else:
+                row["capture"] = ray_tpu.get(obj, timeout=gather_timeout)
+        except Exception as e:
+            row["error"] = str(e)
+        rows.append(row)
+    if out_dir:
+        for row in rows:
+            cap = row.get("capture")
+            if cap is None:
+                continue
+            caps = cap if isinstance(cap, list) else [cap]
+            gathered: List[str] = []
+            for j, c in enumerate(caps):
+                if not isinstance(c, dict):
+                    continue
+                sub = os.path.join(out_dir, str(row["target"]).replace("/", "_"))
+                if len(caps) > 1:
+                    sub = os.path.join(sub, f"rank{c.get('dp_rank', j)}")
+                for rel, data in (c.get("files") or {}).items():
+                    path = os.path.join(sub, rel)
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    with open(path, "wb") as f:
+                        f.write(data)
+                    gathered.append(path)
+            row["gathered"] = gathered
+    return rows
+
+
+def cluster_status(timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Everything `ray_tpu status` renders, as one dict: the cluster summary
+    (nodes / resources / task+actor states), the per-node and per-actor
+    listings, the serve-plane snapshot (which itself carries transport and
+    control-plane stats and, per app, each engine's program registry and
+    device-memory ledger), and the DRIVER-side xprof reports. Calling it is a
+    report path — registry counters and ledger gauges flush here, never from
+    dispatch paths."""
+    from ray_tpu.util import xprof
+
+    out: Dict[str, Any] = {"summary": cluster_summary()}
+    out["nodes"] = list_nodes()
+    try:
+        out["actors"] = list_actors(limit=200)
+    except Exception as e:
+        out["actors"] = [{"error": str(e)}]
+    out["serve"] = serve_stats(timeout_s=timeout_s)
+    out["programs"] = xprof.registry().report()
+    out["memory"] = xprof.device_memory_report()
+    return out
+
+
 __all__ = [
+    "capture_profile",
+    "cluster_status",
     "cluster_summary",
     "control_plane_stats",
     "get_actor",

@@ -43,6 +43,7 @@ LEAKSAN_SUITES = {
     "test_device_objects.py",
     "test_llm_tp.py",
     "test_flight_recorder.py",
+    "test_xprof.py",
 }
 
 

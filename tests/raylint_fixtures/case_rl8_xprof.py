@@ -1,0 +1,51 @@
+"""RL801 fixtures for the profiler capture handle (xprof.start_capture ->
+ProfilerCapture.stop_capture/close), the round-18 RESOURCE_TABLE entry: a
+capture never stopped keeps jax.profiler tracing for the rest of the process's
+life. The fire/suppress shapes mirror case_rl801.py's lease shapes so the new
+obligation rides the exact same path analysis."""
+
+
+def bad_capture_never_stopped(xprof):
+    cap = xprof.start_capture()
+    return cap.log_dir
+
+
+def bad_capture_conditional(xprof, flag):
+    cap = xprof.start_capture()
+    if flag:
+        cap.stop_capture()
+
+
+def bad_capture_risky_gap(xprof, engine, prompt):
+    cap = xprof.start_capture()
+    engine.generate(prompt)
+    cap.stop_capture()
+
+
+def ok_capture_finally(xprof, engine, prompt):
+    cap = xprof.start_capture()
+    try:
+        return engine.generate(prompt)
+    finally:
+        cap.stop_capture()
+
+
+def ok_capture_close_finally(xprof, engine, prompt):
+    cap = xprof.start_capture()
+    try:
+        return engine.generate(prompt)
+    finally:
+        cap.close()
+
+
+def ok_capture_stored(replica, xprof):
+    replica.active_capture = xprof.start_capture()
+
+
+def ok_capture_returned(xprof):
+    return xprof.start_capture()
+
+
+def suppressed_capture(xprof):
+    cap = xprof.start_capture()  # raylint: disable=RL801 (fixture: stop rides the stats report path)
+    return cap.log_dir

@@ -170,6 +170,26 @@ def test_fixture_catches_planted_gcs_lease_and_peer_link_leak():
     assert leaksan.check_growth(before, settle_s=0.2) == {}
 
 
+def test_fixture_catches_planted_profiler_capture_leak():
+    """The round-18 compute-plane observatory is leaksan-covered: a
+    ProfilerCapture started and never stopped grows the `profiler_capture`
+    kind (and keeps jax.profiler tracing for the process's life);
+    stop_capture clears it and is idempotent."""
+    import tempfile
+
+    from ray_tpu.util import xprof
+
+    before = leaksan.snapshot()
+    cap = xprof.start_capture(log_dir=tempfile.mkdtemp(prefix="leaksan_xprof_"))
+    try:
+        growth = leaksan.check_growth(before, settle_s=0.2)
+        assert "profiler_capture" in growth, growth
+    finally:
+        cap.stop_capture()
+    cap.stop_capture()  # idempotent: double stop must not underflow
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+
+
 def test_check_growth_waits_for_async_teardown():
     # growth that resolves within the settle window is not a leak: the
     # devobj stream pump releases on its own thread after the reader drains

@@ -61,6 +61,13 @@ def test_jit_program_cache_bounded_under_adversarial_length_mix(monkeypatch):
         first_again, _, _ = engine.prefill_detached([5, 9])
         np.testing.assert_allclose(first_ref, first_again, rtol=1e-5)
         assert len(engine._jit_prefill) <= 3
+        # The eviction rebuild IS the planted retrace the program registry
+        # exists to catch: the bucket-2 key compiled twice, and exactly the
+        # rebuild shows up as a recompile (xla_recompiles_total's source).
+        rows = {r["key"]: r
+                for r in engine._xprof.report(owner=engine._xprof_owner)["programs"]}
+        bucket2 = rows[("detached", 2)]
+        assert bucket2["compiles"] == 2 and bucket2["recompiles"] == 1, bucket2
     finally:
         engine.shutdown()
 
@@ -208,6 +215,46 @@ def test_decode_loop_is_host_native_one_pull_per_dispatch(monkeypatch):
         rec = engine._recorder.records()[-1]
         assert rec["tokens"] == max_tokens
         assert "prefill-chunk" in rec["phases"] and "decode" in rec["phases"]
+    finally:
+        engine.shutdown()
+
+
+def test_observability_reports_add_zero_pulls_and_zero_programs(monkeypatch):
+    """The round-18 micro-assert: the program registry and device-memory
+    ledger ride the existing report paths — exercising scheduler_stats()
+    (which now carries both reports) against a WARM engine adds zero
+    device->host pulls, zero compiled programs, and zero recompiles, and a
+    warm generate after the reports costs exactly its token accounting."""
+    from ray_tpu.llm import _engine as engine_mod
+
+    spy = _NpSpy()
+    monkeypatch.setattr(engine_mod, "np", spy)
+    engine = _tiny_engine(num_slots=2, max_seq=64, multi_step=1,
+                          prefix_cache=False)
+    try:
+        _generate(engine, [5, 9, 17, 3], max_tokens=4)  # warm every program
+        programs = len(engine._jit_prefill)
+        pulls = spy.device_pulls
+        recompiles_before = engine._xprof.recompiles_total
+        for _ in range(2):
+            stats = engine.scheduler_stats()
+        assert spy.device_pulls == pulls, "stats reports pulled device state"
+        assert len(engine._jit_prefill) == programs
+        assert engine._xprof.recompiles_total == recompiles_before
+        # the reports really flowed: registry rows for this engine's owner
+        # and a ledger row attributing its KV bytes
+        prog_report = stats["programs"]
+        assert prog_report["totals"]["programs"] > 0
+        assert all(r["owner"] == engine._xprof_owner
+                   for r in prog_report["programs"])
+        mem = stats["memory"]
+        owner_row = mem["owners"][engine._xprof_owner]
+        assert owner_row["components"]["kv_slots"] > 0
+        assert mem["tracked_bytes_total"] >= owner_row["bytes"]
+        # a warm generate after the reports stays at the exact pull bound
+        out = _generate(engine, [5, 9, 17, 3], max_tokens=4)
+        assert len(out) == 4
+        assert spy.device_pulls == pulls + 4  # 1 admission + 3 decode steps
     finally:
         engine.shutdown()
 

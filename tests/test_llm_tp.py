@@ -349,6 +349,52 @@ def test_from_sharded_checkpoint_restores_to_mesh_layout(tmp_path):
         eng1.shutdown()
 
 
+# -- device-memory ledger: per-shard attribution ------------------------------
+
+_LEDGER_SNIPPET = r"""
+import json
+import jax, jax.numpy as jnp
+from ray_tpu.models.transformer import Transformer, get_config
+from ray_tpu.llm._engine import DecodeEngine
+from ray_tpu.util import xprof
+
+cfg = get_config("test-tiny", scan_layers=False, remat=False, n_kv_heads=4)
+model = Transformer(cfg)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+eng = DecodeEngine(cfg, params, num_slots=2, max_seq=64, tp=2)
+rep = xprof.device_memory_report()
+row = rep["owners"][eng._xprof_owner]
+out = {
+    "pool_bytes": eng._kv_pool.total_bytes,
+    "kv_slots": row["components"]["kv_slots"],
+    "per_device": row.get("per_device", {}),
+    "tracked_total": rep["tracked_bytes_total"],
+}
+eng.shutdown()
+out["owners_after"] = [o for o in xprof.device_memory_report()["owners"]
+                       if o.startswith("engine-")]
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_device_memory_report_attributes_tp2_shards(multi_device_run):
+    """The ledger's TP contract: on a TP=2 mesh, device_memory_report()
+    attributes the engine's KV bytes per DEVICE (shard shape metadata only —
+    per_device_byte_map never pulls), the per-device rows sum exactly to the
+    pool's tracked total, split evenly across the mesh, and the owner row
+    vanishes on shutdown."""
+    out = multi_device_run(_LEDGER_SNIPPET, timeout=600)
+    assert out["pool_bytes"] > 0
+    assert out["kv_slots"] == out["pool_bytes"]
+    assert out["tracked_total"] >= out["pool_bytes"]
+    per_device = {k: int(v) for k, v in out["per_device"].items()}
+    assert len(per_device) == 2, per_device      # exactly the TP=2 mesh
+    assert sum(per_device.values()) == out["pool_bytes"], per_device
+    lo, hi = sorted(per_device.values())
+    assert lo == hi, per_device                  # heads shard evenly
+    assert out["owners_after"] == []             # shutdown unregisters
+
+
 # -- drain-and-retire frees every shard ---------------------------------------
 
 @needs_mesh

@@ -252,6 +252,21 @@ def test_rl801_kvtier_fires_and_suppresses():
         assert sym not in found, (sym, found.get(sym))
 
 
+def test_rl801_profiler_capture_fires_and_suppresses():
+    """The round-18 RESOURCE_TABLE entry (xprof.start_capture ->
+    ProfilerCapture.stop_capture/close) flows through the same RL801 path
+    analysis: a capture never stopped keeps jax.profiler tracing for the
+    rest of the process's life (docs/observability.md)."""
+    found = _codes_by_symbol(_fixture("case_rl8_xprof.py"))
+    for sym in ("bad_capture_never_stopped", "bad_capture_conditional",
+                "bad_capture_risky_gap"):
+        assert found.get(sym) == {"RL801"}, (sym, found.get(sym))
+    for sym in ("ok_capture_finally", "ok_capture_close_finally",
+                "ok_capture_stored", "ok_capture_returned",
+                "suppressed_capture"):
+        assert sym not in found, (sym, found.get(sym))
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}

@@ -196,3 +196,81 @@ def test_serve_stats_one_call_snapshot():
     assert sched["iterations"] >= 1 and "recorder" in sched
     assert isinstance(snap["transport"], dict)
     assert isinstance(snap["control_plane"], dict)
+
+
+def test_capture_profile_round_trip_on_live_replicas(tmp_path):
+    """`ray_tpu.util.state.capture_profile` starts a trace capture on every
+    replica of a DP=2 app simultaneously (two live worker processes) and
+    gathers non-empty trace artifacts back to the driver, writing them under
+    out_dir/<app>/rank<k>/."""
+    import os
+
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+    from ray_tpu.util.state import capture_profile
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=2
+    )
+    handle = serve.run(app, name="obs-prof", route_prefix=None,
+                       _timeout_s=300)
+    handle.generate.remote("warm request", max_tokens=2).result(timeout_s=300)
+
+    rows = capture_profile(["obs-prof"], duration_s=0.3,
+                           out_dir=str(tmp_path))
+    (row,) = rows
+    assert row["target"] == "obs-prof"
+    assert "error" not in row, row
+    caps = row["capture"]
+    assert isinstance(caps, list) and len(caps) == 2, caps  # DP fan-out
+    ranks = {c["dp_rank"] for c in caps}
+    assert ranks == {0, 1}, ranks
+    for c in caps:
+        assert c["files"], c                 # non-empty trace artifacts
+        assert "capture_manifest.json" in c["files"]
+        assert c["manifest"]["duration_s"] >= 0.3
+    assert row["gathered"], row
+    for path in row["gathered"]:
+        assert os.path.isfile(path) and os.path.getsize(path) > 0
+    # both ranks' artifacts landed in distinct per-rank dirs
+    rank_dirs = {os.path.relpath(p, tmp_path).split(os.sep)[1]
+                 for p in row["gathered"]}
+    assert rank_dirs == {"rank0", "rank1"}, rank_dirs
+    # a bogus target reports its error without failing the sweep
+    bad = capture_profile(["no-such-app"], duration_s=0.1)
+    assert "error" in bad[0]
+
+
+def test_status_cli_smoke_on_live_cluster(capsys):
+    """`ray_tpu status` against the running mini-cluster: exits cleanly and
+    renders the node/actor/serve/program/memory sections from one
+    cluster_status() snapshot (the acceptance smoke for the operator CLI).
+    Reuses the test's driver connection — cmd_status skips the address file
+    when ray_tpu is already initialized."""
+    import argparse
+
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.dp_serve import build_dp_openai_app
+    from ray_tpu.scripts.scripts import cmd_status, main
+
+    app = build_dp_openai_app(
+        LLMConfig(model_id="test-tiny", num_slots=2), dp_size=1
+    )
+    handle = serve.run(app, name="obs-cli", route_prefix=None,
+                       _timeout_s=300)
+    handle.generate.remote("warm request", max_tokens=2).result(timeout_s=300)
+
+    main(["status"])  # raises on nonzero exit; smoke = it renders
+    text = capsys.readouterr().out
+    for section in ("== nodes ==", "== actors ==", "== serve ==",
+                    "== programs (driver) ==", "== memory (driver) =="):
+        assert section in text, text[:2000]
+    assert "obs-cli" in text                  # the live app shows up
+    assert "ALIVE" in text                    # node listing rendered
+    assert ray_tpu.is_initialized()           # borrowed connection kept open
+
+    cmd_status(argparse.Namespace(json=True))
+    snapshot = json.loads(capsys.readouterr().out)
+    assert snapshot["summary"]["alive_nodes"] >= 1
+    assert "obs-cli" in snapshot["serve"]["apps"]
+    assert "programs" in snapshot and "memory" in snapshot

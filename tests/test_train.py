@@ -41,6 +41,42 @@ def test_basic_fit_reports_metrics(ray_start_regular, storage):
     assert result.error is None
     assert result.metrics["step"] == 2
     assert result.metrics["rank"] == 0  # rank-0 metrics win
+    # round 18: every report carried a per-step flight record; the controller
+    # aggregates the four phases per rank into the final Result
+    stats = result.train_stats
+    assert stats["reports"] == 6  # 3 reports x 2 ranks
+    assert set(stats["phases"]) == {0, 1}
+    for rank_totals in stats["phases"].values():
+        assert set(rank_totals) == {"data_wait_s", "step_compute_s",
+                                    "report_blocked_s", "checkpoint_blocked_s"}
+        assert rank_totals["step_compute_s"] >= 0.0
+
+
+def test_train_stats_report_path_exposes_recorder(ray_start_regular, storage):
+    """`ray_tpu.train.train_stats()` inside a worker (and the WorkerGroup
+    fan-out) is the report path: per-step flight records ride the PR 13
+    FlightRecorder ring, the phase totals accumulate, and the program/memory
+    reports come along — none of which touches the step loop itself."""
+    def loop(config):
+        for step in range(2):
+            train.report({"step": step})
+        stats = train.train_stats()
+        assert stats is not None and stats["reports"] == 2
+        rec = stats["recorder"]
+        assert rec["started"] == 2 and rec["finished"] == 2
+        (last,) = [r for r in stats["records"] if r["rid"] == "step-1"]
+        assert set(last["phases"]) == {"data-wait", "step-compute",
+                                       "report-blocked", "checkpoint-blocked"}
+        assert "programs" in stats and "memory" in stats
+        train.report({"ok": True})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="flight", storage_path=storage),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["ok"] is True
 
 
 def test_ranks_unique_and_broadcast(ray_start_regular, storage, tmp_path):

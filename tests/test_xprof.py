@@ -1,0 +1,219 @@
+"""Compute-plane observatory: XLA program registry, device-memory ledger,
+OOM forensics, and profiler capture (docs/observability.md "compute plane").
+
+The registry's core contract: a warm program never counts a compile again
+(`xla_recompiles_total` reads 0 across any warm run), while a planted retrace
+— rebuilding a program the registry has already seen compiled — fires it.
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.util import xprof
+
+
+@pytest.fixture()
+def reg():
+    r = xprof.ProgramRegistry()
+    yield r
+
+
+# ---- program registry -------------------------------------------------------
+
+def test_registry_counts_one_compile_per_program(reg):
+    prog = reg.instrument("eng", ("decode",), jax.jit(lambda x: x + 1))
+    for i in range(3):
+        _ = prog(jnp.zeros(4))
+    rep = reg.report()
+    assert rep["totals"] == {
+        "programs": 1, "compiles_total": 1, "recompiles_total": 0,
+        "compile_s_total": pytest.approx(rep["totals"]["compile_s_total"]),
+    }
+    (row,) = rep["programs"]
+    assert row["owner"] == "eng" and row["compiles"] == 1
+    assert row["invocations"] == 3 and row["recompiles"] == 0
+    assert row["compile_s"] > 0.0  # first call paid a real trace+compile
+
+
+def test_planted_retrace_fires_recompile_counter(reg):
+    """The adversarial shape: re-instrumenting an already-seen (owner, key) —
+    what a cache eviction rebuild or a shape-retrace storm looks like at the
+    registry — increments recompiles, not warmup compiles."""
+    prog = reg.instrument("eng", ("prefill", 64), jax.jit(lambda x: x * 2))
+    _ = prog(jnp.zeros(4))
+    assert reg.recompiles_total == 0
+
+    # Plant the retrace: the engine rebuilds the same bucket's program.
+    prog2 = reg.instrument("eng", ("prefill", 64), jax.jit(lambda x: x * 2))
+    _ = prog2(jnp.zeros(4))
+    assert reg.recompiles_total == 1
+    rep = reg.report()
+    (row,) = rep["programs"]
+    assert row["compiles"] == 2 and row["recompiles"] == 1
+    # Warm calls after the retrace stay free.
+    _ = prog2(jnp.zeros(4))
+    assert reg.recompiles_total == 1
+
+
+def test_note_span_and_note_exec_never_count_compiles(reg):
+    reg.note_span("checkpoint", ("restore",), 1.5)
+    reg.note_exec("learner", ("update", "sig"), 0.25)
+    rep = reg.report()
+    assert rep["totals"]["compiles_total"] == 0
+    assert rep["totals"]["recompiles_total"] == 0
+    by_owner = {r["owner"]: r for r in rep["programs"]}
+    assert by_owner["checkpoint"]["invocations"] == 1
+    assert by_owner["checkpoint"]["exec_s"] == pytest.approx(1.5)
+    assert by_owner["learner"]["invocations"] == 0
+    assert by_owner["learner"]["exec_s"] == pytest.approx(0.25)
+
+
+def test_report_filters_by_owner_and_forget_owner(reg):
+    a = reg.instrument("a", ("k",), jax.jit(lambda x: x + 1))
+    b = reg.instrument("b", ("k",), jax.jit(lambda x: x - 1))
+    _ = a(jnp.zeros(2))
+    _ = b(jnp.zeros(2))
+    assert len(reg.report(owner="a")["programs"]) == 1
+    assert len(reg.report()["programs"]) == 2
+    reg.forget_owner("a")
+    assert reg.report(owner="a")["programs"] == []
+    # totals watermarks survive the forget: no negative deltas on next report
+    assert reg.report()["totals"]["programs"] == 1
+
+
+def test_instrumented_program_delegates_attributes(reg):
+    jitted = jax.jit(lambda x: x + 1)
+    prog = reg.instrument("eng", ("k",), jitted)
+    _ = prog(jnp.zeros(2))
+    # the adapters stats() probe and any other jit attribute ride through
+    assert prog._cache_size() == jitted._cache_size()
+    assert prog.__wrapped__ is jitted
+
+
+def test_unhashable_key_is_frozen(reg):
+    prog = reg.instrument("eng", ["prefill", [1, 2]], jax.jit(lambda x: x))
+    _ = prog(jnp.zeros(2))
+    (row,) = reg.report()["programs"]
+    assert row["key"] == ("prefill", (1, 2))
+
+
+# ---- device-memory ledger ---------------------------------------------------
+
+def test_memory_ledger_attributes_owner_bytes():
+    xprof.register_memory_owner("san-owner", lambda: {
+        "bytes": 1024, "components": {"kv": 1024},
+        "per_device": {"0": 512, "1": 512},
+    })
+    try:
+        rep = xprof.device_memory_report()
+        assert rep["owners"]["san-owner"]["bytes"] == 1024
+        assert rep["tracked_bytes_total"] >= 1024
+        assert rep["per_device_tracked_bytes"]["0"] == 512
+        assert rep["devices"], "jax.devices() must appear in the report"
+        assert {"id", "platform"} <= set(rep["devices"][0])
+    finally:
+        xprof.unregister_memory_owner("san-owner")
+    assert "san-owner" not in xprof.device_memory_report()["owners"]
+
+
+def test_memory_ledger_owner_error_is_contained():
+    def broken():
+        raise RuntimeError("owner died")
+
+    xprof.register_memory_owner("san-broken", broken)
+    try:
+        rep = xprof.device_memory_report()
+        assert "owner died" in rep["owners"]["san-broken"]["error"]
+    finally:
+        xprof.unregister_memory_owner("san-broken")
+
+
+# ---- OOM forensics ----------------------------------------------------------
+
+def test_is_resource_exhausted_matches_xla_shapes():
+    assert xprof.is_resource_exhausted(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                     "allocate 21474836480 bytes."))
+    assert xprof.is_resource_exhausted(ValueError("Resource exhausted: HBM"))
+    assert not xprof.is_resource_exhausted(ValueError("shape mismatch"))
+
+
+def test_oom_snapshot_ranks_owners_descending():
+    xprof.register_memory_owner("san-big", lambda: {"bytes": 2048})
+    xprof.register_memory_owner("san-small", lambda: {"bytes": 16})
+    try:
+        snap = xprof.oom_snapshot()
+        ranked = [r["owner"] for r in snap["ranked_owners"]
+                  if r["owner"].startswith("san-")]
+        assert ranked == ["san-big", "san-small"]
+        assert snap["ts"] > 0
+    finally:
+        xprof.unregister_memory_owner("san-big")
+        xprof.unregister_memory_owner("san-small")
+
+
+def test_flight_recorder_keeps_first_oom_snapshot():
+    from ray_tpu.llm.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(name="san-oom", capacity=4)
+    try:
+        rec.note_oom({"ts": 1.0, "ranked_owners": [{"owner": "kv", "bytes": 9}]})
+        rec.note_oom({"ts": 2.0, "ranked_owners": []})  # cascade: noise
+        stats = rec.stats()
+        assert stats["oom"] == 2
+        assert stats["last_oom"]["ts"] == 1.0
+    finally:
+        rec.close()
+
+
+# ---- profiler capture -------------------------------------------------------
+
+def test_capture_round_trip_yields_manifest_and_files():
+    log_dir = tempfile.mkdtemp(prefix="xprof_test_")
+    out = xprof.capture(duration_s=0.05, log_dir=log_dir)
+    assert out["log_dir"] == log_dir
+    assert out["manifest"]["duration_s"] >= 0.05
+    assert out["manifest"]["pid"] == os.getpid()
+    # at minimum the manifest itself is gathered inline
+    assert "capture_manifest.json" in out["files"]
+    manifest = json.loads(out["files"]["capture_manifest.json"])
+    assert manifest["log_dir"] == log_dir
+
+
+def test_second_start_capture_raises_while_active():
+    cap = xprof.start_capture(log_dir=tempfile.mkdtemp(prefix="xprof_test_"))
+    try:
+        with pytest.raises(RuntimeError):
+            xprof.start_capture()
+    finally:
+        cap.stop_capture()
+    # idempotent stop, and the slot frees for the next capture
+    cap.stop_capture()
+    cap2 = xprof.start_capture(log_dir=tempfile.mkdtemp(prefix="xprof_test_"))
+    cap2.close()
+
+
+# ---- metrics exposition (report path) ---------------------------------------
+
+def test_registry_report_emits_metrics_deltas(reg, ray_start_isolated):
+    from ray_tpu.util.metrics import render_prometheus
+
+    prog = reg.instrument("eng", ("decode",), jax.jit(lambda x: x + 1))
+    _ = prog(jnp.zeros(2))
+    reg.report()  # the ONLY place counters become util.metrics series
+    text = render_prometheus()
+    assert "xla_compiles_total" in text
+    assert "xla_recompiles_total" in text
+
+
+def test_render_prometheus_alias_preserved():
+    from ray_tpu.util import metrics
+
+    assert metrics.prometheus_text is metrics.render_prometheus
