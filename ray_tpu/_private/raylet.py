@@ -503,6 +503,7 @@ class Raylet:
                 return None
         host, port = info["address"]
         try:
+            # raylint: disable=RL902 (one-shot per-peer dial, memoized in peer_conns above; the steady-state scheduling loop never reaches it)
             conn = await rpc.connect(host, port, handler=self, name=f"raylet->{node_id.hex()[:8]}")
         except OSError:
             return None

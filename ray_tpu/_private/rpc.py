@@ -83,6 +83,9 @@ class RemoteError(RpcError):
         self.remote_traceback = tb
         super().__init__(f"remote call {method!r} failed:\n{tb}")
 
+    def __reduce__(self):  # travels pickled inside RPC error replies
+        return (RemoteError, (self.method, self.remote_traceback))
+
 
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_LEN_SIZE)

@@ -636,7 +636,12 @@ class ObjectRefGenerator:
 
     def __del__(self):
         try:
-            self._worker._streams.pop(self._task_id, None)
+            from ray_tpu.devtools import distsan
+
+            # Local dict cleanup only: the finalizer tag asserts (under
+            # RAY_TPU_DISTSAN=1) that no control-plane call sneaks in here.
+            with distsan.finalizer("stream-iterator"):
+                self._worker._streams.pop(self._task_id, None)
         except Exception:
             pass
 
@@ -959,6 +964,9 @@ class CoreWorker:
         `_connect_gcs_primary` and retries, up to a total deadline
         (`deadline_s`, default CONFIG.gcs_rpc_timeout_s), after which
         ConnectionLost surfaces to the caller."""
+        from ray_tpu.devtools import distsan
+
+        distsan.note_gcs_call(method)  # records if a hot/finalizer tag is active
         deadline = time.monotonic() + (
             deadline_s if deadline_s is not None else CONFIG.gcs_rpc_timeout_s
         )
@@ -999,7 +1007,7 @@ class CoreWorker:
                     "gcs_reconnect_total",
                     "GCS client reconnections that recovered an in-flight call",
                 )
-            self._gcs_reconnect_counter.inc(n)
+            self._gcs_reconnect_counter.inc(n)  # raylint: disable=RL901 (rare reconnect event, not a data path; the nested flush rides the just-recovered connection — see docstring)
         except Exception:
             pass  # observability must never break the recovered call
 

@@ -1,0 +1,159 @@
+"""distsan: runtime distributed-contract sanitizer.
+
+The runtime counterpart of `raylint`'s RL9xx static family (distlint), the
+way leaksan backs the RL8xx checkers: distlint PROVES at parse time that no
+metric mutation or control-plane RPC sits on a hot/finalizer path it can
+see; distsan CATCHES the ones it can't — mutations reached through
+callbacks, dynamic dispatch, or third-party code — at the moment they
+execute.
+
+The model is a thread-local stack of context tags:
+
+- ``hot_path(label)``   — a scheduler/decode/dispatch loop: a blocking GCS
+  round-trip here gates every iteration on the control plane.
+- ``finalizer(label)``  — a ``__del__``/weakref finalizer: GC timing decides
+  when (and on which thread) the control plane would be dialed.
+- ``report_path(label)`` — a stats()/report() export: control-plane traffic
+  here is the contract. The INNERMOST tag decides, so a report-path flush
+  invoked from inside a tagged hot loop is still fine.
+
+Instrumented sites (``util.metrics`` mutators, ``worker.gcs_call``) call
+``note_metric_mutation`` / ``note_gcs_call``; when the innermost tag is a
+hot path or finalizer, a violation record is appended — never raised, so
+production behavior is unchanged even when enabled. The pytest guard
+(tests/conftest.py ``distsan_guard``) fails any test in a wired suite that
+recorded violations.
+
+Zero overhead unless enabled: every note/tag entry starts with one
+``enabled()`` check (an env read / cached bool); nothing is allocated and
+no lock is taken when the sanitizer is off. Enable with
+``RAY_TPU_DISTSAN=1`` in the environment, or programmatically with
+``enable()`` (what the pytest fixture does).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled_override: Optional[bool] = None
+_violations: List[Dict[str, str]] = []
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("RAY_TPU_DISTSAN", "") == "1"
+
+
+def enable() -> None:
+    global _enabled_override
+    _enabled_override = True
+
+
+def disable() -> None:
+    global _enabled_override
+    _enabled_override = False
+
+
+def reset() -> None:
+    """Drop recorded violations and this thread's tag stack (test isolation)."""
+    with _lock:
+        _violations.clear()
+    _tls.stack = []
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class _Tag:
+    """Context-manager tag. Pushes only when the sanitizer is enabled at
+    entry (and balances its own push even if disable() races the body)."""
+
+    __slots__ = ("kind", "label", "_pushed")
+
+    def __init__(self, kind: str, label: str):
+        self.kind = kind
+        self.label = label
+        self._pushed = False
+
+    def __enter__(self):
+        self._pushed = enabled()
+        if self._pushed:
+            _stack().append((self.kind, self.label))
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            stack = _stack()
+            if stack:
+                stack.pop()
+        return False
+
+
+def hot_path(label: str = "") -> _Tag:
+    """Tag the dynamic extent of a scheduler/decode/dispatch loop."""
+    return _Tag("hot", label)
+
+
+def report_path(label: str = "") -> _Tag:
+    """Tag a stats()/report() export — control-plane traffic is the contract."""
+    return _Tag("report", label)
+
+
+def finalizer(label: str = "") -> _Tag:
+    """Tag a __del__ / weakref-finalize body."""
+    return _Tag("finalizer", label)
+
+
+def _innermost() -> Optional[tuple]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _record(kind: str, detail: str, tag: tuple) -> None:
+    entry = {
+        "kind": kind,
+        "detail": detail,
+        "context": tag[0],
+        "label": tag[1],
+        "thread": threading.current_thread().name,
+    }
+    with _lock:
+        _violations.append(entry)
+
+
+def note_gcs_call(verb: str) -> None:
+    """Called by worker.gcs_call at dispatch time. A control-plane round-trip
+    inside a tagged hot loop or finalizer is a violation; inside a report
+    path (innermost) it is the contract."""
+    if not enabled():
+        return
+    tag = _innermost()
+    if tag is not None and tag[0] in ("hot", "finalizer"):
+        _record("gcs_call", verb, tag)
+
+
+def note_metric_mutation(name: str) -> None:
+    """Called by Counter.inc / Gauge.set / Histogram.observe. Every mutation
+    may flush, and a flush is a blocking GCS RPC — so a mutation inside a
+    tagged hot loop or finalizer is a violation even when THIS one happens
+    not to flush."""
+    if not enabled():
+        return
+    tag = _innermost()
+    if tag is not None and tag[0] in ("hot", "finalizer"):
+        _record("metric_mutation", name, tag)
+
+
+def violations() -> List[Dict[str, str]]:
+    """Snapshot of the recorded violations (copies; safe to mutate)."""
+    with _lock:
+        return [dict(v) for v in _violations]

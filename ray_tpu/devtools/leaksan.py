@@ -251,6 +251,6 @@ def _export_gauges(counts: Dict[str, int]) -> None:
             kinds = set(_gauge_kinds_seen)
         # set() outside the lock: a gauge flush is a GCS round-trip
         for kind in kinds:
-            _gauge.set(float(counts.get(kind, 0)), tags={"kind": kind})
+            _gauge.set(float(counts.get(kind, 0)), tags={"kind": kind})  # raylint: disable=RL901 (this IS leaksan's report path: _export_gauges runs only from the live_counts()/snapshot() export, never per-acquire)
     except Exception:
         pass  # observability must never break the workload

@@ -579,12 +579,16 @@ def check_file(ctx: FileContext) -> tuple[list[Finding], list[LockEdge]]:
     # jaxlint (RL6xx/RL7xx) only has something to say about files that
     # touch jax; the import gate keeps control-plane float()/np.asarray
     # idioms out of its sight.
-    from ray_tpu.devtools.raylint import jaxlint, leaklint
+    from ray_tpu.devtools.raylint import distlint, jaxlint, leaklint
 
     findings = findings + jaxlint.check_jax_file(ctx)
     # leaklint (RL8xx) keys off the declarative resource table, so it runs
     # over every file — the table's receiver hints are its precision gate.
     findings = findings + leaklint.check_leak_file(ctx)
+    # distlint (RL9xx) enforces the distributed-plane contracts (report-path
+    # metrics, finalizer/lock/hot-context RPC, remote-safe exceptions,
+    # explicit trace_ctx); its receiver/roster proofs are the precision gate.
+    findings = findings + distlint.check_dist_file(ctx)
     return findings, checker.lock_edges
 
 

@@ -62,6 +62,43 @@ def _expand_only(patterns: str) -> set[str] | None:
     return out
 
 
+def _changed_python_files() -> list[str] | None:
+    """The union of unstaged, staged, and untracked .py files in the git
+    repository at the current directory (for `--changed` pre-commit runs).
+    Returns None when git is unavailable — a usage error upstream."""
+    import os
+    import subprocess
+
+    cmds = [
+        ["git", "rev-parse", "--show-toplevel"],
+        ["git", "diff", "--name-only", "--diff-filter=d"],
+        ["git", "diff", "--name-only", "--diff-filter=d", "--cached"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ]
+    outputs = []
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except OSError:
+            return None
+        if proc.returncode != 0:
+            return None
+        outputs.append(proc.stdout)
+    root = outputs[0].strip()
+    seen: set[str] = set()
+    out: list[str] = []
+    for listing in outputs[1:]:
+        for rel in listing.splitlines():
+            rel = rel.strip()
+            if not rel.endswith(".py") or rel in seen:
+                continue
+            seen.add(rel)
+            abspath = os.path.join(root, rel)
+            if os.path.isfile(abspath):
+                out.append(abspath)
+    return sorted(out)
+
+
 def _finding_dict(f: Finding) -> dict:
     return {"file": f.path, "line": f.line, "code": f.code,
             "symbol": f.symbol, "message": f.message}
@@ -71,8 +108,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="raylint",
         description="framework-aware static analysis for the ray_tpu "
-                    "control plane (RL1xx-RL5xx) and JAX compute plane "
-                    "(RL6xx/RL7xx)",
+                    "control plane (RL1xx-RL5xx), JAX compute plane "
+                    "(RL6xx/RL7xx), resource-lifetime plane (RL8xx), and "
+                    "distributed-contract plane (RL9xx)",
     )
     parser.add_argument("paths", nargs="*", default=["ray_tpu"],
                         help="files or directories to lint")
@@ -93,13 +131,20 @@ def main(argv: list[str] | None = None) -> int:
                              "trailing run of x's wildcards the tail "
                              "(e.g. RL8xx = the whole leaklint family)")
     parser.add_argument("--family", default=None,
-                        choices=sorted(FAMILIES),
-                        help="run one checker family (concurrency = RL1xx-"
-                             "RL5xx, jax = RL6xx/RL7xx, leak = RL8xx); "
+                        help="run one or more checker families, comma-"
+                             "separated (concurrency = RL1xx-RL5xx, jax = "
+                             "RL6xx/RL7xx, leak = RL8xx, dist = RL9xx); "
                              "composable with --select/--only (union). The "
                              "exit contract is unchanged: filters narrow "
                              "which findings (and stale entries) count, "
                              "never how the exit status is derived")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only the .py files git reports as "
+                             "changed (unstaged + staged + untracked) in "
+                             "the repository at the current directory — the "
+                             "fast pre-commit run. Positional paths are "
+                             "ignored; findings, baseline, and exit "
+                             "contract are unchanged")
     parser.add_argument("--codes", action="store_true",
                         help="list checker codes and exit")
     parser.add_argument("--format", choices=("text", "json"), default="text",
@@ -135,11 +180,28 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         selected |= expanded
     if args.family:
-        selected |= FAMILIES[args.family]
+        picked = {f.strip() for f in args.family.split(",") if f.strip()}
+        unknown = picked - set(FAMILIES)
+        if unknown:
+            print(
+                f"unknown family(ies): {sorted(unknown)} "
+                f"(known: {', '.join(sorted(FAMILIES))})", file=sys.stderr,
+            )
+            return 2
+        for fam in picked:
+            selected |= FAMILIES[fam]
     if selected:
         codes = selected
 
-    findings = lint_paths(args.paths, codes=codes)
+    paths = args.paths
+    if args.changed:
+        paths = _changed_python_files()
+        if paths is None:
+            print("--changed requires a git checkout (git not available or "
+                  "not a repository)", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, codes=codes)
 
     if args.emit_baseline:
         json.dump(emit_baseline(findings), sys.stdout, indent=2)
@@ -152,6 +214,10 @@ def main(argv: list[str] | None = None) -> int:
     # unselected codes are not "stale" in any actionable sense.
     if codes:
         stale = [e for e in stale if e.get("code") in codes]
+    # A --changed run only sees a slice of the files: entries for the
+    # unchanged rest of the tree never had the chance to match.
+    if args.changed:
+        stale = []
 
     rc = 1 if violations or (args.fail_stale and stale) else 0
 

@@ -69,6 +69,29 @@ CODES: dict[str, str] = {
     "RL804": "fragile-release: a failing release silently swallowed by an "
              "undocumented broad except, or a release performed under a "
              "different lock than its acquire",
+    # -- distlint family (distributed-contract plane) ------------------------
+    "RL901": "metric-outside-report-path: Counter.inc/Gauge.set/Histogram."
+             "observe reachable from outside the stats()/scheduler_stats()/"
+             "recorder_stats()/report()/control_plane_stats() roster — every "
+             "mutation may flush, and a flush is a blocking GCS RPC",
+    "RL902": "rpc-in-forbidden-context: blocking control-plane RPC "
+             "(gcs_call, KV verbs, by-name get_actor, rpc connect) in a "
+             "__del__/weakref finalizer, under a held lock, or in a "
+             "scheduler/decode hot context",
+    "RL903": "remote-unsafe-exception: exception class whose custom "
+             "__init__ does not forward its args verbatim and that defines "
+             "no __reduce__ — it re-raises mangled (or not at all) after a "
+             ".remote()/RPC pickle round-trip",
+    "RL904": "trace-ctx-across-executor: tracing.current()/"
+             "propagation_context() read inside a callback handed to "
+             "run_in_executor/submit/Thread — contextvars do not cross "
+             "threads; capture trace_ctx before the hop and pass it "
+             "explicitly",
+    "RL905": "await-rpc-under-lock: await of a cross-process call "
+             "(.remote(), gcs verbs, or a helper that performs one) while "
+             "holding an async lock — or a sync-lock-held call into a "
+             "helper that blocks on the control plane (the interprocedural "
+             "RL101/RL902 extension)",
 }
 
 #: Checker families, for the CLI's `--family` filter and the per-family
@@ -77,6 +100,7 @@ FAMILIES: dict[str, frozenset] = {
     "concurrency": frozenset(c for c in CODES if c[2] in "12345"),
     "jax": frozenset(c for c in CODES if c[2] in "67"),
     "leak": frozenset(c for c in CODES if c[2] == "8"),
+    "dist": frozenset(c for c in CODES if c[2] == "9"),
 }
 
 _DISABLE_MARK = "raylint:"

@@ -1,0 +1,720 @@
+"""distlint: the RL9xx distributed-contract family.
+
+Five PRs in a row re-learned the same distributed-plane invariants by
+review comment; these checkers make them machine-enforced:
+
+- **RL901** metric mutation outside a report path. Every `Counter.inc` /
+  `Gauge.set` / `Histogram.observe` may flush — and a flush IS a blocking
+  GCS RPC (`util/metrics.py _maybe_flush`). Mutations are therefore only
+  allowed from the declared report-path roster (`stats`, `scheduler_stats`,
+  `recorder_stats`, `report`, `control_plane_stats`) and from helpers the
+  call graph proves are reached exclusively from those (the same fixpoint
+  shape as jaxlint's hot-context analysis, inverted).
+- **RL902** blocking control-plane RPC (`gcs_call`, KV verbs, by-name actor
+  lookup, rpc `connect`) in a `__del__`/weakref finalizer, under a held
+  sync lock, or in a scheduler/decode hot context.
+- **RL903** exception classes that don't survive a `.remote()`/RPC hop:
+  a custom `__init__` whose `super().__init__(...)` args are not exactly
+  its own positional parameters means default pickling re-calls the class
+  with the FORMATTED message, shifting it into the first parameter slot —
+  define `__reduce__` (the `exceptions.py` idiom) or forward args verbatim.
+- **RL904** trace context read on the wrong side of an executor/thread
+  boundary: `tracing.current()` / `tracing.propagation_context()` inside a
+  callback handed to `run_in_executor` / `executor.submit` /
+  `Thread(target=...)` reads an EMPTY context (contextvars do not cross
+  threads) — capture `trace_ctx` before the hop and pass it explicitly.
+- **RL905** `await` of a cross-process call (`.remote()`, gcs verbs, or an
+  in-file helper that transitively performs one) while holding an
+  `async with <lock>` — the RL101 contract extended to the RPC layer —
+  plus the interprocedural shape RL902 can't see: a call under a held sync
+  lock to an in-file helper that transitively blocks on the control plane.
+
+All five run over every file (no import gate): the contracts are properties
+of the control plane, not of any one library's API.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ray_tpu.devtools.raylint.core import FileContext, Finding
+
+from ray_tpu.devtools.raylint.checkers import (  # shared identity helpers
+    _base_ident,
+    _ident_parts,
+    _is_lockish,
+    _root_name,
+)
+
+#: The declared report-path roster (docs/raylint.md §RL901): functions whose
+#: JOB is to assemble/flush observability state, where a metrics flush (a GCS
+#: round-trip) is the contract rather than a hazard.
+REPORT_ROSTER = frozenset({
+    "stats", "scheduler_stats", "recorder_stats", "report",
+    "control_plane_stats",
+})
+
+_METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+#: Metric mutators (and the explicit flush): each one may perform the
+#: rate-limited GCS kv_put.
+_METRIC_MUTATORS = frozenset({"inc", "set", "observe", "flush"})
+
+_KV_VERBS = frozenset({"kv_get", "kv_put", "kv_del", "kv_keys"})
+#: Receiver ident parts that mark a bare `connect()` as a control-plane dial.
+_RPC_RECEIVER_PARTS = frozenset({
+    "gcs", "rpc", "conn", "client", "stub", "channel", "raylet",
+})
+#: Function name parts that mark a frame as a scheduler/decode hot context.
+_HOT_NAME_PARTS = frozenset({"decode", "schedule", "scheduler"})
+
+_TRACE_READS = frozenset({"current", "propagation_context"})
+
+#: `leaf name -> positional index of the callback` for executor/thread
+#: hand-off calls (run_in_executor's arg 0 is the executor itself).
+_HANDOFF_CALLBACK_POS = {"run_in_executor": 1, "submit": 0}
+_SUBMIT_RECEIVER_PARTS = frozenset({"executor", "executors", "pool", "pools"})
+
+
+def _leaf_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_gcs_rpc(node: ast.Call) -> Optional[str]:
+    """The control-plane RPC verbs RL902/RL905 reason about. Returns a short
+    description or None."""
+    leaf = _leaf_name(node.func)
+    if leaf == "gcs_call":
+        verb = ""
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            verb = f"({node.args[0].value!r})"
+        return f"gcs_call{verb}"
+    if leaf in _KV_VERBS:
+        return leaf
+    if leaf == "get_actor" and node.args and isinstance(
+        node.args[0], ast.Constant
+    ) and isinstance(node.args[0].value, str):
+        return "by-name get_actor"
+    if leaf == "connect" and isinstance(node.func, ast.Attribute):
+        receiver = _base_ident(node.func.value)
+        root = _root_name(node.func.value)
+        parts = set()
+        if receiver:
+            parts |= _ident_parts(receiver)
+        if root:
+            parts |= _ident_parts(root)
+        if parts & _RPC_RECEIVER_PARTS:
+            return "rpc connect"
+    return None
+
+
+def _is_remote_call(node: ast.Call) -> bool:
+    """`handle.method.remote(...)` / `actor.remote(...)` — a cross-process
+    submission."""
+    return isinstance(node.func, ast.Attribute) and node.func.attr == "remote"
+
+
+def _is_tracing_read(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _TRACE_READS:
+        return False
+    root = _root_name(func.value)
+    return root == "tracing" or _base_ident(func.value) == "tracing"
+
+
+def _contains_metric_ctor(value: ast.expr) -> bool:
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            leaf = _leaf_name(node.func)
+            if leaf in _METRIC_CTORS:
+                return True
+    return False
+
+
+def _is_hot_named(name: str) -> bool:
+    return bool(_ident_parts(name) & _HOT_NAME_PARTS)
+
+
+class _Prepass(ast.NodeVisitor):
+    """File-wide facts the per-node checks key off: which names hold metrics,
+    the in-file call graph (and its report-path / rpc / trace-read closures),
+    and which functions are weakref finalizers."""
+
+    def __init__(self, tree: ast.AST):
+        # -- metric identity -------------------------------------------------
+        self.metric_attrs: set[str] = set()     # self.<attr> = Counter(...)
+        self.metric_names: set[str] = set()     # NAME = Gauge(...)
+        self.metric_factories: set[str] = set()  # def f(): return {..Counter..}
+        self._assigned_from_call: list[tuple[str, str]] = []  # (name, callee)
+        # -- call graph ------------------------------------------------------
+        self._calls_all: dict[str, set[str]] = {}
+        self._calls_in_loops: dict[str, set[str]] = {}
+        # -- per-function direct facts ---------------------------------------
+        self._direct_rpc: set[str] = set()
+        self._direct_remote: set[str] = set()
+        self._direct_trace_read: set[str] = set()
+        self.finalizer_funcs: set[str] = set()  # weakref.finalize callbacks
+        self.defined_funcs: set[str] = set()
+        self.async_funcs: set[str] = set()      # bare call = coroutine object
+        self._scope: list[str] = []
+        self._loop_depth = 0
+        self.visit(tree)
+        self._close()
+
+    def _fn_key(self) -> str:
+        return ".".join(self._scope)
+
+    # -- structure -----------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def _visit_fn(self, node):
+        self.defined_funcs.add(node.name)
+        self._scope.append(node.name)
+        saved = self._loop_depth
+        self._loop_depth = 0
+        self._calls_all.setdefault(self._fn_key(), set())
+        self.generic_visit(node)
+        self._loop_depth = saved
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_fn
+
+    def visit_AsyncFunctionDef(self, node):
+        self.async_funcs.add(node.name)
+        self._visit_fn(node)
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # -- facts ---------------------------------------------------------------
+
+    def _note_metric_target(self, target: ast.expr):
+        if isinstance(target, ast.Name):
+            self.metric_names.add(target.id)
+        elif isinstance(target, ast.Attribute) and _root_name(target) in (
+            "self", "cls"
+        ):
+            self.metric_attrs.add(target.attr)
+        elif isinstance(target, ast.Subscript):
+            ident = _base_ident(target)
+            if ident:
+                self.metric_attrs.add(ident)
+
+    def visit_Assign(self, node: ast.Assign):
+        if _contains_metric_ctor(node.value):
+            for t in node.targets:
+                self._note_metric_target(t)
+        elif isinstance(node.value, ast.Call):
+            callee = _leaf_name(node.value.func)
+            if callee:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._assigned_from_call.append((t.id, callee))
+                    elif isinstance(t, ast.Attribute) and _root_name(t) in (
+                        "self", "cls"
+                    ):
+                        self._assigned_from_call.append((t.attr, callee))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None and _contains_metric_ctor(node.value):
+            self._note_metric_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        if node.value is not None and self._scope and _contains_metric_ctor(
+            node.value
+        ):
+            self.metric_factories.add(self._scope[-1])
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        leaf = _leaf_name(node.func)
+        # weakref.finalize(obj, callback, ...): the callback runs at GC time
+        # with the same constraints as __del__.
+        if leaf == "finalize" and len(node.args) >= 2:
+            cb = node.args[1]
+            cb_leaf = (cb.id if isinstance(cb, ast.Name)
+                       else cb.attr if isinstance(cb, ast.Attribute) else None)
+            if cb_leaf:
+                self.finalizer_funcs.add(cb_leaf)
+        if self._scope:
+            key = self._fn_key()
+            if _is_gcs_rpc(node):
+                self._direct_rpc.add(self._scope[-1])
+            if _is_remote_call(node):
+                self._direct_remote.add(self._scope[-1])
+            if _is_tracing_read(node):
+                self._direct_trace_read.add(self._scope[-1])
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute) and _root_name(
+                node.func
+            ) in ("self", "cls"):
+                callee = node.func.attr
+            if callee:
+                self._calls_all.setdefault(key, set()).add(callee)
+                if self._loop_depth:
+                    self._calls_in_loops.setdefault(key, set()).add(callee)
+        self.generic_visit(node)
+
+    # -- closures ------------------------------------------------------------
+
+    def _close(self):
+        # Resolve `m = self._metrics()` once factories are known (one round
+        # is enough: factories are direct `return {…Counter…}` shapes).
+        for name, callee in self._assigned_from_call:
+            if callee in self.metric_factories:
+                self.metric_names.add(name)
+
+        # callers map by trailing name segment (self.foo() can't see which
+        # class defines foo — same convention as jaxlint).
+        callers: dict[str, set[str]] = {}
+        for key, callees in self._calls_all.items():
+            leaf = key.rsplit(".", 1)[-1]
+            for callee in callees:
+                callers.setdefault(callee, set()).add(leaf)
+
+        # report paths: the roster, plus functions whose every in-file caller
+        # is already a report path (and that have at least one caller).
+        report = {f for f in self.defined_funcs if f in REPORT_ROSTER}
+        report |= REPORT_ROSTER
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.defined_funcs:
+                if fn in report:
+                    continue
+                cs = callers.get(fn)
+                if cs and cs <= report:
+                    report.add(fn)
+                    changed = True
+        self.report_paths = report
+
+        # upward closure: a function that calls an rpc/trace-reading helper
+        # has the property itself.
+        def up_close(seed: set[str]) -> set[str]:
+            out = set(seed)
+            changed = True
+            while changed:
+                changed = False
+                for key, callees in self._calls_all.items():
+                    leaf = key.rsplit(".", 1)[-1]
+                    if leaf not in out and callees & out:
+                        out.add(leaf)
+                        changed = True
+            return out
+
+        self.rpc_funcs = up_close(self._direct_rpc)
+        self.crossproc_funcs = up_close(self._direct_rpc | self._direct_remote)
+        self.trace_read_funcs = up_close(self._direct_trace_read)
+
+        # hot contexts: loop-called callees of hot-named functions, closed
+        # downward over the call graph (jaxlint's _compute_hot, seeded by
+        # name instead of by any loop). Report paths are exempt from seeding:
+        # `scheduler_stats` is named for the scheduler but IS the report
+        # path, where control-plane round-trips are the contract.
+        hot: set[str] = set()
+        for key, callees in self._calls_in_loops.items():
+            leaf = key.rsplit(".", 1)[-1]
+            if _is_hot_named(leaf) and leaf not in self.report_paths:
+                hot |= callees
+        hot -= self.report_paths
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in self._calls_all.items():
+                leaf = key.rsplit(".", 1)[-1]
+                if leaf in hot:
+                    new = callees - hot
+                    if new:
+                        hot |= new
+                        changed = True
+        self.hot_funcs = hot
+
+
+class _DistChecker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext, pre: _Prepass):
+        self.ctx = ctx
+        self.pre = pre
+        self.findings: list[Finding] = []
+        self._scope: list[str] = []
+        self._class_stack: list[str] = []
+        self._fn_stack: list[str] = []       # function leaf names
+        self._sync_locks = 0                 # held `with <lockish>:` depth
+        self._async_locks = 0                # held `async with <lockish>:` depth
+        self._loop_depth = 0
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _symbol(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _emit(self, node: ast.AST, code: str, message: str):
+        self.findings.append(Finding(
+            self.ctx.relpath, getattr(node, "lineno", 0), code, message,
+            self._symbol(),
+        ))
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope.append(node.name)
+        self._check_rl903_class(node)
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+        self._scope.pop()
+
+    def _visit_fn(self, node):
+        self._scope.append(node.name)
+        self._fn_stack.append(node.name)
+        saved_loops, saved_sync, saved_async = (
+            self._loop_depth, self._sync_locks, self._async_locks
+        )
+        self._loop_depth = 0
+        # Locks held by the enclosing frame still constrain a nested def only
+        # if it runs inline; a nested def is usually a callback — reset.
+        self._sync_locks = self._async_locks = 0
+        self.generic_visit(node)
+        self._loop_depth, self._sync_locks, self._async_locks = (
+            saved_loops, saved_sync, saved_async
+        )
+        self._fn_stack.pop()
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Lambda(self, node: ast.Lambda):
+        # A lambda body runs when the lambda is CALLED, not where it is
+        # written: `conn.on_close(lambda c: self._lost(c))` under a lock
+        # registers a callback — the lock is long released when it fires.
+        saved_sync, saved_async = self._sync_locks, self._async_locks
+        self._sync_locks = self._async_locks = 0
+        self.generic_visit(node)
+        self._sync_locks, self._async_locks = saved_sync, saved_async
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def _visit_with(self, node, is_async: bool):
+        lockish = sum(1 for item in node.items if _is_lockish(
+            item.context_expr.func if isinstance(item.context_expr, ast.Call)
+            else item.context_expr
+        ))
+        if is_async:
+            self._async_locks += lockish
+        else:
+            self._sync_locks += lockish
+        self.generic_visit(node)
+        if is_async:
+            self._async_locks -= lockish
+        else:
+            self._sync_locks -= lockish
+
+    def visit_With(self, node: ast.With):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._visit_with(node, is_async=True)
+
+    # -- context predicates --------------------------------------------------
+
+    def _in_finalizer(self) -> bool:
+        return any(
+            fn == "__del__" or fn in self.pre.finalizer_funcs
+            for fn in self._fn_stack
+        )
+
+    def _in_hot_context(self) -> bool:
+        if not self._fn_stack:
+            return False
+        fn = self._fn_stack[-1]
+        if self._in_report_path():
+            return False
+        # lexically inside a loop of a scheduler/decode-named function, or
+        # anywhere inside a function the hot closure proved is called per
+        # iteration of one.
+        if self._loop_depth and _is_hot_named(fn):
+            return True
+        return fn in self.pre.hot_funcs
+
+    def _in_report_path(self) -> bool:
+        return bool(self._fn_stack) and any(
+            fn in self.pre.report_paths for fn in self._fn_stack
+        )
+
+    # -- RL901 ---------------------------------------------------------------
+
+    def _metric_receiver(self, recv: ast.expr) -> bool:
+        """Is `recv` provably a Counter/Gauge/Histogram (or a series pulled
+        out of a metrics dict/factory)?"""
+        if isinstance(recv, ast.Name):
+            return recv.id in self.pre.metric_names
+        if isinstance(recv, ast.Attribute):
+            if _root_name(recv) in ("self", "cls"):
+                return recv.attr in self.pre.metric_attrs
+            return recv.attr in self.pre.metric_names
+        if isinstance(recv, ast.Subscript):
+            ident = _base_ident(recv)
+            if ident and (ident in self.pre.metric_attrs
+                          or ident in self.pre.metric_names
+                          or ident in self.pre.metric_factories):
+                return True
+            if isinstance(recv.value, ast.Call):
+                leaf = _leaf_name(recv.value.func)
+                return leaf in self.pre.metric_factories
+            return False
+        if isinstance(recv, ast.Call):
+            return _leaf_name(recv.func) in self.pre.metric_factories
+        return False
+
+    def _check_rl901(self, node: ast.Call):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr not in _METRIC_MUTATORS:
+            return
+        if not self._metric_receiver(func.value):
+            return
+        if self._in_report_path():
+            return
+        where = self._fn_stack[-1] if self._fn_stack else "<module>"
+        self._emit(
+            node, "RL901",
+            f"metric .{func.attr}() outside a report path (in {where!r}): "
+            "every mutation may flush, and a flush is a blocking GCS RPC — "
+            "accumulate plain counters on the data path and mutate/flush "
+            "only from stats()/report()-roster functions",
+        )
+
+    # -- RL902 / RL905 -------------------------------------------------------
+
+    def _check_call_contexts(self, node: ast.Call, awaited: bool):
+        rpc = _is_gcs_rpc(node)
+        if rpc is not None:
+            if self._in_finalizer():
+                self._emit(
+                    node, "RL902",
+                    f"blocking control-plane RPC ({rpc}) in a __del__/"
+                    "finalizer: GC timing decides when (and on which thread) "
+                    "the control plane is dialed — release explicitly and "
+                    "make the finalizer a last-resort local cleanup",
+                )
+                return
+            if self._sync_locks and not awaited:
+                self._emit(
+                    node, "RL902",
+                    f"blocking control-plane RPC ({rpc}) under a held lock: "
+                    "every thread contending for the lock stalls on the GCS "
+                    "round-trip — copy state out, release, then call",
+                )
+                return
+            if self._in_hot_context():
+                self._emit(
+                    node, "RL902",
+                    f"blocking control-plane RPC ({rpc}) in a scheduler/"
+                    "decode hot context: a per-iteration GCS round-trip "
+                    "gates the hot loop on the control plane — batch it or "
+                    "move it off the loop",
+                )
+                return
+        # RL905(a): awaited cross-process call while an async lock is held.
+        if awaited and self._async_locks and (
+            rpc is not None or _is_remote_call(node)
+            or (_leaf_name(node.func) in self.pre.crossproc_funcs
+                and self._is_infile_callee(node))
+        ):
+            what = rpc or (
+                ".remote()" if _is_remote_call(node)
+                else f"{_leaf_name(node.func)}() [performs a cross-process "
+                     "call]"
+            )
+            self._emit(
+                node, "RL905",
+                f"await of a cross-process call ({what}) while holding an "
+                "async lock: the lock is held across a network round-trip, "
+                "stalling every task contending for it — snapshot under the "
+                "lock, release, then await",
+            )
+            return
+        # RL905(b): the interprocedural shape RL902 can't see — a plain call
+        # under a held sync lock to an in-file helper that transitively
+        # blocks on the control plane. Bare calls to `async def` helpers are
+        # exempt: they only BUILD a coroutine (io.spawn(self._resolve(...))
+        # under a lock runs the body later, on the loop, lock released).
+        if (
+            not awaited
+            and self._sync_locks
+            and rpc is None
+            and self._is_infile_callee(node)
+            and _leaf_name(node.func) in self.pre.rpc_funcs
+            and _leaf_name(node.func) not in self.pre.async_funcs
+        ):
+            self._emit(
+                node, "RL905",
+                f"{_leaf_name(node.func)}() performs a blocking control-"
+                "plane RPC and is called under a held lock: the GCS round-"
+                "trip happens with the lock held — hoist the call out of "
+                "the critical section",
+            )
+
+    def _is_infile_callee(self, node: ast.Call) -> bool:
+        """Only `name(...)` / `self.name(...)` calls resolve against the
+        in-file call graph (arbitrary `obj.method()` would alias any
+        same-named function anywhere)."""
+        if isinstance(node.func, ast.Name):
+            return node.func.id in self.pre.defined_funcs
+        if isinstance(node.func, ast.Attribute) and _root_name(node.func) in (
+            "self", "cls"
+        ):
+            return node.func.attr in self.pre.defined_funcs
+        return False
+
+    # -- RL903 ---------------------------------------------------------------
+
+    def _check_rl903_class(self, node: ast.ClassDef):
+        # A base-less class is not raisable: a plain `FooError` value wrapper
+        # pickles by __dict__, so the args-based hazard does not apply.
+        looks_exc = bool(node.bases) and (
+            node.name.endswith(("Error", "Exception")) or any(
+                isinstance(b, (ast.Name, ast.Attribute))
+                and (_leaf_name(b) or "").endswith(("Error", "Exception"))
+                for b in node.bases
+            )
+        )
+        if not looks_exc:
+            return
+        init = None
+        has_reduce = False
+        for stmt in node.body:
+            if isinstance(stmt, ast.FunctionDef):
+                if stmt.name == "__init__":
+                    init = stmt
+                elif stmt.name in ("__reduce__", "__reduce_ex__",
+                                   "__getnewargs__", "__getnewargs_ex__"):
+                    has_reduce = True
+        if init is None or has_reduce:
+            return
+        params = [a.arg for a in init.args.args[1:]]  # drop self
+        if not params and not init.args.vararg:
+            return
+        # Find the super().__init__(...) call; verbatim positional forwarding
+        # of the own parameter list round-trips under default pickling.
+        for sub in ast.walk(init):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "__init__"
+                and isinstance(sub.func.value, ast.Call)
+                and _leaf_name(sub.func.value.func) == "super"
+            ):
+                forwarded = [
+                    a.id for a in sub.args if isinstance(a, ast.Name)
+                ] if all(isinstance(a, ast.Name) for a in sub.args) else None
+                if forwarded == params:
+                    return  # verbatim forwarding: default pickling is stable
+                break
+        self._emit(
+            node, "RL903",
+            f"exception class {node.name} does not survive a .remote()/RPC "
+            "hop: its __init__ formats/transforms its args, so default "
+            "pickling re-calls the class with the FORMATTED message shifted "
+            "into the first parameter — define __reduce__ returning "
+            "(type(self), (<original ctor args>,)) like exceptions.py does",
+        )
+
+    # -- dispatch ------------------------------------------------------------
+
+    def visit_Await(self, node: ast.Await):
+        if isinstance(node.value, ast.Call):
+            self._check_call_contexts(node.value, awaited=True)
+            self._check_rl901(node.value)
+            self._check_rl904(node.value)
+            # visit arguments but not the call head again
+            for arg in ast.iter_child_nodes(node.value):
+                self.visit(arg)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        self._check_call_contexts(node, awaited=False)
+        self._check_rl901(node)
+        self._check_rl904(node)
+        self.generic_visit(node)
+
+    # -- RL904 ---------------------------------------------------------------
+
+    def _callback_reads_trace(self, cb: ast.expr) -> bool:
+        if isinstance(cb, ast.Lambda):
+            return any(
+                isinstance(sub, ast.Call) and _is_tracing_read(sub)
+                for sub in ast.walk(cb.body)
+            )
+        leaf = None
+        if isinstance(cb, ast.Name):
+            leaf = cb.id
+        elif isinstance(cb, ast.Attribute):
+            leaf = cb.attr
+        elif isinstance(cb, ast.Call):
+            # functools.partial(fn, ...) — inspect the wrapped fn
+            if _leaf_name(cb.func) == "partial" and cb.args:
+                return self._callback_reads_trace(cb.args[0])
+            return False
+        return leaf is not None and leaf in self.pre.trace_read_funcs
+
+    def _check_rl904(self, node: ast.Call):
+        leaf = _leaf_name(node.func)
+        cb = None
+        if leaf == "run_in_executor" and len(node.args) >= 2:
+            cb = node.args[1]
+        elif leaf == "submit" and node.args and isinstance(
+            node.func, ast.Attribute
+        ):
+            recv = _base_ident(node.func.value)
+            if recv and _ident_parts(recv) & _SUBMIT_RECEIVER_PARTS:
+                cb = node.args[0]
+        elif leaf == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    cb = kw.value
+                    break
+        if cb is None or not self._callback_reads_trace(cb):
+            return
+        self._emit(
+            node, "RL904",
+            "trace context read inside a callback handed across an executor/"
+            "thread boundary: contextvars do not cross threads, so "
+            "tracing.current()/propagation_context() there reads an EMPTY "
+            "context — capture trace_ctx before the hop and pass it "
+            "explicitly (tracing.activate(trace_ctx) inside the callback)",
+        )
+
+
+def check_dist_file(ctx: FileContext) -> list[Finding]:
+    pre = _Prepass(ctx.tree)
+    checker = _DistChecker(ctx, pre)
+    checker.visit(ctx.tree)
+    return checker.findings

@@ -142,6 +142,11 @@ class TaskCancelledError(RayTpuError):
         self.task_id = task_id
         super().__init__(f"task {task_id} was cancelled")
 
+    def __reduce__(self):
+        # Default Exception pickling would call cls(formatted_message),
+        # shifting the message into the task_id slot after a .remote() hop.
+        return (type(self), (self.task_id,))
+
 
 class PendingCallsLimitExceeded(RayTpuError):
     pass

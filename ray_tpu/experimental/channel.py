@@ -572,7 +572,7 @@ class RpcChannel:
             # not dial its own (the connect runs on the io thread; this
             # caller thread just blocks on the handshake).
             self._conn = w.io.run(
-                rpc.connect(*addr, handler=w, name=f"chan->{addr[1]}")
+                rpc.connect(*addr, handler=w, name=f"chan->{addr[1]}")  # raylint: disable=RL902 (connect-under-lock IS the dedup contract: a losing racer must share this socket, not dial its own)
             )
             _conn_cache[addr] = self._conn
             return self._conn

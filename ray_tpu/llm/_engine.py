@@ -461,6 +461,7 @@ class DecodeEngine:
             "emitted_tokens": 0,
         }
         self._spec_metrics = None
+        self._flushed_spec = [0, 0]  # [proposed, accepted] already exported
         if spec_config:
             self._draft = self._build_draft(dict(spec_config), unbox)
             from ray_tpu.util.metrics import Counter, Gauge
@@ -742,15 +743,9 @@ class DecodeEngine:
             c["emitted_tokens"] += len(emitted)
         c["proposed_tokens"] += round_proposed
         c["accepted_tokens"] += round_accepted
-        if self._spec_metrics is not None:
-            try:
-                self._spec_metrics["proposed"].inc(round_proposed)
-                self._spec_metrics["accepted"].inc(round_accepted)
-                self._spec_metrics["accept_rate"].set(
-                    c["accepted_tokens"] / max(1, c["proposed_tokens"])
-                )
-            except Exception:
-                pass  # metrics must never break the serving path
+        # Plain counters only: the llm_spec_* metrics flush their deltas
+        # from scheduler_stats() — a Metric.inc here rides every spec
+        # round of the decode loop (RL901).
 
     def _insert_prompt_kv(self, slot: int, prompt: List[int], adapter: int,
                           cached_offset: int):
@@ -823,6 +818,12 @@ class DecodeEngine:
         See docs/scheduler.md. This is a REPORT path: the flight recorder's
         pending completions flush to the SLO metrics plane and trace export
         here (never from the dispatch loop)."""
+        from ray_tpu.devtools import distsan
+
+        with distsan.report_path("scheduler_stats"):
+            return self._scheduler_stats_inner()
+
+    def _scheduler_stats_inner(self) -> dict:
         out = self._sched.stats()
         if self._adapters is not None:
             out["adapters"] = self._adapters.stats()
@@ -837,6 +838,21 @@ class DecodeEngine:
             )
             spec["draft"] = self._draft.stats()
             out["spec"] = spec
+            if self._spec_metrics is not None:
+                # Report-path delta flush of the llm_spec_* metrics (the
+                # decode loop only bumps the plain _spec_counters ints).
+                try:
+                    dp = spec["proposed_tokens"] - self._flushed_spec[0]
+                    da = spec["accepted_tokens"] - self._flushed_spec[1]
+                    self._flushed_spec = [
+                        spec["proposed_tokens"], spec["accepted_tokens"]]
+                    if dp:
+                        self._spec_metrics["proposed"].inc(dp)
+                    if da:
+                        self._spec_metrics["accepted"].inc(da)
+                    self._spec_metrics["accept_rate"].set(spec["accept_rate"])
+                except Exception:
+                    pass  # metrics must never break the serving path
         out["recorder"] = self._flush_observability()
         # Compute-plane report (same report-path contract): this engine's
         # compiled-program rows + the process-wide device-memory ledger.
@@ -1655,27 +1671,33 @@ class DecodeEngine:
     def _loop_inner(self):
         """Execute one scheduler plan per iteration: prefill chunks, then
         the speculative verify phase, then the batched decode phase (the
-        order is load-bearing — see Plan)."""
-        while not self._stop:
-            plan = self._sched.next_plan(draft=self._draft)
-            if plan.idle:
-                time.sleep(0.002)
-                continue
-            for chunk in plan.chunks:
-                self._exec_chunk(chunk)
-            if plan.spec_slots:
-                self._spec_round(plan)
-            if plan.decode_slots:
-                if plan.multi_step > 1:
-                    self._multi_round(plan.decode_slots, plan.multi_step)
-                else:
-                    self._decode_round(plan.decode_slots)
-                if self._draft is not None:
-                    for i in plan.decode_slots:
-                        # A plain step advances the target but not a model
-                        # draft's cache: its proposals would be garbage.
-                        # (The ngram draft is stateless here: no-op.)
-                        self._draft.on_plain_decode(i)
+        order is load-bearing — see Plan). The whole loop runs under a
+        distsan hot-path tag: any metric mutation or GCS call reached from
+        an iteration — even through a callback distlint can't see — is a
+        recorded contract violation when the sanitizer is on."""
+        from ray_tpu.devtools import distsan
+
+        with distsan.hot_path("llm-decode-loop"):
+            while not self._stop:
+                plan = self._sched.next_plan(draft=self._draft)
+                if plan.idle:
+                    time.sleep(0.002)
+                    continue
+                for chunk in plan.chunks:
+                    self._exec_chunk(chunk)
+                if plan.spec_slots:
+                    self._spec_round(plan)
+                if plan.decode_slots:
+                    if plan.multi_step > 1:
+                        self._multi_round(plan.decode_slots, plan.multi_step)
+                    else:
+                        self._decode_round(plan.decode_slots)
+                    if self._draft is not None:
+                        for i in plan.decode_slots:
+                            # A plain step advances the target but not a model
+                            # draft's cache: its proposals would be garbage.
+                            # (The ngram draft is stateless here: no-op.)
+                            self._draft.on_plain_decode(i)
 
     def _decode_round(self, decode_slots: List[int]):
         # lens/last_token/adapter_ids ride host->device per dispatch (an

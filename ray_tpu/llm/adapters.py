@@ -243,6 +243,10 @@ class AdapterCache:
             "registered": 0, "hits": 0, "misses": 0, "evictions": 0,
             "page_ins": 0, "rejected_full": 0,
         }
+        # Counter values already pushed to the lora_adapter_* metrics:
+        # stats() flushes the deltas on the report path; acquire() runs on
+        # the admission/decode thread and only touches plain ints.
+        self._flushed = {"hits": 0, "misses": 0, "evictions": 0}
 
     # -- registry ----------------------------------------------------------
     def register(self, name: str, layer_weights: Dict[int, Dict[str, np.ndarray]],
@@ -372,10 +376,8 @@ class AdapterCache:
             if slot is None:
                 slot = self._page_in_locked(entry)
                 self._counters["misses"] += 1
-                self._emit("misses")
             else:
                 self._counters["hits"] += 1
-                self._emit("hits")
             self._resident.move_to_end(uid)
             self._pins[uid] = self._pins.get(uid, 0) + 1
         return AdapterHandle(self, entry.name, uid, slot)
@@ -406,7 +408,6 @@ class AdapterCache:
                 )
             slot = self._resident.pop(victim)
             self._counters["evictions"] += 1
-            self._emit("evictions")
         # ONE host->device staging of the packed factors, then the single
         # cached install program writes the slot row. Both dispatches are
         # async: the stepper never blocks here — a cold adapter costs queue
@@ -457,18 +458,23 @@ class AdapterCache:
             out["install_programs"] = self._jit_install._cache_size()
         except Exception:
             out["install_programs"] = None  # older jax: no introspection
-        self._emit_bytes(out["bytes_resident"])
+        self._flush_metrics(out)
         return out
 
-    def _emit(self, key: str):
+    def _flush_metrics(self, out: dict):
+        """Report-path metrics export: push the lora_adapter_* counter
+        DELTAS since the last stats() and the current bytes gauge — never
+        from acquire(), which runs on the admission/decode thread (and a
+        metric flush is a blocking GCS round-trip)."""
         try:
-            _metrics()[key].inc(1, tags={"cache": self.name})
-        except Exception:
-            pass  # metrics must never break the serving path
-
-    def _emit_bytes(self, value: float):
-        try:
-            _metrics()["bytes"].set(float(value), tags={"cache": self.name})
+            for key in ("hits", "misses", "evictions"):
+                delta = out[key] - self._flushed[key]
+                self._flushed[key] = out[key]
+                if delta:
+                    _metrics()[key].inc(delta, tags={"cache": self.name})
+            _metrics()["bytes"].set(
+                float(out["bytes_resident"]), tags={"cache": self.name}
+            )
         except Exception:
             pass  # metrics must never break the serving path
 

@@ -128,6 +128,13 @@ class PrefixCacheManager:
         self._pool = KVBlockPool(capacity_bytes, block_size)
         self._radix = RadixIndex(block_size)
         self._lock = threading.Lock()
+        # Counter values already pushed to the llm_prefix_cache_* metrics
+        # (stats() flushes the deltas on the report path; lookup/insert run
+        # on the decode-loop thread and only touch plain ints).
+        self._flushed = {
+            "hits": 0, "misses": 0, "hit_tokens": 0,
+            "inserted_blocks": 0, "evicted_blocks": 0,
+        }
         self._counters = {
             "lookups": 0, "hits": 0, "misses": 0, "hit_tokens": 0,
             "inserted_blocks": 0, "evicted_blocks": 0, "rejected_blocks": 0,
@@ -155,7 +162,6 @@ class PrefixCacheManager:
                 nodes.pop()
             if not nodes:
                 self._counters["misses"] += 1
-                self._emit("misses", 1)
                 return None
             block_ids = [n.block_id for n in nodes]
             self._pool.incref(block_ids)
@@ -164,8 +170,6 @@ class PrefixCacheManager:
             self._counters["hits"] += 1
             self._counters["hit_tokens"] += matched
             self._counters["leases_active"] += 1
-        self._emit("hits", 1)
-        self._emit("hit_tokens", matched)
         return PrefixLease(self, block_ids, matched, namespace)
 
     def lease_prefix(self, token_ids: Sequence[int], namespace: int = 0
@@ -260,9 +264,6 @@ class PrefixCacheManager:
                     self._counters["inserted_blocks"] += len(new_ids)
             finally:
                 self._pool.decref(prot)
-        if new_ids:
-            self._emit("inserted", len(new_ids))
-        self._emit_bytes()
         return len(new_ids)
 
     # -- eviction ----------------------------------------------------------
@@ -284,7 +285,6 @@ class PrefixCacheManager:
             evicted += 1
         if evicted:
             self._counters["evicted_blocks"] += evicted
-            self._emit("evictions", evicted)
         return not self._pool.over_capacity(incoming_bytes)
 
     # -- stats -------------------------------------------------------------
@@ -297,18 +297,27 @@ class PrefixCacheManager:
             out["block_size"] = self.block_size
             lookups = max(1, out["lookups"])
             out["hit_rate"] = out["hits"] / lookups
+        self._flush_metrics(out)
         return out
 
-    def _emit(self, key: str, value: float):
+    def _flush_metrics(self, out: dict):
+        """Report-path metrics export: push the llm_prefix_cache_* counter
+        DELTAS since the last stats() and the current bytes gauge — never
+        from the lookup/insert data paths, which run on the decode-loop
+        thread (the manager lock is NOT held here: a metric flush is a
+        blocking GCS round-trip)."""
+        pairs = (("hits", "hits"), ("misses", "misses"),
+                 ("hit_tokens", "hit_tokens"),
+                 ("inserted", "inserted_blocks"),
+                 ("evictions", "evicted_blocks"))
         try:
-            _metrics()[key].inc(value, tags={"cache": self.name})
-        except Exception:
-            pass  # metrics must never break the serving path
-
-    def _emit_bytes(self):
-        try:
+            for mkey, ckey in pairs:
+                delta = out[ckey] - self._flushed[ckey]
+                self._flushed[ckey] = out[ckey]
+                if delta:
+                    _metrics()[mkey].inc(delta, tags={"cache": self.name})
             _metrics()["bytes"].set(
-                float(self._pool.bytes_resident), tags={"cache": self.name}
+                float(out["bytes_resident"]), tags={"cache": self.name}
             )
         except Exception:
             pass  # metrics must never break the serving path

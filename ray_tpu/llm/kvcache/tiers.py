@@ -503,8 +503,10 @@ class TieredPrefixCacheManager(PrefixCacheManager):
             self._pool.free(victim.block_id)
             evicted += 1
         if evicted:
+            # Plain int only: the evictions metric delta flushes from the
+            # base stats() report path (eviction runs on the insert path,
+            # i.e. the decode-loop thread).
             self._counters["evicted_blocks"] += evicted
-            self._emit("evictions", evicted)
         return not self._pool.over_capacity(incoming_bytes)
 
     # -- cluster prefix plane landing point ----------------------------------

@@ -232,11 +232,11 @@ class Scheduler:
             "requests waiting in the engine admission queue",
             tag_keys=("engine",),
         ).set_default_tags(tag)
-        # Per-tenant metering (docs/multitenancy.md). Queue depth and
-        # rejects are cold-path (per submit); the token counters flush from
-        # the REPORT path (stats()) via delta tracking — a per-token metrics
-        # inc in the decode loop is exactly the hot-path flush leaksan's
-        # gauge export learned to avoid.
+        # Per-tenant metering (docs/multitenancy.md). ALL metric mutation
+        # happens on the REPORT path (stats()): gauges export the current
+        # plain-int state, counters flush deltas since the last stats()
+        # call. The submit/decode paths only touch plain ints — a metric
+        # mutation there can block on the GCS flush inside Metric (RL901).
         self._tenant_metrics = {
             "queue": Gauge(
                 "llm_tenant_queue_depth",
@@ -259,9 +259,11 @@ class Scheduler:
                 tag_keys=("engine", "tenant"),
             ).set_default_tags(tag),
         }
-        self._flushed_tokens: Dict[str, List[int]] = {}  # tenant -> [pf, dec]
+        self._flushed_tokens: Dict[str, List[int]] = {}  # tenant -> [pf, dec, rej]
         # Per-phase occupancy: tokens assembled into the most recent
         # iteration, by phase (prefill-chunk vs decode vs spec-verify).
+        # _note() records the plain tuple; stats() exports the gauges.
+        self._last_plan_tokens = (0, 0, 0)  # (prefill, decode, verify)
         self._occ_gauges = {
             phase: Gauge(
                 f"llm_sched_{phase}_tokens",
@@ -305,7 +307,6 @@ class Scheduler:
             if self._tenant_quota and len(t.queue) >= self._tenant_quota:
                 t.rejected += 1
                 self._counters["rejected"] += 1
-                self._emit_reject(request.tenant)
                 raise EngineOverloadedError(
                     f"tenant {request.tenant!r} admission queue is full "
                     f"({len(t.queue)} >= llm_tenant_max_queue_depth="
@@ -315,7 +316,6 @@ class Scheduler:
             if self._max_queue_depth and self._depth >= self._max_queue_depth:
                 t.rejected += 1
                 self._counters["rejected"] += 1
-                self._emit_reject(request.tenant)
                 raise EngineOverloadedError(
                     f"engine admission queue is full ({self._depth} >= "
                     f"llm_max_queue_depth={self._max_queue_depth}); shed load "
@@ -329,10 +329,6 @@ class Scheduler:
                 t.pass_ = max(t.pass_, self._vtime)
             t.queue.append(request)
             self._depth += 1
-            depth = self._depth
-            tdepth = len(t.queue)
-        self._queue_gauge.set(float(depth))
-        self._emit_tenant_queue(request.tenant, tdepth)
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -366,7 +362,6 @@ class Scheduler:
                     handle.release()
                 except Exception:
                     pass  # cache poisoned mid-death; keep failing callbacks
-        self._queue_gauge.set(0.0)
         return queued
 
     # -- stepper-thread API -------------------------------------------------
@@ -479,7 +474,6 @@ class Scheduler:
             admitted += 1
         if admitted:
             self._counters["admitted"] += admitted
-            self._queue_gauge.set(float(self.queue_depth()))
 
     def next_plan(self, draft=None) -> Plan:
         """Assemble one iteration. Budget policy: decode (1 token/slot) and
@@ -654,16 +648,29 @@ class Scheduler:
                 }
         out["tenants"] = tenants
         self._flush_tenant_tokens(tenants)
+        try:
+            self._queue_gauge.set(float(out["queue_depth"]))
+            pf, dec, ver = self._last_plan_tokens
+            self._occ_gauges["prefill"].set(float(pf))
+            self._occ_gauges["decode"].set(float(dec))
+            self._occ_gauges["verify"].set(float(ver))
+        except Exception:
+            pass  # metrics must never break the serving path
         return out
 
     def _flush_tenant_tokens(self, tenants: Dict[str, dict]):
-        """Report-path metrics export: push the per-tenant token counter
-        DELTAS since the last flush (never from the decode loop)."""
+        """Report-path metrics export: push the per-tenant token/reject
+        counter DELTAS since the last flush and the current queue gauges
+        (never from the submit or decode paths)."""
         for name, t in tenants.items():
-            seen = self._flushed_tokens.setdefault(name, [0, 0])
+            seen = self._flushed_tokens.setdefault(name, [0, 0, 0])
+            if len(seen) < 3:
+                seen.append(0)
             dp = t["prefill_tokens"] - seen[0]
             dd = t["decode_tokens"] - seen[1]
+            dr = t["rejected"] - seen[2]
             seen[0], seen[1] = t["prefill_tokens"], t["decode_tokens"]
+            seen[2] = t["rejected"]
             try:
                 if dp:
                     self._tenant_metrics["prefill"].inc(
@@ -671,23 +678,13 @@ class Scheduler:
                 if dd:
                     self._tenant_metrics["decode"].inc(
                         dd, tags={"tenant": name})
+                if dr:
+                    self._tenant_metrics["rejected"].inc(
+                        dr, tags={"tenant": name})
                 self._tenant_metrics["queue"].set(
                     float(t["queued"]), tags={"tenant": name})
             except Exception:
                 pass  # metrics must never break the serving path
-
-    def _emit_reject(self, tenant: str):
-        try:
-            self._tenant_metrics["rejected"].inc(1, tags={"tenant": tenant})
-        except Exception:
-            pass  # metrics must never break the serving path
-
-    def _emit_tenant_queue(self, tenant: str, depth: int):
-        try:
-            self._tenant_metrics["queue"].set(
-                float(depth), tags={"tenant": tenant})
-        except Exception:
-            pass  # metrics must never break the serving path
 
     def _note(self, plan: Plan):
         c = self._counters
@@ -699,9 +696,7 @@ class Scheduler:
             c["spec_rounds"] += 1
         if plan.prefill_tokens and (plan.decode_slots or plan.spec_slots):
             c["interleaved_iterations"] += 1
-        try:
-            self._occ_gauges["prefill"].set(float(plan.prefill_tokens))
-            self._occ_gauges["decode"].set(float(plan.decode_tokens))
-            self._occ_gauges["verify"].set(float(plan.verify_tokens))
-        except Exception:
-            pass  # metrics must never break the serving path
+        # Plain tuple only: the occupancy GAUGES export from stats() — a
+        # Metric mutation here would ride every planner iteration (RL901).
+        self._last_plan_tokens = (
+            plan.prefill_tokens, plan.decode_tokens, plan.verify_tokens)

@@ -40,24 +40,9 @@ from flax import struct
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.8 top-level; fall back to the experimental location
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-
-def shard_map(f, *, mesh, in_specs, out_specs, axis_names):
-    """shard_map with a manual-axes subset, across jax versions: newer jax
-    spells it `axis_names={...}`; 0.4.x spells the complement `auto={...}`
-    (and type-checks replication of the manually-psummed outputs too eagerly,
-    hence check_rep=False)."""
-    try:
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, axis_names=axis_names)
-    except TypeError:
-        auto = frozenset(mesh.axis_names) - set(axis_names)
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_rep=False, auto=auto)
+# The cross-version shard_map shim moved to util.jax_compat (shared with the
+# collective XLA tier); re-exported here for the existing call sites.
+from ray_tpu.util.jax_compat import shard_map  # noqa: F401
 
 
 class PipelineState(struct.PyTreeNode):

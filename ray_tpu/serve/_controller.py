@@ -88,7 +88,7 @@ class ServeController:
         async with self._recover_lock:
             if self._recovered:
                 return
-            await self._recover()
+            await self._recover()  # raylint: disable=RL905 (the recover lock exists precisely to hold callers across this await: nothing may proceed on unrecovered state)
             self._recovered = True
         self._arm_control_loop()
 
@@ -286,7 +286,7 @@ class ServeController:
             elif self._http_options is None:
                 self._http_options = {}
                 await self._persist_state()
-            await self._reconcile_proxies_locked()
+            await self._reconcile_proxies_locked()  # raylint: disable=RL905 (proxy reconciliation is deliberately lock-serialized: two interleaved reconciles would double-start proxies on the same node)
         await self._persist_registry()
         import ray_tpu
 
@@ -305,7 +305,7 @@ class ServeController:
         if self._http_options is None:
             return
         async with self._proxy_lock:
-            await self._reconcile_proxies_locked()
+            await self._reconcile_proxies_locked()  # raylint: disable=RL905 (proxy reconciliation is deliberately lock-serialized: two interleaved reconciles would double-start proxies on the same node)
 
     async def _reconcile_proxies_locked(self):
         import ray_tpu

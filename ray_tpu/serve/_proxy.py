@@ -47,7 +47,7 @@ class HTTPProxy:
         # across the bind await; without the lock the loser EADDRINUSEs on its
         # own sibling and silently rebinds ephemeral, splitting the port table.
         async with self._start_lock:
-            return await self._start_locked()
+            return await self._start_locked()  # raylint: disable=RL905 (serializing concurrent starts across the bind await IS the lock's purpose — see comment above)
 
     async def _start_locked(self) -> int:
         if self._server is not None:

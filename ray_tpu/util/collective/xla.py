@@ -28,10 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.util.collective.types import ReduceOp
-
-
-def _axis_size(axis_name) -> int:
-    return jax.lax.axis_size(axis_name)
+from ray_tpu.util.jax_compat import axis_size as _axis_size, shard_map
 
 
 def allreduce(x, axis_name, op: ReduceOp = ReduceOp.SUM):
@@ -116,7 +113,7 @@ class MeshGroup:
         fn = self._cache.get(key)
         if fn is None:
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(allreduce, axis_name=self.axis, op=op),
                     mesh=self.mesh,
                     in_specs=P(self.axis),

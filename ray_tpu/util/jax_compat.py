@@ -1,0 +1,45 @@
+"""Cross-version jax shims (the PR-2 shard_map compat, now shared).
+
+jax moved `shard_map` from `jax.experimental.shard_map` to the top level and
+renamed the manual-axes parameter (`auto={...}` complement on 0.4.x,
+`axis_names={...}` on >= 0.8); `jax.lax.axis_size` is also absent on 0.4.x.
+Every mesh-collective call site routes through here so the library (and its
+tests) runs against either API surface unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.8 top-level; fall back to the experimental location
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None):
+    """shard_map across jax versions.
+
+    `axis_names=None` means fully manual over every mesh axis — the one
+    spelling both API generations accept. With a manual-axes SUBSET, newer
+    jax spells it `axis_names={...}`; 0.4.x spells the complement
+    `auto={...}` (and type-checks replication of the manually-psummed
+    outputs too eagerly, hence check_rep=False).
+    """
+    if axis_names is None:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, axis_names=axis_names)
+    except TypeError:
+        auto = frozenset(mesh.axis_names) - set(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, auto=auto)
+
+
+def axis_size(axis_name) -> int:
+    """`jax.lax.axis_size` (>= 0.6), or the psum-of-ones equivalent on 0.4.x."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:  # pragma: no cover - depends on installed jax
+        return jax.lax.psum(1, axis_name)

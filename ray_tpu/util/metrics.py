@@ -32,6 +32,15 @@ def _worker():
     return ray_tpu.global_worker()
 
 
+def _note_mutation(name: str):
+    """distsan hook: a mutation may flush, and a flush is a blocking GCS
+    RPC — record it when a tagged hot/finalizer context is active. One
+    enabled() check when the sanitizer is off."""
+    from ray_tpu.devtools import distsan
+
+    distsan.note_metric_mutation(name)
+
+
 class Metric:
     def __init__(self, name: str, description: str = "",
                  tag_keys: Optional[Tuple[str, ...]] = None):
@@ -81,6 +90,7 @@ class Metric:
 
 class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        _note_mutation(self._name)
         key = self._key(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
@@ -89,6 +99,7 @@ class Counter(Metric):
 
 class Gauge(Metric):
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _note_mutation(self._name)
         key = self._key(tags)
         with self._lock:
             self._values[key] = value
@@ -105,6 +116,7 @@ class Histogram(Metric):
         )
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _note_mutation(self._name)
         base = dict(self._key(tags))
         with self._lock:
             for b in self._boundaries:

@@ -71,6 +71,41 @@ def leaksan_guard(request):
             f"live handles: {report}", pytrace=False,
         )
 
+# -- distsan guard (docs/raylint.md §distsan) ---------------------------------
+# The suites that drive the tagged hot-path/report-path/finalizer contexts
+# (the llm decode loop, scheduler stats export, stream finalizers): each test
+# runs under the runtime distributed-contract sanitizer and FAILS if a metric
+# mutation or GCS call landed inside a hot/finalizer context.
+DISTSAN_SUITES = {
+    "test_llm_engine_hotpath.py",
+    "test_llm_scheduler.py",
+    "test_llm_multitenant.py",
+    "test_serve_observability.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def distsan_guard(request):
+    fspath = getattr(request.node, "fspath", None)
+    name = os.path.basename(str(fspath)) if fspath is not None else ""
+    if name not in DISTSAN_SUITES:
+        yield
+        return
+    from ray_tpu.devtools import distsan
+
+    distsan.enable()
+    distsan.reset()
+    yield
+    found = distsan.violations()
+    distsan.disable()
+    distsan.reset()
+    if found:
+        pytest.fail(
+            "distsan: control-plane traffic recorded inside a hot/finalizer "
+            f"context during this test: {found}", pytrace=False,
+        )
+
+
 _WORKER_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",

@@ -1,0 +1,71 @@
+"""RL901 fixtures: metric mutation outside a report path.
+
+The metric identity proofs (ctor-assigned self attrs, module names, dict
+displays, in-file factories) and the report-path roster propagation are the
+precision gate: contextvar `.set()` and rllib's connector `.observe()` must
+stay out of sight.
+"""
+
+from contextvars import ContextVar
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+REQUESTS = Counter("requests_total")
+_model_id = ContextVar("model_id", default="")
+
+
+def bad_module_metric_inc(n):
+    REQUESTS.inc(n)
+
+
+def _series():
+    return {"latency": Histogram("latency_s")}
+
+
+def bad_factory_series_observe(dt):
+    _series()["latency"].observe(dt)
+
+
+class Plane:
+    def __init__(self):
+        self._hits = Counter("hits_total")
+        self._depth = Gauge("queue_depth")
+        self._m = {"lat": Histogram("lat_s")}
+
+    def bad_data_path_inc(self):
+        self._hits.inc()
+
+    def bad_dict_series_observe(self, dt):
+        self._m["lat"].observe(dt)
+
+    def bad_explicit_flush(self):
+        self._depth.flush()
+
+    def stats(self):
+        self._depth.set(1.0)
+        self._refresh()
+        return {"depth": 1.0}
+
+    def _refresh(self):
+        # called ONLY from stats(): the report-path fixpoint covers it
+        self._hits.inc(0.0)
+
+    def _shared_helper(self):
+        # called from report() AND from a data path: NOT report-path-only,
+        # so the mutation inside it fires
+        self._hits.inc()
+
+    def on_request(self):
+        self._shared_helper()
+
+    def report(self):
+        self._shared_helper()
+
+    def ok_contextvar_set(self, mid):
+        _model_id.set(mid)
+
+    def ok_plain_counter(self):
+        self.n = getattr(self, "n", 0) + 1
+
+    def suppressed_inc(self):
+        self._hits.inc()  # raylint: disable=RL901 (fixture: flushed by the caller's report tick)

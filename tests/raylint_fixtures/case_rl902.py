@@ -1,0 +1,90 @@
+"""RL902 fixtures: blocking control-plane RPC in a forbidden context
+(finalizer, held lock, scheduler/decode hot context)."""
+
+import weakref
+
+
+class Holder:
+    def __del__(self):
+        self._worker.gcs_call("kv_del", "ns", self._key)
+
+    def close(self):
+        # ok: explicit release path, not GC-timed
+        self._worker.gcs_call("kv_del", "ns", self._key)
+
+
+def _finalize_entry(worker, key):
+    worker.gcs_call("kv_del", "ns", key)
+
+
+class Registered:
+    def __init__(self, worker, key):
+        weakref.finalize(self, _finalize_entry, worker, key)
+
+
+def bad_rpc_under_lock(worker, lock, key):
+    with lock:
+        return worker.gcs_call("kv_get", "ns", key)
+
+
+def bad_kv_verb_under_lock(store, state_lock, key, blob):
+    with state_lock:
+        store.kv_put("ns", key, blob, True)
+
+
+def bad_by_name_lookup_in_del(registry):
+    class _Owner:
+        def __del__(self):
+            registry.get_actor("controller")
+
+    return _Owner()
+
+
+def bad_connect_under_lock(rpc_client, conn_cache, conn_lock, addr):
+    with conn_lock:
+        conn_cache[addr] = rpc_client.connect(addr)
+
+
+class Scheduler:
+    def decode_loop(self, worker, batches):
+        for b in batches:
+            worker.gcs_call("kv_put", "ns", b.key, b.blob, True)
+
+    def schedule_step(self, worker, reqs):
+        for r in reqs:
+            self._place(worker, r)
+
+    def _place(self, worker, r):
+        # hot by propagation: called per schedule_step iteration
+        worker.gcs_call("get_node", r.node_id)
+
+    def scheduler_stats(self, worker):
+        # ok: the report path IS allowed its control-plane round-trips,
+        # even though "scheduler" is in its name
+        out = {}
+        for key in worker.gcs_call("kv_keys", "metrics", b""):
+            out[key] = worker.gcs_call("kv_get", "metrics", key)
+        return out
+
+
+def ok_plain_method(worker, key):
+    return worker.gcs_call("kv_get", "ns", key)
+
+
+def ok_copy_out_then_call(worker, lock, key):
+    with lock:
+        k = bytes(key)
+    return worker.gcs_call("kv_get", "ns", k)
+
+
+def ok_socket_connect(sock, addr):
+    # bare connect() on a non-rpc receiver is out of scope
+    sock.connect(addr)
+
+
+def suppressed_del_rpc(worker, key):
+    class _Owner:
+        def __del__(self):
+            worker.gcs_call("kv_del", "ns", key)  # raylint: disable=RL902 (fixture: last-resort reap, explicit close is primary)
+
+    return _Owner()

@@ -1,0 +1,54 @@
+"""RL903 fixtures: exception classes that must survive a .remote()/RPC
+pickle round-trip (the exceptions.py __reduce__ idiom made mandatory)."""
+
+
+class BadFormattedInit(Exception):
+    """Default pickling re-calls BadFormattedInit(formatted_message): the
+    message lands in task_id and the original args are gone."""
+
+    def __init__(self, task_id):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} wedged")
+
+
+class BadDefaultedError(Exception):
+    def __init__(self, actor_id=None):
+        self.actor_id = actor_id
+        super().__init__(f"actor {actor_id} unavailable")
+
+
+class BadDerivedError(BadFormattedInit):
+    def __init__(self, task_id, node):
+        self.node = node
+        super().__init__(f"{task_id}@{node}")
+
+
+class OkReduceError(Exception):
+    def __init__(self, task_id):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} wedged")
+
+    def __reduce__(self):
+        return type(self), (self.task_id,)
+
+
+class OkVerbatimForward(Exception):
+    def __init__(self, msg):
+        super().__init__(msg)
+        self.msg = msg
+
+
+class OkNoCustomInit(Exception):
+    pass
+
+
+class OkPlainFormatter:
+    """Formats its ctor args but is no exception class: out of scope."""
+
+    def __init__(self, name):
+        self.label = f"<{name}>"
+
+
+class SuppressedError(Exception):  # raylint: disable=RL903 (fixture: never crosses a process boundary)
+    def __init__(self, code):
+        super().__init__(f"code {code}")

@@ -1,0 +1,76 @@
+"""RL904 fixtures: trace context read on the wrong side of an executor/
+thread boundary (contextvars do not cross run_in_executor)."""
+
+import threading
+from functools import partial
+
+from ray_tpu.util import tracing
+
+
+def _work_reads_trace(payload):
+    ctx = tracing.current()
+    return payload, ctx
+
+
+def _work_transitively(payload):
+    return _work_reads_trace(payload)
+
+
+def _work_takes_ctx(payload, trace_ctx):
+    token = tracing.activate(trace_ctx)
+    try:
+        return payload
+    finally:
+        tracing.deactivate(token)
+
+
+async def bad_lambda_reads_inside(loop, payload):
+    return await loop.run_in_executor(
+        None, lambda: (payload, tracing.current())
+    )
+
+
+async def bad_named_callback(loop, payload):
+    return await loop.run_in_executor(None, _work_reads_trace, payload)
+
+
+async def bad_transitive_callback(loop, payload):
+    return await loop.run_in_executor(None, _work_transitively, payload)
+
+
+async def bad_partial_callback(loop, payload):
+    return await loop.run_in_executor(
+        None, partial(_work_reads_trace, payload)
+    )
+
+
+def bad_executor_submit(executor, payload):
+    return executor.submit(_work_reads_trace, payload)
+
+
+def bad_thread_target(payload):
+    t = threading.Thread(target=_work_reads_trace, args=(payload,))
+    t.start()
+    return t
+
+
+async def ok_captured_before_hop(loop, payload):
+    trace_ctx = tracing.current()
+    return await loop.run_in_executor(
+        None, _work_takes_ctx, payload, trace_ctx
+    )
+
+
+async def ok_lambda_closes_over_capture(loop, payload):
+    trace_ctx = tracing.current()
+    return await loop.run_in_executor(
+        None, lambda: _work_takes_ctx(payload, trace_ctx)
+    )
+
+
+async def ok_plain_callback(loop, q):
+    return await loop.run_in_executor(None, q.get)
+
+
+async def suppressed_read_inside(loop, payload):
+    return await loop.run_in_executor(None, _work_reads_trace, payload)  # raylint: disable=RL904 (fixture: span loss accepted for this batch path)

@@ -1,0 +1,79 @@
+"""RL905 fixtures: cross-process calls under held locks — awaited under an
+async lock, or reached interprocedurally under a sync lock."""
+
+
+class Controller:
+    async def bad_await_remote_under_lock(self, handle):
+        async with self._state_lock:
+            return await handle.ping.remote()
+
+    async def bad_await_gcs_under_lock(self, worker):
+        async with self._state_lock:
+            return await worker.gcs_call("kv_get", "ns", b"k")
+
+    async def bad_await_helper_under_lock(self, req):
+        async with self._engine_lock:
+            return await self._dispatch(req)
+
+    async def _dispatch(self, req):
+        return await self._replica.handle.remote(req)
+
+    async def ok_await_outside_lock(self, handle):
+        async with self._state_lock:
+            req = self._next()
+        return await handle.ping.remote(req)
+
+    async def ok_local_await_under_lock(self, req):
+        async with self._state_lock:
+            return await self._validate(req)
+
+    async def _validate(self, req):
+        return req
+
+    def _next(self):
+        return 1
+
+    async def suppressed_await_under_lock(self, handle):
+        async with self._state_lock:
+            return await handle.ping.remote()  # raylint: disable=RL905 (fixture: single-task lock, rpc has a 1s deadline)
+
+
+def _refresh_placement(worker):
+    return worker.gcs_call("get_nodes")
+
+
+def bad_sync_helper_under_lock(worker, cache_lock):
+    with cache_lock:
+        return _refresh_placement(worker)
+
+
+def ok_sync_helper_outside_lock(worker, cache_lock):
+    with cache_lock:
+        pass
+    return _refresh_placement(worker)
+
+
+def ok_local_helper_under_lock(records, cache_lock):
+    with cache_lock:
+        return _summarize(records)
+
+
+def _summarize(records):
+    return len(records)
+
+
+async def _aresolve(worker, actor_id):
+    return worker.gcs_call("get_actor_info", actor_id)
+
+
+def ok_spawn_async_helper_under_lock(io, worker, cache_lock):
+    # Building the coroutine under the lock is fine: _aresolve's body (and
+    # its GCS round-trip) runs later on the io loop, lock long released.
+    with cache_lock:
+        io.spawn(_aresolve(worker, "a1"))
+
+
+def ok_lambda_callback_under_lock(conn, worker, cache_lock):
+    # The lambda body executes when the close callback FIRES, not here.
+    with cache_lock:
+        conn.on_close(lambda c: _refresh_placement(worker))

@@ -159,6 +159,7 @@ def test_xla_tier():
 
     from ray_tpu.parallel.mesh import create_mesh
     from ray_tpu.util.collective import ReduceOp, xla
+    from ray_tpu.util.jax_compat import shard_map
 
     mesh = create_mesh({"dp": 4})
     group = xla.MeshGroup(mesh, "dp")
@@ -174,7 +175,7 @@ def test_xla_tier():
         return y, z
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             step, mesh=mesh, in_specs=P("dp"), out_specs=(P(None), P("dp"))
         )
     )

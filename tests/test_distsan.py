@@ -1,0 +1,157 @@
+"""distsan: the runtime distributed-contract sanitizer catches planted
+hot-path/finalizer control-plane traffic and stays zero-cost when disabled
+(docs/raylint.md §distsan)."""
+
+import threading
+
+import pytest
+
+from ray_tpu.devtools import distsan
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sanitizer():
+    distsan.reset()
+    distsan.enable()
+    yield
+    distsan.reset()
+    distsan.disable()
+
+
+def test_metric_mutation_in_hot_path_flagged():
+    """The real util.metrics hook: every mutator may flush, and a flush is
+    a blocking GCS RPC — inside a tagged hot loop that's a violation even
+    when this particular mutation doesn't flush."""
+    c = Counter("distsan_test_counter")
+    with distsan.hot_path("test-decode-loop"):
+        c.inc()
+    found = distsan.violations()
+    assert len(found) == 1
+    v = found[0]
+    assert v["kind"] == "metric_mutation"
+    assert v["detail"] == "distsan_test_counter"
+    assert v["context"] == "hot"
+    assert v["label"] == "test-decode-loop"
+
+
+def test_all_three_mutators_are_hooked():
+    with distsan.hot_path("loop"):
+        Counter("distsan_c").inc()
+        Gauge("distsan_g").set(1.0)
+        Histogram("distsan_h").observe(0.5)
+    kinds = [v["detail"] for v in distsan.violations()]
+    assert kinds == ["distsan_c", "distsan_g", "distsan_h"]
+
+
+def test_gcs_call_in_finalizer_flagged():
+    with distsan.finalizer("stream-iterator"):
+        distsan.note_gcs_call("kv_put")
+    found = distsan.violations()
+    assert len(found) == 1
+    assert found[0]["kind"] == "gcs_call"
+    assert found[0]["detail"] == "kv_put"
+    assert found[0]["context"] == "finalizer"
+
+
+def test_report_path_is_the_contract():
+    with distsan.report_path("stats"):
+        Counter("distsan_report_counter").inc()
+        distsan.note_gcs_call("kv_put")
+    assert distsan.violations() == []
+
+
+def test_innermost_tag_decides():
+    # A report-path flush invoked FROM a hot loop is fine (that's exactly
+    # how stats() collection threads overlap the decode loop)...
+    with distsan.hot_path("loop"):
+        with distsan.report_path("stats"):
+            distsan.note_gcs_call("kv_put")
+    assert distsan.violations() == []
+    # ...but a hot section entered from a report path is still hot.
+    with distsan.report_path("stats"):
+        with distsan.hot_path("loop"):
+            distsan.note_gcs_call("kv_put")
+    assert len(distsan.violations()) == 1
+
+
+def test_untagged_context_not_asserted():
+    # distsan only checks what is tagged: plain data-path traffic is
+    # distlint's (static) territory.
+    Counter("distsan_untagged").inc()
+    distsan.note_gcs_call("kv_get")
+    assert distsan.violations() == []
+
+
+def test_disabled_records_nothing():
+    distsan.disable()
+    with distsan.hot_path("loop"):
+        Counter("distsan_off").inc()
+        distsan.note_gcs_call("kv_put")
+    assert distsan.violations() == []
+    distsan.enable()
+
+
+def test_enable_mid_tag_stays_balanced():
+    """A tag entered while disabled pushes nothing, so enabling inside its
+    body must not underflow the stack on exit."""
+    distsan.disable()
+    with distsan.hot_path("loop"):
+        distsan.enable()
+        # The tag did not push: this note sees no hot context.
+        distsan.note_gcs_call("kv_put")
+    assert distsan.violations() == []
+    with distsan.hot_path("loop"):
+        distsan.note_gcs_call("kv_put")
+    assert len(distsan.violations()) == 1
+
+
+def test_env_var_enables(monkeypatch):
+    distsan.reset()
+    # Drop the programmatic override so the env decides.
+    distsan._enabled_override = None
+    monkeypatch.delenv("RAY_TPU_DISTSAN", raising=False)
+    assert not distsan.enabled()
+    monkeypatch.setenv("RAY_TPU_DISTSAN", "1")
+    assert distsan.enabled()
+
+
+def test_tags_are_thread_local():
+    """A hot tag on one thread must not indict another thread's traffic,
+    and each violation records the thread it happened on."""
+    ready = threading.Event()
+    release = threading.Event()
+
+    def hot_holder():
+        with distsan.hot_path("holder-loop"):
+            ready.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hot_holder, name="distsan-holder")
+    t.start()
+    try:
+        assert ready.wait(5.0)
+        distsan.note_gcs_call("kv_put")  # this thread is untagged
+        assert distsan.violations() == []
+    finally:
+        release.set()
+        t.join(5.0)
+
+    def tagged_worker():
+        with distsan.finalizer("worker-del"):
+            distsan.note_gcs_call("get_actor_info")
+
+    t2 = threading.Thread(target=tagged_worker, name="distsan-worker")
+    t2.start()
+    t2.join(5.0)
+    found = distsan.violations()
+    assert len(found) == 1
+    assert found[0]["thread"] == "distsan-worker"
+
+
+def test_violations_snapshot_is_a_copy():
+    with distsan.hot_path("loop"):
+        distsan.note_gcs_call("kv_put")
+    first = distsan.violations()
+    first[0]["kind"] = "mutated"
+    assert distsan.violations()[0]["kind"] == "gcs_call"

@@ -151,7 +151,7 @@ def test_gqa_flash_matches_reference():
 
 
 def test_ring_attention_matches_full():
-    from jax import shard_map
+    from ray_tpu.util.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.ops.attention import reference_attention
@@ -177,7 +177,7 @@ def test_ring_attention_matches_full():
 
 
 def test_ulysses_attention_matches_full():
-    from jax import shard_map
+    from ray_tpu.util.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.ops.attention import reference_attention
@@ -273,6 +273,7 @@ def test_ulysses_attention_gqa_with_small_kv_heads():
 
     from ray_tpu.ops.attention import reference_attention
     from ray_tpu.ops.ring_attention import ulysses_attention
+    from ray_tpu.util.jax_compat import shard_map
 
     sp = 4
     mesh = mesh_lib.create_mesh({"sp": sp}, devices=jax.devices()[:sp])
@@ -282,7 +283,7 @@ def test_ulysses_attention_gqa_with_small_kv_heads():
     k = jax.random.normal(jax.random.fold_in(key, 1), (B, S, Hkv, D), jnp.float32)
     v = jax.random.normal(jax.random.fold_in(key, 2), (B, S, Hkv, D), jnp.float32)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ulysses_attention(
                 q, k, v, "sp",
                 attn_fn=lambda a, b, c: reference_attention(a, b, c, causal=True),

@@ -22,6 +22,7 @@ def test_hybrid_mesh_layout_and_collectives():
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.util.jax_compat import shard_map
 
     m = mesh_lib.create_mesh({"fsdp": 2, "tp": 2}, dcn_axes={"dp": 2})
     assert m.shape["dp"] == 2 and m.shape["fsdp"] == 2 and m.shape["tp"] == 2
@@ -31,7 +32,7 @@ def test_hybrid_mesh_layout_and_collectives():
     assert set(ids[1].flatten().tolist()) == {4, 5, 6, 7}
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.psum(x, "dp"), mesh=m, in_specs=P("dp"), out_specs=P()
         )
     )

@@ -330,6 +330,93 @@ def test_jaxlint_skips_files_without_jax(tmp_path):
     assert not [x for x in lint_file(str(f)) if x.code.startswith("RL6")]
 
 
+# ---- distlint family (RL9xx) ------------------------------------------------
+
+def test_rl901_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl901.py"))
+    for sym in ("bad_module_metric_inc", "bad_factory_series_observe",
+                "bad_data_path_inc", "bad_dict_series_observe",
+                "bad_explicit_flush", "_shared_helper"):
+        assert found.get(sym) == {"RL901"}, (sym, found.get(sym))
+    for sym in ("stats", "_refresh", "report", "on_request",
+                "ok_contextvar_set", "ok_plain_counter", "suppressed_inc"):
+        assert sym not in found, (sym, found.get(sym))
+
+
+def test_rl902_fires_and_suppresses():
+    findings = _fixture("case_rl902.py")
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, set()).add(f.code)
+    assert by_symbol.get("Holder.__del__") == {"RL902"}
+    assert by_symbol.get("_finalize_entry") == {"RL902"}
+    assert by_symbol.get("bad_rpc_under_lock") == {"RL902"}
+    assert by_symbol.get("bad_kv_verb_under_lock") == {"RL902"}
+    assert by_symbol.get(
+        "bad_by_name_lookup_in_del._Owner.__del__"
+    ) == {"RL902"}
+    assert by_symbol.get("bad_connect_under_lock") == {"RL902"}
+    assert by_symbol.get("Scheduler.decode_loop") == {"RL902"}
+    assert by_symbol.get("Scheduler._place") == {"RL902"}  # hot by propagation
+    for sym in ("Holder.close", "Scheduler.scheduler_stats",
+                "Scheduler.schedule_step", "ok_plain_method",
+                "ok_copy_out_then_call", "ok_socket_connect",
+                "suppressed_del_rpc._Owner.__del__"):
+        assert sym not in by_symbol, (sym, by_symbol.get(sym))
+
+
+def test_rl903_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl903.py"))
+    for sym in ("BadFormattedInit", "BadDefaultedError", "BadDerivedError"):
+        assert found.get(sym) == {"RL903"}, (sym, found.get(sym))
+    for sym in ("OkReduceError", "OkVerbatimForward", "OkNoCustomInit",
+                "OkPlainFormatter", "SuppressedError"):
+        assert sym not in found, (sym, found.get(sym))
+
+
+def test_rl904_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl904.py"))
+    for sym in ("bad_lambda_reads_inside", "bad_named_callback",
+                "bad_transitive_callback", "bad_partial_callback",
+                "bad_executor_submit", "bad_thread_target"):
+        assert found.get(sym) == {"RL904"}, (sym, found.get(sym))
+    for sym in ("ok_captured_before_hop", "ok_lambda_closes_over_capture",
+                "ok_plain_callback", "suppressed_read_inside",
+                "_work_reads_trace", "_work_transitively", "_work_takes_ctx"):
+        assert sym not in found, (sym, found.get(sym))
+
+
+def test_rl905_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl905.py"))
+    for sym in ("bad_await_remote_under_lock", "bad_await_gcs_under_lock",
+                "bad_await_helper_under_lock", "bad_sync_helper_under_lock"):
+        assert found.get(sym) == {"RL905"}, (sym, found.get(sym))
+    for sym in ("ok_await_outside_lock", "ok_local_await_under_lock",
+                "ok_sync_helper_outside_lock", "ok_local_helper_under_lock",
+                "suppressed_await_under_lock", "_dispatch",
+                "_refresh_placement"):
+        assert sym not in found, (sym, found.get(sym))
+
+
+def test_distlint_silent_on_report_path_shapes(tmp_path):
+    # The blessed shape: data paths bump plain ints; stats() mutates the
+    # gauges and does the control-plane round-trips.
+    f = tmp_path / "blessed.py"
+    f.write_text(
+        "from ray_tpu.util.metrics import Gauge\n"
+        "class Plane:\n"
+        "    def __init__(self):\n"
+        "        self._depth = Gauge('depth')\n"
+        "        self._n = 0\n"
+        "    def on_request(self):\n"
+        "        self._n += 1\n"
+        "    def stats(self, worker):\n"
+        "        self._depth.set(float(self._n))\n"
+        "        return {'kv': worker.gcs_call('kv_keys', 'ns', b'')}\n"
+    )
+    assert not [x for x in lint_file(str(f)) if x.code.startswith("RL9")]
+
+
 # ---- baseline ---------------------------------------------------------------
 
 def test_baseline_grandfathers_by_symbol():
@@ -432,12 +519,13 @@ def test_cli_fail_stale(tmp_path):
 
 def test_shipped_tree_clean_per_family():
     """The tier-1 gate, per family: the concurrency checkers (RL1xx-RL5xx),
-    the jaxlint compute-plane checkers (RL6xx/RL7xx), and the leaklint
-    resource-lifetime checkers (RL8xx) must EACH report zero unbaselined
-    findings over the shipped package."""
+    the jaxlint compute-plane checkers (RL6xx/RL7xx), the leaklint
+    resource-lifetime checkers (RL8xx), and the distlint distributed-contract
+    checkers (RL9xx) must EACH report zero unbaselined findings over the
+    shipped package."""
     from ray_tpu.devtools.raylint.core import FAMILIES
 
-    assert set(FAMILIES) == {"concurrency", "jax", "leak"}
+    assert set(FAMILIES) == {"concurrency", "jax", "leak", "dist"}
     findings = lint_paths([PKG_DIR])
     entries = load_baseline()
     for name, codes in FAMILIES.items():
@@ -483,16 +571,103 @@ def test_cli_only_and_family_filters(tmp_path):
          "--fail-stale"]
     ) == 0
     # unknown pattern is a usage error (exit 2), per the documented contract
-    assert raylint_main([str(mixed), "--only", "RL9xx"]) == 2
+    assert raylint_main([str(mixed), "--only", "RL0xx"]) == 2
+    # unknown family is a usage error too
+    assert raylint_main([str(mixed), "--family", "nope"]) == 2
+
+
+def test_cli_family_comma_list(tmp_path):
+    """`--family a,b,...` unions the families — the one-invocation tier-1
+    gate shape (`--family concurrency,jax,leak,dist`)."""
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        # RL501 (discarded .remote) AND RL901 (metric inc outside report path)
+        "from ray_tpu.util.metrics import Counter\n"
+        "C = Counter('c')\n"
+        "def f(actor):\n"
+        "    actor.ping.remote()\n"
+        "    C.inc()\n"
+    )
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"entries": []}))
+    # each family alone sees only its own finding
+    base_dist = tmp_path / "dist_base.json"
+    base_dist.write_text(json.dumps({"entries": [
+        {"file": "mixed.py", "code": "RL901", "symbol": "f", "reason": "t"}
+    ]}))
+    assert raylint_main(
+        [str(mixed), "--family", "dist", "--baseline", str(base_dist)]
+    ) == 0
+    # the union sees both
+    both = tmp_path / "both_base.json"
+    both.write_text(json.dumps({"entries": [
+        {"file": "mixed.py", "code": "RL901", "symbol": "f", "reason": "t"},
+        {"file": "mixed.py", "code": "RL501", "symbol": "f", "reason": "t"},
+    ]}))
+    assert raylint_main(
+        [str(mixed), "--family", "concurrency,dist", "--baseline", str(both)]
+    ) == 0
+    assert raylint_main(
+        [str(mixed), "--family", "concurrency,dist",
+         "--baseline", str(base_dist)]
+    ) == 1
+
+
+def test_cli_changed_lints_only_git_changed_files(tmp_path):
+    """--changed scopes the run to git's changed/untracked .py files (the
+    pre-commit shape); unmatched baseline entries are not stale for it."""
+    import subprocess as sp
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           # the linter runs from inside the scratch repo: keep ray_tpu
+           # importable without an install
+           "PYTHONPATH": os.path.dirname(PKG_DIR)}
+    sp.run(["git", "init", "-q"], cwd=repo, check=True, env=env)
+    committed = repo / "committed.py"
+    committed.write_text("def f(actor):\n    actor.ping.remote()\n")
+    sp.run(["git", "add", "-A"], cwd=repo, check=True, env=env)
+    sp.run(["git", "commit", "-qm", "seed"], cwd=repo, check=True, env=env)
+
+    def run(*extra):
+        return sp.run(
+            [sys.executable, "-m", "ray_tpu.devtools.raylint", "--changed",
+             "--baseline", str(repo / "nope.json"), *extra],
+            cwd=repo, capture_output=True, text=True, timeout=120, env=env,
+        )
+
+    # nothing changed: the committed violation is out of scope
+    assert run().returncode == 0
+    # an untracked violating file IS in scope
+    (repo / "fresh.py").write_text("def g(actor):\n    actor.ping.remote()\n")
+    proc = run()
+    assert proc.returncode == 1 and "fresh.py" in proc.stdout
+    assert "committed.py" not in proc.stdout
+    # a clean changed file, with a baseline covering OTHER files: not stale
+    (repo / "fresh.py").write_text("x = 1\n")
+    base = repo / "base.json"
+    base.write_text(json.dumps({"entries": [
+        {"file": "elsewhere.py", "code": "RL501", "symbol": "f",
+         "reason": "t"}
+    ]}))
+    proc = sp.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", "--changed",
+         "--baseline", str(base), "--fail-stale"],
+        cwd=repo, capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_cli_module_entrypoint_clean_tree():
-    """The tier-1 gate as CI invokes it: zero unbaselined findings AND zero
-    stale baseline entries — a fixed-but-still-baselined finding fails loudly
-    instead of lingering as a grandfather clause nobody re-earns."""
+    """The tier-1 gate as CI invokes it — all four families in one
+    invocation: zero unbaselined findings AND zero stale baseline entries —
+    a fixed-but-still-baselined finding fails loudly instead of lingering
+    as a grandfather clause nobody re-earns."""
     proc = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.devtools.raylint", "--fail-stale",
-         PKG_DIR],
+        [sys.executable, "-m", "ray_tpu.devtools.raylint",
+         "--family", "concurrency,jax,leak,dist", "--fail-stale", PKG_DIR],
         capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
