@@ -228,6 +228,285 @@ def run_load(engine, cfg, *, rate_rps: float, n_requests: int, mix: str,
     return row
 
 
+class _PacedReplica:
+    """One DP replica with an explicit admission-rate budget.
+
+    On a one-core CI host every in-process engine shares the same CPU, so
+    raw engine throughput cannot model per-replica capacity (N engines are
+    still one core of compute, and building an engine mid-run starves the
+    live one). The pacer caps each replica at `rps` admissions per second —
+    the stand-in for one TPU host's serving capacity — while the REAL
+    engine underneath still produces tokens, queue depth, and SLO burn for
+    the control law to read. TTFT is measured from arrival, so admission
+    queueing in an overloaded replica shows up as the SLO breach it is.
+    """
+
+    def __init__(self, engine, rps: float, cap: int = 64):
+        self.engine = engine
+        self._gap = 1.0 / rps
+        self._cap = cap
+        self._q: List = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._th = threading.Thread(target=self._drain, daemon=True)
+        self._th.start()
+
+    def submit(self, prompt, params, cb):
+        from ray_tpu.llm.scheduler.scheduler import EngineOverloadedError
+
+        with self._cv:
+            if len(self._q) >= self._cap:
+                raise EngineOverloadedError(
+                    f"replica admission queue at capacity ({self._cap})")
+            self._q.append((prompt, params, cb))
+            self._cv.notify()
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            pending = len(self._q)
+        return pending + self.engine._sched.queue_depth()
+
+    def ongoing(self) -> int:
+        st = self.engine._sched.stats()
+        return st.get("running", 0) + st.get("prefilling", 0)
+
+    def burn(self) -> float:
+        return self.engine._serve_metrics.burn_rate("")
+
+    def _drain(self):
+        free_at = time.perf_counter()
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait(0.1)
+                if not self._q:
+                    return  # stopped AND fully drained
+                prompt, params, cb = self._q.pop(0)
+            delay = free_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                self.engine.submit(prompt, params, cb)
+            except Exception:
+                cb(-1, True)  # surfaces as a rejection, not a lost request
+            free_at = max(free_at, time.perf_counter()) + self._gap
+
+    def close(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._th.join(timeout=120)
+        self.engine.shutdown()
+
+
+def run_autopilot_ab(cfg, params, *, base_rps: float, surge_rps: float,
+                     phase_requests, slo_ttft_s: float, slo_tpot_s: float,
+                     autopilot: bool, max_seq: int, seed: int = 0) -> dict:
+    """One arm of the autopilot A/B (docs/autoscale.md): an in-process DP
+    replica pool under a rate-STEP schedule (base -> 3x surge -> base ->
+    quiet). The closed-loop arm drives the pool's size with the real
+    `replica_law` off the replicas' own queue/burn signals — the same law
+    the serve controller ticks — while the static arm holds one replica.
+    Replicas are paced `_PacedReplica`s over a warm standby pool built
+    off-clock (see its docstring for why), with a fixed activation delay
+    per scale-up standing in for provisioning. The row records
+    goodput-under-SLO, TTFT p50/p99, and the replica count over time."""
+    import numpy as np
+
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm._engine import DecodeEngine
+    from ray_tpu.llm.scheduler.scheduler import EngineOverloadedError
+    from ray_tpu.serve.autopilot import ReplicaBounds
+    from ray_tpu.serve.autopilot._laws import new_replica_state, replica_law
+
+    rng = np.random.default_rng(seed)
+
+    def new_engine(i: int) -> DecodeEngine:
+        # Two slots per replica: small enough that the surge genuinely
+        # overloads ONE replica (the regime the autopilot exists for) while
+        # three absorb it.
+        e = DecodeEngine(cfg, params, num_slots=2, max_seq=max_seq, seed=i)
+        # Warm-start analog of the serve path's mmap + prefix bootstrap:
+        # compile the arrival-sized buckets before the replica is routed.
+        # Own rng: this runs on the control thread concurrently with the
+        # submit loop's draws.
+        wrng = np.random.default_rng(1000 + i)
+        for n in (8, 32, max_seq // 4):
+            done = threading.Event()
+            e.submit(wrng.integers(0, cfg.vocab_size, n).tolist(),
+                     SamplingParams(max_tokens=4),
+                     lambda t, f, _d=done: _d.set() if f else None)
+            done.wait(600)
+        return e
+
+    max_replicas = 3
+    replica_rps = 1.5 * base_rps
+    activation_delay_s = 1.0
+    # Warm standby pool, built OFF-CLOCK (the static arm only needs one).
+    replicas = [_PacedReplica(new_engine(i), replica_rps)
+                for i in range(max_replicas if autopilot else 1)]
+    pool = replicas[:1]
+    lock = threading.Lock()
+    bounds = ReplicaBounds(
+        min_replicas=1, max_replicas=max_replicas, burn_high=1.0,
+        queue_high=8.0, sustain_ticks=2, upscale_cooldown_s=0.5,
+        downscale_cooldown_s=1.0, cold_start_guard_s=0.0)
+    law_state = new_replica_state(1)
+    t0 = time.perf_counter()
+    series: List[List[float]] = [[0.0, 1]]
+    stop = threading.Event()
+
+    def control_loop():
+        while not stop.wait(0.25):
+            with lock:
+                live = list(pool)
+            queued = sum(r.queue_depth() for r in live)
+            ongoing = sum(r.ongoing() for r in live)
+            burn = max((r.burn() for r in live), default=0.0)
+            fired = replica_law(
+                state=law_state, replicas=len(live), queued=queued,
+                ongoing=ongoing, burn=burn, bounds=bounds,
+                now=time.perf_counter())
+            if fired is None:
+                continue
+            target = fired[0]
+            if target > len(live):
+                time.sleep(activation_delay_s)  # provisioning stand-in
+            with lock:
+                # Activation routes new arrivals to standby replicas;
+                # deactivation is drain-and-retire (a demoted replica keeps
+                # serving its admitted queue, it just stops receiving).
+                pool[:] = replicas[:target]
+                series.append([round(time.perf_counter() - t0, 2),
+                               len(pool)])
+
+    controller = None
+    if autopilot:
+        controller = threading.Thread(target=control_loop, daemon=True)
+        controller.start()
+
+    phases = [(base_rps, phase_requests[0]), (surge_rps, phase_requests[1]),
+              (base_rps, phase_requests[2])]
+    n_total = sum(n for _r, n in phases)
+    prompt_lens = _lengths(rng, n_total, mean_log=2.5, sigma=0.6, lo=4,
+                           hi=max_seq // 4)
+    arrivals = [_Arrival() for _ in range(n_total)]
+
+    def cb_for(a: _Arrival):
+        def cb(token: int, finished: bool):
+            if token < 0:  # pacer-surfaced late rejection
+                a.rejected = True
+                a.done.set()
+                return
+            a.token_times.append(time.perf_counter())
+            if finished:
+                a.done.set()
+        return cb
+
+    i = 0
+    next_t = time.perf_counter()
+    for rate, n in phases:
+        gaps = rng.exponential(1.0 / rate, size=n)
+        for g in gaps:
+            next_t += g
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            a = arrivals[i]
+            prompt = rng.integers(0, cfg.vocab_size, prompt_lens[i]).tolist()
+            a.t_submit = time.perf_counter()
+            with lock:
+                # Least-queued routing across the live pool (the DP router's
+                # balanced pick, collapsed to in-process form).
+                target = min(pool, key=lambda r: r.queue_depth())
+            try:
+                target.submit(prompt, SamplingParams(max_tokens=48),
+                              cb_for(a))
+            except EngineOverloadedError:
+                a.rejected = True
+                a.done.set()
+            i += 1
+    for a in arrivals:
+        a.done.wait(timeout=600)
+    # Quiet tail: the closed loop must also scale back DOWN once idle.
+    if autopilot:
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            with lock:
+                if len(pool) == 1:
+                    break
+            time.sleep(0.25)
+    stop.set()
+    if controller is not None:
+        controller.join(timeout=10)
+
+    ttfts = [a.ttft() for a in arrivals if a.ttft() is not None]
+    good = sum(
+        1 for a in arrivals
+        if not a.rejected and a.ttft() is not None
+        and a.ttft() <= slo_ttft_s
+        and (a.tpot() is None or a.tpot() <= slo_tpot_s)
+    )
+    with lock:
+        series.append([round(time.perf_counter() - t0, 2), len(pool)])
+        pool.clear()
+    for r in replicas:
+        r.close()
+    counts = [n for _t, n in series]
+    return {
+        "metric": "autopilot_ab",
+        "arm": "autopilot" if autopilot else "static",
+        "schedule": {"base_rps": base_rps, "surge_rps": surge_rps,
+                     "phase_requests": list(phase_requests),
+                     "replica_rps": replica_rps,
+                     "activation_delay_s": activation_delay_s},
+        "requests": n_total,
+        "rejected": sum(1 for a in arrivals if a.rejected),
+        "slo": {"ttft_s": slo_ttft_s, "tpot_s": slo_tpot_s},
+        "goodput_fraction": round(good / n_total, 3),
+        "ttft_p50_s": round(_pctl(ttfts, 0.5), 4),
+        "ttft_p99_s": round(_pctl(ttfts, 0.99), 4),
+        "replicas_over_time": series,
+        "scaled_up": max(counts) > 1,
+        "scaled_back_down": max(counts) > 1 and counts[-1] == 1,
+    }
+
+
+def run_autopilot_ab_suite(args) -> List[dict]:
+    """Both arms on one loaded model; the A/B contract is autopilot goodput
+    >= static goodput under the same rate-step schedule, having scaled up
+    AND back down."""
+    import jax
+
+    from ray_tpu.llm import LLMConfig, load_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_id = "gpt2-125m" if on_tpu else "test-tiny"
+    cfg, params = load_model(LLMConfig(model_id=model_id))
+    max_seq = 1024 if on_tpu else 256
+    slo_ttft = args.slo_ttft if args.slo_ttft is not None else (
+        0.5 if on_tpu else 0.25)
+    slo_tpot = args.slo_tpot if args.slo_tpot is not None else 0.05
+    base = args.ab_base_rps or (4.0 if on_tpu else 10.0)
+    surge = args.ab_surge_rps or 3.0 * base
+    # Duration-based phases: the surge window must dwarf an engine cold
+    # start (~5s build+warm on CPU) or scaling up can never pay off before
+    # the step ends. ~3s base, ~20s surge, ~5s base.
+    durations = (3.0, 20.0, 5.0)
+    phase_requests = tuple(
+        max(4, int(r * d))
+        for r, d in zip((base, surge, base), durations))
+    rows = []
+    for autopilot in (False, True):
+        rows.append(run_autopilot_ab(
+            cfg, params, base_rps=base, surge_rps=surge,
+            phase_requests=phase_requests, slo_ttft_s=slo_ttft,
+            slo_tpot_s=slo_tpot, autopilot=autopilot, max_seq=max_seq,
+            seed=11))
+        print(json.dumps(rows[-1]))
+    return rows
+
+
 def main():
     import jax
 
@@ -237,7 +516,27 @@ def main():
     parser.add_argument("--requests", type=int, default=200)
     parser.add_argument("--slo-ttft", type=float, default=None)
     parser.add_argument("--slo-tpot", type=float, default=None)
+    parser.add_argument("--autopilot-ab", action="store_true",
+                        help="run the static-vs-closed-loop A/B under a "
+                             "rate-step schedule and append the rows to "
+                             "BENCH_LOAD.json (docs/autoscale.md)")
+    parser.add_argument("--ab-base-rps", type=float, default=None)
+    parser.add_argument("--ab-surge-rps", type=float, default=None)
     args = parser.parse_args()
+
+    if args.autopilot_ab:
+        rows = run_autopilot_ab_suite(args)
+        try:
+            with open("BENCH_LOAD.json") as f:
+                out = json.load(f)
+        except (OSError, ValueError):
+            out = {"bench": "open_loop_load", "results": []}
+        out["results"] = [r for r in out.get("results", [])
+                          if r.get("metric") != "autopilot_ab"] + rows
+        with open("BENCH_LOAD.json", "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(rows))
+        return
 
     engine, cfg, model_id, on_tpu = build_engine(
         slots=8, tenant_weights={"gold": 2.0, "silver": 1.0, "bronze": 1.0},

@@ -113,6 +113,23 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_slo_ttft_s": (float, 0.5, "time-to-first-token SLO: completions whose TTFT exceeds this count as SLO breaches in the llm_slo_* burn/goodput counters (docs/observability.md)"),
     "llm_slo_tpot_s": (float, 0.05, "per-request mean inter-token-latency SLO: completions whose mean TPOT exceeds this count as SLO breaches (docs/observability.md)"),
     "llm_slo_error_budget": (float, 0.01, "allowed SLO breach fraction: llm_slo_burn_rate = windowed breach fraction / this budget, so burn > 1 means the error budget is being exhausted"),
+    # --- serve autopilot (docs/autoscale.md) ---
+    "serve_autopilot": (bool, False, "closed-loop SLO autopilot inside the serve controller: scales DP replicas on burn-rate/queue pressure, nudges per-tenant WFQ weights toward SLO attainment, and rebalances the prefill:decode split (docs/autoscale.md)"),
+    "serve_autopilot_interval_s": (float, 1.0, "autopilot control-law evaluation interval; signals are probed and laws evaluated at most this often inside the controller's control loop"),
+    "serve_autopilot_min_replicas": (int, 1, "default replica floor for autopilot-managed deployments without an AutoscalingConfig (0 enables scale-to-zero; a deployment's own AutoscalingConfig bounds win when set)"),
+    "serve_autopilot_max_replicas": (int, 8, "default replica ceiling for autopilot-managed deployments without an AutoscalingConfig"),
+    "serve_autopilot_burn_high": (float, 1.0, "scale-up pressure threshold on llm_slo_burn_rate: burn >= this (budget exhausting) counts a hot tick"),
+    "serve_autopilot_queue_high": (float, 8.0, "scale-up pressure threshold on mean queued requests per replica: queue/replica >= this counts a hot tick even when burn is still low (queue growth leads breach by a window)"),
+    "serve_autopilot_sustain_ticks": (int, 2, "consecutive autopilot ticks a pressure (or idle) condition must hold before any action fires — the hysteresis that keeps a one-tick spike from scaling"),
+    "serve_autopilot_upscale_cooldown_s": (float, 5.0, "minimum seconds between scale-up actions on one deployment (persisted: a restarted controller honors the remaining cooldown instead of flapping)"),
+    "serve_autopilot_downscale_cooldown_s": (float, 30.0, "minimum seconds between scale-down actions on one deployment; deliberately long so capacity added for a surge is not shed on the first quiet window"),
+    "serve_autopilot_cold_start_guard_s": (float, 60.0, "after a scale-to-zero wake (first request found zero replicas), the deployment may not scale back to zero for this long — the cold-start guard against wake/retire thrash"),
+    "serve_autopilot_weight_step": (float, 0.25, "max fractional change to one tenant's WFQ weight per autopilot action (bounded step: weight moves by at most this fraction per decision)"),
+    "serve_autopilot_weight_floor": (float, 0.25, "WFQ weight floor no tenant is nudged below — the starvation guard: a compliant tenant keeps at least this share-weight while a breaching tenant is boosted"),
+    "serve_autopilot_weight_max": (float, 8.0, "WFQ weight ceiling the autopilot will not boost a breaching tenant past"),
+    "serve_autopilot_weight_deadband": (float, 0.25, "burn-rate deadband around 1.0 inside which tenant weights are left alone (attainment hysteresis: only clearly-breaching or clearly-healthy tenants move)"),
+    "serve_autopilot_pd_ratio_tol": (float, 2.0, "prefill:decode rebalance trigger: when TTFT pressure exceeds TPOT pressure by this factor (or vice versa), one replica shifts between the prefill and decode pools"),
+    "serve_autopilot_decision_log": (int, 256, "bounded entries in the autopilot decision log surfaced through serve_stats()/`ray_tpu status` (rule fired, signal values, action taken)"),
     "metrics_series_ttl_s": (float, 300.0, "collect-time TTL for cluster metric series: entries whose reporting worker is gone (not the driver, no live actor) AND whose last flush is older than this are pruned from the GCS KV metrics namespace instead of living forever"),
     "tune_checkpoint_period_s": (float, 1.0, "experiment-state snapshot interval for Tuner.restore"),
     "data_block_target_bytes": (int, 128 * 1024 * 1024, "target block size for ray_tpu.data"),

@@ -124,6 +124,13 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
     # wraps the pair; any direct start_capture() must stop_capture()/close().
     ResourceSpec("profiler capture (ProfilerCapture)", "start_capture",
                  release=("stop_capture", "close")),
+    # Round 20 (docs/autoscale.md): an autopilot scale-op token. Every
+    # begin_scale_op() must resolve to commit() (decision applied, persisted)
+    # or abort() (target rolled back). A dropped token leaves the decision
+    # log entry "pending" forever and — worse — a half-applied replica
+    # target that the next controller restart replays.
+    ResourceSpec("autopilot scale-op token (ScaleOp)", "begin_scale_op",
+                 release=("commit", "abort")),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

@@ -383,6 +383,19 @@ class LLMServer:
         (docs/observability.md)."""
         return self._engine.recorder_stats()
 
+    async def set_tenant_weight(self, tenant: str, weight: float) -> float:
+        """Adaptive-WFQ actuator (docs/autoscale.md): the serve autopilot
+        broadcasts adapted per-tenant weights here; the engine forwards to
+        its scheduler's weighted-fair queues."""
+        self._engine.set_tenant_weight(tenant, weight)
+        return float(weight)
+
+    async def autopilot_signals(self) -> dict:
+        """The serve autopilot's per-replica signal probe (queue depth,
+        occupancy, per-tenant SLO burn rates). Deployments whose replicas
+        answer this become autopilot-managed; see docs/autoscale.md."""
+        return self._engine.autopilot_signals()
+
     async def capture_profile(self, duration_s: float = 3.0,
                               log_dir: Optional[str] = None) -> dict:
         """On-demand profiler capture on this replica (the fleet surface

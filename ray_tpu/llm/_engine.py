@@ -877,6 +877,36 @@ class DecodeEngine:
         what flushes pending metrics/spans — the report-path contract."""
         return self._flush_observability()
 
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Actuator for the serve autopilot's adaptive-WFQ loop (and for
+        operators): reshare one tenant's weighted-fair queue weight."""
+        self._sched.set_tenant_weight(tenant, weight)
+
+    def autopilot_signals(self) -> dict:
+        """Compact control-law signal vector for the serve autopilot
+        (docs/autoscale.md): queue/occupancy from the scheduler, burn
+        rates from the SLO metrics plane. REPORT path — probing it also
+        drains the observability backlog, so the autopilot's tick cadence
+        doubles as the metric flush cadence for an otherwise-idle engine."""
+        from ray_tpu.devtools import distsan
+
+        with distsan.report_path("autopilot_signals"):
+            st = self._sched.stats()
+            self._flush_observability()
+            burns = self._serve_metrics.burn_rates()
+            return {
+                "role": "engine",
+                "queued": st.get("queue_depth", 0),
+                "running": (st.get("running", 0) or 0)
+                + (st.get("prefilling", 0) or 0),
+                "burn_rate": max(burns.values(), default=0.0),
+                "tenant_burn": {t: b for t, b in burns.items() if t},
+                "tenant_weights": {
+                    t: info.get("weight", 1.0)
+                    for t, info in (st.get("tenants") or {}).items()
+                },
+            }
+
     def request_timing(self, rid: str) -> Optional[dict]:
         """Per-request timing breakdown (the response-metadata payload):
         queue/prefill/decode phase durations, TTFT, mean TPOT, e2e, routing

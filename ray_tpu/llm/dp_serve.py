@@ -117,6 +117,11 @@ class DPLLMServer(LLMServer):
         stats = await super().recorder_stats()
         return {"dp_rank": self.dp_rank, **stats}
 
+    async def autopilot_signals(self) -> dict:
+        """Autopilot signal probe, rank-tagged (docs/autoscale.md)."""
+        sig = await super().autopilot_signals()
+        return {"dp_rank": self.dp_rank, **sig}
+
     async def capture_profile(self, duration_s: float = 3.0,
                               log_dir: Optional[str] = None) -> dict:
         """Profiler capture, rank-tagged (docs/observability.md)."""
@@ -176,6 +181,12 @@ class DPRouter:
     # bounds staleness, never correctness (a stale entry just means one
     # page-in on the replica that evicted it).
     ADAPTER_CAP = 256
+    # Hot-prefix memory for scale-up bootstrap (docs/autoscale.md): the
+    # router remembers the most-routed whole-block prefixes so a replica
+    # the autopilot just spawned can pull them from current holders and
+    # join WARM instead of recomputing the working set request by request.
+    HOT_PREFIX_CAP = 32
+    BOOTSTRAP_TOP_K = 4
 
     def __init__(self, server_handle, assigner, config: Optional[LLMConfig] = None):
         from ray_tpu._private.config import CONFIG
@@ -194,9 +205,15 @@ class DPRouter:
         # fingerprints, so tenants land where their adapter (and their
         # prefix cache, which is namespaced BY adapter) is already hot.
         self._adapter_res: Dict[object, OrderedDict] = {}
+        # chain tuple -> {"token_ids", "adapter", "hits"}: the bootstrap
+        # source material. Replica ids already offered a bootstrap live in
+        # _bootstrapped so each new replica is primed at most once.
+        self._hot_prefixes: OrderedDict = OrderedDict()
+        self._bootstrapped: set = set()
         self._routing = {"cache_routed": 0, "balanced": 0, "untracked": 0,
                          "adapter_routed": 0, "remote_fetched": 0,
-                         "remote_fetch_failed": 0}
+                         "remote_fetch_failed": 0, "bootstrap_fetched": 0,
+                         "bootstrap_failed": 0, "retired_pruned": 0}
 
     # -- prefix fingerprints -----------------------------------------------
     def _chain(self, token_ids: List[int]) -> List[int]:
@@ -224,6 +241,21 @@ class DPRouter:
             res[adapter] = None
             while len(res) > self.ADAPTER_CAP:
                 res.popitem(last=False)
+
+    def _note_hot_prefix(self, chain: List[int], token_ids: List[int],
+                         adapter: str):
+        """Remember this request's whole-block prefix as bootstrap material
+        (bounded LRU with hit counts; plain dict ops, hot-path safe)."""
+        covered = len(chain) * self._block
+        key = tuple(chain)
+        info = self._hot_prefixes.pop(key, None)
+        if info is None:
+            info = {"token_ids": list(token_ids[:covered]),
+                    "adapter": adapter, "hits": 0}
+        info["hits"] += 1
+        self._hot_prefixes[key] = info
+        while len(self._hot_prefixes) > self.HOT_PREFIX_CAP:
+            self._hot_prefixes.popitem(last=False)
 
     def _match_len(self, actor_id, chain: List[int]) -> int:
         fps = self._fingerprints.get(actor_id) or ()
@@ -257,6 +289,21 @@ class DPRouter:
             del self._fingerprints[aid]  # replica died or was redeployed
         for aid in [a for a in self._adapter_res if a not in live]:
             del self._adapter_res[aid]
+        self._bootstrapped = {a for a in self._bootstrapped if a in live}
+        # A replica this router has never seen (an autopilot scale-up) gets
+        # one background bootstrap: pull the hottest prefixes from their
+        # current holders so it joins warm (docs/autoscale.md).
+        for r in replicas:
+            if r._actor_id in self._bootstrapped:
+                continue
+            self._bootstrapped.add(r._actor_id)
+            if (len(replicas) > 1 and self._hot_prefixes
+                    and self._remote_fetch_enabled()):
+                try:
+                    asyncio.get_running_loop().create_task(
+                        self.bootstrap_replica(r))
+                except RuntimeError:
+                    pass  # no running loop (sync test harness): skip
         loads = router.loads() if len(replicas) > 1 else {}
 
         def overloaded(r):
@@ -389,6 +436,8 @@ class DPRouter:
         if mode != "remote_fetch":
             self._routing[mode] += 1
         self._record(replica._actor_id, chain, adapter)
+        if chain and token_ids is not None:
+            self._note_hot_prefix(chain, token_ids, adapter)
         # Router-side tokenization rides along: replicas accept token lists.
         # The routing reason rides too — the replica's flight recorder stamps
         # it into the request's trace and timing breakdown.
@@ -401,6 +450,73 @@ class DPRouter:
         return await asyncio.get_running_loop().run_in_executor(
             None, lambda: ray_tpu.get(self._assigner.ranks.remote())
         )
+
+    # -- autopilot hooks (docs/autoscale.md) --------------------------------
+    async def retire_replica(self, actor_id) -> dict:
+        """Explicit scale-down prune: the serve controller calls this
+        BEFORE retiring a replica so its prefix fingerprints and
+        adapter-residency entries leave the routing tables while the actor
+        is still alive — without it, cache-affine traffic keeps chasing the
+        corpse until the lazy dead-replica pruning notices on a later pick."""
+        hexid = actor_id.hex() if hasattr(actor_id, "hex") else str(actor_id)
+
+        def _hex(aid):
+            return aid.hex() if hasattr(aid, "hex") else str(aid)
+
+        fingerprints = adapters = 0
+        for aid in [a for a in self._fingerprints if _hex(a) == hexid]:
+            fingerprints += len(self._fingerprints.pop(aid))
+        for aid in [a for a in self._adapter_res if _hex(a) == hexid]:
+            adapters += len(self._adapter_res.pop(aid))
+        self._bootstrapped = {
+            a for a in self._bootstrapped if _hex(a) != hexid
+        }
+        self._routing["retired_pruned"] += 1
+        return {"fingerprints": fingerprints, "adapters": adapters}
+
+    async def bootstrap_replica(self, replica) -> int:
+        """Prefix-fingerprint bootstrap for a fresh replica: pull the
+        hottest remembered prefixes from their best current holders into
+        `replica`'s cache over the cluster prefix plane, so an
+        autopilot-spawned replica serves its first requests suffix-only.
+        Best-effort: a failed fetch is a recompute, never an error."""
+        if not self._remote_fetch_enabled():
+            return 0
+        top = sorted(self._hot_prefixes.items(),
+                     key=lambda kv: -kv[1]["hits"])[:self.BOOTSTRAP_TOP_K]
+        fetched = 0
+        for chain_key, info in top:
+            chain = list(chain_key)
+            router = self._server.generate._get_router()
+            best, best_len = None, 0
+            for r in router.replicas():
+                if r._actor_id == replica._actor_id:
+                    continue
+                m = self._match_len(r._actor_id, chain)
+                if m > best_len:
+                    best, best_len = r, m
+            if best is None:
+                continue
+            if await self._remote_fetch(best, replica, info["token_ids"],
+                                        info["adapter"]):
+                self._record(replica._actor_id, chain[:best_len],
+                             info["adapter"])
+                self._routing["bootstrap_fetched"] += 1
+                fetched += 1
+            else:
+                self._routing["bootstrap_failed"] += 1
+        return fetched
+
+    async def set_tenant_weight(self, tenant: str, weight: float) -> float:
+        """Fan one tenant's adapted WFQ weight out to every DP rank (the
+        autopilot's weight broadcasts also reach the DPLLMServer replicas
+        directly; this is the operator/API path)."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: self._server.set_tenant_weight.broadcast(tenant, weight),
+        )
+        return float(weight)
 
     async def load_lora(self, name: str, layer_weights: dict,
                         alpha: float = 1.0) -> List[int]:

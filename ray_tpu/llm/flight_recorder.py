@@ -469,5 +469,18 @@ class ServeMetrics:
             breaches = sum(1 for ok in w if not ok)
             return (breaches / len(w)) / self.error_budget
 
+    def burn_rates(self) -> Dict[str, float]:
+        """Every tenant's current burn rate (the autopilot's signal vector;
+        "" is untenanted traffic). Pure window math — no metric mutation,
+        safe from any path."""
+        with self._lock:
+            out = {}
+            for tenant, w in self._window.items():
+                if not w:
+                    continue
+                breaches = sum(1 for ok in w if not ok)
+                out[tenant] = (breaches / len(w)) / self.error_budget
+            return out
+
 
 __all__ = ["FlightRecorder", "RequestRecord", "ServeMetrics"]

@@ -121,6 +121,13 @@ class PrefillServer:
         pending trace spans (docs/observability.md)."""
         return self._engine.recorder_stats()
 
+    async def autopilot_signals(self) -> dict:
+        """Autopilot probe; the prefill role marks this pool as the TTFT
+        side of the P:D rebalance law (docs/autoscale.md)."""
+        sig = self._engine.autopilot_signals()
+        sig["role"] = "prefill"
+        return sig
+
     async def shutdown(self):
         """Explicit retirement hook for the serve controller's retire path."""
         self._engine.shutdown()
@@ -251,6 +258,19 @@ class DecodeServer:
         metrics and trace spans (docs/observability.md)."""
         return self._engine.recorder_stats()
 
+    async def set_tenant_weight(self, tenant: str, weight: float) -> float:
+        """Adaptive-WFQ actuator on the decode pool (the phase that owns
+        the weighted-fair queues)."""
+        self._engine.set_tenant_weight(tenant, weight)
+        return float(weight)
+
+    async def autopilot_signals(self) -> dict:
+        """Autopilot probe; the decode role marks this pool as the TPOT
+        side of the P:D rebalance law (docs/autoscale.md)."""
+        sig = self._engine.autopilot_signals()
+        sig["role"] = "decode"
+        return sig
+
     async def shutdown(self):
         """Explicit retirement hook: stops the stepper and fails queued
         requests, so a decode replica retired mid-stream unblocks its
@@ -268,10 +288,22 @@ class PDRouter:
     """Request path: tokenize -> prefill replica -> KV transfer -> decode replica."""
 
     def __init__(self, prefill_handle, decode_handle, config: LLMConfig):
+        from collections import deque
+
+        from ray_tpu._private.config import CONFIG
+
         self._prefill = prefill_handle
         self._decode = decode_handle
         self._tokenizer = resolve_tokenizer(config.tokenizer)
         self._model_id = config.model_id
+        # Phase-pressure samples for the autopilot's P:D rebalance law
+        # (docs/autoscale.md): bounded deques of (prefill_s / TTFT SLO) and
+        # (decode TPOT / TPOT SLO) — plain appends on the request path, read
+        # only from the autopilot_signals report probe.
+        self._slo_ttft_s = max(1e-9, CONFIG.llm_slo_ttft_s)
+        self._slo_tpot_s = max(1e-9, CONFIG.llm_slo_tpot_s)
+        self._ttft_samples: deque = deque(maxlen=128)
+        self._tpot_samples: deque = deque(maxlen=128)
 
     async def generate(self, prompt: Union[str, List[int]], *,
                        max_tokens: int = 64, temperature: float = 0.0,
@@ -296,6 +328,8 @@ class PDRouter:
             # cache with the transferred rows (docs/kvcache.md).
             token_ids=token_ids, request_id=rid,
         )
+        latency_s = time.monotonic() - t0
+        self._note_pd_sample(t_prefill, latency_s, len(result["token_ids"]))
         return {
             **result,
             "usage": {
@@ -304,7 +338,28 @@ class PDRouter:
                 "total_tokens": len(token_ids) + len(result["token_ids"]),
             },
             "prefill_s": t_prefill,
-            "latency_s": time.monotonic() - t0,
+            "latency_s": latency_s,
+        }
+
+    def _note_pd_sample(self, prefill_s: float, latency_s: float,
+                        completion_tokens: int):
+        """Record one request's phase pressures (plain deque appends)."""
+        self._ttft_samples.append(prefill_s / self._slo_ttft_s)
+        tpot = (latency_s - prefill_s) / max(1, completion_tokens)
+        self._tpot_samples.append(tpot / self._slo_tpot_s)
+
+    async def autopilot_signals(self) -> dict:
+        """Autopilot probe: TTFT-vs-TPOT pressure for the P:D rebalance law
+        (pressure 1.0 = that phase is exactly at its SLO component)."""
+        ttft = list(self._ttft_samples)
+        tpot = list(self._tpot_samples)
+        return {
+            "role": "pd_router",
+            "queued": 0,
+            "running": 0,
+            "ttft_pressure": sum(ttft) / len(ttft) if ttft else 0.0,
+            "tpot_pressure": sum(tpot) / len(tpot) if tpot else 0.0,
+            "samples": len(ttft),
         }
 
     async def generate_multicast(self, prompt: Union[str, List[int]], *,

@@ -426,6 +426,27 @@ def render_status(status: dict) -> str:
     for k, v in sorted(transport.items()):
         lines.append(f"  {k}: {v}")
 
+    lines.append("== autopilot ==")
+    ap = serve.get("autopilot") or {}
+    if not ap.get("enabled"):
+        lines.append("  (off)")
+    else:
+        for key, target in sorted((ap.get("targets") or {}).items()):
+            lines.append(f"  target {key}: {target}")
+        for app, tenants in sorted((ap.get("weights") or {}).items()):
+            kv = " ".join(f"{t}={w:.2f}" for t, w in sorted(tenants.items()))
+            lines.append(f"  weights {app}: {kv}")
+        counts = ap.get("counts") or {}
+        if counts:
+            kv = " ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+            lines.append(f"  decisions: {kv}")
+        for d in (ap.get("decisions") or [])[-5:]:
+            lines.append(f"  [{d.get('seq')}] {d.get('rule')} "
+                         f"{d.get('app')}/{d.get('deployment') or d.get('tenant')} "
+                         f"-> {d.get('outcome')}")
+    if "error" in ap:
+        lines.append(f"  (error: {ap['error']})")
+
     lines.append("== control plane ==")
     cp = serve.get("control_plane") or {}
     for section in ("store", "repl"):

@@ -22,6 +22,11 @@ DEFAULT_APP_NAME = "default"
 CONTROLLER_KV_NS = "serve_ctrl"
 TARGET_STATE_KEY = b"target_state"
 REGISTRY_KEY = b"registry"
+# Autopilot state (targets, cooldown clocks, tenant weights, decision log)
+# lives in its OWN record: a declarative redeploy replays TARGET_STATE_KEY
+# wholesale, and the autopilot's imperative targets must survive that
+# (docs/autoscale.md §persistence).
+AUTOPILOT_KEY = b"autopilot"
 
 
 class ControllerUnavailableError(ConnectionError):

@@ -16,6 +16,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve._common import (
+    AUTOPILOT_KEY,
     CONTROLLER_KV_NS,
     REGISTRY_KEY,
     TARGET_STATE_KEY,
@@ -65,6 +66,13 @@ class ServeController:
         # proxy — interleaved starts split the bound-port table.
         self._proxy_lock = asyncio.Lock()
         self._mux_ids: Dict[str, dict] = {}  # "app#dep" -> {actor_id: [model ids]}
+        # SLO autopilot (docs/autoscale.md): lazily constructed on the first
+        # tick with CONFIG.serve_autopilot on, or recovered from its own KV
+        # record. Its targets/cooldowns persist separately from the
+        # declarative state so deploy replays cannot clobber them.
+        self._autopilot = None
+        self._autopilot_last = 0.0
+        self._autopilot_wake_ts: Dict[str, float] = {}
 
     # -- durable control-plane state --------------------------------------
     #
@@ -118,6 +126,23 @@ class ServeController:
             lambda: w.gcs_kv_get(CONTROLLER_KV_NS, REGISTRY_KEY)
         )
         registry = cloudpickle.loads(registry_blob) if registry_blob else {}
+        # Autopilot law state (targets, cooldown wall-clocks, tenant
+        # weights): a restarted controller resumes mid-loop — remaining
+        # cooldowns are honored, so recovery cannot double-fire a scale
+        # decision the previous incarnation just took.
+        ap_blob = await self._kv_io(
+            lambda: w.gcs_kv_get(CONTROLLER_KV_NS, AUTOPILOT_KEY)
+        )
+        if ap_blob:
+            try:
+                from ray_tpu._private.config import CONFIG
+                from ray_tpu.serve.autopilot import Autopilot
+
+                self._autopilot = Autopilot.load(
+                    cloudpickle.loads(ap_blob),
+                    decision_log_cap=CONFIG.serve_autopilot_decision_log)
+            except Exception:
+                traceback.print_exc()  # corrupt blob: start the loop cold
         self._versions = dict(registry.get("versions") or {})
 
         # Probe every registered actor CONCURRENTLY; adopt the live ones.
@@ -232,11 +257,25 @@ class ServeController:
         await self._kv_io(lambda: w.gcs_kv_put(CONTROLLER_KV_NS, REGISTRY_KEY, blob))
         self._registry_snapshot = fingerprint
 
+    async def _persist_autopilot(self):
+        if self._autopilot is None:
+            return
+        import cloudpickle
+
+        import ray_tpu
+
+        blob = cloudpickle.dumps(self._autopilot.dump())
+        w = ray_tpu.global_worker()
+        await self._kv_io(
+            lambda: w.gcs_kv_put(CONTROLLER_KV_NS, AUTOPILOT_KEY, blob)
+        )
+        self._autopilot.mark_clean()
+
     async def _clear_persisted_state(self):
         import ray_tpu
 
         w = ray_tpu.global_worker()
-        for key in (TARGET_STATE_KEY, REGISTRY_KEY):
+        for key in (TARGET_STATE_KEY, REGISTRY_KEY, AUTOPILOT_KEY):
             try:
                 await self._kv_io(
                     lambda k=key: w.gcs_call("kv_del", CONTROLLER_KV_NS, k)
@@ -384,6 +423,17 @@ class ServeController:
                 for r in live.pop(name, []):
                     self._kill(r)
                 self._bump(app, name)
+            elif (
+                prev is not None
+                and "_autoscale_target" in prev
+                and spec["config"].autoscaling_config is not None
+            ):
+                # Same code, declarative re-apply: the autoscaler's earned
+                # target survives the replay — `self._apps[app] =
+                # deployments` below would otherwise snap the replica count
+                # back to the spec's min and re-cold-start the surge
+                # capacity (regression: test_serve_autopilot).
+                spec["_autoscale_target"] = prev["_autoscale_target"]
         # Deployments dropped from the app entirely.
         for name in list(old):
             if name != "__meta__" and name not in deployments:
@@ -441,6 +491,31 @@ class ServeController:
             ray_tpu.kill(actor)
         except Exception:
             pass
+
+    async def _notify_retire(self, app: str, name: str, victim):
+        """Scale-down prune hook: before the victim actor dies, the app's
+        ingress router (DPRouter/PDRouter) is told to drop the victim's
+        prefix fingerprints and adapter-residency entries — without this,
+        the router keeps routing cache-affine traffic at a corpse until its
+        dead-replica pruning notices on a later pick. Best-effort and
+        duck-typed: plain apps whose ingress has no `retire_replica` simply
+        skip it."""
+        from ray_tpu.serve._common import async_get
+
+        meta = self._apps.get(app, {}).get("__meta__", {})
+        ingress = meta.get("ingress")
+        if not ingress or ingress == name:
+            return
+        routers = self._replicas.get(app, {}).get(ingress, [])
+        refs = [
+            r.handle_request.remote("retire_replica", (victim._actor_id,), {})
+            for r in routers
+        ]
+        for ref in refs:
+            try:
+                await async_get(ref, timeout=2)
+            except Exception:
+                pass  # no hook on this ingress (or it is mid-restart)
 
     async def _retire(self, actor):
         """Graceful replica retirement (delete/scale-down path): give the
@@ -526,6 +601,16 @@ class ServeController:
     def _target_replicas(self, app: str, name: str) -> int:
         spec = self._apps[app][name]
         cfg = spec["config"]
+        # Autopilot-held targets win for managed deployments: they are the
+        # closed-loop decision, persisted in their own KV record so neither
+        # a controller restart nor a declarative redeploy resets them.
+        if self._autopilot is not None:
+            from ray_tpu._private.config import CONFIG
+
+            if CONFIG.serve_autopilot:
+                target = self._autopilot.target_for(app, name)
+                if target is not None and self._autopilot.manages(app, name):
+                    return target
         if cfg.autoscaling_config is not None:
             return spec.setdefault("_autoscale_target", cfg.autoscaling_config.min_replicas)
         return cfg.num_replicas
@@ -565,6 +650,7 @@ class ServeController:
                 self._bump(app, name)
             while len(replicas) > want:
                 victim = replicas.pop()
+                await self._notify_retire(app, name, victim)
                 await self._retire(victim)
                 self._bump(app, name)
 
@@ -657,9 +743,16 @@ class ServeController:
                 # python/ray/serve/multiplex.py).
                 self._mux_ids[f"{app}#{name}"] = mux_ids
                 cfg = spec["config"]
-                if cfg.autoscaling_config is not None and stats:
+                # The legacy ongoing-requests autoscaler stands down for
+                # autopilot-managed deployments: two laws writing one
+                # target would fight.
+                if cfg.autoscaling_config is not None and stats and not (
+                    self._autopilot is not None
+                    and self._autopilot.manages(app, name)
+                ):
                     self._autoscale(app, name, spec, stats)
             await self._reconcile_app(app)
+        await self._maybe_autopilot()
         await self._reconcile_proxies()
 
     def _autoscale(self, app: str, name: str, spec: dict, stats: List[dict]):
@@ -681,3 +774,186 @@ class ServeController:
             spec["_autoscale_target"] = current - 1  # scale down gently
             self._last_scale[key] = now
             self._state_dirty = True
+
+    # -- SLO autopilot (docs/autoscale.md) ---------------------------------
+    def _ensure_autopilot(self):
+        if self._autopilot is None:
+            from ray_tpu._private.config import CONFIG
+            from ray_tpu.serve.autopilot import Autopilot
+
+            self._autopilot = Autopilot(
+                decision_log_cap=CONFIG.serve_autopilot_decision_log)
+        return self._autopilot
+
+    def _autopilot_bounds(self, spec: dict):
+        """Per-deployment scaling bounds: the deployment's own
+        AutoscalingConfig min/max win when set; the serve_autopilot_* flags
+        are the fleet default. Timing knobs always come from the flags."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.serve.autopilot import ReplicaBounds
+
+        ac = spec["config"].autoscaling_config
+        return ReplicaBounds(
+            min_replicas=(ac.min_replicas if ac is not None
+                          else CONFIG.serve_autopilot_min_replicas),
+            max_replicas=(ac.max_replicas if ac is not None
+                          else CONFIG.serve_autopilot_max_replicas),
+            burn_high=CONFIG.serve_autopilot_burn_high,
+            queue_high=CONFIG.serve_autopilot_queue_high,
+            sustain_ticks=CONFIG.serve_autopilot_sustain_ticks,
+            upscale_cooldown_s=CONFIG.serve_autopilot_upscale_cooldown_s,
+            downscale_cooldown_s=CONFIG.serve_autopilot_downscale_cooldown_s,
+            cold_start_guard_s=CONFIG.serve_autopilot_cold_start_guard_s,
+        )
+
+    async def _autopilot_observe(self) -> list:
+        """Probe every replica's `autopilot_signals()` (duck-typed opt-in:
+        deployments whose replicas answer become autopilot-managed) and
+        fold the answers into per-deployment observations."""
+        from ray_tpu.serve._common import async_get
+        from ray_tpu.serve.autopilot import aggregate_signals
+
+        probes = []
+        for app, deps in list(self._apps.items()):
+            for name, spec in list(deps.items()):
+                if name == "__meta__":
+                    continue
+                replicas = self._replicas.get(app, {}).get(name, [])
+                if not replicas:
+                    continue
+                refs = [
+                    r.handle_request.remote("autopilot_signals", (), {})
+                    for r in replicas
+                ]
+                probes.append((app, name, spec, len(replicas), refs))
+        out = []
+        for app, name, spec, n, refs in probes:
+            results = await asyncio.gather(
+                *(async_get(ref, timeout=5) for ref in refs),
+                return_exceptions=True)
+            signals = [r for r in results if isinstance(r, dict)]
+            if not signals:
+                continue  # no replica opted in: not autopilot-managed
+            obs = aggregate_signals(app, name, signals)
+            obs.replicas = n  # count starting replicas too, not just responders
+            obs.bounds = self._autopilot_bounds(spec)
+            out.append(obs)
+        return out
+
+    async def _maybe_autopilot(self):
+        from ray_tpu._private.config import CONFIG
+
+        if not CONFIG.serve_autopilot:
+            return
+        now = time.time()
+        if now - self._autopilot_last < CONFIG.serve_autopilot_interval_s:
+            return
+        self._autopilot_last = now
+        from ray_tpu.serve.autopilot import (
+            ScaleAction,
+            WeightBounds,
+        )
+
+        ap = self._ensure_autopilot()
+        observations = await self._autopilot_observe()
+        weight_bounds = WeightBounds(
+            step=CONFIG.serve_autopilot_weight_step,
+            floor=CONFIG.serve_autopilot_weight_floor,
+            ceiling=CONFIG.serve_autopilot_weight_max,
+            deadband=CONFIG.serve_autopilot_weight_deadband,
+            sustain_ticks=CONFIG.serve_autopilot_sustain_ticks,
+            cooldown_s=CONFIG.serve_autopilot_upscale_cooldown_s,
+        )
+        actions = ap.tick(
+            observations, weight_bounds,
+            pd_ratio_tol=CONFIG.serve_autopilot_pd_ratio_tol, now=now)
+        for action in actions:
+            if isinstance(action, ScaleAction):
+                op = ap.begin_scale_op(action)
+                await self._apply_scale_op(op, action.app)
+            else:
+                await self._broadcast_weight(action)
+        if ap.dirty:
+            await self._persist_autopilot()
+
+    async def _apply_scale_op(self, op, app: str) -> bool:
+        """Actuate one replica-count change under its two-phase token: the
+        reconcile either lands (commit) or the autopilot's target rolls
+        back to what the cluster actually has (abort) — a failed scale-up
+        must not persist a phantom target that respawns forever."""
+        try:
+            await self._reconcile_app(app)
+            await self._persist_registry()
+        except Exception:
+            traceback.print_exc()
+            op.abort()
+            return False
+        op.commit()
+        return True
+
+    async def _broadcast_weight(self, action) -> None:
+        """Push one tenant's adapted WFQ weight to every managed replica of
+        the app (the engine forwards to its scheduler's weighted-fair
+        queues; DPRouter fans out to DP ranks)."""
+        from ray_tpu.serve._common import async_get
+
+        refs = []
+        for name in list(self._apps.get(action.app, {})):
+            if name == "__meta__":
+                continue
+            if not (self._autopilot is not None
+                    and self._autopilot.manages(action.app, name)):
+                continue
+            for r in self._replicas.get(action.app, {}).get(name, []):
+                refs.append(r.handle_request.remote(
+                    "set_tenant_weight", (action.tenant, action.weight), {}))
+        applied = 0
+        for ref in refs:
+            try:
+                await async_get(ref, timeout=5)
+                applied += 1
+            except Exception:
+                pass  # replica died or lacks the hook: next tick re-nudges
+        action.decision["outcome"] = (
+            f"applied:{applied}/{len(refs)}" if refs else "no_replicas")
+
+    async def autopilot_wake(self, app: str, deployment: str) -> bool:
+        """Scale-to-zero cold start: a deployment handle found zero
+        replicas for an existing deployment. Bypasses pressure hysteresis
+        (the requester is already waiting) and arms the cold-start guard so
+        the fresh replica is not retired straight back to zero."""
+        from ray_tpu._private.config import CONFIG
+
+        await self._ensure_recovered()
+        if not CONFIG.serve_autopilot:
+            return False
+        spec = self._apps.get(app, {}).get(deployment)
+        if spec is None or deployment == "__meta__":
+            return False
+        key = f"{app}#{deployment}"
+        now = time.monotonic()
+        # A fleet of handles stampeding the same cold deployment collapses
+        # to one wake per second.
+        if now - self._autopilot_wake_ts.get(key, -1e9) < 1.0:
+            return False
+        self._autopilot_wake_ts[key] = now
+        ap = self._ensure_autopilot()
+        action = ap.wake(app, deployment, self._autopilot_bounds(spec))
+        if action is None:
+            return False
+        op = ap.begin_scale_op(action)
+        ok = await self._apply_scale_op(op, app)
+        await self._persist_autopilot()
+        return ok
+
+    async def autopilot_stats(self) -> dict:
+        """Report surface for serve_stats()/`ray_tpu status`: the decision
+        log, autopilot-held targets, and adapted tenant weights. This is
+        also where the autopilot's own metrics flush (report path)."""
+        from ray_tpu._private.config import CONFIG
+
+        await self._ensure_recovered()
+        out = {"enabled": bool(CONFIG.serve_autopilot)}
+        if self._autopilot is not None:
+            out.update(self._autopilot.stats())
+        return out

@@ -1,0 +1,57 @@
+"""SLO autopilot: the closed-loop control plane for the serve stack.
+
+PR 13 built the measurement plane (per-tenant TTFT/TPOT, llm_slo_good/
+breach counters, llm_slo_burn_rate, the request flight recorder); this
+package closes the loop. A periodic task inside the (HA, KV-persisted)
+ServeController evaluates pure control laws over those signals and drives
+three actuators (docs/autoscale.md):
+
+1. replica autoscaling — sustained burn-rate/queue pressure spawns DP
+   replicas (mmap warm-start + DPRouter prefix-fingerprint bootstrap so
+   they join warm); sustained idleness drains and retires them through
+   `prepare_shutdown`, down to zero with a cold-start wake guard;
+2. adaptive WFQ — per-tenant weights nudge toward per-tenant SLO
+   attainment with bounded steps, a burn-rate deadband, and an absolute
+   floor no tenant sinks below, broadcast via `set_tenant_weight`;
+3. P:D rebalancing — the prefill:decode replica split shifts when TTFT
+   pressure diverges from TPOT pressure.
+
+Everything the loop decides lands in a bounded DecisionLog surfaced by
+`serve_stats()` and `ray_tpu status`; law state (targets, cooldown clocks,
+weights) persists to GCS KV so a restarted controller resumes mid-loop
+without flapping. Off by default — enable with RAY_TPU_SERVE_AUTOPILOT=1.
+"""
+
+from ray_tpu.serve.autopilot._core import (
+    Autopilot,
+    ScaleAction,
+    ScaleOp,
+    WeightAction,
+)
+from ray_tpu.serve.autopilot._laws import (
+    DeploymentObservation,
+    ReplicaBounds,
+    WeightBounds,
+    aggregate_signals,
+    pd_law,
+    replica_law,
+    wake_law,
+    weight_law,
+)
+from ray_tpu.serve.autopilot._log import DecisionLog
+
+__all__ = [
+    "Autopilot",
+    "DecisionLog",
+    "DeploymentObservation",
+    "ReplicaBounds",
+    "ScaleAction",
+    "ScaleOp",
+    "WeightAction",
+    "WeightBounds",
+    "aggregate_signals",
+    "pd_law",
+    "replica_law",
+    "wake_law",
+    "weight_law",
+]

@@ -1,0 +1,345 @@
+"""Autopilot core: law state, decision bookkeeping, scale-op tokens.
+
+The Autopilot object lives inside the ServeController and is driven by
+`_maybe_autopilot()` each control-loop tick. The split of responsibilities:
+
+- the CONTROLLER observes (probes replicas' `autopilot_signals()`), applies
+  actions (reconcile, `set_tenant_weight` broadcasts), and persists the
+  autopilot blob to GCS KV under AUTOPILOT_KEY;
+- the AUTOPILOT holds the law state (targets, tick counters, cooldown
+  clocks, tenant weights), evaluates the pure laws in `_laws.py`, and
+  records every firing in the bounded DecisionLog.
+
+Law evaluation runs under a distsan hot-path tag: `tick()` must not touch
+metrics or the GCS — plain ints/dicts only. All metric flushes happen in
+`stats()` (a report path, and a distlint RL901 roster name).
+
+Every replica-count change is wrapped in a ScaleOp token (leaksan-tracked,
+leaklint RL801 row): the controller commits it after the reconcile lands or
+aborts it — restoring the previous target — when actuation fails, so a
+failed scale-up cannot leave a phantom target that respawns replicas
+forever.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu.devtools import distsan, leaksan
+from ray_tpu.serve.autopilot._laws import (
+    DeploymentObservation,
+    ReplicaBounds,
+    WeightBounds,
+    new_pd_state,
+    new_replica_state,
+    new_weight_state,
+    pd_law,
+    replica_law,
+    wake_law,
+    weight_law,
+)
+from ray_tpu.serve.autopilot._log import DecisionLog
+
+
+@dataclass
+class ScaleAction:
+    app: str
+    deployment: str
+    target: int
+    rule: str
+    decision: dict
+
+
+@dataclass
+class WeightAction:
+    app: str
+    tenant: str
+    weight: float
+    rule: str
+    decision: dict
+
+
+class ScaleOp:
+    """Two-phase token for one replica-count change. `commit()` after the
+    reconcile landed; `abort()` rolls the law target back to what it was so
+    a failed actuation does not persist a target the cluster never reached.
+    Exactly one of the two must be called (leaksan kind
+    ``autopilot_scale_op``; leaklint RL801 enforces the pairing statically).
+    """
+
+    def __init__(self, autopilot: "Autopilot", key: str, prev_target: int,
+                 decision: dict):
+        self._ap = autopilot
+        self._key = key
+        self._prev = prev_target
+        self._decision = decision
+        self._done = False
+        self._token = f"{key}:{decision.get('seq', 0)}"
+        leaksan.track("autopilot_scale_op", token=self._token)
+
+    def commit(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._decision["outcome"] = "applied"
+        self._ap._dirty = True
+        leaksan.untrack("autopilot_scale_op", token=self._token)
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._decision["outcome"] = "aborted"
+        st = self._ap._deps.get(self._key)
+        if st is not None:
+            st["target"] = self._prev
+        self._ap._dirty = True
+        leaksan.untrack("autopilot_scale_op", token=self._token)
+
+
+class Autopilot:
+    """Closed-loop controller state machine (docs/autoscale.md)."""
+
+    def __init__(self, *, decision_log_cap: int = 256):
+        # "app#dep" -> replica-law state dict
+        self._deps: Dict[str, dict] = {}
+        # app -> tenant -> weight-law state dict
+        self._tenants: Dict[str, Dict[str, dict]] = {}
+        # app -> pd-law state dict
+        self._pd: Dict[str, dict] = {}
+        self._log = DecisionLog(decision_log_cap)
+        # Deployments that have EVER answered an autopilot_signals probe:
+        # the controller's legacy ongoing-requests autoscaler stands down
+        # for these (two laws writing one target would fight). Sticky by
+        # design — a deployment at scale-to-zero has no replicas to answer
+        # the probe, yet must stay managed or the declarative spec would
+        # respawn what the idle law just retired.
+        self._managed: set = set()
+        self._dirty = False
+        # Metric-flush watermarks (stats() flushes deltas only).
+        self._flushed_counts: Dict[str, int] = {}
+
+    # -- persistence -------------------------------------------------------
+    def dump(self) -> dict:
+        return {
+            "deps": {k: dict(v) for k, v in self._deps.items()},
+            "tenants": {
+                app: {t: dict(s) for t, s in tenants.items()}
+                for app, tenants in self._tenants.items()
+            },
+            "pd": {k: dict(v) for k, v in self._pd.items()},
+            "managed": sorted(self._managed),
+            "log": self._log.dump(),
+        }
+
+    @classmethod
+    def load(cls, blob: dict, *, decision_log_cap: int = 256) -> "Autopilot":
+        ap = cls(decision_log_cap=decision_log_cap)
+        ap._deps = {k: dict(v) for k, v in (blob.get("deps") or {}).items()}
+        ap._tenants = {
+            app: {t: dict(s) for t, s in tenants.items()}
+            for app, tenants in (blob.get("tenants") or {}).items()
+        }
+        ap._pd = {k: dict(v) for k, v in (blob.get("pd") or {}).items()}
+        ap._managed = set(blob.get("managed") or ())
+        ap._log = DecisionLog.load(blob.get("log") or {}, decision_log_cap)
+        return ap
+
+    @property
+    def dirty(self) -> bool:
+        return self._dirty
+
+    def mark_clean(self) -> None:
+        self._dirty = False
+
+    # -- controller-facing surface -----------------------------------------
+    def manages(self, app: str, deployment: str) -> bool:
+        return f"{app}#{deployment}" in self._managed
+
+    def target_for(self, app: str, deployment: str) -> Optional[int]:
+        st = self._deps.get(f"{app}#{deployment}")
+        return None if st is None else int(st["target"])
+
+    def tenant_weight(self, app: str, tenant: str) -> Optional[float]:
+        st = self._tenants.get(app, {}).get(tenant)
+        return None if st is None else float(st["weight"])
+
+    def begin_scale_op(self, action: ScaleAction) -> ScaleOp:
+        key = f"{action.app}#{action.deployment}"
+        prev = int(action.decision.get("signals", {}).get("from",
+                                                          action.target))
+        return ScaleOp(self, key, prev, action.decision)
+
+    def wake(self, app: str, deployment: str,
+             bounds: ReplicaBounds) -> Optional[ScaleAction]:
+        """Scale-to-zero cold start: called (via the controller) when a
+        routed request found zero replicas."""
+        now = time.time()
+        key = f"{app}#{deployment}"
+        self._managed.add(key)
+        st = self._deps.setdefault(key, new_replica_state(0))
+        fired = wake_law(state=st, bounds=bounds, now=now)
+        if fired is None:
+            return None
+        target, rule, detail = fired
+        self._dirty = True
+        decision = self._log.append(
+            rule=rule, app=app, deployment=deployment, signals=detail,
+            action=f"target={target}", t=now)
+        return ScaleAction(app, deployment, target, rule, decision)
+
+    # -- the control law tick ----------------------------------------------
+    def tick(self, observations: List[DeploymentObservation],
+             weight_bounds: WeightBounds, *, pd_ratio_tol: float = 2.0,
+             now: Optional[float] = None,
+             ) -> List[object]:
+        """Evaluate every law over one tick's observations. Pure state-math
+        under a distsan hot-path tag — actuation (reconcile, weight
+        broadcasts, KV persists) is the controller's job, driven by the
+        returned ScaleAction/WeightAction list."""
+        now = time.time() if now is None else now
+        actions: List[object] = []
+        with distsan.hot_path("serve-autopilot-tick"):
+            self._managed.update(
+                f"{o.app}#{o.deployment}" for o in observations
+            )
+            by_app: Dict[str, List[DeploymentObservation]] = {}
+            for obs in observations:
+                by_app.setdefault(obs.app, []).append(obs)
+
+            for obs in observations:
+                if obs.role != "engine":
+                    continue
+                key = f"{obs.app}#{obs.deployment}"
+                bounds = obs.bounds or ReplicaBounds()
+                st = self._deps.get(key)
+                if st is None:
+                    st = self._deps[key] = new_replica_state(
+                        max(bounds.min_replicas, obs.replicas))
+                fired = replica_law(
+                    state=st, replicas=obs.replicas, queued=obs.queued,
+                    ongoing=obs.ongoing, burn=obs.burn, bounds=bounds,
+                    now=now)
+                self._dirty = True  # tick counters moved
+                if fired is None:
+                    continue
+                target, rule, detail = fired
+                decision = self._log.append(
+                    rule=rule, app=obs.app, deployment=obs.deployment,
+                    signals=detail, action=f"target={target}", t=now)
+                actions.append(ScaleAction(obs.app, obs.deployment, target,
+                                           rule, decision))
+
+            for app, app_obs in by_app.items():
+                actions.extend(self._tick_weights(
+                    app, app_obs, weight_bounds, now))
+                actions.extend(self._tick_pd(
+                    app, app_obs, weight_bounds, pd_ratio_tol, now))
+        return actions
+
+    def _tick_weights(self, app: str, app_obs: List[DeploymentObservation],
+                      bounds: WeightBounds, now: float) -> List[WeightAction]:
+        tenant_burn: Dict[str, float] = {}
+        for obs in app_obs:
+            for tenant, burn in obs.tenant_burn.items():
+                tenant_burn[tenant] = max(tenant_burn.get(tenant, 0.0), burn)
+        actions: List[WeightAction] = []
+        tenants = self._tenants.setdefault(app, {})
+        for tenant, burn in sorted(tenant_burn.items()):
+            st = tenants.setdefault(tenant, new_weight_state())
+            fired = weight_law(state=st, burn=burn, bounds=bounds, now=now)
+            if fired is None:
+                continue
+            weight, rule, detail = fired
+            self._dirty = True
+            decision = self._log.append(
+                rule=rule, app=app, tenant=tenant, signals=detail,
+                action=f"weight={weight:.3f}", t=now)
+            actions.append(WeightAction(app, tenant, weight, rule, decision))
+        return actions
+
+    def _tick_pd(self, app: str, app_obs: List[DeploymentObservation],
+                 weight_bounds: WeightBounds, ratio_tol: float,
+                 now: float) -> List[ScaleAction]:
+        prefill = next((o for o in app_obs if o.role == "prefill"), None)
+        decode = next((o for o in app_obs if o.role == "decode"), None)
+        if prefill is None or decode is None:
+            return []
+        ttft_p = max(o.ttft_pressure for o in app_obs)
+        tpot_p = max(o.tpot_pressure for o in app_obs)
+        st = self._pd.setdefault(app, new_pd_state())
+        p_target = self.target_for(app, prefill.deployment)
+        d_target = self.target_for(app, decode.deployment)
+        p_now = p_target if p_target is not None else prefill.replicas
+        d_now = d_target if d_target is not None else decode.replicas
+        fired = pd_law(
+            state=st, ttft_pressure=ttft_p, tpot_pressure=tpot_p,
+            prefill_replicas=p_now, decode_replicas=d_now,
+            ratio_tol=ratio_tol, sustain_ticks=weight_bounds.sustain_ticks,
+            cooldown_s=weight_bounds.cooldown_s, now=now)
+        if fired is None:
+            return []
+        new_p, new_d, rule, detail = fired
+        self._dirty = True
+        actions: List[ScaleAction] = []
+        for dep, old, new in ((prefill.deployment, p_now, new_p),
+                              (decode.deployment, d_now, new_d)):
+            key = f"{app}#{dep}"
+            st_dep = self._deps.setdefault(key, new_replica_state(old))
+            st_dep["target"] = new
+            sig = dict(detail)
+            sig["from"] = old
+            decision = self._log.append(
+                rule=rule, app=app, deployment=dep, signals=sig,
+                action=f"target={new}", t=now)
+            actions.append(ScaleAction(app, dep, new, rule, decision))
+        return actions
+
+    # -- report path ---------------------------------------------------------
+    def stats(self) -> dict:
+        """REPORT path (distlint RL901 roster name): the only place
+        autopilot metrics flush. Decision counts flush as deltas against a
+        watermark; targets and weights export as gauges."""
+        with distsan.report_path("autopilot-stats"):
+            try:
+                from ray_tpu.util.metrics import Counter, Gauge
+
+                decisions = Counter(
+                    "serve_autopilot_decisions_total",
+                    "autopilot control-law firings", tag_keys=("rule",))
+                for rule, count in self._log.counts.items():
+                    delta = count - self._flushed_counts.get(rule, 0)
+                    if delta:
+                        decisions.inc(float(delta), tags={"rule": rule})
+                        self._flushed_counts[rule] = count
+                target_g = Gauge(
+                    "serve_autopilot_target",
+                    "autopilot-held replica target",
+                    tag_keys=("app", "deployment"))
+                for key, st in self._deps.items():
+                    app, _, dep = key.partition("#")
+                    target_g.set(float(st["target"]),
+                                 tags={"app": app, "deployment": dep})
+                weight_g = Gauge(
+                    "serve_autopilot_tenant_weight",
+                    "autopilot-adapted WFQ tenant weight",
+                    tag_keys=("app", "tenant"))
+                for app, tenants in self._tenants.items():
+                    for tenant, st in tenants.items():
+                        weight_g.set(float(st["weight"]),
+                                     tags={"app": app, "tenant": tenant})
+            except Exception:
+                pass  # metrics must never break the report surface
+            return {
+                "targets": {k: int(v["target"])
+                            for k, v in sorted(self._deps.items())},
+                "weights": {
+                    app: {t: round(float(s["weight"]), 4)
+                          for t, s in sorted(tenants.items())}
+                    for app, tenants in sorted(self._tenants.items())
+                },
+                "counts": dict(self._log.counts),
+                "decisions": self._log.entries(16),
+            }

@@ -1,0 +1,272 @@
+"""Autopilot control laws: pure functions from signals + state to actions.
+
+Each law is a pure function of (aggregated signals, mutable per-key state
+dict, bounds, now) — no clocks, no RPC, no metrics — so the laws unit-test
+with a fake clock and run inside the controller under a distsan hot-path
+tag without ever touching the control plane. The caller (Autopilot.tick)
+owns persistence of the state dicts and actuation of the returned actions.
+
+The control-law table (signal → condition → action → cooldown) is
+documented in docs/autoscale.md and must stay in sync with this module.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ReplicaBounds:
+    """Per-deployment scaling bounds + timing knobs, resolved once per tick
+    from the deployment's AutoscalingConfig (when set) or the
+    serve_autopilot_* flags."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    burn_high: float = 1.0
+    queue_high: float = 8.0
+    sustain_ticks: int = 2
+    upscale_cooldown_s: float = 5.0
+    downscale_cooldown_s: float = 30.0
+    cold_start_guard_s: float = 60.0
+
+
+@dataclass(frozen=True)
+class WeightBounds:
+    step: float = 0.25
+    floor: float = 0.25
+    ceiling: float = 8.0
+    deadband: float = 0.25
+    sustain_ticks: int = 2
+    cooldown_s: float = 5.0
+
+
+def new_replica_state(target: int) -> dict:
+    """Fresh per-deployment law state. Wall-clock timestamps (time.time)
+    so persisted cooldowns survive a controller restart."""
+    return {
+        "target": int(target),
+        "hot_ticks": 0,
+        "idle_ticks": 0,
+        "last_up_t": 0.0,
+        "last_down_t": 0.0,
+        "woken_t": 0.0,
+    }
+
+
+def replica_law(
+    *,
+    state: dict,
+    replicas: int,
+    queued: float,
+    ongoing: float,
+    burn: float,
+    bounds: ReplicaBounds,
+    now: float,
+) -> Optional[Tuple[int, str, dict]]:
+    """Replica-count law. Mutates `state` tick counters; returns
+    (new_target, rule, detail) when an action fires, else None.
+
+    Up: burn-rate or per-replica queue pressure sustained for
+    `sustain_ticks`, after the upscale cooldown. The step is proportional
+    to queue overload (a 3x rate step should not climb one replica per
+    cooldown) but always bounded by max_replicas.
+    Down: zero queue, zero in-flight, and burn comfortably inside budget
+    sustained for 2x `sustain_ticks`, after the (long) downscale cooldown —
+    one replica at a time, and down to zero only outside the cold-start
+    guard window.
+    """
+    target = state["target"]
+    per_replica_q = queued / max(1, replicas)
+    hot = burn >= bounds.burn_high or per_replica_q >= bounds.queue_high
+    idle = burn < 0.5 * bounds.burn_high and queued <= 0 and ongoing <= 0
+    state["hot_ticks"] = state["hot_ticks"] + 1 if hot else 0
+    state["idle_ticks"] = state["idle_ticks"] + 1 if idle else 0
+
+    if (
+        hot
+        and target < bounds.max_replicas
+        and state["hot_ticks"] >= bounds.sustain_ticks
+        and now - state["last_up_t"] >= bounds.upscale_cooldown_s
+    ):
+        # Queue-proportional step: enough replicas that the CURRENT queue
+        # would sit at ~queue_high per replica, at least +1.
+        step = max(1, math.ceil(queued / max(bounds.queue_high, 1.0)) - target)
+        new = min(bounds.max_replicas, target + step)
+        state["target"] = new
+        state["last_up_t"] = now
+        state["hot_ticks"] = 0
+        return new, "replica_up", {
+            "burn": round(burn, 3), "queued": queued,
+            "per_replica_queue": round(per_replica_q, 2), "from": target,
+        }
+
+    floor = bounds.min_replicas
+    if floor == 0 and now - state["woken_t"] < bounds.cold_start_guard_s:
+        floor = max(floor, 1)  # cold-start guard: no re-zero right after a wake
+    if (
+        idle
+        and target > floor
+        and state["idle_ticks"] >= 2 * bounds.sustain_ticks
+        and now - state["last_down_t"] >= bounds.downscale_cooldown_s
+    ):
+        new = target - 1
+        state["target"] = new
+        state["last_down_t"] = now
+        state["idle_ticks"] = 0
+        return new, "replica_down", {
+            "burn": round(burn, 3), "queued": queued, "from": target,
+        }
+    return None
+
+
+def wake_law(*, state: dict, bounds: ReplicaBounds, now: float,
+             ) -> Optional[Tuple[int, str, dict]]:
+    """Scale-to-zero wake: a routed request found ZERO replicas. Bypasses
+    pressure hysteresis and cooldowns by design — the requester is already
+    waiting — and arms the cold-start guard so the idle law cannot retire
+    the fresh replica straight back to zero."""
+    if state["target"] >= 1:
+        return None
+    state["target"] = 1
+    state["woken_t"] = now
+    state["idle_ticks"] = 0
+    return 1, "cold_start_wake", {"from": 0}
+
+
+def new_weight_state(weight: float = 1.0) -> dict:
+    return {"weight": float(weight), "hot_ticks": 0, "cool_ticks": 0,
+            "last_t": 0.0}
+
+
+def weight_law(
+    *,
+    state: dict,
+    burn: float,
+    bounds: WeightBounds,
+    now: float,
+) -> Optional[Tuple[float, str, dict]]:
+    """Adaptive-WFQ law for ONE tenant. Nudges the tenant's weight toward
+    SLO attainment with a bounded multiplicative step and a burn-rate
+    deadband; boosted weights decay back toward 1.0 once the tenant is
+    healthy again. The floor/ceiling bounds are absolute — no decision can
+    starve a tenant below `floor`."""
+    w = state["weight"]
+    breaching = burn >= 1.0 + bounds.deadband
+    healthy = burn <= 1.0 - bounds.deadband
+    state["hot_ticks"] = state["hot_ticks"] + 1 if breaching else 0
+    state["cool_ticks"] = state["cool_ticks"] + 1 if healthy else 0
+    if now - state["last_t"] < bounds.cooldown_s:
+        return None
+    if breaching and state["hot_ticks"] >= bounds.sustain_ticks:
+        new = min(bounds.ceiling, max(bounds.floor, w * (1.0 + bounds.step)))
+        if new != w:
+            state["weight"] = new
+            state["last_t"] = now
+            state["hot_ticks"] = 0
+            return new, "weight_up", {"burn": round(burn, 3),
+                                      "from": round(w, 4)}
+        return None
+    if (
+        healthy
+        and w > 1.0
+        and state["cool_ticks"] >= 2 * bounds.sustain_ticks
+    ):
+        new = max(1.0, max(bounds.floor, w / (1.0 + bounds.step)))
+        state["weight"] = new
+        state["last_t"] = now
+        state["cool_ticks"] = 0
+        return new, "weight_decay", {"burn": round(burn, 3),
+                                     "from": round(w, 4)}
+    return None
+
+
+def new_pd_state() -> dict:
+    return {"hot_ticks": 0, "last_t": 0.0}
+
+
+def pd_law(
+    *,
+    state: dict,
+    ttft_pressure: float,
+    tpot_pressure: float,
+    prefill_replicas: int,
+    decode_replicas: int,
+    ratio_tol: float,
+    sustain_ticks: int,
+    cooldown_s: float,
+    now: float,
+) -> Optional[Tuple[int, int, str, dict]]:
+    """P:D rebalance law. Pressures are dimensionless (observed latency /
+    its SLO component, so 1.0 = at budget). When one side's pressure
+    exceeds the other's by `ratio_tol` for `sustain_ticks`, one replica
+    shifts toward the pressured phase — total replica count is conserved,
+    and neither pool drops below one."""
+    if ttft_pressure <= 0 and tpot_pressure <= 0:
+        state["hot_ticks"] = 0
+        return None
+    eps = 1e-9
+    ratio = (ttft_pressure + eps) / (tpot_pressure + eps)
+    toward_prefill = ratio >= ratio_tol and decode_replicas > 1
+    toward_decode = ratio <= 1.0 / ratio_tol and prefill_replicas > 1
+    if not (toward_prefill or toward_decode):
+        state["hot_ticks"] = 0
+        return None
+    state["hot_ticks"] += 1
+    if state["hot_ticks"] < sustain_ticks or now - state["last_t"] < cooldown_s:
+        return None
+    state["hot_ticks"] = 0
+    state["last_t"] = now
+    detail = {"ttft_pressure": round(ttft_pressure, 3),
+              "tpot_pressure": round(tpot_pressure, 3),
+              "ratio": round(ratio, 3)}
+    if toward_prefill:
+        return (prefill_replicas + 1, decode_replicas - 1,
+                "pd_shift_prefill", detail)
+    return (prefill_replicas - 1, decode_replicas + 1,
+            "pd_shift_decode", detail)
+
+
+@dataclass
+class DeploymentObservation:
+    """One deployment's aggregated signal vector for a tick, built by the
+    controller from per-replica `autopilot_signals()` probes."""
+
+    app: str
+    deployment: str
+    replicas: int = 0
+    role: str = "engine"  # engine | prefill | decode | router | pd_router
+    queued: float = 0.0
+    ongoing: float = 0.0
+    burn: float = 0.0
+    tenant_burn: Dict[str, float] = field(default_factory=dict)
+    ttft_pressure: float = 0.0
+    tpot_pressure: float = 0.0
+    bounds: Optional[ReplicaBounds] = None
+
+
+def aggregate_signals(app: str, deployment: str,
+                      signals: List[dict]) -> DeploymentObservation:
+    """Fold per-replica signal dicts into one DeploymentObservation.
+    Queue depths sum (total backlog); burn rates take the max across
+    replicas (worst replica exhausts the budget first); per-tenant burn
+    takes the per-tenant max for the same reason."""
+    obs = DeploymentObservation(app=app, deployment=deployment,
+                                replicas=len(signals))
+    for sig in signals:
+        if not isinstance(sig, dict):
+            continue
+        obs.role = str(sig.get("role", obs.role))
+        obs.queued += float(sig.get("queued", 0) or 0)
+        obs.ongoing += float(sig.get("running", 0) or 0)
+        obs.burn = max(obs.burn, float(sig.get("burn_rate", 0.0) or 0.0))
+        for tenant, burn in (sig.get("tenant_burn") or {}).items():
+            obs.tenant_burn[tenant] = max(
+                obs.tenant_burn.get(tenant, 0.0), float(burn))
+        obs.ttft_pressure = max(
+            obs.ttft_pressure, float(sig.get("ttft_pressure", 0.0) or 0.0))
+        obs.tpot_pressure = max(
+            obs.tpot_pressure, float(sig.get("tpot_pressure", 0.0) or 0.0))
+    return obs

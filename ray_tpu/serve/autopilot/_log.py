@@ -1,0 +1,64 @@
+"""Bounded autopilot decision log.
+
+Every control-law firing is recorded — rule, the signal values it saw, the
+action taken, and the actuation outcome — in a bounded ring surfaced
+through `serve_stats()["autopilot"]` and `ray_tpu status`. Appends are
+plain-deque operations (hot-tick safe under distsan); nothing here touches
+metrics or the control plane.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class DecisionLog:
+    def __init__(self, cap: int = 256):
+        self._cap = max(1, int(cap))
+        self._entries: deque = deque(maxlen=self._cap)
+        self._seq = 0
+        # rule -> count, plain ints (flushed to metrics only from stats()).
+        self.counts: Dict[str, int] = {}
+
+    def append(self, *, rule: str, app: str, deployment: str = "",
+               tenant: str = "", signals: Optional[dict] = None,
+               action: str = "", t: float = 0.0) -> dict:
+        self._seq += 1
+        entry = {
+            "seq": self._seq,
+            "t": t,
+            "rule": rule,
+            "app": app,
+            "deployment": deployment,
+            "tenant": tenant,
+            "signals": dict(signals or {}),
+            "action": action,
+            "outcome": "pending",
+        }
+        self._entries.append(entry)
+        self.counts[rule] = self.counts.get(rule, 0) + 1
+        return entry
+
+    def entries(self, n: int = 0) -> List[dict]:
+        out = [dict(e) for e in self._entries]
+        return out[-n:] if n else out
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def dump(self) -> dict:
+        # Persist a short tail only: the log is operator context, not state
+        # the laws depend on — a restarted controller needs recent history
+        # for `ray_tpu status`, not the full ring.
+        return {"seq": self._seq, "counts": dict(self.counts),
+                "entries": self.entries(32)}
+
+    @classmethod
+    def load(cls, blob: dict, cap: int = 256) -> "DecisionLog":
+        log = cls(cap)
+        log._seq = int(blob.get("seq", 0))
+        log.counts = dict(blob.get("counts") or {})
+        for e in blob.get("entries") or []:
+            log._entries.append(dict(e))
+        return log

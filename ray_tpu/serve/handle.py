@@ -177,6 +177,23 @@ class _Router:
         self._mux: Dict[Any, list] = {}  # actor_id -> [model ids]
         self._model_affinity: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        self._last_wake = 0.0
+
+    def _wake(self):
+        """Scale-to-zero cold start (docs/autoscale.md): this routing call
+        found an EXISTING deployment with zero replicas — tell the
+        autopilot a requester is waiting so it can spawn one without
+        waiting out its pressure hysteresis. Fire-and-forget and throttled;
+        a no-autopilot controller just answers False."""
+        now = time.monotonic()
+        if now - self._last_wake < 1.0:
+            return
+        self._last_wake = now
+        try:
+            self._controller().autopilot_wake.remote(  # raylint: disable=RL501 (fire-and-forget wake; pick() retry loop observes the result)
+                self._app, self._deployment)
+        except Exception:
+            pass  # controller unreachable: the retry loop already backs off
 
     def _controller(self):
         # Cached handle: the by-name lookup needs the GCS, but calls on a
@@ -251,6 +268,8 @@ class _Router:
                 raise RuntimeError(
                     f"no replicas for deployment {self._app}#{self._deployment}"
                 )
+            if last_err is None and self._exists:
+                self._wake()
             # Exponential backoff + jitter: a fleet of handles re-resolving a
             # restarted controller must not stampede it.
             time.sleep(delay * (0.5 + random.random()))

@@ -343,6 +343,15 @@ def serve_stats(timeout_s: float = 30.0) -> Dict[str, Any]:
         out["control_plane"] = control_plane_stats()
     except Exception as e:
         out["control_plane"] = {"error": str(e)}
+    try:
+        from ray_tpu.serve import _existing_controller
+
+        controller = _existing_controller()
+        if controller is not None:
+            out["autopilot"] = ray_tpu.get(
+                controller.autopilot_stats.remote(), timeout=timeout_s)
+    except Exception as e:
+        out["autopilot"] = {"error": str(e)}
     return out
 
 

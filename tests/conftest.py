@@ -44,6 +44,7 @@ LEAKSAN_SUITES = {
     "test_llm_tp.py",
     "test_flight_recorder.py",
     "test_xprof.py",
+    "test_autopilot.py",
 }
 
 
@@ -81,6 +82,7 @@ DISTSAN_SUITES = {
     "test_llm_scheduler.py",
     "test_llm_multitenant.py",
     "test_serve_observability.py",
+    "test_autopilot.py",
 }
 
 

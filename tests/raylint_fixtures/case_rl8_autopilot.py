@@ -1,0 +1,51 @@
+"""RL801 fixtures for the autopilot scale-op token (Autopilot.begin_scale_op
+-> ScaleOp.commit/abort), the round-20 RESOURCE_TABLE entry: a dropped token
+leaves the decision "pending" forever and a half-applied replica target that
+the next controller restart replays. Fire/suppress shapes mirror
+case_rl8_xprof.py so the new obligation rides the same path analysis."""
+
+
+def bad_scale_op_never_resolved(autopilot, action):
+    op = autopilot.begin_scale_op(action)
+    return op.token
+
+
+def bad_scale_op_conditional(autopilot, action, ok):
+    op = autopilot.begin_scale_op(action)
+    if ok:
+        op.commit()
+
+
+def bad_scale_op_risky_gap(autopilot, controller, action):
+    op = autopilot.begin_scale_op(action)
+    controller.reconcile(action.app)
+    op.commit()
+
+
+def ok_scale_op_finally(autopilot, controller, action):
+    op = autopilot.begin_scale_op(action)
+    try:
+        return controller.reconcile(action.app)
+    finally:
+        op.commit()
+
+
+def ok_scale_op_abort_finally(autopilot, controller, action):
+    op = autopilot.begin_scale_op(action)
+    try:
+        return controller.reconcile(action.app)
+    finally:
+        op.abort()
+
+
+def ok_scale_op_stored(controller, autopilot, action):
+    controller.pending_op = autopilot.begin_scale_op(action)
+
+
+def ok_scale_op_returned(autopilot, action):
+    return autopilot.begin_scale_op(action)
+
+
+def suppressed_scale_op(autopilot, action):
+    op = autopilot.begin_scale_op(action)  # raylint: disable=RL801 (fixture: resolution rides _apply_scale_op)
+    return op.token

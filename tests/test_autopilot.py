@@ -1,0 +1,453 @@
+"""Unit tests for the SLO autopilot (docs/autoscale.md): pure control laws
+with fake clocks, the Autopilot tick state machine, ScaleOp commit/abort
+bookkeeping, persistence round-trips (restart must not flap), and the
+DPRouter's retire/bootstrap hooks.
+
+This file runs under BOTH conftest sanitizer guards: distsan (the tick is a
+hot path — law math must not touch metrics; metric flushes belong to the
+stats() report path) and leaksan (every ScaleOp token must resolve to
+commit/abort).
+"""
+
+import asyncio
+from collections import OrderedDict, deque
+
+import pytest
+
+from ray_tpu.serve.autopilot import (
+    Autopilot,
+    DecisionLog,
+    DeploymentObservation,
+    ReplicaBounds,
+    ScaleAction,
+    WeightAction,
+    WeightBounds,
+    aggregate_signals,
+    pd_law,
+    replica_law,
+    wake_law,
+    weight_law,
+)
+from ray_tpu.serve.autopilot._laws import (
+    new_pd_state,
+    new_replica_state,
+    new_weight_state,
+)
+
+
+B = ReplicaBounds(min_replicas=1, max_replicas=4, burn_high=1.0,
+                  queue_high=8.0, sustain_ticks=2, upscale_cooldown_s=5.0,
+                  downscale_cooldown_s=30.0, cold_start_guard_s=60.0)
+WB = WeightBounds(step=0.25, floor=0.25, ceiling=8.0, deadband=0.25,
+                  sustain_ticks=2, cooldown_s=5.0)
+
+
+# --- replica law -----------------------------------------------------------
+def test_replica_law_upscale_needs_sustained_pressure():
+    st = new_replica_state(1)
+    assert replica_law(state=st, replicas=1, queued=20, ongoing=2, burn=0.0,
+                       bounds=B, now=100.0) is None  # first hot tick
+    fired = replica_law(state=st, replicas=1, queued=20, ongoing=2, burn=0.0,
+                        bounds=B, now=101.0)
+    assert fired is not None
+    target, rule, detail = fired
+    assert rule == "replica_up"
+    # Queue-proportional step: 20 queued / 8 per-replica-high -> 3 replicas.
+    assert target == 3
+    assert st["target"] == 3 and detail["from"] == 1
+
+
+def test_replica_law_burn_alone_triggers_and_cooldown_blocks():
+    st = new_replica_state(1)
+    for now in (100.0, 101.0):
+        fired = replica_law(state=st, replicas=1, queued=0, ongoing=1,
+                            burn=2.0, bounds=B, now=now)
+    assert fired is not None and fired[0] == 2
+    # Still burning, sustain satisfied again — but inside the 5s cooldown.
+    for now in (102.0, 103.0):
+        assert replica_law(state=st, replicas=2, queued=0, ongoing=1,
+                           burn=2.0, bounds=B, now=now) is None
+    fired = replica_law(state=st, replicas=2, queued=0, ongoing=1, burn=2.0,
+                        bounds=B, now=107.0)
+    assert fired is not None and fired[0] == 3
+
+
+def test_replica_law_capped_at_max():
+    st = new_replica_state(4)
+    for now in (0.0, 1.0, 2.0):
+        assert replica_law(state=st, replicas=4, queued=500, ongoing=8,
+                           burn=5.0, bounds=B, now=now) is None
+    assert st["target"] == 4
+
+
+def test_replica_law_downscale_sustained_idle():
+    st = new_replica_state(3)
+    st["last_down_t"] = 0.0
+    fired = None
+    for i in range(2 * B.sustain_ticks):
+        fired = replica_law(state=st, replicas=3, queued=0, ongoing=0,
+                            burn=0.0, bounds=B, now=100.0 + i)
+    assert fired is not None
+    assert fired[:2] == (2, "replica_down")
+    # One step at a time: next fire needs the downscale cooldown again.
+    for i in range(2 * B.sustain_ticks):
+        fired = replica_law(state=st, replicas=2, queued=0, ongoing=0,
+                            burn=0.0, bounds=B, now=110.0 + i)
+    assert fired is None
+    assert st["target"] == 2
+
+
+def test_replica_law_scale_to_zero_blocked_by_cold_start_guard():
+    b0 = ReplicaBounds(min_replicas=0, max_replicas=4, sustain_ticks=1,
+                       downscale_cooldown_s=0.0, cold_start_guard_s=60.0)
+    st = new_replica_state(1)
+    st["woken_t"] = 100.0
+    for i in range(4):  # inside the guard window: floor is raised to 1
+        assert replica_law(state=st, replicas=1, queued=0, ongoing=0,
+                           burn=0.0, bounds=b0, now=101.0 + i) is None
+    fired = replica_law(state=st, replicas=1, queued=0, ongoing=0, burn=0.0,
+                        bounds=b0, now=200.0)  # guard expired
+    assert fired is not None and fired[0] == 0
+
+
+def test_wake_law_zero_to_one_and_noop_when_up():
+    b0 = ReplicaBounds(min_replicas=0)
+    st = new_replica_state(0)
+    fired = wake_law(state=st, bounds=b0, now=50.0)
+    assert fired == (1, "cold_start_wake", {"from": 0})
+    assert st["woken_t"] == 50.0
+    assert wake_law(state=st, bounds=b0, now=51.0) is None
+
+
+# --- weight law ------------------------------------------------------------
+def test_weight_law_boost_decay_and_bounds():
+    st = new_weight_state()
+    st["last_t"] = -100.0
+    assert weight_law(state=st, burn=3.0, bounds=WB, now=0.0) is None
+    fired = weight_law(state=st, burn=3.0, bounds=WB, now=1.0)
+    assert fired is not None
+    w, rule, _ = fired
+    assert rule == "weight_up" and w == pytest.approx(1.25)
+    # Healthy again: decays back toward 1.0 after 2x sustain, never below.
+    for i in range(2 * WB.sustain_ticks):
+        fired = weight_law(state=st, burn=0.0, bounds=WB, now=10.0 + i)
+    assert fired is not None and fired[1] == "weight_decay"
+    assert fired[0] == pytest.approx(1.0)
+    # At 1.0 and healthy: no further decay (floor of the decay path).
+    for i in range(4 * WB.sustain_ticks):
+        assert weight_law(state=st, burn=0.0, bounds=WB, now=30.0 + i) is None
+
+
+def test_weight_law_ceiling():
+    st = new_weight_state(8.0)
+    st["last_t"] = -100.0
+    for i in range(4):
+        assert weight_law(state=st, burn=5.0, bounds=WB, now=float(i)) is None
+    assert st["weight"] == 8.0
+
+
+def test_weight_law_deadband_is_quiet():
+    st = new_weight_state()
+    st["last_t"] = -100.0
+    for i in range(6):
+        assert weight_law(state=st, burn=1.0, bounds=WB, now=float(i)) is None
+
+
+# --- pd law ----------------------------------------------------------------
+def test_pd_law_shifts_toward_pressured_phase_conserving_total():
+    st = new_pd_state()
+    kw = dict(ratio_tol=2.0, sustain_ticks=2, cooldown_s=0.0)
+    assert pd_law(state=st, ttft_pressure=3.0, tpot_pressure=0.5,
+                  prefill_replicas=1, decode_replicas=3, now=0.0, **kw) is None
+    fired = pd_law(state=st, ttft_pressure=3.0, tpot_pressure=0.5,
+                   prefill_replicas=1, decode_replicas=3, now=1.0, **kw)
+    assert fired is not None
+    p, d, rule, _ = fired
+    assert (p, d, rule) == (2, 2, "pd_shift_prefill")
+
+    st = new_pd_state()
+    for now in (0.0, 1.0):
+        fired = pd_law(state=st, ttft_pressure=0.2, tpot_pressure=2.0,
+                       prefill_replicas=3, decode_replicas=1, now=now, **kw)
+    assert fired is not None and fired[:3] == (2, 2, "pd_shift_decode")
+
+
+def test_pd_law_never_empties_a_pool():
+    st = new_pd_state()
+    kw = dict(ratio_tol=2.0, sustain_ticks=1, cooldown_s=0.0)
+    assert pd_law(state=st, ttft_pressure=9.0, tpot_pressure=0.1,
+                  prefill_replicas=3, decode_replicas=1, now=0.0, **kw) is None
+    assert pd_law(state=st, ttft_pressure=0.1, tpot_pressure=9.0,
+                  prefill_replicas=1, decode_replicas=3, now=1.0, **kw) is None
+
+
+# --- signal aggregation ----------------------------------------------------
+def test_aggregate_signals_sum_queue_max_burn():
+    obs = aggregate_signals("app", "LLM", [
+        {"role": "engine", "queued": 3, "running": 1, "burn_rate": 0.5,
+         "tenant_burn": {"a": 0.5, "b": 2.0}},
+        {"role": "engine", "queued": 5, "running": 2, "burn_rate": 1.5,
+         "tenant_burn": {"a": 1.0}},
+        "not-a-dict",  # a failed probe must not poison the fold
+    ])
+    assert obs.replicas == 3  # len(signals); controller overrides with live count
+    assert obs.queued == 8 and obs.ongoing == 3
+    assert obs.burn == 1.5
+    assert obs.tenant_burn == {"a": 1.0, "b": 2.0}
+
+
+# --- decision log ----------------------------------------------------------
+def test_decision_log_bounded_and_round_trips():
+    log = DecisionLog(cap=4)
+    for i in range(10):
+        log.append(rule="replica_up", app="a", deployment="d",
+                   action=f"target={i}", t=float(i))
+    assert len(log) == 4
+    assert log.counts == {"replica_up": 10}
+    assert [e["seq"] for e in log.entries()] == [7, 8, 9, 10]
+    loaded = DecisionLog.load(log.dump(), cap=4)
+    assert loaded.counts == {"replica_up": 10}
+    assert [e["seq"] for e in loaded.entries()] == [7, 8, 9, 10]
+    loaded.append(rule="replica_down", app="a")
+    assert loaded.entries()[-1]["seq"] == 11  # seq survives the round trip
+
+
+# --- Autopilot tick --------------------------------------------------------
+def _obs(app="app", dep="LLM", **kw):
+    kw.setdefault("bounds", B)
+    kw.setdefault("replicas", 1)
+    return DeploymentObservation(app=app, deployment=dep, **kw)
+
+
+def test_tick_scale_up_then_down_full_cycle():
+    ap = Autopilot()
+    actions = ap.tick([_obs(queued=20.0, ongoing=2.0)], WB, now=100.0)
+    assert actions == []
+    actions = ap.tick([_obs(queued=20.0, ongoing=2.0)], WB, now=101.0)
+    assert len(actions) == 1 and isinstance(actions[0], ScaleAction)
+    assert actions[0].rule == "replica_up" and actions[0].target == 3
+    assert ap.manages("app", "LLM") and ap.target_for("app", "LLM") == 3
+    # Commit, then drain: sustained idle + downscale cooldown -> step down.
+    ap.begin_scale_op(actions[0]).commit()
+    assert actions[0].decision["outcome"] == "applied"
+    down = []
+    for i in range(8):
+        down += ap.tick([_obs(replicas=3)], WB, now=140.0 + i)
+    assert [a.rule for a in down] == ["replica_down"]
+    assert ap.target_for("app", "LLM") == 2
+
+
+def test_tick_ignores_router_roles():
+    ap = Autopilot()
+    for now in (0.0, 1.0, 2.0):
+        actions = ap.tick(
+            [_obs(dep="Router", role="pd_router", queued=99.0)], WB, now=now)
+        assert actions == []
+    assert ap.target_for("app", "Router") is None
+    assert ap.manages("app", "Router")  # managed (probe answered), not scaled
+
+
+def test_managed_set_is_sticky_across_empty_ticks():
+    ap = Autopilot()
+    ap.tick([_obs()], WB, now=0.0)
+    assert ap.manages("app", "LLM")
+    ap.tick([], WB, now=1.0)  # scale-to-zero: no replicas answer probes
+    assert ap.manages("app", "LLM")
+    ap2 = Autopilot.load(ap.dump())
+    assert ap2.manages("app", "LLM")
+
+
+def test_scale_op_abort_restores_target():
+    ap = Autopilot()
+    ap.tick([_obs(queued=20.0)], WB, now=100.0)
+    action = ap.tick([_obs(queued=20.0)], WB, now=101.0)[0]
+    assert ap.target_for("app", "LLM") == 3
+    op = ap.begin_scale_op(action)
+    op.abort()
+    assert ap.target_for("app", "LLM") == 1
+    assert action.decision["outcome"] == "aborted"
+    op.abort()  # idempotent: double-resolve is a no-op
+    op.commit()
+    assert action.decision["outcome"] == "aborted"
+
+
+def test_dump_load_no_flap():
+    """Restart mid-loop must RESUME, not re-fire: the persisted cooldown
+    clock blocks an immediate duplicate scale-up (ISSUE: 'resumes mid-loop
+    without flapping')."""
+    ap = Autopilot()
+    ap.tick([_obs(queued=20.0)], WB, now=100.0)
+    actions = ap.tick([_obs(queued=20.0)], WB, now=101.0)
+    ap.begin_scale_op(actions[0]).commit()
+    ap2 = Autopilot.load(ap.dump())
+    assert ap2.target_for("app", "LLM") == 3
+    for i in range(3):  # same pressure, inside the persisted cooldown
+        assert ap2.tick([_obs(replicas=3, queued=20.0)], WB,
+                        now=102.0 + i) == []
+
+
+def test_tick_weight_actions_and_stats_surface():
+    ap = Autopilot()
+    burn = {"noisy": 3.0, "quiet": 0.1}
+    actions = []
+    for now in (10.0, 11.0, 12.0):
+        actions += ap.tick([_obs(tenant_burn=burn)], WB, now=now)
+    ups = [a for a in actions if isinstance(a, WeightAction)]
+    assert [a.tenant for a in ups] == ["noisy"]
+    assert ups[0].weight == pytest.approx(1.25)
+    assert ap.tenant_weight("app", "noisy") == pytest.approx(1.25)
+    assert ap.tenant_weight("app", "quiet") == pytest.approx(1.0)
+    st = ap.stats()
+    assert st["weights"]["app"]["noisy"] == pytest.approx(1.25)
+    assert st["counts"].get("weight_up") == 1
+    assert st["decisions"][-1]["rule"] == "weight_up"
+    ap.stats()  # second flush: watermark makes the counter delta zero
+
+
+def test_tick_pd_rebalance_emits_paired_actions():
+    ap = Autopilot()
+    obs = [
+        _obs(dep="Prefill-m", role="prefill", replicas=1),
+        _obs(dep="Decode-m", role="decode", replicas=3),
+        _obs(dep="PDRouter-m", role="pd_router", ttft_pressure=3.0,
+             tpot_pressure=0.5),
+    ]
+    wb = WeightBounds(sustain_ticks=2, cooldown_s=0.0)
+    assert ap.tick(obs, wb, now=0.0) == []
+    actions = ap.tick(obs, wb, now=1.0)
+    assert {(a.deployment, a.target) for a in actions} == {
+        ("Prefill-m", 2), ("Decode-m", 2)}
+    assert ap.target_for("app", "Prefill-m") == 2
+    assert ap.target_for("app", "Decode-m") == 2
+
+
+def test_wake_arms_cold_start_guard():
+    ap = Autopilot()
+    b0 = ReplicaBounds(min_replicas=0, max_replicas=4, sustain_ticks=1,
+                       downscale_cooldown_s=0.0, cold_start_guard_s=60.0)
+    action = ap.wake("app", "LLM", b0)
+    assert action is not None and action.rule == "cold_start_wake"
+    assert ap.target_for("app", "LLM") == 1 and ap.manages("app", "LLM")
+    ap.begin_scale_op(action).commit()
+    assert ap.wake("app", "LLM", b0) is None  # already >= 1
+    # The fresh replica is idle but inside the guard: no re-zero.
+    t0 = action.decision["t"]
+    for i in range(6):
+        assert ap.tick([_obs(replicas=1, bounds=b0)], WB, now=t0 + 1 + i) == []
+    assert ap.target_for("app", "LLM") == 1
+
+
+# --- DPRouter autopilot hooks ---------------------------------------------
+class _FakeId:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class _FakeReplica:
+    def __init__(self, h):
+        self._actor_id = _FakeId(h)
+
+
+def _make_dp_router(replicas):
+    """A DPRouter over stub handles — no cluster, no tokenizer."""
+    from ray_tpu.llm.dp_serve import DPRouter
+
+    class _FakeRouter:
+        def replicas(self_inner):
+            return replicas
+
+    class _FakeMethod:
+        def _get_router(self_inner):
+            return _FakeRouter()
+
+    class _FakeServer:
+        generate = _FakeMethod()
+
+    return DPRouter(_FakeServer(), assigner=None)
+
+
+def test_dp_router_retire_replica_prunes_tables():
+    r1, r2 = _FakeReplica("aa"), _FakeReplica("bb")
+    dpr = _make_dp_router([r1, r2])
+    dpr._fingerprints[r1._actor_id] = OrderedDict([(1, None), (2, None)])
+    dpr._fingerprints[r2._actor_id] = OrderedDict([(3, None)])
+    dpr._adapter_res[r1._actor_id] = OrderedDict([("lora-a", None)])
+    dpr._bootstrapped = {r1._actor_id, r2._actor_id}
+    pruned = asyncio.run(dpr.retire_replica(r1._actor_id))
+    assert pruned == {"fingerprints": 2, "adapters": 1}
+    assert r1._actor_id not in dpr._fingerprints
+    assert r1._actor_id not in dpr._adapter_res
+    assert dpr._bootstrapped == {r2._actor_id}
+    assert r2._actor_id in dpr._fingerprints  # survivor untouched
+    assert dpr._routing["retired_pruned"] == 1
+    # The controller ships the id through pickling — hex-string ids work too.
+    dpr._fingerprints[r2._actor_id] = OrderedDict([(3, None)])
+    asyncio.run(dpr.retire_replica("bb"))
+    assert r2._actor_id not in dpr._fingerprints
+
+
+def test_dp_router_hot_prefix_lru_and_bootstrap():
+    holder, fresh = _FakeReplica("aa"), _FakeReplica("bb")
+    dpr = _make_dp_router([holder, fresh])
+    block = dpr._block
+    toks = list(range(block * 2))
+    chain = dpr._chain(toks)
+    assert chain
+    for _ in range(3):
+        dpr._note_hot_prefix(chain, toks, "lora-a")
+    assert dpr._hot_prefixes[tuple(chain)]["hits"] == 3
+    # LRU bound holds.
+    for i in range(dpr.HOT_PREFIX_CAP + 5):
+        dpr._note_hot_prefix([10_000 + i], [i] * block, "")
+    assert len(dpr._hot_prefixes) == dpr.HOT_PREFIX_CAP
+
+    dpr = _make_dp_router([holder, fresh])
+    dpr._note_hot_prefix(chain, toks, "lora-a")
+    dpr._record(holder._actor_id, chain, "lora-a")
+    fetches = []
+
+    async def fake_fetch(src, dst, token_ids, adapter):
+        fetches.append((src._actor_id.hex(), dst._actor_id.hex(), adapter))
+        return True
+
+    dpr._remote_fetch = fake_fetch
+    dpr._remote_fetch_enabled = lambda: True
+    fetched = asyncio.run(dpr.bootstrap_replica(fresh))
+    assert fetched == 1
+    assert fetches == [("aa", "bb", "lora-a")]
+    # The fresh replica's fingerprints now claim the prefix: cache-affine
+    # routing can target it immediately.
+    assert dpr._match_len(fresh._actor_id, chain) == len(chain)
+    assert dpr._routing["bootstrap_fetched"] == 1
+
+
+def test_dp_router_bootstrap_disabled_without_remote_fetch():
+    holder, fresh = _FakeReplica("aa"), _FakeReplica("bb")
+    dpr = _make_dp_router([holder, fresh])
+    dpr._note_hot_prefix([1], [0] * dpr._block, "")
+    dpr._remote_fetch_enabled = lambda: False
+    assert asyncio.run(dpr.bootstrap_replica(fresh)) == 0
+
+
+# --- PDRouter pressure samples ---------------------------------------------
+def test_pd_router_pressure_samples():
+    from ray_tpu.llm.pd_disagg import PDRouter
+
+    pdr = PDRouter.__new__(PDRouter)
+    pdr._slo_ttft_s = 0.5
+    pdr._slo_tpot_s = 0.1
+    pdr._ttft_samples = deque(maxlen=128)
+    pdr._tpot_samples = deque(maxlen=128)
+    sig = asyncio.run(pdr.autopilot_signals())
+    assert sig["role"] == "pd_router" and sig["samples"] == 0
+    assert sig["ttft_pressure"] == 0.0
+    # prefill 1.0s against a 0.5s TTFT SLO -> pressure 2.0;
+    # (1.5 - 1.0)s over 10 tokens against a 0.1s TPOT SLO -> pressure 0.5.
+    pdr._note_pd_sample(1.0, 1.5, 10)
+    sig = asyncio.run(pdr.autopilot_signals())
+    assert sig["ttft_pressure"] == pytest.approx(2.0)
+    assert sig["tpot_pressure"] == pytest.approx(0.5)
+    assert sig["samples"] == 1

@@ -961,3 +961,298 @@ def test_single_candidate_gcs_mode_unchanged(monkeypatch):
     finally:
         cluster.shutdown()
         CONFIG._reset()
+
+
+# ---------------------------------------------------------- autopilot chaos
+
+# The autopilot flag + timing knobs must reach the controller process (CONFIG
+# reads env per process): tiny hysteresis so pressure resolves in test time.
+_AUTOPILOT_ENV = {
+    **_NODE_ENV,
+    "RAY_TPU_SERVE_AUTOPILOT": "1",
+    "RAY_TPU_SERVE_AUTOPILOT_INTERVAL_S": "0.1",
+    "RAY_TPU_SERVE_AUTOPILOT_SUSTAIN_TICKS": "2",
+    "RAY_TPU_SERVE_AUTOPILOT_UPSCALE_COOLDOWN_S": "0.2",
+    "RAY_TPU_SERVE_AUTOPILOT_DOWNSCALE_COOLDOWN_S": "0.5",
+    "RAY_TPU_SERVE_AUTOPILOT_COLD_START_GUARD_S": "1.0",
+    "RAY_TPU_SERVE_AUTOPILOT_QUEUE_HIGH": "8",
+}
+
+
+def _wait_until(pred, timeout_s=60.0, interval_s=0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval_s)
+    return None
+
+
+def _serve_replica_count(app, deployment):
+    from ray_tpu import serve
+
+    try:
+        st = serve.status()
+    except Exception:
+        return -1
+    return (st.get(app, {}).get("deployments", {})
+            .get(deployment, {}).get("num_replicas", 0))
+
+
+def test_autopilot_scaleup_rides_through_gcs_kill():
+    """SIGKILL the GCS in the middle of an autopilot scale-up: the scale-op
+    either completes once the GCS returns or rolls back cleanly — and no
+    replica PROCESS is orphaned (every pid the deployment ever started is
+    either in the final registered replica set or dead)."""
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4,
+                                      "env_vars": _AUTOPILOT_ENV})
+    try:
+        cluster.connect()
+
+        @ray_tpu.remote
+        class Box:
+            def __init__(self):
+                self._sig = {"queued": 0, "running": 1, "burn_rate": 0.0}
+                self._pids = []
+
+            def set_pressure(self, **kw):
+                self._sig.update(kw)
+
+            def signals(self):
+                return dict(self._sig)
+
+            def note_pid(self, pid):
+                self._pids.append(pid)
+
+            def pids(self):
+                return list(self._pids)
+
+        box = Box.remote()
+
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 1e9,
+        })
+        class Engine:
+            def __init__(self, b):
+                self._box = b
+                ray_tpu.get(b.note_pid.remote(os.getpid()))
+
+            def pid(self):
+                return os.getpid()
+
+            def autopilot_signals(self):
+                sig = ray_tpu.get(self._box.signals.remote())
+                sig["role"] = "engine"
+                return sig
+
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(Engine.bind(box), name="ap-gcs", route_prefix=None)
+        assert handle.remote(1).result(timeout_s=60) == 1
+
+        # Hot pressure, then kill the GCS right as the sustain window (2
+        # ticks at 0.25s loop interval) is about to fire the scale-up.
+        ray_tpu.get(box.set_pressure.remote(queued=30, burn_rate=3.0))
+        time.sleep(0.4)
+        cluster.head.kill_gcs()
+        time.sleep(3.0)
+        cluster.head.restart_gcs()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if [n for n in ray_tpu.nodes() if n["alive"]]:
+                    break
+            except Exception:
+                time.sleep(0.5)
+
+        # Pressure is still hot: the scale-up must COMPLETE once the control
+        # plane is back (a rolled-back op re-fires on a later tick).
+        assert _wait_until(
+            lambda: _serve_replica_count("ap-gcs", "Engine") >= 2,
+            timeout_s=90), "scale-up never completed after GCS recovery"
+        ray_tpu.get(box.set_pressure.remote(queued=0, running=1,
+                                            burn_rate=0.0))
+        time.sleep(1.0)
+
+        # No orphans: every pid this deployment ever started is either a
+        # currently-registered replica or a dead process.
+        pid_handle = serve.DeploymentHandle("ap-gcs", "Engine", "pid")
+        registered = set(pid_handle.broadcast())
+        started = set(ray_tpu.get(box.pids.remote()))
+        orphans = []
+        for pid in started - registered:
+            try:
+                os.kill(pid, 0)
+                orphans.append(pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+        assert not orphans, f"orphan replica processes: {orphans}"
+        # Registered count agrees with the serve status view (consistency:
+        # the op committed; no half-applied target left behind).
+        assert len(registered) == _serve_replica_count("ap-gcs", "Engine")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def test_autopilot_absorbs_poisson_rate_step_surge():
+    """3x Poisson rate step against a single-slot engine: the SLO burn rate
+    (measured by the replicas themselves) must trigger an autopilot
+    scale-up, and goodput (fraction of requests under the 0.5s SLO) must
+    recover within the deadline after the fleet widens."""
+    import asyncio
+    from collections import deque as _deque
+
+    from ray_tpu import serve
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6, num_tpus=0, worker_env=_AUTOPILOT_ENV)
+    try:
+
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 1e9,
+        })
+        class SurgeEngine:
+            """One request slot per replica (0.04s service time); queue wait
+            shows up as latency, latency breaches show up as burn."""
+
+            def __init__(self):
+                self._sem = asyncio.Semaphore(1)
+                self._waiting = 0
+                self._lat = _deque(maxlen=64)
+
+            def autopilot_signals(self):
+                lat = list(self._lat)
+                # Burn = breach fraction / error budget (SLO 0.2s in-replica,
+                # 1% budget): one sustained breach saturates the signal.
+                breaches = sum(1 for x in lat if x > 0.2)
+                burn = (breaches / len(lat)) / 0.01 if lat else 0.0
+                return {"role": "engine", "queued": self._waiting,
+                        "running": 1, "burn_rate": burn}
+
+            async def __call__(self, _x):
+                t0 = time.monotonic()
+                self._waiting += 1
+                async with self._sem:
+                    self._waiting -= 1
+                    await asyncio.sleep(0.04)
+                self._lat.append(time.monotonic() - t0)
+                return 0
+
+        handle = serve.run(SurgeEngine.bind(), name="ap-surge",
+                           route_prefix=None)
+        rng = random.Random(7)
+        lock = threading.Lock()
+        done = []  # (t_completed, latency_s)
+        halt = threading.Event()
+
+        def fire():
+            t0 = time.monotonic()
+            try:
+                handle.remote(0).result(timeout_s=60)
+                with lock:
+                    done.append((time.monotonic(), time.monotonic() - t0))
+            except Exception:
+                with lock:
+                    done.append((time.monotonic(), float("inf")))
+
+        def traffic(rate_fn):
+            while not halt.is_set():
+                threading.Thread(target=fire, daemon=True).start()
+                time.sleep(rng.expovariate(rate_fn()))
+
+        # Warm phase at 10 rps (utilization 0.4 on one slot), step to 30 rps.
+        t_start = time.monotonic()
+        step_at = t_start + 2.0
+
+        def rate():
+            return 10.0 if time.monotonic() < step_at else 30.0
+
+        t = threading.Thread(target=traffic, args=(rate,), daemon=True)
+        t.start()
+        try:
+            assert _wait_until(
+                lambda: _serve_replica_count("ap-surge", "SurgeEngine") >= 2,
+                timeout_s=45), "burn rate never triggered a scale-up"
+            t_scaled = time.monotonic()
+
+            def goodput_recovered():
+                with lock:
+                    recent = [lat for (ts, lat) in done
+                              if ts > time.monotonic() - 2.0]
+                return (len(recent) >= 20
+                        and sum(1 for x in recent if x < 0.5) / len(recent)
+                        >= 0.7)
+
+            assert _wait_until(goodput_recovered, timeout_s=45), \
+                "goodput did not recover after the scale-up"
+            assert t_scaled - step_at < 45.0
+        finally:
+            halt.set()
+            t.join(timeout=10)
+        time.sleep(0.5)  # let in-flight fire() threads drain
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def test_autopilot_scale_to_zero_and_first_request_cold_start():
+    """min_replicas=0 round trip: the deployment drains to ZERO replicas
+    when idle, the first request wakes it (handle -> controller wake path),
+    completes, and the cold-start guard keeps the fresh replica alive long
+    enough to serve before the idle law may retire it again."""
+    from ray_tpu import serve
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0, worker_env=_AUTOPILOT_ENV)
+    try:
+
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 0, "max_replicas": 2,
+            "target_ongoing_requests": 1e9,
+        })
+        class ColdEngine:
+            def autopilot_signals(self):
+                return {"role": "engine", "queued": 0, "running": 0,
+                        "burn_rate": 0.0}
+
+            def __call__(self, x):
+                return x * 2
+
+        handle = serve.run(ColdEngine.bind(), name="ap-cold",
+                           route_prefix=None)
+        assert _serve_replica_count("ap-cold", "ColdEngine") == 0
+
+        # First request: wake -> spawn -> serve, inside the routing deadline.
+        assert handle.remote(21).result(timeout_s=90) == 42
+        assert _serve_replica_count("ap-cold", "ColdEngine") == 1
+
+        # Idle past the cold-start guard (1s) + sustain + cooldown: back to 0.
+        assert _wait_until(
+            lambda: _serve_replica_count("ap-cold", "ColdEngine") == 0,
+            timeout_s=90) is not None, "idle deployment never drained to zero"
+
+        # And it wakes AGAIN: scale-to-zero is a cycle, not a one-way door.
+        assert handle.remote(4).result(timeout_s=90) == 8
+        assert _serve_replica_count("ap-cold", "ColdEngine") >= 1
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()

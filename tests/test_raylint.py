@@ -267,6 +267,22 @@ def test_rl801_profiler_capture_fires_and_suppresses():
         assert sym not in found, (sym, found.get(sym))
 
 
+def test_rl801_autopilot_scale_op_table_row():
+    """Round 20: the autopilot scale-op token (Autopilot.begin_scale_op ->
+    ScaleOp.commit/abort) flows through the same RL801 path analysis: a
+    dropped token leaves its decision "pending" forever and a half-applied
+    replica target for the next controller restart to replay
+    (docs/autoscale.md)."""
+    found = _codes_by_symbol(_fixture("case_rl8_autopilot.py"))
+    for sym in ("bad_scale_op_never_resolved", "bad_scale_op_conditional",
+                "bad_scale_op_risky_gap"):
+        assert found.get(sym) == {"RL801"}, (sym, found.get(sym))
+    for sym in ("ok_scale_op_finally", "ok_scale_op_abort_finally",
+                "ok_scale_op_stored", "ok_scale_op_returned",
+                "suppressed_scale_op"):
+        assert sym not in found, (sym, found.get(sym))
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}

@@ -1,0 +1,256 @@
+"""SLO autopilot integration (docs/autoscale.md): the closed control loop
+running inside the real ServeController — burn-rate scale-up, idle
+drain-down, adaptive WFQ weight broadcasts with the starvation floor, the
+satellite regression that autoscale targets survive a controller SIGKILL
+(KV-persisted, not snapped back to the declarative spec), and the legacy
+autoscaler's target surviving an identical redeploy.
+
+Deployments opt in by answering `autopilot_signals()`; the FakeEngine here
+reads its pressure from a shared box actor so tests can turn SLO burn and
+queue depth up and down like a dial — no model, no real traffic needed.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from tests.conftest import _WORKER_ENV
+
+# The autopilot flag + timing knobs must reach the CONTROLLER process (and
+# every replica): CONFIG reads env per process. Tiny intervals/cooldowns so
+# sustained-pressure hysteresis resolves in test time, with a long enough
+# downscale cooldown that up and down phases don't interleave.
+_AP_ENV = {
+    **_WORKER_ENV,
+    "RAY_TPU_SERVE_AUTOPILOT": "1",
+    "RAY_TPU_SERVE_AUTOPILOT_INTERVAL_S": "0.1",
+    "RAY_TPU_SERVE_AUTOPILOT_SUSTAIN_TICKS": "2",
+    "RAY_TPU_SERVE_AUTOPILOT_UPSCALE_COOLDOWN_S": "0.2",
+    "RAY_TPU_SERVE_AUTOPILOT_DOWNSCALE_COOLDOWN_S": "0.5",
+    "RAY_TPU_SERVE_AUTOPILOT_COLD_START_GUARD_S": "1.0",
+    "RAY_TPU_SERVE_AUTOPILOT_QUEUE_HIGH": "8",
+}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=6, num_tpus=0, worker_env=_AP_ENV)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_apps():
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+@ray_tpu.remote
+class PressureBox:
+    """Shared signal dial: replicas read their reported pressure here and
+    record the weight broadcasts they receive."""
+
+    def __init__(self):
+        self._sig = {"queued": 0, "running": 1, "burn_rate": 0.0,
+                     "tenant_burn": {}}
+        self._weights = {}
+
+    def set_pressure(self, **kw):
+        self._sig.update(kw)
+
+    def signals(self):
+        return dict(self._sig)
+
+    def note_weight(self, tenant, weight):
+        self._weights.setdefault(tenant, []).append(weight)
+
+    def weights(self):
+        return dict(self._weights)
+
+
+def _fake_engine(box):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        # Make the legacy ongoing-requests law inert so any scaling observed
+        # is the autopilot's (the controller also stands the legacy law down
+        # for managed deployments — that standdown is under test here).
+        "target_ongoing_requests": 1e9,
+    })
+    class Engine:
+        def __init__(self, pressure_box):
+            self._box = pressure_box
+
+        def pid(self):
+            return os.getpid()
+
+        def autopilot_signals(self):
+            sig = ray_tpu.get(self._box.signals.remote())
+            sig["role"] = "engine"
+            return sig
+
+        def set_tenant_weight(self, tenant, weight):
+            ray_tpu.get(self._box.note_weight.remote(tenant, weight))
+            return weight
+
+        def __call__(self, x):
+            return x
+
+    return Engine.bind(box)
+
+
+def _replica_count(app, deployment):
+    st = serve.status()
+    return (st.get(app, {}).get("deployments", {})
+            .get(deployment, {}).get("num_replicas", 0))
+
+
+def _wait_for(pred, timeout_s=60.0, interval_s=0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval_s)
+    return None
+
+
+def _controller():
+    from ray_tpu.serve._common import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def test_autopilot_scales_up_on_burn_and_back_down():
+    box = PressureBox.remote()
+    handle = serve.run(_fake_engine(box), name="ap-scale", route_prefix=None)
+    assert handle.remote(1).result(timeout_s=60) == 1
+    assert _replica_count("ap-scale", "Engine") == 1
+
+    # Sustained burn + queue pressure: the autopilot must scale up.
+    ray_tpu.get(box.set_pressure.remote(queued=30, burn_rate=3.0))
+    assert _wait_for(
+        lambda: _replica_count("ap-scale", "Engine") >= 2), \
+        "autopilot never scaled up under sustained burn"
+
+    # Pressure gone AND idle (no queued, no in-flight): drain back down.
+    ray_tpu.get(box.set_pressure.remote(queued=0, running=0, burn_rate=0.0))
+    assert _wait_for(
+        lambda: _replica_count("ap-scale", "Engine") == 1, timeout_s=90), \
+        "autopilot never drained idle replicas back down"
+
+    # Every decision is on the record, with its actuation outcome.
+    stats = ray_tpu.get(_controller().autopilot_stats.remote(), timeout=30)
+    assert stats["enabled"]
+    rules = {d["rule"] for d in stats["decisions"]}
+    assert "replica_up" in rules and "replica_down" in rules
+    applied = [d for d in stats["decisions"] if d["outcome"] == "applied"]
+    assert applied, f"no decision recorded as applied: {stats['decisions']}"
+    assert stats["targets"].get("ap-scale#Engine") == 1
+
+    # The one-call operator snapshot surfaces the same plane.
+    from ray_tpu.util.state import serve_stats
+
+    snap = serve_stats(timeout_s=30)
+    assert snap["autopilot"]["enabled"]
+    assert "ap-scale#Engine" in snap["autopilot"]["targets"]
+
+
+def test_autopilot_weight_broadcast_respects_floor():
+    box = PressureBox.remote()
+    serve.run(_fake_engine(box), name="ap-weights", route_prefix=None)
+
+    # One tenant burns its SLO budget 3x over; one is comfortably inside.
+    ray_tpu.get(box.set_pressure.remote(
+        tenant_burn={"noisy": 3.0, "quiet": 0.1}))
+
+    def noisy_boosted():
+        w = ray_tpu.get(box.weights.remote())
+        return [x for x in w.get("noisy", []) if x > 1.0]
+
+    boosts = _wait_for(noisy_boosted)
+    assert boosts, "breaching tenant's weight was never raised"
+
+    weights = ray_tpu.get(box.weights.remote())
+    from ray_tpu._private.config import CONFIG
+
+    # No broadcast may push ANY tenant below the starvation floor, and the
+    # compliant tenant is never demoted below its initial fair share.
+    for tenant, history in weights.items():
+        for w in history:
+            assert w >= CONFIG.serve_autopilot_weight_floor
+    assert all(w >= 1.0 for w in weights.get("quiet", []))
+
+    stats = ray_tpu.get(_controller().autopilot_stats.remote(), timeout=30)
+    assert stats["weights"]["ap-weights"]["noisy"] > 1.0
+
+
+def test_autopilot_target_survives_controller_sigkill():
+    """Satellite regression: kill the controller mid-scale-up — the
+    autopilot-held target is KV-persisted in its own record, so the new
+    incarnation must keep the scaled-up replica count instead of snapping
+    back to the declarative spec's one replica."""
+    box = PressureBox.remote()
+    serve.run(_fake_engine(box), name="ap-restart", route_prefix=None)
+    ray_tpu.get(box.set_pressure.remote(queued=30, burn_rate=3.0))
+    assert _wait_for(lambda: _replica_count("ap-restart", "Engine") >= 2), \
+        "no scale-up before the kill"
+    scaled = _replica_count("ap-restart", "Engine")
+
+    # Hold pressure NEUTRAL (not hot, not idle: in-flight work pins it) so
+    # any replica-count change after the restart is a recovery bug, not a law
+    # firing.
+    ray_tpu.get(box.set_pressure.remote(queued=0, running=1, burn_rate=0.0))
+
+    controller = _controller()
+    old_pid = ray_tpu.get(controller.health.remote(), timeout=30)["pid"]
+    os.kill(old_pid, signal.SIGKILL)
+    assert _wait_for(
+        lambda: _probe_pid(controller) not in (None, old_pid),
+        timeout_s=90), "controller never restarted"
+
+    # The recovered controller reconciles from the PERSISTED autopilot
+    # target: the replica count must hold for several control-loop ticks.
+    time.sleep(2.0)
+    assert _replica_count("ap-restart", "Engine") == scaled, \
+        "controller restart snapped the autopilot target back to the spec"
+    stats = ray_tpu.get(_controller().autopilot_stats.remote(), timeout=30)
+    assert stats["targets"].get("ap-restart#Engine") == scaled
+
+
+def _probe_pid(controller):
+    try:
+        return ray_tpu.get(controller.health.remote(), timeout=10)["pid"]
+    except Exception:
+        return None
+
+
+def test_legacy_autoscale_target_survives_identical_redeploy():
+    """Satellite regression for the non-autopilot path: a replayed deploy of
+    the identical app must ADOPT the current autoscale target from the
+    previous spec, not reset the replica count to min_replicas."""
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.2,
+    })
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind(), name="ap-legacy", route_prefix=None)
+    responses = [handle.remote(i) for i in range(12)]
+    assert _wait_for(lambda: _replica_count("ap-legacy", "Slow") >= 2,
+                     timeout_s=30), "legacy autoscaler never scaled up"
+    scaled = _replica_count("ap-legacy", "Slow")
+    assert sorted(r.result(timeout_s=60) for r in responses) == list(range(12))
+
+    serve.run(Slow.bind(), name="ap-legacy", route_prefix=None)
+    assert _replica_count("ap-legacy", "Slow") == scaled, \
+        "identical redeploy reset the autoscale target to min_replicas"
