@@ -18,16 +18,12 @@ _ENV_PREFIX = "RAY_TPU_"
 _DEFS: dict[str, tuple[type, Any, str]] = {
     # --- core runtime ---
     "max_direct_call_object_size": (int, 100 * 1024, "objects <= this many bytes are returned inline through the owner's memory store instead of the shared-memory store"),
-    "task_retry_delay_ms": (int, 100, "delay before retrying a failed task"),
     "max_task_retries_default": (int, 3, "default max_retries for remote functions"),
     "max_object_reconstructions": (int, 3, "how many times a lost plasma object may be rebuilt by re-running its producing task (0 disables lineage reconstruction)"),
     "max_lineage_entries": (int, 10000, "max owned objects whose producing task spec is retained for reconstruction; oldest entries are evicted first"),
-    "max_actor_restarts_default": (int, 0, "default max_restarts for actors"),
     "worker_register_timeout_s": (float, 60.0, "how long the raylet waits for a spawned worker to register (covers slow interpreter+jax imports on loaded hosts)"),
-    "worker_pool_prestart": (int, 0, "number of workers to prestart per node"),
     "idle_worker_kill_s": (float, 300.0, "kill idle workers after this many seconds"),
     "get_poll_interval_s": (float, 0.002, "poll interval for blocking gets"),
-    "rpc_connect_timeout_s": (float, 10.0, "TCP connect timeout for internal RPC"),
     "heartbeat_interval_s": (float, 1.0, "raylet -> GCS resource/health report interval"),
     "node_death_timeout_s": (float, 5.0, "GCS marks a node dead after missing heartbeats for this long"),
     "object_store_memory_fraction": (float, 0.3, "fraction of system memory for the per-node shared-memory object store"),
@@ -36,16 +32,12 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "pull_chunk_window": (int, 8, "pipelined in-flight chunk requests per remote object pull"),
     "pull_budget_bytes": (int, 1 << 30, "cap on total bytes of concurrently in-flight remote pulls (backpressure)"),
     "object_store_min_chunk_bytes": (int, 1024 * 1024, "chunk size for node-to-node object transfer"),
-    "memory_store_max_inline_refs": (int, 10000, "max unresolved inline futures per worker"),
-    "actor_queue_warn_size": (int, 5000, "warn when an actor's pending call queue exceeds this"),
     # --- memory / OOM defense ---
     "memory_monitor_refresh_ms": (int, 250, "node memory poll interval for the OOM monitor; 0 disables worker killing (reference: memory_monitor_refresh_ms)"),
     "memory_usage_threshold": (float, 0.95, "kill workers when node memory usage crosses this fraction (reference: memory_usage_threshold)"),
     "memory_monitor_min_wait_s": (float, 1.0, "usage must stay above threshold this long before a kill (debounce against transient spikes)"),
     "meminfo_path": (str, "/proc/meminfo", "meminfo source; tests point this at a fake file to simulate pressure"),
     # --- scheduling ---
-    "scheduler_spread_threshold": (float, 0.5, "hybrid policy: prefer local node until its utilization crosses this threshold, then spread"),
-    "lease_timeout_s": (float, 30.0, "worker lease validity"),
     "lease_worker_slots": (int, 32, "tasks the owner pipelines ahead per leased worker (execution stays sequential at the worker); deep pipelines coalesce submit bursts into few large frames"),
     "lease_pipeline_min_depth": (int, 2, "starting per-worker pipeline depth for the lease fast path; lease denials ramp it toward lease_worker_slots"),
     "borrow_audit_interval_s": (float, 30.0, "how often owners audit registered borrowers for liveness (crashed borrowers are reconciled)"),
@@ -53,7 +45,6 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "borrow_audit_min_age_s": (float, 2.0, "minimum wall-clock age of a not-held entry before reconciliation (protects slow in-flight handoffs)"),
     "test_delay_borrow_report_ms": (int, 0, "fault injection: delay legacy borrow-report notifies by this long (stress the sequenced protocol)"),
     # --- logging / observability ---
-    "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
     "event_buffer_size": (int, 10000, "per-worker task event buffer entries"),
     "metrics_report_interval_s": (float, 5.0, "metrics push interval"),
     "gcs_max_task_events": (int, 100000, "task events retained by the GCS before the oldest half is dropped (reference: task_events_max_num_task_in_gcs)"),
@@ -83,12 +74,10 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "dag_execute_timeout_s": (float, 60.0, "compiled-DAG submission/read timeout"),
     "client_proxy_node_cache_s": (float, 5.0, "client proxy's cache TTL for the cluster's registered-endpoint allowlist"),
     # --- train / libraries ---
-    "train_health_check_interval_s": (float, 1.0, "train controller worker poll interval"),
     "train_ckpt_async": (bool, True, "sharded checkpoints persist on a background writer thread; the step loop pays only one batched device->host snapshot per save (0 = write+commit inline, docs/checkpoint.md)"),
     "train_ckpt_inflight": (int, 2, "bounded in-flight async checkpoint saves per process; a save past the budget backpressures the step loop instead of growing host memory with unpersisted snapshots"),
     "train_ckpt_commit_timeout_s": (float, 120.0, "how long the committing rank waits for every process's shard spec before abandoning the commit (the directory stays manifest-less, i.e. garbage)"),
     "train_flight_records": (int, 64, "per-step flight records kept in each train worker's recorder ring (docs/observability.md): data-wait/step-compute/report-blocked/checkpoint-blocked phase attribution per report(), exported only from train_stats()/Result (0 disables)"),
-    "serve_long_poll_timeout_s": (float, 30.0, "serve long-poll timeout"),
     "serve_http_port": (int, 8000, "default HTTP port each node's serve proxy binds (reference: serve DEFAULT_HTTP_PORT)"),
     "serve_handle_max_retries": (int, 3, "deployment-handle resubmissions after replica death before the call fails"),
     "serve_control_loop_interval_s": (float, 0.25, "serve controller reconcile interval"),
@@ -139,6 +128,21 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
 }
 
 
+_MISSING = object()
+
+
+def _unknown_flag_message(name: str) -> str:
+    """KeyError text for a flag absent from _DEFS, with a did-you-mean
+    suggestion so a typo'd read points straight at the intended flag
+    instead of silently running on a default (raylint RL1004 catches the
+    static cases; this is the runtime complement)."""
+    import difflib
+
+    close = difflib.get_close_matches(name, list(_DEFS), n=1)
+    hint = f" — did you mean {close[0]!r}?" if close else ""
+    return f"unknown config flag {name!r}{hint}"
+
+
 class _Config:
     """Singleton flag table with env overrides (RAY_TPU_<NAME>=value)."""
 
@@ -147,12 +151,14 @@ class _Config:
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
+            # Dunder/underscore probes (hasattr, copy, pickle protocols)
+            # must keep raising AttributeError, never KeyError.
             raise AttributeError(name)
         cache = self.__dict__["_cache"]
         if name in cache:
             return cache[name]
         if name not in _DEFS:
-            raise AttributeError(f"unknown config {name!r}")
+            raise KeyError(_unknown_flag_message(name))
         typ, default, _doc = _DEFS[name]
         raw = os.environ.get(_ENV_PREFIX + name.upper())
         if raw is None:
@@ -165,6 +171,16 @@ class _Config:
             value = typ(raw)
         cache[name] = value
         return value
+
+    def get(self, name: str, default: Any = _MISSING):
+        """Dynamic read with the same typo defense as attribute access:
+        unknown flags raise KeyError with a did-you-mean suggestion unless
+        an explicit default is supplied."""
+        if name in _DEFS:
+            return getattr(self, name)
+        if default is not _MISSING:
+            return default
+        raise KeyError(_unknown_flag_message(name))
 
     def _reset(self):
         self.__dict__["_cache"] = {}

@@ -535,7 +535,10 @@ class GcsService:
 
     # ---------------- object directory ----------------
 
-    async def rpc_report_object(self, conn, object_id: ObjectID, node_id: NodeID, size, owner):
+    async def _report_object(self, conn, object_id: ObjectID, node_id: NodeID, size, owner):
+        # Not an rpc_ verb: raylets batch directory traffic through
+        # rpc_object_ops_batch; exposing this directly would be dead API
+        # surface (raylint RL1006).
         entry = self.object_dir.setdefault(
             object_id, {"size": size, "owner": owner, "locations": set()}
         )
@@ -551,9 +554,9 @@ class GcsService:
         for op in ops:
             if op[0] == "report":
                 _, object_id, node_id, size, owner = op
-                await self.rpc_report_object(conn, object_id, node_id, size, owner)
+                await self._report_object(conn, object_id, node_id, size, owner)
             else:
-                await self.rpc_free_object(conn, op[1])
+                await self._free_object(conn, op[1])
 
     async def rpc_object_locations(self, conn, object_id: ObjectID):
         entry = self.object_dir.get(object_id)
@@ -566,7 +569,9 @@ class GcsService:
                 locs.append({"node_id": nid, "address": node.address})
         return {"size": entry["size"], "owner": entry["owner"], "locations": locs}
 
-    async def rpc_free_object(self, conn, object_id: ObjectID):
+    async def _free_object(self, conn, object_id: ObjectID):
+        # Not an rpc_ verb: reachable only through rpc_object_ops_batch (see
+        # _report_object above).
         entry = self.object_dir.pop(object_id, None)
         if entry is None:
             return False

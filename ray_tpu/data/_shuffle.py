@@ -127,7 +127,7 @@ def random_shuffle(bundles: List[RefBundle], seed: Optional[int], n_out: Optiona
 
         selects = [_select_remote.remote(i, m) for m in map_refs]
         reduce_out.append(
-            _reduce_remote.options(num_returns=2).remote(postprocess, *selects)
+            _reduce_remote.options(num_returns=2).remote(postprocess, *selects)  # raylint: disable=RL1005 (shipping the UDF closure IS the data-plane contract; captures are per-task by construction)
         )
     for blocks_ref, meta_ref in reduce_out:
         rows, nbytes = ray_tpu.get(meta_ref)
@@ -315,8 +315,8 @@ def hash_join(left: List[RefBundle], right: List[RefBundle], on: List[str],
         ]) if block.num_rows else np.zeros(0, np.int64)
         return [acc.take_rows(np.nonzero(hashes == i)[0]) for i in range(n_out)]
 
-    left_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in left]
-    right_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in right]
+    left_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in left]  # raylint: disable=RL1005 (shipping the UDF closure IS the data-plane contract; part_fn's captures are read-only)
+    right_maps = [_partition_remote.remote(part_fn, n_out, b.block_ref) for b in right]  # raylint: disable=RL1005 (same shipped hash-partition UDF)
     lschema = ray_tpu.get(_schema_remote.remote(left[0].block_ref))
     rschema = ray_tpu.get(_schema_remote.remote(right[0].block_ref))
     out: List[RefBundle] = []

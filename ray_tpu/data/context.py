@@ -9,26 +9,30 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
-def _flag(name):
+def _block_target_bytes() -> int:
+    # Direct attribute reads (not getattr-with-a-string) keep these flags
+    # visible to raylint's RL1004 dead/unknown-flag analysis.
     from ray_tpu._private.config import CONFIG
 
-    return getattr(CONFIG, name)  # typo'd keys fail loudly
+    return CONFIG.data_block_target_bytes
+
+
+def _output_queue_size() -> int:
+    from ray_tpu._private.config import CONFIG
+
+    return CONFIG.data_output_queue_size
 
 
 @dataclass
 class DataContext:
-    target_max_block_size: int = field(
-        default_factory=lambda: _flag("data_block_target_bytes")
-    )
+    target_max_block_size: int = field(default_factory=_block_target_bytes)
     target_min_block_size: int = 1 * 1024 * 1024
     # Rows per block produced by reads when the source can't estimate sizes.
     default_batch_size: int = 1024
     # Executor limits (backpressure).
     max_tasks_in_flight: int = 16
     max_queued_bundles: int = 32
-    output_queue_size: int = field(
-        default_factory=lambda: _flag("data_output_queue_size")
-    )
+    output_queue_size: int = field(default_factory=_output_queue_size)
     # Default parallelism for reads when not specified (-1 = auto).
     read_parallelism: int = -1
     # Verbose per-op stats collection.

@@ -1,0 +1,297 @@
+"""apisurface: the committed snapshot of the cross-process contract surface.
+
+Everything apilint (docs/raylint.md, RL10xx) checks call sites *against* —
+actor classes and their remote-callable method signatures, `@remote`
+functions, the duck-typed protocol rosters and who implements them, the GCS
+verb table, and the `_DEFS` flag registry — is ALSO the project's de-facto
+public API: it is what a peer process two releases older, an operator
+script, or a dashboard actually talks to. This module snapshots that
+surface deterministically to `API_SURFACE.json` at the repo root (plus the
+generated `docs/flags.md`), and a tier-1 test diffs the live tree against
+the committed copy:
+
+- unintentional drift (a renamed remote method, a signature change, a flag
+  deleted under an operator) fails CI with a readable diff;
+- intentional drift is one command — `python -m ray_tpu.devtools.apisurface
+  --write` — and the regenerated snapshot is reviewed in the PR like a
+  lockfile.
+
+The snapshot is built from the same AST registry apilint uses (no imports
+of the scanned modules, no runtime state, keys sorted), so regeneration is
+byte-deterministic for a given tree.
+
+CLI:
+    python -m ray_tpu.devtools.apisurface --check      # diff live vs committed
+    python -m ray_tpu.devtools.apisurface --write      # regenerate both files
+    python -m ray_tpu.devtools.apisurface --flags-md   # regenerate docs/flags.md
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+from ray_tpu.devtools.raylint import apilint
+from ray_tpu.devtools.raylint.core import _load_context, iter_python_files
+
+SURFACE_FILE = "API_SURFACE.json"
+FLAGS_MD = os.path.join("docs", "flags.md")
+
+_SECTION_RE = re.compile(r"#\s*---\s*(.+?)\s*---")
+
+
+def repo_root() -> str:
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+
+
+def _build_registry(pkg_dir: str) -> apilint.ApiRegistry:
+    ctxs = []
+    for abspath in iter_python_files([pkg_dir]):
+        ctx, err = _load_context(abspath)
+        if err is None:
+            ctxs.append(ctx)
+    return apilint.build_registry(ctxs)
+
+
+def _flag_sections(reg: apilint.ApiRegistry) -> Dict[str, str]:
+    """flag name -> the `# --- section ---` comment above it in the defining
+    file ("" when none)."""
+    out: Dict[str, str] = {}
+    by_file: Dict[str, List[apilint.FlagDef]] = {}
+    for f in reg.flags.values():
+        by_file.setdefault(f.relpath, []).append(f)
+    for relpath, flags in by_file.items():
+        path = os.path.join(repo_root(), relpath)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        section_at: Dict[int, str] = {}
+        current = ""
+        for i, line in enumerate(lines, start=1):
+            m = _SECTION_RE.search(line)
+            if m:
+                current = m.group(1)
+            section_at[i] = current
+        for f in flags:
+            out[f.name] = section_at.get(f.lineno, "")
+    return out
+
+
+def build_surface(pkg_dir: Optional[str] = None) -> dict:
+    """The deterministic cross-process contract snapshot."""
+    if pkg_dir is None:
+        import ray_tpu
+
+        pkg_dir = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    reg = _build_registry(pkg_dir)
+
+    actor_classes: Dict[str, dict] = {}
+    for info in reg.actor_classes():
+        methods, closed = reg.resolved_methods(info)
+        public = {
+            name: sigs[0].render()
+            for name, sigs in methods.items()
+            if not name.startswith("_") or name == "__call__"
+        }
+        key = info.name
+        if key in actor_classes:  # same class name in two files: qualify
+            key = f"{info.name}@{info.relpath}"
+        actor_classes[key] = {
+            "file": info.relpath,
+            "via": info.actor_via,
+            "bases_resolved": closed,
+            "methods": dict(sorted(public.items())),
+        }
+
+    protocols: Dict[str, dict] = {}
+    for spec in apilint.PROTOCOL_TABLE:
+        implementors = []
+        for info in reg.actor_classes():
+            methods, _ = reg.resolved_methods(info)
+            if any(a in methods for a in spec.anchors):
+                implementors.append(info.name)
+        protocols[spec.protocol] = {
+            "members": {
+                m: {"npos": npos, "kwnames": list(kw)}
+                for m, (npos, kw) in spec.members
+            },
+            "anchors": list(spec.anchors),
+            "implementors": sorted(set(implementors)),
+        }
+
+    gcs_verbs = {
+        verb: {
+            "handler": f"{v.class_name}.rpc_{verb}",
+            "file": v.relpath,
+            "sig": v.sig.render(),
+        }
+        for verb, v in reg.gcs_verbs.items()
+    }
+
+    sections = _flag_sections(reg)
+    flags = {
+        name: {
+            "type": f.type_name,
+            "default": f.default_src,
+            "doc": f.doc,
+            "section": sections.get(name, ""),
+        }
+        for name, f in reg.flags.items()
+    }
+
+    remote_functions = {
+        name: sorted(s.render() for s in sigs)
+        for name, sigs in reg.remote_functions.items()
+    }
+
+    return {
+        "actor_classes": dict(sorted(actor_classes.items())),
+        "remote_functions": dict(sorted(remote_functions.items())),
+        "protocols": dict(sorted(protocols.items())),
+        "gcs_verbs": dict(sorted(gcs_verbs.items())),
+        "flags": dict(sorted(flags.items())),
+    }
+
+
+def render_surface(surface: dict) -> str:
+    return json.dumps(surface, indent=2, sort_keys=True) + "\n"
+
+
+def render_flags_md(surface: dict) -> str:
+    """docs/flags.md, grouped by the `# --- section ---` comments in
+    `_private/config.py`. Generated — edit _DEFS, then run
+    `python -m ray_tpu.devtools.apisurface --flags-md`."""
+    lines = [
+        "# Configuration flags",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. Regenerate with:",
+        "     python -m ray_tpu.devtools.apisurface --flags-md",
+        "     (drift-gated by tests/test_apisurface.py) -->",
+        "",
+        "Every flag lives in `ray_tpu/_private/config.py` `_DEFS` and is",
+        "overridable with the environment variable `RAY_TPU_<NAME>`",
+        "(upper-cased). Reads of names not in this table raise `KeyError`",
+        "with a did-you-mean suggestion; `raylint --family api` (RL1004)",
+        "catches typo'd and dead flags statically (docs/raylint.md).",
+        "",
+    ]
+    by_section: Dict[str, List[str]] = {}
+    for name, f in surface["flags"].items():
+        by_section.setdefault(f["section"] or "other", []).append(name)
+    for section in sorted(by_section):
+        lines.append(f"## {section}")
+        lines.append("")
+        lines.append("| flag | type | default | purpose |")
+        lines.append("|---|---|---|---|")
+        for name in sorted(by_section[section]):
+            f = surface["flags"][name]
+            doc = f["doc"].replace("|", "\\|")
+            default = f"`{f['default']}`".replace("|", "\\|")
+            lines.append(f"| `{name}` | {f['type']} | {default} | {doc} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def diff_surface(committed: dict, live: dict) -> List[str]:
+    """Readable per-entry diff (empty when identical)."""
+    out: List[str] = []
+
+    def walk(path: str, a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) | set(b)):
+                kp = f"{path}.{k}" if path else str(k)
+                if k not in b:
+                    out.append(f"- {kp}: removed from live tree "
+                               f"(committed: {json.dumps(a[k], sort_keys=True)[:120]})")
+                elif k not in a:
+                    out.append(f"+ {kp}: new in live tree "
+                               f"({json.dumps(b[k], sort_keys=True)[:120]})")
+                else:
+                    walk(kp, a[k], b[k])
+        elif a != b:
+            out.append(
+                f"~ {path}: {json.dumps(a, sort_keys=True)[:120]} -> "
+                f"{json.dumps(b, sort_keys=True)[:120]}"
+            )
+
+    walk("", committed, live)
+    return out
+
+
+def check(root: Optional[str] = None) -> List[str]:
+    """-> list of drift lines (surface + flags.md); empty when in sync."""
+    root = root or repo_root()
+    live = build_surface()
+    problems: List[str] = []
+    surface_path = os.path.join(root, SURFACE_FILE)
+    try:
+        with open(surface_path, encoding="utf-8") as fh:
+            committed = json.load(fh)
+    except (OSError, ValueError):
+        problems.append(f"! {SURFACE_FILE} missing or unreadable at {root}")
+        committed = {}
+    problems.extend(diff_surface(committed, live))
+    md_path = os.path.join(root, FLAGS_MD)
+    want_md = render_flags_md(live)
+    try:
+        with open(md_path, encoding="utf-8") as fh:
+            have_md = fh.read()
+    except OSError:
+        have_md = ""
+    if have_md != want_md:
+        problems.append(f"! {FLAGS_MD} is stale — regenerate with "
+                        "`python -m ray_tpu.devtools.apisurface --flags-md`")
+    return problems
+
+
+def write(root: Optional[str] = None, flags_only: bool = False) -> List[str]:
+    root = root or repo_root()
+    live = build_surface()
+    written = []
+    if not flags_only:
+        path = os.path.join(root, SURFACE_FILE)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(render_surface(live))
+        written.append(path)
+    path = os.path.join(root, FLAGS_MD)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(render_flags_md(live))
+    written.append(path)
+    return written
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv == ["--check"] or not argv:
+        problems = check()
+        if problems:
+            print("API surface drift (regenerate with "
+                  "`python -m ray_tpu.devtools.apisurface --write` if "
+                  "intentional):")
+            for p in problems:
+                print(" ", p)
+            return 1
+        print("API surface in sync")
+        return 0
+    if argv == ["--write"]:
+        for p in write():
+            print("wrote", p)
+        return 0
+    if argv == ["--flags-md"]:
+        for p in write(flags_only=True):
+            print("wrote", p)
+        return 0
+    print("usage: python -m ray_tpu.devtools.apisurface "
+          "[--check|--write|--flags-md]", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

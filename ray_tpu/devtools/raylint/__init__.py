@@ -38,6 +38,29 @@ the runtime live-handle sanitizer these checkers pair with):
 - RL803 use/double-release    handle used or released again after release
 - RL804 fragile-release       swallowed release failure / lock-mismatched release
 
+distlint family (distributed-contract plane; see also devtools/distsan.py,
+the runtime contract sanitizer these checkers pair with):
+
+- RL901 metric-outside-report metric mutation off the report path
+- RL902 blocking-control-rpc  control-plane RPC on a latency-critical path
+- RL903 unpicklable-exception exception class that dies crossing a hop
+- RL904 trace-context-hop     trace context read on the wrong thread
+- RL905 rpc-under-lock        cross-process call awaited under a held lock
+
+apilint family (cross-process call contracts; the static half of the
+API-surface gate in devtools/apisurface.py):
+
+- RL1001 unknown-remote-method  `.remote()` to a method no target defines
+- RL1002 remote-arity-mismatch  call shape that can't bind the target sig
+- RL1003 protocol-drift         deployed class with a partial duck-typed
+                                roster (PROTOCOL_TABLE) or drifted shape
+- RL1004 unknown-or-dead-flag   CONFIG read absent from _DEFS / flag no
+                                code reads
+- RL1005 unpicklable-boundary   lambda, local def, or OS handle shipped
+                                through a `.remote()` boundary
+- RL1006 gcs-verb-drift         unknown `gcs_call` verb / orphan rpc_*
+                                handler no string anywhere names
+
 Suppress a finding with a trailing (or immediately preceding) comment::
 
     ref = actor.ping.remote()  # raylint: disable=RL501

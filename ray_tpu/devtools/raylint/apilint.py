@@ -1,0 +1,1058 @@
+"""apilint: the RL10xx cross-process call-contract family.
+
+Everything that crosses a process boundary in this framework is dynamically
+dispatched: `.remote()` method names resolve as strings inside the worker
+(`worker.py` `spec["method_name"]`), serve handles and the DP/PD routers
+broadcast duck-typed stats/control surfaces across a growing roster of
+classes, GCS verbs are bare strings at `gcs_call` sites, and the `_DEFS`
+flag table is string-keyed. Every one of those contracts is invisible to the
+other checker families — a typo becomes an `AttributeError`/`TypeError`
+inside a remote worker, mid-chaos-test. apilint makes them fail at lint time:
+
+- **RL1001** unknown-remote-method: `h.method.remote(...)` where `method`
+  does not exist on the resolved target class (handle provenance tracked
+  through `h = Cls.remote(...)` / `self._h = Cls.options(...).remote(...)`
+  assignments), or — when the handle cannot be resolved — on ANY class or
+  function in the scanned tree.
+- **RL1002** remote-arity-mismatch: positional count / keyword names at a
+  cross-process call site that no candidate target `def` accepts
+  (defaults/`*args`/`**kwargs`-aware). Covers actor constructors
+  (`Cls.remote(...)` vs `__init__`), handle method calls, `@remote`
+  functions, and `gcs_call` verb arity vs the `rpc_<verb>` handler.
+- **RL1003** protocol-drift: the cross-process surface protocols this
+  codebase broadcasts (`PROTOCOL_TABLE`, the leaklint `RESOURCE_TABLE`
+  shape) — a deployed class implementing any anchor of a roster must
+  implement every member with a signature the broadcast call shape accepts.
+- **RL1004** unknown-or-dead-flag: `CONFIG.<name>` reads of flags absent
+  from `_DEFS` (pre-PR-21 these silently read nothing; now they raise, but
+  only at runtime), and `_DEFS` entries no scanned file ever reads.
+- **RL1005** unpicklable-at-boundary: lambdas, locally-defined functions,
+  and OS handles (open files, locks, threads) passed as `.remote()`
+  arguments. Closures DO cloudpickle, but they ship their captured enclosing
+  state by value — a copy executes in the worker, silently diverging from
+  the driver's state; OS handles don't survive the hop at all.
+- **RL1006** unknown-gcs-verb: `gcs_call("verb", ...)` strings with no
+  `rpc_<verb>` handler on the GCS service classes, and orphan handlers no
+  string in the tree ever names.
+
+Unlike the per-file families, apilint needs a tree-wide prepass:
+`build_registry()` runs over every parsed file first (classes + method
+signatures, actor/deployment detection, `_DEFS`, `rpc_*` verb tables,
+`CONFIG` reads), then `check_api_file()` lints each file against it and
+`tree_findings()` emits the aggregate checks (dead flags, orphan verbs).
+Fixture files lint standalone because a single file is its own registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.raylint.core import FileContext, Finding
+
+#: Receiver names that denote the central flag singleton. `CONFIG` is the
+#: canonical import; `_CFG` is ray_tpu/__init__.py's local alias.
+_CONFIG_NAMES = frozenset({"CONFIG", "_CFG"})
+
+#: Real methods on _Config — attribute access to these is not a flag read.
+_CONFIG_METHODS = frozenset({"get"})
+
+#: Ctor leaf names whose results are OS-backed and must not cross a pickle
+#: boundary (RL1005).
+_OS_HANDLE_CTORS = {
+    "open": "open file handle",
+    "Lock": "lock",
+    "RLock": "lock",
+    "Condition": "condition variable",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+    "Thread": "thread object",
+    "socket": "socket",
+}
+
+
+# -- signatures ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Sig:
+    """A callable's parameter shape, `self`/`cls` dropped for methods."""
+
+    params: Tuple[str, ...]          # positional-or-keyword (incl pos-only)
+    defaults: int                    # trailing params with defaults
+    default_srcs: Tuple[str, ...]    # unparsed default exprs, same order
+    kwonly: Tuple[str, ...]
+    kwonly_required: Tuple[str, ...]
+    kwonly_default_srcs: Tuple[str, ...]  # "" for required kw-only params
+    vararg: bool
+    kwarg: bool
+    lineno: int
+
+    def accepts(self, npos: int, kwnames: Tuple[str, ...]) -> Optional[str]:
+        """None if a call with `npos` positional args and `kwnames` keyword
+        args binds; otherwise a TypeError-style description."""
+        if npos > len(self.params) and not self.vararg:
+            return (f"takes at most {len(self.params)} positional "
+                    f"argument(s), got {npos}")
+        consumed = set(self.params[:min(npos, len(self.params))])
+        for kw in kwnames:
+            if kw in consumed:
+                return f"got multiple values for argument {kw!r}"
+            if (kw not in self.params and kw not in self.kwonly
+                    and not self.kwarg):
+                return f"got an unexpected keyword argument {kw!r}"
+        required = self.params[:len(self.params) - self.defaults]
+        missing = [p for p in required[npos:] if p not in kwnames]
+        missing += [k for k in self.kwonly_required if k not in kwnames]
+        if missing:
+            return "missing required argument(s): " + ", ".join(
+                repr(m) for m in missing
+            )
+        return None
+
+    def render(self) -> str:
+        """Deterministic human/text form for API_SURFACE.json."""
+        parts: List[str] = []
+        plain = len(self.params) - self.defaults
+        for i, p in enumerate(self.params):
+            if i < plain:
+                parts.append(p)
+            else:
+                parts.append(f"{p}={self.default_srcs[i - plain]}")
+        if self.vararg:
+            parts.append("*args")
+        elif self.kwonly:
+            parts.append("*")
+        for k, d in zip(self.kwonly, self.kwonly_default_srcs):
+            parts.append(k if not d else f"{k}={d}")
+        if self.kwarg:
+            parts.append("**kwargs")
+        return "(" + ", ".join(parts) + ")"
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "..."
+
+
+def sig_of(fn: ast.AST, drop_first: bool) -> Sig:
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    if drop_first and params:
+        params = params[1:]
+    defaults = list(a.defaults)
+    kwonly = tuple(p.arg for p in a.kwonlyargs)
+    kw_required, kw_srcs = [], []
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is None:
+            kw_required.append(p.arg)
+            kw_srcs.append("")
+        else:
+            kw_srcs.append(_unparse(d))
+    return Sig(
+        params=tuple(params),
+        defaults=len(defaults),
+        default_srcs=tuple(_unparse(d) for d in defaults),
+        kwonly=kwonly,
+        kwonly_required=tuple(kw_required),
+        kwonly_default_srcs=tuple(kw_srcs),
+        vararg=a.vararg is not None,
+        kwarg=a.kwarg is not None,
+        lineno=getattr(fn, "lineno", 0),
+    )
+
+
+# -- the protocol table (RL1003) ----------------------------------------------
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """One duck-typed cross-process surface: defining any ANCHOR member
+    makes a deployed class part of the protocol, which then requires EVERY
+    member, each callable with its declared broadcast shape."""
+
+    protocol: str
+    #: member -> (npos, kwnames) the broadcast/collection site calls it with.
+    members: Tuple[Tuple[str, Tuple[int, Tuple[str, ...]]], ...]
+    anchors: Tuple[str, ...]
+
+
+PROTOCOL_TABLE: Tuple[ProtocolSpec, ...] = (
+    # serve_stats()/`ray_tpu status` collect these per replica; the DP/PD
+    # routers broadcast them across their pools. A replica class exposing one
+    # without the rest turns the operator snapshot into AttributeError.
+    ProtocolSpec(
+        "llm-stats-surface",
+        members=(
+            ("cache_stats", (0, ())),
+            ("scheduler_stats", (0, ())),
+            ("recorder_stats", (0, ())),
+            ("capture_profile", (0, ("duration_s",))),
+        ),
+        anchors=("cache_stats", "scheduler_stats", "recorder_stats"),
+    ),
+    # The SLO autopilot's sticky managed set: a deployment is managed once
+    # ANY replica answers autopilot_signals(), and managed deployments
+    # receive set_tenant_weight broadcasts — implementing the signal without
+    # the actuator detonates the weight law's broadcast.
+    ProtocolSpec(
+        "autopilot-surface",
+        members=(
+            ("autopilot_signals", (0, ())),
+            ("set_tenant_weight", (2, ())),
+        ),
+        anchors=("autopilot_signals", "set_tenant_weight"),
+    ),
+    # Replica.prepare_shutdown() calls the wrapped instance's shutdown() with
+    # zero args before the controller hard-kills; a shutdown that grew a
+    # required parameter silently stops being graceful.
+    ProtocolSpec(
+        "graceful-shutdown",
+        members=(("shutdown", (0, ())),),
+        anchors=("shutdown",),
+    ),
+)
+
+
+# -- registry -----------------------------------------------------------------
+
+@dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    lineno: int
+    bases: Tuple[str, ...]
+    methods: Dict[str, Sig]
+    self_attrs: Set[str]
+    actor_via: Optional[str] = None    # how it crosses a process boundary
+
+
+@dataclass
+class FlagDef:
+    name: str
+    relpath: str
+    lineno: int
+    type_name: str
+    default_src: str
+    doc: str
+
+
+@dataclass
+class VerbDef:
+    verb: str
+    relpath: str
+    lineno: int
+    class_name: str
+    sig: Sig                            # `self` and `conn` dropped
+
+
+@dataclass
+class ApiRegistry:
+    classes: Dict[str, List[ClassInfo]] = field(default_factory=dict)
+    #: every def anywhere, by leaf name (the RL1001 fallback universe)
+    function_names: Set[str] = field(default_factory=set)
+    method_universe: Set[str] = field(default_factory=set)
+    remote_functions: Dict[str, List[Sig]] = field(default_factory=dict)
+    flags: Dict[str, FlagDef] = field(default_factory=dict)
+    flag_reads: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    gcs_verbs: Dict[str, VerbDef] = field(default_factory=dict)
+    str_constants: Set[str] = field(default_factory=set)
+    _resolve_cache: Dict[int, Tuple[Dict[str, List[Sig]], bool]] = field(
+        default_factory=dict
+    )
+
+    # -- method resolution with in-tree inheritance --------------------------
+
+    def resolved_methods(
+        self, info: ClassInfo
+    ) -> Tuple[Dict[str, List[Sig]], bool]:
+        """-> ({method -> candidate sigs}, all_bases_resolved). Merges base
+        classes resolvable by leaf name in the registry; a base the registry
+        does not know (imported from outside the scanned tree) makes the
+        method set open-ended, which demotes precise RL1001 to the weak
+        universe check."""
+        cached = self._resolve_cache.get(id(info))
+        if cached is not None:
+            return cached
+        self._resolve_cache[id(info)] = ({}, False)  # cycle guard
+        merged: Dict[str, List[Sig]] = {}
+        closed = True
+        for base in info.bases:
+            if base == "object":
+                continue
+            candidates = self.classes.get(base)
+            if not candidates:
+                closed = False
+                continue
+            for c in candidates:
+                bm, bclosed = self.resolved_methods(c)
+                closed = closed and bclosed
+                for name, sigs in bm.items():
+                    merged.setdefault(name, []).extend(sigs)
+        for name, sig in info.methods.items():
+            merged[name] = [sig]   # own def overrides inherited candidates
+        self._resolve_cache[id(info)] = (merged, closed)
+        return merged, closed
+
+    def actor_classes(self) -> List[ClassInfo]:
+        out = []
+        for infos in self.classes.values():
+            out.extend(i for i in infos if i.actor_via)
+        return out
+
+    def method_candidates(self, name: str) -> List[Sig]:
+        """Candidate sigs for an unresolved handle call: methods named `name`
+        on actor classes first (the plausible targets), any class otherwise,
+        plus same-named remote functions."""
+        actor, anywhere = [], []
+        for infos in self.classes.values():
+            for info in infos:
+                sig = info.methods.get(name)
+                if sig is None:
+                    continue
+                (actor if info.actor_via else anywhere).append(sig)
+        out = actor or anywhere
+        out = out + self.remote_functions.get(name, [])
+        return out
+
+
+def _leaf(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _root(node: ast.expr) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_remote_decorator(dec: ast.expr) -> bool:
+    """@remote / @ray_tpu.remote / @remote(...) / @ray_tpu.remote(...)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Name):
+        return dec.id == "remote"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "remote" and _root(dec) in ("ray_tpu", "ray")
+    return False
+
+
+def _is_deployment_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return _leaf(dec) == "deployment"
+
+
+def _unwrap_options(base: ast.expr) -> ast.expr:
+    """`X.options(...).remote(...)` -> X (same for handle-method options)."""
+    if (isinstance(base, ast.Call) and isinstance(base.func, ast.Attribute)
+            and base.func.attr == "options"):
+        return base.func.value
+    return base
+
+
+def _gcs_call_verb(node: ast.Call) -> Optional[str]:
+    if _leaf(node.func) != "gcs_call":
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+        node.args[0].value, str
+    ):
+        return node.args[0].value
+    return None
+
+
+def _is_gcsish_class(name: str, relpath: str) -> bool:
+    import os as _os
+    import re as _re
+
+    parts = {p for p in _re.sub(
+        r"([a-z0-9])([A-Z])", r"\1_\2", name
+    ).lower().split("_") if p}
+    return "gcs" in parts or _os.path.basename(relpath).startswith("gcs")
+
+
+class _FileScan(ast.NodeVisitor):
+    """Registry facts from one file: classes + signatures, actor-class
+    markers, `@remote` functions, `_DEFS`, `rpc_*` verb handlers, CONFIG
+    reads, and the string-constant pool."""
+
+    def __init__(self, ctx: FileContext, reg: ApiRegistry):
+        self.ctx = ctx
+        self.reg = reg
+        self._class_stack: List[ClassInfo] = []
+        # names seen in `X.remote(...)` / wrap positions; resolved to classes
+        # or functions once the whole tree is scanned.
+        self.remote_instantiated: Set[str] = set()
+        self.deployment_wrapped: Set[str] = set()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        info = ClassInfo(
+            name=node.name,
+            relpath=self.ctx.relpath,
+            lineno=node.lineno,
+            bases=tuple(
+                b for b in (_leaf(x) for x in node.bases) if b
+            ),
+            methods={},
+            self_attrs=set(),
+        )
+        for dec in node.decorator_list:
+            if _is_remote_decorator(dec):
+                info.actor_via = "@remote"
+            elif _is_deployment_decorator(dec):
+                info.actor_via = "serve-deployment"
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_static = any(
+                    _leaf(d) == "staticmethod" for d in stmt.decorator_list
+                )
+                info.methods[stmt.name] = sig_of(stmt, drop_first=not is_static)
+                self.reg.method_universe.add(stmt.name)
+                if stmt.name.startswith("rpc_") and _is_gcsish_class(
+                    node.name, self.ctx.relpath
+                ):
+                    verb = stmt.name[len("rpc_"):]
+                    self.reg.gcs_verbs.setdefault(verb, VerbDef(
+                        verb=verb,
+                        relpath=self.ctx.relpath,
+                        lineno=stmt.lineno,
+                        class_name=node.name,
+                        # drop `conn` (the transport hands it in, callers
+                        # never pass it)
+                        sig=_drop_leading(sig_of(stmt, drop_first=True), 1),
+                    ))
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Store)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                info.self_attrs.add(sub.attr)
+        self.reg.classes.setdefault(node.name, []).append(info)
+        self._class_stack.append(info)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_fn(self, node):
+        self.reg.function_names.add(node.name)
+        if not self._class_stack or not isinstance(
+            getattr(node, "parent", None), ast.ClassDef
+        ):
+            # any def (module-level or nested) counts for the fallback
+            # universe; @remote functions additionally get an arity contract
+            for dec in node.decorator_list:
+                if _is_remote_decorator(dec):
+                    self.reg.remote_functions.setdefault(node.name, []).append(
+                        sig_of(node, drop_first=False)
+                    )
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Assign(self, node: ast.Assign):
+        # _DEFS: dict[str, tuple[type, Any, str]] = {...} (plain Assign or
+        # the annotated form handled in visit_AnnAssign)
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "_DEFS":
+                self._scan_defs(node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if isinstance(node.target, ast.Name) and node.target.id == "_DEFS" \
+                and node.value is not None:
+            self._scan_defs(node.value)
+        self.generic_visit(node)
+
+    def _scan_defs(self, value: ast.expr):
+        if not isinstance(value, ast.Dict):
+            return
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            type_name, default_src, doc = "?", "?", ""
+            if isinstance(v, ast.Tuple) and len(v.elts) >= 2:
+                type_name = _leaf(v.elts[0]) or "?"
+                default_src = _unparse(v.elts[1])
+                if len(v.elts) >= 3 and isinstance(
+                    v.elts[2], ast.Constant
+                ) and isinstance(v.elts[2].value, str):
+                    doc = v.elts[2].value
+            self.reg.flags[k.value] = FlagDef(
+                name=k.value, relpath=self.ctx.relpath, lineno=k.lineno,
+                type_name=type_name, default_src=default_src, doc=doc,
+            )
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in _CONFIG_NAMES
+                and not node.attr.startswith("_")
+                and node.attr not in _CONFIG_METHODS):
+            self.reg.flag_reads.setdefault(node.attr, []).append(
+                (self.ctx.relpath, node.lineno)
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        # X.remote(...) / X.options(...).remote(...): X is remote-instantiated
+        if isinstance(func, ast.Attribute) and func.attr == "remote":
+            base = _unwrap_options(func.value)
+            if isinstance(base, ast.Name):
+                self.remote_instantiated.add(base.id)
+        # serve.deployment(X) / serve.deployment(...)(X) / remote(...)(X) /
+        # ray_tpu.remote(X)
+        target = None
+        head = func
+        if isinstance(head, ast.Call):
+            head = head.func
+        leaf = _leaf(head)
+        if leaf == "deployment":
+            target = self.deployment_wrapped
+        elif leaf == "remote" and (
+            isinstance(head, ast.Name)
+            or (isinstance(head, ast.Attribute)
+                and _root(head) in ("ray_tpu", "ray"))
+        ):
+            target = self.remote_instantiated
+        if target is not None:
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    target.add(a.id)
+        # getattr(CONFIG, "name") and CONFIG.get("name") count as flag reads
+        if (_leaf(func) == "getattr" and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in _CONFIG_NAMES
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            self.reg.flag_reads.setdefault(node.args[1].value, []).append(
+                (self.ctx.relpath, node.lineno)
+            )
+        if (isinstance(func, ast.Attribute) and func.attr == "get"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in _CONFIG_NAMES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            self.reg.flag_reads.setdefault(node.args[0].value, []).append(
+                (self.ctx.relpath, node.lineno)
+            )
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant):
+        if isinstance(node.value, str) and len(node.value) <= 80:
+            self.reg.str_constants.add(node.value)
+
+
+def _drop_leading(sig: Sig, n: int) -> Sig:
+    params = sig.params[n:]
+    dropped_defaults = max(0, sig.defaults - len(params))
+    return Sig(
+        params=params,
+        defaults=sig.defaults - dropped_defaults,
+        default_srcs=sig.default_srcs[dropped_defaults:],
+        kwonly=sig.kwonly,
+        kwonly_required=sig.kwonly_required,
+        kwonly_default_srcs=sig.kwonly_default_srcs,
+        vararg=sig.vararg,
+        kwarg=sig.kwarg,
+        lineno=sig.lineno,
+    )
+
+
+def build_registry(ctxs: List[FileContext]) -> ApiRegistry:
+    reg = ApiRegistry()
+    pending_remote: Set[str] = set()
+    pending_deploy: Set[str] = set()
+    for ctx in ctxs:
+        scan = _FileScan(ctx, reg)
+        scan.visit(ctx.tree)
+        pending_remote |= scan.remote_instantiated
+        pending_deploy |= scan.deployment_wrapped
+    for name in pending_deploy:
+        for info in reg.classes.get(name, ()):
+            info.actor_via = info.actor_via or "serve-deployment"
+    for name in pending_remote:
+        infos = reg.classes.get(name)
+        if infos:
+            for info in infos:
+                info.actor_via = info.actor_via or "remote-instantiation"
+    return reg
+
+
+# -- per-file checks ----------------------------------------------------------
+
+class _ApiChecker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext, reg: ApiRegistry):
+        self.ctx = ctx
+        self.reg = reg
+        self.findings: List[Finding] = []
+        self._scope: List[str] = []
+        self._class_info_stack: List[Optional[ClassInfo]] = []
+        # per-function-scope maps: var -> actor class name ("handle"),
+        # var -> class name ("class object"), var -> RL1005 hazard kind
+        self._handle_scopes: List[Dict[str, str]] = [{}]
+        self._clsobj_scopes: List[Dict[str, str]] = [{}]
+        self._hazard_scopes: List[Dict[str, str]] = [{}]
+        # per-enclosing-class attr maps (self._h = Cls.remote(...))
+        self._attr_handles: List[Dict[str, str]] = []
+        self._attr_clsobjs: List[Dict[str, str]] = []
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _symbol(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _emit(self, node: ast.AST, code: str, message: str,
+              symbol: Optional[str] = None):
+        self.findings.append(Finding(
+            self.ctx.relpath, getattr(node, "lineno", 0), code, message,
+            symbol if symbol is not None else self._symbol(),
+        ))
+
+    def _my_class_info(self) -> Optional[ClassInfo]:
+        for info in reversed(self._class_info_stack):
+            if info is not None:
+                return info
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        info = None
+        for c in self.reg.classes.get(node.name, ()):
+            if c.relpath == self.ctx.relpath and c.lineno == node.lineno:
+                info = c
+                break
+        if info is not None:
+            self._check_rl1003(node, info)
+        self._scope.append(node.name)
+        self._class_info_stack.append(info)
+        # pre-collect handle/class-object attributes assigned anywhere in the
+        # class, so method order doesn't matter
+        attr_handles: Dict[str, str] = {}
+        attr_clsobjs: Dict[str, str] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    cls = self._instantiated_class(sub.value)
+                    if cls:
+                        attr_handles[t.attr] = cls
+                    elif (isinstance(sub.value, ast.Name)
+                          and sub.value.id in self.reg.classes):
+                        attr_clsobjs[t.attr] = sub.value.id
+        self._attr_handles.append(attr_handles)
+        self._attr_clsobjs.append(attr_clsobjs)
+        self.generic_visit(node)
+        self._attr_handles.pop()
+        self._attr_clsobjs.pop()
+        self._class_info_stack.pop()
+        self._scope.pop()
+
+    def _visit_fn(self, node):
+        # a def nested inside another function is a locally-defined function:
+        # shipping it through .remote() ships its closure by value
+        if self._handle_scopes[-1] is not self._handle_scopes[0] or \
+                len(self._handle_scopes) > 1:
+            self._hazard_scopes[-1].setdefault(
+                node.name, "locally-defined function"
+            )
+        self._scope.append(node.name)
+        self._class_info_stack.append(None)
+        self._handle_scopes.append(dict(self._handle_scopes[-1]))
+        self._clsobj_scopes.append(dict(self._clsobj_scopes[-1]))
+        self._hazard_scopes.append({})
+        self.generic_visit(node)
+        self._hazard_scopes.pop()
+        self._clsobj_scopes.pop()
+        self._handle_scopes.pop()
+        self._class_info_stack.pop()
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- assignment tracking -------------------------------------------------
+
+    def _instantiated_class(self, value: ast.expr) -> Optional[str]:
+        """`Cls.remote(...)` / `Cls.options(...).remote(...)` -> "Cls"."""
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "remote"):
+            return None
+        base = _unwrap_options(value.func.value)
+        if isinstance(base, ast.Name) and base.id in self.reg.classes:
+            return base.id
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            cls = self._instantiated_class(node.value)
+            if cls:
+                self._handle_scopes[-1][name] = cls
+                self._hazard_scopes[-1].pop(name, None)
+            elif isinstance(node.value, ast.Lambda):
+                self._hazard_scopes[-1][name] = "lambda"
+            elif isinstance(node.value, ast.Call):
+                leaf = _leaf(node.value.func)
+                if leaf in _OS_HANDLE_CTORS:
+                    self._hazard_scopes[-1][name] = _OS_HANDLE_CTORS[leaf]
+                else:
+                    self._hazard_scopes[-1].pop(name, None)
+                    self._handle_scopes[-1].pop(name, None)
+            elif (isinstance(node.value, ast.Name)
+                    and node.value.id in self.reg.classes):
+                self._clsobj_scopes[-1][name] = node.value.id
+            else:
+                self._hazard_scopes[-1].pop(name, None)
+                self._handle_scopes[-1].pop(name, None)
+                self._clsobj_scopes[-1].pop(name, None)
+        self.generic_visit(node)
+
+    # -- RL1003 --------------------------------------------------------------
+
+    def _check_rl1003(self, node: ast.ClassDef, info: ClassInfo):
+        if not info.actor_via:
+            return
+        methods, closed = self.reg.resolved_methods(info)
+        if "__getattr__" in methods:
+            return  # dynamic attribute surface: membership is unknowable
+        for spec in PROTOCOL_TABLE:
+            if not any(a in methods for a in spec.anchors):
+                continue
+            missing, drifted = [], []
+            for member, (npos, kwnames) in spec.members:
+                sigs = methods.get(member)
+                if sigs is None:
+                    if closed:
+                        missing.append(member)
+                    continue
+                problems = [s.accepts(npos, tuple(kwnames)) for s in sigs]
+                if all(p is not None for p in problems):
+                    drifted.append(f"{member}{sigs[0].render()}: {problems[0]}")
+            if missing:
+                self._emit(
+                    node, "RL1003",
+                    f"class {info.name} implements part of the "
+                    f"{spec.protocol!r} cross-process protocol but is "
+                    f"missing {', '.join(sorted(missing))} — duck-typed "
+                    "broadcasts/collections across this surface fail on "
+                    "exactly this class; implement the full roster or "
+                    "rename the partial member off the protocol",
+                    symbol=info.name,
+                )
+            for d in drifted:
+                self._emit(
+                    node, "RL1003",
+                    f"class {info.name}: {spec.protocol!r} protocol member "
+                    f"{d} — the broadcast call shape no longer binds",
+                    symbol=info.name,
+                )
+
+    # -- RL1004 --------------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (self.reg.flags
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in _CONFIG_NAMES
+                and not node.attr.startswith("_")
+                and node.attr not in _CONFIG_METHODS
+                and node.attr not in self.reg.flags):
+            import difflib
+
+            close = difflib.get_close_matches(
+                node.attr, list(self.reg.flags), n=1
+            )
+            hint = f" — did you mean {close[0]!r}?" if close else ""
+            self._emit(
+                node, "RL1004",
+                f"config read of unknown flag {node.attr!r}: not in _DEFS, "
+                f"so this raises KeyError at runtime{hint}",
+            )
+        self.generic_visit(node)
+
+    # -- calls: RL1001 / RL1002 / RL1005 / RL1006 ----------------------------
+
+    def _resolve_receiver(self, recv: ast.expr) -> Optional[str]:
+        if isinstance(recv, ast.Name):
+            return self._handle_scopes[-1].get(recv.id)
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and self._attr_handles):
+            return self._attr_handles[-1].get(recv.attr)
+        return None
+
+    def _call_shape(self, node: ast.Call):
+        """-> (npos, kwnames) or None when *args/**kwargs make it dynamic."""
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            return None
+        if any(kw.arg is None for kw in node.keywords):
+            return None
+        return len(node.args), tuple(kw.arg for kw in node.keywords)
+
+    def _check_remote_call(self, node: ast.Call):
+        base = _unwrap_options(node.func.value)
+        shape = self._call_shape(node)
+        self._check_rl1005(node)
+        if isinstance(base, ast.Name):
+            name = base.id
+            cls = self._clsobj_scopes[-1].get(name) or (
+                name if name in self.reg.classes else None
+            )
+            if cls:
+                self._check_ctor(node, cls, shape)
+            elif name in self.reg.remote_functions:
+                self._check_against(
+                    node, self.reg.remote_functions[name], shape,
+                    f"remote function {name}",
+                )
+            return
+        if not isinstance(base, ast.Attribute):
+            return
+        method = base.attr
+        recv = base.value
+        # `self.X.remote(...)`: X is a value attribute of this class — a
+        # stored class object (ctor) or a stored remote-function handle.
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            if self._attr_clsobjs and method in self._attr_clsobjs[-1]:
+                self._check_ctor(node, self._attr_clsobjs[-1][method], shape)
+            return
+        cls = self._resolve_receiver(recv)
+        if cls is not None:
+            self._check_handle_method(node, cls, method, shape)
+            return
+        # Unresolved handle: weak checks against the whole-tree universe.
+        # Only meaningful when the scanned set declares methods at all —
+        # a classless scratch file would otherwise flag every method name.
+        if method.startswith("_") or not self.reg.method_universe:
+            return
+        if (method not in self.reg.method_universe
+                and method not in self.reg.function_names
+                and method not in self.reg.remote_functions):
+            self._emit(
+                node, "RL1001",
+                f".remote() call to {method!r}: no class or function in the "
+                "scanned tree defines this name — the method string resolves "
+                "at the worker and raises AttributeError inside the remote "
+                "process",
+            )
+            return
+        candidates = self.reg.method_candidates(method)
+        if candidates and shape is not None:
+            self._check_against(
+                node, candidates, shape, f"remote method {method}",
+                any_ok=True,
+            )
+
+    def _check_ctor(self, node: ast.Call, cls_name: str, shape):
+        infos = self.reg.classes.get(cls_name, [])
+        if not infos or shape is None:
+            return
+        sigs, closed = [], True
+        for info in infos:
+            methods, c = self.reg.resolved_methods(info)
+            closed = closed and c
+            init = methods.get("__init__")
+            if init:
+                sigs.extend(init)
+        if not sigs:
+            if not closed:
+                return  # __init__ may live on an unscanned base
+            sigs = [Sig((), 0, (), (), (), (), False, False, 0)]
+        self._check_against(
+            node, sigs, shape, f"{cls_name}.__init__", any_ok=True,
+        )
+
+    def _check_handle_method(self, node: ast.Call, cls_name: str,
+                             method: str, shape):
+        infos = self.reg.classes.get(cls_name, [])
+        sigs = []
+        closed = True
+        dynamic = False
+        for info in infos:
+            methods, c = self.reg.resolved_methods(info)
+            closed = closed and c
+            dynamic = dynamic or "__getattr__" in methods
+            found = methods.get(method)
+            if found:
+                sigs.extend(found)
+        if not sigs:
+            if closed and not dynamic:
+                self._emit(
+                    node, "RL1001",
+                    f".remote() call to {cls_name}.{method}: class "
+                    f"{cls_name} defines no such method — resolves as a "
+                    "string at the worker and raises AttributeError inside "
+                    "the remote process",
+                )
+            return
+        if shape is not None:
+            self._check_against(
+                node, sigs, shape, f"{cls_name}.{method}", any_ok=True,
+            )
+
+    def _check_against(self, node: ast.Call, sigs: List[Sig], shape,
+                       what: str, any_ok: bool = False):
+        if shape is None or not sigs:
+            return
+        npos, kwnames = shape
+        problems = [s.accepts(npos, kwnames) for s in sigs]
+        if any(p is None for p in problems):
+            return
+        self._emit(
+            node, "RL1002",
+            f"cross-process call does not bind to {what}"
+            f"{sigs[0].render()}: {problems[0]} — the TypeError fires "
+            "inside the remote worker, not here",
+        )
+
+    def _check_rl1005(self, node: ast.Call):
+        values = list(node.args) + [kw.value for kw in node.keywords]
+        for v in values:
+            if isinstance(v, ast.Starred):
+                v = v.value
+            kind = None
+            if isinstance(v, ast.Lambda):
+                kind = "lambda"
+            elif isinstance(v, ast.Name):
+                kind = self._hazard_scopes[-1].get(v.id)
+            elif isinstance(v, ast.Call):
+                leaf = _leaf(v.func)
+                kind = _OS_HANDLE_CTORS.get(leaf)
+            if kind is None:
+                continue
+            if kind in ("lambda", "locally-defined function"):
+                msg = (
+                    f"{kind} passed across a .remote() submission boundary: "
+                    "closures cloudpickle BY VALUE with their captured "
+                    "enclosing state — the worker executes a copy that "
+                    "silently diverges from the driver; pass a module-level "
+                    "function and explicit arguments instead"
+                )
+            else:
+                msg = (
+                    f"{kind} passed across a .remote() submission boundary: "
+                    "OS-backed handles do not survive the pickle hop — open/"
+                    "construct it inside the remote task instead"
+                )
+            self._emit(node, "RL1005", msg)
+
+    def _check_gcs_call(self, node: ast.Call):
+        verb = _gcs_call_verb(node)
+        if verb is None or not self.reg.gcs_verbs:
+            return
+        vdef = self.reg.gcs_verbs.get(verb)
+        if vdef is None:
+            import difflib
+
+            close = difflib.get_close_matches(
+                verb, list(self.reg.gcs_verbs), n=1
+            )
+            hint = f" — did you mean {close[0]!r}?" if close else ""
+            self._emit(
+                node, "RL1006",
+                f"gcs_call verb {verb!r} has no rpc_{verb} handler on the "
+                f"GCS service{hint} — the call fails with an unknown-method "
+                "error at the server",
+            )
+            return
+        # arity: gcs_call(verb, *args) forwards positionally only (its own
+        # keywords — timeout/deadline_s — stay client-side)
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            return
+        npos = len(node.args) - 1
+        problem = vdef.sig.accepts(npos, ())
+        if problem is not None:
+            self._emit(
+                node, "RL1002",
+                f"gcs_call({verb!r}, ...) does not bind to "
+                f"rpc_{verb}{vdef.sig.render()}: {problem} — the TypeError "
+                "fires inside the GCS server",
+            )
+
+    def _check_config_get(self, node: ast.Call):
+        """CONFIG.get("name") with a constant key and no fallback default is
+        the same typo surface as attribute access."""
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "get"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in _CONFIG_NAMES):
+            return
+        if len(node.args) != 1 or node.keywords:
+            return  # an explicit default makes the unknown key intentional
+        key = node.args[0]
+        if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+            return
+        if self.reg.flags and key.value not in self.reg.flags:
+            import difflib
+
+            close = difflib.get_close_matches(
+                key.value, list(self.reg.flags), n=1
+            )
+            hint = f" — did you mean {close[0]!r}?" if close else ""
+            self._emit(
+                node, "RL1004",
+                f"config read of unknown flag {key.value!r}: not in _DEFS, "
+                f"so this raises KeyError at runtime{hint}",
+            )
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "remote":
+            self._check_remote_call(node)
+        self._check_gcs_call(node)
+        self._check_config_get(node)
+        self.generic_visit(node)
+
+
+def check_api_file(ctx: FileContext, reg: ApiRegistry) -> List[Finding]:
+    checker = _ApiChecker(ctx, reg)
+    checker.visit(ctx.tree)
+    return checker.findings
+
+
+# -- tree-wide findings -------------------------------------------------------
+
+def tree_findings(reg: ApiRegistry) -> List[Finding]:
+    """Aggregate checks that only make sense over the whole run: dead flags
+    (RL1004) and orphan GCS verbs (RL1006)."""
+    out: List[Finding] = []
+    # Dead flags: only when the run plausibly contains the consumers — i.e.
+    # at least one flag read was seen at all. A run over config.py alone (or
+    # a --changed run touching only it) skips the analysis instead of
+    # declaring the entire table dead.
+    if reg.flags and reg.flag_reads:
+        for name in sorted(reg.flags):
+            if name in reg.flag_reads:
+                continue
+            f = reg.flags[name]
+            out.append(Finding(
+                f.relpath, f.lineno, "RL1004",
+                f"flag {name!r} is declared in _DEFS but never read "
+                "anywhere in the scanned tree — a dead flag documents "
+                "behavior the code does not have; delete it or wire it up",
+                "_DEFS",
+            ))
+    # Orphan verbs: a handler nothing in the tree ever names as a string is
+    # unreachable API surface (server-internal dispatch and peer replication
+    # verbs reference their names as strings too, so they stay covered).
+    for verb in sorted(reg.gcs_verbs):
+        if verb in reg.str_constants:
+            continue
+        v = reg.gcs_verbs[verb]
+        out.append(Finding(
+            v.relpath, v.lineno, "RL1006",
+            f"orphan GCS handler rpc_{verb} on {v.class_name}: no string in "
+            "the scanned tree names this verb, so no client can reach it — "
+            "delete it or add the missing call site",
+            f"{v.class_name}.rpc_{verb}",
+        ))
+    return out

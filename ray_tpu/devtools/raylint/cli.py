@@ -109,8 +109,9 @@ def main(argv: list[str] | None = None) -> int:
         prog="raylint",
         description="framework-aware static analysis for the ray_tpu "
                     "control plane (RL1xx-RL5xx), JAX compute plane "
-                    "(RL6xx/RL7xx), resource-lifetime plane (RL8xx), and "
-                    "distributed-contract plane (RL9xx)",
+                    "(RL6xx/RL7xx), resource-lifetime plane (RL8xx), "
+                    "distributed-contract plane (RL9xx), and cross-process "
+                    "call-contract plane (RL10xx)",
     )
     parser.add_argument("paths", nargs="*", default=["ray_tpu"],
                         help="files or directories to lint")
@@ -133,7 +134,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--family", default=None,
                         help="run one or more checker families, comma-"
                              "separated (concurrency = RL1xx-RL5xx, jax = "
-                             "RL6xx/RL7xx, leak = RL8xx, dist = RL9xx); "
+                             "RL6xx/RL7xx, leak = RL8xx, dist = RL9xx, "
+                             "api = RL10xx); "
                              "composable with --select/--only (union). The "
                              "exit contract is unchanged: filters narrow "
                              "which findings (and stale entries) count, "

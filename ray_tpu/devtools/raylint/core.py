@@ -92,15 +92,44 @@ CODES: dict[str, str] = {
              "holding an async lock — or a sync-lock-held call into a "
              "helper that blocks on the control plane (the interprocedural "
              "RL101/RL902 extension)",
+    # -- apilint family (cross-process call-contract plane) -------------------
+    "RL1001": "unknown-remote-method: `.remote()`/handle call names a method "
+              "that does not exist on the resolved target actor class (or "
+              "anywhere in the tree) — it resolves as a string at the worker "
+              "and detonates as AttributeError inside the remote process",
+    "RL1002": "remote-arity-mismatch: positional count or keyword names at a "
+              "cross-process call site don't fit the target `def` "
+              "(defaults/*args/**kwargs-aware) — the TypeError fires inside "
+              "the worker, not at the call site",
+    "RL1003": "protocol-drift: a class implementing part of a declared "
+              "cross-process surface protocol (stats roster, autopilot "
+              "signal/actuator pair, graceful-shutdown) is missing the rest "
+              "or disagrees on a member's signature — duck-typed broadcasts "
+              "then fail on exactly this class",
+    "RL1004": "unknown-or-dead-flag: a config read names a flag absent from "
+              "`_DEFS` (typo = KeyError at runtime, silence before PR 21), "
+              "or a declared flag is never read anywhere in the tree",
+    "RL1005": "unpicklable-at-boundary: a lambda, locally-defined function, "
+              "or open OS handle (file, lock, thread) passed as a `.remote()`"
+              " argument — closures ship their captured enclosing state by "
+              "value and OS handles don't survive the pickle hop at all",
+    "RL1006": "unknown-gcs-verb: a `gcs_call(...)` verb string with no "
+              "rpc_<verb> handler on the GCS service (or an orphan handler "
+              "no call site ever names)",
 }
 
 #: Checker families, for the CLI's `--family` filter and the per-family
 #: tier-1 gates: each lint plane can run and be gated independently.
+#: RL10xx codes are six chars long, so the single-digit plane index only
+#: applies to the five-char classic codes.
 FAMILIES: dict[str, frozenset] = {
-    "concurrency": frozenset(c for c in CODES if c[2] in "12345"),
-    "jax": frozenset(c for c in CODES if c[2] in "67"),
-    "leak": frozenset(c for c in CODES if c[2] == "8"),
-    "dist": frozenset(c for c in CODES if c[2] == "9"),
+    "concurrency": frozenset(
+        c for c in CODES if len(c) == 5 and c[2] in "12345"
+    ),
+    "jax": frozenset(c for c in CODES if len(c) == 5 and c[2] in "67"),
+    "leak": frozenset(c for c in CODES if len(c) == 5 and c[2] == "8"),
+    "dist": frozenset(c for c in CODES if len(c) == 5 and c[2] == "9"),
+    "api": frozenset(c for c in CODES if c.startswith("RL10")),
 }
 
 _DISABLE_MARK = "raylint:"
@@ -206,29 +235,23 @@ def _is_suppressed(ctx: FileContext, f: Finding) -> bool:
     return f.code in disabled or "*" in disabled
 
 
-def _lint_one(abspath: str):
-    """-> (findings, lock_edges) for one file, suppressions applied.
-
-    RL201 is cross-file: edges are returned for the caller to aggregate into
-    one acquisition-order graph per run."""
+def _load_context(abspath: str):
+    """-> (FileContext, None) or (None, syntax-error Finding)."""
     with open(abspath, encoding="utf-8") as fh:
         source = fh.read()
     try:
         tree = ast.parse(source, filename=abspath)
     except SyntaxError as e:
-        return [Finding(normalize_path(abspath), e.lineno or 0, "RL000",
-                        f"syntax error: {e.msg}", "<module>")], []
+        return None, Finding(normalize_path(abspath), e.lineno or 0, "RL000",
+                             f"syntax error: {e.msg}", "<module>")
     ctx = FileContext(abspath=abspath, relpath=normalize_path(abspath),
                       source=source, tree=tree)
     _parse_suppressions(ctx)
-    from ray_tpu.devtools.raylint import checkers
-
-    findings, edges = checkers.check_file(ctx)
-    return [f for f in findings if not _is_suppressed(ctx, f)], edges
+    return ctx, None
 
 
 def lint_file(abspath: str, codes: set[str] | None = None) -> list[Finding]:
-    """Lint one file (including its own lock graph)."""
+    """Lint one file (including its own lock graph and api registry)."""
     return lint_paths([abspath], codes=codes)
 
 
@@ -250,15 +273,38 @@ def iter_python_files(paths: Iterable[str]) -> list[str]:
 
 def lint_paths(paths: Iterable[str],
                codes: set[str] | None = None) -> list[Finding]:
-    from ray_tpu.devtools.raylint import checkers
+    """Two-pass run: every file parses into a FileContext first, the apilint
+    registry (actor classes, flags, GCS verbs) is built over ALL of them, then
+    the per-file checkers run with that tree-wide context. RL201 lock edges
+    and RL1004/RL1006 tree findings aggregate across the whole run."""
+    from ray_tpu.devtools.raylint import apilint, checkers
 
     findings: list[Finding] = []
-    all_edges = []
+    ctxs: list[FileContext] = []
     for abspath in iter_python_files(paths):
-        file_findings, edges = _lint_one(abspath)
-        findings.extend(file_findings)
+        ctx, err = _load_context(abspath)
+        if err is not None:
+            findings.append(err)
+        else:
+            ctxs.append(ctx)
+
+    registry = apilint.build_registry(ctxs)
+    all_edges = []
+    for ctx in ctxs:
+        file_findings, edges = checkers.check_file(ctx)
+        file_findings = file_findings + apilint.check_api_file(ctx, registry)
+        findings.extend(
+            f for f in file_findings if not _is_suppressed(ctx, f)
+        )
         all_edges.extend(edges)
     findings.extend(checkers.lock_cycle_findings(all_edges))
+    # Tree-wide findings (dead flags, orphan GCS verbs) anchor to their
+    # declaration line; suppression comments there still apply.
+    ctx_by_path = {c.relpath: c for c in ctxs}
+    for f in apilint.tree_findings(registry):
+        ctx = ctx_by_path.get(f.path)
+        if ctx is None or not _is_suppressed(ctx, f):
+            findings.append(f)
     if codes:
         findings = [f for f in findings if f.code in codes]
     findings.sort(key=lambda f: (f.path, f.line, f.code))

@@ -530,6 +530,21 @@ class DPRouter:
             lambda: self._server.load_lora.broadcast(name, layer_weights, alpha),
         )
 
+    async def autopilot_signals(self) -> dict:
+        """Autopilot probe for the router deployment itself. The router does
+        no engine work — queued/running stay 0 so it can never trigger
+        replica scaling — but it must answer the probe because it answers
+        set_tenant_weight: the autopilot's sticky managed set pairs the two
+        (signal ⇒ weight broadcasts), and raylint RL1003 pins the pairing."""
+        return {
+            "role": "dp_router",
+            "queued": 0,
+            "running": 0,
+            "tracked_replicas": len(self._fingerprints),
+            "cache_routed": self._routing["cache_routed"],
+            "balanced": self._routing["balanced"],
+        }
+
     async def routing_stats(self) -> dict:
         """Cache-aware + adapter-aware routing counters, fingerprint and
         residency footprints."""

@@ -116,10 +116,35 @@ class PrefillServer:
     async def cache_stats(self) -> Optional[dict]:
         return self._engine.prefix_cache_stats()
 
+    async def scheduler_stats(self) -> dict:
+        """Prefill-side admission/occupancy counters: the llm-stats surface
+        must be whole on every deployed replica class (raylint RL1003) so
+        fleet snapshots never AttributeError on one phase."""
+        return self._engine.scheduler_stats()
+
     async def recorder_stats(self) -> dict:
         """Prefill-side flight-recorder report path: flushes this engine's
         pending trace spans (docs/observability.md)."""
         return self._engine.recorder_stats()
+
+    async def set_tenant_weight(self, tenant: str, weight: float) -> float:
+        """Adaptive-WFQ actuator: prefill admission shares the tenant
+        weights. Required because this class answers autopilot_signals —
+        the autopilot broadcasts weight updates to every replica of a
+        managed deployment (docs/autoscale.md)."""
+        self._engine.set_tenant_weight(tenant, weight)
+        return float(weight)
+
+    async def capture_profile(self, duration_s: float = 3.0,
+                              log_dir: Optional[str] = None) -> dict:
+        """On-demand profiler capture on this prefill replica (the fleet
+        capture fan-out reaches both PD phases)."""
+        loop = asyncio.get_running_loop()
+        from ray_tpu.util import xprof
+
+        return await loop.run_in_executor(
+            None, lambda: xprof.capture(duration_s, log_dir)
+        )
 
     async def autopilot_signals(self) -> dict:
         """Autopilot probe; the prefill role marks this pool as the TTFT
@@ -270,6 +295,18 @@ class DecodeServer:
         sig = self._engine.autopilot_signals()
         sig["role"] = "decode"
         return sig
+
+    async def capture_profile(self, duration_s: float = 3.0,
+                              log_dir: Optional[str] = None) -> dict:
+        """On-demand profiler capture on this decode replica — completes the
+        llm-stats surface so the fleet capture fan-out covers the TPOT
+        phase too."""
+        loop = asyncio.get_running_loop()
+        from ray_tpu.util import xprof
+
+        return await loop.run_in_executor(
+            None, lambda: xprof.capture(duration_s, log_dir)
+        )
 
     async def shutdown(self):
         """Explicit retirement hook: stops the stepper and fails queued
@@ -448,6 +485,46 @@ class PDRouter:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, lambda: {"decode": self._decode.scheduler_stats.broadcast()}
+        )
+
+    async def cache_stats(self) -> dict:
+        """Prefix-cache counters from BOTH phases' replica pools (the PD
+        view of where prefixes live: computed on prefill, fed forward into
+        decode)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            lambda: {
+                "prefill": self._prefill.cache_stats.broadcast(),
+                "decode": self._decode.cache_stats.broadcast(),
+            },
+        )
+
+    async def set_tenant_weight(self, tenant: str, weight: float) -> float:
+        """Fan one tenant's adapted WFQ weight out to both phases. Required
+        because this router answers autopilot_signals (the P:D pressure
+        probe): managed deployments receive the autopilot's weight
+        broadcasts (docs/autoscale.md)."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: (
+                self._prefill.set_tenant_weight.broadcast(tenant, weight),
+                self._decode.set_tenant_weight.broadcast(tenant, weight),
+            ),
+        )
+        return float(weight)
+
+    async def capture_profile(self, duration_s: float = 3.0) -> dict:
+        """Fan a profiler capture out to both phases' replicas and gather
+        the trace artifacts per pool (docs/observability.md)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            lambda: {
+                "prefill": self._prefill.capture_profile.broadcast(duration_s),
+                "decode": self._decode.capture_profile.broadcast(duration_s),
+            },
         )
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):

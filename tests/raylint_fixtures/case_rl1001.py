@@ -1,0 +1,72 @@
+"""RL1001 fixtures: .remote() call to a method the target class doesn't have.
+
+The handle-provenance tracking (local vars, self attrs, .options() chains)
+gives precise resolution; untracked handles fall back to the whole-file
+method/function universe. Classes with __getattr__ opt out (dynamic surface).
+"""
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def read(self):
+        return self.value
+
+
+class Dynamic:
+    """__getattr__ makes the method surface unknowable — never fires."""
+
+    def __getattr__(self, name):
+        return lambda *a: None
+
+
+class Holder:
+    def __init__(self):
+        self._h = Counter.remote(0)
+
+    def bad_attr_handle_typo(self):
+        return self._h.incremant.remote(1)
+
+    def ok_attr_handle(self):
+        return self._h.increment.remote(1)
+
+
+def bad_tracked_handle_typo():
+    h = Counter.remote(0)
+    return h.incremant.remote(1)
+
+
+def bad_options_chain_typo():
+    h = Counter.options(num_cpus=1).remote(0)
+    return h.reed.remote()
+
+
+def bad_untracked_unknown_everywhere(mystery):
+    # weak path: no class or function anywhere in this file defines it
+    return mystery.frobnicate_xyz.remote(1)
+
+
+def ok_tracked_handle():
+    h = Counter.remote(0)
+    return h.increment.remote(by=2)
+
+
+def ok_untracked_but_known_somewhere(mystery):
+    # `increment` exists on Counter: an untracked handle gets the benefit
+    # of the doubt
+    return mystery.increment.remote(1)
+
+
+def ok_dynamic_class():
+    h = Dynamic.remote()
+    return h.anything_at_all.remote()
+
+
+def suppressed_tracked_typo():
+    h = Counter.remote(0)
+    return h.incremant.remote(1)  # raylint: disable=RL1001 (fixture: patched onto the class at runtime)

@@ -1,0 +1,74 @@
+"""RL1002 fixtures: cross-process call shapes that no target def binds.
+
+Covers actor constructors, resolved handle methods, unknown kwargs,
+missing required args, @remote function arity, and the *args/**kwargs
+escape hatch (dynamic shapes are never checked).
+"""
+
+
+class Engine:
+    def __init__(self, model_id, slots=4):
+        self.model_id = model_id
+        self.slots = slots
+
+    def generate(self, prompt, *, max_tokens=64, temperature=0.0):
+        return prompt
+
+    def warm(self, *blobs):
+        return len(blobs)
+
+
+def remote(fn=None, **opts):
+    return fn if fn is not None else (lambda f: f)
+
+
+@remote
+def score(row, scale=1.0):
+    return row
+
+
+def bad_ctor_too_many_args():
+    return Engine.remote("m", 4, 99)
+
+
+def bad_ctor_missing_required():
+    return Engine.remote()
+
+
+def bad_unknown_kwarg():
+    h = Engine.remote("m")
+    return h.generate.remote("hi", max_token=8)
+
+
+def bad_positional_overflow():
+    h = Engine.remote("m")
+    # max_tokens is keyword-only: two positionals cannot bind
+    return h.generate.remote("hi", 8)
+
+
+def bad_remote_function_arity():
+    return score.remote("row", 2.0, "extra")
+
+
+def ok_ctor():
+    return Engine.remote("m", slots=8)
+
+
+def ok_generate():
+    h = Engine.remote("m")
+    return h.generate.remote("hi", max_tokens=8)
+
+
+def ok_vararg_target():
+    h = Engine.remote("m")
+    return h.warm.remote(1, 2, 3, 4, 5)
+
+
+def ok_dynamic_call_shape(args, kwargs):
+    h = Engine.remote("m")
+    return h.generate.remote(*args, **kwargs)
+
+
+def suppressed_unknown_kwarg():
+    h = Engine.remote("m")
+    return h.generate.remote("hi", max_token=8)  # raylint: disable=RL1002 (fixture: server build injects this kwarg)

@@ -1,0 +1,68 @@
+"""RL1003 fixtures: duck-typed protocol rosters must be whole.
+
+A deployed class answering any ANCHOR of a PROTOCOL_TABLE roster must
+implement every member with the broadcast call shape. Non-deployed classes
+(engine internals) are out of scope no matter what they implement.
+"""
+
+
+class PartialStats:
+    """Deployed below; implements two of the llm-stats anchors but not the
+    rest of the roster -> fleet stat collection AttributeErrors here."""
+
+    def cache_stats(self):
+        return {}
+
+    def scheduler_stats(self):
+        return {}
+
+
+class SignalNoActuator:
+    """Answers the autopilot probe without the weight actuator: the sticky
+    managed set will broadcast set_tenant_weight straight into an
+    AttributeError inside this replica."""
+
+    def autopilot_signals(self):
+        return {"queued": 0, "running": 0}
+
+
+class DriftedShutdown:
+    """Has the member but the broadcast shape (zero args) no longer binds."""
+
+    def shutdown(self, grace_period):
+        return grace_period
+
+
+class WholeSurface:
+    def cache_stats(self):
+        return {}
+
+    def scheduler_stats(self):
+        return {}
+
+    def recorder_stats(self):
+        return {}
+
+    def capture_profile(self, duration_s=3.0):
+        return {}
+
+
+class EngineInternal:
+    """Not deployed: partial surface is fine off the process boundary."""
+
+    def cache_stats(self):
+        return {}
+
+
+class SuppressedPartial:  # raylint: disable=RL1003 (fixture: roster completed by a mixin the linter can't see)
+    def autopilot_signals(self):
+        return {}
+
+
+def build_app(serve):
+    a = serve.deployment(name="partial")(PartialStats)
+    b = serve.deployment(name="signal")(SignalNoActuator)
+    c = serve.deployment(name="drifted")(DriftedShutdown)
+    d = serve.deployment(name="whole")(WholeSurface)
+    e = serve.deployment(name="suppressed")(SuppressedPartial)
+    return a, b, c, d, e

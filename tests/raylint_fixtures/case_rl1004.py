@@ -1,0 +1,44 @@
+"""RL1004 fixtures: flag reads absent from _DEFS, and dead declared flags.
+
+Self-contained: this file carries its own _DEFS registry plus the reads,
+exactly the shape of _private/config.py + its consumers when the whole
+tree is linted in one run.
+"""
+
+from typing import Any
+
+_DEFS: dict[str, tuple[type, Any, str]] = {
+    "llm_block_size": (int, 16, "KV block size"),
+    "llm_slots": (int, 4, "decode slots"),
+    "dead_flag_never_read": (int, 0, "nothing reads me"),  # raylint: disable=RL1004 (fixture: reserved for the next migration step)
+    "dead_flag_fires": (int, 0, "nothing reads me either"),
+}
+
+
+class CONFIG:
+    pass
+
+
+def bad_unknown_flag_read():
+    return CONFIG.llm_blok_size
+
+
+def bad_unknown_flag_get():
+    return CONFIG.get("llm_slotz")
+
+
+def ok_known_reads():
+    return CONFIG.llm_block_size + CONFIG.llm_slots
+
+
+def ok_get_with_default(name):
+    # an explicit fallback makes the unknown key intentional
+    return CONFIG.get("llm_slotz", 4)
+
+
+def ok_dynamic_read(name):
+    return getattr(CONFIG, name)
+
+
+def suppressed_unknown_read():
+    return CONFIG.llm_blok_size  # raylint: disable=RL1004 (fixture: legacy alias resolved by a shim)

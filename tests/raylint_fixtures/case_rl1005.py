@@ -1,0 +1,63 @@
+"""RL1005 fixtures: values that should not cross a .remote() boundary.
+
+Lambdas and locally-defined functions cloudpickle fine — but they ship
+their captured enclosing state BY VALUE, so the worker runs a silently
+diverging copy. OS-backed handles (files, locks, threads) don't survive
+the hop at all.
+"""
+
+import threading
+
+
+def process(fn, data):
+    return fn(data)
+
+
+class Mapper:
+    def apply(self, fn, block):
+        return fn(block)
+
+
+def bad_lambda_arg(data):
+    return process.remote(lambda row: row * 2, data)
+
+
+def bad_local_function(data):
+    scale = 2
+
+    def udf(row):
+        return row * scale
+
+    return process.remote(udf, data)
+
+
+def bad_open_handle(path):
+    fh = open(path)
+    return process.remote(fh, None)
+
+
+def bad_inline_open(path):
+    return process.remote(open(path), None)
+
+
+def bad_lock_arg(data):
+    guard = threading.Lock()
+    return process.remote(guard, data)
+
+
+def ok_module_function(data):
+    return process.remote(process, data)
+
+
+def ok_plain_values(path, data):
+    return process.remote(path, data)
+
+
+def ok_reassigned_handle(path, data):
+    fh = open(path)
+    fh = path  # rebound to a plain value before the submission
+    return process.remote(fh, data)
+
+
+def suppressed_lambda(data):
+    return process.remote(lambda row: row, data)  # raylint: disable=RL1005 (fixture: pure stateless closure, divergence impossible)

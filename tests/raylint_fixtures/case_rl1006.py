@@ -1,0 +1,57 @@
+"""RL1006 fixtures: gcs_call verbs vs the rpc_* handler table.
+
+Unknown verb strings fail at the server with an unknown-method error;
+handlers no string anywhere names are unreachable API surface. Verb arity
+against the handler signature is RL1002 (same binding contract as every
+other cross-process call).
+"""
+
+
+class GcsService:
+    """Handler roster (gcs-ish by class name, like the real one)."""
+
+    async def rpc_kv_put(self, conn, key, value, overwrite=True):
+        return True
+
+    async def rpc_kv_get(self, conn, key):
+        return None
+
+    async def rpc_heartbeat(self, conn, node_id, resources=None):
+        return True
+
+    async def rpc_orphan_handler(self, conn):
+        return True
+
+    async def rpc_suppressed_orphan(self, conn):  # raylint: disable=RL1006 (fixture: reached by a client outside the scanned tree)
+        return True
+
+
+class RayletService:
+    """rpc_-prefixed methods on a non-GCS class are not verbs."""
+
+    async def rpc_unrelated(self, conn):
+        return True
+
+
+def bad_unknown_verb(worker):
+    return worker.gcs_call("kv_putt", "k", b"v")
+
+
+def bad_verb_arity(worker):
+    return worker.gcs_call("kv_get", "k", "extra", "args")
+
+
+def ok_known_verb(worker):
+    return worker.gcs_call("kv_put", "k", b"v")
+
+
+def ok_default_arg_verb(worker):
+    return worker.gcs_call("heartbeat", "node-1")
+
+
+def ok_dynamic_verb(worker, verb):
+    return worker.gcs_call(verb, "k")
+
+
+def suppressed_unknown_verb(worker):
+    return worker.gcs_call("kv_putt", "k", b"v")  # raylint: disable=RL1006 (fixture: verb registered by a plugin at runtime)

@@ -1,6 +1,14 @@
 """Seeded RL501 violations (discarded remote/execute results)."""
 
 
+class _Probe:
+    """Defines ping so the api-family universe check stays quiet: this
+    fixture seeds dropped-ref violations, not unknown-method ones."""
+
+    def ping(self):
+        return True
+
+
 def bad_fire_and_forget(actor):
     actor.ping.remote()                            # RL501
 

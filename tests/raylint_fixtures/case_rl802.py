@@ -1,6 +1,14 @@
 """RL802 fixtures: cross-process release reachable only from __del__."""
 
 
+class _Assigner:
+    """Defines the release(token) target so the api-family arity check
+    stays quiet: this fixture seeds gc-only releases, not call-shape ones."""
+
+    def release(self, token):
+        return token
+
+
 class BadGcOnly:
     def __init__(self, assigner, token):
         self._assigner = assigner

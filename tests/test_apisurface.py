@@ -1,0 +1,126 @@
+"""apisurface: the committed API_SURFACE.json / docs/flags.md drift gate.
+
+The contract surface (actor classes + methods, remote functions, protocol
+rosters, GCS verbs, flags) is snapshotted into committed artifacts; this is
+the tier-1 test that fails when the surface drifts without regenerating
+them. Regeneration is one command: `python -m ray_tpu.devtools.apisurface
+--write`.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from ray_tpu.devtools import apisurface
+
+
+def test_committed_surface_in_sync():
+    """THE drift gate: the shipped tree matches the committed snapshot.
+
+    If this fails, either the drift is intentional (regenerate with
+    `python -m ray_tpu.devtools.apisurface --write` and commit the result)
+    or a change leaked onto the cross-process surface by accident — the
+    printed diff names exactly what moved.
+    """
+    problems = apisurface.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_surface_build_is_deterministic():
+    a = apisurface.render_surface(apisurface.build_surface())
+    b = apisurface.render_surface(apisurface.build_surface())
+    assert a == b
+    doc = json.loads(a)
+    # stable top-level shape, sorted keys, trailing newline
+    assert list(doc) == sorted(doc)
+    assert set(doc) == {"actor_classes", "remote_functions", "protocols",
+                        "gcs_verbs", "flags"}
+    assert a.endswith("\n")
+
+
+def test_surface_carries_the_contract_sections():
+    doc = json.loads(apisurface.render_surface(apisurface.build_surface()))
+    # spot-check each section against known shipped surface members
+    assert "RayTrainWorker" in doc["actor_classes"]
+    assert "kv_put" in doc["gcs_verbs"]
+    assert "llm-stats-surface" in doc["protocols"]
+    assert "data_block_target_bytes" in doc["flags"]
+    for name, flag in doc["flags"].items():
+        assert set(flag) == {"type", "default", "doc", "section"}, name
+
+
+def test_drift_produces_readable_diff(tmp_path):
+    """Mutating a copy of the committed snapshot yields +/-/~ lines that
+    name the drifted path, not a bare 'files differ'."""
+    root = apisurface.repo_root()
+    shutil.copy(os.path.join(root, apisurface.FLAGS_MD),
+                tmp_path / "flags.md")
+    committed = json.load(open(os.path.join(root, apisurface.SURFACE_FILE)))
+    committed["flags"].pop("data_block_target_bytes")
+    committed["flags"]["phantom_flag"] = {
+        "type": "int", "default": "0", "doc": "never existed", "section": "x",
+    }
+    os.makedirs(tmp_path / "docs")
+    shutil.move(str(tmp_path / "flags.md"), tmp_path / "docs" / "flags.md")
+    (tmp_path / apisurface.SURFACE_FILE).write_text(
+        json.dumps(committed, indent=2, sort_keys=True) + "\n")
+    problems = apisurface.check(root=str(tmp_path))
+    text = "\n".join(problems)
+    assert "flags.data_block_target_bytes" in text
+    assert "flags.phantom_flag" in text
+    assert any(p.startswith("+") for p in problems)
+    assert any(p.startswith("-") for p in problems)
+
+
+def test_missing_snapshot_is_drift(tmp_path):
+    problems = apisurface.check(root=str(tmp_path))
+    assert any(apisurface.SURFACE_FILE in p for p in problems)
+
+
+def test_flags_md_staleness_gate(tmp_path):
+    """docs/flags.md is generated, committed, and part of the same gate:
+    a stale copy fails check() with the regeneration command in the
+    message."""
+    root = apisurface.repo_root()
+    shutil.copy(os.path.join(root, apisurface.SURFACE_FILE),
+                tmp_path / apisurface.SURFACE_FILE)
+    os.makedirs(tmp_path / "docs")
+    (tmp_path / "docs" / "flags.md").write_text("# stale by hand\n")
+    problems = apisurface.check(root=str(tmp_path))
+    stale = [p for p in problems if "flags.md" in p]
+    assert stale and "--flags-md" in stale[0]
+
+
+def test_flags_md_matches_generator():
+    root = apisurface.repo_root()
+    want = apisurface.render_flags_md(apisurface.build_surface())
+    have = open(os.path.join(root, apisurface.FLAGS_MD),
+                encoding="utf-8").read()
+    assert have == want
+    assert "GENERATED" in want  # the do-not-edit banner survives
+
+
+def test_cli_check_and_usage_exit_codes():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.apisurface", "--check"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "in sync" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.apisurface", "--bogus"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 2
+    assert "usage" in proc.stderr
+
+
+def test_write_roundtrips_to_in_sync(tmp_path):
+    os.makedirs(tmp_path / "docs")
+    assert apisurface.check(root=str(tmp_path)) != []
+    written = apisurface.write(root=str(tmp_path))
+    assert len(written) == 2
+    assert apisurface.check(root=str(tmp_path)) == []

@@ -536,12 +536,13 @@ def test_cli_fail_stale(tmp_path):
 def test_shipped_tree_clean_per_family():
     """The tier-1 gate, per family: the concurrency checkers (RL1xx-RL5xx),
     the jaxlint compute-plane checkers (RL6xx/RL7xx), the leaklint
-    resource-lifetime checkers (RL8xx), and the distlint distributed-contract
-    checkers (RL9xx) must EACH report zero unbaselined findings over the
-    shipped package."""
+    resource-lifetime checkers (RL8xx), the distlint distributed-contract
+    checkers (RL9xx), and the apilint cross-process call-contract checkers
+    (RL10xx) must EACH report zero unbaselined findings over the shipped
+    package."""
     from ray_tpu.devtools.raylint.core import FAMILIES
 
-    assert set(FAMILIES) == {"concurrency", "jax", "leak", "dist"}
+    assert set(FAMILIES) == {"concurrency", "jax", "leak", "dist", "api"}
     findings = lint_paths([PKG_DIR])
     entries = load_baseline()
     for name, codes in FAMILIES.items():
@@ -677,13 +678,13 @@ def test_cli_changed_lints_only_git_changed_files(tmp_path):
 
 
 def test_cli_module_entrypoint_clean_tree():
-    """The tier-1 gate as CI invokes it — all four families in one
+    """The tier-1 gate as CI invokes it — all five families in one
     invocation: zero unbaselined findings AND zero stale baseline entries —
     a fixed-but-still-baselined finding fails loudly instead of lingering
     as a grandfather clause nobody re-earns."""
     proc = subprocess.run(
         [sys.executable, "-m", "ray_tpu.devtools.raylint",
-         "--family", "concurrency,jax,leak,dist", "--fail-stale", PKG_DIR],
+         "--family", "concurrency,jax,leak,dist,api", "--fail-stale", PKG_DIR],
         capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -704,3 +705,195 @@ def test_disable_file_directive(tmp_path):
         "def f(actor):\n    actor.ping.remote()\n"
     )
     assert not lint_file(str(f))
+
+
+# ---- apilint: the RL10xx cross-process call-contract family ----------------
+
+def test_rl1001_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl1001.py"))
+    for sym in ("bad_attr_handle_typo", "bad_tracked_handle_typo",
+                "bad_options_chain_typo", "bad_untracked_unknown_everywhere"):
+        assert found.get(sym) == {"RL1001"}, (sym, found)
+    for sym in ("ok_attr_handle", "ok_tracked_handle",
+                "ok_untracked_but_known_somewhere", "ok_dynamic_class",
+                "suppressed_tracked_typo"):
+        assert sym not in found, sym
+
+
+def test_rl1002_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl1002.py"))
+    for sym in ("bad_ctor_too_many_args", "bad_ctor_missing_required",
+                "bad_unknown_kwarg", "bad_positional_overflow",
+                "bad_remote_function_arity"):
+        assert found.get(sym) == {"RL1002"}, (sym, found)
+    for sym in ("ok_ctor", "ok_generate", "ok_vararg_target",
+                "ok_dynamic_call_shape", "suppressed_unknown_kwarg"):
+        assert sym not in found, sym
+
+
+def test_rl1003_fires_and_suppresses():
+    findings = _fixture("case_rl1003.py")
+    found = _codes_by_symbol(findings)
+    assert found.get("PartialStats") == {"RL1003"}
+    assert found.get("SignalNoActuator") == {"RL1003"}
+    assert found.get("DriftedShutdown") == {"RL1003"}
+    for sym in ("WholeSurface", "EngineInternal", "SuppressedPartial"):
+        assert sym not in found, sym
+    # the message names what's missing, so the fix is mechanical
+    partial = [f for f in findings if f.symbol == "PartialStats"][0]
+    assert "recorder_stats" in partial.message
+    assert "capture_profile" in partial.message
+
+
+def test_rl1004_fires_and_suppresses():
+    findings = _fixture("case_rl1004.py")
+    found = _codes_by_symbol(findings)
+    assert found.get("bad_unknown_flag_read") == {"RL1004"}
+    assert found.get("bad_unknown_flag_get") == {"RL1004"}
+    for sym in ("ok_known_reads", "ok_get_with_default", "ok_dynamic_read",
+                "suppressed_unknown_read"):
+        assert sym not in found, sym
+    # did-you-mean suggestion in the typo message
+    typo = [f for f in findings if f.symbol == "bad_unknown_flag_read"][0]
+    assert "did you mean 'llm_block_size'" in typo.message
+    # dead flags anchor at their _DEFS line; the suppressed one stays quiet
+    dead = [f for f in findings if f.symbol == "_DEFS"]
+    assert len(dead) == 1 and "dead_flag_fires" in dead[0].message
+
+
+def test_rl1005_fires_and_suppresses():
+    found = _codes_by_symbol(_fixture("case_rl1005.py"))
+    for sym in ("bad_lambda_arg", "bad_local_function", "bad_open_handle",
+                "bad_inline_open", "bad_lock_arg"):
+        assert found.get(sym) == {"RL1005"}, (sym, found)
+    for sym in ("ok_module_function", "ok_plain_values",
+                "ok_reassigned_handle", "suppressed_lambda"):
+        assert sym not in found, sym
+
+
+def test_rl1006_fires_and_suppresses():
+    findings = _fixture("case_rl1006.py")
+    found = _codes_by_symbol(findings)
+    assert found.get("bad_unknown_verb") == {"RL1006"}
+    # verb arity is the same binding contract as every cross-process call
+    assert found.get("bad_verb_arity") == {"RL1002"}
+    assert found.get("rpc_orphan_handler") == {"RL1006"}
+    for sym in ("ok_known_verb", "ok_default_arg_verb", "ok_dynamic_verb",
+                "suppressed_unknown_verb", "rpc_suppressed_orphan",
+                "rpc_unrelated"):
+        assert sym not in found, sym
+    unknown = [f for f in findings if f.symbol == "bad_unknown_verb"][0]
+    assert "did you mean 'kv_put'" in unknown.message
+
+
+def test_planted_defects_produce_expected_codes(tmp_path):
+    """The acceptance probe: four planted defects in a small fixture TREE
+    (cross-file — the registry is tree-wide) each produce exactly the
+    expected RL10xx code."""
+    (tmp_path / "server.py").write_text(
+        "class Server:\n"
+        "    def __init__(self, model_id):\n"
+        "        self.model_id = model_id\n"
+        "    def generate(self, prompt, max_tokens=64):\n"
+        "        return prompt\n"
+        "    def cache_stats(self):\n"
+        "        return {}\n"
+        "    def scheduler_stats(self):\n"
+        "        return {}\n"
+    )
+    (tmp_path / "flags.py").write_text(
+        "_DEFS = {\n"
+        "    'slots': (int, 4, 'decode slots'),\n"
+        "}\n"
+    )
+    (tmp_path / "driver.py").write_text(
+        "from server import Server\n"
+        "from flags import _DEFS\n"
+        "class CONFIG: pass\n"
+        "def drive(serve):\n"
+        "    serve.deployment(name='s')(Server)\n"
+        "    h = Server.remote('m')\n"
+        "    a = h.generate_stream.remote('hi')\n"       # typo'd method
+        "    b = h.generate.remote('hi', max_token=8)\n"  # bad kwarg
+        "    return a, b, CONFIG.slotz + CONFIG.slots\n"  # unknown flag read
+    )
+    findings = lint_paths([str(tmp_path)])
+    codes_by_line = {}
+    for f in findings:
+        codes_by_line.setdefault((f.path.rsplit("/", 1)[-1], f.line),
+                                 set()).add(f.code)
+    assert codes_by_line.get(("driver.py", 7)) == {"RL1001"}
+    assert codes_by_line.get(("driver.py", 8)) == {"RL1002"}
+    assert codes_by_line.get(("driver.py", 9)) == {"RL1004"}
+    # roster-incomplete protocol class (deployed in driver.py, defined in
+    # server.py): exactly RL1003, anchored at the class definition
+    assert codes_by_line.get(("server.py", 1)) == {"RL1003"}
+    assert len(findings) == 4, "\n".join(f.render() for f in findings)
+
+
+def test_cli_only_rl10xx_and_json_for_api_family(tmp_path, capsys):
+    """`--only RL10xx` isolates the api plane; `--format json` carries its
+    findings with the same schema as every other family."""
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        # RL501 (discarded .remote) AND RL1001 (typo'd tracked method)
+        "class A:\n"
+        "    def ping(self):\n"
+        "        return 1\n"
+        "def f():\n"
+        "    h = A.remote()\n"
+        "    h.ping.remote()\n"
+        "    h.pnig.remote()\n"
+    )
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"entries": []}))
+    assert raylint_main(
+        [str(mixed), "--baseline", str(empty), "--only", "RL10xx",
+         "--format", "json"]
+    ) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {v["code"] for v in doc["violations"]} == {"RL1001"}
+    assert raylint_main(
+        [str(mixed), "--baseline", str(empty), "--family", "api",
+         "--format", "json"]
+    ) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {v["code"] for v in doc["violations"]} == {"RL1001"}
+    # the concurrency finding exists when the api filter is off
+    assert raylint_main([str(mixed), "--baseline", str(empty),
+                         "--family", "concurrency", "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {v["code"] for v in doc["violations"]} == {"RL501"}
+
+
+def test_cli_changed_covers_api_family(tmp_path):
+    """--changed + --family api: an untracked file with a cross-process
+    contract violation is caught pre-commit; the registry is built from the
+    changed set (self-contained files, the fixture shape)."""
+    import subprocess as sp
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "PYTHONPATH": os.path.dirname(PKG_DIR)}
+    sp.run(["git", "init", "-q"], cwd=repo, check=True, env=env)
+    (repo / "seed.py").write_text("x = 1\n")
+    sp.run(["git", "add", "-A"], cwd=repo, check=True, env=env)
+    sp.run(["git", "commit", "-qm", "seed"], cwd=repo, check=True, env=env)
+    (repo / "fresh.py").write_text(
+        "class A:\n"
+        "    def ping(self):\n"
+        "        return 1\n"
+        "def f():\n"
+        "    h = A.remote()\n"
+        "    h.pnig.remote()\n"
+    )
+    proc = sp.run(
+        [sys.executable, "-m", "ray_tpu.devtools.raylint", "--changed",
+         "--family", "api", "--baseline", str(repo / "nope.json")],
+        cwd=repo, capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 1 and "RL1001" in proc.stdout, (
+        proc.stdout + proc.stderr
+    )
