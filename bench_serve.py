@@ -876,6 +876,169 @@ def bench_pd_ttft():
         ray_tpu.shutdown()
 
 
+def bench_stream_ttft_vs_blocking(on_tpu: bool):
+    """Round 22 (docs/generation.md): the TokenStream subscription vs the
+    raw-callback blocking path on the SAME engine and prompt — streaming is
+    a host-side relay, so its TTFT must sit on top of blocking TTFT."""
+    import numpy as np
+
+    from ray_tpu.llm._engine import SamplingParams
+
+    engine, cfg, model_id, _ = build_engine(spec=False, slots=4)
+    prompt_len, max_tokens = (128, 32) if on_tpu else (16, 16)
+    rng = np.random.default_rng(7)
+    try:
+        run_requests(engine, cfg.vocab_size, 2, prompt_len, 4)  # warm
+        blocking, streaming = [], []
+        for _ in range(5):
+            prompt = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+            first = [None]
+            done = threading.Event()
+            t0 = time.perf_counter()
+
+            def cb(tok, fin, first=first, done=done, t0=t0):
+                if first[0] is None:
+                    first[0] = time.perf_counter() - t0
+                if fin:
+                    done.set()
+
+            engine.submit(prompt, SamplingParams(max_tokens=max_tokens), cb)
+            done.wait(600)
+            blocking.append(first[0])
+
+            t0 = time.perf_counter()
+            stream = engine.open_stream(
+                prompt, SamplingParams(max_tokens=max_tokens))
+            ttft = None
+            for _tok in stream:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+            streaming.append(ttft)
+        return {
+            "metric": "stream_ttft_vs_blocking",
+            "value": round(min(streaming), 4),
+            "blocking_ttft_s": round(min(blocking), 4),
+            "stream_over_blocking": round(min(streaming) / max(min(blocking), 1e-9), 3),
+            "model": model_id,
+        }
+    finally:
+        engine.shutdown()
+
+
+def bench_guided_decode_overhead(on_tpu: bool):
+    """Round 22 (docs/generation.md): decode throughput with an
+    allow-everything constraint vs unconstrained — isolates the per-step
+    host cost of the mask add + DFA advance (the mask changes no tokens)."""
+    import numpy as np
+
+    from ray_tpu.llm import ByteTokenizer
+    from ray_tpu.llm._engine import SamplingParams
+    from ray_tpu.llm.generate import compile_constraint
+
+    engine, cfg, model_id, _ = build_engine(spec=False, slots=4)
+    prompt_len, max_tokens = (128, 64) if on_tpu else (16, 32)
+    n = 4
+    rng = np.random.default_rng(11)
+    constraint = compile_constraint("(.|\n)*", ByteTokenizer(), cfg.vocab_size)
+    try:
+        run_requests(engine, cfg.vocab_size, 2, prompt_len, max_tokens)  # warm
+        results = {}
+        for mode in ("plain", "guided"):
+            done = [threading.Event() for _ in range(n)]
+            counts = [0] * n
+            t0 = time.perf_counter()
+
+            def cb_for(i):
+                def cb(token, finished):
+                    counts[i] += 1
+                    if finished:
+                        done[i].set()
+
+                return cb
+
+            for i in range(n):
+                prompt = rng.integers(0, 256, prompt_len).tolist()
+                engine.submit(
+                    prompt, SamplingParams(max_tokens=max_tokens), cb_for(i),
+                    constraint=constraint if mode == "guided" else None,
+                )
+            for ev in done:
+                ev.wait(600)
+            results[mode] = sum(counts) / (time.perf_counter() - t0)
+        return {
+            "metric": "guided_decode_overhead",
+            "value": round(results["guided"], 1),
+            "plain_tokens_per_s": round(results["plain"], 1),
+            "guided_over_plain": round(results["guided"] / results["plain"], 3),
+            "model": model_id,
+        }
+    finally:
+        engine.shutdown()
+
+
+def bench_batch_coexistence(on_tpu: bool):
+    """Round 22 (docs/generation.md): online TTFT p50/p99 with a deep
+    floor-weight batch-tenant backlog queued vs a no-batch baseline — the
+    number the batch-admission policy exists to protect."""
+    import numpy as np
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.llm._engine import SamplingParams
+
+    engine, cfg, model_id, _ = build_engine(spec=False, slots=4)
+    prompt_len = 128 if on_tpu else 16
+    rng = np.random.default_rng(13)
+
+    def timed_online(n):
+        ttfts, dones = [], []
+        for _ in range(n):
+            first = [None]
+            done = threading.Event()
+            t0 = time.perf_counter()
+
+            def cb(tok, fin, first=first, done=done, t0=t0):
+                if first[0] is None and tok >= 0:
+                    first[0] = time.perf_counter() - t0
+                if fin:
+                    done.set()
+
+            engine.submit(
+                rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
+                SamplingParams(max_tokens=8), cb, tenant="online")
+            dones.append((done, first))
+            time.sleep(0.02)
+        for done, first in dones:
+            done.wait(600)
+            ttfts.append(first[0])
+        return ttfts
+
+    try:
+        run_requests(engine, cfg.vocab_size, 2, prompt_len, 8)  # warm
+        base = timed_online(8)
+        batch_done = [threading.Event() for _ in range(16)]
+        for i in range(16):
+            engine.submit(
+                rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
+                SamplingParams(max_tokens=24),
+                lambda t, f, ev=batch_done[i]: ev.set() if f else None,
+                tenant=CONFIG.llm_batch_tenant)
+        loaded = timed_online(8)
+        for ev in batch_done:
+            ev.wait(600)
+        return {
+            "metric": "batch_coexistence",
+            "value": round(_pctl(loaded, 0.99), 4),
+            "online_ttft_p50_s": round(_pctl(loaded, 0.5), 4),
+            "baseline_ttft_p99_s": round(_pctl(base, 0.99), 4),
+            "loaded_over_baseline_p99": round(
+                _pctl(loaded, 0.99) / max(_pctl(base, 0.99), 1e-9), 2),
+            "batch_backlog_rows": 16,
+            "model": model_id,
+        }
+    finally:
+        engine.shutdown()
+
+
 def main():
     import jax
 
@@ -935,6 +1098,12 @@ def main():
     # Tensor-parallel decode sweep + model-larger-than-one-chip (round 15,
     # docs/serving_tp.md).
     results.extend(bench_tp_sweep(on_tpu))
+
+    # Generation modes (round 22, docs/generation.md): streaming TTFT tax,
+    # guided-mask host overhead, and online TTFT under a batch backlog.
+    results.append(bench_stream_ttft_vs_blocking(on_tpu))
+    results.append(bench_guided_decode_overhead(on_tpu))
+    results.append(bench_batch_coexistence(on_tpu))
 
     # PD disaggregation TTFT across real replica actors (round 11).
     results.append(bench_pd_ttft())

@@ -102,6 +102,13 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_slo_ttft_s": (float, 0.5, "time-to-first-token SLO: completions whose TTFT exceeds this count as SLO breaches in the llm_slo_* burn/goodput counters (docs/observability.md)"),
     "llm_slo_tpot_s": (float, 0.05, "per-request mean inter-token-latency SLO: completions whose mean TPOT exceeds this count as SLO breaches (docs/observability.md)"),
     "llm_slo_error_budget": (float, 0.01, "allowed SLO breach fraction: llm_slo_burn_rate = windowed breach fraction / this budget, so burn > 1 means the error budget is being exhausted"),
+    "llm_guided_max_states": (int, 4096, "DFA state cap for guided-decoding constraint compilation (docs/generation.md): a regex/schema/grammar whose subset construction exceeds this raises at compile time instead of growing compile memory unboundedly"),
+    "llm_guided_max_depth": (int, 8, "bounded-recursion inlining rounds for grammar constraints: a <rule> reference surviving this many substitution rounds is unbounded CFG recursion and fails compilation (it cannot lower to a finite token-mask DFA)"),
+    "llm_guided_cache_entries": (int, 32, "compiled-constraint LRU entries per server/tokenizer (docs/generation.md): repeated guided requests against the same schema skip DFA construction and reuse the cached per-state token masks"),
+    "llm_stream_buffer_tokens": (int, 4096, "undelivered buffered tokens a TokenStream holds before cancelling its own request (docs/generation.md): a stalled streaming consumer sheds the slot instead of growing host memory without bound (0 disables the guard)"),
+    "llm_batch_tenant": (str, "batch", "the WFQ tenant name offline batch traffic (data/llm.py EngineStage) is admitted under on live serve replicas (docs/generation.md): this tenant is pinned to llm_batch_weight and excluded from autopilot SLO signals, so online traffic always preempts batch and batch pressure never scales the fleet"),
+    "llm_batch_weight": (float, 1e-6, "the floor WFQ weight pinned on the llm_batch_tenant queues: batch admissions take enormous stride-pass steps, so they only drain when no online tenant has queued work (set_tenant_weight cannot raise it — the floor is structural)"),
+    "llm_batch_max_inflight": (int, 16, "bounded in-flight window for EngineStage batch submission: at most this many rows ride the engine/serve queues concurrently, so one batch block cannot flood an online replica's admission queue (0 = submit the whole block up front)"),
     # --- serve autopilot (docs/autoscale.md) ---
     "serve_autopilot": (bool, False, "closed-loop SLO autopilot inside the serve controller: scales DP replicas on burn-rate/queue pressure, nudges per-tenant WFQ weights toward SLO attainment, and rebalances the prefill:decode split (docs/autoscale.md)"),
     "serve_autopilot_interval_s": (float, 1.0, "autopilot control-law evaluation interval; signals are probed and laws evaluated at most this often inside the controller's control loop"),

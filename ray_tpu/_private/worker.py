@@ -3171,6 +3171,22 @@ class CoreWorker:
                         await loop.run_in_executor(None, send, value)
             except rpc.RpcError:
                 return
+            except asyncio.CancelledError:
+                # Stream cancelled mid-flight (serve cancel plane / actor
+                # teardown): close the producer so its finally-blocks release
+                # what they hold, then finish the stream cleanly — the owner
+                # sees a short stream, not a failed task.
+                try:
+                    if hasattr(result, "aclose"):
+                        await result.aclose()
+                    elif hasattr(result, "close"):
+                        result.close()
+                except Exception:
+                    pass  # producer teardown is best-effort: the stream still
+                    # finishes below, and the generator's own finally already
+                    # released what it held before the close raised
+                await loop.run_in_executor(None, finish)
+                return
             except Exception as e:  # noqa: BLE001
                 err = RayTpuTaskError.from_exception(spec["name"], e)
                 await loop.run_in_executor(None, lambda: send(err, error=True))

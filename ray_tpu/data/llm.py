@@ -73,6 +73,13 @@ class EngineProcessorConfig(ProcessorConfig):
     # Record the per-batch token emission order (row indices) in an
     # "emit_order" column — proof that continuous batching interleaved rows.
     record_emit_order: bool = False
+    # Shared-fleet batch mode (docs/generation.md): a serve DeploymentHandle
+    # (picklable: app+deployment names) routes this stage's rows into LIVE
+    # serve replicas as the zero-floor-weight batch WFQ tenant instead of
+    # building a dedicated engine per pool actor. Online traffic always
+    # preempts: the scheduler's batch tenant has a floor weight and the
+    # autopilot ignores batch pressure (no scale-up on batch load).
+    serve_handle: Optional[Any] = None
 
 
 # Keep the reference's public spelling available for drop-in familiarity.
@@ -219,6 +226,13 @@ class EngineStage:
         from ray_tpu.llm._engine import DecodeEngine
 
         self._config = config
+        self._handle = config.serve_handle
+        if self._handle is not None:
+            # Shared-fleet mode: rows ride live serve replicas as the batch
+            # tenant; no local engine (and no extra compiled programs).
+            self._engine = None
+            self._pid = os.getpid()
+            return
         kwargs = dict(config.engine_kwargs)
         llm_cfg = LLMConfig(
             model_id=config.model_id,
@@ -239,8 +253,87 @@ class EngineStage:
         )
         self._pid = os.getpid()
 
+    @staticmethod
+    def _row_sampling(defaults: Dict[str, Any], row: Dict[str, Any]) -> dict:
+        # Arrow struct columns null-pad keys missing in some rows; a None
+        # must not shadow a configured default.
+        row_sp = {
+            k: v for k, v in (row.get("sampling_params") or {}).items()
+            if v is not None
+        }
+        return {**defaults, **row_sp}
+
+    @staticmethod
+    def _row_token_ids(row: Dict[str, Any]) -> List[int]:
+        token_ids = row.get("tokenized_prompt")
+        if token_ids is None:
+            raise ValueError(
+                "engine stage needs a 'tokenized_prompt' column; enable "
+                "tokenize=True or provide token ids in preprocess"
+            )
+        return [int(t) for t in token_ids]
+
+    def _call_serve(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Shared-fleet mode: each row becomes one generate() on a live
+        serve replica, tagged as the batch WFQ tenant, with a bounded
+        in-flight window so batch load can never swamp a replica's admission
+        queue ahead of online traffic (docs/generation.md)."""
+        from ray_tpu._private.config import CONFIG
+
+        rows = _rows(batch)
+        if not rows:
+            return batch
+        defaults = self._config.sampling_params
+        tenant = CONFIG.llm_batch_tenant
+        window = max(1, int(CONFIG.llm_batch_max_inflight))
+        t0 = time.monotonic()
+        results: List[Optional[dict]] = [None] * len(rows)
+        inflight: List[Tuple[int, Any]] = []  # (row index, response) FIFO
+        prompt_lens: List[int] = []
+
+        def drain_one():
+            i, resp = inflight.pop(0)
+            results[i] = resp.result(timeout_s=300)
+
+        for i, row in enumerate(rows):
+            sp = self._row_sampling(defaults, row)
+            token_ids = self._row_token_ids(row)
+            prompt_lens.append(len(token_ids))
+            while len(inflight) >= window:
+                drain_one()
+            inflight.append((i, self._handle.generate.remote(
+                token_ids,
+                max_tokens=int(sp.get("max_tokens", 32)),
+                temperature=float(sp.get("temperature", 0.0)),
+                top_k=int(sp.get("top_k", 0)),
+                stop_token_id=sp.get("stop_token_id"),
+                lora=str(sp.get("lora", "")),
+                tenant=tenant,
+            )))
+        while inflight:
+            drain_one()
+        dt = max(time.monotonic() - t0, 1e-9)
+        gen_tokens = sum(len(r["token_ids"]) for r in results)
+        if self._config.log_stats:
+            print(
+                f"[data.llm] serve batch of {len(rows)} prompts: {gen_tokens} "
+                f"tokens in {dt:.2f}s = {gen_tokens / dt:.1f} tok/s "
+                f"(tenant {tenant!r})"
+            )
+        for i, row in enumerate(rows):
+            row["generated_tokens"] = list(results[i]["token_ids"])
+            row["num_input_tokens"] = prompt_lens[i]
+            row["num_generated_tokens"] = len(results[i]["token_ids"])
+            row["batch_tokens_per_s"] = gen_tokens / dt
+            row["engine_pid"] = self._pid
+        return _rows_to_batch(rows)
+
     def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
-        from ray_tpu.llm._engine import SamplingParams
+        from ray_tpu.llm._engine import EngineOverloadedError, SamplingParams
+
+        if self._handle is not None:
+            return self._call_serve(batch)
+        from ray_tpu._private.config import CONFIG
 
         rows = _rows(batch)
         if not rows:
@@ -251,52 +344,77 @@ class EngineStage:
         emit_lock = threading.Lock()
         emit_order: List[int] = []
         t0 = time.monotonic()
+        # Bounded in-flight window (docs/generation.md): at most
+        # llm_batch_max_inflight rows live in the engine at once, so a colocated
+        # online tenant's admissions always find queue room — batch preempts
+        # nothing. Released by each row's finish callback.
+        window = threading.Semaphore(max(1, int(CONFIG.llm_batch_max_inflight)))
+        rids = [f"batch-{id(done_events):x}-{i}" for i in range(len(rows))]
 
         def make_cb(i: int):
             def cb(token: int, finished: bool):
                 with emit_lock:
-                    outputs[i].append(int(token))
-                    emit_order.append(i)
+                    if token >= 0:
+                        outputs[i].append(int(token))
+                        emit_order.append(i)
                 if finished:
                     done_events[i].set()
+                    window.release()
 
             return cb
 
         prompt_lens = []
+        dead = False
         for i, row in enumerate(rows):
-            # Arrow struct columns null-pad keys missing in some rows; a None
-            # must not shadow a configured default.
-            row_sp = {
-                k: v for k, v in (row.get("sampling_params") or {}).items()
-                if v is not None
-            }
-            sp = {**defaults, **row_sp}
-            token_ids = row.get("tokenized_prompt")
-            if token_ids is None:
-                raise ValueError(
-                    "engine stage needs a 'tokenized_prompt' column; enable "
-                    "tokenize=True or provide token ids in preprocess"
-                )
-            token_ids = [int(t) for t in token_ids]
+            sp = self._row_sampling(defaults, row)
+            token_ids = self._row_token_ids(row)
             prompt_lens.append(len(token_ids))
-            self._engine.submit(
-                token_ids,
-                SamplingParams(
-                    max_tokens=int(sp.get("max_tokens", 32)),
-                    temperature=float(sp.get("temperature", 0.0)),
-                    top_k=int(sp.get("top_k", 0)),
-                    stop_token_id=sp.get("stop_token_id"),
-                ),
-                make_cb(i),
-                lora=str(sp.get("lora", "")),
-            )
-        for ev in done_events:
-            # Poll-wait so a dead stepper thread fails the batch instead of
-            # hanging the whole Data job on callbacks that will never fire.
-            while not ev.wait(2.0):
+            while not window.acquire(timeout=2.0):
+                if self._engine.error is not None:
+                    dead = True
+                    break
+            if dead:
+                break
+            while True:
+                try:
+                    self._engine.submit(
+                        token_ids,
+                        SamplingParams(
+                            max_tokens=int(sp.get("max_tokens", 32)),
+                            temperature=float(sp.get("temperature", 0.0)),
+                            top_k=int(sp.get("top_k", 0)),
+                            stop_token_id=sp.get("stop_token_id"),
+                        ),
+                        make_cb(i),
+                        lora=str(sp.get("lora", "")),
+                        tenant=CONFIG.llm_batch_tenant,
+                        request_id=rids[i],
+                    )
+                    break
+                except EngineOverloadedError:
+                    if self._engine.error is not None:
+                        dead = True
+                        break
+                    time.sleep(0.05)  # queue full of online traffic: yield
+            if dead:
+                break
+        if not dead:
+            for ev in done_events:
+                # Poll-wait so a dead stepper thread fails the batch instead
+                # of hanging the whole Data job on callbacks that never fire.
+                while not ev.wait(2.0):
+                    if self._engine.error is not None:
+                        break
                 if self._engine.error is not None:
                     break
         if self._engine.error is not None:
+            # Cancel/drain every still-unfinished submission BEFORE raising:
+            # a failed batch must leave zero live slots or leases behind
+            # (leaksan flight_record / lease books balance). cancel() is
+            # queue-side-safe even with the stepper dead and never raises.
+            for i, ev in enumerate(done_events):
+                if not ev.is_set():
+                    self._engine.cancel(rids[i])
             raise RuntimeError(
                 "LLM engine stepper died"
             ) from self._engine.error

@@ -212,6 +212,22 @@ PROTOCOL_TABLE: Tuple[ProtocolSpec, ...] = (
         members=(("shutdown", (0, ())),),
         anchors=("shutdown",),
     ),
+    # Round 21 (docs/generation.md): the streaming front-door pair. The
+    # OpenAI router dispatches body["stream"] to generate_stream and
+    # everything else to generate on the SAME handle — a deployed class
+    # exposing the streaming half without the blocking twin (or accepting
+    # different request knobs on each) breaks that dispatch, and the
+    # SSE-vs-blocking token-identity tests stop meaning anything.
+    ProtocolSpec(
+        "llm-stream-surface",
+        members=(
+            ("generate", (1, ("max_tokens", "temperature", "top_k",
+                              "lora", "guided"))),
+            ("generate_stream", (1, ("max_tokens", "temperature", "top_k",
+                                     "lora", "guided"))),
+        ),
+        anchors=("generate_stream",),
+    ),
 )
 
 

@@ -131,6 +131,17 @@ RESOURCE_TABLE: Tuple[ResourceSpec, ...] = (
     # target that the next controller restart replays.
     ResourceSpec("autopilot scale-op token (ScaleOp)", "begin_scale_op",
                  release=("commit", "abort")),
+    # Round 22 (docs/generation.md): the generation-modes plane. An
+    # open_stream() nobody closes orphans a decode slot behind a vanished
+    # consumer — the slot, its prefix lease, and its adapter pin stay live
+    # until max_tokens runs out (or forever on a stalled constraint). A
+    # guided-decoding ConstraintState begun but never released keeps its
+    # token-DFA walk (and the leaksan book entry) past the request's life.
+    ResourceSpec("engine token stream (TokenStream)", "open_stream",
+                 release=("close", "cancel")),
+    ResourceSpec("guided-decoding constraint state (ConstraintState)",
+                 "begin", hints=("constraint", "guided"),
+                 release=("release",)),
 )
 
 #: Methods that release SOMETHING in this codebase's vocabulary; RL802/RL803

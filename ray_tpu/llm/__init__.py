@@ -40,6 +40,14 @@ class ByteTokenizer:
     def decode(self, ids: List[int]) -> str:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
+    def token_bytes(self, token_id: int) -> Optional[bytes]:
+        """Exact byte rendering of one token (the guided-decoding byte-DFA
+        keys its token masks on this; docs/generation.md). None marks an
+        unrenderable id, which the mask then permanently disallows."""
+        if 0 <= token_id < 256:
+            return bytes([token_id])
+        return None
+
 
 class HFTokenizer:
     """Adapter over a HuggingFace tokenizer (encode/decode protocol)."""
@@ -177,6 +185,14 @@ class LLMServer:
         self._cfg = cfg
         self._config = config
         self._tokenizer = resolve_tokenizer(config.tokenizer)
+        # Guided decoding (docs/generation.md): specs compile ONCE per
+        # distinct schema/regex against this replica's tokenizer and model
+        # vocab, then every request with the same spec reuses the DFA.
+        from ray_tpu.llm.generate import ConstraintCompiler
+
+        self._constraints = ConstraintCompiler(
+            self._tokenizer, cfg.vocab_size
+        )
         self._engine = DecodeEngine(
             cfg, params, num_slots=config.num_slots,
             max_seq=config.max_seq or min(cfg.max_seq, 2048), seed=config.seed,
@@ -196,9 +212,11 @@ class LLMServer:
                        max_tokens: int = 64, temperature: float = 0.0,
                        top_k: int = 0, stop_token_id: Optional[int] = None,
                        lora: str = "", tenant: Optional[str] = None,
-                       route: Optional[str] = None) -> dict:
+                       route: Optional[str] = None,
+                       guided=None) -> dict:
         t0 = time.monotonic()
         rid = uuid.uuid4().hex  # keys the engine's flight-recorder record
+        constraint = self._constraints.get(guided) if guided is not None else None
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -222,6 +240,7 @@ class LLMServer:
                            top_k=top_k, stop_token_id=stop_token_id),
             cb,
             lora=lora, tenant=tenant, request_id=rid, route=route,
+            constraint=constraint,
         )
         await done
         gen = list(out)
@@ -245,27 +264,35 @@ class LLMServer:
     async def generate_stream(self, prompt: Union[str, List[int]], *,
                               max_tokens: int = 64, temperature: float = 0.0,
                               top_k: int = 0, stop_token_id: Optional[int] = None,
-                              lora: str = ""):
+                              lora: str = "", tenant: Optional[str] = None,
+                              route: Optional[str] = None,
+                              request_id: Optional[str] = None,
+                              guided=None):
         """Async generator: yields text increments as tokens are decoded.
 
         SSE-ready: the OpenAI router maps each item to one `data:` event
         (reference: vllm_engine.py generate -> StreamingResponse path).
+        Closing the generator mid-stream (client disconnect) cancels the
+        engine request: GeneratorExit lands on the `await`, the finally
+        closes the TokenStream, and close() retires the slot / releases
+        leases within one scheduler iteration (docs/generation.md).
         """
         token_ids = (
             self._tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
+        constraint = self._constraints.get(guided) if guided is not None else None
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
         def cb(token: int, finished: bool):
             loop.call_soon_threadsafe(queue.put_nowait, (token, finished))
 
-        self._engine.submit(
+        stream = self._engine.open_stream(
             token_ids,
             SamplingParams(max_tokens=max_tokens, temperature=temperature,
                            top_k=top_k, stop_token_id=stop_token_id),
-            cb,
-            lora=lora,
+            lora=lora, tenant=tenant, route=route, request_id=request_id,
+            on_token=cb, constraint=constraint,
         )
         # Incremental detokenization with a short prefix window: deltas come
         # from decode(prefix + pending) minus decode(prefix), so tokenizers
@@ -276,21 +303,28 @@ class LLMServer:
         PREFIX = 8
         emitted: List[int] = []
         sent = 0  # tokens already covered by yielded text
-        while True:
-            token, finished = await queue.get()
-            if not (finished and stop_token_id is not None and token == stop_token_id):
-                emitted.append(token)
-            prefix = emitted[max(0, sent - PREFIX):sent]
-            cur = self._tokenizer.decode(prefix + emitted[sent:])
-            base = self._tokenizer.decode(prefix) if prefix else ""
-            delta = cur[len(base):]
-            if delta.endswith("�") and not finished:
-                pass  # mid-codepoint: hold until the remaining bytes arrive
-            elif delta:
-                yield delta
-                sent = len(emitted)
-            if finished:
-                return
+        try:
+            while True:
+                token, finished = await queue.get()
+                if token >= 0 and not (
+                    finished and stop_token_id is not None and token == stop_token_id
+                ):
+                    emitted.append(token)
+                prefix = emitted[max(0, sent - PREFIX):sent]
+                cur = self._tokenizer.decode(prefix + emitted[sent:])
+                base = self._tokenizer.decode(prefix) if prefix else ""
+                delta = cur[len(base):]
+                if delta.endswith("�") and not finished:
+                    pass  # mid-codepoint: hold until the remaining bytes arrive
+                elif delta:
+                    yield delta
+                    sent = len(emitted)
+                if finished:
+                    return
+        finally:
+            # No-op after a clean finish; on disconnect/error this is the
+            # cancel path that frees the slot and the constraint state.
+            stream.close()
 
     async def model_id(self) -> str:
         return self._config.model_id
@@ -472,6 +506,20 @@ class OpenAIRouter:
             top_k=int(body.get("top_k", 0)),
             lora=lora,
         )
+        # Guided decoding (docs/generation.md): OpenAI `response_format`
+        # json_schema envelope, plus the vLLM-style guided_* extensions.
+        guided = None
+        rf = body.get("response_format")
+        if isinstance(rf, dict) and rf.get("type") == "json_schema":
+            guided = {"json_schema": rf.get("json_schema", {})}
+        if body.get("guided_regex"):
+            guided = {"regex": body["guided_regex"]}
+        elif body.get("guided_json"):
+            guided = {"json_schema": body["guided_json"]}
+        elif body.get("guided_grammar") is not None:
+            guided = {"grammar": body["guided_grammar"]}
+        if guided is not None:
+            gen_kwargs["guided"] = guided
         created = int(time.time())
         if body.get("stream"):
             yield {"__serve_content_type__": "text/event-stream"}
@@ -494,18 +542,25 @@ class OpenAIRouter:
                          "model": model, "choices": [choice]}
                 return f"data: {_json.dumps(chunk)}\n\n"
 
+            stream = handle.options(stream=True).generate_stream.remote(
+                prompt, **gen_kwargs
+            )
             try:
-                stream = handle.options(stream=True).generate_stream.remote(
-                    prompt, **gen_kwargs
-                )
                 first = True
                 async for delta_text in stream:
                     yield sse(delta_text, first=first)
                     first = False
-            except KeyError:
+            except (KeyError, ValueError):
                 yield sse("", finish_reason="error")
                 yield "data: [DONE]\n\n"
                 return
+            finally:
+                # Client disconnect raises GeneratorExit at the yield above;
+                # closing the deployment stream propagates the cancel to the
+                # replica so the decode slot frees (docs/generation.md).
+                close = getattr(stream, "close", None)
+                if close is not None:
+                    close()
             yield sse("", finish_reason="length")
             yield "data: [DONE]\n\n"
             return
@@ -525,6 +580,14 @@ class OpenAIRouter:
             yield {"__serve_content_type__": "application/json"}
             yield {"error": {"message": f"unknown lora adapter in model {model!r}",
                              "type": "invalid_request_error"}}
+            return
+        except ValueError as e:
+            # Guided-decoding compile rejections (SchemaError/PatternError/
+            # GrammarError are ValueError subclasses) and other bad params.
+            yield {"__serve_content_type__": "application/json"}
+            yield {"error": {"message": str(e),
+                             "type": "invalid_request_error",
+                             "code": "guided_decoding"}}
             return
         yield {"__serve_content_type__": "application/json"}
         if is_chat:

@@ -325,6 +325,14 @@ class DecodeEngine:
         self._lens = np.zeros((self.B,), np.int32)
         self._last_token = np.zeros((self.B,), np.int32)
         self._stop = False
+        # Cross-thread cancel plane (docs/generation.md): cancel() resolves
+        # still-QUEUED requests synchronously under the scheduler's
+        # admission lock; anything already prefilling or decoding goes into
+        # this set and the stepper retires it at the TOP of its next
+        # iteration — a mid-stream disconnect frees the slot, lease,
+        # adapter pin, and constraint state within one scheduler iteration.
+        self._pending_cancels: set = set()
+        self._cancel_lock = threading.Lock()
         # Set when the stepper thread dies on an exception; submitters check it
         # instead of waiting forever on callbacks that will never fire.
         self.error: Optional[BaseException] = None
@@ -658,12 +666,24 @@ class DecodeEngine:
         return toks, caches, lens
 
     def _spec_verify_batched(self, params, lora, adapter_ids, tokens, caches,
-                             lens, gate):
+                             lens, gate, constraint_mask):
         """Target forward over [t0, d1..dk] for EVERY slot in one dispatch:
         tokens [B, k+1] at positions lens..lens+k. Non-participating slots
         (gate False) flow through the forward for batching but leave their
         KV rows untouched — the canonical row for a plainly-decoding slot is
         written by the decode dispatch that follows the verify phase.
+
+        constraint_mask [B, k+1, V] is the guided-decoding composition point
+        (docs/generation.md): an ALWAYS-PASSED additive logits mask — all
+        zeros for unguided slots — folded in before the argmax, so the same
+        ONE verify program per k serves guided and unguided traffic (no
+        guided program variant, no recompile when a guided request lands).
+        A disallowed draft token's mask row pins its logit to -inf, the
+        masked argmax disagrees with the proposal, and the standard
+        acceptance rule rejects at that position with the masked argmax as
+        the correction — exactly what masked plain decode would emit, which
+        is what keeps guided spec decode token-identical.
+
         Returns on-device argmax [B, k+1] (the host needs k+1 ints per slot,
         not logits)."""
         B, S = tokens.shape
@@ -673,6 +693,7 @@ class DecodeEngine:
             params, self.cfg, tokens, positions, caches, lens, kv_mask,
             lora=lora, adapter_ids=adapter_ids, write_gate=gate,
         )
+        logits = logits + constraint_mask
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_caches
 
     # -- speculative phase --------------------------------------------------
@@ -689,6 +710,12 @@ class DecodeEngine:
         tokens = np.zeros((self.B, S), np.int32)
         gate = np.zeros((self.B,), bool)
         base_lens: Dict[int, int] = {}
+        # Guided composition (docs/generation.md): per-position constraint
+        # masks for guided participants, zeros elsewhere — built host-side
+        # by walking a CLONE of each slot's automaton through its KNOWN
+        # proposal (the real state advances only through _emit). The array
+        # is always passed, so the verify program's signature never forks.
+        cmask = np.zeros((self.B, S, self.cfg.vocab_size), np.float32)
         for i in plan.spec_slots:
             s = self._sched.slots[i]
             p = plan.proposals[i]
@@ -696,6 +723,12 @@ class DecodeEngine:
             tokens[i, 1:1 + len(p)] = p
             gate[i] = True
             base_lens[i] = s.host_len
+            if s.constraint is not None:
+                rows = s.constraint.proposal_masks(
+                    [int(x) for x in p], s.params.stop_token_id, length=S,
+                    budget=s.params.max_tokens - s.generated,
+                )
+                cmask[i, :len(rows)] = rows
         t_verify = time.time()
         verify = self._program(
             self._jit_spec_verify, ("verify", S),
@@ -704,7 +737,7 @@ class DecodeEngine:
         greedy_dev, self._caches = verify(
             self.params, self._lora_tables(), jnp.asarray(self._adapter_ids),
             jnp.asarray(tokens), self._caches, jnp.asarray(self._lens),
-            jnp.asarray(gate),
+            jnp.asarray(gate), jnp.asarray(cmask),
         )
         # The round's ONE acceptance sync: k+1 tokens per participating slot
         # arrive in a single batched pull — no per-token host round trip.
@@ -891,19 +924,31 @@ class DecodeEngine:
         from ray_tpu.devtools import distsan
 
         with distsan.report_path("autopilot_signals"):
+            from ray_tpu._private.config import CONFIG
+
             st = self._sched.stats()
             self._flush_observability()
             burns = self._serve_metrics.burn_rates()
+            # Batch is NON-SLO load (docs/generation.md): its queued depth
+            # and burn are excluded from the control-law signals, so a deep
+            # offline backlog never scales the fleet up or steals tenant
+            # weight — online pressure alone drives the laws.
+            batch = CONFIG.llm_batch_tenant
+            tenants = st.get("tenants") or {}
+            batch_queued = int((tenants.get(batch) or {}).get("queued", 0))
+            online_burns = {t: b for t, b in burns.items() if t != batch}
             return {
                 "role": "engine",
-                "queued": st.get("queue_depth", 0),
+                "queued": max(0, st.get("queue_depth", 0) - batch_queued),
                 "running": (st.get("running", 0) or 0)
                 + (st.get("prefilling", 0) or 0),
-                "burn_rate": max(burns.values(), default=0.0),
-                "tenant_burn": {t: b for t, b in burns.items() if t},
+                "burn_rate": max(online_burns.values(), default=0.0),
+                "tenant_burn": {
+                    t: b for t, b in online_burns.items() if t
+                },
                 "tenant_weights": {
                     t: info.get("weight", 1.0)
-                    for t, info in (st.get("tenants") or {}).items()
+                    for t, info in tenants.items() if t != batch
                 },
             }
 
@@ -990,15 +1035,22 @@ class DecodeEngine:
     # -- public API --------------------------------------------------------
     def submit(self, token_ids: List[int], sampling: SamplingParams, callback,
                lora: str = "", tenant: Optional[str] = None,
-               request_id: Optional[str] = None, route: Optional[str] = None):
+               request_id: Optional[str] = None, route: Optional[str] = None,
+               constraint=None):
         """callback(token_id: int, finished: bool) per generated token.
 
         tenant keys the weighted-fair admission queue (docs/multitenancy.md);
         it defaults to the adapter name, the natural tenant identity of a
         LoRA fleet. request_id keys the flight-recorder record (the serve
         layers pass theirs so `request_timing()` can surface the breakdown
-        in response metadata); route is the DP router's routing reason,
-        recorded for the trace. Raises ValueError when the prompt cannot fit
+        in response metadata) AND is the `cancel()` handle; route is the DP
+        router's routing reason, recorded for the trace. constraint is a
+        compiled guided-decoding `TokenConstraint`
+        (ray_tpu.llm.generate.compile_constraint — callers own the
+        tokenizer, the engine owns the per-request state): its token masks
+        fold into this request's host sampling rows and spec-verify gate,
+        and its state releases on finish/cancel/drain/shutdown
+        (docs/generation.md). Raises ValueError when the prompt cannot fit
         the engine's sequence budget (it is never silently truncated),
         UnknownAdapterError for an unregistered adapter,
         EngineOverloadedError when the tenant's quota or the global depth
@@ -1016,6 +1068,7 @@ class DecodeEngine:
                 f"client-side or raise max_seq"
             )
         adapter = self._adapter_index(lora)
+        self._check_constraint(constraint)
         # The prompt is never truncated; a generation budget that would
         # overflow the KV rows shrinks max_tokens instead.
         headroom = self.T - 1 - len(token_ids)
@@ -1026,15 +1079,37 @@ class DecodeEngine:
             "prompt", prompt=token_ids, sampling=sampling, callback=callback,
             adapter=adapter, tenant=tenant,
         )
+        req.rid = request_id
         req.rec = self._start_record(request_id, tenant, route,
                                      prompt_len=len(token_ids))
+        if constraint is not None:
+            req.constraint = constraint.begin(
+                request_id or f"req-{id(req):x}"
+            )
         try:
             self._sched.submit(req)
         except EngineOverloadedError:
+            if req.constraint is not None:
+                req.constraint.release()
+                req.constraint = None
             summary = self._recorder.finish(req.rec, status="rejected")
             if summary is not None:
                 self._serve_metrics.record(summary)
             raise
+
+    def _check_constraint(self, constraint):
+        """A constraint compiled against a different logits width would
+        mis-mask silently; fail the submit loudly instead."""
+        if constraint is None:
+            return
+        vocab = getattr(constraint, "vocab", None)
+        if vocab is not None and int(vocab) != int(self.cfg.vocab_size):
+            raise ValueError(
+                f"guided constraint compiled for vocab {vocab} but this "
+                f"engine's model has vocab_size={self.cfg.vocab_size}; "
+                f"compile_constraint(spec, tokenizer, vocab_size) must use "
+                f"the MODEL's logits width"
+            )
 
     def _start_record(self, request_id: Optional[str], tenant: str,
                       route: Optional[str] = None, **mark_attrs):
@@ -1058,7 +1133,8 @@ class DecodeEngine:
                          token_ids: Optional[List[int]] = None,
                          tenant: Optional[str] = None,
                          request_id: Optional[str] = None,
-                         transfer_s: Optional[float] = None):
+                         transfer_s: Optional[float] = None,
+                         constraint=None):
         """Admit a request whose prefill ran elsewhere (PD disaggregation,
         reference prefill_decode_disagg.py): kv [L, 2, P, Hkv, D] is the
         transferred cache prefix — host numpy, or a jax Array when the
@@ -1075,6 +1151,7 @@ class DecodeEngine:
                 f"max_seq (build_pd_openai_app shares one config)"
             )
         adapter = self._adapter_index(lora)
+        self._check_constraint(constraint)
         # Same KV headroom contract as the prompt path: the cache must hold
         # prompt_len + max_tokens rows, so a long transferred prefix shrinks
         # the generation budget rather than silently wrapping the cache.
@@ -1089,6 +1166,7 @@ class DecodeEngine:
             adapter=adapter, kv=kv, first_logits=first_logits,
             tenant=tenant,
         )
+        req.rid = request_id
         req.rec = self._start_record(request_id, tenant,
                                      prompt_len=int(prompt_len))
         if req.rec is not None and transfer_s is not None:
@@ -1096,13 +1174,132 @@ class DecodeEngine:
             t1 = time.time()
             req.rec.span("pd-transfer", t1 - transfer_s, t1,
                          prompt_len=int(prompt_len))
+        if constraint is not None:
+            req.constraint = constraint.begin(
+                request_id or f"req-{id(req):x}"
+            )
         try:
             self._sched.submit(req)
         except EngineOverloadedError:
+            if req.constraint is not None:
+                req.constraint.release()
+                req.constraint = None
             summary = self._recorder.finish(req.rec, status="rejected")
             if summary is not None:
                 self._serve_metrics.record(summary)
             raise
+
+    def open_stream(self, token_ids: List[int], sampling: SamplingParams, *,
+                    lora: str = "", tenant: Optional[str] = None,
+                    request_id: Optional[str] = None,
+                    route: Optional[str] = None, on_token=None,
+                    constraint=None, buffer_cap: Optional[int] = None):
+        """Submit a request and return its `TokenStream` subscription
+        (docs/generation.md) instead of wiring a raw callback: per-token
+        delivery via iteration/`get()` (buffered) or the `on_token` relay
+        (the asyncio-bridge shape generate_stream uses). The stream's
+        `close()`/`cancel()` is the mid-stream-disconnect path — it cancels
+        the underlying request, and the engine frees the slot, prefix
+        lease, adapter pin, and constraint state within one scheduler
+        iteration. Lifecycle: every open_stream must resolve through
+        close() (iterating to exhaustion closes for you); leaksan's
+        token_stream books fail tests on a stranded subscription."""
+        import uuid
+
+        from ray_tpu.llm.generate import TokenStream
+
+        rid = request_id or f"stream-{uuid.uuid4().hex}"
+        stream = TokenStream(self, rid, on_token=on_token,
+                             buffer_cap=buffer_cap)
+        try:
+            self.submit(
+                token_ids, sampling, stream._push, lora=lora, tenant=tenant,
+                request_id=rid, route=route, constraint=constraint,
+            )
+        except BaseException:
+            # The submit never enqueued: close the subscription WITHOUT the
+            # cancel round-trip (there is no request to cancel).
+            stream._finished.set()
+            stream.close()
+            raise
+        return stream
+
+    def cancel(self, request_id: Optional[str]) -> bool:
+        """Cancel one request by the id its submit carried (the mid-stream
+        client-disconnect path; docs/generation.md). Still-QUEUED requests
+        retire synchronously here: callback fires (-1, True), the flight
+        record finishes as `cancelled`, the constraint state releases.
+        Anything already prefilling or decoding is handed to the stepper
+        through the pending-cancel set and retires at the top of its next
+        iteration — slot, prefix lease, adapter pin, and constraint state
+        all free within ONE scheduler iteration. Never raises: cancelling
+        an unknown/finished id (or racing engine shutdown) is a no-op —
+        the terminal paths already freed everything."""
+        if not request_id:
+            return False
+        req = self._sched.cancel_queued(request_id)
+        if req is not None:
+            self._fail_cancelled_request(req)
+            return True
+        with self._cancel_lock:
+            self._pending_cancels.add(request_id)
+        return True
+
+    def _fail_cancelled_request(self, req: Request):
+        """Retire a cancelled not-yet-active request: books balance (lease,
+        adapter pin, constraint, flight record) and the callback observes
+        the terminal sentinel exactly once."""
+        if req.constraint is not None:
+            req.constraint.release()
+            req.constraint = None
+        rec, req.rec = req.rec, None
+        summary = self._recorder.finish(rec, status="cancelled")
+        if summary is not None:
+            self._serve_metrics.record(summary)
+        if req.callback is not None:
+            try:
+                req.callback(-1, True)
+            except Exception:
+                pass  # the cancel must complete past a broken callback
+
+    def _process_cancels(self):
+        """Stepper-side half of cancel(): runs at the top of every loop
+        iteration, so an active/prefilling cancel completes within one
+        scheduler iteration. Ids that match nothing (request already
+        finished, or cancelled while queued) drop silently."""
+        with self._cancel_lock:
+            if not self._pending_cancels:
+                return
+            rids, self._pending_cancels = self._pending_cancels, set()
+        for rid in rids:
+            self._cancel_one(rid)
+
+    def _cancel_one(self, rid: str):
+        # Queued again-check first: a cancel() that raced admission may have
+        # missed the queue scan while the request was still queued.
+        req = self._sched.cancel_queued(rid)
+        if req is None:
+            req = self._sched.cancel_prefilling(rid)
+        if req is not None:
+            self._fail_cancelled_request(req)
+            return
+        for i, s in enumerate(self._sched.slots):
+            if not s.active or s.rid != rid:
+                continue
+            s.active = False
+            if s.constraint is not None:
+                s.constraint.release()
+                s.constraint = None
+            self._finish_record(s, status="cancelled")
+            self._release_slot_pin(s)
+            if self._draft is not None:
+                self._draft.on_finish(i, s)
+            if s.callback is not None:
+                try:
+                    s.callback(-1, True)
+                except Exception:
+                    pass  # the cancel must complete past a broken callback
+            return
 
     def prefill_detached(self, token_ids: List[int], lora: str = "",
                          request_id: Optional[str] = None,
@@ -1338,6 +1535,7 @@ class DecodeEngine:
             self._thread.join(timeout=5)
         for slot in self._sched.slots:
             self._release_slot_pin(slot)  # adapter pins die with the engine
+            self._release_slot_constraint(slot)
             if slot.active and slot.callback is not None:
                 slot.active = False
                 try:
@@ -1345,6 +1543,7 @@ class DecodeEngine:
                 except Exception:
                     pass  # shutdown must proceed past a broken callback
         for req in self._sched.drain():
+            # drain() released each request's lease/pin/constraint already.
             if req.callback is not None:
                 try:
                     req.callback(-1, True)
@@ -1520,7 +1719,12 @@ class DecodeEngine:
         # The admission sync: the request's FIRST token must be sampled
         # host-side before the slot can join the decode batch — one
         # [V]-row pull per admitted request, not per step or per chunk.
-        first = _sample_host(np.asarray(last_logits), req.sampling, self._np_rng)  # raylint: disable=RL603 (one per-admission pull)
+        first_row = np.asarray(last_logits)  # raylint: disable=RL603 (one per-admission pull)
+        if req.constraint is not None:
+            first_row = first_row + req.constraint.mask(
+                req.sampling.stop_token_id, budget=req.sampling.max_tokens
+            )
+        first = _sample_host(first_row, req.sampling, self._np_rng)
         if self._prefix_cache is not None:
             self._insert_prompt_kv(slot, req.prompt, req.adapter,
                                    req.cached_offset)
@@ -1575,8 +1779,14 @@ class DecodeEngine:
             req.rec.span("pd-attach", t_attach, time.time(),
                          prompt_len=prompt_len, bucket=bucket,
                          on_device=on_device)
-        first = _sample_host(np.asarray(req.first_logits), req.sampling,
-                             self._np_rng)
+        first_row = np.asarray(req.first_logits)
+        if req.constraint is not None:
+            # Guided PD decode: the transferred first-logits row gets the
+            # same start-state mask a local prefill's first sample would.
+            first_row = first_row + req.constraint.mask(
+                req.sampling.stop_token_id, budget=req.sampling.max_tokens
+            )
+        first = _sample_host(first_row, req.sampling, self._np_rng)
         prompt_tokens = req.prompt
         # PD-disagg transferred prefixes feed the prefix cache too: the
         # host-side kv is already in pool layout, so insertion is free of
@@ -1614,19 +1824,21 @@ class DecodeEngine:
         self._last_token[slot] = first
         self._emit(slot, first)
 
-    def _finish_record(self, s):
+    def _finish_record(self, s, status: str = "ok"):
         """Retire a slot's flight record exactly once: the decode phase
         aggregates into ONE span (first..last token — the per-token record
         is the timestamp list, not n events) and the completion summary
         queues for the report-path metrics flush (a GCS RPC must never ride
-        this loop)."""
+        this loop). status="cancelled" is the disconnect path — the record
+        retires under that outcome and stays OUT of the SLO good/bad books
+        (a client hanging up is not an availability breach)."""
         rec, s.rec = s.rec, None
         if rec is None:
             return
         tt = rec.token_times
         if tt:
             rec.span("decode", tt[0], tt[-1], tokens=len(tt))
-        summary = self._recorder.finish(rec)
+        summary = self._recorder.finish(rec, status=status)
         if summary is not None:
             self._serve_metrics.record(summary)
 
@@ -1636,6 +1848,17 @@ class DecodeEngine:
             s.generated >= s.params.max_tokens
             or (s.params.stop_token_id is not None and token == s.params.stop_token_id)
         )
+        if s.constraint is not None:
+            if (s.params.stop_token_id is not None
+                    and token == s.params.stop_token_id):
+                pass  # the stop token ends output; it never enters the DFA
+            else:
+                s.constraint.advance(token)
+                if s.constraint.is_complete():
+                    # Accepting dead-end: nothing can legally extend the
+                    # output — finish NOW instead of burning max_tokens on
+                    # tokens the mask would make degenerate.
+                    done = True
         self._sched.note_emitted(slot)  # per-tenant decode-token metering
         if s.rec is not None:
             s.rec.token()  # host timestamp append; TTFT/TPOT derive from these
@@ -1652,6 +1875,9 @@ class DecodeEngine:
             self._finish_record(s)  # callback-abort path: books still balance
         if done:
             s.active = False
+            if s.constraint is not None:
+                s.constraint.release()  # guided books balance on finish
+                s.constraint = None
             self._release_slot_pin(s)
             if self._draft is not None:
                 self._draft.on_finish(slot, s)
@@ -1669,6 +1895,17 @@ class DecodeEngine:
             except Exception:
                 pass  # a poisoned cache must not break finish/teardown
 
+    @staticmethod
+    def _release_slot_constraint(s):
+        """Release a slot's guided constraint state exactly once on the
+        terminal paths that bypass _emit (shutdown, stepper death)."""
+        state, s.constraint = s.constraint, None
+        if state is not None:
+            try:
+                state.release()
+            except Exception:
+                pass  # leaksan books must balance even on a broken state
+
     def _loop(self):
         try:
             self._loop_inner()
@@ -1684,6 +1921,7 @@ class DecodeEngine:
             # forever: fail every active/queued request loudly.
             for slot in self._sched.slots:
                 self._release_slot_pin(slot)
+                self._release_slot_constraint(slot)
                 if slot.active and slot.callback is not None:
                     slot.active = False
                     try:
@@ -1709,6 +1947,10 @@ class DecodeEngine:
 
         with distsan.hot_path("llm-decode-loop"):
             while not self._stop:
+                # Disconnect cancels retire FIRST (before planning), so a
+                # cancelled slot never joins another decode dispatch: the
+                # cancel-to-free latency is bounded by one iteration.
+                self._process_cancels()
                 plan = self._sched.next_plan(draft=self._draft)
                 if plan.idle:
                     time.sleep(0.002)
@@ -1751,7 +1993,22 @@ class DecodeEngine:
             self._lens[i] += 1  # the decode step wrote this slot's kv row
             if not s.active:
                 continue
-            token = _sample_host(logits_np[i], s.params, self._np_rng)
+            row = logits_np[i]
+            if s.constraint is not None:
+                # Guided composition point (docs/generation.md): one cached
+                # [V] mask row + one numpy add on the already-pulled logits
+                # — strictly host-side, zero new compiled programs. When the
+                # unconstrained argmax is already legal the mask cannot
+                # change it, so guided greedy output is token-identical to
+                # unconstrained greedy except where the constraint binds.
+                # budget= steers onto a completable path once remaining
+                # max_tokens gets tight (an unbounded quantifier must not
+                # eat the budget and truncate mid-pattern).
+                row = row + s.constraint.mask(
+                    s.params.stop_token_id,
+                    budget=s.params.max_tokens - s.generated,
+                )
+            token = _sample_host(row, s.params, self._np_rng)
             s.generated += 1
             s.host_len += 1
             s.tokens.append(token)

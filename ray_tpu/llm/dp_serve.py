@@ -446,6 +446,73 @@ class DPRouter:
         args = (token_ids,) if token_ids is not None else (prompt,)
         return await self._submit(router, replica, args, kw)
 
+    async def generate_stream(self, prompt: Union[str, List[int]], **kw):
+        """Streaming twin of generate(): the SAME cache/adapter-aware pick,
+        remote-fetch, and routing bookkeeping, then per-token deltas streamed
+        from the chosen rank (docs/generation.md). Closing this generator
+        mid-stream rides the serve cancel plane down to the rank's engine —
+        the finally closes the inner stream, which fires cancel_stream on the
+        replica, and the decode slot frees within one scheduler iteration."""
+        token_ids: Optional[List[int]] = None
+        if isinstance(prompt, (list, tuple)):
+            token_ids = list(prompt)
+        elif self._tokenizer is not None:
+            token_ids = self._tokenizer.encode(prompt)
+        chain = self._chain(token_ids) if token_ids else []
+        adapter = kw.get("lora") or ""
+        routable = getattr(self._server.generate, "_get_router", None)
+        if (not chain and not adapter) or routable is None:
+            self._routing["untracked"] += 1
+            stream = self._server.options(stream=True).generate_stream.remote(
+                prompt, **kw
+            )
+            try:
+                async for delta in stream:
+                    yield delta
+            finally:
+                stream.close()
+            return
+        replica, router, mode, holder = self._pick(chain, adapter)
+        if (holder is not None and token_ids is not None
+                and self._remote_fetch_enabled()):
+            if await self._remote_fetch(holder, replica, token_ids, adapter):
+                mode = "remote_fetch"
+                self._routing["remote_fetched"] += 1
+            else:
+                self._routing["remote_fetch_failed"] += 1
+        if mode != "remote_fetch":
+            self._routing[mode] += 1
+        self._record(replica._actor_id, chain, adapter)
+        if chain and token_ids is not None:
+            self._note_hot_prefix(chain, token_ids, adapter)
+        kw = dict(kw)
+        kw.setdefault("route", mode)
+        args = (token_ids,) if token_ids is not None else (prompt,)
+        # Stream from the SPECIFIC routed replica with the handle's exact
+        # cancel plane (token + cancel_stream thunk) and load bookkeeping.
+        import uuid
+
+        from ray_tpu.serve._replica import STREAM_CANCEL_KWARG
+        from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+        cancel_token = uuid.uuid4().hex
+        ref_gen = replica.handle_request_streaming.options(
+            num_returns="streaming"
+        ).remote("generate_stream", args,
+                 {**kw, STREAM_CANCEL_KWARG: cancel_token})
+
+        def cancel():
+            replica.cancel_stream.remote(cancel_token)  # raylint: disable=RL501 (fire-and-forget cancel; the stream's own finish is the observable)
+
+        gen = DeploymentResponseGenerator(
+            ref_gen, on_done=lambda: router.done(replica), cancel=cancel
+        )
+        try:
+            async for delta in gen:
+                yield delta
+        finally:
+            gen.close()
+
     async def ranks(self) -> dict:
         return await asyncio.get_running_loop().run_in_executor(
             None, lambda: ray_tpu.get(self._assigner.ranks.remote())

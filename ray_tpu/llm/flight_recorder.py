@@ -154,7 +154,7 @@ class FlightRecorder:
         self._unexported: deque = deque()  # summaries awaiting span export
         self._lock = threading.Lock()
         self._counters = {"started": 0, "finished": 0, "dropped": 0,
-                          "rejected": 0, "exported_spans": 0}
+                          "rejected": 0, "cancelled": 0, "exported_spans": 0}
         # OOM forensics (docs/observability.md "compute plane"): the ranked
         # device-memory ledger snapshot a RESOURCE_EXHAUSTED escape pinned
         # here before the engine re-raised. One slot — the FIRST OOM is the
@@ -194,12 +194,14 @@ class FlightRecorder:
     def finish(self, rec: Optional[RequestRecord],
                status: str = "ok") -> Optional[dict]:
         """Normal completion: move the record to the ring and queue its
-        summary for the report-path metrics flush. Idempotent."""
+        summary for the report-path metrics flush. Idempotent.
+        status="cancelled" (the mid-stream-disconnect path,
+        docs/generation.md) keeps its own counter so operators can tell
+        client hang-ups from served completions at a glance."""
         if rec is None:
             return None
-        return self._retire(
-            rec, status, "rejected" if status == "rejected" else "finished"
-        )
+        counter = status if status in ("rejected", "cancelled") else "finished"
+        return self._retire(rec, status, counter)
 
     def drop(self, rec: Optional[RequestRecord]) -> Optional[dict]:
         """Abnormal end (drain, stepper death, shutdown): books still
@@ -439,6 +441,13 @@ class ServeMetrics:
             for s in drained:
                 tenant = s.get("tenant") or ""
                 tags = {"tenant": tenant}
+                if s["status"] == "cancelled":
+                    # A client hang-up is visible (requests_total{outcome=
+                    # "cancelled"}) but NOT an SLO breach: it must not feed
+                    # the burn window the autopilot scales on, or a flaky
+                    # client could scale the fleet (docs/generation.md).
+                    m["requests"].inc(1, tags={**tags, "outcome": "cancelled"})
+                    continue
                 good = self.good(s)
                 with self._lock:
                     w = self._window.setdefault(

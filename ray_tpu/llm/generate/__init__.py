@@ -1,0 +1,54 @@
+"""ray_tpu.llm.generate: generation modes riding the one decode scheduler.
+
+Three coordinated pieces (docs/generation.md):
+
+- **Guided decoding** — `compile_constraint` / `Constraint` /
+  `ConstraintState`: regex, JSON-schema, and grammar specs compile to a
+  byte-DFA whose per-state token masks fold into the engine's existing
+  host sampling row and the batched spec-verify program (zero new compiled
+  programs; token-identical to unconstrained greedy whenever the
+  unconstrained argmax is already legal).
+- **Token streaming** — `TokenStream` from `DecodeEngine.open_stream`:
+  the cancellable per-token subscription that backs
+  `LLMServer.generate_stream` -> DP/PD routers -> SSE at the proxy, with
+  mid-stream disconnect cancelling the slot leak-free.
+- **Offline batch admission** — no class here: batch is a POLICY
+  (`llm_batch_tenant` floor-weight WFQ tenant + bounded in-flight window in
+  `ray_tpu.data.llm.EngineStage` + non-SLO autopilot signals), composed from
+  the scheduler/engine surfaces this package's modes also ride.
+"""
+
+from ray_tpu.llm.generate._constraint import (
+    Constraint,
+    ConstraintCompiler,
+    ConstraintState,
+    TokenConstraint,
+    compile_constraint,
+)
+from ray_tpu.llm.generate._fsm import (
+    PatternError,
+    compile_pattern,
+    escape_literal,
+    token_byte_table,
+)
+from ray_tpu.llm.generate._grammar import GrammarError, grammar_to_regex
+from ray_tpu.llm.generate._schema import SchemaError, schema_to_regex
+from ray_tpu.llm.generate._stream import StreamClosed, TokenStream
+
+__all__ = [
+    "Constraint",
+    "ConstraintCompiler",
+    "ConstraintState",
+    "GrammarError",
+    "PatternError",
+    "SchemaError",
+    "StreamClosed",
+    "TokenConstraint",
+    "TokenStream",
+    "compile_constraint",
+    "compile_pattern",
+    "escape_literal",
+    "grammar_to_regex",
+    "schema_to_regex",
+    "token_byte_table",
+]

@@ -181,12 +181,26 @@ class DecodeServer:
             tp=config.tp,
         )
 
-    async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
-                                 max_tokens: int = 64, temperature: float = 0.0,
-                                 top_k: int = 0, stop_token_id: Optional[int] = None,
-                                 lora: str = "",
-                                 token_ids: Optional[List[int]] = None,
-                                 request_id: Optional[str] = None) -> dict:
+    def _guided_constraint(self, guided):
+        """Compile (or cache-hit) a guided-decoding spec against this decode
+        engine's tokenizer/vocab — the constraint masks decode-side sampling
+        and spec-verify exactly as in the colocated engine
+        (docs/generation.md)."""
+        if guided is None:
+            return None
+        compiler = getattr(self, "_constraints", None)
+        if compiler is None:
+            from ray_tpu.llm.generate import ConstraintCompiler
+
+            compiler = self._constraints = ConstraintCompiler(
+                self._tokenizer, self._engine.cfg.vocab_size
+            )
+        return compiler.get(guided)
+
+    async def _pull_kv(self, kv):
+        """Resolve the transferred KV prefix (multicast subscription or
+        point-to-point DeviceObjectRef pull) to device/host rows.
+        Returns (kv, transfer_s)."""
         loop = asyncio.get_running_loop()
         from ray_tpu.experimental.device_objects import DeviceObjectRef, get as dev_get
 
@@ -244,6 +258,17 @@ class DecodeServer:
                                       sharding=kv_sharding)
             )
             transfer_s = time.monotonic() - t_pull  # the PD KV handoff leg
+        return kv, transfer_s
+
+    async def generate_prefilled(self, kv, prompt_len: int, first_logits, *,
+                                 max_tokens: int = 64, temperature: float = 0.0,
+                                 top_k: int = 0, stop_token_id: Optional[int] = None,
+                                 lora: str = "",
+                                 token_ids: Optional[List[int]] = None,
+                                 request_id: Optional[str] = None,
+                                 guided=None) -> dict:
+        loop = asyncio.get_running_loop()
+        kv, transfer_s = await self._pull_kv(kv)
         done: asyncio.Future = loop.create_future()
         out: List[int] = []
 
@@ -261,6 +286,7 @@ class DecodeServer:
                            top_k=top_k, stop_token_id=stop_token_id),
             cb, lora=lora, token_ids=token_ids,
             request_id=rid, transfer_s=transfer_s,
+            constraint=self._guided_constraint(guided),
         )
         await done
         gen = list(out)
@@ -268,6 +294,73 @@ class DecodeServer:
             gen = gen[:-1]
         return {"token_ids": gen, "text": self._tokenizer.decode(gen),
                 "timing": self._engine.request_timing(rid)}
+
+    async def generate_prefilled_stream(self, kv, prompt_len: int,
+                                        first_logits, *,
+                                        max_tokens: int = 64,
+                                        temperature: float = 0.0,
+                                        top_k: int = 0,
+                                        stop_token_id: Optional[int] = None,
+                                        lora: str = "",
+                                        token_ids: Optional[List[int]] = None,
+                                        request_id: Optional[str] = None,
+                                        guided=None):
+        """Streaming twin of generate_prefilled: pulls the transferred KV
+        prefix, then yields text deltas per decoded token
+        (docs/generation.md). Closing the generator mid-stream cancels the
+        decode slot via the engine's cancel plane — the finally closes the
+        TokenStream, and the multicast/point-to-point pull already completed
+        (its subscription released) before the first yield."""
+        loop = asyncio.get_running_loop()
+        kv, transfer_s = await self._pull_kv(kv)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def cb(token: int, finished: bool):
+            loop.call_soon_threadsafe(queue.put_nowait, (token, finished))
+
+        rid = request_id or uuid.uuid4().hex
+        from ray_tpu.llm.generate import TokenStream
+
+        stream = TokenStream(self._engine, rid, on_token=cb)
+        try:
+            self._engine.submit_prefilled(
+                kv, prompt_len, first_logits,
+                SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                               top_k=top_k, stop_token_id=stop_token_id),
+                stream._push, lora=lora, token_ids=token_ids,
+                request_id=rid, transfer_s=transfer_s,
+                constraint=self._guided_constraint(guided),
+            )
+        except Exception:
+            # Rejected at admission: nothing to cancel engine-side.
+            stream._finished.set()
+            stream.close()
+            raise
+        # Same incremental-detokenization window as LLMServer.generate_stream.
+        PREFIX = 8
+        emitted: List[int] = []
+        sent = 0
+        try:
+            while True:
+                token, finished = await queue.get()
+                if token >= 0 and not (
+                    finished and stop_token_id is not None
+                    and token == stop_token_id
+                ):
+                    emitted.append(token)
+                prefix = emitted[max(0, sent - PREFIX):sent]
+                cur = self._tokenizer.decode(prefix + emitted[sent:])
+                base = self._tokenizer.decode(prefix) if prefix else ""
+                delta = cur[len(base):]
+                if delta.endswith("�") and not finished:
+                    pass
+                elif delta:
+                    yield delta
+                    sent = len(emitted)
+                if finished:
+                    return
+        finally:
+            stream.close()
 
     async def load_lora(self, name: str, layer_weights: dict, alpha: float = 1.0):
         return self._engine.add_lora(name, layer_weights, alpha)
@@ -345,7 +438,7 @@ class PDRouter:
     async def generate(self, prompt: Union[str, List[int]], *,
                        max_tokens: int = 64, temperature: float = 0.0,
                        top_k: int = 0, stop_token_id: Optional[int] = None,
-                       lora: str = "") -> dict:
+                       lora: str = "", guided=None) -> dict:
         t0 = time.monotonic()
         # One request id spans both phases: the prefill-side and decode-side
         # flight records share it (and the caller's trace), so a PD request
@@ -360,7 +453,7 @@ class PDRouter:
         result = await self._decode.generate_prefilled.remote(
             pre["kv"], pre["prompt_len"], pre["first_logits"],
             max_tokens=max_tokens, temperature=temperature, top_k=top_k,
-            stop_token_id=stop_token_id, lora=lora,
+            stop_token_id=stop_token_id, lora=lora, guided=guided,
             # The prompt rides along so the decode engine can feed its prefix
             # cache with the transferred rows (docs/kvcache.md).
             token_ids=token_ids, request_id=rid,
@@ -377,6 +470,45 @@ class PDRouter:
             "prefill_s": t_prefill,
             "latency_s": latency_s,
         }
+
+    async def generate_stream(self, prompt: Union[str, List[int]], *,
+                              max_tokens: int = 64, temperature: float = 0.0,
+                              top_k: int = 0,
+                              stop_token_id: Optional[int] = None,
+                              lora: str = "", guided=None):
+        """Streaming PD path: prefill as usual, then per-token text deltas
+        stream from the decode pool (docs/generation.md). The prefill/KV
+        handoff completes before the first delta (TTFT covers it); closing
+        this generator mid-stream rides the serve cancel plane down to the
+        decode replica, which frees the slot within one scheduler iteration.
+        Phase-pressure samples land like generate()'s, with the delta count
+        standing in for the completion token count."""
+        t0 = time.monotonic()
+        rid = uuid.uuid4().hex
+        token_ids = (
+            self._tokenizer.encode(prompt) if isinstance(prompt, str)
+            else list(prompt)
+        )
+        pre = await self._prefill.prefill.remote(token_ids, lora,
+                                                 request_id=rid)
+        t_prefill = time.monotonic() - t0
+        stream = self._decode.options(
+            stream=True
+        ).generate_prefilled_stream.remote(
+            pre["kv"], pre["prompt_len"], pre["first_logits"],
+            max_tokens=max_tokens, temperature=temperature, top_k=top_k,
+            stop_token_id=stop_token_id, lora=lora, guided=guided,
+            token_ids=token_ids, request_id=rid,
+        )
+        chunks = 0
+        try:
+            async for delta in stream:
+                chunks += 1
+                yield delta
+        finally:
+            stream.close()
+            self._note_pd_sample(t_prefill, time.monotonic() - t0,
+                                 max(1, chunks))
 
     def _note_pd_sample(self, prefill_s: float, latency_s: float,
                         completion_tokens: int):

@@ -58,7 +58,7 @@ class Slot:
 
     __slots__ = ("active", "generated", "params", "callback", "prompt_len",
                  "tokens", "host_len", "adapter", "history", "tenant",
-                 "adapter_handle", "rec")
+                 "adapter_handle", "rec", "rid", "constraint")
 
     def __init__(self):
         self.active = False
@@ -72,6 +72,8 @@ class Slot:
         self.tenant = ""
         self.adapter_handle = None  # pin released when the slot finishes
         self.rec = None    # flight-recorder RequestRecord (host-side only)
+        self.rid = None    # request id: the engine cancel() lookup key
+        self.constraint = None  # guided-decoding ConstraintState (or None)
         # prompt + generated tokens: the draft providers' lookup corpus
         self.history: List[int] = []
 
@@ -93,7 +95,7 @@ class Request:
     __slots__ = ("kind", "prompt", "sampling", "callback", "adapter",
                  "prompt_len", "prefilled", "slot", "lease", "cached_offset",
                  "kv", "first_logits", "chunks", "tenant", "adapter_slot",
-                 "adapter_handle", "seq", "rec")
+                 "adapter_handle", "seq", "rec", "rid", "constraint")
 
     def __init__(self, kind: str, *, prompt: Optional[List[int]] = None,
                  sampling=None, callback=None, adapter: int = 0,
@@ -118,6 +120,8 @@ class Request:
         self.adapter_handle = None
         self.seq = 0                # arrival order (the FIFO control's key)
         self.rec = None             # flight-recorder RequestRecord (or None)
+        self.rid = None             # caller request id (cancel lookup key)
+        self.constraint = None      # guided ConstraintState (begin()..release())
 
 
 class ScheduledChunk:
@@ -208,11 +212,16 @@ class Scheduler:
         self.multi_step = max(1, int(multi_step))
         self._lookup = lookup       # prefix-cache lookup(prompt, adapter)
         self.wfq = bool(wfq)
-        if tenant_quota is None:
-            from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.config import CONFIG
 
+        if tenant_quota is None:
             tenant_quota = CONFIG.llm_tenant_max_queue_depth
         self._tenant_quota = max(0, int(tenant_quota))
+        # Offline batch admission (docs/generation.md): the batch tenant is
+        # PINNED to the floor weight — a policy, not a weight the autopilot
+        # or operators can raise — so online traffic always preempts it.
+        self._batch_tenant = CONFIG.llm_batch_tenant
+        self._batch_weight = max(1e-6, float(CONFIG.llm_batch_weight))
         self._weights: Dict[str, float] = dict(tenant_weights or {})
         # adapter uid -> AdapterHandle | None (engine-injected; None = the
         # cache is fully pinned, leave the request queued)
@@ -284,14 +293,23 @@ class Scheduler:
         """Caller holds the lock."""
         t = self._tenants.get(name)
         if t is None:
-            t = self._tenants[name] = _TenantState(
-                self._weights.get(name, 1.0)
-            )
+            if name == self._batch_tenant:
+                # Batch rides the SAME stride machinery as online tenants,
+                # at the floor weight: its per-token stride is enormous, so
+                # any online tenant's queued work wins every admission race
+                # while otherwise-idle capacity still drains batch rows.
+                t = self._tenants[name] = _TenantState(self._batch_weight)
+            else:
+                t = self._tenants[name] = _TenantState(
+                    self._weights.get(name, 1.0)
+                )
         return t
 
     def set_tenant_weight(self, tenant: str, weight: float):
         """Priority classes ride on weights: a tenant with weight w gets a
         w-proportional share of admitted tokens under saturation."""
+        if tenant == self._batch_tenant:
+            return  # the batch tenant's floor weight is not reshareable
         with self._lock:
             self._weights[tenant] = float(weight)
             if tenant in self._tenants:
@@ -362,7 +380,56 @@ class Scheduler:
                     handle.release()
                 except Exception:
                     pass  # cache poisoned mid-death; keep failing callbacks
+            if r.constraint is not None:
+                state, r.constraint = r.constraint, None
+                try:
+                    state.release()
+                except Exception:
+                    pass  # leaksan books must balance even mid-death
         return queued
+
+    def cancel_queued(self, rid: str) -> Optional[Request]:
+        """Remove one still-queued request by its id (ANY thread — the
+        client-disconnect path races the stepper's admission here, and the
+        admission lock arbitrates). Returns the request — its callback,
+        record, and constraint state are the caller's to fail/release — or
+        None when the id is not queued (it may be prefilling or active,
+        which only the stepper may touch; the engine's pending-cancel set
+        covers those within one scheduler iteration)."""
+        if not rid:
+            return None
+        with self._lock:
+            for t in self._tenants.values():
+                for r in t.queue:
+                    if r.rid == rid:
+                        t.queue.remove(r)
+                        self._depth -= 1
+                        return r
+        return None
+
+    def cancel_prefilling(self, rid: str) -> Optional[Request]:
+        """Remove one slot-assigned, still-chunk-prefilling request by id
+        (STEPPER THREAD ONLY: _prefilling is stepper-owned). Its prefix
+        lease and adapter pin release here; KV rows the dispatched chunks
+        already wrote are dead weight the slot's next occupant overwrites
+        write-before-read (same contract as rejected spec proposals)."""
+        for r in self._prefilling:
+            if r.rid == rid:
+                self._prefilling.remove(r)
+                if r.lease is not None:
+                    lease, r.lease = r.lease, None
+                    try:
+                        lease.release()
+                    except Exception:
+                        pass  # a poisoned pool must not block the cancel
+                if r.adapter_handle is not None:
+                    handle, r.adapter_handle = r.adapter_handle, None
+                    try:
+                        handle.release()
+                    except Exception:
+                        pass  # a poisoned adapter cache must not block the cancel
+                return r
+        return None
 
     # -- stepper-thread API -------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -579,7 +646,12 @@ class Scheduler:
         """Tokens per decode dispatch: >1 only when every active slot is
         greedy (on-device argmax is exact then), capped at the smallest
         remaining budget and power-of-two bucketed to bound the jit cache."""
-        if any(self.slots[i].params.temperature > 0 for i in decode_slots):
+        if any(self.slots[i].params.temperature > 0
+               or self.slots[i].constraint is not None
+               for i in decode_slots):
+            # Sampling slots need host-side sampling; GUIDED slots need the
+            # host-side constraint mask before each argmax — the on-device
+            # multi-token argmax chain can honor neither.
             return 1
         remaining = min(
             self.slots[i].params.max_tokens - self.slots[i].generated
@@ -624,6 +696,10 @@ class Scheduler:
         s.tenant = req.tenant
         s.adapter_handle, req.adapter_handle = req.adapter_handle, None
         s.rec = req.rec  # the decode phase records against the slot
+        s.rid = req.rid
+        # The constraint state rides the same request->slot handoff as the
+        # adapter pin: the engine releases it when the slot finishes.
+        s.constraint, req.constraint = req.constraint, None
         s.tokens = [first_token]
         s.history = list(req.prompt) + [first_token]
         if req in self._prefilling:

@@ -15,6 +15,11 @@ import inspect
 from typing import Any
 
 MUX_KWARG = "_serve_mux_model_id"
+# Streaming cancel plane (docs/generation.md): the handle injects a token into
+# streaming-call kwargs; an abandoned DeploymentResponseGenerator fires
+# cancel_stream(token) and the replica interrupts the endpoint generator so
+# its finally-blocks release what they hold (decode slots, leases, pins).
+STREAM_CANCEL_KWARG = "_serve_stream_cancel_token"
 
 
 async def _await_it(awaitable):
@@ -34,6 +39,7 @@ class Replica:
         self._app = app
         self._ongoing = 0
         self._total = 0
+        self._stream_cancels: dict = {}  # cancel token -> asyncio.Event
         if inspect.isclass(target):
             self._instance = target(*init_args, **init_kwargs)
         else:
@@ -152,6 +158,11 @@ class Replica:
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
         mux_id = kwargs.pop(MUX_KWARG, "")
+        cancel_token = kwargs.pop(STREAM_CANCEL_KWARG, None)
+        cancel_ev: "asyncio.Event | None" = None
+        if cancel_token is not None:
+            cancel_ev = asyncio.Event()
+            self._stream_cancels[cancel_token] = cancel_ev
         self._ongoing += 1
         self._total += 1
         token = _set_model_id(mux_id)
@@ -181,8 +192,12 @@ class Replica:
             if inspect.isawaitable(out):
                 out = await out
             if inspect.isasyncgen(out):
-                async for item in out:
-                    yield item
+                if cancel_ev is None:
+                    async for item in out:
+                        yield item
+                else:
+                    async for item in self._drive_cancellable(out, cancel_ev):
+                        yield item
             elif inspect.isgenerator(out):
                 loop = asyncio.get_running_loop()
                 done = object()
@@ -197,6 +212,9 @@ class Replica:
                         _reset_model_id(t)
 
                 while True:
+                    if cancel_ev is not None and cancel_ev.is_set():
+                        out.close()  # run the generator's finally-blocks
+                        break
                     item = await loop.run_in_executor(None, nxt)
                     if item is done:
                         break
@@ -204,8 +222,55 @@ class Replica:
             else:
                 yield out
         finally:
+            if cancel_token is not None:
+                self._stream_cancels.pop(cancel_token, None)
             _reset_model_id(token)
             self._ongoing -= 1
+
+    @staticmethod
+    async def _drive_cancellable(out, cancel_ev: "asyncio.Event"):
+        """Drive an async generator, aborting it when cancel_ev fires.
+
+        The abort cancels the in-flight __anext__, so the endpoint generator
+        resumes with CancelledError at its await point and its finally-blocks
+        run (LLMServer.generate_stream closes its TokenStream there, which
+        retires the decode slot within one scheduler iteration)."""
+        while True:
+            nxt = asyncio.ensure_future(out.__anext__())
+            waiter = asyncio.ensure_future(cancel_ev.wait())
+            try:
+                await asyncio.wait(
+                    {nxt, waiter}, return_when=asyncio.FIRST_COMPLETED
+                )
+            finally:
+                waiter.cancel()
+            if cancel_ev.is_set() and not nxt.done():
+                nxt.cancel()
+                try:
+                    await nxt
+                except (asyncio.CancelledError, StopAsyncIteration):
+                    pass
+                try:
+                    await out.aclose()  # no-op if the cancel already closed it
+                except Exception:
+                    pass  # the generator's finally already ran on cancel;
+                    # a second close failing must not mask the cancel path
+                return
+            try:
+                item = await nxt
+            except StopAsyncIteration:
+                return
+            yield item
+
+    async def cancel_stream(self, token: str) -> bool:
+        """Cancel plane for abandoned streams (client disconnect): the handle
+        fires this with the token it injected; returns False for unknown /
+        already-finished streams (cancel is idempotent and never raises)."""
+        ev = self._stream_cancels.get(token)
+        if ev is None:
+            return False
+        ev.set()
+        return True
 
     async def get_stats(self) -> dict:
         import os

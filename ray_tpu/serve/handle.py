@@ -70,10 +70,12 @@ class DeploymentResponseGenerator:
     endpoint streams, as they are produced (reference:
     serve.handle.DeploymentResponseGenerator over a streaming replica call)."""
 
-    def __init__(self, ref_gen, on_done=None):
+    def __init__(self, ref_gen, on_done=None, cancel=None):
         self._ref_gen = ref_gen  # ObjectRefGenerator
         self._on_done = on_done
+        self._cancel = cancel
         self._finished = False
+        self._exhausted = False  # producer ran to completion (no cancel needed)
 
     def _finish(self):
         if not self._finished:
@@ -82,7 +84,14 @@ class DeploymentResponseGenerator:
                 self._on_done()
 
     def close(self):
-        """Release router bookkeeping for an abandoned stream."""
+        """Release router bookkeeping for an abandoned stream, and — when the
+        producer is still live — fire the replica-side cancel so the endpoint
+        generator's finally-blocks run (docs/generation.md cancel plane)."""
+        if not self._exhausted and self._cancel is not None:
+            try:
+                self._cancel()
+            except Exception:
+                pass  # cancel is best-effort; the replica may already be gone
         self._finish()
 
     def __del__(self):
@@ -99,6 +108,7 @@ class DeploymentResponseGenerator:
             ref = next(self._ref_gen)
             return ray_tpu.get(ref)
         except StopIteration:
+            self._exhausted = True
             self._finish()
             raise
         except Exception:
@@ -438,12 +448,22 @@ class DeploymentHandle:
             kwargs = {**kwargs, MUX_KWARG: model_id}
 
         if self._stream:
+            import uuid
+
+            from ray_tpu.serve._replica import STREAM_CANCEL_KWARG
+
+            cancel_token = uuid.uuid4().hex
+            kwargs = {**kwargs, STREAM_CANCEL_KWARG: cancel_token}
             replica = router.pick(model_id)
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming"
             ).remote(method, args, kwargs)
+
+            def cancel():
+                replica.cancel_stream.remote(cancel_token)  # raylint: disable=RL501 (fire-and-forget cancel; the stream's own finish is the observable)
+
             return DeploymentResponseGenerator(
-                ref_gen, on_done=lambda: router.done(replica)
+                ref_gen, on_done=lambda: router.done(replica), cancel=cancel
             )
 
         def submit():

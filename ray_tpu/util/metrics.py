@@ -105,6 +105,20 @@ class Gauge(Metric):
             self._values[key] = value
         self._maybe_flush()
 
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        """Delta mutation (Prometheus up/down-gauge shape; the reference
+        Gauge is set-only). For live-occupancy series — active token
+        streams, batch in-flight windows — where concurrent reporters can't
+        know the absolute value to set()."""
+        _note_mutation(self._name)
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        self._maybe_flush()
+
+    def dec(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        self.inc(-value, tags)
+
 
 class Histogram(Metric):
     def __init__(self, name: str, description: str = "",

@@ -45,6 +45,9 @@ LEAKSAN_SUITES = {
     "test_flight_recorder.py",
     "test_xprof.py",
     "test_autopilot.py",
+    "test_llm_generate.py",
+    "test_llm_stream.py",
+    "test_llm_batch.py",
 }
 
 
@@ -83,6 +86,9 @@ DISTSAN_SUITES = {
     "test_llm_multitenant.py",
     "test_serve_observability.py",
     "test_autopilot.py",
+    "test_llm_generate.py",
+    "test_llm_stream.py",
+    "test_llm_batch.py",
 }
 
 

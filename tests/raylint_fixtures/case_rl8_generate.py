@@ -1,0 +1,84 @@
+"""RL801 fixtures for the round-22 generation-modes lifetimes
+(docs/generation.md): the engine token stream (DecodeEngine.open_stream ->
+TokenStream.close/cancel) and the guided-decoding constraint state
+(Constraint.begin -> ConstraintState.release). An unclosed stream orphans a
+decode slot (plus its prefix lease and adapter pin) behind a vanished
+consumer; an unreleased constraint state keeps its token-DFA walk past the
+request's life. Fire/suppress shapes mirror case_rl8_autopilot.py so the new
+obligations ride the same path analysis."""
+
+
+def bad_stream_never_closed(engine, token_ids, sampling):
+    stream = engine.open_stream(token_ids, sampling)
+    return stream.request_id
+
+
+def bad_stream_conditional(engine, token_ids, sampling, want_all):
+    stream = engine.open_stream(token_ids, sampling)
+    if want_all:
+        stream.close()
+
+
+def bad_stream_risky_gap(engine, proxy, token_ids, sampling):
+    stream = engine.open_stream(token_ids, sampling)
+    proxy.register(stream.request_id)
+    stream.close()
+
+
+def ok_stream_finally(engine, token_ids, sampling):
+    stream = engine.open_stream(token_ids, sampling)
+    try:
+        return list(stream)
+    finally:
+        stream.close()
+
+
+def ok_stream_cancel_finally(engine, token_ids, sampling):
+    stream = engine.open_stream(token_ids, sampling)
+    try:
+        return stream.get(timeout=1.0)
+    finally:
+        stream.cancel()
+
+
+def ok_stream_stored(server, engine, token_ids, sampling):
+    server.live_stream = engine.open_stream(token_ids, sampling)
+
+
+def ok_stream_returned(engine, token_ids, sampling):
+    return engine.open_stream(token_ids, sampling)
+
+
+def suppressed_stream(engine, token_ids, sampling):
+    stream = engine.open_stream(token_ids, sampling)  # raylint: disable=RL801 (fixture: close rides the consumer's iterator finally)
+    return stream.request_id
+
+
+def bad_constraint_never_released(constraint, rid):
+    state = constraint.begin(rid)
+    return state.mask(0)
+
+
+def bad_constraint_conditional(constraint, rid, accepted):
+    state = constraint.begin(rid)
+    if accepted:
+        state.release()
+
+
+def ok_constraint_finally(constraint, rid, tokens):
+    state = constraint.begin(rid)
+    try:
+        for t in tokens:
+            state.advance(t)
+        return state.is_complete()
+    finally:
+        state.release()
+
+
+def ok_constraint_stored(req, constraint, rid):
+    req.constraint = constraint.begin(rid)
+
+
+def suppressed_constraint(constraint, rid):
+    state = constraint.begin(rid)  # raylint: disable=RL801 (fixture: release rides the scheduler's finish path)
+    return state

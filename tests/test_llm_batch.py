@@ -1,0 +1,281 @@
+"""Offline batch on the shared fleet (docs/generation.md): EngineStage rows
+ride the decode scheduler as the zero-floor-weight batch WFQ tenant.
+
+The contract under test: online traffic always preempts queued batch rows
+(online TTFT stays in tolerance of a no-batch baseline even over a deep
+batch backlog); the batch tenant's floor weight is pinned (not reshareable);
+the autopilot's control-law signals exclude batch pressure entirely (a deep
+offline backlog must never scale the fleet); and a dying engine stepper
+cancels/drains the in-flight batch instead of hanging the Data job — with
+zero live slots, leases, or flight records left behind (this suite runs
+under the leaksan + distsan autouse guards).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _engine(tiny, **kw):
+    from ray_tpu.llm import DecodeEngine
+
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq", 128)
+    return DecodeEngine(cfg, params, **kw)
+
+
+def _submit_timed(engine, token_ids, *, max_tokens, tenant, results, idx):
+    """Submit one request; records (ttft_s, finished_at) into results[idx]."""
+    from ray_tpu.llm import SamplingParams
+
+    t0 = time.monotonic()
+    state = {"ttft": None, "done": threading.Event()}
+    results[idx] = state
+
+    def cb(tok, fin):
+        if state["ttft"] is None and tok >= 0:
+            state["ttft"] = time.monotonic() - t0
+        if fin:
+            state["end"] = time.monotonic()
+            state["done"].set()
+
+    engine.submit(list(token_ids), SamplingParams(max_tokens=max_tokens),
+                  cb, tenant=tenant)
+
+
+def test_online_ttft_survives_batch_backlog(tiny):
+    """Admission-level preemption: with a deep batch-tenant backlog queued,
+    online arrivals still reach a slot ahead of every queued batch row, so
+    online p99 TTFT stays within tolerance of the no-batch baseline (the
+    worst case is draining the one in-flight batch row per slot)."""
+    from ray_tpu._private.config import CONFIG
+
+    engine = _engine(tiny)
+    try:
+        # -- no-batch baseline -------------------------------------------
+        base: list = [None] * 4
+        for i in range(4):
+            _submit_timed(engine, b"online", max_tokens=8,
+                          tenant="online", results=base, idx=i)
+        for s in base:
+            assert s["done"].wait(300)
+        base_p99 = max(s["ttft"] for s in base)
+
+        # -- deep batch backlog + online arrivals ------------------------
+        batch: list = [None] * 10
+        for i in range(10):
+            _submit_timed(engine, b"batchrow", max_tokens=24,
+                          tenant=CONFIG.llm_batch_tenant, results=batch, idx=i)
+        online: list = [None] * 4
+        for i in range(4):
+            _submit_timed(engine, b"online", max_tokens=8,
+                          tenant="online", results=online, idx=i)
+        for s in online:
+            assert s["done"].wait(300)
+        online_p99 = max(s["ttft"] for s in online)
+        # Tolerance: one in-flight batch row per slot may drain first (24
+        # tokens), plus generous CI scheduling slack. What this catches is
+        # the failure mode — online queued BEHIND the 10-row backlog, whose
+        # TTFT would be the whole backlog's decode time.
+        assert online_p99 <= base_p99 * 10 + 3.0, (
+            f"online TTFT {online_p99:.3f}s vs baseline {base_p99:.3f}s: "
+            f"batch backlog starved online admission"
+        )
+        last_online = max(s["end"] for s in online)
+        for s in batch:
+            assert s["done"].wait(300)
+        last_batch = max(s["end"] for s in batch)
+        assert last_online < last_batch, (
+            "every online request should complete before the batch backlog "
+            "drains (batch is the background tenant)"
+        )
+    finally:
+        engine.shutdown()
+
+
+def test_batch_tenant_floor_weight_pinned(tiny):
+    """The batch tenant's WFQ weight is a floor, not a knob: the autopilot's
+    set_tenant_weight actuator must not reshare it upward."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.llm import SamplingParams
+
+    engine = _engine(tiny)
+    try:
+        done = threading.Event()
+        engine.submit(list(b"b"), SamplingParams(max_tokens=4),
+                      lambda t, f: done.set() if f else None,
+                      tenant=CONFIG.llm_batch_tenant)
+        assert done.wait(300)
+        engine.set_tenant_weight(CONFIG.llm_batch_tenant, 100.0)
+        st = engine.scheduler_stats()
+        weight = st["tenants"][CONFIG.llm_batch_tenant]["weight"]
+        assert weight <= max(1e-6, CONFIG.llm_batch_weight)
+    finally:
+        engine.shutdown()
+
+
+def test_autopilot_signals_exclude_batch_pressure(tiny):
+    """A deep offline backlog is NON-SLO load: the autopilot's queued depth,
+    tenant weights, and burn map must not see the batch tenant at all."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.llm import SamplingParams
+
+    engine = _engine(tiny)
+    try:
+        dones = []
+        for _ in range(12):
+            ev = threading.Event()
+            dones.append(ev)
+            engine.submit(
+                list(b"backlog"), SamplingParams(max_tokens=16),
+                lambda t, f, ev=ev: ev.set() if f else None,
+                tenant=CONFIG.llm_batch_tenant)
+        st = engine.scheduler_stats()
+        sig = engine.autopilot_signals()
+        if st["tenants"][CONFIG.llm_batch_tenant]["queued"] > 0:
+            # Backlog still queued when sampled: the signal must hide it.
+            assert sig["queued"] == 0, (st, sig)
+        assert CONFIG.llm_batch_tenant not in sig["tenant_weights"]
+        assert CONFIG.llm_batch_tenant not in sig["tenant_burn"]
+        for ev in dones:
+            assert ev.wait(300)
+    finally:
+        engine.shutdown()
+
+
+def _stage_batch(n, prompt=b"row"):
+    return {"tokenized_prompt": np.array([list(prompt) for _ in range(n)])}
+
+
+def test_engine_stage_rides_batch_tenant(tiny):
+    """The Data-plane stage tags every row as the batch tenant (that is what
+    makes coexistence and non-SLO treatment structural, not opt-in)."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.data.llm import EngineProcessorConfig, EngineStage
+
+    cfg = EngineProcessorConfig(
+        model_id="test-tiny",
+        engine_kwargs={"num_slots": 2, "max_seq": 128},
+        sampling_params={"max_tokens": 6},
+        log_stats=False,
+    )
+    stage = EngineStage(cfg)
+    try:
+        out = stage(_stage_batch(5))
+        assert all(n == 6 for n in out["num_generated_tokens"])
+        st = stage._engine.scheduler_stats()
+        assert st["tenants"][CONFIG.llm_batch_tenant]["admitted"] == 5
+    finally:
+        stage._engine.shutdown()
+
+
+def test_engine_stage_poisoned_stepper_cancels_and_raises(tiny):
+    """Stepper-death regression: a fault in the decode loop mid-batch must
+    fail the stage call loudly (RuntimeError, not a hang), cancel/drain
+    every unfinished row, and leave zero live slots or flight records —
+    the leaksan guard on this suite enforces the book balance."""
+    from ray_tpu.data.llm import EngineProcessorConfig, EngineStage
+
+    cfg = EngineProcessorConfig(
+        model_id="test-tiny",
+        engine_kwargs={"num_slots": 2, "max_seq": 128},
+        sampling_params={"max_tokens": 120},
+        log_stats=False,
+    )
+    stage = EngineStage(cfg)
+    engine = stage._engine
+    err: list = []
+
+    def run():
+        try:
+            stage(_stage_batch(4))
+        except RuntimeError as e:
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if engine._sched.stats()["running"] > 0:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("batch never reached the engine")
+
+        def boom():
+            raise RuntimeError("injected stepper fault")
+
+        engine._process_cancels = boom  # poison: dies at the next iteration
+        t.join(120)
+        assert not t.is_alive(), "stage call hung on a dead stepper"
+        assert err and "stepper died" in str(err[0])
+        assert engine.error is not None
+        st = engine._sched.stats()
+        assert st["running"] == 0 and st["queue_depth"] == 0
+        rec = engine._recorder.stats()
+        assert rec["live"] == 0  # every flight record retired
+        with pytest.raises(RuntimeError, match="stepper died"):
+            from ray_tpu.llm import SamplingParams
+
+            engine.submit([1], SamplingParams(max_tokens=2), lambda a, b: None)
+    finally:
+        t.join(5)
+        engine.shutdown()
+
+
+@pytest.fixture(scope="module")
+def llm_handle(_cluster):
+    from ray_tpu.llm import LLMConfig, build_llm_deployment
+
+    app = build_llm_deployment(LLMConfig(model_id="test-tiny", num_slots=2))
+    handle = serve.run(app, name="llm-batch", route_prefix=None,
+                       _timeout_s=240)
+    yield handle
+    serve.delete("llm-batch")
+
+
+def test_engine_stage_shared_fleet_via_serve_handle(tiny, llm_handle):
+    """Shared-fleet mode: serve_handle routes the stage's rows into LIVE
+    serve replicas as the batch tenant — no local engine, no new compiled
+    programs, and the replica's scheduler sees the batch tenant."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.data.llm import EngineProcessorConfig, EngineStage
+
+    cfg = EngineProcessorConfig(
+        model_id="test-tiny",
+        sampling_params={"max_tokens": 5},
+        serve_handle=llm_handle,
+        log_stats=False,
+    )
+    stage = EngineStage(cfg)
+    assert stage._engine is None  # no dedicated engine in shared-fleet mode
+    out = stage(_stage_batch(4))
+    assert all(n == 5 for n in out["num_generated_tokens"])
+    st = llm_handle.scheduler_stats.remote().result(timeout_s=120)
+    assert st["tenants"][CONFIG.llm_batch_tenant]["admitted"] >= 4

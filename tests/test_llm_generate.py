@@ -1,0 +1,314 @@
+"""Guided decoding (docs/generation.md): constraint-masked generation on the
+one decode scheduler.
+
+The contract under test: every guided output is 100% valid under its spec
+(regex / JSON schema / grammar); guidance is token-identical to unconstrained
+greedy whenever the unconstrained argmax is already legal; the masked
+spec-verify gate is token-identical to masked plain decode; and the
+per-request constraint state balances its leaksan books on every end-of-life
+path (this suite runs under the leaksan + distsan autouse guards).
+"""
+
+import json
+import re
+import threading
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Shared test-tiny config + params (engines are cheap, init is not)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _engine(tiny, **kw):
+    from ray_tpu.llm import DecodeEngine
+
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq", 128)
+    return DecodeEngine(cfg, params, **kw)
+
+
+def _run(engine, token_ids, sampling, constraint=None):
+    """Blocking generate via the raw callback surface; returns token list."""
+    acc = []
+    done = threading.Event()
+
+    def cb(tok, fin):
+        acc.append(tok)
+        if fin:
+            done.set()
+
+    engine.submit(list(token_ids), sampling, cb, constraint=constraint)
+    assert done.wait(300), "generation did not finish"
+    return [t for t in acc if t >= 0]
+
+
+def _compile(spec, vocab):
+    from ray_tpu.llm import ByteTokenizer
+    from ray_tpu.llm.generate import compile_constraint
+
+    return compile_constraint(spec, ByteTokenizer(), vocab)
+
+
+def test_guided_regex_output_fullmatches(tiny):
+    from ray_tpu.llm import ByteTokenizer, SamplingParams
+
+    cfg, _ = tiny
+    engine = _engine(tiny)
+    try:
+        constraint = _compile("[0-9]{4}", cfg.vocab_size)
+        toks = _run(engine, b"ab", SamplingParams(max_tokens=16),
+                    constraint=constraint)
+        text = ByteTokenizer().decode(toks)
+        # The accepting dead-end finishes the slot at exactly 4 digits —
+        # no stop token, no burned max_tokens budget.
+        assert re.fullmatch(r"[0-9]{4}", text), (toks, text)
+    finally:
+        engine.shutdown()
+
+
+def test_guided_json_schema_output_parses_valid(tiny):
+    from ray_tpu.llm import ByteTokenizer, SamplingParams
+
+    cfg, _ = tiny
+    schema = {
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"}, "n": {"type": "integer"}},
+        "required": ["ok", "n"],
+    }
+    engine = _engine(tiny)
+    try:
+        constraint = _compile({"json_schema": schema}, cfg.vocab_size)
+        toks = _run(engine, b"x", SamplingParams(max_tokens=48),
+                    constraint=constraint)
+        obj = json.loads(ByteTokenizer().decode(toks))
+        assert isinstance(obj["ok"], bool)
+        assert isinstance(obj["n"], int)
+        assert set(obj) == {"ok", "n"}
+    finally:
+        engine.shutdown()
+
+
+def test_guided_grammar_output_matches_lowered_regex(tiny):
+    from ray_tpu.llm import ByteTokenizer, SamplingParams
+    from ray_tpu.llm.generate import grammar_to_regex
+
+    cfg, _ = tiny
+    rules = {"root": "<word>(,<word>){0,2}", "word": "[a-z]{2,4}"}
+    engine = _engine(tiny)
+    try:
+        constraint = _compile({"grammar": rules}, cfg.vocab_size)
+        toks = _run(engine, b"q", SamplingParams(max_tokens=24),
+                    constraint=constraint)
+        text = ByteTokenizer().decode(toks)
+        # The lowered grammar is a plain regex in both the engine's subset
+        # and Python's re — validate against the exact same pattern.
+        assert re.fullmatch(grammar_to_regex(rules), text), text
+    finally:
+        engine.shutdown()
+
+
+def test_guided_identity_when_argmax_always_legal(tiny):
+    """A constraint that allows every byte adds 0 to every legal logit, so
+    guided greedy must be TOKEN-IDENTICAL to unconstrained greedy — and
+    guidance must compile ZERO new device programs (the masks are host-side
+    numpy on the already-pulled logits row)."""
+    from ray_tpu.llm import SamplingParams
+
+    cfg, _ = tiny
+    engine = _engine(tiny)
+    try:
+        prompt = b"hello"
+        base = _run(engine, prompt, SamplingParams(max_tokens=8))
+        compiles = engine.scheduler_stats()["programs"]["totals"]["compiles_total"]
+        constraint = _compile("(.|\n)*", cfg.vocab_size)
+        guided = _run(engine, prompt, SamplingParams(max_tokens=8),
+                      constraint=constraint)
+        assert guided == base
+        after = engine.scheduler_stats()["programs"]["totals"]["compiles_total"]
+        assert after == compiles, "guided decoding compiled a new program"
+    finally:
+        engine.shutdown()
+
+
+def test_guided_budget_steering_completes_within_max_tokens(tiny):
+    """An unbounded quantifier (JSON integers, a{1,50}) must not eat the
+    whole max_tokens budget and truncate mid-pattern: as the remaining
+    budget tightens, the mask steers onto a completable path, so the output
+    is ALWAYS a full match — for any model, any sampling."""
+    from ray_tpu.llm import ByteTokenizer, SamplingParams
+
+    cfg, _ = tiny
+    engine = _engine(tiny)
+    try:
+        constraint = _compile("a{1,50}b", cfg.vocab_size)
+        toks = _run(engine, b"go", SamplingParams(max_tokens=3),
+                    constraint=constraint)
+        text = ByteTokenizer().decode(toks)
+        assert re.fullmatch(r"a{1,50}b", text), text
+
+        schema = {"type": "object",
+                  "properties": {"ok": {"type": "boolean"},
+                                 "n": {"type": "integer"}},
+                  "required": ["ok", "n"]}
+        constraint = _compile({"json_schema": schema}, cfg.vocab_size)
+        toks = _run(engine, b"x", SamplingParams(max_tokens=20),
+                    constraint=constraint)
+        obj = json.loads(ByteTokenizer().decode(toks))
+        assert isinstance(obj["ok"], bool) and isinstance(obj["n"], int)
+    finally:
+        engine.shutdown()
+
+
+def test_guided_spec_verify_matches_plain_decode(tiny):
+    """The batched spec-verify gate composes the same per-position masks as
+    the host sampling row: masked spec decode ≡ masked plain decode."""
+    from ray_tpu.llm import SamplingParams
+
+    cfg, _ = tiny
+    constraint = _compile("[0-9]{6}", cfg.vocab_size)
+    plain = _engine(tiny, multi_step=1)
+    try:
+        want = _run(plain, b"n=", SamplingParams(max_tokens=12),
+                    constraint=constraint)
+    finally:
+        plain.shutdown()
+    spec = _engine(tiny, spec_config={"num_spec_tokens": 6})
+    try:
+        got = _run(spec, b"n=", SamplingParams(max_tokens=12),
+                   constraint=constraint)
+        st = spec.scheduler_stats()
+        assert st["spec"]["proposed_tokens"] > 0  # the gate actually ran
+    finally:
+        spec.shutdown()
+    assert got == want
+
+
+def test_constraint_vocab_mismatch_rejected_loudly(tiny):
+    """A constraint compiled against the wrong logits width must raise at
+    submit, never silently mask garbage — and must not leak state."""
+    from ray_tpu.llm import SamplingParams
+
+    cfg, _ = tiny
+    engine = _engine(tiny)
+    try:
+        bad = _compile("[0-9]+", cfg.vocab_size + 64)
+        with pytest.raises(ValueError, match="vocab"):
+            engine.submit([1, 2], SamplingParams(max_tokens=4),
+                          lambda t, f: None, constraint=bad)
+    finally:
+        engine.shutdown()
+
+
+def test_constraint_compiler_caches_by_spec(tiny):
+    from ray_tpu.llm import ByteTokenizer
+    from ray_tpu.llm.generate import ConstraintCompiler
+
+    cfg, _ = tiny
+    comp = ConstraintCompiler(ByteTokenizer(), cfg.vocab_size, capacity=2)
+    a1 = comp.get({"regex": "[0-9]+"})
+    a2 = comp.get({"regex": "[0-9]+"})
+    assert a1 is a2  # LRU hit skips DFA construction
+    comp.get({"regex": "[a-z]+"})
+    comp.get({"regex": "[A-Z]+"})  # evicts the oldest entry
+    assert comp.get({"regex": "[0-9]+"}) is not a1
+
+
+def test_fixture_catches_planted_constraint_state_leak(tiny):
+    """The leaksan contract for the guided plane: a ConstraintState begun
+    and never released grows the `constraint_state` kind; releasing clears
+    it (this is what fails any engine path that strands one)."""
+    from ray_tpu.devtools import leaksan
+
+    cfg, _ = tiny
+    constraint = _compile("[0-9]{2}", cfg.vocab_size)
+    before = leaksan.snapshot()
+    state = constraint.begin("planted-leak")
+    growth = leaksan.check_growth(before, settle_s=0.2)
+    assert "constraint_state" in growth, growth
+    state.release()
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+
+
+def test_guided_json_schema_through_http(ray_start_regular):
+    """End-to-end acceptance: an OpenAI `response_format` json_schema
+    request through the HTTP proxy returns parseable, schema-valid output;
+    an unsupported guided spec fails as a 4xx-shaped error, not a hang."""
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, build_openai_app
+
+    app = build_openai_app([LLMConfig(model_id="test-tiny", num_slots=2)])
+    serve.run(app, name="openai-guided", route_prefix="/", _timeout_s=240)
+    try:
+        port = serve.get_proxy_port()
+
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                data=json.dumps(payload).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                return json.loads(resp.read())
+
+        schema = {
+            "type": "object",
+            "properties": {"ok": {"type": "boolean"}},
+            "required": ["ok"],
+        }
+        out = post({
+            "model": "test-tiny",
+            "messages": [{"role": "user", "content": "give me json"}],
+            "max_tokens": 32,
+            "response_format": {"type": "json_schema",
+                                "json_schema": {"schema": schema}},
+        })
+        content = out["choices"][0]["message"]["content"]
+        obj = json.loads(content)
+        assert isinstance(obj["ok"], bool) and set(obj) == {"ok"}
+
+        bad = post({
+            "model": "test-tiny",
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 8,
+            "guided_json": {"type": "tuple"},  # outside the supported subset
+        })
+        assert bad["error"]["code"] == "guided_decoding"
+    finally:
+        serve.delete("openai-guided")
+        serve.shutdown()
+
+
+def test_guided_state_released_on_cancel(tiny):
+    """cancel() of a guided request frees the constraint state within one
+    scheduler iteration (the leaksan guard on this suite enforces the
+    balance; this asserts the cancelled flight record too)."""
+    from ray_tpu.llm import SamplingParams
+
+    cfg, _ = tiny
+    engine = _engine(tiny)
+    try:
+        constraint = _compile("[0-9]{64}", cfg.vocab_size)
+        done = threading.Event()
+        engine.submit([1], SamplingParams(max_tokens=120),
+                      lambda t, f: done.set() if f else None,
+                      request_id="guided-cancel", constraint=constraint)
+        engine.cancel("guided-cancel")
+        assert done.wait(60)
+        stats = engine.recorder_stats()
+        assert stats["cancelled"] >= 1
+    finally:
+        engine.shutdown()

@@ -1,0 +1,221 @@
+"""Token streaming (docs/generation.md): TokenStream at the engine, SSE-shaped
+generate_stream at the serve layer, and the mid-stream-disconnect cancel plane.
+
+The contract under test: a streamed request is token-identical to its
+blocking twin; closing a stream mid-flight cancels the request, frees the
+slot within one scheduler iteration, and finishes the flight record as
+`cancelled` (not an SLO breach); a stalled consumer is shed at the buffer
+cap instead of growing host memory. This suite runs under the leaksan +
+distsan autouse guards, so every path here must balance its books.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import Transformer, get_config
+
+    cfg = get_config("test-tiny", scan_layers=False, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _engine(tiny, **kw):
+    from ray_tpu.llm import DecodeEngine
+
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq", 128)
+    return DecodeEngine(cfg, params, **kw)
+
+
+def _wait_idle(engine, timeout=30.0):
+    """Poll until the scheduler holds zero active work (cancel-to-free is
+    one scheduler iteration; the poll absorbs CI timer jitter only)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = engine.scheduler_stats()
+        if not st.get("running") and not st.get("prefilling"):
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"engine never went idle: {engine.scheduler_stats()}")
+
+
+def test_open_stream_tokens_match_blocking(tiny):
+    from ray_tpu.llm import SamplingParams
+
+    engine = _engine(tiny)
+    try:
+        acc, done = [], threading.Event()
+
+        def cb(tok, fin):
+            acc.append(tok)
+            if fin:
+                done.set()
+
+        engine.submit(list(b"hi"), SamplingParams(max_tokens=8), cb)
+        assert done.wait(300)
+        blocking = [t for t in acc if t >= 0]
+
+        stream = engine.open_stream(list(b"hi"), SamplingParams(max_tokens=8))
+        streamed = list(stream)  # iteration closes on exhaustion
+        assert streamed == blocking
+    finally:
+        engine.shutdown()
+
+
+def test_stream_get_timeout_raises_stream_closed(tiny):
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.generate import StreamClosed
+
+    engine = _engine(tiny)
+    try:
+        stream = engine.open_stream(list(b"x"), SamplingParams(max_tokens=2))
+        try:
+            got = []
+            while True:
+                tok, fin = stream.get(timeout=120)
+                if tok >= 0:
+                    got.append(tok)
+                if fin:
+                    break
+            assert len(got) == 2
+            with pytest.raises(StreamClosed):
+                stream.get(timeout=0.05)  # drained: nothing further arrives
+        finally:
+            stream.close()
+    finally:
+        engine.shutdown()
+
+
+def test_mid_stream_disconnect_cancels_and_frees_slot(tiny):
+    """The disconnect path end to end at the engine: close() on a live
+    stream cancels the request, the slot frees within one scheduler
+    iteration, and the record retires as `cancelled`."""
+    from ray_tpu.llm import SamplingParams
+
+    engine = _engine(tiny)
+    try:
+        before = engine.recorder_stats()["cancelled"]
+        stream = engine.open_stream(
+            list(b"stream"), SamplingParams(max_tokens=120),
+            request_id="disconnect-me",
+        )
+        tok, fin = stream.get(timeout=120)
+        assert tok >= 0 and not fin  # mid-flight, provably
+        stream.close()
+        st = _wait_idle(engine)
+        assert st["queue_depth"] == 0
+        assert engine.recorder_stats()["cancelled"] == before + 1
+    finally:
+        engine.shutdown()
+
+
+def test_stalled_consumer_shed_at_buffer_cap(tiny):
+    """A consumer that never drains must not buffer without bound: past
+    `buffer_cap` undelivered tokens the stream cancels its own request."""
+    from ray_tpu.llm import SamplingParams
+
+    engine = _engine(tiny)
+    try:
+        stream = engine.open_stream(
+            list(b"y"), SamplingParams(max_tokens=120), buffer_cap=4,
+        )
+        try:
+            assert stream._finished.wait(120)  # self-cancel finished it
+            delivered = list(stream)
+            assert len(delivered) < 120, "cap never shed the request"
+            _wait_idle(engine)
+            assert engine.recorder_stats()["cancelled"] >= 1
+        finally:
+            stream.close()
+    finally:
+        engine.shutdown()
+
+
+def test_fixture_catches_planted_token_stream_leak():
+    """The leaksan contract for the streaming plane: a TokenStream opened
+    and never closed grows the `token_stream` kind; closing clears it."""
+    from ray_tpu.devtools import leaksan
+    from ray_tpu.llm.generate import TokenStream
+
+    class _StubEngine:
+        def cancel(self, rid):
+            return True
+
+    before = leaksan.snapshot()
+    stream = TokenStream(_StubEngine(), "planted-stream", buffer_cap=0)
+    growth = leaksan.check_growth(before, settle_s=0.2)
+    assert "token_stream" in growth, growth
+    stream.close()
+    assert leaksan.check_growth(before, settle_s=0.2) == {}
+
+
+# -- serve layer: generate_stream through a real deployment -------------------
+
+
+@pytest.fixture(scope="module")
+def llm_handle(_cluster):
+    from ray_tpu.llm import LLMConfig, build_llm_deployment
+
+    # max_seq is deliberately large: the disconnect test needs a request
+    # whose natural completion is far beyond the cancel round-trip, so the
+    # cancel is provably what retired it.
+    app = build_llm_deployment(
+        LLMConfig(model_id="test-tiny", num_slots=2, max_seq=4096))
+    handle = serve.run(app, name="llm-stream", route_prefix=None,
+                       _timeout_s=240)
+    yield handle
+    serve.delete("llm-stream")
+
+
+def test_serve_generate_stream_matches_blocking(llm_handle):
+    out = llm_handle.generate.remote("hi", max_tokens=8).result(timeout_s=240)
+    gen = llm_handle.options(stream=True).generate_stream.remote(
+        "hi", max_tokens=8)
+    try:
+        streamed = "".join(gen)
+    finally:
+        gen.close()
+    assert streamed == out["text"]
+
+
+def test_serve_stream_disconnect_cancels_replica_request(llm_handle):
+    """The full disconnect chain: handle-side close() -> replica cancel
+    event -> endpoint generator finally -> TokenStream.close -> engine
+    cancel. The replica's engine must retire the request as `cancelled`
+    and return to idle — a vanished client must not pin a decode slot."""
+    before = llm_handle.recorder_stats.remote().result(timeout_s=120)["cancelled"]
+    gen = llm_handle.options(stream=True).generate_stream.remote(
+        "stream me", max_tokens=4000)
+    first = next(iter(gen))  # provably mid-flight
+    assert isinstance(first, str) and first
+    gen.close()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        stats = llm_handle.recorder_stats.remote().result(timeout_s=120)
+        sched = llm_handle.scheduler_stats.remote().result(timeout_s=120)
+        if (stats["cancelled"] >= before + 1
+                and not sched.get("running") and not sched.get("prefilling")):
+            return
+        time.sleep(0.25)
+    raise AssertionError(
+        f"disconnect never retired the request: {stats} / {sched}")

@@ -283,6 +283,25 @@ def test_rl801_autopilot_scale_op_table_row():
         assert sym not in found, (sym, found.get(sym))
 
 
+def test_rl801_generate_modes_table_rows():
+    """Round 22: the engine token stream (DecodeEngine.open_stream ->
+    TokenStream.close/cancel) and the guided-decoding constraint state
+    (Constraint.begin -> ConstraintState.release) flow through the same
+    RL801 path analysis: an unclosed stream orphans a decode slot behind a
+    vanished consumer, an unreleased constraint state outlives its request
+    (docs/generation.md)."""
+    found = _codes_by_symbol(_fixture("case_rl8_generate.py"))
+    for sym in ("bad_stream_never_closed", "bad_stream_conditional",
+                "bad_stream_risky_gap", "bad_constraint_never_released",
+                "bad_constraint_conditional"):
+        assert found.get(sym) == {"RL801"}, (sym, found.get(sym))
+    for sym in ("ok_stream_finally", "ok_stream_cancel_finally",
+                "ok_stream_stored", "ok_stream_returned", "suppressed_stream",
+                "ok_constraint_finally", "ok_constraint_stored",
+                "suppressed_constraint"):
+        assert sym not in found, (sym, found.get(sym))
+
+
 def test_rl802_fires_and_suppresses():
     findings = _fixture("case_rl802.py")
     by_symbol = {}
